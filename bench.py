@@ -1,0 +1,88 @@
+"""Headline benchmark: Lloyd-iteration points/sec/chip at K=1024, d=128.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md): the reference's best per-GPU rate is
+22.2M pt·iter/s at K=3, d=5 (executions_log.csv:320; it has no successful
+single-GPU row — all 80 died with InternalError — so the single-device anchor
+is that per-GPU rate). BASELINE.md prescribes 1/(K·d) scaling as the honest
+extrapolation basis: 22.2e6 * (3*5) / (1024*128) ≈ 2.54e3 pt·iter/s/device at
+this benchmark's shape. vs_baseline = measured / 2.54e3. (The target in
+BASELINE.json is ≥10x.)
+
+Method: N points (bf16, d=128) resident in HBM; one jit'd Lloyd iteration =
+blocked distance matmul (‖x‖²−2xCᵀ+‖c‖² on the MXU, f32 accumulation) →
+argmin → one-hot-matmul sufficient stats → centroid update. Timed over
+several iterations after a warmup compile, jax.block_until_ready at the end.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.ops.assign import apply_centroid_update, lloyd_stats_blocked
+
+K = 1024
+D = 128
+BLOCK_ROWS = 1 << 17  # 128K-row blocks: (block, K) f32 intermediates = 512 MB
+TIMED_ITERS = 10
+
+BASELINE_PT_ITER_PER_S = 22.2e6 * (3 * 5) / (K * D)  # ≈ 2.54e3, see module doc
+
+
+def pick_n(hbm_bytes: int) -> int:
+    """Points that fit comfortably: bf16 data + f32 block intermediates."""
+    budget = int(hbm_bytes * 0.5)
+    n = budget // (D * 2)  # bf16 point rows
+    return max((n // BLOCK_ROWS) * BLOCK_ROWS, BLOCK_ROWS)
+
+
+@jax.jit
+def lloyd_iter(x, c):
+    stats = lloyd_stats_blocked(x, c, BLOCK_ROWS)
+    return apply_centroid_update(stats, c)
+
+
+def main():
+    dev = jax.devices()[0]
+    try:
+        hbm = dev.memory_stats().get("bytes_limit", 16 << 30)
+    except Exception:
+        hbm = 16 << 30
+    n = pick_n(hbm)
+    if dev.platform == "cpu":  # keep CI/dev runs quick
+        n = min(n, BLOCK_ROWS * 2)
+
+    key = jax.random.PRNGKey(0)
+    kx, kc = jax.random.split(key)
+    x = jax.random.normal(kx, (n, D), jnp.bfloat16)
+    c = jax.random.normal(kc, (K, D), jnp.bfloat16)
+    jax.block_until_ready((x, c))
+
+    c_warm = lloyd_iter(x, c)  # compile + 1 iter
+    jax.block_until_ready(c_warm)
+
+    t0 = time.perf_counter()
+    ci = c
+    for _ in range(TIMED_ITERS):
+        ci = lloyd_iter(x, ci.astype(jnp.bfloat16))
+    jax.block_until_ready(ci)
+    dt = time.perf_counter() - t0
+
+    value = n * TIMED_ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"lloyd_points_per_sec_per_chip_K{K}_d{D}",
+                "value": round(value, 1),
+                "unit": "pt*iter/s/chip",
+                "vs_baseline": round(value / BASELINE_PT_ITER_PER_S, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

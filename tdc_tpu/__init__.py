@@ -1,0 +1,22 @@
+"""tdc_tpu — TPU-native distributed clustering framework.
+
+Re-implements the capabilities of Jhonsonzhangxing/tensorflow-distributed-clustering
+(multi-GPU TF 1.x distributed K-Means / Fuzzy C-Means) as an idiomatic
+JAX / XLA / Pallas / pjit framework for TPU meshes.
+"""
+
+__version__ = "0.1.0"
+
+from tdc_tpu.models.kmeans import KMeansResult, kmeans_fit, kmeans_predict
+from tdc_tpu.models.fuzzy import FuzzyCMeansResult, fuzzy_cmeans_fit
+from tdc_tpu.parallel.mesh import make_mesh
+
+__all__ = [
+    "KMeansResult",
+    "kmeans_fit",
+    "kmeans_predict",
+    "FuzzyCMeansResult",
+    "fuzzy_cmeans_fit",
+    "make_mesh",
+    "__version__",
+]

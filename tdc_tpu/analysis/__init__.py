@@ -1,0 +1,1 @@
+"""Results compilation and profile-trace parsing (reference L6)."""

@@ -1,0 +1,130 @@
+"""Profile-trace and results compiler — `compileResults.py` parity for TPU.
+
+Reference: scripts/compileResults.py parses nvprof text logs (regex-split on
+'==N== Profiling result:' / 'API calls:'; unit-normalized per-kernel rows) into
+profling_result_*.csv / API_calls_*.csv. The TPU equivalent consumes the
+perfetto trace.json.gz files emitted by jax.profiler (tdc_tpu.cli.main
+--profile_dir) and produces the same table shape: one row per op/kernel with
+time %, total time, call count, avg/min/max, name.
+
+Also compiles executions_log.csv into per-method throughput pivot tables
+(n_obs x K x n_devices), the reference's visualization-notebook analysis step.
+
+Run: python -m tdc_tpu.analysis.compile_results --input_dir traces/ --output_dir out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+
+import pandas as pd
+
+
+def parse_trace_file(path: str) -> pd.DataFrame:
+    """Aggregate a perfetto trace into per-op stats.
+
+    Columns mirror the reference parser's output
+    (scripts/compileResults.py:86-105): time %, total seconds, calls,
+    avg/min/max seconds, name. Durations in the trace are microseconds.
+    """
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        trace = json.load(f)
+    events = [
+        e
+        for e in trace.get("traceEvents", [])
+        if e.get("ph") == "X" and "dur" in e and e.get("name")
+    ]
+    if not events:
+        return pd.DataFrame(
+            columns=["time_pct", "total_s", "calls", "avg_s", "min_s", "max_s", "name"]
+        )
+    df = pd.DataFrame(
+        {"name": [e["name"] for e in events], "dur_s": [e["dur"] / 1e6 for e in events]}
+    )
+    g = df.groupby("name")["dur_s"]
+    out = pd.DataFrame(
+        {
+            "total_s": g.sum(),
+            "calls": g.count(),
+            "avg_s": g.mean(),
+            "min_s": g.min(),
+            "max_s": g.max(),
+        }
+    )
+    out["time_pct"] = 100.0 * out["total_s"] / out["total_s"].sum()
+    out = out.sort_values("total_s", ascending=False).reset_index()
+    return out[["time_pct", "total_s", "calls", "avg_s", "min_s", "max_s", "name"]]
+
+
+def compile_traces(input_dir: str, output_dir: str) -> list[str]:
+    """Parse every trace under input_dir → profiling_result_<name>.csv
+    (reference emitted profling_result_* — typo not reproduced)."""
+    os.makedirs(output_dir, exist_ok=True)
+    written = []
+    pattern = os.path.join(input_dir, "**", "*.trace.json*")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        df = parse_trace_file(path)
+        # Tag from the input-relative path, not the basename: jax.profiler
+        # emits identically-named traces in per-run subdirectories.
+        rel = os.path.relpath(path, input_dir)
+        tag = rel.split(".")[0].replace(os.sep, "_") or "trace"
+        out_path = os.path.join(output_dir, f"profiling_result_{tag}.csv")
+        df.to_csv(out_path, index=False)
+        written.append(out_path)
+    return written
+
+
+def compile_log(log_csv: str, output_dir: str) -> list[str]:
+    """Pivot the experiment CSV into per-method throughput tables."""
+    os.makedirs(output_dir, exist_ok=True)
+    df = pd.read_csv(log_csv)
+    written = []
+    num = pd.to_numeric(df["computation_time"], errors="coerce")
+    ok = df[num.notna()].copy()
+    ok["computation_time"] = num[num.notna()]
+    ok["pt_iter_per_s"] = (
+        pd.to_numeric(ok["n_obs"]) * pd.to_numeric(ok["n_iter"], errors="coerce")
+        / ok["computation_time"]
+    )
+    for method, sub in ok.groupby("method_name"):
+        pivot = sub.pivot_table(
+            index=["n_obs", "K"], columns="num_GPUs", values="pt_iter_per_s",
+            aggfunc="max",
+        )
+        out_path = os.path.join(output_dir, f"throughput_{method}.csv")
+        pivot.to_csv(out_path)
+        written.append(out_path)
+    # Failure matrix: the reference's CSV doubles as a pass/fail grid (§4).
+    fail = df[num.isna()]
+    if len(fail):
+        out_path = os.path.join(output_dir, "failures.csv")
+        fail.to_csv(out_path, index=False)
+        written.append(out_path)
+    return written
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tdc_tpu.analysis.compile_results")
+    p.add_argument("--input_dir", help="directory of jax profiler traces")
+    p.add_argument("--log_csv", help="experiment results CSV to pivot")
+    p.add_argument("--output_dir", required=True)
+    args = p.parse_args(argv)
+    written = []
+    if args.input_dir:
+        written += compile_traces(args.input_dir, args.output_dir)
+    if args.log_csv:
+        written += compile_log(args.log_csv, args.output_dir)
+    for w in written:
+        print(w)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

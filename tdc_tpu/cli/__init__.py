@@ -1,0 +1,1 @@
+"""Experiment harness: CLI, sweep runner, results parsing."""

@@ -1,0 +1,97 @@
+"""Sweep runner — the reference's experiment matrix driver, config-as-data.
+
+Reference: scripts/new_experiment.py:30-64 (and generate-logs.py): nested
+hard-coded loops over n_obs x K x n_GPUs x method, each config run as a
+subprocess under nvprof for crash isolation, results appended to one CSV.
+Here the matrix is a JSON spec, isolation is still per-config subprocess, and
+profiling is jax.profiler traces via --profile_dir.
+
+Spec format (JSON):
+{
+  "data": {"n_obs": [1000000], "n_dim": [8], "seed": 123128},
+  "grid": {"K": [3, 9, 15], "n_devices": [1], "method_name": ["distributedKMeans"]},
+  "fixed": {"n_max_iters": 20, "tol": -1.0},
+  "log_file": "executions_log.csv"
+}
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import subprocess
+import sys
+
+
+def expand_grid(spec: dict) -> list[dict]:
+    """Cartesian product of data x grid axes, reference loop-nest order
+    (n_obs outermost, then grid keys in declaration order)."""
+    data = spec.get("data", {})
+    grid = dict(spec.get("grid", {}))
+    fixed = spec.get("fixed", {})
+    axes = {"n_obs": data.get("n_obs", [None]), "n_dim": data.get("n_dim", [None])}
+    axes.update(grid)
+    configs = []
+    for combo in itertools.product(*axes.values()):
+        cfg = dict(zip(axes.keys(), combo))
+        cfg.update(fixed)
+        if "seed" in data:
+            cfg.setdefault("seed", data["seed"])
+        configs.append({k: v for k, v in cfg.items() if v is not None})
+    return configs
+
+
+def config_argv(cfg: dict, log_file: str | None) -> list[str]:
+    argv = [sys.executable, "-m", "tdc_tpu.cli.main"]
+    rename = {"n_devices": "n_GPUs"}
+    for k, v in cfg.items():
+        flag = rename.get(k, k)
+        if isinstance(v, bool):
+            if v:
+                argv.append(f"--{flag}")
+        else:
+            argv.append(f"--{flag}={v}")
+    if log_file:
+        argv.append(f"--log_file={log_file}")
+    return argv
+
+
+def run_sweep(spec: dict, *, dry_run: bool = False, isolate: bool = True) -> list[int]:
+    """Run every config; per-config subprocess isolation (reference :59) so a
+    hard crash can't kill the sweep. Returns per-config exit codes."""
+    log_file = spec.get("log_file")
+    codes = []
+    configs = expand_grid(spec)
+    for i, cfg in enumerate(configs):
+        argv = config_argv(cfg, log_file)
+        print(f"[{i + 1}/{len(configs)}] {' '.join(argv[2:])}", flush=True)
+        if dry_run:
+            codes.append(0)
+            continue
+        if isolate:
+            proc = subprocess.run(argv)
+            codes.append(proc.returncode)
+            print(f"  -> exit {proc.returncode}", flush=True)
+        else:
+            from tdc_tpu.cli.main import main as run_main
+            codes.append(run_main(argv[3:]))
+    return codes
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tdc_tpu.sweep")
+    p.add_argument("spec", help="JSON sweep spec path, or '-' for stdin")
+    p.add_argument("--dry_run", action="store_true")
+    p.add_argument("--no_isolate", action="store_true",
+                   help="run in-process (faster, no crash isolation)")
+    args = p.parse_args(argv)
+    spec = json.load(sys.stdin if args.spec == "-" else open(args.spec))
+    codes = run_sweep(spec, dry_run=args.dry_run, isolate=not args.no_isolate)
+    failed = sum(1 for c in codes if c != 0)
+    print(f"sweep done: {len(codes) - failed}/{len(codes)} ok")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

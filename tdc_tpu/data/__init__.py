@@ -1,0 +1,16 @@
+"""Data generation, loading, and HBM-aware batching."""
+
+from tdc_tpu.data.synthetic import make_blobs, make_classification_data, save_npz
+from tdc_tpu.data.loader import load_points, batch_iterator, NpzStream
+from tdc_tpu.data.batching import auto_batch_size, oom_adaptive
+
+__all__ = [
+    "make_blobs",
+    "make_classification_data",
+    "save_npz",
+    "load_points",
+    "batch_iterator",
+    "NpzStream",
+    "auto_batch_size",
+    "oom_adaptive",
+]

@@ -1,0 +1,72 @@
+"""HBM-aware batch sizing and OOM-adaptive retry.
+
+Reference counterparts: the hand-tuned per-GPU-count max_size table
+(New-Distributed-KMeans.ipynb#cell13: e.g. 2x134217728*itemsize for 8 GPUs) and
+the OOM-halving loop (`except ResourceExhaustedError: num_batches *= 2`,
+scripts/distribuitedClustering.py:357-360). Here the initial size is *computed*
+from device memory and the working-set model of the matmul-form kernels, and the
+retry loop is a reusable combinator that doubles num_batches on RESOURCE_EXHAUSTED.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+import jax
+
+T = TypeVar("T")
+
+# The matmul-form Lloyd working set per device, in bytes per point row:
+#   x row (d f32) + distance row (K f32, fused but budgeted) + one-hot row
+#   (K f32 when XLA materializes it). Everything else (centroids, stats) is
+#   O(K*d), independent of N.
+_DEFAULT_HBM_BYTES = 16 << 30  # v5e = 16 GiB HBM per chip
+_SAFETY_FRACTION = 0.6
+
+
+def device_hbm_bytes(device=None) -> int:
+    dev = device or jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return _DEFAULT_HBM_BYTES
+
+
+def auto_batch_size(
+    n_dim: int, k: int, *, n_devices: int = 1, itemsize: int = 4, device=None
+) -> int:
+    """Max points per *global* batch that fit the per-device working set.
+
+    Replaces the magic table keyed on GPU count (New-Distributed-KMeans.ipynb#cell13)
+    with bytes_limit-derived sizing: rows_per_device = safety * HBM / bytes_per_row.
+    """
+    bytes_per_row = itemsize * n_dim + 4 * k + 4 * k  # x + dists + one-hot, f32
+    per_device = int(_SAFETY_FRACTION * device_hbm_bytes(device) / bytes_per_row)
+    return max(per_device * n_devices, 1)
+
+
+def is_oom_error(e: BaseException) -> bool:
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "out of memory" in msg
+
+
+def oom_adaptive(
+    run: Callable[[int], T], *, initial_num_batches: int = 1, max_doublings: int = 12
+) -> tuple[T, int]:
+    """Call run(num_batches); on an OOM error double num_batches and retry
+    (reference semantics, :357-360). Returns (result, num_batches_used)."""
+    num_batches = initial_num_batches
+    for _ in range(max_doublings + 1):
+        try:
+            return run(num_batches), num_batches
+        except Exception as e:  # jaxlib raises XlaRuntimeError; match by message
+            if not is_oom_error(e):
+                raise
+            num_batches *= 2
+    raise MemoryError(
+        f"still RESOURCE_EXHAUSTED after {max_doublings} doublings "
+        f"(num_batches={num_batches})"
+    )

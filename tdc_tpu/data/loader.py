@@ -1,0 +1,79 @@
+"""Dataset loading and streaming.
+
+Reference counterparts: `np.load(data_file)` + `np.array_split`
+(scripts/distribuitedClustering.py:322-335) — which stage the *entire* dataset
+through a single feed_dict (:273), the anti-pattern behind its OOM envelope —
+and the abandoned tf.data prototype (batching_tests.ipynb#cell5-7). Here
+loading is memmap-backed and batches stream host→device with double buffering
+via jax's async dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import numpy as np
+
+
+def load_points(data_file: str, *, mmap: bool = True):
+    """Load (X, Y) from an .npz (keys 'X','Y', reference layout) or a .npy.
+
+    .npz members can't be memmapped directly; for large out-of-core runs prefer
+    .npy (np.lib.format.open_memmap) or convert once with NpzStream.to_npy.
+    """
+    if data_file.endswith(".npz"):
+        with np.load(data_file, allow_pickle=False) as z:
+            x = z["X"]
+            y = z["Y"] if "Y" in z.files else None
+        return x, y
+    mode = "r" if mmap else None
+    x = np.load(data_file, mmap_mode=mode)
+    return x, None
+
+
+def batch_iterator(
+    x: np.ndarray, num_batches: int
+) -> Iterator[np.ndarray]:
+    """Sequential contiguous batches, np.array_split semantics (reference :335)."""
+    n = x.shape[0]
+    base, extra = divmod(n, num_batches)
+    start = 0
+    for i in range(num_batches):
+        size = base + (1 if i < extra else 0)
+        yield x[start : start + size]
+        start += size
+
+
+class NpzStream:
+    """Re-iterable batch stream over a memmapped array or in-memory array.
+
+    `callable` protocol matches models/streaming.py: stream() returns a fresh
+    iterator each call (one full pass per Lloyd iteration).
+    """
+
+    def __init__(self, x: np.ndarray, batch_rows: int):
+        self.x = x
+        self.batch_rows = int(batch_rows)
+
+    def __call__(self) -> Iterator[np.ndarray]:
+        n = self.x.shape[0]
+        for start in range(0, n, self.batch_rows):
+            yield np.ascontiguousarray(self.x[start : start + self.batch_rows])
+
+    @property
+    def num_batches(self) -> int:
+        return -(-self.x.shape[0] // self.batch_rows)
+
+    @staticmethod
+    def to_npy(npz_path: str, npy_path: str, key: str = "X", chunk: int = 1 << 22) -> str:
+        """One-time .npz → memmappable .npy conversion for out-of-core runs."""
+        with np.load(npz_path, allow_pickle=False) as z:
+            src = z[key]
+            out = np.lib.format.open_memmap(
+                npy_path, mode="w+", dtype=src.dtype, shape=src.shape
+            )
+            for s in range(0, src.shape[0], chunk):
+                out[s : s + chunk] = src[s : s + chunk]
+            out.flush()
+        return npy_path

@@ -1,0 +1,66 @@
+"""Synthetic dataset generation.
+
+Reference counterpart: `make_data` (scripts/new_experiment.py:9-27) — sklearn
+`make_classification(n_obs, n_dim, n_classes=2, class_sep=1.5)` dumped to .npz —
+and the notebook variant (New-Distributed-KMeans.ipynb#cell3). sklearn's
+generator is CPU-serial and was the sweep's slowest non-compute phase at 100M
+rows; here generation is jit-compiled on device in chunks and is deterministic
+given a seed across chip counts.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_blobs(
+    seed: int, n_obs: int, n_dim: int, k: int, *, class_sep: float = 1.5, dtype=np.float32
+):
+    """Gaussian blobs: (X (n_obs, n_dim) dtype, y (n_obs,) int32) on host.
+
+    Generated in ≤2^24-row device chunks so 1B-row datasets don't need
+    1B-row device buffers.
+    """
+    chunk = min(n_obs, 1 << 24)
+    key = jax.random.PRNGKey(seed)
+    xs, ys = [], []
+    remaining = n_obs
+    while remaining > 0:
+        key, kchunk = jax.random.split(key)
+        # centers must match across chunks: derive them from the *seed*, not
+        # the rolling key.
+        n = min(chunk, remaining)
+        x, y = _blobs_chunk_fixed_centers(jax.random.PRNGKey(seed), kchunk, n, n_dim, k, class_sep)
+        xs.append(np.asarray(x, dtype=dtype))
+        ys.append(np.asarray(y))
+        remaining -= n
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+@partial(jax.jit, static_argnames=("n", "d", "k"))
+def _blobs_chunk_fixed_centers(
+    center_key: jax.Array, chunk_key: jax.Array, n: int, d: int, k: int, class_sep: float
+):
+    centers = (
+        jax.random.uniform(center_key, (k, d), minval=-1.0, maxval=1.0) * 2.0 * class_sep
+    )
+    kl, kn = jax.random.split(chunk_key)
+    labels = jax.random.randint(kl, (n,), 0, k)
+    noise = jax.random.normal(kn, (n, d))
+    return centers[labels] + noise, labels.astype(jnp.int32)
+
+
+def make_classification_data(seed: int, n_obs: int, n_dim: int, *, class_sep: float = 1.5):
+    """2-class variant matching the reference's make_data signature
+    (scripts/new_experiment.py:9-27): n_classes=2, class_sep=1.5."""
+    return make_blobs(seed, n_obs, n_dim, 2, class_sep=class_sep)
+
+
+def save_npz(filepath: str, x: np.ndarray, y: np.ndarray) -> None:
+    """Persist in the reference's .npz layout (keys 'X', 'Y';
+    scripts/new_experiment.py:25)."""
+    np.savez(filepath, X=x, Y=y)

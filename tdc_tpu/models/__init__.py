@@ -1,0 +1,17 @@
+"""Clustering algorithms (the reference's L1+L3 layers, TPU-native)."""
+
+from tdc_tpu.models.kmeans import KMeansResult, kmeans_fit, kmeans_predict
+from tdc_tpu.models.fuzzy import FuzzyCMeansResult, fuzzy_cmeans_fit, fuzzy_predict
+from tdc_tpu.models.minibatch import MiniBatchKMeans
+from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+__all__ = [
+    "KMeansResult",
+    "kmeans_fit",
+    "kmeans_predict",
+    "FuzzyCMeansResult",
+    "fuzzy_cmeans_fit",
+    "fuzzy_predict",
+    "MiniBatchKMeans",
+    "streamed_kmeans_fit",
+]

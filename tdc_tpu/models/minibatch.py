@@ -1,0 +1,92 @@
+"""Mini-batch K-Means (BASELINE.json config 3).
+
+The reference approximates out-of-core K-Means by running full Lloyd per batch
+and taking the *unweighted mean of per-batch centroids*
+(scripts/distribuitedClustering.py:310, defect 8). This module implements the
+principled alternative: per-center learning-rate updates (Sculley 2010 style, as
+in sklearn MiniBatchKMeans) with a single jit-compiled step. For *exact*
+out-of-core Lloyd see models/streaming.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.ops.assign import lloyd_stats
+from tdc_tpu.models.kmeans import resolve_init
+
+
+class MiniBatchState(NamedTuple):
+    centroids: jax.Array  # (K, d) float32
+    counts: jax.Array  # (K,) float32 — lifetime per-center point counts
+    step: jax.Array  # () int32
+    last_sse: jax.Array  # () float32 — SSE of the last batch
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def minibatch_step(state: MiniBatchState, batch: jax.Array) -> MiniBatchState:
+    """One mini-batch update: assign batch, move each centroid toward its batch
+    mean with per-center rate 1/lifetime_count."""
+    stats = lloyd_stats(batch, state.centroids)
+    new_counts = state.counts + stats.counts
+    # c <- c + (sum_b - n_b * c) / max(total_count, 1): equivalently a running
+    # average over every point the center has ever absorbed.
+    denom = jnp.maximum(new_counts, 1.0)[:, None]
+    delta = (stats.sums - stats.counts[:, None] * state.centroids) / denom
+    return MiniBatchState(
+        centroids=state.centroids + delta,
+        counts=new_counts,
+        step=state.step + 1,
+        last_sse=stats.sse,
+    )
+
+
+class MiniBatchKMeans:
+    """Host-side driver: feed batches (numpy or jax) through jit'd steps.
+
+    Usage:
+        mbk = MiniBatchKMeans(k=1024, d=128, init=c0)
+        for batch in loader:
+            mbk.partial_fit(batch)
+        labels = kmeans_predict(x, mbk.centroids)
+    """
+
+    def __init__(self, k: int, d: int, *, init=None, key=None):
+        self.k, self.d = k, d
+        self._state: MiniBatchState | None = None
+        self._init_spec = init
+        self._key = key
+
+    def _ensure_init(self, batch: jax.Array):
+        if self._state is not None:
+            return
+        init = "kmeans++" if self._init_spec is None else self._init_spec
+        c0 = resolve_init(jnp.asarray(batch), self.k, init, self._key)
+        self._state = MiniBatchState(
+            centroids=c0,
+            counts=jnp.zeros((self.k,), jnp.float32),
+            step=jnp.asarray(0, jnp.int32),
+            last_sse=jnp.asarray(jnp.inf, jnp.float32),
+        )
+
+    def partial_fit(self, batch) -> "MiniBatchKMeans":
+        batch = jnp.asarray(batch)
+        self._ensure_init(batch)
+        self._state = minibatch_step(self._state, batch)
+        return self
+
+    @property
+    def centroids(self) -> jax.Array:
+        if self._state is None:
+            raise ValueError("partial_fit was never called")
+        return self._state.centroids
+
+    @property
+    def state(self) -> MiniBatchState:
+        if self._state is None:
+            raise ValueError("partial_fit was never called")
+        return self._state

@@ -1,0 +1,33 @@
+"""Compute kernels: distances, assignment, sufficient statistics, seeding."""
+
+from tdc_tpu.ops.distance import (
+    pairwise_sq_dist,
+    pairwise_dist,
+    cosine_similarity,
+)
+from tdc_tpu.ops.assign import (
+    assign_clusters,
+    cluster_stats,
+    lloyd_stats,
+    fuzzy_stats,
+    apply_centroid_update,
+)
+from tdc_tpu.ops.init import (
+    init_first_k,
+    init_random,
+    init_kmeans_pp,
+)
+
+__all__ = [
+    "pairwise_sq_dist",
+    "pairwise_dist",
+    "cosine_similarity",
+    "assign_clusters",
+    "cluster_stats",
+    "lloyd_stats",
+    "fuzzy_stats",
+    "apply_centroid_update",
+    "init_first_k",
+    "init_random",
+    "init_kmeans_pp",
+]

@@ -1,0 +1,175 @@
+"""Assignment + sufficient-statistics kernels.
+
+The reference has two centroid-update variants:
+  A) K separate gather/where/reduce_mean passes (scripts/distribuitedClustering.py:238-240)
+     — NaN on empty clusters;
+  B) tf.unsorted_segment_sum of X and of ones (visualization.ipynb#cell5) — the
+     better one, guarded with tf.where(is_nan -> 0) which snaps empty clusters to
+     the origin.
+
+On TPU both become one *one-hot matmul*: one_hot(assign, K)^T @ X rides the MXU
+and returns (K, d) partial sums; its column sum is the counts (replacing the
+reference's CPU-side tf.bincount at :245-246). Empty clusters keep their previous
+centroid (deterministic; no NaN, no snap-to-origin) — see `apply_centroid_update`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.ops.distance import pairwise_sq_dist
+
+
+class SufficientStats(NamedTuple):
+    """Per-shard (or globally reduced) Lloyd sufficient statistics."""
+
+    sums: jax.Array  # (K, d) Σx per cluster
+    counts: jax.Array  # (K,) points per cluster
+    sse: jax.Array  # () sum of min squared distances (the cost the reference
+    #                  commented out "for performance", visualization.ipynb#cell5)
+
+
+def assign_clusters(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    """Hard assignment: argmin over squared distances (reference :234)."""
+    return jnp.argmin(pairwise_sq_dist(x, centroids), axis=-1).astype(jnp.int32)
+
+
+def cluster_stats(x: jax.Array, assign: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """(Σx per cluster, counts) from a precomputed assignment.
+
+    one_hot^T @ x is an (K, N) x (N, d) matmul — MXU-friendly, exact in f32.
+    """
+    # bf16 x: one-hot entries (0/1) are exact in bf16 and the MXU accumulates
+    # in f32 via preferred_element_type, so the per-cluster sums are the exact
+    # f32 sums of the (bf16-rounded) inputs in a single MXU pass. f32 x:
+    # HIGHEST-precision pass for exactness.
+    if x.dtype == jnp.bfloat16:
+        one_hot = jax.nn.one_hot(assign, k, dtype=jnp.bfloat16)  # (N, K)
+        precision = jax.lax.Precision.DEFAULT
+    else:
+        one_hot = jax.nn.one_hot(assign, k, dtype=jnp.float32)
+        x = x.astype(jnp.float32)
+        precision = jax.lax.Precision.HIGHEST
+    sums = jax.lax.dot_general(
+        one_hot,
+        x,
+        (((0,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    )  # (K, d)
+    counts = jnp.sum(one_hot.astype(jnp.float32), axis=0)  # (K,)
+    return sums, counts
+
+
+def lloyd_stats(x: jax.Array, centroids: jax.Array) -> SufficientStats:
+    """Fused distance → argmin → one-hot-matmul sufficient stats.
+
+    This is the per-shard tower body (reference L1,
+    scripts/distribuitedClustering.py:207-251) as one fused XLA computation.
+    """
+    d2 = pairwise_sq_dist(x, centroids)  # (N, K)
+    assign = jnp.argmin(d2, axis=-1)
+    sse = jnp.sum(jnp.min(d2, axis=-1))
+    sums, counts = cluster_stats(x, assign.astype(jnp.int32), centroids.shape[0])
+    return SufficientStats(sums=sums, counts=counts, sse=sse)
+
+
+def lloyd_stats_blocked(
+    x: jax.Array, centroids: jax.Array, block_rows: int
+) -> SufficientStats:
+    """lloyd_stats over N-blocks via lax.scan — bounds the materialized
+    (block, K) distance/one-hot intermediates to VMEM-friendly sizes so large-N
+    iterations never allocate the full N x K matrix in HBM.
+
+    Requires N % block_rows == 0 (pad upstream; see data/batching.py).
+    """
+    n, d = x.shape
+    k = centroids.shape[0]
+    if n % block_rows != 0:
+        raise ValueError(f"N={n} not divisible by block_rows={block_rows}")
+    xb = x.reshape(n // block_rows, block_rows, d)
+
+    def body(acc, blk):
+        s = lloyd_stats(blk, centroids)
+        return (
+            SufficientStats(
+                sums=acc.sums + s.sums,
+                counts=acc.counts + s.counts,
+                sse=acc.sse + s.sse,
+            ),
+            None,
+        )
+
+    zero = SufficientStats(
+        sums=jnp.zeros((k, d), jnp.float32),
+        counts=jnp.zeros((k,), jnp.float32),
+        sse=jnp.zeros((), jnp.float32),
+    )
+    acc, _ = jax.lax.scan(body, zero, xb)
+    return acc
+
+
+def apply_centroid_update(
+    stats: SufficientStats, prev_centroids: jax.Array
+) -> jax.Array:
+    """New centroids = Σx / count, keeping the previous centroid for empty
+    clusters (deterministic under psum; fixes reference defect 6 where variant A
+    yields NaN and variant B snaps empty clusters to the origin)."""
+    counts = stats.counts[:, None]
+    safe = jnp.maximum(counts, 1.0)
+    new = stats.sums / safe
+    return jnp.where(counts > 0, new, prev_centroids.astype(new.dtype))
+
+
+class FuzzyStats(NamedTuple):
+    weighted_sums: jax.Array  # (K, d) Σ u^m x
+    weights: jax.Array  # (K,) Σ u^m
+    objective: jax.Array  # () Σ u^m d²  (the fuzzy c-means objective J_m)
+
+
+def _memberships_from_d2(d2: jax.Array, m: float, eps: float) -> jax.Array:
+    """u = d2^(-1/(m-1)) normalized over K; eps keeps a point sitting exactly
+    on a centroid at full membership there instead of NaN."""
+    inv = (d2 + eps) ** (-1.0 / (m - 1.0))
+    return inv / jnp.sum(inv, axis=-1, keepdims=True)
+
+
+def fuzzy_memberships(
+    x: jax.Array, centroids: jax.Array, m: float = 2.0, eps: float = 1e-9
+) -> jax.Array:
+    """Fuzzy membership matrix U (N, K).
+
+    u_ik = 1 / Σ_j (d_ik / d_ij)^(2/(m-1)), computed stably in log-free form from
+    squared distances:  u = d2^(-1/(m-1)) normalized over K.
+
+    The reference computes u = d^(-2/(M-1)) with a NaN guard
+    (scripts/distribuitedClustering.py:117-126) but binds M to the *data
+    dimensionality* (defect 7); here `m` is an explicit fuzzifier, default 2.
+    """
+    return _memberships_from_d2(pairwise_sq_dist(x, centroids), m, eps)
+
+
+def fuzzy_stats(
+    x: jax.Array, centroids: jax.Array, m: float = 2.0, eps: float = 1e-9
+) -> FuzzyStats:
+    """Fused fuzzy tower: memberships → MU = u^m → (MU^T x, ΣMU, J_m).
+
+    Mirrors reference :129-148 (MU = u^M; partial_MU_x = MU^T @ X; global
+    division) with the fuzzifier decoupled from d.
+    """
+    d2 = pairwise_sq_dist(x, centroids)
+    u = _memberships_from_d2(d2, m, eps)
+    mu = u**m  # (N, K)
+    weighted_sums = jax.lax.dot_general(
+        mu,
+        x.astype(jnp.float32),
+        (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    weights = jnp.sum(mu, axis=0)
+    objective = jnp.sum(mu * d2)
+    return FuzzyStats(weighted_sums, weights, objective)

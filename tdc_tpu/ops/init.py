@@ -1,0 +1,60 @@
+"""Centroid seeding.
+
+The reference seeds either with the first K rows (New-Distributed-KMeans.ipynb#cell10)
+or sklearn's serial CPU k-means++ via the latent-NameError call
+`k_means_._init_centroids(data, K, 'k-means++')` (scripts/distribuitedClustering.py:82,191,
+Testing Images.ipynb#cell1). Here all seeding runs on device, is jit-able, and is
+deterministic given a PRNG key.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.ops.distance import pairwise_sq_dist
+
+
+def init_first_k(x: jax.Array, k: int) -> jax.Array:
+    """First-K-rows seeding (reference parity: initial_centers = X[0:K])."""
+    return x[:k].astype(jnp.float32)
+
+
+def init_random(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
+    """K distinct uniform-random points as seeds."""
+    idx = jax.random.choice(key, x.shape[0], shape=(k,), replace=False)
+    return x[idx].astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def init_kmeans_pp(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
+    """Device-resident k-means++ (D² sampling), jit-able via lax.fori_loop.
+
+    Replaces the reference's CPU sklearn seeding. O(K·N·d) total; each round
+    updates a running min-squared-distance vector instead of recomputing all
+    pairwise distances, and samples the next center ~ D².
+    """
+    n = x.shape[0]
+    xf = x.astype(jnp.float32)
+    key, k0 = jax.random.split(key)
+    first = jax.random.randint(k0, (), 0, n)
+    centers = jnp.zeros((k, x.shape[1]), jnp.float32).at[0].set(xf[first])
+    d2 = pairwise_sq_dist(xf, xf[first][None, :])[:, 0]  # (N,)
+
+    def body(i, carry):
+        centers, d2, key = carry
+        key, ki = jax.random.split(key)
+        # Sample proportional to D²; gumbel-top-1 on log weights is
+        # categorical sampling without building a cumulative sum.
+        logw = jnp.where(d2 > 0, jnp.log(d2), -jnp.inf)
+        g = jax.random.gumbel(ki, (n,))
+        nxt = jnp.argmax(logw + g)
+        c = xf[nxt]
+        centers = centers.at[i].set(c)
+        d2 = jnp.minimum(d2, pairwise_sq_dist(xf, c[None, :])[:, 0])
+        return centers, d2, key
+
+    centers, _, _ = jax.lax.fori_loop(1, k, body, (centers, d2, key))
+    return centers

@@ -1,0 +1,20 @@
+"""Mesh construction, sharding helpers, and explicit-collective steps."""
+
+from tdc_tpu.parallel.mesh import (
+    make_mesh,
+    shard_points,
+    replicate,
+    data_sharding,
+    replicated_sharding,
+)
+from tdc_tpu.parallel.collectives import distributed_lloyd_stats, distributed_fuzzy_stats
+
+__all__ = [
+    "make_mesh",
+    "shard_points",
+    "replicate",
+    "data_sharding",
+    "replicated_sharding",
+    "distributed_lloyd_stats",
+    "distributed_fuzzy_stats",
+]

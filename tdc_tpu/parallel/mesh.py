@@ -1,0 +1,67 @@
+"""Device-mesh construction and sharding specs.
+
+Replaces the reference's communication layer (SURVEY.md §2.4): its broadcast was
+a /cpu:0 tf.Variable read by every GPU tower through an implicit H2D copy
+(scripts/distribuitedClustering.py:199,221); its all-reduce was tf.add_n on the
+CPU (:257-258). Here the data axis is a `jax.sharding.Mesh` axis: points are
+sharded along it, centroids are replicated in HBM, and the reduce is a psum (or
+an XLA-inserted all-reduce when using the auto-sharded jit path).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D data-parallel mesh over the first `n_devices` devices.
+
+    The reference selected GPUs uniformly at random *without a seed*
+    (scripts/distribuitedClustering.py:69, defect 3); device choice here is
+    deterministic: the first n in `jax.devices()` order.
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:n_devices]), (axis_name,))
+
+
+def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Shard leading (points) axis across the mesh."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (centroids and other model state)."""
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(x, multiple: int, fill_value=np.nan):
+    """Pad the leading axis to a multiple of `multiple` (mesh size) so the
+    array is evenly shardable. Returns (padded, n_valid)."""
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_width = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(np.asarray(x), pad_width, constant_values=fill_value), n
+
+
+def shard_points(x, mesh: Mesh, axis_name: str = DATA_AXIS) -> jax.Array:
+    """Place points on the mesh sharded along the data axis.
+
+    Replaces the reference's tf.split-on-CPU + per-tower Variables staged
+    through a full-dataset feed_dict (scripts/distribuitedClustering.py:197,217,273).
+    """
+    return jax.device_put(x, data_sharding(mesh, axis_name))
+
+
+def replicate(x, mesh: Mesh) -> jax.Array:
+    """Place an array fully replicated on every device of the mesh."""
+    return jax.device_put(x, replicated_sharding(mesh))

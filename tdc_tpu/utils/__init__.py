@@ -1,0 +1,17 @@
+"""Timers, experiment CSV logging, error capture."""
+
+from tdc_tpu.utils.timing import PhaseTimers
+from tdc_tpu.utils.logging import (
+    REFERENCE_COLUMNS,
+    EXTENDED_COLUMNS,
+    ensure_log_file,
+    append_result_row,
+)
+
+__all__ = [
+    "PhaseTimers",
+    "REFERENCE_COLUMNS",
+    "EXTENDED_COLUMNS",
+    "ensure_log_file",
+    "append_result_row",
+]

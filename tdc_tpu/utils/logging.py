@@ -1,0 +1,66 @@
+"""Append-only experiment CSV with the reference's schema plus TPU extensions.
+
+Reference: 10-column header written on demand
+(scripts/distribuitedClustering.py:30-36), one row appended per run (:379-405),
+with exception *names* written into the metric columns on failure (:362-377) so
+the log doubles as a pass/fail matrix. We keep those semantics and add
+backend / n_chips / throughput / convergence columns (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+REFERENCE_COLUMNS = [
+    "method_name",
+    "seed",
+    "num_GPUs",  # kept under the reference's name; means "num devices" here
+    "K",
+    "n_obs",
+    "n_dim",
+    "setup_time",
+    "initialization_time",
+    "computation_time",
+    "n_iter",
+]
+
+EXTENDED_COLUMNS = REFERENCE_COLUMNS + [
+    "backend",
+    "n_chips",
+    "points_per_sec_per_chip",
+    "sse",
+    "converged",
+    "num_batches",
+    "status",
+]
+
+
+def ensure_log_file(path: str, columns=None) -> None:
+    """Create the CSV with a header iff absent (reference `is_valid_file`
+    semantics, :30-36)."""
+    columns = columns or EXTENDED_COLUMNS
+    if not os.path.exists(path):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", newline="") as f:
+            csv.writer(f).writerow(columns)
+
+
+def append_result_row(path: str, row: dict, columns=None) -> None:
+    columns = columns or EXTENDED_COLUMNS
+    ensure_log_file(path, columns)
+    with open(path, "a", newline="") as f:
+        csv.writer(f).writerow([row.get(c, "") for c in columns])
+
+
+def error_row(base: dict, exc: BaseException) -> dict:
+    """Reference defect-preserving behavior done right: on failure, write the
+    exception class name into every metric column (:362-377) and set status."""
+    name = type(exc).__name__
+    row = dict(base)
+    for c in ("setup_time", "initialization_time", "computation_time", "n_iter",
+              "points_per_sec_per_chip", "sse"):
+        row[c] = name
+    row["converged"] = False
+    row["status"] = f"error:{name}"
+    return row

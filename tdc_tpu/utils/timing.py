@@ -1,0 +1,46 @@
+"""Three-phase wall-clock timers with async-dispatch safety.
+
+Reference schema (scripts/distribuitedClustering.py): setup_time (graph build,
+:181/265), initialization_time (var init + H2D, :272-274), computation_time
+(accumulated per-iteration sess.run, :276-280). JAX dispatch is asynchronous, so
+every phase boundary calls jax.block_until_ready on the tensors produced in that
+phase — otherwise compute time would be booked into whichever phase first
+touches the result.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+import jax
+
+
+class PhaseTimers:
+    """Accumulating named phase timers.
+
+    with timers.phase("computation", block_on=result): ...
+    """
+
+    def __init__(self):
+        self.seconds: dict[str, float] = {}
+
+    @contextmanager
+    def phase(self, name: str, block_on=None):
+        t0 = time.perf_counter()
+        out = {}
+        try:
+            yield out
+        finally:
+            target = out.get("block_on", block_on)
+            if target is not None:
+                jax.block_until_ready(target)
+            self.seconds[name] = self.seconds.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def get(self, name: str) -> float:
+        return self.seconds.get(name, 0.0)
+
+    def as_dict(self) -> dict[str, float]:
+        return dict(self.seconds)

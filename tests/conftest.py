@@ -1,0 +1,44 @@
+"""Test env: simulate an 8-device TPU pod on CPU (SURVEY.md §4).
+
+Must run before jax is imported anywhere: forces the CPU platform with 8
+virtual devices so mesh/psum tests exercise real multi-device sharding without
+hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The environment may pre-import jax and pin jax_platforms (e.g. a PJRT plugin
+# registered from sitecustomize); override via config too, which works as long
+# as no backend has been initialized yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(123128)  # the reference sweep's --seed
+
+
+@pytest.fixture(scope="session")
+def blobs_small():
+    """Well-separated 3-cluster blobs (the reference's canonical validation
+    shape: visualization.ipynb uses 500k x 3, K=15; we shrink for CI)."""
+    rng = np.random.default_rng(0)
+    centers = np.array([[0.0, 0.0], [10.0, 0.0], [0.0, 10.0]], np.float32)
+    x = np.concatenate(
+        [rng.normal(c, 1.0, size=(400, 2)).astype(np.float32) for c in centers]
+    )
+    y = np.repeat(np.arange(3), 400)
+    perm = rng.permutation(len(x))
+    return x[perm], y[perm], centers

@@ -1,0 +1,101 @@
+"""Data layer tests: synthetic generation, npz round-trip, batching, OOM retry."""
+
+import numpy as np
+import pytest
+
+from tdc_tpu.data import (
+    make_blobs,
+    make_classification_data,
+    save_npz,
+    load_points,
+    batch_iterator,
+    NpzStream,
+    auto_batch_size,
+    oom_adaptive,
+)
+
+
+def test_blobs_deterministic():
+    x1, y1 = make_blobs(7, 1000, 4, 3)
+    x2, y2 = make_blobs(7, 1000, 4, 3)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+    assert x1.shape == (1000, 4) and y1.shape == (1000,)
+    assert x1.dtype == np.float32
+
+
+def test_blobs_chunked_consistent_centers():
+    # Chunked generation must use the same centers for every chunk: per-label
+    # means should agree between a small and a (chunk-split) large draw.
+    x, y = make_blobs(3, 5000, 3, 4, class_sep=5.0)
+    for k in range(4):
+        pts = x[y == k]
+        assert pts.std(axis=0).max() < 2.0  # one tight blob, not a mixture
+
+
+def test_make_classification_two_classes():
+    x, y = make_classification_data(1826273, 2000, 5)  # the reference data seed
+    assert set(np.unique(y)) == {0, 1}
+
+
+def test_npz_roundtrip(tmp_path):
+    x, y = make_blobs(0, 100, 3, 2)
+    p = str(tmp_path / "d.npz")
+    save_npz(p, x, y)
+    x2, y2 = load_points(p)
+    np.testing.assert_array_equal(x, x2)
+    np.testing.assert_array_equal(y, y2)
+
+
+def test_npy_memmap_roundtrip(tmp_path):
+    x, y = make_blobs(0, 100, 3, 2)
+    pz = str(tmp_path / "d.npz")
+    save_npz(pz, x, y)
+    pn = NpzStream.to_npy(pz, str(tmp_path / "d.npy"))
+    x2, _ = load_points(pn)
+    np.testing.assert_array_equal(x, np.asarray(x2))
+
+
+def test_batch_iterator_array_split_semantics():
+    x = np.arange(10)[:, None]
+    batches = list(batch_iterator(x, 3))
+    got = np.concatenate(batches)[:, 0]
+    np.testing.assert_array_equal(got, np.arange(10))
+    assert [len(b) for b in batches] == [len(s) for s in np.array_split(x, 3)]
+
+
+def test_npz_stream_reiterable():
+    x = np.arange(20).reshape(10, 2)
+    s = NpzStream(x, 3)
+    assert s.num_batches == 4
+    for _ in range(2):  # two full passes, fresh iterator each
+        np.testing.assert_array_equal(np.concatenate(list(s())), x)
+
+
+def test_auto_batch_size_positive_and_scales():
+    b1 = auto_batch_size(128, 1024, n_devices=1)
+    b8 = auto_batch_size(128, 1024, n_devices=8)
+    assert b1 > 0
+    assert b8 == 8 * b1
+
+
+def test_oom_adaptive_doubles_until_fit():
+    calls = []
+
+    def run(num_batches):
+        calls.append(num_batches)
+        if num_batches < 4:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory trying to allocate")
+        return "ok"
+
+    result, nb = oom_adaptive(run, initial_num_batches=1)
+    assert result == "ok" and nb == 4
+    assert calls == [1, 2, 4]
+
+
+def test_oom_adaptive_reraises_other_errors():
+    def run(num_batches):
+        raise ValueError("not an oom")
+
+    with pytest.raises(ValueError):
+        oom_adaptive(run)

@@ -1,0 +1,142 @@
+"""Unit tests for distance / assignment / sufficient-stats kernels vs numpy
+and scipy oracles (the per-kernel tests the reference lacked, SURVEY.md §4)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from scipy.spatial.distance import cdist
+
+from tdc_tpu.ops import (
+    pairwise_sq_dist,
+    pairwise_dist,
+    cosine_similarity,
+    assign_clusters,
+    cluster_stats,
+    lloyd_stats,
+    apply_centroid_update,
+)
+from tdc_tpu.ops.assign import SufficientStats, fuzzy_memberships, fuzzy_stats
+
+
+@pytest.fixture
+def xc(rng):
+    x = rng.normal(size=(257, 7)).astype(np.float32)
+    c = rng.normal(size=(11, 7)).astype(np.float32)
+    return x, c
+
+
+def test_pairwise_sq_dist_matches_scipy(xc):
+    x, c = xc
+    got = np.asarray(pairwise_sq_dist(x, c))
+    want = cdist(x, c, "sqeuclidean")
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_pairwise_sq_dist_nonnegative(rng):
+    # The expansion form can go negative in fp; must be clamped.
+    x = rng.normal(size=(64, 3)).astype(np.float32) * 1e3
+    got = np.asarray(pairwise_sq_dist(x, x[:5]))
+    assert (got >= 0).all()
+    # Self-distance ~ 0 up to f32 cancellation at this scale (‖x‖² ~ 1e6,
+    # so absolute error ~ 1e6 * f32 eps ≈ 0.1-1).
+    assert np.diag(got[:5]).max() <= 1e-6 * got.max()
+
+
+def test_pairwise_dist_sqrt(xc):
+    x, c = xc
+    np.testing.assert_allclose(
+        np.asarray(pairwise_dist(x, c)), cdist(x, c, "euclidean"), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_bf16_inputs_accumulate_f32(xc):
+    x, c = xc
+    got = np.asarray(
+        pairwise_sq_dist(jnp.asarray(x, jnp.bfloat16), jnp.asarray(c, jnp.bfloat16))
+    )
+    want = cdist(x, c, "sqeuclidean")
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.1)
+
+
+def test_cosine_similarity(xc):
+    x, c = xc
+    got = np.asarray(cosine_similarity(x, c))
+    want = 1.0 - cdist(x, c, "cosine")
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_assign_clusters_matches_numpy(xc):
+    x, c = xc
+    got = np.asarray(assign_clusters(x, c))
+    want = cdist(x, c, "sqeuclidean").argmin(axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cluster_stats_matches_numpy(xc):
+    x, c = xc
+    a = cdist(x, c, "sqeuclidean").argmin(axis=1)
+    sums, counts = cluster_stats(jnp.asarray(x), jnp.asarray(a, jnp.int32), 11)
+    want_counts = np.bincount(a, minlength=11)
+    np.testing.assert_allclose(np.asarray(counts), want_counts, atol=0)
+    for j in range(11):
+        np.testing.assert_allclose(
+            np.asarray(sums)[j], x[a == j].sum(axis=0), rtol=1e-4, atol=1e-4
+        )
+
+
+def test_lloyd_stats_sse(xc):
+    x, c = xc
+    stats = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    d2 = cdist(x, c, "sqeuclidean")
+    np.testing.assert_allclose(float(stats.sse), d2.min(axis=1).sum(), rtol=1e-4)
+
+
+def test_empty_cluster_keeps_previous_centroid():
+    # Cluster 2 is far away and captures nothing: reference variant A yields
+    # NaN, variant B snaps to origin (defect 6). We keep the previous centroid.
+    x = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0]], np.float32)
+    c = np.array([[0.0, 0.0], [1.0, 1.0], [100.0, 100.0]], np.float32)
+    stats = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    new_c = np.asarray(apply_centroid_update(stats, jnp.asarray(c)))
+    assert not np.isnan(new_c).any()
+    np.testing.assert_allclose(new_c[2], c[2])
+
+
+def test_fuzzy_memberships_rows_sum_to_one(xc):
+    x, c = xc
+    u = np.asarray(fuzzy_memberships(x, c, m=2.0))
+    np.testing.assert_allclose(u.sum(axis=1), 1.0, atol=1e-5)
+    assert (u >= 0).all()
+
+
+def test_fuzzy_memberships_numpy_oracle(xc):
+    x, c = xc
+    m = 2.0
+    d2 = cdist(x, c, "sqeuclidean") + 1e-9
+    inv = d2 ** (-1.0 / (m - 1.0))
+    want = inv / inv.sum(axis=1, keepdims=True)
+    got = np.asarray(fuzzy_memberships(x, c, m=m))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+
+
+def test_fuzzy_stats_matches_numpy(xc):
+    x, c = xc
+    m = 2.0
+    d2 = cdist(x, c, "sqeuclidean") + 1e-9
+    inv = d2 ** (-1.0 / (m - 1.0))
+    u = inv / inv.sum(axis=1, keepdims=True)
+    mu = u**m
+    stats = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=m)
+    np.testing.assert_allclose(
+        np.asarray(stats.weighted_sums), mu.T @ x, rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(np.asarray(stats.weights), mu.sum(axis=0), rtol=1e-4)
+
+
+def test_point_on_centroid_full_membership():
+    x = np.array([[5.0, 5.0]], np.float32)
+    c = np.array([[5.0, 5.0], [0.0, 0.0]], np.float32)
+    u = np.asarray(fuzzy_memberships(x, c, m=2.0))
+    assert u[0, 0] > 0.999

@@ -1,0 +1,60 @@
+"""Timers and CSV logging tests (reference schema parity, SURVEY.md §5)."""
+
+import csv
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from tdc_tpu.utils import (
+    PhaseTimers,
+    REFERENCE_COLUMNS,
+    EXTENDED_COLUMNS,
+    ensure_log_file,
+    append_result_row,
+)
+from tdc_tpu.utils.logging import error_row
+
+
+def test_reference_schema_is_prefix():
+    # The first 10 extended columns are exactly the reference's 10-column schema
+    # (scripts/distribuitedClustering.py:33-35).
+    assert EXTENDED_COLUMNS[: len(REFERENCE_COLUMNS)] == REFERENCE_COLUMNS
+    assert REFERENCE_COLUMNS == [
+        "method_name", "seed", "num_GPUs", "K", "n_obs", "n_dim",
+        "setup_time", "initialization_time", "computation_time", "n_iter",
+    ]
+
+
+def test_log_header_created_once(tmp_path):
+    p = str(tmp_path / "log.csv")
+    ensure_log_file(p)
+    ensure_log_file(p)  # idempotent
+    rows = list(csv.reader(open(p)))
+    assert rows == [EXTENDED_COLUMNS]
+
+
+def test_append_row(tmp_path):
+    p = str(tmp_path / "log.csv")
+    append_result_row(p, {"method_name": "distributedKMeans", "K": 3, "status": "ok"})
+    rows = list(csv.reader(open(p)))
+    assert rows[1][0] == "distributedKMeans"
+    assert rows[1][EXTENDED_COLUMNS.index("K")] == "3"
+
+
+def test_error_row_writes_exception_name_into_metrics(tmp_path):
+    # Reference behavior (:362-377): exception name lands in the metric columns.
+    row = error_row({"method_name": "distributedKMeans"}, MemoryError("boom"))
+    assert row["computation_time"] == "MemoryError"
+    assert row["n_iter"] == "MemoryError"
+    assert row["status"] == "error:MemoryError"
+
+
+def test_phase_timers_accumulate_and_block():
+    t = PhaseTimers()
+    with t.phase("computation"):
+        time.sleep(0.01)
+    with t.phase("computation", block_on=jnp.ones((1000, 1000)) @ jnp.ones((1000, 1000))):
+        pass
+    assert t.get("computation") >= 0.01
+    assert set(t.as_dict()) == {"computation"}
