@@ -12,8 +12,12 @@ BASELINE.json is ≥10x.)
 
 Method: N points (bf16, d=128) resident in HBM; one jit'd Lloyd iteration =
 blocked distance matmul (‖x‖²−2xCᵀ+‖c‖² on the MXU, f32 accumulation) →
-argmin → one-hot-matmul sufficient stats → centroid update. Timed over
-several iterations after a warmup compile, jax.block_until_ready at the end.
+argmin → one-hot-matmul sufficient stats → centroid update, chained so each
+iteration data-depends on the previous. Timing: some runtimes (including
+tunneled PJRT clients) resolve block_until_ready on enqueue, so the sync point
+is a device→host fetch of the final centroids, and the per-iteration time is
+the SLOPE between a short and a long chain — constant dispatch/fetch/tunnel
+overhead cancels.
 """
 
 import json
@@ -21,20 +25,22 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tdc_tpu.ops.assign import apply_centroid_update, lloyd_stats_blocked
 
 K = 1024
 D = 128
 BLOCK_ROWS = 1 << 17  # 128K-row blocks: (block, K) f32 intermediates = 512 MB
-TIMED_ITERS = 10
+ITERS_SHORT = 4
+ITERS_LONG = 36
 
 BASELINE_PT_ITER_PER_S = 22.2e6 * (3 * 5) / (K * D)  # ≈ 2.54e3, see module doc
 
 
 def pick_n(hbm_bytes: int) -> int:
     """Points that fit comfortably: bf16 data + f32 block intermediates."""
-    budget = int(hbm_bytes * 0.5)
+    budget = int(hbm_bytes * 0.25)
     n = budget // (D * 2)  # bf16 point rows
     return max((n // BLOCK_ROWS) * BLOCK_ROWS, BLOCK_ROWS)
 
@@ -43,6 +49,17 @@ def pick_n(hbm_bytes: int) -> int:
 def lloyd_iter(x, c):
     stats = lloyd_stats_blocked(x, c, BLOCK_ROWS)
     return apply_centroid_update(stats, c)
+
+
+def chain(x, c, iters):
+    """iters data-dependent Lloyd iterations; returns wall time to a host
+    fetch of the final centroids (the only trustworthy sync point)."""
+    ci = c
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ci = lloyd_iter(x, ci.astype(jnp.bfloat16))
+    np.asarray(ci)  # true sync: D2H of (K, D) f32
+    return time.perf_counter() - t0
 
 
 def main():
@@ -59,19 +76,18 @@ def main():
     kx, kc = jax.random.split(key)
     x = jax.random.normal(kx, (n, D), jnp.bfloat16)
     c = jax.random.normal(kc, (K, D), jnp.bfloat16)
-    jax.block_until_ready((x, c))
 
-    c_warm = lloyd_iter(x, c)  # compile + 1 iter
-    jax.block_until_ready(c_warm)
+    np.asarray(lloyd_iter(x, c))  # compile + warm, incl. fetch path
 
-    t0 = time.perf_counter()
-    ci = c
-    for _ in range(TIMED_ITERS):
-        ci = lloyd_iter(x, ci.astype(jnp.bfloat16))
-    jax.block_until_ready(ci)
-    dt = time.perf_counter() - t0
+    # Best-of-2 slopes to shrug off queue hiccups.
+    slopes = []
+    for _ in range(2):
+        t_short = chain(x, c, ITERS_SHORT)
+        t_long = chain(x, c, ITERS_LONG)
+        slopes.append((t_long - t_short) / (ITERS_LONG - ITERS_SHORT))
+    per_iter = max(min(slopes), 1e-9)
 
-    value = n * TIMED_ITERS / dt
+    value = n / per_iter
     print(
         json.dumps(
             {

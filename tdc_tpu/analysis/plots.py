@@ -1,0 +1,65 @@
+"""Validation plots — the reference's notebook scatter-plot checks as a module.
+
+Reference: New-Distributed-KMeans.ipynb#cell22-25 and visualization.ipynb
+#cell4/#cell6: scatter of (subsampled) points colored by label with centers
+overlaid, before/after. Headless here (Agg backend), writes PNGs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def scatter_clusters(
+    x: np.ndarray,
+    labels: np.ndarray | None,
+    centers: np.ndarray | None,
+    out_path: str,
+    *,
+    max_points: int = 20000,
+    title: str = "",
+    seed: int = 0,
+):
+    """2-D scatter (first two dims) colored by label, centers as X markers."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    x = np.asarray(x)
+    if x.shape[0] > max_points:
+        idx = np.random.default_rng(seed).choice(x.shape[0], max_points, replace=False)
+        x = x[idx]
+        labels = labels[idx] if labels is not None else None
+    fig, ax = plt.subplots(figsize=(6, 6))
+    ax.scatter(x[:, 0], x[:, 1], c=labels, s=2, cmap="tab20", alpha=0.5)
+    if centers is not None:
+        centers = np.asarray(centers)
+        ax.scatter(centers[:, 0], centers[:, 1], c="black", s=120, marker="x",
+                   linewidths=2, label="centers")
+        ax.legend()
+    ax.set_title(title)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=100)
+    plt.close(fig)
+    return out_path
+
+
+def convergence_curve(sse_per_iter, out_path: str, *, title: str = "SSE per iteration"):
+    """Cost-vs-iteration plot (the metric the reference commented out 'for
+    performance', visualization.ipynb#cell5:66-68 — cheap on TPU)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.plot(np.arange(1, len(sse_per_iter) + 1), sse_per_iter, marker="o")
+    ax.set_xlabel("iteration")
+    ax.set_ylabel("SSE")
+    ax.set_title(title)
+    ax.set_yscale("log")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=100)
+    plt.close(fig)
+    return out_path

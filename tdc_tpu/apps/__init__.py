@@ -1,0 +1,1 @@
+"""Applications (reference L6): image segmentation, digits clustering."""

@@ -1,0 +1,68 @@
+"""Digit-image clustering — the MNIST-style config (BASELINE.json config 2:
+"MNIST 60k x 784 pixel vectors, K=10").
+
+With no network egress the full MNIST download is unavailable; this app runs
+on a local MNIST .npz if provided (--data_file, keys X (N, 784) / Y) and falls
+back to sklearn's bundled digits dataset (1797 x 64, same structure) otherwise.
+
+CLI: python -m tdc_tpu.apps.digits [--data_file mnist.npz] [--K 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+import jax
+
+from tdc_tpu.models import kmeans_fit, kmeans_predict
+
+
+def cluster_purity(labels: np.ndarray, truth: np.ndarray) -> float:
+    """Fraction of points in their cluster's majority class."""
+    total = 0
+    for c in np.unique(labels):
+        mask = labels == c
+        if mask.any():
+            _, counts = np.unique(truth[mask], return_counts=True)
+            total += counts.max()
+    return total / len(labels)
+
+
+def run(data_file: str | None, k: int, seed: int, max_iters: int):
+    if data_file:
+        with np.load(data_file, allow_pickle=False) as z:
+            x, y = z["X"].astype(np.float32), z["Y"]
+    else:
+        from sklearn.datasets import load_digits
+
+        digits = load_digits()
+        x, y = digits.data.astype(np.float32), digits.target
+    x /= max(x.max(), 1.0)  # scale pixels to [0, 1]
+    res = kmeans_fit(
+        x, k, init="kmeans++", key=jax.random.PRNGKey(seed), max_iters=max_iters
+    )
+    labels = np.asarray(kmeans_predict(x, res.centroids))
+    purity = cluster_purity(labels, y)
+    return res, labels, purity, x.shape
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tdc_tpu.apps.digits")
+    p.add_argument("--data_file", default=None, help="MNIST-style .npz (X, Y)")
+    p.add_argument("--K", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--n_max_iters", type=int, default=50)
+    args = p.parse_args(argv)
+    res, labels, purity, shape = run(args.data_file, args.K, args.seed,
+                                     args.n_max_iters)
+    print(f"clustered {shape[0]}x{shape[1]} digits into K={args.K}: "
+          f"n_iter={int(res.n_iter)} sse={float(res.sse):.4g} "
+          f"purity={purity:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,7 +66,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="centroid-shift convergence tolerance; negative = "
                         "fixed n_max_iters (reference parity)")
     p.add_argument("--init", type=str, default="kmeans++",
-                   choices=("kmeans++", "random", "first_k"))
+                   choices=("kmeans++", "kmeans_parallel", "random", "first_k"))
     p.add_argument("--fuzzifier", type=float, default=2.0,
                    help="fuzzy c-means m (explicit; reference bound it to "
                         "n_dim, defect 7)")
@@ -111,6 +111,7 @@ def run_experiment(args) -> dict:
     from tdc_tpu.models import (
         fuzzy_cmeans_fit,
         kmeans_fit,
+        streamed_fuzzy_fit,
         streamed_kmeans_fit,
     )
     from tdc_tpu.parallel import make_mesh
@@ -135,32 +136,22 @@ def run_experiment(args) -> dict:
         streamed = args.streamed or num_batches > 1
         if args.method_name == "distributedFuzzyCMeans":
             if streamed:
-                raise NotImplementedError(
-                    "streamed fuzzy c-means lands in a later milestone; "
-                    "use --num_batches=1"
+                rows = -(-n_obs // num_batches)
+                return streamed_fuzzy_fit(
+                    NpzStream(np.asarray(x), rows), args.K, n_dim,
+                    m=args.fuzzifier, init=args.init, key=key,
+                    max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
                 )
             return fuzzy_cmeans_fit(
                 x, args.K, m=args.fuzzifier, init=args.init, key=key,
                 max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
             )
         if streamed:
-            # Never silently change semantics on the fallback path: the
-            # streamed fitter doesn't do spherical or mesh sharding yet.
-            if args.spherical:
-                raise NotImplementedError(
-                    "streamed spherical k-means not implemented; "
-                    "use --num_batches=1 without --streamed"
-                )
-            if mesh is not None:
-                raise NotImplementedError(
-                    "streamed + multi-device not implemented yet; "
-                    "use --n_GPUs=1 with --num_batches>1"
-                )
             rows = -(-n_obs // num_batches)
             return streamed_kmeans_fit(
                 NpzStream(np.asarray(x), rows), args.K, n_dim,
                 init=args.init, key=key, max_iters=args.n_max_iters,
-                tol=args.tol,
+                tol=args.tol, spherical=args.spherical, mesh=mesh,
             )
         return kmeans_fit(
             x, args.K, init=args.init, key=key, max_iters=args.n_max_iters,

@@ -57,12 +57,45 @@ def config_argv(cfg: dict, log_file: str | None) -> list[str]:
     return argv
 
 
-def run_sweep(spec: dict, *, dry_run: bool = False, isolate: bool = True) -> list[int]:
+_RESUME_KEYS = ("method_name", "seed", "K", "n_obs", "n_dim")
+
+
+def completed_configs(log_file: str | None) -> set[tuple]:
+    """Configs already logged with status ok — sweep resume works by diffing
+    the CSV against the config matrix (SURVEY.md §5 checkpoint/resume row)."""
+    import csv
+    import os
+
+    done = set()
+    if not log_file or not os.path.exists(log_file):
+        return done
+    with open(log_file) as f:
+        for row in csv.DictReader(f):
+            if row.get("status") == "ok":
+                done.add(tuple(str(row.get(k, "")) for k in _RESUME_KEYS))
+    return done
+
+
+def _config_key(cfg: dict) -> tuple:
+    defaults = {"method_name": "distributedKMeans", "seed": 123128}
+    return tuple(str(cfg.get(k, defaults.get(k, ""))) for k in _RESUME_KEYS)
+
+
+def run_sweep(
+    spec: dict, *, dry_run: bool = False, isolate: bool = True, resume: bool = False
+) -> list[int]:
     """Run every config; per-config subprocess isolation (reference :59) so a
-    hard crash can't kill the sweep. Returns per-config exit codes."""
+    hard crash can't kill the sweep. Returns per-config exit codes.
+    resume=True skips configs already logged ok in the spec's log_file."""
     log_file = spec.get("log_file")
     codes = []
     configs = expand_grid(spec)
+    if resume:
+        done = completed_configs(log_file)
+        skipped = [c for c in configs if _config_key(c) in done]
+        configs = [c for c in configs if _config_key(c) not in done]
+        if skipped:
+            print(f"resume: skipping {len(skipped)} completed configs")
     for i, cfg in enumerate(configs):
         argv = config_argv(cfg, log_file)
         print(f"[{i + 1}/{len(configs)}] {' '.join(argv[2:])}", flush=True)
@@ -85,9 +118,13 @@ def main(argv=None) -> int:
     p.add_argument("--dry_run", action="store_true")
     p.add_argument("--no_isolate", action="store_true",
                    help="run in-process (faster, no crash isolation)")
+    p.add_argument("--resume", action="store_true",
+                   help="skip configs already logged ok in the log_file")
     args = p.parse_args(argv)
     spec = json.load(sys.stdin if args.spec == "-" else open(args.spec))
-    codes = run_sweep(spec, dry_run=args.dry_run, isolate=not args.no_isolate)
+    codes = run_sweep(
+        spec, dry_run=args.dry_run, isolate=not args.no_isolate, resume=args.resume
+    )
     failed = sum(1 for c in codes if c != 0)
     print(f"sweep done: {len(codes) - failed}/{len(codes)} ok")
     return 1 if failed else 0

@@ -3,7 +3,7 @@
 from tdc_tpu.models.kmeans import KMeansResult, kmeans_fit, kmeans_predict
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult, fuzzy_cmeans_fit, fuzzy_predict
 from tdc_tpu.models.minibatch import MiniBatchKMeans
-from tdc_tpu.models.streaming import streamed_kmeans_fit
+from tdc_tpu.models.streaming import streamed_kmeans_fit, streamed_fuzzy_fit
 
 __all__ = [
     "KMeansResult",
@@ -14,4 +14,5 @@ __all__ = [
     "fuzzy_predict",
     "MiniBatchKMeans",
     "streamed_kmeans_fit",
+    "streamed_fuzzy_fit",
 ]

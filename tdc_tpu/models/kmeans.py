@@ -97,6 +97,10 @@ def resolve_init(
         return init_random(key, x, k)
     if init in ("kmeans++", "k-means++"):
         return init_kmeans_pp(key, x, k)
+    if init in ("kmeans||", "k-means||", "kmeans_parallel"):
+        from tdc_tpu.ops.kmeans_parallel import init_kmeans_parallel
+
+        return init_kmeans_parallel(key, x, k)
     raise ValueError(f"unknown init: {init!r}")
 
 

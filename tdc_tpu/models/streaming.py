@@ -1,4 +1,4 @@
-"""Exact out-of-core Lloyd K-Means over streamed batches.
+"""Exact out-of-core Lloyd K-Means / Fuzzy C-Means over streamed batches.
 
 The reference's out-of-core story (run_experiments,
 scripts/distribuitedClustering.py:296-318) runs *independent* K-Means per batch
@@ -7,25 +7,70 @@ produced NaN columns (defects 6+8). Exact streamed Lloyd instead accumulates the
 sufficient statistics (Σx, counts) across *all* batches within each iteration,
 then updates centroids once — bit-identical to full-batch Lloyd, with only
 (K×d + K) device state between batches.
+
+Multi-device: pass `mesh=` — each host batch is zero-padded to the mesh size,
+sharded over the data axis, and the padding's (exactly known) contribution is
+subtracted: zero rows all land in the cluster with the smallest ‖c‖² and add
+zero to Σx, so the correction is a count/sse adjustment. The cross-device
+reduce is XLA's all-reduce of the stats contraction (the reference's
+add_n-on-CPU, :257-258, device-resident).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Iterable
+from functools import partial
+from typing import Callable, Iterable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from tdc_tpu.ops.assign import SufficientStats, apply_centroid_update, lloyd_stats
-from tdc_tpu.models.kmeans import KMeansResult, resolve_init
+from tdc_tpu.ops.assign import (
+    FuzzyStats,
+    SufficientStats,
+    apply_centroid_update,
+    fuzzy_stats,
+    lloyd_stats,
+)
+from tdc_tpu.models.kmeans import KMeansResult, resolve_init, _normalize
+from tdc_tpu.models.fuzzy import FuzzyCMeansResult
+from tdc_tpu.parallel import mesh as mesh_lib
 
 
-@jax.jit
-def _accumulate(acc: SufficientStats, batch: jax.Array, centroids: jax.Array) -> SufficientStats:
+@partial(jax.jit, static_argnames=("spherical",))
+def _accumulate(
+    acc: SufficientStats,
+    batch: jax.Array,
+    centroids: jax.Array,
+    n_valid: jax.Array,
+    spherical: bool,
+) -> SufficientStats:
+    """Add one (possibly zero-padded) batch's stats; subtract the padding's
+    exact contribution (zero rows → argmin-‖c‖² cluster, zero Σx, ‖c_j‖² sse
+    each; for spherical, zero rows are left unnormalized and behave the same)."""
+    if spherical:
+        norms = jnp.linalg.norm(batch, axis=-1, keepdims=True)
+        batch = jnp.where(norms > 0, batch / jnp.maximum(norms, 1e-12), batch)
     s = lloyd_stats(batch, centroids)
+    n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(jnp.float32)
+    c2 = jnp.sum(centroids.astype(jnp.float32) ** 2, axis=-1)
+    j = jnp.argmin(c2)
+    counts = s.counts.at[j].add(-n_pad)
+    sse = s.sse - n_pad * c2[j]
     return SufficientStats(
-        sums=acc.sums + s.sums, counts=acc.counts + s.counts, sse=acc.sse + s.sse
+        sums=acc.sums + s.sums, counts=acc.counts + counts, sse=acc.sse + sse
     )
+
+
+def _prepare_batch(batch, mesh):
+    """(device_array, n_valid): pad to mesh multiple and shard, or pass through."""
+    batch = np.asarray(batch)
+    n_valid = batch.shape[0]
+    if mesh is None:
+        return jnp.asarray(batch), n_valid
+    n_dev = int(np.prod(mesh.devices.shape))
+    padded, _ = mesh_lib.pad_to_multiple(batch, n_dev, fill_value=0.0)
+    return mesh_lib.shard_points(padded, mesh), n_valid
 
 
 def streamed_kmeans_fit(
@@ -37,6 +82,10 @@ def streamed_kmeans_fit(
     key=None,
     max_iters: int = 20,
     tol: float = 1e-4,
+    spherical: bool = False,
+    mesh: jax.sharding.Mesh | None = None,
+    ckpt_dir: str | None = None,
+    ckpt_every: int = 5,
 ) -> KMeansResult:
     """Exact Lloyd over a re-iterable stream of (B, d) batches.
 
@@ -47,36 +96,86 @@ def streamed_kmeans_fit(
         *only* data movement, and stats accumulate exactly).
       init: explicit (K, d) array, or an init name resolved against the first
         batch of the first pass.
+      spherical: cosine K-Means (normalize rows and centroids).
+      mesh: optional data-parallel mesh; batches are padded+sharded per step.
+      ckpt_dir: if set, save a checkpoint every `ckpt_every` iterations and at
+        the end, and resume from the latest checkpoint if one exists (the
+        checkpoint/resume capability the reference lacked, SURVEY.md §5).
     """
     first = None
     if not hasattr(init, "shape"):
         first = next(iter(batches()))
-        init = resolve_init(jnp.asarray(first), k, init, key)
+        first = jnp.asarray(first)
+        if spherical:
+            first = _normalize(first.astype(jnp.float32))
+        init = resolve_init(first, k, init, key)
     c = jnp.asarray(init, jnp.float32)
     if c.shape != (k, d):
         raise ValueError(f"init shape {c.shape} != {(k, d)}")
+    if spherical:
+        c = _normalize(c)
+    if mesh is not None:
+        c = mesh_lib.replicate(c, mesh)
 
     def zero_stats():
-        return SufficientStats(
+        z = SufficientStats(
             sums=jnp.zeros((k, d), jnp.float32),
             counts=jnp.zeros((k,), jnp.float32),
             sse=jnp.zeros((), jnp.float32),
         )
+        if mesh is not None:
+            z = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), z)
+        return z
 
     def full_pass(c):
         acc = zero_stats()
         for batch in batches():
-            acc = _accumulate(acc, jnp.asarray(batch), c)
+            xb, n_valid = _prepare_batch(batch, mesh)
+            acc = _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical)
         return acc
 
+    start_iter = 0
+    if ckpt_dir is not None:
+        from tdc_tpu.utils.checkpoint import restore_checkpoint
+
+        saved = restore_checkpoint(ckpt_dir)
+        if saved is not None:
+            if saved.meta.get("k") != k or saved.meta.get("d") != d:
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir} is for K={saved.meta.get('k')}, "
+                    f"d={saved.meta.get('d')}, not ({k}, {d})"
+                )
+            c = jnp.asarray(saved.centroids, jnp.float32)
+            if mesh is not None:
+                c = mesh_lib.replicate(c, mesh)
+            start_iter = saved.n_iter
+
+    def _save(n_iter, c):
+        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
+
+        save_checkpoint(
+            ckpt_dir,
+            ClusterState(
+                centroids=np.asarray(c), n_iter=n_iter, key=None,
+                batch_cursor=0, meta={"k": k, "d": d, "spherical": spherical},
+            ),
+            step=n_iter,
+        )
+
     shift = jnp.inf
-    n_iter = 0
-    for n_iter in range(1, max_iters + 1):
+    n_iter = start_iter
+    for n_iter in range(start_iter + 1, max_iters + 1):
         acc = full_pass(c)
         new_c = apply_centroid_update(acc, c)
+        if spherical:
+            new_c = _normalize(new_c)
         shift = float(jnp.max(jnp.linalg.norm(new_c - c, axis=-1)))
         c = new_c
-        if tol >= 0 and shift <= tol:
+        done = tol >= 0 and shift <= tol
+        if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
+                                     or n_iter == max_iters):
+            _save(n_iter, c)
+        if done:
             break
     # One extra stats pass so the reported SSE matches the *returned* centroids
     # (kmeans_fit does the same; the in-loop SSE is one update stale).
@@ -85,6 +184,81 @@ def streamed_kmeans_fit(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),
         sse=jnp.asarray(sse, jnp.float32),
+        shift=jnp.asarray(shift, jnp.float32),
+        converged=jnp.asarray(tol >= 0 and shift <= tol),
+    )
+
+
+@jax.jit
+def _accumulate_fuzzy(
+    acc: FuzzyStats, batch: jax.Array, centroids: jax.Array, n_valid: jax.Array, m: float
+) -> FuzzyStats:
+    """Fuzzy stats are also plain sums over points, so exact streaming works
+    the same way. Padding correction: a zero row's memberships are
+    u = softmin of ‖c‖² (independent of the row), contributing u^m to weights
+    and u^m·‖c_j‖² to the objective but zero to Σ u^m x."""
+    s = fuzzy_stats(batch, centroids, m=m)
+    n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(jnp.float32)
+    zero_row = jnp.zeros((1, batch.shape[1]), batch.dtype)
+    zs = fuzzy_stats(zero_row, centroids, m=m)
+    return FuzzyStats(
+        weighted_sums=acc.weighted_sums + s.weighted_sums,  # zero row adds 0
+        weights=acc.weights + s.weights - n_pad * zs.weights,
+        objective=acc.objective + s.objective - n_pad * zs.objective,
+    )
+
+
+def streamed_fuzzy_fit(
+    batches: Callable[[], Iterable],
+    k: int,
+    d: int,
+    *,
+    m: float = 2.0,
+    init,
+    key=None,
+    max_iters: int = 20,
+    tol: float = 1e-4,
+    mesh: jax.sharding.Mesh | None = None,
+) -> FuzzyCMeansResult:
+    """Exact streamed Fuzzy C-Means (same contract as streamed_kmeans_fit)."""
+    if m <= 1.0:
+        raise ValueError(f"fuzzifier m must be > 1, got {m}")
+    if not hasattr(init, "shape"):
+        first = jnp.asarray(next(iter(batches())))
+        init = resolve_init(first, k, init, key)
+    c = jnp.asarray(init, jnp.float32)
+    if c.shape != (k, d):
+        raise ValueError(f"init shape {c.shape} != {(k, d)}")
+    if mesh is not None:
+        c = mesh_lib.replicate(c, mesh)
+
+    def full_pass(c):
+        acc = FuzzyStats(
+            weighted_sums=jnp.zeros((k, d), jnp.float32),
+            weights=jnp.zeros((k,), jnp.float32),
+            objective=jnp.zeros((), jnp.float32),
+        )
+        if mesh is not None:
+            acc = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), acc)
+        for batch in batches():
+            xb, n_valid = _prepare_batch(batch, mesh)
+            acc = _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m)
+        return acc
+
+    shift = jnp.inf
+    n_iter = 0
+    for n_iter in range(1, max_iters + 1):
+        acc = full_pass(c)
+        new_c = acc.weighted_sums / jnp.maximum(acc.weights[:, None], 1e-12)
+        shift = float(jnp.max(jnp.linalg.norm(new_c - c, axis=-1)))
+        c = new_c
+        if tol >= 0 and shift <= tol:
+            break
+    objective = full_pass(c).objective
+    return FuzzyCMeansResult(
+        centroids=c,
+        n_iter=jnp.asarray(n_iter, jnp.int32),
+        objective=jnp.asarray(objective, jnp.float32),
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(tol >= 0 and shift <= tol),
     )

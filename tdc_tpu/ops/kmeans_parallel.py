@@ -1,0 +1,120 @@
+"""Distributed k-means‖ (k-means parallel) seeding.
+
+Replaces the reference's serial CPU sklearn k-means++ call
+(`k_means_._init_centroids(data, K, 'k-means++')`,
+scripts/distribuitedClustering.py:82,191 — a latent NameError there) with the
+oversampling scheme of Bahmani et al. (k-means‖): a handful of rounds, each
+sampling ~ℓ candidates *independently per point* with probability
+ℓ·d²(x)/Σd², then weighted k-means++ over the small candidate set. All rounds
+are jit-able, device-resident, and deterministic given the key — including
+across mesh shapes, since sampling is a per-point Bernoulli draw keyed on the
+global point index (no cross-device sequential dependence).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.ops.distance import pairwise_sq_dist
+from tdc_tpu.ops.init import init_kmeans_pp
+
+
+@partial(jax.jit, static_argnames=("k", "rounds", "oversample"))
+def init_kmeans_parallel(
+    key: jax.Array,
+    x: jax.Array,
+    k: int,
+    *,
+    rounds: int = 5,
+    oversample: int | None = None,
+) -> jax.Array:
+    """k-means‖ seeding: returns (K, d) f32 centers.
+
+    Candidate pool is fixed-size (rounds*oversample + 1, padded with the first
+    center) so shapes are static under jit. Default oversampling factor 2K per
+    round, the paper's recommendation.
+    """
+    n, d = x.shape
+    if oversample is None:
+        oversample = 2 * k
+    xf = x.astype(jnp.float32)
+    pool_size = rounds * oversample + 1
+
+    key, k0 = jax.random.split(key)
+    first_idx = jax.random.randint(k0, (), 0, n)
+    first = xf[first_idx]
+
+    # Candidate pool and weights; slot 0 = first center.
+    pool = jnp.zeros((pool_size, d), jnp.float32).at[0].set(first)
+    pool_valid = jnp.zeros((pool_size,), bool).at[0].set(True)
+    d2 = pairwise_sq_dist(xf, first[None, :])[:, 0]  # (N,)
+
+    def round_body(r, carry):
+        pool, pool_valid, d2, key = carry
+        key, kr = jax.random.split(key)
+        cost = jnp.sum(d2)
+        # Bernoulli per point: p = min(1, l * d² / cost).
+        p = jnp.minimum(oversample * d2 / jnp.maximum(cost, 1e-30), 1.0)
+        u = jax.random.uniform(kr, (n,))
+        chosen = u < p
+        # Keep at most `oversample` chosen points deterministically: rank
+        # chosen points by (u/p) (uniform among chosen) and take the smallest.
+        score = jnp.where(chosen, u / jnp.maximum(p, 1e-30), jnp.inf)
+        order = jnp.argsort(score)[:oversample]  # (oversample,) point indices
+        valid = jnp.take(chosen, order)  # padding slots where too few chosen
+        cands = jnp.take(xf, order, axis=0)
+        start = 1 + r * oversample
+        pool = jax.lax.dynamic_update_slice(pool, cands, (start, 0))
+        pool_valid = jax.lax.dynamic_update_slice(pool_valid, valid, (start,))
+        # Update running min distance against the *valid* new candidates only.
+        cd2 = pairwise_sq_dist(xf, cands)  # (N, oversample)
+        cd2 = jnp.where(valid[None, :], cd2, jnp.inf)
+        d2 = jnp.minimum(d2, jnp.min(cd2, axis=1))
+        return pool, pool_valid, d2, key
+
+    pool, pool_valid, d2, key = jax.lax.fori_loop(
+        0, rounds, round_body, (pool, pool_valid, d2, key)
+    )
+
+    # Weight candidates by the number of points they attract, then run
+    # weighted k-means++ on the (small) pool to pick the final K.
+    cand_d2 = pairwise_sq_dist(xf, pool)  # (N, pool)
+    cand_d2 = jnp.where(pool_valid[None, :], cand_d2, jnp.inf)
+    owner = jnp.argmin(cand_d2, axis=1)  # (N,)
+    weights = jnp.zeros((pool_size,), jnp.float32).at[owner].add(1.0)
+    weights = jnp.where(pool_valid, weights, 0.0)
+    key, kf = jax.random.split(key)
+    return _weighted_kmeans_pp(kf, pool, weights, k)
+
+
+def _weighted_kmeans_pp(key, pts, weights, k: int):
+    """k-means++ over a small weighted candidate set (the k-means‖ reduce
+    step; runs on device, pool is O(rounds·K) rows)."""
+    m = pts.shape[0]
+    key, k0 = jax.random.split(key)
+    # First center ~ weights.
+    logw = jnp.where(weights > 0, jnp.log(weights), -jnp.inf)
+    g = jax.random.gumbel(k0, (m,))
+    first = jnp.argmax(logw + g)
+    centers = jnp.zeros((k, pts.shape[1]), jnp.float32).at[0].set(pts[first])
+    d2 = pairwise_sq_dist(pts, pts[first][None, :])[:, 0]
+
+    def body(i, carry):
+        centers, d2, key = carry
+        key, ki = jax.random.split(key)
+        wd2 = weights * d2
+        lw = jnp.where(wd2 > 0, jnp.log(wd2), -jnp.inf)
+        nxt = jnp.argmax(lw + jax.random.gumbel(ki, (m,)))
+        c = pts[nxt]
+        centers = centers.at[i].set(c)
+        d2 = jnp.minimum(d2, pairwise_sq_dist(pts, c[None, :])[:, 0])
+        return centers, d2, key
+
+    centers, _, _ = jax.lax.fori_loop(1, k, body, (centers, d2, key))
+    return centers
+
+
+__all__ = ["init_kmeans_parallel"]

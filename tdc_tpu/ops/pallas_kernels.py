@@ -1,0 +1,166 @@
+"""Pallas TPU kernels for the large-K·d regime.
+
+The XLA matmul-form path (ops/distance.py) materializes the (N, K) distance
+matrix in HBM; at K = 16,384 that is 64 KB per point row and the iteration
+becomes HBM-traffic-bound. This kernel streams K-tiles of the centroid matrix
+through VMEM and keeps a *running* (min, argmin) per point — structurally
+flash-attention's online-softmax trick applied to argmin (SURVEY.md §5
+"long-context" row) — so the N×K matrix never exists anywhere.
+
+The inner product still rides the MXU: each grid step computes a
+(BLOCK_N, d) x (d, BLOCK_K) tile of -2·x·cᵀ + ‖c‖² and folds it into the
+running accumulator. ‖x‖² is row-constant and dropped from the argmin; the
+wrapper adds it back when true distances are requested.
+
+Mosaic notes (learned the hard way on v5e): jnp.argmin's f32→i32 cast does not
+legalize, and 1-D outputs stall the pipeline — so the argmin is a masked
+f32-iota min and both outputs are (N, 1) columns.
+
+Reference counterpart: the tile/subtract/square/reduce_sum + argmin tower
+(scripts/distribuitedClustering.py:221-234), which materialized the even
+bigger N×K×M tensor.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Pad value for centroid rows added to reach a BLOCK_K multiple: ‖c‖² ≈ 1e30
+# dominates any real -2xᵀc term, so padded rows are never the argmin.
+_PAD_CENTROID = 1e15
+_ARG_SENTINEL = 2**30  # masked-out i32 index value; > any real K
+
+
+def _distance_argmin_kernel(x_ref, c_ref, c2_ref, mind_ref, arg_ref, *, block_k: int):
+    j = pl.program_id(1)
+    cross = jax.lax.dot_general(
+        x_ref[...],
+        c_ref[...],
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (BN, BK)
+    d2 = c2_ref[...] - 2.0 * cross  # (1, BK) + (BN, BK); ‖x‖² row-constant, omitted
+    tile_min = jnp.min(d2, axis=1, keepdims=True)  # (BN, 1)
+    # Manual argmin: first column index achieving the min, all-i32 (neither
+    # jnp.argmin nor f32<->i32 vector casts legalize in Mosaic).
+    col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1) + j * block_k
+    masked = jnp.where(d2 <= tile_min, col, _ARG_SENTINEL)
+    tile_arg = jnp.min(masked, axis=1, keepdims=True)  # (BN, 1) i32 index
+
+    @pl.when(j == 0)
+    def _():
+        mind_ref[...] = tile_min
+        arg_ref[...] = tile_arg
+
+    @pl.when(j > 0)
+    def _():
+        better = tile_min < mind_ref[...]
+        mind_ref[...] = jnp.where(better, tile_min, mind_ref[...])
+        arg_ref[...] = jnp.where(better, tile_arg, arg_ref[...])
+
+
+def _pad_axis(a, axis: int, multiple: int, value):
+    size = a.shape[axis]
+    rem = (-size) % multiple
+    if rem == 0:
+        return a
+    pads = [(0, 0)] * a.ndim
+    pads[axis] = (0, rem)
+    return jnp.pad(a, pads, constant_values=value)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_n", "block_k", "return_dist", "interpret"),
+)
+def distance_argmin(
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    block_n: int = 1024,
+    block_k: int = 512,
+    return_dist: bool = False,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(argmin (N,) int32, min squared distance (N,) f32) without materializing N×K.
+
+    Args:
+      x: (N, d) points, f32 or bf16.
+      centroids: (K, d).
+      block_n / block_k: VMEM tile sizes (points / centroids per grid step).
+      return_dist: also return true min ‖x−c‖² (adds the ‖x‖² term back);
+        otherwise the distance output is the shifted value (still argmin-valid).
+      interpret: run in interpreter mode (auto-True off-TPU so tests exercise
+        the same kernel on the CPU mesh).
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    n, d = x.shape
+    k = centroids.shape[0]
+    # Lane-align d (zero columns change nothing), tile-align N and K.
+    xp = _pad_axis(_pad_axis(x, 1, 128, 0), 0, block_n, 0)
+    cp = _pad_axis(
+        _pad_axis(centroids.astype(x.dtype), 1, 128, 0), 0, block_k, _PAD_CENTROID
+    )
+    c2 = jnp.sum(cp.astype(jnp.float32) ** 2, axis=1)[None, :]  # (1, K_pad)
+    n_pad, k_pad = xp.shape[0], cp.shape[0]
+
+    grid = (n_pad // block_n, k_pad // block_k)
+    mind, argf = pl.pallas_call(
+        functools.partial(_distance_argmin_kernel, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (block_n, xp.shape[1]), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (block_k, cp.shape[1]), lambda i, j: (j, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, block_k), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xp, cp, c2)
+    mind = mind[:n, 0]
+    arg = argf[:n, 0]
+    if return_dist:
+        x2 = jnp.sum(x.astype(jnp.float32) ** 2, axis=1)
+        mind = jnp.maximum(mind + x2, 0.0)
+    return arg, mind
+
+
+def lloyd_stats_pallas(
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    block_n: int = 1024,
+    block_k: int = 512,
+    interpret: bool | None = None,
+):
+    """Lloyd sufficient stats with the Pallas assign path: fused
+    distance-argmin kernel (no N×K materialization) + one-hot-matmul stats.
+
+    Drop-in replacement for ops.assign.lloyd_stats in the large-K·d regime;
+    same return type, so models/kmeans.py can swap it in per fit.
+    """
+    from tdc_tpu.ops.assign import SufficientStats, cluster_stats
+
+    arg, mind = distance_argmin(
+        x, centroids,
+        block_n=block_n, block_k=block_k,
+        return_dist=True, interpret=interpret,
+    )
+    sums, counts = cluster_stats(x, arg, centroids.shape[0])
+    return SufficientStats(sums=sums, counts=counts, sse=jnp.sum(mind))

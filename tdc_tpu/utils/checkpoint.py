@@ -1,0 +1,80 @@
+"""Checkpoint / resume — a capability the reference entirely lacks
+(SURVEY.md §5: no tf.train.Saver, nothing persisted; its only resumable state
+was the append-only results CSV).
+
+Checkpoint state = (centroids, iteration, RNG key, batch cursor) per the
+SURVEY plan, persisted with orbax. Works for the in-jit fits (save at the end)
+and the streamed fits (save every N iterations, resume mid-run).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, NamedTuple
+
+import jax
+import numpy as np
+
+
+class ClusterState(NamedTuple):
+    """Everything needed to resume a clustering run."""
+
+    centroids: Any  # (K, d) f32
+    n_iter: int
+    key: Any  # PRNG key (or None)
+    batch_cursor: int  # batches consumed in the current pass (streamed mode)
+    meta: dict  # method/K/n_dim/tol/... for sanity checks on restore
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(ckpt_dir: str, state: ClusterState, step: int) -> str:
+    """Write state under ckpt_dir/step_<N>; returns the path."""
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    payload = {
+        "centroids": np.asarray(state.centroids),
+        "n_iter": np.asarray(state.n_iter),
+        "key": np.asarray(state.key) if state.key is not None else np.zeros(2, np.uint32),
+        "has_key": np.asarray(state.key is not None),
+        "batch_cursor": np.asarray(state.batch_cursor),
+        "meta": dict(state.meta),
+    }
+    _checkpointer().save(path, payload, force=True)
+    return path
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [
+        int(name.split("_")[1])
+        for name in os.listdir(ckpt_dir)
+        if name.startswith("step_") and name.split("_")[1].isdigit()
+    ]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, step: int | None = None) -> ClusterState | None:
+    """Load the given (default: latest) checkpoint, or None if none exists."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            return None
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    payload = _checkpointer().restore(path)
+    key = (
+        jax.numpy.asarray(payload["key"])
+        if bool(np.asarray(payload["has_key"]))
+        else None
+    )
+    return ClusterState(
+        centroids=jax.numpy.asarray(payload["centroids"]),
+        n_iter=int(np.asarray(payload["n_iter"])),
+        key=key,
+        batch_cursor=int(np.asarray(payload["batch_cursor"])),
+        meta=dict(payload["meta"]),
+    )

@@ -2,10 +2,11 @@
 
 Reference schema (scripts/distribuitedClustering.py): setup_time (graph build,
 :181/265), initialization_time (var init + H2D, :272-274), computation_time
-(accumulated per-iteration sess.run, :276-280). JAX dispatch is asynchronous, so
-every phase boundary calls jax.block_until_ready on the tensors produced in that
-phase — otherwise compute time would be booked into whichever phase first
-touches the result.
+(accumulated per-iteration sess.run, :276-280). JAX dispatch is asynchronous,
+so every phase boundary syncs on the tensors produced in that phase — and
+because some PJRT clients (tunneled backends) resolve block_until_ready on
+enqueue rather than completion, the sync is a device→host fetch of one element
+per array leaf (a few bytes; forces true completion everywhere).
 """
 
 from __future__ import annotations
@@ -14,6 +15,16 @@ import time
 from contextlib import contextmanager
 
 import jax
+import numpy as np
+
+
+def hard_sync(target) -> None:
+    """Block until `target` is actually computed: block_until_ready plus a
+    1-element D2H fetch per leaf (enqueue-acking clients lie about the former)."""
+    jax.block_until_ready(target)
+    for leaf in jax.tree.leaves(target):
+        if hasattr(leaf, "shape") and getattr(leaf, "size", 0):
+            np.asarray(jax.numpy.ravel(leaf)[0])
 
 
 class PhaseTimers:
@@ -34,7 +45,7 @@ class PhaseTimers:
         finally:
             target = out.get("block_on", block_on)
             if target is not None:
-                jax.block_until_ready(target)
+                hard_sync(target)
             self.seconds[name] = self.seconds.get(name, 0.0) + (
                 time.perf_counter() - t0
             )

@@ -1,0 +1,76 @@
+"""Application-layer tests: segmentation (vs sklearn oracle, as the reference
+cross-checked vs cv2.kmeans) and digits clustering."""
+
+import numpy as np
+import pytest
+
+from tdc_tpu.apps.segmentation import (
+    crosscheck_sklearn,
+    segment_image,
+    segment_pixels,
+)
+from tdc_tpu.apps.digits import cluster_purity, run as digits_run
+
+
+@pytest.fixture(scope="module")
+def toy_image():
+    """64x64 RGB with three flat color regions + noise."""
+    rng = np.random.default_rng(0)
+    img = np.zeros((64, 64, 3), np.float32)
+    img[:21] = [220, 30, 30]
+    img[21:42] = [30, 220, 30]
+    img[42:] = [30, 30, 220]
+    return np.clip(img + rng.normal(0, 8, img.shape), 0, 255).astype(np.float32)
+
+
+def test_segment_image_three_regions(toy_image):
+    recolored, labels, centers = segment_image(toy_image, 3, seed=0)
+    assert recolored.shape == toy_image.shape and recolored.dtype == np.uint8
+    # Each region maps to a single dominant label.
+    for sl in (slice(0, 21), slice(21, 42), slice(42, 64)):
+        region = labels[sl].ravel()
+        vals, counts = np.unique(region, return_counts=True)
+        assert counts.max() / region.size > 0.99
+    # And the three dominant labels differ.
+    assert len({labels[5, 5], labels[30, 30], labels[60, 60]}) == 3
+
+
+def test_segment_pixels_fuzzy(toy_image):
+    pixels = toy_image.reshape(-1, 3)
+    labels, centers, res = segment_pixels(pixels, 3, method="fuzzy", seed=0)
+    assert labels.shape == (pixels.shape[0],)
+    assert not np.isnan(centers).any()
+
+
+def test_crosscheck_sklearn_centers_close(toy_image):
+    pixels = toy_image.reshape(-1, 3)
+    ours, theirs, t_ours, t_sk, worst = crosscheck_sklearn(pixels, 3)
+    assert worst < 10.0  # color units out of 255; same clusters found
+
+
+def test_nan_sentinel():
+    with pytest.raises(ValueError):
+        segment_pixels(np.zeros((10, 3), np.float32), 3, method="bogus")
+
+
+def test_digits_clustering_purity():
+    res, labels, purity, shape = digits_run(None, 10, seed=0, max_iters=50)
+    assert shape == (1797, 64)
+    assert purity > 0.6  # typical k-means purity on digits is ~0.7-0.8
+
+
+def test_cluster_purity_perfect():
+    labels = np.array([0, 0, 1, 1])
+    truth = np.array([5, 5, 9, 9])
+    assert cluster_purity(labels, truth) == 1.0
+
+
+def test_plots_write_files(tmp_path, blobs_small):
+    from tdc_tpu.analysis.plots import convergence_curve, scatter_clusters
+
+    x, y, centers = blobs_small
+    p1 = scatter_clusters(x, y, centers, str(tmp_path / "s.png"), title="t")
+    p2 = convergence_curve([100.0, 10.0, 5.0], str(tmp_path / "c.png"))
+    import os
+
+    assert os.path.getsize(p1) > 1000 and os.path.getsize(p2) > 1000

@@ -1,0 +1,102 @@
+"""Checkpoint/resume tests (capability absent from the reference, SURVEY.md §5)."""
+
+import csv
+
+import numpy as np
+import jax
+import pytest
+
+from tdc_tpu.models import streamed_kmeans_fit
+from tdc_tpu.data.loader import NpzStream
+from tdc_tpu.utils.checkpoint import (
+    ClusterState,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = ClusterState(
+        centroids=np.arange(12, dtype=np.float32).reshape(3, 4),
+        n_iter=7,
+        key=jax.random.PRNGKey(3),
+        batch_cursor=2,
+        meta={"k": 3, "d": 4},
+    )
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, state, step=7)
+    got = restore_checkpoint(d)
+    np.testing.assert_array_equal(np.asarray(got.centroids), state.centroids)
+    assert got.n_iter == 7 and got.batch_cursor == 2
+    np.testing.assert_array_equal(np.asarray(got.key), np.asarray(state.key))
+    assert got.meta["k"] == 3
+
+
+def test_latest_step_picks_max(tmp_path):
+    d = str(tmp_path / "ckpt")
+    s = ClusterState(np.zeros((2, 2), np.float32), 0, None, 0, {"k": 2, "d": 2})
+    save_checkpoint(d, s._replace(n_iter=3), step=3)
+    save_checkpoint(d, s._replace(n_iter=10), step=10)
+    assert latest_step(d) == 10
+    assert restore_checkpoint(d).n_iter == 10
+    assert restore_checkpoint(d, step=3).n_iter == 3
+
+
+def test_restore_missing_returns_none(tmp_path):
+    assert restore_checkpoint(str(tmp_path / "nope")) is None
+
+
+def test_streamed_fit_resume_matches_uninterrupted(blobs_small, tmp_path):
+    x, _, _ = blobs_small
+    init = x[:3]
+    full = streamed_kmeans_fit(
+        NpzStream(x, 200), 3, 2, init=init, max_iters=12, tol=-1.0
+    )
+    # Interrupted run: 6 iterations, checkpointed.
+    d = str(tmp_path / "ckpt")
+    streamed_kmeans_fit(
+        NpzStream(x, 200), 3, 2, init=init, max_iters=6, tol=-1.0,
+        ckpt_dir=d, ckpt_every=3,
+    )
+    assert latest_step(d) == 6
+    # Resumed run continues from iter 6 to 12.
+    resumed = streamed_kmeans_fit(
+        NpzStream(x, 200), 3, 2, init=init, max_iters=12, tol=-1.0,
+        ckpt_dir=d, ckpt_every=3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(resumed.centroids), np.asarray(full.centroids),
+        rtol=1e-5, atol=1e-5,
+    )
+    assert int(resumed.n_iter) == 12
+
+
+def test_resume_rejects_mismatched_shape(blobs_small, tmp_path):
+    x, _, _ = blobs_small
+    d = str(tmp_path / "ckpt")
+    streamed_kmeans_fit(
+        NpzStream(x, 200), 3, 2, init=x[:3], max_iters=2, tol=-1.0, ckpt_dir=d
+    )
+    with pytest.raises(ValueError, match="checkpoint"):
+        streamed_kmeans_fit(
+            NpzStream(x, 200), 5, 2, init=x[:5], max_iters=2, tol=-1.0, ckpt_dir=d
+        )
+
+
+def test_sweep_resume_skips_completed(tmp_path):
+    from tdc_tpu.cli.sweep import run_sweep
+
+    log = str(tmp_path / "log.csv")
+    spec = {
+        "data": {"n_obs": [600], "n_dim": [2], "seed": 3},
+        "grid": {"K": [2, 3]},
+        "fixed": {"n_max_iters": 4, "n_devices": 1},
+        "log_file": log,
+    }
+    assert run_sweep(spec, isolate=False) == [0, 0]
+    # Second invocation with resume: nothing left to run.
+    codes = run_sweep(spec, isolate=False, resume=True)
+    assert codes == []
+    rows = list(csv.DictReader(open(log)))
+    assert len(rows) == 2  # no duplicate rows appended

@@ -90,17 +90,29 @@ def test_cli_streamed(tmp_path):
 
 
 def test_cli_error_captured_in_csv(tmp_path):
-    # Streamed fuzzy is not implemented yet: must land as an error row
-    # (reference :362-377 semantics), exit code 1.
+    # A malformed data file (1-D array) must land as an error row with the
+    # exception name in the metric columns (reference :362-377 semantics),
+    # exit code 1 — not a traceback crash.
+    bad = str(tmp_path / "bad.npy")
+    np.save(bad, np.arange(10.0))
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(f"--data_file={bad} --K=3 --log_file={log} --n_GPUs=2".split())
+    assert rc == 1
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["computation_time"] == "ValueError"
+    assert row["status"] == "error:ValueError"
+    assert row["num_GPUs"] == "2"  # device count preserved in error rows
+
+
+def test_cli_streamed_fuzzy(tmp_path):
     log = str(tmp_path / "log.csv")
     rc = cli_main(
         f"--n_obs=2000 --n_dim=3 --K=3 --method_name=distributedFuzzyCMeans "
-        f"--log_file={log} --n_GPUs=1 --num_batches=4".split()
+        f"--log_file={log} --n_GPUs=1 --num_batches=4 --n_max_iters=15".split()
     )
-    assert rc == 1
+    assert rc == 0
     row = list(csv.DictReader(open(log)))[0]
-    assert row["computation_time"] == "NotImplementedError"
-    assert row["status"] == "error:NotImplementedError"
+    assert row["status"] == "ok" and row["num_batches"] == "4"
 
 
 def test_cli_data_file_roundtrip(tmp_path):

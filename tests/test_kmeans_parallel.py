@@ -1,0 +1,41 @@
+"""k-means‖ seeding tests."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.ops.kmeans_parallel import init_kmeans_parallel
+from tdc_tpu.models import kmeans_fit
+
+
+def test_shapes_and_determinism(blobs_small):
+    x, _, _ = blobs_small
+    c1 = np.asarray(init_kmeans_parallel(jax.random.PRNGKey(5), jnp.asarray(x), 3))
+    c2 = np.asarray(init_kmeans_parallel(jax.random.PRNGKey(5), jnp.asarray(x), 3))
+    assert c1.shape == (3, 2)
+    np.testing.assert_array_equal(c1, c2)
+    assert not np.isnan(c1).any()
+
+
+def test_seeds_cover_blobs(blobs_small):
+    x, _, centers = blobs_small
+    c = np.asarray(init_kmeans_parallel(jax.random.PRNGKey(0), jnp.asarray(x), 3))
+    d = np.linalg.norm(c[:, None, :] - centers[None], axis=-1)
+    assert (d.min(axis=0) < 3.0).all(), f"seeds {c} miss a blob"
+
+
+def test_fit_with_kmeans_parallel_init(blobs_small):
+    x, _, centers = blobs_small
+    res = kmeans_fit(x, 3, init="kmeans||", key=jax.random.PRNGKey(1), max_iters=50)
+    assert bool(res.converged)
+    got = np.asarray(res.centroids)
+    d = np.linalg.norm(got[:, None, :] - centers[None], axis=-1)
+    assert (d.min(axis=0) < 0.2).all()
+
+
+def test_candidate_pool_larger_than_n_clusters(rng):
+    # K larger relative to a small N: pool must still produce K finite rows.
+    x = rng.normal(size=(200, 4)).astype(np.float32)
+    c = np.asarray(init_kmeans_parallel(jax.random.PRNGKey(2), jnp.asarray(x), 16))
+    assert c.shape == (16, 4)
+    assert np.isfinite(c).all()

@@ -1,0 +1,58 @@
+"""Pallas distance-argmin kernel tests (interpret mode on the CPU mesh; the
+same kernel runs compiled on TPU)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from scipy.spatial.distance import cdist
+
+from tdc_tpu.ops.pallas_kernels import distance_argmin
+
+
+def test_matches_scipy_small(rng):
+    x = rng.normal(size=(300, 7)).astype(np.float32)
+    c = rng.normal(size=(37, 7)).astype(np.float32)
+    arg, mind = distance_argmin(jnp.asarray(x), jnp.asarray(c), return_dist=True)
+    d2 = cdist(x, c, "sqeuclidean")
+    np.testing.assert_array_equal(np.asarray(arg), d2.argmin(1))
+    np.testing.assert_allclose(np.asarray(mind), d2.min(1), rtol=1e-4, atol=1e-4)
+
+
+def test_multiple_k_tiles(rng):
+    # K spans several tiles: exercises the running-argmin accumulation and
+    # the cross-tile index offset.
+    x = rng.normal(size=(256, 9)).astype(np.float32)
+    c = rng.normal(size=(70, 9)).astype(np.float32)
+    arg, _ = distance_argmin(
+        jnp.asarray(x), jnp.asarray(c), block_n=128, block_k=16
+    )
+    d2 = cdist(x, c, "sqeuclidean")
+    np.testing.assert_array_equal(np.asarray(arg), d2.argmin(1))
+
+
+def test_padding_rows_never_selected(rng):
+    # K=5 pads to a full block of 1e15 rows; none may win the argmin.
+    x = rng.normal(size=(130, 3)).astype(np.float32)
+    c = rng.normal(size=(5, 3)).astype(np.float32)
+    arg, _ = distance_argmin(jnp.asarray(x), jnp.asarray(c), block_n=128, block_k=128)
+    assert np.asarray(arg).max() < 5
+
+
+def test_uneven_n(rng):
+    x = rng.normal(size=(257, 4)).astype(np.float32)
+    c = rng.normal(size=(8, 4)).astype(np.float32)
+    arg, mind = distance_argmin(jnp.asarray(x), jnp.asarray(c), return_dist=True)
+    assert arg.shape == (257,) and mind.shape == (257,)
+    d2 = cdist(x, c, "sqeuclidean")
+    np.testing.assert_array_equal(np.asarray(arg), d2.argmin(1))
+
+
+def test_bf16_inputs(rng):
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    c = rng.normal(size=(32, 16)).astype(np.float32)
+    arg, _ = distance_argmin(
+        jnp.asarray(x, jnp.bfloat16), jnp.asarray(c, jnp.bfloat16)
+    )
+    d2 = cdist(x, c, "sqeuclidean")
+    # bf16 rounding can flip near-ties; demand 99%+ agreement.
+    assert (np.asarray(arg) == d2.argmin(1)).mean() > 0.99

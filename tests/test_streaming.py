@@ -44,6 +44,77 @@ def test_minibatch_converges_near_fullbatch(blobs_small):
     assert (d.min(axis=0) < 0.5).all()
 
 
+def test_streamed_mesh_equals_single_device(blobs_small):
+    # Batches of 130 don't divide the 8-way mesh: exercises the zero-pad +
+    # exact correction path.
+    from tdc_tpu.parallel import make_mesh
+
+    x, _, _ = blobs_small
+    init = x[:3]
+    mesh = make_mesh(8)
+    st_mesh = streamed_kmeans_fit(
+        NpzStream(x, 130), 3, 2, init=init, max_iters=40, tol=1e-6, mesh=mesh
+    )
+    st_single = streamed_kmeans_fit(
+        NpzStream(x, 130), 3, 2, init=init, max_iters=40, tol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(st_mesh.centroids), np.asarray(st_single.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert int(st_mesh.n_iter) == int(st_single.n_iter)
+    np.testing.assert_allclose(float(st_mesh.sse), float(st_single.sse), rtol=1e-4)
+
+
+def test_streamed_spherical_unit_centroids(rng):
+    from tdc_tpu.models import kmeans_fit
+
+    x = rng.normal(size=(600, 8)).astype(np.float32)
+    st = streamed_kmeans_fit(
+        NpzStream(x, 100), 4, 8, init=x[:4], max_iters=30, tol=1e-6,
+        spherical=True,
+    )
+    norms = np.linalg.norm(np.asarray(st.centroids), axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+    full = kmeans_fit(x, 4, init=x[:4], max_iters=30, tol=1e-6, spherical=True)
+    np.testing.assert_allclose(
+        np.asarray(st.centroids), np.asarray(full.centroids), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_streamed_fuzzy_equals_fullbatch(blobs_small):
+    from tdc_tpu.models import fuzzy_cmeans_fit, streamed_fuzzy_fit
+
+    x, _, _ = blobs_small
+    init = x[:3]
+    full = fuzzy_cmeans_fit(x, 3, m=2.0, init=init, max_iters=20, tol=-1.0)
+    st = streamed_fuzzy_fit(
+        NpzStream(x, 130), 3, 2, m=2.0, init=init, max_iters=20, tol=-1.0
+    )
+    np.testing.assert_allclose(
+        np.asarray(st.centroids), np.asarray(full.centroids), rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(float(st.objective), float(full.objective), rtol=1e-3)
+
+
+def test_streamed_fuzzy_mesh(blobs_small):
+    from tdc_tpu.parallel import make_mesh
+    from tdc_tpu.models import streamed_fuzzy_fit
+
+    x, _, _ = blobs_small
+    mesh = make_mesh(8)
+    st_mesh = streamed_fuzzy_fit(
+        NpzStream(x, 130), 3, 2, m=2.0, init=x[:3], max_iters=15, tol=-1.0,
+        mesh=mesh,
+    )
+    st = streamed_fuzzy_fit(
+        NpzStream(x, 130), 3, 2, m=2.0, init=x[:3], max_iters=15, tol=-1.0
+    )
+    np.testing.assert_allclose(
+        np.asarray(st_mesh.centroids), np.asarray(st.centroids), rtol=1e-3, atol=1e-3
+    )
+
+
 def test_minibatch_counts_accumulate(blobs_small):
     x, _, _ = blobs_small
     mbk = MiniBatchKMeans(k=3, d=2, init=x[:3])
