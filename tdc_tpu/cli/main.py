@@ -78,6 +78,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--streamed", action="store_true",
                    help="force exact streamed Lloyd even if data fits")
     p.add_argument("--class_sep", type=float, default=1.5)
+    p.add_argument("--native_loader", action="store_true",
+                   help="stream batches through the C++ prefetch loader "
+                        "(requires --data_file pointing at an .npy)")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="write a jax.profiler trace here (nvprof equivalent)")
     return p
@@ -148,8 +151,16 @@ def run_experiment(args) -> dict:
             )
         if streamed:
             rows = -(-n_obs // num_batches)
+            if args.native_loader:
+                if not (args.data_file and args.data_file.endswith(".npy")):
+                    raise ValueError("--native_loader requires an .npy --data_file")
+                from tdc_tpu.data.native_loader import NativePrefetchStream
+
+                stream = NativePrefetchStream(args.data_file, rows)
+            else:
+                stream = NpzStream(np.asarray(x), rows)
             return streamed_kmeans_fit(
-                NpzStream(np.asarray(x), rows), args.K, n_dim,
+                stream, args.K, n_dim,
                 init=args.init, key=key, max_iters=args.n_max_iters,
                 tol=args.tol, spherical=args.spherical, mesh=mesh,
             )

@@ -1,0 +1,115 @@
+"""ctypes wrapper over the native prefetching loader (native/prefetch_loader.cpp).
+
+Streams .npy batches off disk on a background C++ thread so disk IO overlaps
+device compute during streamed Lloyd passes — replacing the reference's
+synchronous full-dataset feed_dict staging (scripts/distribuitedClustering.py:273).
+
+The shared library is built on first use with `make -C native/` (g++ is in the
+image); if the toolchain is unavailable the loader raises and callers fall
+back to the pure-numpy NpzStream.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtdc_prefetch.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ldr_open.restype = ctypes.c_int64
+        lib.ldr_open.argtypes = [ctypes.c_char_p] + [ctypes.c_int64] * 5
+        lib.ldr_next.restype = ctypes.c_int64
+        lib.ldr_next.argtypes = [ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
+        lib.ldr_reset.restype = ctypes.c_int64
+        lib.ldr_reset.argtypes = [ctypes.c_int64]
+        lib.ldr_close.restype = ctypes.c_int64
+        lib.ldr_close.argtypes = [ctypes.c_int64]
+        lib.ldr_last_error.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def _npy_header(path: str):
+    """(data_offset, dtype, shape) of an uncompressed C-contiguous .npy."""
+    with open(path, "rb") as f:
+        version = np.lib.format.read_magic(f)
+        shape, fortran, dtype = np.lib.format._read_array_header(f, version)
+        if fortran:
+            raise ValueError("Fortran-ordered .npy not supported")
+        return f.tell(), dtype, shape
+
+
+class NativePrefetchStream:
+    """Re-iterable prefetched batch stream over an .npy file.
+
+    Same callable protocol as data.loader.NpzStream: each call returns a fresh
+    iterator over (rows_per_batch, d) float batches; one pass per Lloyd
+    iteration. The C++ reader stays `depth` batches ahead of the consumer.
+    """
+
+    def __init__(self, npy_path: str, rows_per_batch: int, *, depth: int = 4):
+        offset, dtype, shape = _npy_header(npy_path)
+        if len(shape) != 2:
+            raise ValueError(f"expected 2-D points file, got shape {shape}")
+        self.dtype = dtype
+        self.shape = shape
+        self.rows_per_batch = int(rows_per_batch)
+        self._row_bytes = int(dtype.itemsize * shape[1])
+        lib = _load_lib()
+        self._id = lib.ldr_open(
+            npy_path.encode(), offset, self._row_bytes, shape[0],
+            self.rows_per_batch, depth,
+        )
+        if self._id < 0:
+            raise OSError(f"ldr_open failed (errno {lib.ldr_last_error()})")
+        self._lib = lib
+
+    @property
+    def num_batches(self) -> int:
+        return -(-self.shape[0] // self.rows_per_batch)
+
+    def __call__(self):
+        lib = self._lib
+        if lib.ldr_reset(self._id) != 0:
+            raise OSError("ldr_reset failed")
+        buf = np.empty((self.rows_per_batch, self.shape[1]), self.dtype)
+        while True:
+            rows = lib.ldr_next(
+                self._id, buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes
+            )
+            if rows < 0:
+                raise OSError(f"ldr_next failed (errno {lib.ldr_last_error()})")
+            if rows == 0:
+                return
+            # Copy out: the ring slot is recycled as soon as we return.
+            yield buf[:rows].copy()
+
+    def close(self):
+        if getattr(self, "_id", -1) >= 0:
+            self._lib.ldr_close(self._id)
+            self._id = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,75 @@
+"""Multi-host execution: jax.distributed over DCN with per-host data shards.
+
+The reference is strictly single-process in-graph replication — no
+ClusterSpec/gRPC/MPI/Horovod anywhere (SURVEY.md §2.3 "Multi-host" row; its
+only orchestration is subprocess.Popen per experiment). This module is the
+pod-scale path: one process per host, `jax.distributed.initialize` for the
+coordinator handshake, a mesh spanning all hosts' devices, and
+`jax.make_array_from_process_local_data` to build the global sharded points
+array from host-local shards (each host loads only its slice — no single-host
+full-dataset staging, the reference's anti-pattern at
+scripts/distribuitedClustering.py:273).
+
+Everything downstream (models/, parallel/collectives.py, sharded_k.py) is
+written against global arrays + meshes and works unchanged on a multi-host
+mesh: psum rides ICI within a slice and DCN across slices, placed by XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tdc_tpu.parallel.mesh import DATA_AXIS
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> tuple[int, int]:
+    """Initialize jax.distributed (no-op when single-process / already up).
+
+    Args come from the environment in managed deployments (TPU VMs autodetect);
+    pass explicitly for manual clusters. Returns (process_index, num_processes).
+    """
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address=coordinator_address)
+    return jax.process_index(), jax.process_count()
+
+
+def global_mesh(axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D mesh over every device of every process."""
+    return Mesh(np.asarray(jax.devices()), (axis_name,))
+
+
+def host_shard_bounds(n_global: int) -> tuple[int, int]:
+    """[start, end) of this host's contiguous row range; even split with the
+    remainder spread over the first hosts (np.array_split semantics, matching
+    the reference's batch split at scripts/distribuitedClustering.py:335)."""
+    p, np_ = jax.process_index(), jax.process_count()
+    base, extra = divmod(n_global, np_)
+    start = p * base + min(p, extra)
+    return start, start + base + (1 if p < extra else 0)
+
+
+def points_from_host_shards(
+    local_rows: np.ndarray, n_global: int, mesh: Mesh, axis_name: str = DATA_AXIS
+) -> jax.Array:
+    """Assemble the global (n_global, d) points array from this host's rows.
+
+    Each process passes only its own host_shard_bounds slice; the result is a
+    single global jax.Array sharded over the mesh's data axis. Requires
+    n_global divisible by the total device count (pad upstream otherwise).
+    """
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_rows), (n_global,) + local_rows.shape[1:]
+    )

@@ -1,0 +1,170 @@
+"""Centroid (K-axis) sharding over a 2-D (data × model) mesh — the
+tensor-parallel analog for clustering (SURVEY.md §2.3: "optional K-axis
+sharding of centroids for the K = 16,384 regime", BASELINE.json config 5).
+
+Layout: points sharded over the 'data' axis, centroids sharded over the
+'model' axis. Each device computes distances only against its K/Pm local
+centroids (the N×K work and memory split Pm ways), the global argmin is a
+small (Pm, n_local) all-gather of per-shard (min, argmin) pairs over ICI, and
+the sufficient statistics stay *sharded over K* — only a psum over the data
+axis touches them, so centroid state never needs to fit on one device.
+
+The reference has no counterpart: its centroid state was a single /cpu:0
+variable broadcast to every tower (scripts/distribuitedClustering.py:199).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tdc_tpu.ops.distance import pairwise_sq_dist
+from tdc_tpu.models.kmeans import KMeansResult
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh_2d(n_data: int, n_model: int) -> Mesh:
+    """(data, model) mesh over the first n_data*n_model devices."""
+    devs = jax.devices()
+    need = n_data * n_model
+    if need > len(devs):
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    return Mesh(
+        np.asarray(devs[:need]).reshape(n_data, n_model), (DATA_AXIS, MODEL_AXIS)
+    )
+
+
+class ShardedStats(NamedTuple):
+    sums: jax.Array  # (K, d) — sharded over K (model axis)
+    counts: jax.Array  # (K,) — sharded over K
+    sse: jax.Array  # () — replicated
+
+
+def _local_stats(x_loc, c_loc):
+    """Per-(data, model) shard body; returns K-sharded stats."""
+    k_per = c_loc.shape[0]
+    m_idx = jax.lax.axis_index(MODEL_AXIS)
+    d2 = pairwise_sq_dist(x_loc, c_loc)  # (n_loc, K/Pm)
+    lmin = jnp.min(d2, axis=1)  # (n_loc,)
+    larg = jnp.argmin(d2, axis=1).astype(jnp.int32) + m_idx * k_per
+    # Global argmin across the model axis: all_gather the per-shard champions
+    # (2 small (Pm, n_loc) arrays over ICI — not the distances).
+    mins = jax.lax.all_gather(lmin, MODEL_AXIS)  # (Pm, n_loc)
+    args = jax.lax.all_gather(larg, MODEL_AXIS)  # (Pm, n_loc)
+    w = jnp.argmin(mins, axis=0)  # (n_loc,) winning shard per point
+    gmin = jnp.take_along_axis(mins, w[None, :], 0)[0]
+    garg = jnp.take_along_axis(args, w[None, :], 0)[0]
+    # Stats for MY K-shard only: one_hot maps out-of-shard assignments to 0.
+    rel = garg - m_idx * k_per
+    one_hot = jax.nn.one_hot(rel, k_per, dtype=jnp.float32)  # (n_loc, K/Pm)
+    sums = jax.lax.dot_general(
+        one_hot,
+        x_loc.astype(jnp.float32),
+        (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    counts = jnp.sum(one_hot, axis=0)
+    # Reduce over the data axis only; K stays sharded. SSE is identical on
+    # every model shard, so the data-psum leaves it replicated.
+    sums = jax.lax.psum(sums, DATA_AXIS)
+    counts = jax.lax.psum(counts, DATA_AXIS)
+    sse = jax.lax.psum(jnp.sum(gmin), DATA_AXIS)
+    return sums, counts, sse, garg
+
+
+def sharded_lloyd_step(mesh: Mesh):
+    """Returns a jit-able step: (x sharded (data,), c sharded (model,)) →
+    (new_c sharded (model,), shift, sse)."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
+        out_specs=(P(MODEL_AXIS, None), P(), P()),
+        check_vma=False,
+    )
+    def step(x_loc, c_loc):
+        sums, counts, sse, _ = _local_stats(x_loc, c_loc)
+        new_c = jnp.where(
+            counts[:, None] > 0,
+            sums / jnp.maximum(counts[:, None], 1.0),
+            c_loc.astype(jnp.float32),
+        )
+        # Shift must be the global max over all K shards.
+        shift_local = jnp.max(jnp.linalg.norm(new_c - c_loc, axis=-1))
+        shift = jax.lax.pmax(shift_local, MODEL_AXIS)
+        return new_c, shift, sse
+
+    return step
+
+
+def sharded_assign(mesh: Mesh):
+    """Jit-able global assignment under the 2-D layout: labels sharded (data,)."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    def assign(x_loc, c_loc):
+        _, _, _, garg = _local_stats(x_loc, c_loc)
+        return garg
+
+    return assign
+
+
+def kmeans_fit_sharded(
+    x,
+    k: int,
+    mesh: Mesh,
+    *,
+    init,
+    max_iters: int = 20,
+    tol: float = 1e-4,
+) -> KMeansResult:
+    """Lloyd K-Means with points sharded over 'data' and centroids over
+    'model'. init must be an explicit (K, d) array (seed at smaller scale or
+    with ops.init / ops.kmeans_parallel first)."""
+    n_data = mesh.devices.shape[0]
+    n_model = mesh.devices.shape[1]
+    x = jnp.asarray(x)
+    if x.shape[0] % n_data != 0:
+        raise ValueError(f"N={x.shape[0]} not divisible by data axis {n_data}")
+    if k % n_model != 0:
+        raise ValueError(f"K={k} not divisible by model axis {n_model}")
+    c = jnp.asarray(init, jnp.float32)
+    if c.shape[0] != k:
+        raise ValueError(f"init has {c.shape[0]} rows, expected {k}")
+    x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
+    c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    step = jax.jit(sharded_lloyd_step(mesh))
+
+    shift = float("inf")
+    sse = float("inf")
+    n_iter = 0
+    converged = False
+    for n_iter in range(1, max_iters + 1):
+        c, shift_dev, sse_dev = step(x, c)
+        shift = float(shift_dev)
+        sse = float(sse_dev)
+        if tol >= 0 and shift <= tol:
+            converged = True
+            break
+    return KMeansResult(
+        centroids=c,
+        n_iter=jnp.asarray(n_iter, jnp.int32),
+        sse=jnp.asarray(sse, jnp.float32),
+        shift=jnp.asarray(shift, jnp.float32),
+        converged=jnp.asarray(converged),
+    )

@@ -1,0 +1,60 @@
+"""Native C++ prefetch loader tests (builds the .so on first use)."""
+
+import numpy as np
+import pytest
+
+from tdc_tpu.data.native_loader import NativePrefetchStream
+from tdc_tpu.models import kmeans_fit, streamed_kmeans_fit
+
+
+@pytest.fixture(scope="module")
+def npy_file(tmp_path_factory):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(1003, 6)).astype(np.float32)
+    p = str(tmp_path_factory.mktemp("native") / "pts.npy")
+    np.save(p, x)
+    return p, x
+
+
+def test_stream_reproduces_file(npy_file):
+    path, x = npy_file
+    s = NativePrefetchStream(path, rows_per_batch=128)
+    got = np.concatenate(list(s()))
+    np.testing.assert_array_equal(got, x)
+    assert s.num_batches == 8
+    s.close()
+
+
+def test_stream_reiterable(npy_file):
+    path, x = npy_file
+    s = NativePrefetchStream(path, rows_per_batch=256, depth=2)
+    for _ in range(3):  # three full passes, as in three Lloyd iterations
+        got = np.concatenate(list(s()))
+        np.testing.assert_array_equal(got, x)
+    s.close()
+
+
+def test_stream_reset_midway(npy_file):
+    path, x = npy_file
+    s = NativePrefetchStream(path, rows_per_batch=128)
+    it = s()
+    next(it), next(it)  # consume 2 of 8 batches, then abandon the pass
+    got = np.concatenate(list(s()))
+    np.testing.assert_array_equal(got, x)
+    s.close()
+
+
+def test_streamed_fit_over_native_loader(npy_file):
+    path, x = npy_file
+    s = NativePrefetchStream(path, rows_per_batch=200)
+    st = streamed_kmeans_fit(s, 4, 6, init=x[:4], max_iters=25, tol=1e-6)
+    full = kmeans_fit(x, 4, init=x[:4], max_iters=25, tol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(st.centroids), np.asarray(full.centroids), rtol=1e-4, atol=1e-4
+    )
+    s.close()
+
+
+def test_open_missing_file_raises():
+    with pytest.raises((OSError, FileNotFoundError)):
+        NativePrefetchStream("/nonexistent/file.npy", 128)

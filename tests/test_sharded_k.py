@@ -1,0 +1,67 @@
+"""K-axis (tensor-parallel analog) sharding tests on the 8-device CPU mesh."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tdc_tpu.models import kmeans_fit
+from tdc_tpu.parallel.sharded_k import (
+    kmeans_fit_sharded,
+    make_mesh_2d,
+    sharded_assign,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    centers = rng.normal(scale=10, size=(8, 6)).astype(np.float32)
+    x = (centers[rng.integers(0, 8, 1600)]
+         + rng.normal(size=(1600, 6)).astype(np.float32))
+    return x.astype(np.float32)
+
+
+def test_sharded_fit_matches_single_device(data):
+    mesh = make_mesh_2d(2, 4)  # 2-way data x 4-way model
+    init = data[:8]
+    sharded = kmeans_fit_sharded(data, 8, mesh, init=init, max_iters=40, tol=1e-6)
+    single = kmeans_fit(data, 8, init=init, max_iters=40, tol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sharded.centroids), np.asarray(single.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert int(sharded.n_iter) == int(single.n_iter)
+    np.testing.assert_allclose(float(sharded.sse), float(single.sse), rtol=1e-4)
+
+
+def test_sharded_fit_4x2(data):
+    mesh = make_mesh_2d(4, 2)
+    init = data[:8]
+    sharded = kmeans_fit_sharded(data, 8, mesh, init=init, max_iters=40, tol=1e-6)
+    single = kmeans_fit(data, 8, init=init, max_iters=40, tol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sharded.centroids), np.asarray(single.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_sharded_assign_matches_global(data):
+    from tdc_tpu.ops.assign import assign_clusters
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh_2d(2, 4)
+    c = data[:8]
+    xs = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("data", None)))
+    cs = jax.device_put(jnp.asarray(c), NamedSharding(mesh, P("model", None)))
+    labels = np.asarray(jax.jit(sharded_assign(mesh))(xs, cs))
+    want = np.asarray(assign_clusters(jnp.asarray(data), jnp.asarray(c)))
+    np.testing.assert_array_equal(labels, want)
+
+
+def test_sharded_fit_validates_divisibility(data):
+    mesh = make_mesh_2d(2, 4)
+    with pytest.raises(ValueError, match="divisible"):
+        kmeans_fit_sharded(data, 6, mesh, init=data[:6])  # 6 % 4 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        kmeans_fit_sharded(data[:1599], 8, mesh, init=data[:8])
