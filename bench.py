@@ -28,10 +28,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from tdc_tpu.ops.assign import apply_centroid_update, lloyd_stats_blocked
+from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
 
 K = 1024
 D = 128
-BLOCK_ROWS = 1 << 17  # 128K-row blocks: (block, K) f32 intermediates = 512 MB
+BLOCK_ROWS = 1 << 17  # XLA fallback blocks (CPU path)
+FUSED_BLOCK_N = 2048  # fused-kernel N-tile; best of the VMEM-feasible sweep
 ITERS_SHORT = 4
 ITERS_LONG = 36
 
@@ -47,7 +49,12 @@ def pick_n(hbm_bytes: int) -> int:
 
 @jax.jit
 def lloyd_iter(x, c):
-    stats = lloyd_stats_blocked(x, c, BLOCK_ROWS)
+    # Fused single-pass Pallas kernel on TPU (distance -> argmin -> one-hot
+    # accumulate, no (N, K) intermediate); XLA blocked path elsewhere.
+    if jax.devices()[0].platform == "tpu":
+        stats = lloyd_stats_fused(x, c, block_n=FUSED_BLOCK_N)
+    else:
+        stats = lloyd_stats_blocked(x, c, BLOCK_ROWS)
     return apply_centroid_update(stats, c)
 
 

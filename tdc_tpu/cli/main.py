@@ -78,11 +78,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--streamed", action="store_true",
                    help="force exact streamed Lloyd even if data fits")
     p.add_argument("--class_sep", type=float, default=1.5)
+    p.add_argument("--kernel", type=str, default="xla", choices=("xla", "pallas"),
+                   help="sufficient-stats kernel for single-device K-Means: "
+                        "'pallas' = fused single-pass VMEM kernel")
     p.add_argument("--native_loader", action="store_true",
                    help="stream batches through the C++ prefetch loader "
                         "(requires --data_file pointing at an .npy)")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="write a jax.profiler trace here (nvprof equivalent)")
+    p.add_argument("--run_log", type=str, default=None,
+                   help="append structured JSONL run events here")
     return p
 
 
@@ -167,6 +172,7 @@ def run_experiment(args) -> dict:
         return kmeans_fit(
             x, args.K, init=args.init, key=key, max_iters=args.n_max_iters,
             tol=args.tol, spherical=args.spherical, mesh=mesh,
+            kernel=args.kernel if mesh is None else "xla",
         )
 
     if args.profile_dir:
@@ -222,7 +228,12 @@ def main(argv=None) -> int:
     validate_args(parser, args)
 
     from tdc_tpu.utils.logging import append_result_row, error_row
+    from tdc_tpu.utils.structlog import RunLog
 
+    runlog = RunLog(args.run_log)
+    runlog.event("run_start", method=args.method_name, K=args.K,
+                 n_obs=args.n_obs, n_dim=args.n_dim, seed=args.seed,
+                 n_devices=args.n_devices)
     base = {
         "method_name": args.method_name,
         "seed": args.seed,
@@ -238,10 +249,14 @@ def main(argv=None) -> int:
     except Exception as e:  # reference :362-377: capture into the CSV, exit 1
         if args.log_file:
             append_result_row(args.log_file, error_row(base, e))
+        runlog.event("run_error", error=type(e).__name__, message=str(e)[:500])
         print(f"FAILED: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
     if args.log_file:
         append_result_row(args.log_file, row)
+    runlog.event("run_ok", **{k: row[k] for k in
+                              ("n_iter", "sse", "converged", "computation_time",
+                               "points_per_sec_per_chip", "num_batches")})
     print(
         f"{row['method_name']}: n_iter={row['n_iter']} "
         f"sse={row['sse']:.6g} converged={row['converged']} "

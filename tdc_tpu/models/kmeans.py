@@ -38,20 +38,32 @@ def _normalize(c: jax.Array) -> jax.Array:
     return c / jnp.maximum(jnp.linalg.norm(c, axis=-1, keepdims=True), 1e-12)
 
 
-@partial(jax.jit, static_argnames=("max_iters", "spherical"))
+def _stats_fn(kernel: str):
+    if kernel == "xla":
+        return lloyd_stats
+    if kernel == "pallas":
+        from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+
+        return lloyd_stats_fused
+    raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
+
+
+@partial(jax.jit, static_argnames=("max_iters", "spherical", "kernel"))
 def _lloyd_loop(
     x: jax.Array,
     init_centroids: jax.Array,
     max_iters: int,
     tol: float,
     spherical: bool,
+    kernel: str = "xla",
 ) -> KMeansResult:
     """One traced Lloyd loop. tol < 0 disables the convergence test (reference
     fixed-iteration parity mode)."""
+    stats_fn = _stats_fn(kernel)
 
     def body(carry):
         c, _, i, _ = carry
-        stats = lloyd_stats(x, c)
+        stats = stats_fn(x, c)
         new_c = apply_centroid_update(stats, c)
         if spherical:
             new_c = _normalize(new_c)
@@ -70,7 +82,7 @@ def _lloyd_loop(
     c, shift, n_iter, sse = jax.lax.while_loop(cond, body, init)
     # The SSE in the carry is measured *before* the final update; recompute the
     # final cost once so the reported SSE matches the returned centroids.
-    final_sse = lloyd_stats(x, c).sse
+    final_sse = stats_fn(x, c).sse
     return KMeansResult(
         centroids=c,
         n_iter=n_iter,
@@ -114,6 +126,7 @@ def kmeans_fit(
     tol: float = 1e-4,
     spherical: bool = False,
     mesh: jax.sharding.Mesh | None = None,
+    kernel: str = "xla",
 ) -> KMeansResult:
     """Fit K-Means.
 
@@ -131,7 +144,12 @@ def kmeans_fit(
       spherical: cosine K-Means — inputs are L2-normalized and centroids are
         re-normalized after every update (BASELINE.json config 5).
       mesh: optional jax.sharding.Mesh with a 'data' axis.
+      kernel: 'xla' (matmul-form, default) or 'pallas' (fused single-pass
+        kernel, single-device only — best at K·d where the (K, d) accumulator
+        fits VMEM; see ops/pallas_kernels.lloyd_stats_fused).
     """
+    if kernel != "xla" and mesh is not None:
+        raise ValueError("kernel='pallas' is single-device; drop mesh=")
     x = jnp.asarray(x)
     if spherical:
         x = _normalize(x.astype(jnp.float32))
@@ -149,7 +167,9 @@ def kmeans_fit(
         c_init = mesh_lib.replicate(c_init, mesh)
     else:
         c_init = resolve_init(x, k, init, key)
-    return _lloyd_loop(x, c_init, int(max_iters), float(tol), bool(spherical))
+    return _lloyd_loop(
+        x, c_init, int(max_iters), float(tol), bool(spherical), kernel
+    )
 
 
 def kmeans_predict(x, centroids, *, spherical: bool = False) -> jax.Array:

@@ -141,6 +141,123 @@ def distance_argmin(
     return arg, mind
 
 
+def _fused_lloyd_kernel(
+    x_ref, c_ref, c2_ref, x2_ref, sums_ref, counts_ref, sse_ref,
+    acc_sums, acc_counts, acc_sse,
+):
+    """Grid over N-blocks; K fully VMEM-resident. Per block: distances →
+    argmin (iota trick) → exact one-hot (col == argmin) → MXU accumulate into
+    VMEM scratch; outputs written once at the last block."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_sums[...] = jnp.zeros_like(acc_sums)
+        acc_counts[...] = jnp.zeros_like(acc_counts)
+        acc_sse[...] = jnp.zeros_like(acc_sse)
+
+    cross = jax.lax.dot_general(
+        x_ref[...],
+        c_ref[...],
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (BN, K)
+    d2 = c2_ref[...] - 2.0 * cross
+    tile_min = jnp.min(d2, axis=1, keepdims=True)  # (BN, 1)
+    col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+    masked = jnp.where(d2 <= tile_min, col, _ARG_SENTINEL)
+    tile_arg = jnp.min(masked, axis=1, keepdims=True)  # (BN, 1)
+    one_hot = (col == tile_arg).astype(x_ref.dtype)  # exact single 1 per row
+    acc_sums[...] += jax.lax.dot_general(
+        one_hot,
+        x_ref[...],
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_counts[...] += jnp.sum(one_hot.astype(jnp.float32), axis=0, keepdims=True)
+    # True SSE needs the dropped ‖x‖² back: Σ(min d2') + Σ‖x‖² per block.
+    acc_sse[...] += jnp.sum(tile_min) + jnp.sum(x2_ref[...])
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        sums_ref[...] = acc_sums[...]
+        counts_ref[...] = acc_counts[...]
+        sse_ref[...] = acc_sse[...]
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def lloyd_stats_fused(
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    block_n: int = 512,
+    interpret: bool | None = None,
+):
+    """Fully-fused Lloyd sufficient stats: one kernel, one pass over x, no
+    (N, K) intermediate anywhere (HBM or otherwise). Requires the (K, d)
+    f32 accumulator + (BN, K) tiles to fit VMEM — the K·d ≲ 1M regime; use
+    lloyd_stats_pallas (two-pass) or ops.assign.lloyd_stats_blocked beyond.
+
+    Returns ops.assign.SufficientStats (sums (K,d) f32, counts (K,) f32,
+    sse () f32 — true Σ min‖x−c‖², clamped at 0).
+    """
+    from tdc_tpu.ops.assign import SufficientStats
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    n, d = x.shape
+    k = centroids.shape[0]
+    xp = _pad_axis(_pad_axis(x, 1, 128, 0), 0, block_n, 0)
+    cp = _pad_axis(
+        _pad_axis(centroids.astype(x.dtype), 1, 128, 0), 0, 128, _PAD_CENTROID
+    )
+    c2 = jnp.sum(cp.astype(jnp.float32) ** 2, axis=1)[None, :]  # (1, K_pad)
+    x2 = jnp.sum(xp.astype(jnp.float32) ** 2, axis=1, keepdims=True)  # (N_pad, 1)
+    n_pad, k_pad = xp.shape[0], cp.shape[0]
+    d_pad = xp.shape[1]
+    n_blocks = n_pad // block_n
+
+    sums, counts, sse = pl.pallas_call(
+        _fused_lloyd_kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((block_n, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k_pad, d_pad), jnp.float32),
+            pltpu.VMEM((1, k_pad), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp, cp, c2, x2)
+    # Padded x rows are all-zero: they land on some real cluster (the smallest
+    # ‖c‖²) with zero Σx contribution but count/sse pollution — correct it.
+    n_fake = n_pad - n
+    if n_fake:
+        c2v = c2[0, :k]
+        j = jnp.argmin(c2v)
+        counts = counts.at[0, j].add(-float(n_fake))
+        sse = sse - n_fake * c2v[j]
+    return SufficientStats(
+        sums=sums[:k, :d],
+        counts=counts[0, :k],
+        sse=jnp.maximum(sse[0, 0], 0.0),
+    )
+
+
 def lloyd_stats_pallas(
     x: jax.Array,
     centroids: jax.Array,

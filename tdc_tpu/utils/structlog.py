@@ -1,0 +1,29 @@
+"""Structured JSONL event logging (SURVEY.md §5 observability row: the
+reference's only observability was the 10-column CSV plus prints).
+
+One JSON object per line: {"ts", "event", ...fields}. Cheap, append-only,
+greppable; the CSV stays the canonical results matrix, this is the run log.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class RunLog:
+    """Append-only JSONL logger; no-op when path is None."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def event(self, name: str, **fields) -> None:
+        if not self.path:
+            return
+        rec = {"ts": round(time.time(), 3), "event": name}
+        rec.update(fields)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")

@@ -1,0 +1,62 @@
+"""Determinism / race tests (SURVEY.md §5: the reference had none; JAX's
+functional purity plus fixed psum reduction order makes these checkable)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.models import kmeans_fit, fuzzy_cmeans_fit
+from tdc_tpu.ops.assign import lloyd_stats
+from tdc_tpu.parallel import (
+    distributed_lloyd_stats,
+    make_mesh,
+    replicate,
+    shard_points,
+)
+
+
+def test_distributed_stats_bitwise_repeatable(rng):
+    x = rng.normal(size=(800, 6)).astype(np.float32)
+    c = rng.normal(size=(5, 6)).astype(np.float32)
+    mesh = make_mesh(8)
+    xs = shard_points(x, mesh)
+    cs = replicate(jnp.asarray(c), mesh)
+    a = distributed_lloyd_stats(xs, cs, mesh)
+    b = distributed_lloyd_stats(xs, cs, mesh)
+    # Same program, same mesh: reductions must be bitwise identical.
+    np.testing.assert_array_equal(np.asarray(a.sums), np.asarray(b.sums))
+    np.testing.assert_array_equal(np.asarray(a.counts), np.asarray(b.counts))
+    assert float(a.sse) == float(b.sse)
+
+
+def test_fit_bitwise_repeatable_across_processes_of_same_shape(blobs_small):
+    x, _, _ = blobs_small
+    mesh = make_mesh(8)
+    r1 = kmeans_fit(x, 3, init=x[:3], max_iters=30, tol=1e-6, mesh=mesh)
+    r2 = kmeans_fit(x, 3, init=x[:3], max_iters=30, tol=1e-6, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(r1.centroids), np.asarray(r2.centroids))
+
+
+def test_single_device_stats_bitwise_repeatable(rng):
+    x = rng.normal(size=(1000, 8)).astype(np.float32)
+    c = rng.normal(size=(7, 8)).astype(np.float32)
+    a = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    b = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    np.testing.assert_array_equal(np.asarray(a.sums), np.asarray(b.sums))
+
+
+def test_donation_safety_fuzzy(blobs_small):
+    # fuzzy fit must not alias/donate its input: x must be readable after.
+    x, _, _ = blobs_small
+    xj = jnp.asarray(x)
+    before = np.asarray(xj).copy()
+    fuzzy_cmeans_fit(xj, 3, init=x[:3], max_iters=5, tol=-1.0)
+    np.testing.assert_array_equal(np.asarray(xj), before)
+
+
+def test_seed_isolation(blobs_small):
+    # Different keys -> different kmeans++ seeds; same key -> same.
+    x, _, _ = blobs_small
+    r1 = kmeans_fit(x, 4, init="kmeans++", key=jax.random.PRNGKey(0), max_iters=1, tol=-1.0)
+    r2 = kmeans_fit(x, 4, init="kmeans++", key=jax.random.PRNGKey(1), max_iters=1, tol=-1.0)
+    assert not np.allclose(np.asarray(r1.centroids), np.asarray(r2.centroids))

@@ -47,6 +47,47 @@ def test_uneven_n(rng):
     np.testing.assert_array_equal(np.asarray(arg), d2.argmin(1))
 
 
+def test_fused_lloyd_stats_matches_xla(rng):
+    from tdc_tpu.ops.assign import lloyd_stats
+    from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+
+    x = rng.normal(size=(1003, 7)).astype(np.float32)  # uneven N, odd d
+    c = rng.normal(size=(37, 7)).astype(np.float32)
+    got = lloyd_stats_fused(jnp.asarray(x), jnp.asarray(c), block_n=256)
+    want = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    np.testing.assert_allclose(np.asarray(got.sums), np.asarray(want.sums),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+    np.testing.assert_allclose(float(got.sse), float(want.sse), rtol=1e-5)
+
+
+def test_fused_lloyd_pad_correction_empty_near_origin(rng):
+    # Zero-padded fake rows land on the cluster nearest the origin; the
+    # correction must remove exactly their count/sse pollution.
+    from tdc_tpu.ops.assign import lloyd_stats
+    from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+
+    x = rng.normal(size=(130, 3)).astype(np.float32) + 5.0  # no real point at 0
+    c = np.array([[5.0, 5.0, 5.0], [0.1, 0.1, 0.1]], np.float32)
+    got = lloyd_stats_fused(jnp.asarray(x), jnp.asarray(c), block_n=128)
+    want = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+    np.testing.assert_allclose(float(got.sse), float(want.sse), rtol=1e-5)
+
+
+def test_kmeans_fit_pallas_kernel_matches(blobs_small):
+    from tdc_tpu.models import kmeans_fit
+
+    x, _, _ = blobs_small
+    r_pallas = kmeans_fit(x, 3, init=x[:3], max_iters=40, tol=1e-6, kernel="pallas")
+    r_xla = kmeans_fit(x, 3, init=x[:3], max_iters=40, tol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(r_pallas.centroids), np.asarray(r_xla.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert int(r_pallas.n_iter) == int(r_xla.n_iter)
+
+
 def test_bf16_inputs(rng):
     x = rng.normal(size=(256, 16)).astype(np.float32)
     c = rng.normal(size=(32, 16)).astype(np.float32)
