@@ -1,0 +1,92 @@
+"""Head-to-head on the reference's own benchmark grid.
+
+The reference's empirical baseline (BASELINE.md, from executions_log.csv):
+d=5, 25M points, 20 Lloyd iterations, seed 123128, up to 8 GPUs:
+
+    K-Means       K=3:  2.81 s on 8 GPUs  (178 M pt·iter/s)
+    K-Means       K=15: 15.5 s on 5-8 GPUs (~32 M pt·iter/s, CPU-reduce bound)
+    FuzzyCMeans   K=3:  1.53 s on 8 GPUs  (326 M pt·iter/s)
+    FuzzyCMeans   K=15: 8.48 s on 8 GPUs  (59 M pt·iter/s)
+
+This script runs the same grid on ONE TPU chip with fixed 20 iterations and
+prints a comparison table. Timing uses the chained-slope method (see bench.py):
+per-iteration time = slope between short and long chains, synced by a
+device→host fetch, so tunnel/dispatch constants cancel.
+
+Run: python benchmarks/reference_showdown.py [--n_obs 25000000]
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tdc_tpu.ops.assign import (
+    apply_centroid_update,
+    fuzzy_stats,
+    lloyd_stats,
+)
+
+REFERENCE_8GPU = {  # (method, K) -> seconds for 20 iters (BASELINE.md)
+    ("kmeans", 3): 2.81,
+    ("kmeans", 15): 15.5,
+    ("fuzzy", 3): 1.53,
+    ("fuzzy", 15): 8.48,
+}
+
+
+def make_iter(method):
+    @jax.jit
+    def it(x, c):
+        if method == "kmeans":
+            return apply_centroid_update(lloyd_stats(x, c), c)
+        s = fuzzy_stats(x, c, m=2.0)
+        return s.weighted_sums / jnp.maximum(s.weights[:, None], 1e-12)
+
+    return it
+
+
+def slope_time(it, x, c, i_short=3, i_long=13):
+    def chain(iters):
+        ci = c
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ci = it(x, ci)
+        np.asarray(ci)
+        return time.perf_counter() - t0
+
+    chain(2)  # warm
+    best = min(
+        (chain(i_long) - chain(i_short)) / (i_long - i_short) for _ in range(2)
+    )
+    return best
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n_obs", type=int, default=25_000_000)
+    p.add_argument("--n_dim", type=int, default=5)
+    args = p.parse_args()
+
+    key = jax.random.PRNGKey(123128)
+    x = jax.random.normal(key, (args.n_obs, args.n_dim), jnp.float32)
+    print(f"n_obs={args.n_obs} d={args.n_dim}, 20 Lloyd iters, one {jax.devices()[0].device_kind}")
+    print(f"{'method':<8} {'K':>3} {'t20 (s)':>9} {'pt·iter/s':>12} "
+          f"{'ref 8-GPU t20':>14} {'speedup':>8}")
+    for method in ("kmeans", "fuzzy"):
+        it = make_iter(method)
+        for k in (3, 9, 15):
+            c = jnp.asarray(np.asarray(x[:k]), jnp.float32)
+            per = slope_time(it, x, c)
+            t20 = per * 20
+            rate = args.n_obs / per
+            ref = REFERENCE_8GPU.get((method, k))
+            speed = f"{ref / t20:7.1f}x" if ref else "      —"
+            ref_s = f"{ref:10.2f} s" if ref else "         —"
+            print(f"{method:<8} {k:>3} {t20:9.3f} {rate:12.3e} {ref_s:>14} {speed:>8}")
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,7 @@ from tdc_tpu.models.kmeans import KMeansResult, kmeans_fit, kmeans_predict
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult, fuzzy_cmeans_fit, fuzzy_predict
 from tdc_tpu.models.minibatch import MiniBatchKMeans
 from tdc_tpu.models.streaming import streamed_kmeans_fit, streamed_fuzzy_fit
+from tdc_tpu.models.estimators import KMeans, FuzzyCMeans
 
 __all__ = [
     "KMeansResult",
@@ -15,4 +16,6 @@ __all__ = [
     "MiniBatchKMeans",
     "streamed_kmeans_fit",
     "streamed_fuzzy_fit",
+    "KMeans",
+    "FuzzyCMeans",
 ]

@@ -1,0 +1,60 @@
+"""sklearn-style estimator facade tests."""
+
+import numpy as np
+import pytest
+
+from tdc_tpu.models.estimators import FuzzyCMeans, KMeans
+
+
+def test_kmeans_estimator_basic(blobs_small):
+    x, y, centers = blobs_small
+    est = KMeans(n_clusters=3, random_state=0, max_iter=100).fit(x)
+    assert est.cluster_centers_.shape == (3, 2)
+    assert est.converged_ and est.n_iter_ < 100
+    assert est.inertia_ > 0
+    assert (est.labels_ == est.predict(x)).all()
+    d = np.linalg.norm(est.cluster_centers_[:, None] - centers[None], axis=-1)
+    assert (d.min(axis=0) < 0.2).all()
+
+
+def test_kmeans_estimator_transform(blobs_small):
+    x, _, _ = blobs_small
+    est = KMeans(n_clusters=3, random_state=0).fit(x)
+    t = est.transform(x[:10])
+    assert t.shape == (10, 3)
+    assert (t.argmin(axis=1) == est.predict(x[:10])).all()
+
+
+def test_kmeans_fit_predict(blobs_small):
+    x, _, _ = blobs_small
+    labels = KMeans(n_clusters=3, random_state=0).fit_predict(x)
+    assert labels.shape == (len(x),)
+    assert set(np.unique(labels)) <= {0, 1, 2}
+
+
+def test_unfitted_raises(blobs_small):
+    x, _, _ = blobs_small
+    with pytest.raises(AttributeError, match="not fitted"):
+        KMeans(3).predict(x)
+    with pytest.raises(AttributeError, match="not fitted"):
+        FuzzyCMeans(3).predict(x)
+
+
+def test_fuzzy_estimator(blobs_small):
+    x, _, _ = blobs_small
+    est = FuzzyCMeans(n_clusters=3, m=2.0, random_state=0, max_iter=100).fit(x)
+    proba = est.predict_proba(x[:20])
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert (proba.argmax(axis=1) == est.predict(x[:20])).all()
+    assert est.objective_ > 0
+
+
+def test_estimator_mesh(blobs_small):
+    from tdc_tpu.parallel import make_mesh
+
+    x, _, _ = blobs_small
+    est = KMeans(n_clusters=3, random_state=0, mesh=make_mesh(8)).fit(x)
+    single = KMeans(n_clusters=3, random_state=0).fit(x)
+    np.testing.assert_allclose(
+        est.cluster_centers_, single.cluster_centers_, rtol=1e-4, atol=1e-4
+    )
