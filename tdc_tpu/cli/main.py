@@ -88,6 +88,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace here (nvprof equivalent)")
     p.add_argument("--run_log", type=str, default=None,
                    help="append structured JSONL run events here")
+    p.add_argument("--dtype", type=str, default="float32",
+                   choices=("float32", "bfloat16"),
+                   help="device dtype for the points (bfloat16 = MXU fast path)")
+    p.add_argument("--ckpt_dir", type=str, default=None,
+                   help="checkpoint/resume directory (streamed mode): saves "
+                        "centroids+iteration via orbax and resumes if present")
+    # Multi-host (jax.distributed over DCN); on managed TPU pods these
+    # autodetect — pass explicitly for manual clusters.
+    p.add_argument("--coordinator_address", type=str, default=None)
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
     return p
 
 
@@ -114,6 +125,13 @@ def run_experiment(args) -> dict:
         import jax
         jax.config.update("jax_platforms", args.backend)
     import jax
+
+    if args.num_processes or args.coordinator_address:
+        from tdc_tpu.parallel.multihost import initialize_distributed
+
+        initialize_distributed(
+            args.coordinator_address, args.num_processes, args.process_id
+        )
     from tdc_tpu.data import load_points, make_blobs, NpzStream
     from tdc_tpu.data.batching import oom_adaptive
     from tdc_tpu.models import (
@@ -141,7 +159,16 @@ def run_experiment(args) -> dict:
     key = jax.random.PRNGKey(args.seed)
 
     def fit(num_batches: int):
+        import jax.numpy as jnp
+
         streamed = args.streamed or num_batches > 1
+        # bf16 applies to the in-memory device paths; streamed batches keep
+        # their on-disk dtype (stats accumulate in f32 either way).
+        xx = (
+            jnp.asarray(x, jnp.bfloat16)
+            if (args.dtype == "bfloat16" and not streamed)
+            else x
+        )
         if args.method_name == "distributedFuzzyCMeans":
             if streamed:
                 rows = -(-n_obs // num_batches)
@@ -151,7 +178,7 @@ def run_experiment(args) -> dict:
                     max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
                 )
             return fuzzy_cmeans_fit(
-                x, args.K, m=args.fuzzifier, init=args.init, key=key,
+                xx, args.K, m=args.fuzzifier, init=args.init, key=key,
                 max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
             )
         if streamed:
@@ -168,9 +195,10 @@ def run_experiment(args) -> dict:
                 stream, args.K, n_dim,
                 init=args.init, key=key, max_iters=args.n_max_iters,
                 tol=args.tol, spherical=args.spherical, mesh=mesh,
+                ckpt_dir=args.ckpt_dir,
             )
         return kmeans_fit(
-            x, args.K, init=args.init, key=key, max_iters=args.n_max_iters,
+            xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,
             tol=args.tol, spherical=args.spherical, mesh=mesh,
             kernel=args.kernel if mesh is None else "xla",
         )
