@@ -31,17 +31,25 @@ class FuzzyCMeansResult(NamedTuple):
     converged: jax.Array  # () bool
 
 
-@partial(jax.jit, static_argnames=("max_iters",))
+@partial(jax.jit, static_argnames=("max_iters", "block_rows"))
 def _fcm_loop(
     x: jax.Array,
     init_centroids: jax.Array,
     max_iters: int,
     tol: float,
     m: float,
+    block_rows: int = 0,
 ) -> FuzzyCMeansResult:
+    if block_rows:
+        from tdc_tpu.ops.assign import fuzzy_stats_padded_blocked
+
+        stats_fn = lambda x, c: fuzzy_stats_padded_blocked(x, c, m, block_rows)
+    else:
+        stats_fn = lambda x, c: fuzzy_stats(x, c, m=m)
+
     def body(carry):
         c, _, i, _ = carry
-        stats = fuzzy_stats(x, c, m=m)
+        stats = stats_fn(x, c)
         new_c = stats.weighted_sums / jnp.maximum(stats.weights[:, None], 1e-12)
         shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
         return new_c, shift, i + 1, stats.objective
@@ -57,7 +65,7 @@ def _fcm_loop(
         jnp.asarray(jnp.inf, jnp.float32),
     )
     c, shift, n_iter, _ = jax.lax.while_loop(cond, body, init)
-    final_obj = fuzzy_stats(x, c, m=m).objective
+    final_obj = stats_fn(x, c).objective
     return FuzzyCMeansResult(
         centroids=c,
         n_iter=n_iter,
@@ -95,7 +103,12 @@ def fuzzy_cmeans_fit(
         c_init = mesh_lib.replicate(c_init, mesh)
     else:
         c_init = resolve_init(x, k, init, key)
-    return _fcm_loop(x, c_init, int(max_iters), float(tol), float(m))
+    block_rows = 0
+    if mesh is None:
+        from tdc_tpu.models.kmeans import auto_block_rows
+
+        block_rows = auto_block_rows(x.shape[0], k)
+    return _fcm_loop(x, c_init, int(max_iters), float(tol), float(m), block_rows)
 
 
 def fuzzy_predict(x, centroids, *, m: float = 2.0, soft: bool = False):

@@ -38,8 +38,12 @@ def _normalize(c: jax.Array) -> jax.Array:
     return c / jnp.maximum(jnp.linalg.norm(c, axis=-1, keepdims=True), 1e-12)
 
 
-def _stats_fn(kernel: str):
+def _stats_fn(kernel: str, block_rows: int):
     if kernel == "xla":
+        if block_rows:
+            from tdc_tpu.ops.assign import lloyd_stats_padded_blocked
+
+            return lambda x, c: lloyd_stats_padded_blocked(x, c, block_rows)
         return lloyd_stats
     if kernel == "pallas":
         from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
@@ -48,7 +52,25 @@ def _stats_fn(kernel: str):
     raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
 
 
-@partial(jax.jit, static_argnames=("max_iters", "spherical", "kernel"))
+def auto_block_rows(n: int, k: int, *, budget_bytes: int | None = None) -> int:
+    """N-block size so the (block, K) f32 intermediates stay within a memory
+    budget — the library-level guard against the reference's tile-OOM failure
+    mode (271/320 of its runs). 0 = no blocking needed."""
+    if budget_bytes is None:
+        try:
+            budget_bytes = int(
+                jax.devices()[0].memory_stats().get("bytes_limit", 16 << 30)
+            )
+        except Exception:
+            budget_bytes = 16 << 30
+    # Working set ≈ 2 (N, K) f32 buffers (distances + one-hot).
+    if 8 * n * k <= 0.3 * budget_bytes:
+        return 0
+    block = int(0.15 * budget_bytes / (8 * k))
+    return max(1 << max(block.bit_length() - 1, 10), 1024)  # pow2, ≥1024
+
+
+@partial(jax.jit, static_argnames=("max_iters", "spherical", "kernel", "block_rows"))
 def _lloyd_loop(
     x: jax.Array,
     init_centroids: jax.Array,
@@ -56,10 +78,11 @@ def _lloyd_loop(
     tol: float,
     spherical: bool,
     kernel: str = "xla",
+    block_rows: int = 0,
 ) -> KMeansResult:
     """One traced Lloyd loop. tol < 0 disables the convergence test (reference
     fixed-iteration parity mode)."""
-    stats_fn = _stats_fn(kernel)
+    stats_fn = _stats_fn(kernel, block_rows)
 
     def body(carry):
         c, _, i, _ = carry
@@ -150,6 +173,9 @@ def kmeans_fit(
     """
     if kernel != "xla" and mesh is not None:
         raise ValueError("kernel='pallas' is single-device; drop mesh=")
+    block_rows = 0
+    if mesh is None and kernel == "xla":
+        block_rows = auto_block_rows(int(np.asarray(x.shape[0])), k)
     x = jnp.asarray(x)
     if spherical:
         x = _normalize(x.astype(jnp.float32))
@@ -168,7 +194,8 @@ def kmeans_fit(
     else:
         c_init = resolve_init(x, k, init, key)
     return _lloyd_loop(
-        x, c_init, int(max_iters), float(tol), bool(spherical), kernel
+        x, c_init, int(max_iters), float(tol), bool(spherical), kernel,
+        block_rows,
     )
 
 

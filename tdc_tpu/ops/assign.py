@@ -112,6 +112,83 @@ def lloyd_stats_blocked(
     return acc
 
 
+def _pad_rows(x: jax.Array, multiple: int) -> tuple[jax.Array, int]:
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem:
+        x = jnp.pad(x, ((0, rem), (0, 0)))
+    return x, rem
+
+
+def lloyd_stats_padded_blocked(
+    x: jax.Array, centroids: jax.Array, block_rows: int
+) -> SufficientStats:
+    """lloyd_stats_blocked for arbitrary N: zero-pads to a block multiple and
+    subtracts the padding's exact contribution (zero rows land on the
+    argmin-‖c‖² cluster with zero Σx — same correction as the fused Pallas
+    kernel and the streaming path)."""
+    xp, n_fake = _pad_rows(x, block_rows)
+    stats = lloyd_stats_blocked(xp, centroids, block_rows)
+    if n_fake == 0:
+        return stats
+    c2 = jnp.sum(centroids.astype(jnp.float32) ** 2, axis=-1)
+    j = jnp.argmin(c2)
+    return SufficientStats(
+        sums=stats.sums,
+        counts=stats.counts.at[j].add(-float(n_fake)),
+        sse=stats.sse - n_fake * c2[j],
+    )
+
+
+def fuzzy_stats_padded_blocked(
+    x: jax.Array, centroids: jax.Array, m: float, block_rows: int
+) -> FuzzyStats:
+    """fuzzy_stats_blocked for arbitrary N with the zero-row correction (a
+    zero row's memberships depend only on ‖c‖², contributing to weights and
+    objective but not Σ u^m x)."""
+    xp, n_fake = _pad_rows(x, block_rows)
+    stats = fuzzy_stats_blocked(xp, centroids, m, block_rows)
+    if n_fake == 0:
+        return stats
+    zs = fuzzy_stats(jnp.zeros((1, x.shape[1]), x.dtype), centroids, m=m)
+    return FuzzyStats(
+        weighted_sums=stats.weighted_sums,
+        weights=stats.weights - n_fake * zs.weights,
+        objective=stats.objective - n_fake * zs.objective,
+    )
+
+
+def fuzzy_stats_blocked(
+    x: jax.Array, centroids: jax.Array, m: float, block_rows: int
+) -> FuzzyStats:
+    """fuzzy_stats over N-blocks via lax.scan (memberships are row-local, so
+    fuzzy stats block exactly like Lloyd stats). Requires N % block_rows == 0."""
+    n, d = x.shape
+    k = centroids.shape[0]
+    if n % block_rows != 0:
+        raise ValueError(f"N={n} not divisible by block_rows={block_rows}")
+    xb = x.reshape(n // block_rows, block_rows, d)
+
+    def body(acc, blk):
+        s = fuzzy_stats(blk, centroids, m=m)
+        return (
+            FuzzyStats(
+                weighted_sums=acc.weighted_sums + s.weighted_sums,
+                weights=acc.weights + s.weights,
+                objective=acc.objective + s.objective,
+            ),
+            None,
+        )
+
+    zero = FuzzyStats(
+        weighted_sums=jnp.zeros((k, d), jnp.float32),
+        weights=jnp.zeros((k,), jnp.float32),
+        objective=jnp.zeros((), jnp.float32),
+    )
+    acc, _ = jax.lax.scan(body, zero, xb)
+    return acc
+
+
 def apply_centroid_update(
     stats: SufficientStats, prev_centroids: jax.Array
 ) -> jax.Array:

@@ -1,0 +1,78 @@
+"""Auto-blocking tests: padded-blocked stats must equal unblocked exactly
+(this is the library-level guard against the reference's tile-OOM failure
+mode — 271/320 of its logged runs)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tdc_tpu.models import fuzzy_cmeans_fit, kmeans_fit
+from tdc_tpu.models.kmeans import auto_block_rows
+from tdc_tpu.ops.assign import (
+    fuzzy_stats,
+    fuzzy_stats_padded_blocked,
+    lloyd_stats,
+    lloyd_stats_padded_blocked,
+)
+
+
+def test_padded_blocked_lloyd_exact(rng):
+    x = rng.normal(size=(1003, 6)).astype(np.float32)  # 1003 % 256 != 0
+    c = rng.normal(size=(11, 6)).astype(np.float32)
+    got = lloyd_stats_padded_blocked(jnp.asarray(x), jnp.asarray(c), 256)
+    want = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    np.testing.assert_allclose(np.asarray(got.sums), np.asarray(want.sums),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+    np.testing.assert_allclose(float(got.sse), float(want.sse), rtol=1e-5)
+
+
+def test_padded_blocked_fuzzy_exact(rng):
+    x = rng.normal(size=(777, 4)).astype(np.float32)
+    c = rng.normal(size=(5, 4)).astype(np.float32)
+    got = fuzzy_stats_padded_blocked(jnp.asarray(x), jnp.asarray(c), 2.0, 128)
+    want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=2.0)
+    np.testing.assert_allclose(
+        np.asarray(got.weighted_sums), np.asarray(want.weighted_sums),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(np.asarray(got.weights), np.asarray(want.weights),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(got.objective), float(want.objective),
+                               rtol=1e-4)
+
+
+def test_auto_block_rows_thresholds():
+    # Small problems: no blocking. Huge N*K: power-of-two block >= 1024.
+    assert auto_block_rows(10_000, 16, budget_bytes=16 << 30) == 0
+    b = auto_block_rows(100_000_000, 16384, budget_bytes=16 << 30)
+    assert b >= 1024 and (b & (b - 1)) == 0
+    assert 8 * b * 16384 <= 0.2 * (16 << 30)
+
+
+def test_fit_with_forced_blocking_matches(blobs_small, monkeypatch):
+    # Force tiny budget so the fit path actually blocks, then compare.
+    import tdc_tpu.models.kmeans as km
+
+    x, _, _ = blobs_small
+    plain = kmeans_fit(x, 3, init=x[:3], max_iters=40, tol=1e-6)
+    monkeypatch.setattr(km, "auto_block_rows", lambda n, k, **kw: 1024)
+    blocked = kmeans_fit(x, 3, init=x[:3], max_iters=40, tol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(blocked.centroids), np.asarray(plain.centroids),
+        rtol=1e-5, atol=1e-5,
+    )
+    assert int(blocked.n_iter) == int(plain.n_iter)
+
+
+def test_fuzzy_fit_with_forced_blocking_matches(blobs_small, monkeypatch):
+    import tdc_tpu.models.fuzzy as fz
+    import tdc_tpu.models.kmeans as km
+
+    x, _, _ = blobs_small
+    plain = fuzzy_cmeans_fit(x, 3, init=x[:3], max_iters=15, tol=-1.0)
+    monkeypatch.setattr(km, "auto_block_rows", lambda n, k, **kw: 512)
+    blocked = fuzzy_cmeans_fit(x, 3, init=x[:3], max_iters=15, tol=-1.0)
+    np.testing.assert_allclose(
+        np.asarray(blocked.centroids), np.asarray(plain.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
