@@ -102,6 +102,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cache_dir", type=str,
                    default=os.path.expanduser("~/.cache/tdc_tpu_xla"),
                    help="persistent XLA compilation cache ('' disables)")
+    p.add_argument("--history_file", type=str, default=None,
+                   help="write per-iteration (sse, shift) CSV (streamed mode)")
     return p
 
 
@@ -236,6 +238,15 @@ def run_experiment(args) -> dict:
     finally:
         if args.profile_dir:
             jax.profiler.stop_trace()
+
+    if args.history_file and getattr(result, "history", None) is not None:
+        import csv as _csv
+
+        with open(args.history_file, "w", newline="") as f:
+            w = _csv.writer(f)
+            w.writerow(["iteration", "sse", "shift"])
+            for i, (sse_i, shift_i) in enumerate(np.asarray(result.history), 1):
+                w.writerow([i, sse_i, shift_i])
 
     n_iter = int(result.n_iter)
     comp = timers.get("computation")

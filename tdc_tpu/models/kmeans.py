@@ -32,6 +32,9 @@ class KMeansResult(NamedTuple):
     sse: jax.Array  # () float32 — final sum of squared errors
     shift: jax.Array  # () float32 — last max centroid movement (L2)
     converged: jax.Array  # () bool
+    # (n_iter, 2) [sse, shift] per iteration — filled by the streamed fit
+    # (the cost curve the reference commented out "for performance").
+    history: object = None
 
 
 def _normalize(c: jax.Array) -> jax.Array:
@@ -199,10 +202,25 @@ def kmeans_fit(
     )
 
 
-def kmeans_predict(x, centroids, *, spherical: bool = False) -> jax.Array:
+def kmeans_predict(
+    x, centroids, *, spherical: bool = False, kernel: str = "auto"
+) -> jax.Array:
     """Per-point cluster labels (the reference's full `cluster_idx` output,
-    Testing Images.ipynb#cell1 result_matrix/argmin path)."""
+    Testing Images.ipynb#cell1 result_matrix/argmin path).
+
+    kernel: 'xla', 'pallas' (blockwise online-argmin, no N×K buffer), or
+    'auto' — pallas on TPU once the N×K matrix would exceed ~1 GB.
+    """
     x = jnp.asarray(x)
     if spherical:
         x = _normalize(x.astype(jnp.float32))
-    return assign_clusters(x, jnp.asarray(centroids))
+    centroids = jnp.asarray(centroids)
+    if kernel == "auto":
+        on_tpu = jax.devices()[0].platform == "tpu"
+        big = 4 * x.shape[0] * centroids.shape[0] > (1 << 30)
+        kernel = "pallas" if (on_tpu and big) else "xla"
+    if kernel == "pallas":
+        from tdc_tpu.ops.pallas_kernels import distance_argmin
+
+        return distance_argmin(x, centroids)[0]
+    return assign_clusters(x, centroids)

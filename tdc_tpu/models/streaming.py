@@ -164,12 +164,14 @@ def streamed_kmeans_fit(
 
     shift = jnp.inf
     n_iter = start_iter
+    history = []
     for n_iter in range(start_iter + 1, max_iters + 1):
         acc = full_pass(c)
         new_c = apply_centroid_update(acc, c)
         if spherical:
             new_c = _normalize(new_c)
         shift = float(jnp.max(jnp.linalg.norm(new_c - c, axis=-1)))
+        history.append((float(acc.sse), shift))
         c = new_c
         done = tol >= 0 and shift <= tol
         if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
@@ -186,6 +188,7 @@ def streamed_kmeans_fit(
         sse=jnp.asarray(sse, jnp.float32),
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(tol >= 0 and shift <= tol),
+        history=np.asarray(history, np.float32),
     )
 
 

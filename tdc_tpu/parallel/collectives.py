@@ -30,12 +30,25 @@ from tdc_tpu.parallel.mesh import DATA_AXIS
 
 
 def distributed_lloyd_stats(
-    x: jax.Array, centroids: jax.Array, mesh: Mesh, axis_name: str = DATA_AXIS
+    x: jax.Array,
+    centroids: jax.Array,
+    mesh: Mesh,
+    axis_name: str = DATA_AXIS,
+    kernel: str = "xla",
 ) -> SufficientStats:
     """Globally-reduced Lloyd stats: per-shard tower + psum.
 
     x must be sharded (axis_name) on its leading axis; centroids replicated.
+    kernel='pallas' runs the fused single-pass VMEM kernel *inside* each
+    shard_map body — per-device compute identical to the single-chip fast
+    path, with only the (K, d) stats crossing ICI.
     """
+    if kernel == "pallas":
+        from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+
+        local_fn = lloyd_stats_fused
+    else:
+        local_fn = lloyd_stats
 
     @partial(
         shard_map,
@@ -45,7 +58,7 @@ def distributed_lloyd_stats(
         check_vma=False,
     )
     def step(x_shard, c):
-        local = lloyd_stats(x_shard, c)
+        local = local_fn(x_shard, c)
         return jax.tree.map(lambda t: jax.lax.psum(t, axis_name), local)
 
     return step(x, centroids)

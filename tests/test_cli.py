@@ -131,6 +131,25 @@ def test_cli_data_file_roundtrip(tmp_path):
     assert row["n_obs"] == "1000" and row["n_dim"] == "3"
 
 
+def test_sweep_survives_crashing_config(tmp_path):
+    # Fault injection: one config is invalid (fuzzifier=1.0 -> ValueError).
+    # The sweep must record the failure and still run the remaining configs
+    # (the reference's per-config crash isolation, new_experiment.py:59-64).
+    log = str(tmp_path / "log.csv")
+    spec = {
+        "data": {"n_obs": [600], "n_dim": [2], "seed": 3},
+        "grid": {"fuzzifier": [1.0, 2.0]},
+        "fixed": {"K": 2, "n_max_iters": 4, "n_devices": 1,
+                  "method_name": "distributedFuzzyCMeans"},
+        "log_file": log,
+    }
+    codes = run_sweep(spec, isolate=False)
+    assert codes == [1, 0]  # first config fails, second succeeds
+    rows = list(csv.DictReader(open(log)))
+    assert rows[0]["status"].startswith("error:ValueError")
+    assert rows[1]["status"] == "ok"
+
+
 def test_sweep_grid_expansion():
     spec = {
         "data": {"n_obs": [100, 200], "n_dim": [2], "seed": 9},

@@ -33,6 +33,32 @@ def test_distributed_stats_match_single_device(rng):
     np.testing.assert_allclose(float(dist.sse), float(local.sse), rtol=1e-5)
 
 
+def test_distributed_pallas_stats_match(rng):
+    # The fused Pallas kernel inside shard_map (interpret mode on CPU) must
+    # reduce to the same global stats as the XLA tower.
+    x = rng.normal(size=(800, 6)).astype(np.float32)
+    c = rng.normal(size=(5, 6)).astype(np.float32)
+    mesh = make_mesh(8)
+    xs = shard_points(x, mesh)
+    cs = replicate(jnp.asarray(c), mesh)
+    got = distributed_lloyd_stats(xs, cs, mesh, kernel="pallas")
+    want = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    np.testing.assert_allclose(np.asarray(got.sums), np.asarray(want.sums),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+    np.testing.assert_allclose(float(got.sse), float(want.sse), rtol=1e-4)
+
+
+def test_kmeans_predict_pallas_matches(rng):
+    from tdc_tpu.models import kmeans_predict
+
+    x = rng.normal(size=(500, 5)).astype(np.float32)
+    c = rng.normal(size=(9, 5)).astype(np.float32)
+    a = np.asarray(kmeans_predict(x, c, kernel="xla"))
+    b = np.asarray(kmeans_predict(x, c, kernel="pallas"))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_distributed_fuzzy_stats_match(rng):
     x = rng.normal(size=(640, 4)).astype(np.float32)
     c = rng.normal(size=(3, 4)).astype(np.float32)
