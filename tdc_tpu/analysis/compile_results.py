@@ -87,9 +87,15 @@ def compile_log(log_csv: str, output_dir: str) -> list[str]:
     num = pd.to_numeric(df["computation_time"], errors="coerce")
     ok = df[num.notna()].copy()
     ok["computation_time"] = num[num.notna()]
+    # Iterations the run actually executed: n_iter_run when logged (differs
+    # from the cumulative n_iter on checkpoint resume — using n_iter there
+    # would inflate throughput for resumed rows), else n_iter.
+    iters = pd.to_numeric(ok["n_iter"], errors="coerce")
+    if "n_iter_run" in ok.columns:
+        run = pd.to_numeric(ok["n_iter_run"], errors="coerce")
+        iters = run.where(run.notna(), iters)
     ok["pt_iter_per_s"] = (
-        pd.to_numeric(ok["n_obs"]) * pd.to_numeric(ok["n_iter"], errors="coerce")
-        / ok["computation_time"]
+        pd.to_numeric(ok["n_obs"]) * iters / ok["computation_time"]
     )
     for method, sub in ok.groupby("method_name"):
         pivot = sub.pivot_table(

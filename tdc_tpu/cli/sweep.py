@@ -57,12 +57,58 @@ def config_argv(cfg: dict, log_file: str | None) -> list[str]:
     return argv
 
 
+# CSV-fallback resume keys (legacy logs only; new runs use the sidecar hash
+# file, which covers every axis). num_GPUs is compared separately because a
+# config that doesn't pin n_devices can't be matched against the CSV's
+# recorded actual device count.
 _RESUME_KEYS = ("method_name", "seed", "K", "n_obs", "n_dim")
 
 
-def completed_configs(log_file: str | None) -> set[tuple]:
-    """Configs already logged with status ok — sweep resume works by diffing
-    the CSV against the config matrix (SURVEY.md §5 checkpoint/resume row)."""
+def _config_hash(cfg: dict) -> str:
+    """Stable hash over the FULL config — every grid axis participates, so a
+    sweep varying tol/n_devices/anything resumes correctly."""
+    import hashlib
+    import json as _json
+
+    blob = _json.dumps(cfg, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _done_file(log_file: str) -> str:
+    return log_file + ".sweep_done"
+
+
+def completed_configs(log_file: str | None) -> set[str]:
+    """Full-config hashes already completed, from the sidecar done-file.
+
+    A done-file whose log CSV has been deleted is stale — the user's "delete
+    the log to redo the sweep clean" gesture must reset resume state too, so
+    the sidecar is discarded (with a notice) rather than silently honored.
+    """
+    import os
+    import sys
+
+    done = set()
+    if not log_file or not os.path.exists(_done_file(log_file)):
+        return done
+    if not os.path.exists(log_file):
+        print(
+            f"note: {log_file} is gone; removing stale {_done_file(log_file)} "
+            "and restarting the sweep from scratch",
+            file=sys.stderr,
+        )
+        os.remove(_done_file(log_file))
+        return done
+    with open(_done_file(log_file)) as f:
+        for line in f:
+            if line.strip():
+                done.add(line.strip())
+    return done
+
+
+def completed_csv_keys(log_file: str | None) -> set[tuple]:
+    """Legacy fallback: configs logged ok in the CSV as (key5, num_GPUs)
+    pairs. Coarser than the hash — only consulted when no done-file exists."""
     import csv
     import os
 
@@ -72,7 +118,8 @@ def completed_configs(log_file: str | None) -> set[tuple]:
     with open(log_file) as f:
         for row in csv.DictReader(f):
             if row.get("status") == "ok":
-                done.add(tuple(str(row.get(k, "")) for k in _RESUME_KEYS))
+                key5 = tuple(str(row.get(k, "")) for k in _RESUME_KEYS)
+                done.add((key5, str(row.get("num_GPUs", ""))))
     return done
 
 
@@ -81,21 +128,69 @@ def _config_key(cfg: dict) -> tuple:
     return tuple(str(cfg.get(k, defaults.get(k, ""))) for k in _RESUME_KEYS)
 
 
+def _covered_by_csv(cfg: dict, csv_done: set[tuple]) -> bool:
+    """True if a legacy CSV row covers this config. num_GPUs participates only
+    when the config pins n_devices (otherwise the CSV records the run's actual
+    device count, which the config can't predict)."""
+    key5 = _config_key(cfg)
+    if "n_devices" in cfg:
+        return (key5, str(cfg["n_devices"])) in csv_done
+    return any(k == key5 for k, _ in csv_done)
+
+
+def _mark_done(log_file: str | None, cfg: dict) -> None:
+    if not log_file:
+        return
+    with open(_done_file(log_file), "a") as f:
+        f.write(_config_hash(cfg) + "\n")
+
+
 def run_sweep(
-    spec: dict, *, dry_run: bool = False, isolate: bool = True, resume: bool = False
+    spec: dict,
+    *,
+    dry_run: bool = False,
+    isolate: bool = True,
+    resume: bool = False,
+    resume_legacy_csv: bool = False,
 ) -> list[int]:
     """Run every config; per-config subprocess isolation (reference :59) so a
     hard crash can't kill the sweep. Returns per-config exit codes.
-    resume=True skips configs already logged ok in the spec's log_file."""
+
+    resume=True skips configs whose full-config hash is in the sidecar
+    done-file (written per completed config; covers every grid axis).
+    resume_legacy_csv=True additionally lets pre-done-file logs skip configs
+    via coarse CSV matching — explicitly opt-in because the CSV records only
+    method/seed/K/n_obs/n_dim/num_GPUs: a legacy row CANNOT distinguish
+    configs that differ on tol/init/n_max_iters/... (round-1 advisor bug
+    class). Safe default: hash-only, worst case a re-run.
+    """
     log_file = spec.get("log_file")
     codes = []
     configs = expand_grid(spec)
     if resume:
         done = completed_configs(log_file)
-        skipped = [c for c in configs if _config_key(c) in done]
-        configs = [c for c in configs if _config_key(c) not in done]
-        if skipped:
-            print(f"resume: skipping {len(skipped)} completed configs")
+        keep = [c for c in configs if _config_hash(c) not in done]
+        if resume_legacy_csv and not done:
+            # Opt-in coarse fallback for pre-done-file logs. Matched
+            # completions are migrated into the done-file so later resumes
+            # (hash branch) keep them. A config whose 5-key collides with
+            # another in THIS grid is never covered (known-ambiguous even
+            # within the grid).
+            from collections import Counter
+
+            key_counts = Counter(_config_key(c) for c in configs)
+            csv_done = completed_csv_keys(log_file)
+            still = []
+            for c in keep:
+                if key_counts[_config_key(c)] == 1 and _covered_by_csv(c, csv_done):
+                    if not dry_run:  # a dry run must not mutate on-disk state
+                        _mark_done(log_file, c)
+                else:
+                    still.append(c)
+            keep = still
+        if len(keep) < len(configs):
+            print(f"resume: skipping {len(configs) - len(keep)} completed configs")
+        configs = keep
     for i, cfg in enumerate(configs):
         argv = config_argv(cfg, log_file)
         print(f"[{i + 1}/{len(configs)}] {' '.join(argv[2:])}", flush=True)
@@ -104,11 +199,14 @@ def run_sweep(
             continue
         if isolate:
             proc = subprocess.run(argv)
-            codes.append(proc.returncode)
-            print(f"  -> exit {proc.returncode}", flush=True)
+            code = proc.returncode
+            print(f"  -> exit {code}", flush=True)
         else:
             from tdc_tpu.cli.main import main as run_main
-            codes.append(run_main(argv[3:]))
+            code = run_main(argv[3:])
+        codes.append(code)
+        if code == 0:
+            _mark_done(log_file, cfg)
     return codes
 
 
@@ -119,11 +217,17 @@ def main(argv=None) -> int:
     p.add_argument("--no_isolate", action="store_true",
                    help="run in-process (faster, no crash isolation)")
     p.add_argument("--resume", action="store_true",
-                   help="skip configs already logged ok in the log_file")
+                   help="skip configs already completed (full-config hash "
+                        "recorded in <log_file>.sweep_done)")
+    p.add_argument("--resume_legacy_csv", action="store_true",
+                   help="with --resume on a pre-done-file log: also skip via "
+                        "coarse CSV matching (cannot distinguish configs "
+                        "differing only on axes the CSV doesn't record)")
     args = p.parse_args(argv)
     spec = json.load(sys.stdin if args.spec == "-" else open(args.spec))
     codes = run_sweep(
-        spec, dry_run=args.dry_run, isolate=not args.no_isolate, resume=args.resume
+        spec, dry_run=args.dry_run, isolate=not args.no_isolate,
+        resume=args.resume, resume_legacy_csv=args.resume_legacy_csv,
     )
     failed = sum(1 for c in codes if c != 0)
     print(f"sweep done: {len(codes) - failed}/{len(codes)} ok")

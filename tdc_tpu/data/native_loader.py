@@ -30,10 +30,43 @@ def _load_lib():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        # Always invoke make: its dependency tracking is a no-op when the .so
+        # is fresh, and an edited prefetch_loader.cpp is never silently
+        # shadowed by a stale binary. Only a missing toolchain falls back to
+        # an existing .so; a failed compile must surface, stderr included.
+        # An flock serializes concurrent builders across processes (the
+        # Makefile's atomic tmp+rename already guarantees no one dlopens a
+        # partial .so; the lock just avoids duplicate compiles).
+        try:
+            import fcntl
+
+            lock = open(os.path.join(_NATIVE_DIR, ".build_lock"), "w")
+            fcntl.flock(lock, fcntl.LOCK_EX)
+        except OSError:
+            lock = None  # e.g. read-only dir / no-flock fs: rely on atomic mv
+        try:
             subprocess.run(
                 ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
             )
+        except FileNotFoundError:
+            if not os.path.exists(_LIB_PATH):
+                raise
+        except subprocess.CalledProcessError as e:
+            # Surface the compile error; but a deployment with a working
+            # prebuilt .so and no usable toolchain should still load it.
+            msg = (
+                "make failed building libtdc_prefetch.so:\n"
+                + e.stderr.decode(errors="replace")
+            )
+            if not os.path.exists(_LIB_PATH):
+                raise RuntimeError(msg) from e
+            import sys
+
+            print(f"WARNING: {msg}\nfalling back to existing {_LIB_PATH}",
+                  file=sys.stderr)
+        finally:
+            if lock is not None:
+                lock.close()  # releases the flock
         lib = ctypes.CDLL(_LIB_PATH)
         lib.ldr_open.restype = ctypes.c_int64
         lib.ldr_open.argtypes = [ctypes.c_char_p] + [ctypes.c_int64] * 5

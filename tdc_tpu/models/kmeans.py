@@ -28,13 +28,16 @@ from tdc_tpu.parallel import mesh as mesh_lib
 
 class KMeansResult(NamedTuple):
     centroids: jax.Array  # (K, d) float32
-    n_iter: jax.Array  # () int32 — iterations actually run
+    n_iter: jax.Array  # () int32 — cumulative iterations (incl. resumed-from)
     sse: jax.Array  # () float32 — final sum of squared errors
     shift: jax.Array  # () float32 — last max centroid movement (L2)
     converged: jax.Array  # () bool
     # (n_iter, 2) [sse, shift] per iteration — filled by the streamed fit
     # (the cost curve the reference commented out "for performance").
     history: object = None
+    # Iterations executed by THIS fit call (None = same as n_iter). Differs on
+    # checkpoint resume; throughput must be computed from this, not n_iter.
+    n_iter_run: object = None
 
 
 def _normalize(c: jax.Array) -> jax.Array:

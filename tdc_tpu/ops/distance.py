@@ -22,14 +22,26 @@ def pairwise_sq_dist(
     centroids: jax.Array,
     *,
     precision: jax.lax.Precision | None = None,
+    center: bool = False,
 ) -> jax.Array:
     """Squared Euclidean distance between every point and every centroid.
+
+    Precision note: the ‖x‖² − 2x·c + ‖c‖² expansion loses relative accuracy
+    ~‖x‖²·eps when the data sits far from the origin (unlike the reference's
+    exact (x−c)² form) — clusters separated by distances much smaller than
+    their offset can be mis-assigned. Mitigations: pass `center=True` (shifts
+    both x and centroids by the centroid mean — distances are translation-
+    invariant, so this is exact and removes the offset term), pre-center the
+    data once upstream, or use `pairwise_sq_dist_direct` for small d.
 
     Args:
       x: (N, d) points.
       centroids: (K, d) centroids.
       precision: matmul precision; defaults to HIGHEST for small d where
         cancellation in the expansion matters.
+      center: subtract the centroid mean from both operands before expanding
+        (O((N+K)·d) extra work vs the O(N·K·d) matmul; worth it when
+        ‖x‖ ≫ inter-cluster distances).
 
     Returns:
       (N, K) squared distances, clamped at 0 (the expansion can go slightly
@@ -37,6 +49,10 @@ def pairwise_sq_dist(
     """
     x = jnp.asarray(x)
     centroids = jnp.asarray(centroids)
+    if center:
+        mu = jnp.mean(centroids.astype(jnp.float32), axis=0)
+        x = x.astype(jnp.float32) - mu
+        centroids = centroids.astype(jnp.float32) - mu
     if precision is None:
         # bf16 inputs: single-pass MXU matmul with f32 accumulation (the TPU
         # fast path). f32 inputs: HIGHEST so the expansion's cancellation
@@ -59,6 +75,33 @@ def pairwise_sq_dist(
     )  # (N, K)
     d2 = x_sq - 2.0 * cross + c_sq
     return jnp.maximum(d2, 0.0)
+
+
+def pairwise_sq_dist_direct(
+    x: jax.Array, centroids: jax.Array, *, block_rows: int = 4096
+) -> jax.Array:
+    """Exact (x−c)² squared distances — the reference's formulation
+    (scripts/distribuitedClustering.py:221-230), but blocked over N so the
+    (block, K, d) difference tensor stays bounded instead of the reference's
+    full N×K×M materialization. VPU-bound (no MXU); use only when the matmul
+    expansion's cancellation error matters and centering isn't enough.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    c = jnp.asarray(centroids, jnp.float32)
+    n = x.shape[0]
+    if n <= block_rows:
+        diff = x[:, None, :] - c[None, :, :]
+        return jnp.sum(diff * diff, axis=-1)
+    pad = (-n) % block_rows
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    xb = xp.reshape(-1, block_rows, x.shape[1])
+
+    def body(_, blk):
+        diff = blk[:, None, :] - c[None, :, :]
+        return None, jnp.sum(diff * diff, axis=-1)
+
+    _, d2 = jax.lax.scan(body, None, xb)
+    return d2.reshape(-1, c.shape[0])[:n]
 
 
 def pairwise_dist(x: jax.Array, centroids: jax.Array) -> jax.Array:

@@ -26,6 +26,7 @@ REFERENCE_COLUMNS = [
 ]
 
 EXTENDED_COLUMNS = REFERENCE_COLUMNS + [
+    "n_iter_run",  # iterations executed by THIS run (≠ n_iter on ckpt resume)
     "backend",
     "n_chips",
     "points_per_sec_per_chip",

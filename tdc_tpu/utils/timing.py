@@ -53,5 +53,8 @@ class PhaseTimers:
     def get(self, name: str) -> float:
         return self.seconds.get(name, 0.0)
 
+    def set(self, name: str, seconds: float) -> None:
+        self.seconds[name] = float(seconds)
+
     def as_dict(self) -> dict[str, float]:
         return dict(self.seconds)

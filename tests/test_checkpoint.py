@@ -100,3 +100,98 @@ def test_sweep_resume_skips_completed(tmp_path):
     assert codes == []
     rows = list(csv.DictReader(open(log)))
     assert len(rows) == 2  # no duplicate rows appended
+
+
+def test_sweep_resume_distinguishes_non_csv_axes(tmp_path):
+    """A grid varying an axis the CSV doesn't record (tol) must not be
+    collapsed on resume (round-1 advisor finding: resume keyed only on
+    method/seed/K/n_obs/n_dim silently skipped distinct configs)."""
+    from tdc_tpu.cli.sweep import run_sweep
+
+    log = str(tmp_path / "log.csv")
+    base = {
+        "data": {"n_obs": [600], "n_dim": [2], "seed": 3},
+        "fixed": {"n_max_iters": 4, "n_devices": 1},
+        "log_file": log,
+    }
+    spec1 = dict(base, grid={"K": [2], "tol": [-1.0]})
+    assert run_sweep(spec1, isolate=False) == [0]
+    # Same K/seed/n_obs but different tol: a fresh config, must run.
+    spec2 = dict(base, grid={"K": [2], "tol": [0.5]})
+    codes = run_sweep(spec2, isolate=False, resume=True)
+    assert codes == [0]
+    # And re-resuming the second spec now skips it.
+    assert run_sweep(spec2, isolate=False, resume=True) == []
+
+
+def test_resume_of_finished_run_reports_converged(blobs_small, tmp_path):
+    """Re-running a completed checkpointed fit must report the checkpointed
+    run's true state (converged, final shift) and zero iterations executed —
+    not shift=inf/converged=False (round-1 advisor finding)."""
+    x, _, _ = blobs_small
+    d = str(tmp_path / "ckpt")
+    first = streamed_kmeans_fit(
+        NpzStream(x, 200), 3, 2, init=x[:3], max_iters=50, tol=1e-3, ckpt_dir=d
+    )
+    assert bool(first.converged)
+    again = streamed_kmeans_fit(
+        NpzStream(x, 200), 3, 2, init=x[:3], max_iters=50, tol=1e-3, ckpt_dir=d
+    )
+    assert bool(again.converged)
+    assert float(again.shift) == float(first.shift)
+    assert again.n_iter_run == 0 and int(again.n_iter) == int(first.n_iter)
+    assert len(again.history) == len(first.history)
+    np.testing.assert_allclose(
+        np.asarray(again.centroids), np.asarray(first.centroids), atol=1e-6
+    )
+
+
+def test_sweep_legacy_csv_never_covers_ambiguous_grid(tmp_path):
+    """CSV fallback with a grid that varies a non-CSV axis (tol): the rows are
+    ambiguous, so NO config may be skipped (a false skip would be migrated as
+    a permanent hash completion)."""
+    import os
+
+    from tdc_tpu.cli.sweep import run_sweep, _done_file
+
+    log = str(tmp_path / "log.csv")
+    base = {
+        "data": {"n_obs": [600], "n_dim": [2], "seed": 3},
+        "fixed": {"n_max_iters": 4, "n_devices": 1},
+        "log_file": log,
+    }
+    assert run_sweep(dict(base, grid={"K": [2], "tol": [-1.0]}), isolate=False) == [0]
+    os.remove(_done_file(log))  # legacy state: CSV rows only
+    codes = run_sweep(
+        dict(base, grid={"K": [2], "tol": [-1.0, 0.5]}), isolate=False,
+        resume=True, resume_legacy_csv=True,
+    )
+    assert codes == [0, 0]  # both ran; neither coarsely matched away
+    # And without the opt-in, a pre-done-file log never skips anything.
+    os.remove(_done_file(log))
+    spec_single = dict(base, grid={"K": [2], "tol": [-1.0]})
+    assert run_sweep(spec_single, isolate=False, resume=True) == [0]
+
+
+def test_sweep_resume_migrates_legacy_csv(tmp_path):
+    """A log with CSV rows but no done-file (pre-done-file sweep): the CSV
+    fallback must both skip covered configs AND record them in the done-file,
+    so a later resume (hash branch) doesn't re-run them."""
+    import os
+
+    from tdc_tpu.cli.sweep import run_sweep, _done_file
+
+    log = str(tmp_path / "log.csv")
+    spec = {
+        "data": {"n_obs": [600], "n_dim": [2], "seed": 3},
+        "grid": {"K": [2]},
+        "fixed": {"n_max_iters": 4, "n_devices": 1},
+        "log_file": log,
+    }
+    assert run_sweep(spec, isolate=False) == [0]
+    os.remove(_done_file(log))  # simulate a legacy (pre-done-file) log
+    codes = run_sweep(spec, isolate=False, resume=True, resume_legacy_csv=True)
+    assert codes == []  # CSV fallback covered it
+    # The fallback migrated the completion: the plain hash branch covers it now.
+    assert os.path.exists(_done_file(log))
+    assert run_sweep(spec, isolate=False, resume=True) == []

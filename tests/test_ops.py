@@ -43,6 +43,30 @@ def test_pairwise_sq_dist_nonnegative(rng):
     assert np.diag(got[:5]).max() <= 1e-6 * got.max()
 
 
+def test_pairwise_sq_dist_center_fixes_far_offset(rng):
+    """Data at a large offset with tight clusters: the raw expansion loses
+    ~‖x‖²·eps and can mis-rank near-ties; center=True restores the exact
+    ranking (translation invariance). Round-1 advisor finding."""
+    offset = np.full((1, 4), 1e4, np.float32)
+    c = offset + rng.normal(size=(8, 4)).astype(np.float32) * 0.01
+    x = offset + rng.normal(size=(512, 4)).astype(np.float32) * 0.01
+    want = cdist(x - offset, c - offset, "sqeuclidean")
+    got = np.asarray(pairwise_sq_dist(x, c, center=True))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-8)
+    # Assignments from the centered form match the exact oracle everywhere.
+    np.testing.assert_array_equal(got.argmin(1), want.argmin(1))
+
+
+def test_pairwise_sq_dist_direct_exact(rng):
+    from tdc_tpu.ops.distance import pairwise_sq_dist_direct
+
+    x = (rng.normal(size=(300, 5)) * 3 + 50).astype(np.float32)
+    c = (rng.normal(size=(7, 5)) * 3 + 50).astype(np.float32)
+    want = cdist(x, c, "sqeuclidean")
+    got = np.asarray(pairwise_sq_dist_direct(x, c, block_rows=128))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
 def test_pairwise_dist_sqrt(xc):
     x, c = xc
     np.testing.assert_allclose(
