@@ -1,0 +1,113 @@
+"""K=16,384 d=768 regime benchmark (BASELINE.json config 5 shape).
+
+Measures Lloyd-iteration throughput with the K-sharded machinery
+(parallel/sharded_k): the Pallas blockwise online-argmin kernel inside an
+N-blocked shard tower, one-hot-matmul stats, psum'd over the data axis.
+
+On a TPU chip this runs the real shape (K=16,384, d=768) on a 1x1 mesh —
+the single-chip blockwise configuration; the reference could not run
+anything near this regime (its N x K x d tile OOM'd 271/320 runs at K<=15,
+d=5 — scripts/distribuitedClustering.py:221-230). On CPU it shrinks shapes
+and also validates the 2-D (data x model) layout on the virtual 8-device
+mesh.
+
+Prints one JSON line per configuration:
+  {"metric", "value", "unit", "vs_baseline"}.
+Baseline anchor as in bench.py: 22.2M pt*iter/s/GPU at K=3, d=5 scaled by
+1/(K*d) -> 22.2e6 * 15 / (16384*768) ≈ 26.5 pt*iter/s at this shape.
+
+Run:  python benchmarks/bench_sharded_k.py
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tdc_tpu.parallel.sharded_k import make_mesh_2d, make_sharded_lloyd_step
+
+BASE_RATE = 22.2e6 * (3 * 5)  # reference best per-GPU rate x (K*d) it ran at
+
+
+def measure(step, x, c, iters_short=3, iters_long=13, repeats=3):
+    """Per-iteration seconds from the slope of two chained runs (constant
+    dispatch/fetch overhead cancels; see bench.py timing notes). Median of
+    several slopes with a wide iteration spread — short spreads are swamped
+    by the variance of the tunnel's constant overhead and can report
+    physically impossible rates (> chip peak FLOP/s)."""
+
+    def chain(iters):
+        ci = c
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ci, _, _ = step(x, ci, x.shape[0])
+        np.asarray(ci)  # true sync: D2H fetch
+        return time.perf_counter() - t0
+
+    slopes = sorted(
+        (chain(iters_long) - chain(iters_short)) / (iters_long - iters_short)
+        for _ in range(repeats)
+    )
+    return max(slopes[len(slopes) // 2], 1e-9)
+
+
+def run(tag, mesh, n, k, d, kernel, block_rows):
+    rng = np.random.default_rng(0)
+    dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+    x = jnp.asarray(rng.normal(size=(n, d)), dtype)
+    c = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+    c = jax.device_put(c, NamedSharding(mesh, P("model", None)))
+    step = make_sharded_lloyd_step(mesh, kernel=kernel, block_rows=block_rows)
+    np.asarray(step(x, c, x.shape[0])[0])  # compile + warm
+    per_iter = measure(step, x, c)
+    value = n / per_iter
+    base = BASE_RATE / (k * d)
+    print(
+        json.dumps(
+            {
+                "metric": f"sharded_lloyd_pt_iter_per_s_{tag}_K{k}_d{d}",
+                "value": round(value, 1),
+                "unit": "pt*iter/s",
+                "vs_baseline": round(value / base, 2),
+            }
+        )
+    )
+
+
+def main():
+    # A sitecustomize on some machines pins jax_platforms after env vars are
+    # read; re-assert JAX_PLATFORMS so CPU-mesh validation runs actually land
+    # on CPU (same dance as __graft_entry__.dryrun_multichip).
+    import os
+
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        try:
+            jax.config.update("jax_platforms", env_platforms)
+        except Exception:
+            pass
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        # The real regime, single chip: blockwise Pallas argmin, K fully
+        # resident as one model shard.
+        run("1chip", make_mesh_2d(1, 1), n=1 << 19, k=16384, d=768,
+            kernel="pallas", block_rows=1 << 16)
+    else:
+        # CPU dev/CI: shrunken single-device shape (interpret-mode Pallas is
+        # too slow; use the XLA tower) ...
+        run("1dev_cpu", make_mesh_2d(1, 1), n=1 << 14, k=2048, d=128,
+            kernel="xla", block_rows=1 << 12)
+        # ... and the 2-D (data x model) layout on the virtual mesh.
+        if len(jax.devices()) >= 8:
+            run("2x4_cpu", make_mesh_2d(2, 4), n=1 << 14, k=2048, d=128,
+                kernel="xla", block_rows=1 << 12)
+
+
+if __name__ == "__main__":
+    main()

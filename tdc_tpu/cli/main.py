@@ -79,8 +79,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="force exact streamed Lloyd even if data fits")
     p.add_argument("--class_sep", type=float, default=1.5)
     p.add_argument("--kernel", type=str, default="xla", choices=("xla", "pallas"),
-                   help="sufficient-stats kernel for single-device K-Means: "
-                        "'pallas' = fused single-pass VMEM kernel")
+                   help="sufficient-stats kernel for K-Means: 'pallas' = "
+                        "fused single-pass VMEM kernel (single-device and "
+                        "mesh; with --shard_k, the blockwise online-argmin "
+                        "kernel runs inside each shard)")
+    p.add_argument("--shard_k", type=int, default=1,
+                   help="model-axis size: shard the K centroids this many "
+                        "ways over a 2-D (data x model) mesh (the K=16,384 "
+                        "regime; requires n_devices %% shard_k == 0 and "
+                        "K %% shard_k == 0; kmeans only)")
+    p.add_argument("--block_rows", type=int, default=-1,
+                   help="N-block rows inside each shard for --shard_k "
+                        "(-1 = auto from device memory, 0 = no blocking)")
     p.add_argument("--native_loader", action="store_true",
                    help="stream batches through the C++ prefetch loader "
                         "(requires --data_file pointing at an .npy)")
@@ -94,6 +104,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="checkpoint/resume directory (streamed mode): saves "
                         "centroids+iteration via orbax and resumes if present")
+    p.add_argument("--ckpt_every_batches", type=int, default=None,
+                   help="with --ckpt_dir: also checkpoint mid-pass every N "
+                        "batches (accumulator + batch cursor; resume is "
+                        "bit-identical)")
     # Multi-host (jax.distributed over DCN); on managed TPU pods these
     # autodetect — pass explicitly for manual clusters.
     p.add_argument("--coordinator_address", type=str, default=None)
@@ -117,6 +131,14 @@ def validate_args(parser, args):
             parser.error(f"--{name} must be >= 1")
     if args.n_obs is not None and args.n_obs < args.K:
         parser.error("--n_obs must be >= --K")
+    if args.shard_k > 1:
+        if args.K % args.shard_k != 0:
+            parser.error(f"--K={args.K} not divisible by --shard_k={args.shard_k}")
+        if args.method_name != "distributedKMeans":
+            parser.error("--shard_k supports distributedKMeans only")
+        if args.ckpt_dir:
+            parser.error("--ckpt_dir is not yet supported with --shard_k "
+                         "(the K-sharded driver has no checkpointing)")
 
 
 def run_experiment(args) -> dict:
@@ -167,7 +189,18 @@ def run_experiment(args) -> dict:
             x, _ = make_blobs(args.seed + 1, n_obs, n_dim, max(args.K, 2),
                               class_sep=args.class_sep)
         n_devices = args.n_devices or len(jax.devices())
-        mesh = make_mesh(n_devices) if n_devices > 1 else None
+        mesh2d = None
+        if args.shard_k > 1:
+            if n_devices % args.shard_k != 0:
+                raise ValueError(
+                    f"n_devices={n_devices} not divisible by shard_k={args.shard_k}"
+                )
+            from tdc_tpu.parallel.sharded_k import make_mesh_2d
+
+            mesh2d = make_mesh_2d(n_devices // args.shard_k, args.shard_k)
+            mesh = None
+        else:
+            mesh = make_mesh(n_devices) if n_devices > 1 else None
 
     key = jax.random.PRNGKey(args.seed)
 
@@ -182,6 +215,38 @@ def run_experiment(args) -> dict:
             if (args.dtype == "bfloat16" and not streamed)
             else x
         )
+        def make_stream(rows):
+            """Batch stream honoring --native_loader (C++ prefetch off an
+            .npy) for both the 1-D streamed and the K-sharded paths."""
+            if args.native_loader:
+                if not (args.data_file and args.data_file.endswith(".npy")):
+                    raise ValueError("--native_loader requires an .npy --data_file")
+                from tdc_tpu.data.native_loader import NativePrefetchStream
+
+                return NativePrefetchStream(args.data_file, rows)
+            return NpzStream(np.asarray(x), rows)
+
+        if mesh2d is not None:
+            # K-sharded 2-D layout: always the streamed driver — it subsumes
+            # the in-memory case (one batch) and pads ragged batches exactly.
+            from tdc_tpu.models.kmeans import auto_block_rows
+            from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+            rows = -(-n_obs // num_batches)
+            n_data_ax = n_devices // args.shard_k
+            if args.block_rows < 0:
+                block = auto_block_rows(
+                    -(-rows // n_data_ax), args.K // args.shard_k
+                )
+            else:
+                block = args.block_rows
+            return streamed_kmeans_fit_sharded(
+                make_stream(rows), args.K, n_dim, mesh2d,
+                init=args.init, key=key, max_iters=args.n_max_iters,
+                tol=args.tol, spherical=args.spherical, kernel=args.kernel,
+                block_rows=block,
+                dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+            )
         if args.method_name == "distributedFuzzyCMeans":
             if streamed:
                 rows = -(-n_obs // num_batches)
@@ -189,31 +254,27 @@ def run_experiment(args) -> dict:
                     NpzStream(np.asarray(x), rows), args.K, n_dim,
                     m=args.fuzzifier, init=args.init, key=key,
                     max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
+                    ckpt_dir=args.ckpt_dir,
+                    ckpt_every_batches=args.ckpt_every_batches,
                 )
             return fuzzy_cmeans_fit(
                 xx, args.K, m=args.fuzzifier, init=args.init, key=key,
                 max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
+                kernel=args.kernel,
             )
         if streamed:
             rows = -(-n_obs // num_batches)
-            if args.native_loader:
-                if not (args.data_file and args.data_file.endswith(".npy")):
-                    raise ValueError("--native_loader requires an .npy --data_file")
-                from tdc_tpu.data.native_loader import NativePrefetchStream
-
-                stream = NativePrefetchStream(args.data_file, rows)
-            else:
-                stream = NpzStream(np.asarray(x), rows)
             return streamed_kmeans_fit(
-                stream, args.K, n_dim,
+                make_stream(rows), args.K, n_dim,
                 init=args.init, key=key, max_iters=args.n_max_iters,
                 tol=args.tol, spherical=args.spherical, mesh=mesh,
                 ckpt_dir=args.ckpt_dir,
+                ckpt_every_batches=args.ckpt_every_batches,
             )
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,
             tol=args.tol, spherical=args.spherical, mesh=mesh,
-            kernel=args.kernel if mesh is None else "xla",
+            kernel=args.kernel,
         )
 
     if args.profile_dir:
@@ -236,12 +297,10 @@ def run_experiment(args) -> dict:
         # iteration; a warm re-fit would resume from it and run ~zero
         # iterations, reporting only a final stats pass as the whole
         # computation — so reuse the first fit's timing instead (compile
-        # included; the honest number for a checkpointed run). Non-streamed /
-        # fuzzy fits never receive ckpt_dir, so they keep the warm re-fit.
-        checkpointed = (
-            args.ckpt_dir
-            and (args.streamed or num_batches > 1)
-            and args.method_name == "distributedKMeans"
+        # included; the honest number for a checkpointed run). Non-streamed
+        # fits never receive ckpt_dir, so they keep the warm re-fit.
+        checkpointed = bool(
+            args.ckpt_dir and (args.streamed or num_batches > 1)
         )
         if checkpointed:
             timers.set("computation", timers.get("initialization"))
@@ -256,11 +315,15 @@ def run_experiment(args) -> dict:
     if args.history_file and getattr(result, "history", None) is not None:
         import csv as _csv
 
+        # K-Means history rows hold SSE; fuzzy rows hold the J_m objective.
+        cost_col = (
+            "objective" if args.method_name == "distributedFuzzyCMeans" else "sse"
+        )
         with open(args.history_file, "w", newline="") as f:
             w = _csv.writer(f)
-            w.writerow(["iteration", "sse", "shift"])
-            for i, (sse_i, shift_i) in enumerate(np.asarray(result.history), 1):
-                w.writerow([i, sse_i, shift_i])
+            w.writerow(["iteration", cost_col, "shift"])
+            for i, (cost_i, shift_i) in enumerate(np.asarray(result.history), 1):
+                w.writerow([i, cost_i, shift_i])
 
     n_iter = int(result.n_iter)
     # Throughput from iterations THIS process executed (differs from n_iter

@@ -25,13 +25,40 @@ from tdc_tpu.parallel import mesh as mesh_lib
 
 class FuzzyCMeansResult(NamedTuple):
     centroids: jax.Array  # (K, d) float32
-    n_iter: jax.Array  # () int32
+    n_iter: jax.Array  # () int32 — cumulative iterations (incl. resumed-from)
     objective: jax.Array  # () float32 — J_m = Σ u^m d²
     shift: jax.Array  # () float32
     converged: jax.Array  # () bool
+    # (n_iter, 2) [objective, shift] rows — filled by the streamed fit.
+    history: object = None
+    # Iterations executed by THIS fit call (None = same as n_iter).
+    n_iter_run: object = None
 
 
-@partial(jax.jit, static_argnames=("max_iters", "block_rows"))
+def _fuzzy_stats_fn(kernel: str, m: float, block_rows: int, mesh=None):
+    if kernel == "pallas":
+        if mesh is not None:
+            from tdc_tpu.parallel.collectives import distributed_fuzzy_stats
+
+            return lambda x, c: distributed_fuzzy_stats(
+                x, c, mesh, m=m, kernel="pallas"
+            )
+        from tdc_tpu.ops.pallas_kernels import fuzzy_stats_fused
+
+        return lambda x, c: fuzzy_stats_fused(x, c, m=m)
+    if kernel != "xla":
+        raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
+    if block_rows:
+        from tdc_tpu.ops.assign import fuzzy_stats_padded_blocked
+
+        return lambda x, c: fuzzy_stats_padded_blocked(x, c, m, block_rows)
+    return lambda x, c: fuzzy_stats(x, c, m=m)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_iters", "m", "block_rows", "kernel", "mesh"),
+)
 def _fcm_loop(
     x: jax.Array,
     init_centroids: jax.Array,
@@ -39,13 +66,10 @@ def _fcm_loop(
     tol: float,
     m: float,
     block_rows: int = 0,
+    kernel: str = "xla",
+    mesh: jax.sharding.Mesh | None = None,
 ) -> FuzzyCMeansResult:
-    if block_rows:
-        from tdc_tpu.ops.assign import fuzzy_stats_padded_blocked
-
-        stats_fn = lambda x, c: fuzzy_stats_padded_blocked(x, c, m, block_rows)
-    else:
-        stats_fn = lambda x, c: fuzzy_stats(x, c, m=m)
+    stats_fn = _fuzzy_stats_fn(kernel, m, block_rows, mesh)
 
     def body(carry):
         c, _, i, _ = carry
@@ -85,10 +109,13 @@ def fuzzy_cmeans_fit(
     max_iters: int = 20,
     tol: float = 1e-4,
     mesh: jax.sharding.Mesh | None = None,
+    kernel: str = "xla",
 ) -> FuzzyCMeansResult:
     """Fit Fuzzy C-Means. `tol < 0` forces exactly max_iters iterations
     (reference parity). With `mesh`, points are sharded over the data axis and
-    XLA all-reduces the MU^T X contraction over ICI."""
+    XLA all-reduces the MU^T X contraction over ICI. kernel='pallas' uses the
+    fused single-pass VMEM kernel (no (N, K) membership matrix anywhere;
+    inside a shard_map tower + psum when mesh is given)."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     x = jnp.asarray(x)
@@ -104,15 +131,49 @@ def fuzzy_cmeans_fit(
     else:
         c_init = resolve_init(x, k, init, key)
     block_rows = 0
-    if mesh is None:
+    if mesh is None and kernel == "xla":
         from tdc_tpu.models.kmeans import auto_block_rows
 
         block_rows = auto_block_rows(x.shape[0], k)
-    return _fcm_loop(x, c_init, int(max_iters), float(tol), float(m), block_rows)
+    return _fcm_loop(
+        x, c_init, int(max_iters), float(tol), float(m), block_rows, kernel,
+        mesh if kernel == "pallas" else None,
+    )
 
 
-def fuzzy_predict(x, centroids, *, m: float = 2.0, soft: bool = False):
-    """Memberships (soft=True) or argmax labels (the reference's fuzzy
-    `cluster_idx` via argmax of memberships, Testing Images.ipynb#cell1)."""
-    u = fuzzy_memberships(jnp.asarray(x), jnp.asarray(centroids), m=m)
-    return u if soft else jnp.argmax(u, axis=-1).astype(jnp.int32)
+def fuzzy_predict(x, centroids, *, m: float = 2.0, soft: bool = False,
+                  block_rows: int = 0):
+    """Memberships (soft=True) or hard labels (the reference's fuzzy
+    `cluster_idx` via argmax of memberships, Testing Images.ipynb#cell1).
+
+    Hard labels: membership is monotone-decreasing in squared distance, so
+    argmax(u) == argmin(d²) exactly — routed through kmeans_predict, which
+    picks the blockwise Pallas online-argmin at large N·K. No (N, K) matrix.
+
+    Soft: the (N, K) output is the requested result; with block_rows > 0 (or
+    automatically at >1 GB) it is computed in N-blocks so no intermediate
+    beyond the output itself is materialized.
+    """
+    x = jnp.asarray(x)
+    centroids = jnp.asarray(centroids)
+    if not soft:
+        from tdc_tpu.models.kmeans import kmeans_predict
+
+        return kmeans_predict(x, centroids)
+    if block_rows == 0 and 4 * x.shape[0] * centroids.shape[0] > (1 << 30):
+        block_rows = 1 << 16
+    if block_rows and x.shape[0] > block_rows:
+        n, d = x.shape
+        pad = (-n) % block_rows
+        xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+        xb = xp.reshape(-1, block_rows, d)
+        u = jax.lax.map(
+            lambda blk: fuzzy_memberships(blk, centroids, m=m), xb
+        )
+        return u.reshape(-1, centroids.shape[0])[:n]
+    return fuzzy_memberships(x, centroids, m=m)
+
+
+def predict_proba(x, centroids, *, m: float = 2.0, block_rows: int = 0):
+    """Soft membership matrix (N, K) — sklearn-style alias."""
+    return fuzzy_predict(x, centroids, m=m, soft=True, block_rows=block_rows)

@@ -44,14 +44,25 @@ def _normalize(c: jax.Array) -> jax.Array:
     return c / jnp.maximum(jnp.linalg.norm(c, axis=-1, keepdims=True), 1e-12)
 
 
-def _stats_fn(kernel: str, block_rows: int):
+def _stats_fn(kernel: str, block_rows: int, mesh=None):
     if kernel == "xla":
         if block_rows:
             from tdc_tpu.ops.assign import lloyd_stats_padded_blocked
 
             return lambda x, c: lloyd_stats_padded_blocked(x, c, block_rows)
+        # Mesh path: ops on globally-sharded arrays; XLA inserts the
+        # all-reduce at the stats contraction itself.
         return lloyd_stats
     if kernel == "pallas":
+        if mesh is not None:
+            # Fused VMEM kernel per shard + psum of the (K,d)+(K) stats over
+            # ICI — the per-device compute is identical to the single-chip
+            # fast path; only sufficient statistics cross the interconnect.
+            from tdc_tpu.parallel.collectives import distributed_lloyd_stats
+
+            return lambda x, c: distributed_lloyd_stats(
+                x, c, mesh, kernel="pallas"
+            )
         from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
 
         return lloyd_stats_fused
@@ -76,7 +87,10 @@ def auto_block_rows(n: int, k: int, *, budget_bytes: int | None = None) -> int:
     return max(1 << max(block.bit_length() - 1, 10), 1024)  # pow2, ≥1024
 
 
-@partial(jax.jit, static_argnames=("max_iters", "spherical", "kernel", "block_rows"))
+@partial(
+    jax.jit,
+    static_argnames=("max_iters", "spherical", "kernel", "block_rows", "mesh"),
+)
 def _lloyd_loop(
     x: jax.Array,
     init_centroids: jax.Array,
@@ -85,10 +99,13 @@ def _lloyd_loop(
     spherical: bool,
     kernel: str = "xla",
     block_rows: int = 0,
+    mesh: jax.sharding.Mesh | None = None,
 ) -> KMeansResult:
     """One traced Lloyd loop. tol < 0 disables the convergence test (reference
-    fixed-iteration parity mode)."""
-    stats_fn = _stats_fn(kernel, block_rows)
+    fixed-iteration parity mode). `mesh` is only consulted by the pallas
+    kernel (explicit shard_map body); the xla path distributes via the input
+    sharding."""
+    stats_fn = _stats_fn(kernel, block_rows, mesh)
 
     def body(carry):
         c, _, i, _ = carry
@@ -174,11 +191,11 @@ def kmeans_fit(
         re-normalized after every update (BASELINE.json config 5).
       mesh: optional jax.sharding.Mesh with a 'data' axis.
       kernel: 'xla' (matmul-form, default) or 'pallas' (fused single-pass
-        kernel, single-device only — best at K·d where the (K, d) accumulator
-        fits VMEM; see ops/pallas_kernels.lloyd_stats_fused).
+        VMEM kernel — best at K·d where the (K, d) accumulator fits VMEM; see
+        ops/pallas_kernels.lloyd_stats_fused). With `mesh`, pallas runs
+        inside a shard_map tower per device with a psum of the sufficient
+        stats (parallel/collectives.distributed_lloyd_stats).
     """
-    if kernel != "xla" and mesh is not None:
-        raise ValueError("kernel='pallas' is single-device; drop mesh=")
     block_rows = 0
     if mesh is None and kernel == "xla":
         block_rows = auto_block_rows(int(np.asarray(x.shape[0])), k)
@@ -201,7 +218,7 @@ def kmeans_fit(
         c_init = resolve_init(x, k, init, key)
     return _lloyd_loop(
         x, c_init, int(max_iters), float(tol), bool(spherical), kernel,
-        block_rows,
+        block_rows, mesh if kernel == "pallas" else None,
     )
 
 

@@ -73,6 +73,150 @@ def _prepare_batch(batch, mesh):
     return mesh_lib.shard_points(padded, mesh), n_valid
 
 
+class _ResumeState(NamedTuple):
+    centroids: object  # (K, d) f32 or None if no checkpoint
+    start_iter: int
+    shift: float
+    history: list
+    cursor: int  # batches consumed in the interrupted pass (0 = none)
+    rows_seen: int  # rows covered by `acc` (validates the batch layout)
+    acc: object  # restored accumulator NamedTuple or None
+    key: object
+
+
+class _StreamCheckpointer:
+    """Shared checkpoint/restore machinery for the streamed fits.
+
+    One instance per fit call; parameterized by the accumulator NamedTuple
+    type (SufficientStats / FuzzyStats) via a {meta_key: field_name} map and
+    by hyperparameters (`params`) that are persisted and VALIDATED on restore
+    (k, d, and spherical / fuzzifier m — resuming with different ones would
+    silently mix incompatible state).
+    """
+
+    def __init__(self, ckpt_dir, k, d, params: dict, acc_map: dict, key):
+        self.dir = ckpt_dir
+        self.k, self.d = k, d
+        self.params = params
+        self.acc_map = acc_map
+        self.key = key
+
+    def restore(self, acc_cls, mesh) -> _ResumeState:
+        from tdc_tpu.utils.checkpoint import restore_checkpoint
+
+        none = _ResumeState(None, 0, float("inf"), [], 0, 0, None, self.key)
+        if self.dir is None:
+            return none
+        saved = restore_checkpoint(self.dir)
+        if saved is None:
+            return none
+        if saved.meta.get("k") != self.k or saved.meta.get("d") != self.d:
+            raise ValueError(
+                f"checkpoint in {self.dir} is for K={saved.meta.get('k')}, "
+                f"d={saved.meta.get('d')}, not ({self.k}, {self.d})"
+            )
+        for name, want in self.params.items():
+            got = saved.meta.get(name, want)
+            if isinstance(want, bool):
+                mismatch = bool(got) != want
+            else:
+                mismatch = float(got) != float(want)
+            if mismatch:
+                raise ValueError(
+                    f"checkpoint in {self.dir} was written with {name}={got}; "
+                    f"this run uses {name}={want} — refusing to mix state"
+                )
+        c = jnp.asarray(saved.centroids, jnp.float32)
+        if mesh is not None:
+            c = mesh_lib.replicate(c, mesh)
+        start_iter = saved.n_iter
+        # Restore run state so a resume that has no iterations left still
+        # reports the checkpointed run faithfully (round-1 advisor finding:
+        # shift=inf/converged=False misrepresented a converged run).
+        shift = float(saved.meta.get("shift", float("inf")))
+        hist = np.asarray(saved.meta.get("history", []), np.float32)
+        history = [tuple(r) for r in hist.reshape(-1, 2)]
+        # A checkpoint from a version that didn't persist history (or a
+        # partial one) leaves fewer rows than iterations: pad with NaN so
+        # history row i always corresponds to iteration i+1.
+        if len(history) < start_iter:
+            history = (
+                [(float("nan"), float("nan"))] * (start_iter - len(history))
+                + history
+            )
+        cursor, rows_seen, acc = 0, 0, None
+        first_key = next(iter(self.acc_map))
+        if saved.batch_cursor > 0 and first_key in saved.meta:
+            cursor = int(saved.batch_cursor)
+            rows_seen = int(np.asarray(saved.meta.get("acc_rows", 0)))
+            acc = acc_cls(
+                **{
+                    field: jnp.asarray(saved.meta[name], jnp.float32)
+                    for name, field in self.acc_map.items()
+                }
+            )
+            if mesh is not None:
+                acc = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), acc)
+        key = saved.key if saved.key is not None else self.key
+        return _ResumeState(c, start_iter, shift, history, cursor, rows_seen,
+                            acc, key)
+
+    def validate_cursor(self, batches, state: _ResumeState) -> _ResumeState:
+        """Discard mid-pass state if the stream's batch layout changed since
+        the crash: the cursor is a batch count, so the first `cursor` batches
+        must cover exactly the rows the accumulator already counted —
+        otherwise resume would double-count/drop rows silently."""
+        if state.cursor == 0:
+            return state
+        rows = 0
+        for i, batch in enumerate(batches()):
+            if i >= state.cursor:
+                break
+            rows += np.asarray(batch).shape[0]
+        if rows != state.rows_seen:
+            import sys
+
+            print(
+                f"note: mid-pass checkpoint covers {state.rows_seen} rows but "
+                f"the first {state.cursor} batches now hold {rows}; batch "
+                "layout changed — restarting the interrupted pass from its "
+                "beginning",
+                file=sys.stderr,
+            )
+            return state._replace(cursor=0, rows_seen=0, acc=None)
+        return state
+
+    def save(self, n_iter, c, shift, history, *, batch_cursor=0, acc=None,
+             rows_seen=0):
+        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
+
+        meta = {"k": self.k, "d": self.d, "shift": float(shift)}
+        meta.update(self.params)
+        if history:  # orbax rejects zero-size arrays
+            meta["history"] = np.asarray(history, np.float32).reshape(-1, 2)
+        if acc is not None:
+            meta["acc_rows"] = int(rows_seen)
+            meta.update(
+                {
+                    name: np.asarray(getattr(acc, field))
+                    for name, field in self.acc_map.items()
+                }
+            )
+        save_checkpoint(
+            self.dir,
+            ClusterState(
+                centroids=np.asarray(c), n_iter=n_iter,
+                key=None if self.key is None else np.asarray(self.key),
+                batch_cursor=batch_cursor, meta=meta,
+            ),
+            # Mid-pass saves overwrite the previous completed-iteration step:
+            # the centroids are unchanged during a pass, so this is the same
+            # logical checkpoint enriched with pass progress — step numbering
+            # stays monotone in completed iterations.
+            step=n_iter,
+        )
+
+
 def streamed_kmeans_fit(
     batches: Callable[[], Iterable],
     k: int,
@@ -86,6 +230,7 @@ def streamed_kmeans_fit(
     mesh: jax.sharding.Mesh | None = None,
     ckpt_dir: str | None = None,
     ckpt_every: int = 5,
+    ckpt_every_batches: int | None = None,
 ) -> KMeansResult:
     """Exact Lloyd over a re-iterable stream of (B, d) batches.
 
@@ -101,6 +246,11 @@ def streamed_kmeans_fit(
       ckpt_dir: if set, save a checkpoint every `ckpt_every` iterations and at
         the end, and resume from the latest checkpoint if one exists (the
         checkpoint/resume capability the reference lacked, SURVEY.md §5).
+      ckpt_every_batches: additionally checkpoint mid-pass every this many
+        batches — the in-flight accumulator and batch cursor are persisted,
+        so resume replays only the remaining batches of the interrupted pass
+        (bit-identical to an uninterrupted run: f32 accumulation order is
+        preserved).
     """
     first = None
     if not hasattr(init, "shape"):
@@ -127,69 +277,48 @@ def streamed_kmeans_fit(
             z = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), z)
         return z
 
-    def full_pass(c):
-        acc = zero_stats()
-        for batch in batches():
+    ckpt = _StreamCheckpointer(
+        ckpt_dir, k, d, params={"spherical": bool(spherical)},
+        acc_map={"acc_sums": "sums", "acc_counts": "counts", "acc_sse": "sse"},
+        key=key,
+    )
+    state = ckpt.restore(SufficientStats, mesh)
+    state = ckpt.validate_cursor(batches, state)
+    if state.centroids is not None:
+        c = state.centroids
+    start_iter = state.start_iter
+    shift = state.shift
+    history = state.history
+    resume_cursor, resume_acc = state.cursor, state.acc
+    ckpt.key = state.key
+
+    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
+        """One accumulation pass; resumes from batch `skip` with `acc0`.
+        Mid-pass checkpoints only fire inside a real iteration (n_iter > 0) —
+        never during the final reporting pass."""
+        acc = acc0 if acc0 is not None else zero_stats()
+        rows = rows0
+        for i, batch in enumerate(batches()):
+            if i < skip:
+                continue
             xb, n_valid = _prepare_batch(batch, mesh)
             acc = _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical)
+            rows += int(n_valid)
+            consumed = i + 1
+            if (n_iter > 0 and ckpt_dir is not None and ckpt_every_batches
+                    and consumed % ckpt_every_batches == 0):
+                ckpt.save(n_iter - 1, c, shift, history,
+                          batch_cursor=consumed, acc=acc, rows_seen=rows)
         return acc
-
-    start_iter = 0
-    shift = float("inf")
-    history = []
-    if ckpt_dir is not None:
-        from tdc_tpu.utils.checkpoint import restore_checkpoint
-
-        saved = restore_checkpoint(ckpt_dir)
-        if saved is not None:
-            if saved.meta.get("k") != k or saved.meta.get("d") != d:
-                raise ValueError(
-                    f"checkpoint in {ckpt_dir} is for K={saved.meta.get('k')}, "
-                    f"d={saved.meta.get('d')}, not ({k}, {d})"
-                )
-            c = jnp.asarray(saved.centroids, jnp.float32)
-            if mesh is not None:
-                c = mesh_lib.replicate(c, mesh)
-            start_iter = saved.n_iter
-            # Restore run state so a resume that has no iterations left still
-            # reports the checkpointed run faithfully (round-1 advisor
-            # finding: shift=inf/converged=False misrepresented a converged
-            # run).
-            shift = float(saved.meta.get("shift", float("inf")))
-            hist = np.asarray(saved.meta.get("history", []), np.float32)
-            history = [tuple(r) for r in hist.reshape(-1, 2)]
-            # A checkpoint from a version that didn't persist history (or a
-            # partial one) leaves fewer rows than iterations: pad with NaN so
-            # history row i always corresponds to iteration i+1.
-            if len(history) < start_iter:
-                history = (
-                    [(float("nan"), float("nan"))] * (start_iter - len(history))
-                    + history
-                )
-
-    def _save(n_iter, c, shift, history):
-        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
-
-        save_checkpoint(
-            ckpt_dir,
-            ClusterState(
-                centroids=np.asarray(c), n_iter=n_iter, key=None,
-                batch_cursor=0,
-                meta={
-                    "k": k, "d": d, "spherical": spherical,
-                    "shift": float(shift),
-                    "history": np.asarray(history, np.float32).reshape(-1, 2),
-                },
-            ),
-            step=n_iter,
-        )
 
     n_iter = start_iter
     # A restored checkpoint that had already converged leaves nothing to do —
     # don't run (and checkpoint) extra iterations past convergence.
     resume_converged = tol >= 0 and shift <= tol
     for n_iter in range(start_iter + 1, max_iters + 1) if not resume_converged else ():
-        acc = full_pass(c)
+        acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
+                        rows0=state.rows_seen if resume_cursor else 0)
+        resume_cursor, resume_acc = 0, None
         new_c = apply_centroid_update(acc, c)
         if spherical:
             new_c = _normalize(new_c)
@@ -199,7 +328,7 @@ def streamed_kmeans_fit(
         done = tol >= 0 and shift <= tol
         if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
                                      or n_iter == max_iters):
-            _save(n_iter, c, shift, history)
+            ckpt.save(n_iter, c, shift, history)
         if done:
             break
     # One extra stats pass so the reported SSE matches the *returned* centroids
@@ -246,8 +375,13 @@ def streamed_fuzzy_fit(
     max_iters: int = 20,
     tol: float = 1e-4,
     mesh: jax.sharding.Mesh | None = None,
+    ckpt_dir: str | None = None,
+    ckpt_every: int = 5,
+    ckpt_every_batches: int | None = None,
 ) -> FuzzyCMeansResult:
-    """Exact streamed Fuzzy C-Means (same contract as streamed_kmeans_fit)."""
+    """Exact streamed Fuzzy C-Means — same contract as streamed_kmeans_fit,
+    including checkpoint/resume (per-iteration and mid-pass) and the
+    per-iteration (objective, shift) history the reference never computed."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     if not hasattr(init, "shape"):
@@ -259,7 +393,7 @@ def streamed_fuzzy_fit(
     if mesh is not None:
         c = mesh_lib.replicate(c, mesh)
 
-    def full_pass(c):
+    def zero_stats():
         acc = FuzzyStats(
             weighted_sums=jnp.zeros((k, d), jnp.float32),
             weights=jnp.zeros((k,), jnp.float32),
@@ -267,19 +401,58 @@ def streamed_fuzzy_fit(
         )
         if mesh is not None:
             acc = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), acc)
-        for batch in batches():
-            xb, n_valid = _prepare_batch(batch, mesh)
-            acc = _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m)
         return acc
 
-    shift = jnp.inf
-    n_iter = 0
-    for n_iter in range(1, max_iters + 1):
-        acc = full_pass(c)
+    ckpt = _StreamCheckpointer(
+        ckpt_dir, k, d, params={"m": float(m)},
+        acc_map={
+            "acc_wsums": "weighted_sums",
+            "acc_weights": "weights",
+            "acc_obj": "objective",
+        },
+        key=key,
+    )
+    state = ckpt.restore(FuzzyStats, mesh)
+    state = ckpt.validate_cursor(batches, state)
+    if state.centroids is not None:
+        c = state.centroids
+    start_iter = state.start_iter
+    shift = state.shift
+    history = state.history
+    resume_cursor, resume_acc = state.cursor, state.acc
+    ckpt.key = state.key
+
+    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
+        acc = acc0 if acc0 is not None else zero_stats()
+        rows = rows0
+        for i, batch in enumerate(batches()):
+            if i < skip:
+                continue
+            xb, n_valid = _prepare_batch(batch, mesh)
+            acc = _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m)
+            rows += int(n_valid)
+            consumed = i + 1
+            if (n_iter > 0 and ckpt_dir is not None and ckpt_every_batches
+                    and consumed % ckpt_every_batches == 0):
+                ckpt.save(n_iter - 1, c, shift, history,
+                          batch_cursor=consumed, acc=acc, rows_seen=rows)
+        return acc
+
+    n_iter = start_iter
+    resume_converged = tol >= 0 and shift <= tol
+    for n_iter in range(start_iter + 1, max_iters + 1) if not resume_converged else ():
+        acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
+                        rows0=state.rows_seen if resume_cursor else 0)
+        resume_cursor, resume_acc = 0, None
         new_c = acc.weighted_sums / jnp.maximum(acc.weights[:, None], 1e-12)
         shift = float(jnp.max(jnp.linalg.norm(new_c - c, axis=-1)))
+        history.append((float(acc.objective), shift))
         c = new_c
-        if tol >= 0 and shift <= tol:
+        done = tol >= 0 and shift <= tol
+        if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
+                                     or n_iter == max_iters):
+            ckpt.save(n_iter, c, shift, history)
+        if done:
             break
     objective = full_pass(c).objective
     return FuzzyCMeansResult(
@@ -288,4 +461,6 @@ def streamed_fuzzy_fit(
         objective=jnp.asarray(objective, jnp.float32),
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(tol >= 0 and shift <= tol),
+        history=np.asarray(history, np.float32),
+        n_iter_run=n_iter - start_iter,
     )

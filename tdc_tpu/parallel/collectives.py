@@ -70,8 +70,17 @@ def distributed_fuzzy_stats(
     mesh: Mesh,
     m: float = 2.0,
     axis_name: str = DATA_AXIS,
+    kernel: str = "xla",
 ) -> FuzzyStats:
-    """Globally-reduced fuzzy c-means stats: per-shard tower + psum."""
+    """Globally-reduced fuzzy c-means stats: per-shard tower + psum.
+    kernel='pallas' runs the fused single-pass VMEM fuzzy kernel per shard
+    (no (N, K) membership matrix anywhere)."""
+    if kernel == "pallas":
+        from tdc_tpu.ops.pallas_kernels import fuzzy_stats_fused
+
+        local_fn = lambda x, c: fuzzy_stats_fused(x, c, m=m)
+    else:
+        local_fn = lambda x, c: fuzzy_stats(x, c, m=m)
 
     @partial(
         shard_map,
@@ -81,7 +90,7 @@ def distributed_fuzzy_stats(
         check_vma=False,
     )
     def step(x_shard, c):
-        local = fuzzy_stats(x_shard, c, m=m)
+        local = local_fn(x_shard, c)
         return jax.tree.map(lambda t: jax.lax.psum(t, axis_name), local)
 
     return step(x, centroids)

@@ -9,14 +9,21 @@ small (Pm, n_local) all-gather of per-shard (min, argmin) pairs over ICI, and
 the sufficient statistics stay *sharded over K* — only a psum over the data
 axis touches them, so centroid state never needs to fit on one device.
 
+The per-shard tower is N-blocked (lax.scan) so the (block, K/Pm) distance /
+one-hot intermediates stay bounded at any N, and can run either the XLA
+matmul-form distance or the Pallas blockwise online-argmin kernel
+(ops/pallas_kernels.distance_argmin — no (n, K/Pm) buffer at all).
+
 The reference has no counterpart: its centroid state was a single /cpu:0
-variable broadcast to every tower (scripts/distribuitedClustering.py:199).
+variable broadcast to every tower (scripts/distribuitedClustering.py:199),
+and its N×K work could not exceed one device's memory (the root cause of its
+271/320 InternalError rows).
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import Callable, Iterable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +32,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tdc_tpu.ops.distance import pairwise_sq_dist
-from tdc_tpu.models.kmeans import KMeansResult
+from tdc_tpu.models.kmeans import KMeansResult, _normalize, resolve_init
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -42,73 +49,155 @@ def make_mesh_2d(n_data: int, n_model: int) -> Mesh:
     )
 
 
-class ShardedStats(NamedTuple):
-    sums: jax.Array  # (K, d) — sharded over K (model axis)
-    counts: jax.Array  # (K,) — sharded over K
-    sse: jax.Array  # () — replicated
+def _block_champions(x_blk, c_loc, kernel: str):
+    """Per-block global (min d², argmin) across all K shards.
 
-
-def _local_stats(x_loc, c_loc):
-    """Per-(data, model) shard body; returns K-sharded stats."""
+    Each model shard scores the block against its local centroids, then the
+    per-shard champions — two (Pm, block) arrays, not distances — cross ICI
+    via all_gather for the global argmin.
+    """
     k_per = c_loc.shape[0]
     m_idx = jax.lax.axis_index(MODEL_AXIS)
-    d2 = pairwise_sq_dist(x_loc, c_loc)  # (n_loc, K/Pm)
-    lmin = jnp.min(d2, axis=1)  # (n_loc,)
-    larg = jnp.argmin(d2, axis=1).astype(jnp.int32) + m_idx * k_per
-    # Global argmin across the model axis: all_gather the per-shard champions
-    # (2 small (Pm, n_loc) arrays over ICI — not the distances).
-    mins = jax.lax.all_gather(lmin, MODEL_AXIS)  # (Pm, n_loc)
-    args = jax.lax.all_gather(larg, MODEL_AXIS)  # (Pm, n_loc)
-    w = jnp.argmin(mins, axis=0)  # (n_loc,) winning shard per point
+    if kernel == "pallas":
+        from tdc_tpu.ops.pallas_kernels import distance_argmin
+
+        arg, lmin = distance_argmin(x_blk, c_loc, return_dist=True)
+    else:
+        d2 = pairwise_sq_dist(x_blk, c_loc)  # (block, K/Pm)
+        lmin = jnp.min(d2, axis=1)
+        arg = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    larg = arg + m_idx * k_per
+    mins = jax.lax.all_gather(lmin, MODEL_AXIS)  # (Pm, block)
+    args = jax.lax.all_gather(larg, MODEL_AXIS)  # (Pm, block)
+    w = jnp.argmin(mins, axis=0)  # (block,) winning shard per point
     gmin = jnp.take_along_axis(mins, w[None, :], 0)[0]
     garg = jnp.take_along_axis(args, w[None, :], 0)[0]
+    return gmin, garg
+
+
+def _block_stats(x_blk, c_loc, kernel: str):
+    """(sums (K/Pm, d), counts (K/Pm,), sse ()) for one N-block — local to
+    this (data, model) shard pair; data-psum'd by the caller."""
+    k_per = c_loc.shape[0]
+    m_idx = jax.lax.axis_index(MODEL_AXIS)
+    gmin, garg = _block_champions(x_blk, c_loc, kernel)
     # Stats for MY K-shard only: one_hot maps out-of-shard assignments to 0.
     rel = garg - m_idx * k_per
-    one_hot = jax.nn.one_hot(rel, k_per, dtype=jnp.float32)  # (n_loc, K/Pm)
+    one_hot = jax.nn.one_hot(rel, k_per, dtype=jnp.float32)  # (block, K/Pm)
     sums = jax.lax.dot_general(
         one_hot,
-        x_loc.astype(jnp.float32),
+        x_blk.astype(jnp.float32),
         (((0,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     )
     counts = jnp.sum(one_hot, axis=0)
-    # Reduce over the data axis only; K stays sharded. SSE is identical on
-    # every model shard, so the data-psum leaves it replicated.
-    sums = jax.lax.psum(sums, DATA_AXIS)
-    counts = jax.lax.psum(counts, DATA_AXIS)
-    sse = jax.lax.psum(jnp.sum(gmin), DATA_AXIS)
-    return sums, counts, sse, garg
+    return sums, counts, jnp.sum(gmin)
 
 
-def sharded_lloyd_step(mesh: Mesh):
-    """Returns a jit-able step: (x sharded (data,), c sharded (model,)) →
-    (new_c sharded (model,), shift, sse)."""
+def make_sharded_stats(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
+    """Returns a jit-able fn(x, c) → (sums, counts, sse): x sharded (data,),
+    c sharded (model,); sums/counts stay K-sharded, sse replicated.
+
+    block_rows > 0 scans the local points in (block_rows, d) tiles so the
+    per-shard intermediates never exceed O(block_rows · K/Pm) regardless of N
+    (requires the local shard size to be a block_rows multiple — pad upstream
+    with zero rows and correct via `padding_correction`).
+    """
 
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
-        out_specs=(P(MODEL_AXIS, None), P(), P()),
+        out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P()),
         check_vma=False,
     )
-    def step(x_loc, c_loc):
-        sums, counts, sse, _ = _local_stats(x_loc, c_loc)
+    def stats(x_loc, c_loc):
+        n_loc, d = x_loc.shape
+        k_per = c_loc.shape[0]
+        if block_rows and n_loc > block_rows:
+            if n_loc % block_rows != 0:
+                raise ValueError(
+                    f"local shard rows {n_loc} not divisible by "
+                    f"block_rows={block_rows}"
+                )
+            xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
+
+            def body(acc, blk):
+                s, ct, e = _block_stats(blk, c_loc, kernel)
+                return (acc[0] + s, acc[1] + ct, acc[2] + e), None
+
+            zero = (
+                jnp.zeros((k_per, d), jnp.float32),
+                jnp.zeros((k_per,), jnp.float32),
+                jnp.zeros((), jnp.float32),
+            )
+            (sums, counts, sse), _ = jax.lax.scan(body, zero, xb)
+        else:
+            sums, counts, sse = _block_stats(x_loc, c_loc, kernel)
+        # Reduce over the data axis only; K stays sharded. The champions are
+        # identical on every model shard, so sse comes out replicated.
+        sums = jax.lax.psum(sums, DATA_AXIS)
+        counts = jax.lax.psum(counts, DATA_AXIS)
+        sse = jax.lax.psum(sse, DATA_AXIS)
+        return sums, counts, sse
+
+    return stats
+
+
+def padding_correction(counts, sse, centroids, n_pad):
+    """Remove the exact contribution of `n_pad` zero-padding rows: each lands
+    on the global argmin-‖c‖² cluster with zero Σx, one count, ‖c_j‖² sse
+    (same correction as models/streaming and the fused Pallas kernel)."""
+    c2 = jnp.sum(centroids.astype(jnp.float32) ** 2, axis=-1)
+    j = jnp.argmin(c2)
+    n_pad = jnp.asarray(n_pad, jnp.float32)
+    return counts.at[j].add(-n_pad), sse - n_pad * c2[j]
+
+
+def make_sharded_lloyd_step(
+    mesh: Mesh,
+    kernel: str = "xla",
+    block_rows: int = 0,
+    spherical: bool = False,
+):
+    """Returns a jit'd step: (x (data,)-sharded, c (model,)-sharded, n_valid)
+    → (new_c (model,)-sharded, shift, sse). Zero-padding rows beyond n_valid
+    are corrected exactly."""
+    stats_fn = make_sharded_stats(mesh, kernel, block_rows)
+
+    @jax.jit
+    def step(x, c, n_valid):
+        sums, counts, sse = stats_fn(x, c)
+        n_pad = x.shape[0] - n_valid
+        counts, sse = padding_correction(counts, sse, c, n_pad)
+        cf = c.astype(jnp.float32)
         new_c = jnp.where(
             counts[:, None] > 0,
             sums / jnp.maximum(counts[:, None], 1.0),
-            c_loc.astype(jnp.float32),
+            cf,
         )
-        # Shift must be the global max over all K shards.
-        shift_local = jnp.max(jnp.linalg.norm(new_c - c_loc, axis=-1))
-        shift = jax.lax.pmax(shift_local, MODEL_AXIS)
+        if spherical:
+            new_c = _normalize(new_c)
+        shift = jnp.max(jnp.linalg.norm(new_c - cf, axis=-1))
         return new_c, shift, sse
 
     return step
 
 
-def sharded_assign(mesh: Mesh):
-    """Jit-able global assignment under the 2-D layout: labels sharded (data,)."""
+def sharded_lloyd_step(mesh: Mesh):
+    """Back-compat wrapper: (x, c) → (new_c, shift, sse), no padding."""
+    step = make_sharded_lloyd_step(mesh)
+
+    def run(x, c):
+        return step(x, c, x.shape[0])
+
+    return run
+
+
+def sharded_assign(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
+    """Jit-able global assignment under the 2-D layout: labels sharded
+    (data,). Blocked the same way as the stats tower."""
 
     @partial(
         shard_map,
@@ -118,10 +207,37 @@ def sharded_assign(mesh: Mesh):
         check_vma=False,
     )
     def assign(x_loc, c_loc):
-        _, _, _, garg = _local_stats(x_loc, c_loc)
-        return garg
+        n_loc, d = x_loc.shape
+        if block_rows and n_loc > block_rows:
+            if n_loc % block_rows != 0:
+                raise ValueError(
+                    f"local shard rows {n_loc} not divisible by "
+                    f"block_rows={block_rows}"
+                )
+            xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
+            _, garg = jax.lax.scan(
+                lambda _, blk: (None, _block_champions(blk, c_loc, kernel)[1]),
+                None,
+                xb,
+            )
+            return garg.reshape(-1)
+        return _block_champions(x_loc, c_loc, kernel)[1]
 
     return assign
+
+
+def _resolve_init_sharded(x, k: int, init, key, *, sample_rows: int = 65536):
+    """Init for the K-sharded fit. Arrays pass through; names resolve on a
+    deterministic host-side subsample (the seeding problem is tiny next to
+    the fit — k-means++ on ≤64k rows — and must not require the full dataset
+    on one device)."""
+    if hasattr(init, "shape"):
+        c = jnp.asarray(init, jnp.float32)
+        if c.shape[0] != k:
+            raise ValueError(f"init has {c.shape[0]} rows, expected {k}")
+        return c
+    sample = jnp.asarray(np.asarray(x[: min(x.shape[0], sample_rows)]))
+    return resolve_init(sample, k, init, key)
 
 
 def kmeans_fit_sharded(
@@ -130,12 +246,17 @@ def kmeans_fit_sharded(
     mesh: Mesh,
     *,
     init,
+    key=None,
     max_iters: int = 20,
     tol: float = 1e-4,
+    spherical: bool = False,
+    kernel: str = "xla",
+    block_rows: int = 0,
 ) -> KMeansResult:
     """Lloyd K-Means with points sharded over 'data' and centroids over
-    'model'. init must be an explicit (K, d) array (seed at smaller scale or
-    with ops.init / ops.kmeans_parallel first)."""
+    'model' (the K=16,384 regime). init may be a (K, d) array or an init name
+    ('kmeans++'/'random'/'first_k'/'kmeans||'), resolved on a host subsample.
+    """
     n_data = mesh.devices.shape[0]
     n_model = mesh.devices.shape[1]
     x = jnp.asarray(x)
@@ -143,24 +264,164 @@ def kmeans_fit_sharded(
         raise ValueError(f"N={x.shape[0]} not divisible by data axis {n_data}")
     if k % n_model != 0:
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
-    c = jnp.asarray(init, jnp.float32)
-    if c.shape[0] != k:
-        raise ValueError(f"init has {c.shape[0]} rows, expected {k}")
+    if spherical:
+        x = _normalize(x.astype(jnp.float32))
+    c = _resolve_init_sharded(x, k, init, key)
+    if spherical:
+        c = _normalize(c)
     x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
     c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
-    step = jax.jit(sharded_lloyd_step(mesh))
+    step = make_sharded_lloyd_step(mesh, kernel, block_rows, spherical)
 
     shift = float("inf")
-    sse = float("inf")
     n_iter = 0
     converged = False
     for n_iter in range(1, max_iters + 1):
-        c, shift_dev, sse_dev = step(x, c)
+        c, shift_dev, _ = step(x, c, x.shape[0])
         shift = float(shift_dev)
-        sse = float(sse_dev)
         if tol >= 0 and shift <= tol:
             converged = True
             break
+    # One extra step so the reported SSE matches the *returned* centroids
+    # (every other fit path does the same; the in-loop SSE is measured
+    # against the pre-update centroids). step's SSE is computed against its
+    # INPUT centroids, so re-invoking the already-compiled step and
+    # discarding its update gives exactly that with no extra compile.
+    _, _, sse = step(x, c, x.shape[0])
+    return KMeansResult(
+        centroids=c,
+        n_iter=jnp.asarray(n_iter, jnp.int32),
+        sse=jnp.asarray(float(sse), jnp.float32),
+        shift=jnp.asarray(shift, jnp.float32),
+        converged=jnp.asarray(converged),
+    )
+
+
+class _ShardedAcc(NamedTuple):
+    sums: jax.Array  # (K, d) — K-sharded
+    counts: jax.Array  # (K,) — K-sharded
+    sse: jax.Array  # () — replicated
+
+
+def streamed_kmeans_fit_sharded(
+    batches: Callable[[], Iterable],
+    k: int,
+    d: int,
+    mesh: Mesh,
+    *,
+    init,
+    key=None,
+    max_iters: int = 20,
+    tol: float = 1e-4,
+    spherical: bool = False,
+    kernel: str = "xla",
+    block_rows: int = 0,
+    dtype=None,
+) -> KMeansResult:
+    """Exact out-of-core Lloyd under the 2-D (data × model) layout — the
+    1B×768, K=16,384 configuration: batches stream host→device, each batch's
+    K-sharded sufficient stats accumulate on-device across the pass, and the
+    centroid state never exists unsharded.
+
+    `batches` follows the models/streaming contract: a zero-arg callable
+    returning a fresh iterator of (rows, d) arrays per Lloyd iteration.
+    `dtype` (e.g. jnp.bfloat16) converts batches host-side before transfer —
+    the MXU fast path for the bf16 K=16,384 regime; stats stay f32.
+    """
+    n_data = int(mesh.devices.shape[0])
+    n_model = int(mesh.devices.shape[1])
+    if k % n_model != 0:
+        raise ValueError(f"K={k} not divisible by model axis {n_model}")
+    pad_multiple = n_data * max(block_rows, 1)
+
+    first = None
+    if not hasattr(init, "shape"):
+        first = np.asarray(next(iter(batches())))
+        if spherical:
+            first = np.asarray(_normalize(jnp.asarray(first, jnp.float32)))
+        init = _resolve_init_sharded(first, k, init, key)
+    c = jnp.asarray(init, jnp.float32)
+    if c.shape != (k, d):
+        raise ValueError(f"init shape {c.shape} != {(k, d)}")
+    if spherical:
+        c = _normalize(c)
+    c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
+
+    stats_fn = make_sharded_stats(mesh, kernel, block_rows)
+
+    @jax.jit
+    def accumulate(acc: _ShardedAcc, x, c, n_valid) -> _ShardedAcc:
+        sums, counts, sse = stats_fn(x, c)
+        n_pad = x.shape[0] - n_valid
+        counts, sse = padding_correction(counts, sse, c, n_pad)
+        return _ShardedAcc(acc.sums + sums, acc.counts + counts, acc.sse + sse)
+
+    @jax.jit
+    def update(acc: _ShardedAcc, c):
+        cf = c.astype(jnp.float32)
+        new_c = jnp.where(
+            acc.counts[:, None] > 0,
+            acc.sums / jnp.maximum(acc.counts[:, None], 1.0),
+            cf,
+        )
+        if spherical:
+            new_c = _normalize(new_c)
+        shift = jnp.max(jnp.linalg.norm(new_c - cf, axis=-1))
+        return new_c, shift
+
+    def zero_acc() -> _ShardedAcc:
+        return _ShardedAcc(
+            sums=jax.device_put(
+                jnp.zeros((k, d), jnp.float32),
+                NamedSharding(mesh, P(MODEL_AXIS, None)),
+            ),
+            counts=jax.device_put(
+                jnp.zeros((k,), jnp.float32), NamedSharding(mesh, P(MODEL_AXIS))
+            ),
+            sse=jnp.zeros((), jnp.float32),
+        )
+
+    def put_batch(batch):
+        batch = np.asarray(batch)
+        n_valid = batch.shape[0]
+        rem = (-n_valid) % pad_multiple
+        if rem:
+            batch = np.pad(batch, ((0, rem), (0, 0)))
+        if dtype is not None:
+            import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
+
+            batch = batch.astype(np.dtype(dtype))  # host-side: halves transfer
+        xb = jax.device_put(batch, NamedSharding(mesh, P(DATA_AXIS, None)))
+        if spherical:
+            xb = _spherical_rows(xb)
+        return xb, n_valid
+
+    @jax.jit
+    def _spherical_rows(xb):
+        # Normalize real rows; zero padding rows stay zero (norm 0 guard).
+        norms = jnp.linalg.norm(xb, axis=-1, keepdims=True)
+        return jnp.where(norms > 0, xb / jnp.maximum(norms, 1e-12), xb)
+
+    def full_pass(c):
+        acc = zero_acc()
+        for batch in batches():
+            xb, n_valid = put_batch(batch)
+            acc = accumulate(acc, xb, c, n_valid)
+        return acc
+
+    shift = float("inf")
+    n_iter = 0
+    converged = False
+    for n_iter in range(1, max_iters + 1):
+        acc = full_pass(c)
+        c, shift_dev = update(acc, c)
+        shift = float(shift_dev)
+        if tol >= 0 and shift <= tol:
+            converged = True
+            break
+    # Extra stats pass: report the SSE of the returned centroids, not the
+    # pre-update ones (parity with streamed_kmeans_fit).
+    sse = float(full_pass(c).sse)
     return KMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),

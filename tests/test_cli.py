@@ -78,6 +78,52 @@ def test_cli_multidevice(tmp_path):
     assert row["num_GPUs"] == "8"
 
 
+def test_cli_shard_k(tmp_path):
+    """--shard_k: K-sharded 2-D (data x model) mesh end-to-end through the
+    CLI (round-1 VERDICT item 1 — this regime was library-only)."""
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=4000 --n_dim=4 --K=8 --n_max_iters=30 --seed=1 "
+        f"--log_file={log} --n_GPUs=8 --shard_k=4 --tol=1e-6".split()
+    )
+    assert rc == 0
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["status"] == "ok"
+    assert row["converged"] == "True"
+
+
+def test_cli_shard_k_streamed_pallas_spherical(tmp_path):
+    """--shard_k composes with batching, the pallas shard kernel, spherical
+    mode, and explicit block_rows (the BASELINE config-5 shape in miniature)."""
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=4000 --n_dim=4 --K=8 --n_max_iters=10 --seed=1 "
+        f"--log_file={log} --n_GPUs=8 --shard_k=2 --num_batches=3 "
+        f"--kernel=pallas --spherical --block_rows=64 --tol=-1.0".split()
+    )
+    assert rc == 0
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["status"] == "ok"
+    assert int(row["n_iter"]) == 10
+
+
+def test_cli_shard_k_validation():
+    parser = build_parser()
+    import pytest
+
+    with pytest.raises(SystemExit):
+        args = parser.parse_args(
+            "--n_obs=100 --n_dim=2 --K=7 --shard_k=2".split()
+        )
+        validate_args(parser, args)
+    with pytest.raises(SystemExit):
+        args = parser.parse_args(
+            "--n_obs=100 --n_dim=2 --K=8 --shard_k=2 "
+            "--method_name=distributedFuzzyCMeans".split()
+        )
+        validate_args(parser, args)
+
+
 def test_cli_streamed(tmp_path):
     log = str(tmp_path / "log.csv")
     rc = cli_main(

@@ -75,6 +75,68 @@ def test_fused_lloyd_pad_correction_empty_near_origin(rng):
     np.testing.assert_allclose(float(got.sse), float(want.sse), rtol=1e-5)
 
 
+def test_fused_fuzzy_stats_matches_xla(rng):
+    from tdc_tpu.ops.assign import fuzzy_stats
+    from tdc_tpu.ops.pallas_kernels import fuzzy_stats_fused
+
+    x = rng.normal(size=(1003, 7)).astype(np.float32)  # uneven N, odd d
+    c = rng.normal(size=(37, 7)).astype(np.float32)
+    for m in (1.5, 2.0, 3.0):
+        got = fuzzy_stats_fused(jnp.asarray(x), jnp.asarray(c), m=m, block_n=256)
+        want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=m)
+        np.testing.assert_allclose(
+            np.asarray(got.weighted_sums), np.asarray(want.weighted_sums),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got.weights), np.asarray(want.weights), rtol=1e-4, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            float(got.objective), float(want.objective), rtol=1e-4
+        )
+
+
+def test_fuzzy_fit_pallas_kernel_matches(blobs_small):
+    from tdc_tpu.models import fuzzy_cmeans_fit
+
+    x, _, _ = blobs_small
+    r_pallas = fuzzy_cmeans_fit(x, 3, init=x[:3], max_iters=20, tol=-1.0,
+                                kernel="pallas")
+    r_xla = fuzzy_cmeans_fit(x, 3, init=x[:3], max_iters=20, tol=-1.0)
+    np.testing.assert_allclose(
+        np.asarray(r_pallas.centroids), np.asarray(r_xla.centroids),
+        rtol=1e-4, atol=1e-3,
+    )
+
+
+def test_fuzzy_fit_mesh_pallas_matches(blobs_small):
+    from tdc_tpu.models import fuzzy_cmeans_fit
+    from tdc_tpu.parallel import make_mesh
+
+    x, _, _ = blobs_small
+    mesh = make_mesh(8)
+    r_mesh = fuzzy_cmeans_fit(x, 3, init=x[:3], max_iters=15, tol=-1.0,
+                              mesh=mesh, kernel="pallas")
+    r_single = fuzzy_cmeans_fit(x, 3, init=x[:3], max_iters=15, tol=-1.0)
+    np.testing.assert_allclose(
+        np.asarray(r_mesh.centroids), np.asarray(r_single.centroids),
+        rtol=1e-4, atol=1e-3,
+    )
+
+
+def test_fuzzy_predict_blocked_matches(rng):
+    from tdc_tpu.models.fuzzy import fuzzy_predict
+
+    x = rng.normal(size=(530, 5)).astype(np.float32)
+    c = rng.normal(size=(9, 5)).astype(np.float32)
+    full = np.asarray(fuzzy_predict(x, c, soft=True))
+    blocked = np.asarray(fuzzy_predict(x, c, soft=True, block_rows=128))
+    np.testing.assert_allclose(blocked, full, rtol=1e-5, atol=1e-6)
+    # Hard labels route through argmin-distance (== argmax membership).
+    hard = np.asarray(fuzzy_predict(x, c))
+    np.testing.assert_array_equal(hard, full.argmax(1))
+
+
 def test_kmeans_fit_pallas_kernel_matches(blobs_small):
     from tdc_tpu.models import kmeans_fit
 
@@ -88,7 +150,10 @@ def test_kmeans_fit_pallas_kernel_matches(blobs_small):
     assert int(r_pallas.n_iter) == int(r_xla.n_iter)
 
 
-def test_bf16_inputs(rng):
+def test_bf16_inputs():
+    # Local rng: the near-tie agreement rate is data-dependent, so this test
+    # must not float with the shared session rng's draw order.
+    rng = np.random.default_rng(42)
     x = rng.normal(size=(256, 16)).astype(np.float32)
     c = rng.normal(size=(32, 16)).astype(np.float32)
     arg, _ = distance_argmin(
