@@ -24,25 +24,12 @@ import os
 import pandas as pd
 
 
-def parse_trace_file(path: str) -> pd.DataFrame:
-    """Aggregate a perfetto trace into per-op stats.
+_COLUMNS = ["time_pct", "total_s", "calls", "avg_s", "min_s", "max_s", "name"]
 
-    Columns mirror the reference parser's output
-    (scripts/compileResults.py:86-105): time %, total seconds, calls,
-    avg/min/max seconds, name. Durations in the trace are microseconds.
-    """
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rt") as f:
-        trace = json.load(f)
-    events = [
-        e
-        for e in trace.get("traceEvents", [])
-        if e.get("ph") == "X" and "dur" in e and e.get("name")
-    ]
+
+def _aggregate(events) -> pd.DataFrame:
     if not events:
-        return pd.DataFrame(
-            columns=["time_pct", "total_s", "calls", "avg_s", "min_s", "max_s", "name"]
-        )
+        return pd.DataFrame(columns=_COLUMNS)
     df = pd.DataFrame(
         {"name": [e["name"] for e in events], "dur_s": [e["dur"] / 1e6 for e in events]}
     )
@@ -57,8 +44,46 @@ def parse_trace_file(path: str) -> pd.DataFrame:
         }
     )
     out["time_pct"] = 100.0 * out["total_s"] / out["total_s"].sum()
-    out = out.sort_values("total_s", ascending=False).reset_index()
-    return out[["time_pct", "total_s", "calls", "avg_s", "min_s", "max_s", "name"]]
+    return out.sort_values("total_s", ascending=False).reset_index()[_COLUMNS]
+
+
+def parse_trace_file(path: str) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """Aggregate a perfetto trace into (device-op stats, host/runtime stats).
+
+    The reference's nvprof parser emits two tables per log — per-kernel
+    ('Profiling result:') and per-API-call — scripts/compileResults.py:103-105
+    and :133-136. The TPU analog splits trace events by their process-name
+    metadata: processes named for an accelerator ('/device:TPU:...', 'TPU
+    core', 'GPU') hold device ops; everything else (Python host threads, the
+    PJRT runtime) is the API-call analog. Columns in both mirror the
+    reference parser: time %, total seconds, calls, avg/min/max, name.
+    Durations in the trace are microseconds.
+    """
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        trace = json.load(f)
+    all_events = trace.get("traceEvents", [])
+    pid_names = {
+        e.get("pid"): str(e.get("args", {}).get("name", ""))
+        for e in all_events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+
+    def is_device(pid) -> bool:
+        name = pid_names.get(pid, "").lower()
+        return any(t in name for t in ("tpu", "gpu", "/device:", "xla"))
+
+    events = [
+        e for e in all_events
+        if e.get("ph") == "X" and "dur" in e and e.get("name")
+    ]
+    if not pid_names:
+        # No process metadata (older traces): everything in the device table,
+        # matching the round-1 single-table behavior.
+        return _aggregate(events), pd.DataFrame(columns=_COLUMNS)
+    device = [e for e in events if is_device(e.get("pid"))]
+    host = [e for e in events if not is_device(e.get("pid"))]
+    return _aggregate(device), _aggregate(host)
 
 
 def compile_traces(input_dir: str, output_dir: str) -> list[str]:
@@ -68,14 +93,20 @@ def compile_traces(input_dir: str, output_dir: str) -> list[str]:
     written = []
     pattern = os.path.join(input_dir, "**", "*.trace.json*")
     for path in sorted(glob.glob(pattern, recursive=True)):
-        df = parse_trace_file(path)
+        device_df, host_df = parse_trace_file(path)
         # Tag from the input-relative path, not the basename: jax.profiler
         # emits identically-named traces in per-run subdirectories.
         rel = os.path.relpath(path, input_dir)
         tag = rel.split(".")[0].replace(os.sep, "_") or "trace"
         out_path = os.path.join(output_dir, f"profiling_result_{tag}.csv")
-        df.to_csv(out_path, index=False)
+        device_df.to_csv(out_path, index=False)
         written.append(out_path)
+        if len(host_df):
+            # The reference's second table (API_calls_*.csv,
+            # scripts/compileResults.py:133-136): host/runtime-side calls.
+            api_path = os.path.join(output_dir, f"API_calls_{tag}.csv")
+            host_df.to_csv(api_path, index=False)
+            written.append(api_path)
     return written
 
 

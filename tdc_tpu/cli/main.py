@@ -77,6 +77,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference :357-360 semantics)")
     p.add_argument("--streamed", action="store_true",
                    help="force exact streamed Lloyd even if data fits")
+    p.add_argument("--minibatch", action="store_true",
+                   help="Sculley-style mini-batch K-Means (BASELINE config 3): "
+                        "one update per batch, n_max_iters epochs; batch size "
+                        "from device memory unless --num_batches is given")
+    p.add_argument("--mean_combine", action="store_true",
+                   help="reference-parity batch mode: independent Lloyd per "
+                        "batch, unweighted mean of per-batch centers "
+                        "(reference :310 approximation, for apples-to-apples "
+                        "iters-to-converge comparisons; kmeans only)")
     p.add_argument("--class_sep", type=float, default=1.5)
     p.add_argument("--kernel", type=str, default="xla", choices=("xla", "pallas"),
                    help="sufficient-stats kernel for K-Means: 'pallas' = "
@@ -104,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="checkpoint/resume directory (streamed mode): saves "
                         "centroids+iteration via orbax and resumes if present")
+    p.add_argument("--prefetch", type=int, default=0,
+                   help="streamed modes: background-thread batch prefetch "
+                        "depth (0 = off, the measured-fastest default on "
+                        "warm caches; enable for IO-bound cold streams, or "
+                        "use --native_loader for GIL-free C++ prefetch)")
     p.add_argument("--ckpt_every_batches", type=int, default=None,
                    help="with --ckpt_dir: also checkpoint mid-pass every N "
                         "batches (accumulator + batch cursor; resume is "
@@ -139,6 +153,19 @@ def validate_args(parser, args):
         if args.ckpt_dir:
             parser.error("--ckpt_dir is not yet supported with --shard_k "
                          "(the K-sharded driver has no checkpointing)")
+        if args.minibatch:
+            parser.error("--minibatch and --shard_k are mutually exclusive")
+    if args.minibatch and args.method_name != "distributedKMeans":
+        parser.error("--minibatch supports distributedKMeans only")
+    if args.mean_combine:
+        if args.method_name != "distributedKMeans":
+            parser.error("--mean_combine supports distributedKMeans only")
+        if args.minibatch or args.shard_k > 1:
+            parser.error("--mean_combine excludes --minibatch/--shard_k")
+    if args.ckpt_dir and (args.minibatch or args.mean_combine):
+        # These drivers have no checkpoint support; accepting the flag would
+        # silently skip checkpointing AND corrupt the computation timing.
+        parser.error("--ckpt_dir is not supported with --minibatch/--mean_combine")
 
 
 def run_experiment(args) -> dict:
@@ -226,6 +253,20 @@ def run_experiment(args) -> dict:
                 return NativePrefetchStream(args.data_file, rows)
             return NpzStream(np.asarray(x), rows)
 
+        if args.minibatch:
+            from tdc_tpu.data.batching import auto_batch_size
+            from tdc_tpu.models.minibatch import minibatch_kmeans_fit
+
+            if num_batches > 1:
+                rows = -(-n_obs // num_batches)
+            else:
+                rows = min(auto_batch_size(n_dim, args.K,
+                                           n_devices=n_devices), n_obs)
+            return minibatch_kmeans_fit(
+                make_stream(rows), args.K, n_dim, init=args.init, key=key,
+                epochs=args.n_max_iters, tol=args.tol, mesh=mesh,
+                prefetch=args.prefetch,
+            )
         if mesh2d is not None:
             # K-sharded 2-D layout: always the streamed driver — it subsumes
             # the in-memory case (one batch) and pads ragged batches exactly.
@@ -246,6 +287,7 @@ def run_experiment(args) -> dict:
                 tol=args.tol, spherical=args.spherical, kernel=args.kernel,
                 block_rows=block,
                 dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+                prefetch=args.prefetch,
             )
         if args.method_name == "distributedFuzzyCMeans":
             if streamed:
@@ -256,6 +298,7 @@ def run_experiment(args) -> dict:
                     max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
                     ckpt_dir=args.ckpt_dir,
                     ckpt_every_batches=args.ckpt_every_batches,
+                    prefetch=args.prefetch,
                 )
             return fuzzy_cmeans_fit(
                 xx, args.K, m=args.fuzzifier, init=args.init, key=key,
@@ -264,12 +307,22 @@ def run_experiment(args) -> dict:
             )
         if streamed:
             rows = -(-n_obs // num_batches)
+            if args.mean_combine:
+                from tdc_tpu.models import mean_combine_fit
+
+                return mean_combine_fit(
+                    make_stream(rows), args.K, n_dim, init=args.init,
+                    key=key, max_iters=args.n_max_iters, tol=args.tol,
+                    spherical=args.spherical, mesh=mesh,
+                    prefetch=args.prefetch,
+                )
             return streamed_kmeans_fit(
                 make_stream(rows), args.K, n_dim,
                 init=args.init, key=key, max_iters=args.n_max_iters,
                 tol=args.tol, spherical=args.spherical, mesh=mesh,
                 ckpt_dir=args.ckpt_dir,
                 ckpt_every_batches=args.ckpt_every_batches,
+                prefetch=args.prefetch,
             )
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,

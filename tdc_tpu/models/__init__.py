@@ -2,8 +2,12 @@
 
 from tdc_tpu.models.kmeans import KMeansResult, kmeans_fit, kmeans_predict
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult, fuzzy_cmeans_fit, fuzzy_predict
-from tdc_tpu.models.minibatch import MiniBatchKMeans
-from tdc_tpu.models.streaming import streamed_kmeans_fit, streamed_fuzzy_fit
+from tdc_tpu.models.minibatch import MiniBatchKMeans, minibatch_kmeans_fit
+from tdc_tpu.models.streaming import (
+    mean_combine_fit,
+    streamed_fuzzy_fit,
+    streamed_kmeans_fit,
+)
 from tdc_tpu.models.estimators import KMeans, FuzzyCMeans
 
 __all__ = [
@@ -14,6 +18,8 @@ __all__ = [
     "fuzzy_cmeans_fit",
     "fuzzy_predict",
     "MiniBatchKMeans",
+    "minibatch_kmeans_fit",
+    "mean_combine_fit",
     "streamed_kmeans_fit",
     "streamed_fuzzy_fit",
     "KMeans",

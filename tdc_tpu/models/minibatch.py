@@ -28,10 +28,27 @@ class MiniBatchState(NamedTuple):
 
 
 @partial(jax.jit, donate_argnames=("state",))
-def minibatch_step(state: MiniBatchState, batch: jax.Array) -> MiniBatchState:
+def minibatch_step(
+    state: MiniBatchState, batch: jax.Array, n_valid: jax.Array | None = None
+) -> MiniBatchState:
     """One mini-batch update: assign batch, move each centroid toward its batch
-    mean with per-center rate 1/lifetime_count."""
+    mean with per-center rate 1/lifetime_count.
+
+    n_valid (when given) marks rows beyond it as zero padding (mesh-sharded
+    batches are padded to the device multiple); the padding's exact
+    contribution — argmin-‖c‖² cluster count and sse, zero Σx — is removed,
+    the same correction as models/streaming."""
     stats = lloyd_stats(batch, state.centroids)
+    if n_valid is not None:
+        n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(
+            jnp.float32
+        )
+        c2 = jnp.sum(state.centroids.astype(jnp.float32) ** 2, axis=-1)
+        j = jnp.argmin(c2)
+        stats = stats._replace(
+            counts=stats.counts.at[j].add(-n_pad),
+            sse=stats.sse - n_pad * c2[j],
+        )
     new_counts = state.counts + stats.counts
     # c <- c + (sum_b - n_b * c) / max(total_count, 1): equivalently a running
     # average over every point the center has ever absorbed.
@@ -55,17 +72,22 @@ class MiniBatchKMeans:
         labels = kmeans_predict(x, mbk.centroids)
     """
 
-    def __init__(self, k: int, d: int, *, init=None, key=None):
+    def __init__(self, k: int, d: int, *, init=None, key=None, mesh=None):
         self.k, self.d = k, d
         self._state: MiniBatchState | None = None
         self._init_spec = init
         self._key = key
+        self.mesh = mesh
 
     def _ensure_init(self, batch: jax.Array):
         if self._state is not None:
             return
         init = "kmeans++" if self._init_spec is None else self._init_spec
         c0 = resolve_init(jnp.asarray(batch), self.k, init, self._key)
+        if self.mesh is not None:
+            from tdc_tpu.parallel import mesh as mesh_lib
+
+            c0 = mesh_lib.replicate(c0, self.mesh)
         self._state = MiniBatchState(
             centroids=c0,
             counts=jnp.zeros((self.k,), jnp.float32),
@@ -74,9 +96,18 @@ class MiniBatchKMeans:
         )
 
     def partial_fit(self, batch) -> "MiniBatchKMeans":
-        batch = jnp.asarray(batch)
-        self._ensure_init(batch)
-        self._state = minibatch_step(self._state, batch)
+        self._ensure_init(jnp.asarray(batch) if self.mesh is None else batch)
+        if self.mesh is not None:
+            # Pad to the mesh multiple and shard; the step removes the
+            # padding's exact contribution (zero rows -> argmin-‖c‖² cluster).
+            from tdc_tpu.models.streaming import _prepare_batch
+
+            xb, n_valid = _prepare_batch(batch, self.mesh)
+            self._state = minibatch_step(
+                self._state, xb, jnp.asarray(n_valid)
+            )
+        else:
+            self._state = minibatch_step(self._state, jnp.asarray(batch))
         return self
 
     @property
@@ -90,3 +121,58 @@ class MiniBatchKMeans:
         if self._state is None:
             raise ValueError("partial_fit was never called")
         return self._state
+
+
+def minibatch_kmeans_fit(
+    batches,
+    k: int,
+    d: int,
+    *,
+    init="kmeans++",
+    key=None,
+    epochs: int = 1,
+    tol: float = 1e-4,
+    mesh=None,
+    prefetch: int = 0,
+):
+    """Mini-batch K-Means over a re-iterable batch stream (BASELINE config 3
+    through the same streaming contract as streamed_kmeans_fit).
+
+    Each epoch is one pass; each batch is one Sculley-style step. Convergence
+    is the max centroid shift per epoch vs `tol` (negative tol = fixed
+    epochs). Returns a KMeansResult: n_iter counts epochs, sse is the last
+    batch's SSE (mini-batch never scores the full dataset — by design).
+    """
+    import numpy as np
+
+    from tdc_tpu.models.kmeans import KMeansResult
+    from tdc_tpu.models.streaming import _prefetched
+
+    mbk = MiniBatchKMeans(k, d, init=init, key=key, mesh=mesh)
+    shift = float("inf")
+    n_epoch = 0
+    history = []
+    for n_epoch in range(1, epochs + 1):
+        c_start = None
+        for batch in _prefetched(batches(), prefetch):
+            if c_start is None and mbk._state is None:
+                mbk._ensure_init(jnp.asarray(np.asarray(batch)))
+            if c_start is None:
+                # minibatch_step donates the state, so snapshot a copy — the
+                # live buffer is invalidated by the first step.
+                c_start = jnp.array(mbk.centroids, copy=True)
+            mbk.partial_fit(batch)
+        shift = float(
+            jnp.max(jnp.linalg.norm(mbk.centroids - c_start, axis=-1))
+        )
+        history.append((float(mbk.state.last_sse), shift))
+        if tol >= 0 and shift <= tol:
+            break
+    return KMeansResult(
+        centroids=mbk.centroids,
+        n_iter=jnp.asarray(n_epoch, jnp.int32),
+        sse=mbk.state.last_sse,
+        shift=jnp.asarray(shift, jnp.float32),
+        converged=jnp.asarray(tol >= 0 and shift <= tol),
+        history=np.asarray(history, np.float32),
+    )

@@ -62,6 +62,120 @@ def _accumulate(
     )
 
 
+def _prefetched(it, depth: int):
+    """Pull `it` on a background thread through a bounded queue so host-side
+    batch staging (disk reads, memmap page faults, np copies) overlaps device
+    compute — double-buffering for the numpy path (the C++ native_loader
+    already prefetches internally, GIL-free).
+
+    Default OFF (depth 0): measured on the benchmark chip (RESULTS.md,
+    round 2), the Python producer thread contends on the GIL with the
+    device_put transfer loop and *costs* ~15% when batches come from the
+    warm page cache. Enable (depth>=1) only for genuinely IO-bound streams
+    (cold spinning-disk/network reads), or use the C++ loader.
+
+    depth <= 0 yields `it` unchanged. Producer exceptions re-raise in the
+    consumer; the producer dies with the queue on early exit (daemon)."""
+    if depth <= 0:
+        yield from it
+        return
+    import queue as _queue
+    import threading
+
+    q = _queue.Queue(maxsize=depth)
+    _END = object()
+
+    def produce():
+        try:
+            for item in it:
+                q.put(item)
+            q.put(_END)
+        except BaseException as e:  # propagate (incl. injected test crashes)
+            q.put(e)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
+
+
+def _run_pass(
+    batches,
+    prefetch: int,
+    zero_acc,
+    step_fn,
+    *,
+    ckpt=None,
+    ckpt_every_batches=None,
+    n_iter: int = 0,
+    skip: int = 0,
+    acc0=None,
+    rows0: int = 0,
+    save_args=None,
+):
+    """One accumulation pass over the stream — the loop shared by the
+    streamed kmeans and fuzzy fits.
+
+    step_fn(acc, batch) -> (acc, n_rows). On a mid-pass resume (skip > 0) the
+    skipped prefix is read once, its row count validated against `rows0` (the
+    rows the restored accumulator covers) IN the same loop — a mismatch means
+    the batch layout changed since the crash, and the pass restarts from its
+    beginning with a fresh accumulator rather than silently double-counting
+    or dropping rows. Row-count equality is the exact criterion: the
+    accumulator covers rows [0, rows0) in stream order regardless of where
+    batch boundaries fall.
+
+    Mid-pass checkpoints (ckpt + ckpt_every_batches, n_iter > 0 only — never
+    during a final reporting pass) persist the accumulator + batch cursor +
+    rows via ckpt.save; save_args = (centroids, shift, history), constant
+    during a pass.
+    """
+    while True:
+        acc = acc0 if acc0 is not None else zero_acc()
+        rows = rows0
+        skipped_rows = 0
+        prefix_ok = skip == 0
+        mismatch = False
+        for i, batch in enumerate(_prefetched(batches(), prefetch)):
+            if i < skip:
+                skipped_rows += np.asarray(batch).shape[0]
+                if i == skip - 1:
+                    if skipped_rows != rows0:
+                        mismatch = True
+                        break
+                    prefix_ok = True
+                continue
+            acc, n_rows = step_fn(acc, batch)
+            rows += int(n_rows)
+            consumed = i + 1
+            if (n_iter > 0 and ckpt is not None and ckpt.dir is not None
+                    and ckpt_every_batches
+                    and consumed % ckpt_every_batches == 0):
+                c, shift, history = save_args
+                ckpt.save(n_iter - 1, c, shift, history,
+                          batch_cursor=consumed, acc=acc, rows_seen=rows)
+        if not mismatch and not prefix_ok:
+            # Stream ended inside the skip prefix: fewer batches than the
+            # cursor — layout definitely changed.
+            mismatch = True
+        if not mismatch:
+            return acc
+        import sys
+
+        print(
+            f"note: mid-pass checkpoint covers {rows0} rows but the first "
+            f"{skip} batches now hold {skipped_rows}; batch layout changed — "
+            "restarting the interrupted pass from its beginning",
+            file=sys.stderr,
+        )
+        skip, acc0, rows0 = 0, None, 0
+
+
 def _prepare_batch(batch, mesh):
     """(device_array, n_valid): pad to mesh multiple and shard, or pass through."""
     batch = np.asarray(batch)
@@ -161,31 +275,6 @@ class _StreamCheckpointer:
         return _ResumeState(c, start_iter, shift, history, cursor, rows_seen,
                             acc, key)
 
-    def validate_cursor(self, batches, state: _ResumeState) -> _ResumeState:
-        """Discard mid-pass state if the stream's batch layout changed since
-        the crash: the cursor is a batch count, so the first `cursor` batches
-        must cover exactly the rows the accumulator already counted —
-        otherwise resume would double-count/drop rows silently."""
-        if state.cursor == 0:
-            return state
-        rows = 0
-        for i, batch in enumerate(batches()):
-            if i >= state.cursor:
-                break
-            rows += np.asarray(batch).shape[0]
-        if rows != state.rows_seen:
-            import sys
-
-            print(
-                f"note: mid-pass checkpoint covers {state.rows_seen} rows but "
-                f"the first {state.cursor} batches now hold {rows}; batch "
-                "layout changed — restarting the interrupted pass from its "
-                "beginning",
-                file=sys.stderr,
-            )
-            return state._replace(cursor=0, rows_seen=0, acc=None)
-        return state
-
     def save(self, n_iter, c, shift, history, *, batch_cursor=0, acc=None,
              rows_seen=0):
         from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
@@ -231,6 +320,7 @@ def streamed_kmeans_fit(
     ckpt_dir: str | None = None,
     ckpt_every: int = 5,
     ckpt_every_batches: int | None = None,
+    prefetch: int = 0,
 ) -> KMeansResult:
     """Exact Lloyd over a re-iterable stream of (B, d) batches.
 
@@ -251,6 +341,8 @@ def streamed_kmeans_fit(
         so resume replays only the remaining batches of the interrupted pass
         (bit-identical to an uninterrupted run: f32 accumulation order is
         preserved).
+      prefetch: background-thread batch prefetch depth (0 disables) —
+        overlaps host staging with device compute.
     """
     first = None
     if not hasattr(init, "shape"):
@@ -283,7 +375,6 @@ def streamed_kmeans_fit(
         key=key,
     )
     state = ckpt.restore(SufficientStats, mesh)
-    state = ckpt.validate_cursor(batches, state)
     if state.centroids is not None:
         c = state.centroids
     start_iter = state.start_iter
@@ -293,23 +384,15 @@ def streamed_kmeans_fit(
     ckpt.key = state.key
 
     def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
-        """One accumulation pass; resumes from batch `skip` with `acc0`.
-        Mid-pass checkpoints only fire inside a real iteration (n_iter > 0) —
-        never during the final reporting pass."""
-        acc = acc0 if acc0 is not None else zero_stats()
-        rows = rows0
-        for i, batch in enumerate(batches()):
-            if i < skip:
-                continue
+        def step(acc, batch):
             xb, n_valid = _prepare_batch(batch, mesh)
-            acc = _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical)
-            rows += int(n_valid)
-            consumed = i + 1
-            if (n_iter > 0 and ckpt_dir is not None and ckpt_every_batches
-                    and consumed % ckpt_every_batches == 0):
-                ckpt.save(n_iter - 1, c, shift, history,
-                          batch_cursor=consumed, acc=acc, rows_seen=rows)
-        return acc
+            return _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical), n_valid
+
+        return _run_pass(
+            batches, prefetch, zero_stats, step,
+            ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
+            skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
+        )
 
     n_iter = start_iter
     # A restored checkpoint that had already converged leaves nothing to do —
@@ -342,6 +425,94 @@ def streamed_kmeans_fit(
         converged=jnp.asarray(tol >= 0 and shift <= tol),
         history=np.asarray(history, np.float32),
         n_iter_run=n_iter - start_iter,
+    )
+
+
+def mean_combine_fit(
+    batches: Callable[[], Iterable],
+    k: int,
+    d: int,
+    *,
+    init,
+    key=None,
+    max_iters: int = 20,
+    tol: float = -1.0,
+    spherical: bool = False,
+    mesh: jax.sharding.Mesh | None = None,
+    prefetch: int = 0,
+) -> KMeansResult:
+    """Reference-parity batch mode: run INDEPENDENT full Lloyd per batch from
+    the same init, then average the per-batch centroids unweighted.
+
+    This reproduces `run_experiments`'s mean-combine
+    (scripts/distribuitedClustering.py:310 / New-Distributed-KMeans.ipynb
+    #cell18-19, defect 8) so iters-to-converge and quality can be compared
+    apples-to-apples against the reference's approximation. It is NOT exact
+    Lloyd — use streamed_kmeans_fit for that. One deliberate difference:
+    empty clusters keep their previous centroid instead of going NaN
+    (reference defect 6), so the mean never poisons whole columns.
+
+    Returns a KMeansResult: n_iter = max per-batch iterations, sse = the
+    combined centers' SSE over the full stream (one extra exact pass; the
+    reference never scored its combined centers), shift/converged = the
+    worst per-batch values.
+    """
+    from tdc_tpu.models.kmeans import kmeans_fit
+
+    first = None
+    if not hasattr(init, "shape"):
+        first = jnp.asarray(next(iter(batches())))
+        if spherical:
+            first = _normalize(first.astype(jnp.float32))
+        init = resolve_init(first, k, init, key)
+    c0 = jnp.asarray(init, jnp.float32)
+    if c0.shape != (k, d):
+        raise ValueError(f"init shape {c0.shape} != {(k, d)}")
+
+    total = jnp.zeros((k, d), jnp.float32)
+    n_batches = 0
+    n_iter = 0
+    shift = 0.0
+    converged = True
+    for batch in _prefetched(batches(), prefetch):
+        batch = np.asarray(batch)
+        bmesh = mesh
+        if mesh is not None:
+            n_dev = int(np.prod(mesh.devices.shape))
+            if batch.shape[0] % n_dev != 0:
+                # Padding would bias this batch's independent fit; the
+                # reference's equal-size split made batches divide evenly.
+                bmesh = None
+        res = kmeans_fit(
+            batch, k, init=c0, max_iters=max_iters, tol=tol,
+            spherical=spherical, mesh=bmesh,
+        )
+        total = total + res.centroids
+        n_batches += 1
+        n_iter = max(n_iter, int(res.n_iter))
+        shift = max(shift, float(res.shift))
+        converged = converged and bool(res.converged)
+    if n_batches == 0:
+        raise ValueError("empty batch stream")
+    c = total / n_batches  # the reference's unweighted np.mean (:310)
+    if spherical:
+        c = _normalize(c)
+
+    # Score the combined centers exactly (one stats pass over the stream).
+    acc = SufficientStats(
+        sums=jnp.zeros((k, d), jnp.float32),
+        counts=jnp.zeros((k,), jnp.float32),
+        sse=jnp.zeros((), jnp.float32),
+    )
+    for batch in _prefetched(batches(), prefetch):
+        xb, n_valid = _prepare_batch(batch, None)
+        acc = _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical)
+    return KMeansResult(
+        centroids=c,
+        n_iter=jnp.asarray(n_iter, jnp.int32),
+        sse=acc.sse,
+        shift=jnp.asarray(shift, jnp.float32),
+        converged=jnp.asarray(converged),
     )
 
 
@@ -378,6 +549,7 @@ def streamed_fuzzy_fit(
     ckpt_dir: str | None = None,
     ckpt_every: int = 5,
     ckpt_every_batches: int | None = None,
+    prefetch: int = 0,
 ) -> FuzzyCMeansResult:
     """Exact streamed Fuzzy C-Means — same contract as streamed_kmeans_fit,
     including checkpoint/resume (per-iteration and mid-pass) and the
@@ -413,7 +585,6 @@ def streamed_fuzzy_fit(
         key=key,
     )
     state = ckpt.restore(FuzzyStats, mesh)
-    state = ckpt.validate_cursor(batches, state)
     if state.centroids is not None:
         c = state.centroids
     start_iter = state.start_iter
@@ -423,20 +594,15 @@ def streamed_fuzzy_fit(
     ckpt.key = state.key
 
     def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
-        acc = acc0 if acc0 is not None else zero_stats()
-        rows = rows0
-        for i, batch in enumerate(batches()):
-            if i < skip:
-                continue
+        def step(acc, batch):
             xb, n_valid = _prepare_batch(batch, mesh)
-            acc = _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m)
-            rows += int(n_valid)
-            consumed = i + 1
-            if (n_iter > 0 and ckpt_dir is not None and ckpt_every_batches
-                    and consumed % ckpt_every_batches == 0):
-                ckpt.save(n_iter - 1, c, shift, history,
-                          batch_cursor=consumed, acc=acc, rows_seen=rows)
-        return acc
+            return _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m), n_valid
+
+        return _run_pass(
+            batches, prefetch, zero_stats, step,
+            ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
+            skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
+        )
 
     n_iter = start_iter
     resume_converged = tol >= 0 and shift <= tol

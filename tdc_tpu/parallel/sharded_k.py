@@ -317,6 +317,7 @@ def streamed_kmeans_fit_sharded(
     kernel: str = "xla",
     block_rows: int = 0,
     dtype=None,
+    prefetch: int = 0,
 ) -> KMeansResult:
     """Exact out-of-core Lloyd under the 2-D (data × model) layout — the
     1B×768, K=16,384 configuration: batches stream host→device, each batch's
@@ -403,8 +404,10 @@ def streamed_kmeans_fit_sharded(
         return jnp.where(norms > 0, xb / jnp.maximum(norms, 1e-12), xb)
 
     def full_pass(c):
+        from tdc_tpu.models.streaming import _prefetched
+
         acc = zero_acc()
-        for batch in batches():
+        for batch in _prefetched(batches(), prefetch):
             xb, n_valid = put_batch(batch)
             acc = accumulate(acc, xb, c, n_valid)
         return acc

@@ -42,6 +42,54 @@ def test_segment_pixels_fuzzy(toy_image):
     assert not np.isnan(centers).any()
 
 
+def test_segment_frames_video_loop(toy_image):
+    """Multi-frame driver (reference Testing Images.ipynb#cell12-13): every
+    frame segmented + NaN-checked, periodic oracle check, per-frame rows."""
+    from tdc_tpu.apps.segmentation import segment_frames
+
+    rng = np.random.default_rng(1)
+    frames = [
+        np.clip(toy_image + rng.normal(0, 4, toy_image.shape), 0, 255)
+        for _ in range(4)
+    ]
+    rows = []
+    for recolored, labels, centers, row in segment_frames(
+        frames, 3, seed=0, crosscheck_every=3
+    ):
+        assert recolored.shape == toy_image.shape
+        assert labels.shape == toy_image.shape[:2]
+        assert not np.isnan(centers).any()
+        rows.append(row)
+    assert [r["frame"] for r in rows] == [0, 1, 2, 3]
+    assert all(r["seconds"] > 0 for r in rows)
+    # Oracle columns on frames 0 and 3 only (crosscheck_every=3).
+    assert "max_center_dist" in rows[0] and "max_center_dist" in rows[3]
+    assert "max_center_dist" not in rows[1]
+    assert rows[0]["max_center_dist"] < 10.0
+
+
+def test_segment_frames_cli(tmp_path, toy_image):
+    from PIL import Image
+
+    from tdc_tpu.apps.segmentation import main as seg_main
+
+    for i in range(3):
+        Image.fromarray(toy_image.astype(np.uint8)).save(
+            tmp_path / f"vid01_{i:02d}.png"
+        )
+    out_dir = tmp_path / "out"
+    rc = seg_main([
+        f"--frames={tmp_path}/vid01_*.png", "--K=3",
+        f"--out_dir={out_dir}",
+    ])
+    assert rc == 0
+    import os
+
+    assert sorted(os.listdir(out_dir)) == [
+        "vid01_00_seg.png", "vid01_01_seg.png", "vid01_02_seg.png"
+    ]
+
+
 def test_crosscheck_sklearn_centers_close(toy_image):
     pixels = toy_image.reshape(-1, 3)
     ours, theirs, t_ours, t_sk, worst = crosscheck_sklearn(pixels, 3)

@@ -124,6 +124,31 @@ def test_cli_shard_k_validation():
         validate_args(parser, args)
 
 
+def test_cli_minibatch(tmp_path):
+    """--minibatch routes to the Sculley driver (BASELINE config 3 through
+    the CLI — round-1 VERDICT item 9: it was CLI-orphaned)."""
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=4000 --n_dim=4 --K=3 --n_max_iters=8 --seed=1 "
+        f"--log_file={log} --n_GPUs=1 --minibatch --num_batches=4 "
+        f"--tol=-1.0".split()
+    )
+    assert rc == 0
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["status"] == "ok"
+    assert int(row["n_iter"]) == 8  # epochs
+
+
+def test_cli_minibatch_rejects_fuzzy():
+    parser = build_parser()
+    with pytest.raises(SystemExit):
+        args = parser.parse_args(
+            "--n_obs=100 --n_dim=2 --K=3 --minibatch "
+            "--method_name=distributedFuzzyCMeans".split()
+        )
+        validate_args(parser, args)
+
+
 def test_cli_streamed(tmp_path):
     log = str(tmp_path / "log.csv")
     rc = cli_main(
@@ -262,8 +287,38 @@ def test_parse_trace_file(tmp_path):
     }
     p = str(tmp_path / "t.trace.json")
     json.dump(trace, open(p, "w"))
-    df = parse_trace_file(p)
+    df, api = parse_trace_file(p)
     assert list(df["name"]) == ["fusion.1", "copy.2"]
     row = df.iloc[0]
     assert row["calls"] == 2 and abs(row["time_pct"] - 80.0) < 1e-6
     assert abs(row["avg_s"] - 2e-4) < 1e-9
+    assert len(api) == 0  # no process metadata -> single-table behavior
+
+
+def test_parse_trace_file_splits_device_and_host(tmp_path):
+    """Process-name metadata splits device ops from host/runtime rows — the
+    reference's two tables (profling_result_* and API_calls_*,
+    scripts/compileResults.py:103-136)."""
+    from tdc_tpu.analysis.compile_results import compile_traces, parse_trace_file
+
+    trace = {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "name": "process_name", "pid": 2,
+             "args": {"name": "python3"}},
+            {"ph": "X", "name": "fusion.1", "dur": 100, "ts": 0, "pid": 1},
+            {"ph": "X", "name": "fusion.1", "dur": 300, "ts": 200, "pid": 1},
+            {"ph": "X", "name": "ExecuteSharded", "dur": 500, "ts": 0, "pid": 2},
+        ]
+    }
+    p = str(tmp_path / "t.trace.json")
+    json.dump(trace, open(p, "w"))
+    device, host = parse_trace_file(p)
+    assert list(device["name"]) == ["fusion.1"]
+    assert list(host["name"]) == ["ExecuteSharded"]
+    assert device.iloc[0]["calls"] == 2
+    out = str(tmp_path / "out")
+    written = compile_traces(str(tmp_path), out)
+    names = sorted(f.split("/")[-1] for f in written)
+    assert names == ["API_calls_t.csv", "profiling_result_t.csv"]

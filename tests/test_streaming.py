@@ -44,6 +44,104 @@ def test_minibatch_converges_near_fullbatch(blobs_small):
     assert (d.min(axis=0) < 0.5).all()
 
 
+def test_minibatch_mesh_matches_single_device(blobs_small):
+    """Mesh-sharded mini-batch steps (padded + corrected) must match the
+    single-device steps on the same batch sequence (round-1 VERDICT item 9:
+    MiniBatchKMeans was mesh-unaware)."""
+    from tdc_tpu.parallel import make_mesh
+
+    x, _, _ = blobs_small
+    init = x[:3]
+    mesh = make_mesh(8)
+    single = MiniBatchKMeans(k=3, d=2, init=init)
+    meshed = MiniBatchKMeans(k=3, d=2, init=init, mesh=mesh)
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        idx = rng.choice(len(x), size=130, replace=False)  # 130 % 8 != 0: pads
+        single.partial_fit(x[idx])
+        meshed.partial_fit(x[idx])
+    np.testing.assert_allclose(
+        np.asarray(meshed.centroids), np.asarray(single.centroids),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_minibatch_fit_stream(blobs_small):
+    """minibatch_kmeans_fit: epochs over a stream, KMeansResult contract."""
+    from tdc_tpu.data.loader import NpzStream
+    from tdc_tpu.models.minibatch import minibatch_kmeans_fit
+
+    x, _, centers = blobs_small
+    res = minibatch_kmeans_fit(
+        NpzStream(x, 256), 3, 2, init="kmeans++", key=jax.random.PRNGKey(0),
+        epochs=10, tol=1e-3,
+    )
+    got = np.asarray(res.centroids)
+    d = np.linalg.norm(got[:, None, :] - centers[None], axis=-1)
+    assert (d.min(axis=0) < 0.5).all()
+    assert int(res.n_iter) >= 1 and len(res.history) == int(res.n_iter)
+
+
+def test_prefetched_preserves_order_and_propagates_errors():
+    from tdc_tpu.models.streaming import _prefetched
+
+    items = [np.full((2, 2), i) for i in range(7)]
+    got = list(_prefetched(iter(items), depth=3))
+    assert len(got) == 7
+    for a, b in zip(got, items):
+        np.testing.assert_array_equal(a, b)
+
+    def boom():
+        yield items[0]
+        raise RuntimeError("io died")
+
+    import pytest as _pytest
+
+    it = _prefetched(boom(), depth=2)
+    next(it)
+    with _pytest.raises(RuntimeError, match="io died"):
+        next(it)
+
+
+def test_streamed_prefetch_matches_no_prefetch(blobs_small):
+    x, _, _ = blobs_small
+    a = streamed_kmeans_fit(NpzStream(x, 200), 3, 2, init=x[:3], max_iters=6,
+                            tol=-1.0, prefetch=0)
+    b = streamed_kmeans_fit(NpzStream(x, 200), 3, 2, init=x[:3], max_iters=6,
+                            tol=-1.0, prefetch=2)
+    np.testing.assert_array_equal(np.asarray(a.centroids), np.asarray(b.centroids))
+
+
+def test_mean_combine_matches_manual_reference_semantics(blobs_small):
+    """mean_combine_fit must equal the reference's procedure computed by
+    hand: independent full Lloyd per batch from the SAME init, unweighted
+    mean of per-batch centers (scripts/distribuitedClustering.py:310)."""
+    from tdc_tpu.models import kmeans_fit, mean_combine_fit
+
+    x, _, _ = blobs_small
+    init = x[:3]
+    res = mean_combine_fit(
+        NpzStream(x, 400), 3, 2, init=init, max_iters=10, tol=-1.0
+    )
+    manual = np.mean(
+        [
+            np.asarray(
+                kmeans_fit(x[s:s + 400], 3, init=init, max_iters=10,
+                           tol=-1.0).centroids
+            )
+            for s in range(0, len(x), 400)
+        ],
+        axis=0,
+    )
+    np.testing.assert_allclose(np.asarray(res.centroids), manual,
+                               rtol=1e-5, atol=1e-5)
+    assert int(res.n_iter) == 10
+    # The approximation differs from exact streamed Lloyd (that's the point).
+    exact = streamed_kmeans_fit(NpzStream(x, 400), 3, 2, init=init,
+                                max_iters=10, tol=-1.0)
+    assert float(res.sse) >= float(exact.sse) - 1e-3
+
+
 def test_streamed_mesh_equals_single_device(blobs_small):
     # Batches of 130 don't divide the 8-way mesh: exercises the zero-pad +
     # exact correction path.
