@@ -81,6 +81,8 @@ def test_two_process_distributed_fit_matches_single(tmp_path):
     worker.write_text(_WORKER)
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), str(port), str(i), "2", str(tmp_path)],
