@@ -1,0 +1,87 @@
+"""Elastic gang launcher CLI — supervised multi-process runs with
+restart-from-checkpoint.
+
+The reference launches each experiment as one unsupervised subprocess
+(scripts/new_experiment.py:59); a crash loses the run. This launcher runs a
+worker command as a gang of N `jax.distributed` processes, detects worker
+loss (nonzero exit or heartbeat silence), and restarts the whole gang from
+the latest checkpoint step common to all workers (see
+parallel/supervisor.py for why the gang, not the worker, is the recovery
+unit).
+
+Usage:
+    python -m tdc_tpu.cli.supervise --num_processes=2 --max_restarts=2 \\
+        --ckpt_root=/tmp/ckpts --log_dir=/tmp/gang_logs \\
+        -- python my_worker.py --flags...
+
+The worker should call `tdc_tpu.parallel.multihost.initialize_from_env()`
+first, read its checkpoint directory from $TDC_CKPT_DIR, and pass it as
+`ckpt_dir=` to a streamed fit (models/streaming.py) so resume works.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tdc_tpu.parallel.supervisor import GangFailed, run_gang
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tdc_tpu.cli.supervise",
+        description="Run a worker command as a supervised jax.distributed "
+                    "gang with restart-from-checkpoint.",
+    )
+    p.add_argument("--num_processes", type=int, required=True)
+    p.add_argument("--max_restarts", type=int, default=2,
+                   help="gang restarts after the first launch (default 2)")
+    p.add_argument("--heartbeat_timeout", type=float, default=None,
+                   help="seconds of worker heartbeat silence treated as a "
+                        "hang (off by default; the clock starts at spawn, so "
+                        "allow for compile time)")
+    p.add_argument("--ckpt_root", type=str, default=None,
+                   help="shared checkpoint dir exported to every worker as "
+                        "$TDC_CKPT_DIR (orbax writes on the gang's primary "
+                        "host only, so the dir must be shared); trimmed to "
+                        "the latest complete step before every restart")
+    p.add_argument("--log_dir", type=str, required=True,
+                   help="per-attempt per-worker stdout+stderr capture")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="worker command (prefix with --)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        build_parser().error("no worker command given (append: -- cmd ...)")
+    if args.num_processes < 1:
+        build_parser().error("--num_processes must be >= 1")
+    ckpt_dirs = None
+    if args.ckpt_root is not None:
+        os.makedirs(args.ckpt_root, exist_ok=True)
+        ckpt_dirs = [args.ckpt_root]  # shared by the whole gang
+    try:
+        result = run_gang(
+            cmd,
+            args.num_processes,
+            max_restarts=args.max_restarts,
+            heartbeat_timeout=args.heartbeat_timeout,
+            ckpt_dirs=ckpt_dirs,
+            log_dir=args.log_dir,
+        )
+    except GangFailed as e:
+        print(f"supervise: {e}", file=sys.stderr)
+        return 1
+    print(f"supervise: gang completed in {result.attempts} attempt(s); "
+          f"logs: {args.log_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,7 +102,7 @@ class MiniBatchKMeans:
             # padding's exact contribution (zero rows -> argmin-‖c‖² cluster).
             from tdc_tpu.models.streaming import _prepare_batch
 
-            xb, n_valid = _prepare_batch(batch, self.mesh)
+            xb, n_valid, _ = _prepare_batch(batch, self.mesh)
             self._state = minibatch_step(
                 self._state, xb, jnp.asarray(n_valid)
             )

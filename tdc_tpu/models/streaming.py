@@ -18,7 +18,7 @@ add_n-on-CPU, :257-258, device-resident).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable, Iterable, NamedTuple
 
 import jax
@@ -35,6 +35,7 @@ from tdc_tpu.ops.assign import (
 from tdc_tpu.models.kmeans import KMeansResult, resolve_init, _normalize
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult
 from tdc_tpu.parallel import mesh as mesh_lib
+from tdc_tpu.utils.heartbeat import maybe_beat
 
 
 @partial(jax.jit, static_argnames=("spherical",))
@@ -142,6 +143,9 @@ def _run_pass(
         prefix_ok = skip == 0
         mismatch = False
         for i, batch in enumerate(_prefetched(batches(), prefetch)):
+            maybe_beat()  # also while replaying a resume prefix: reading the
+            # skipped batches is real progress, and a silent replay would trip
+            # the supervisor's hang detector and loop the gang restart
             if i < skip:
                 skipped_rows += np.asarray(batch).shape[0]
                 if i == skip - 1:
@@ -176,15 +180,85 @@ def _run_pass(
         skip, acc0, rows0 = 0, None, 0
 
 
+@lru_cache(maxsize=64)
+def _mesh_layout(mesh) -> tuple[int, int]:
+    """(n_processes, n_local_devices) of `mesh`, cached per mesh — a mesh can
+    be host-local even inside a jax.distributed run, so the mesh (not
+    jax.process_count()) decides whether batches are per-host slices; cached
+    because _prepare_batch sits in the streaming hot loop and scanning
+    thousands of pod devices per batch would be real host-side overhead."""
+    devs = mesh.devices.ravel()
+    procs = {d.process_index for d in devs}
+    local = sum(d.process_index == jax.process_index() for d in devs)
+    return len(procs), local
+
+
 def _prepare_batch(batch, mesh):
-    """(device_array, n_valid): pad to mesh multiple and shard, or pass through."""
+    """(device_array, n_valid_global, n_local): pad to the mesh multiple and
+    shard, or pass through.
+
+    When the mesh spans several processes, `batch` is THIS HOST'S slice of
+    the global batch — rows never leave their host, vs the reference staging
+    the whole dataset through one feed_dict (:273). Contract: every
+    participating host yields the SAME local row count for each batch
+    (host_shard_bounds with totals divisible by the process count, or pad
+    upstream); n_valid_global = local × n_processes is then identical on all
+    hosts, which SPMD scalar args require. Validated on the first batch via
+    _check_equal_local_rows. n_local feeds the mid-pass resume accounting,
+    which counts rows in this host's stream order.
+    """
     batch = np.asarray(batch)
-    n_valid = batch.shape[0]
+    n_local = batch.shape[0]
     if mesh is None:
-        return jnp.asarray(batch), n_valid
+        return jnp.asarray(batch), n_local, n_local
+    nproc, local_dev = _mesh_layout(mesh)
+    if nproc > 1:
+        padded, _ = mesh_lib.pad_to_multiple(
+            batch, max(local_dev, 1), fill_value=0.0
+        )
+        global_shape = (padded.shape[0] * nproc,) + padded.shape[1:]
+        arr = jax.make_array_from_process_local_data(
+            mesh_lib.data_sharding(mesh), padded, global_shape
+        )
+        return arr, n_local * nproc, n_local
     n_dev = int(np.prod(mesh.devices.shape))
     padded, _ = mesh_lib.pad_to_multiple(batch, n_dev, fill_value=0.0)
-    return mesh_lib.shard_points(padded, mesh), n_valid
+    return mesh_lib.shard_points(padded, mesh), n_local, n_local
+
+
+def _check_equal_local_rows(batches, first, mesh):
+    """One-time validation of the equal-local-rows contract (first batch
+    only): unequal per-host counts would otherwise surface as a cross-host
+    shape mismatch or a silently hung collective with nothing pointing at
+    batch sizing. Reuses `first` when the init path already read it."""
+    if mesh is None or _mesh_layout(mesh)[0] <= 1:
+        return
+    if first is None:
+        first = next(iter(batches()))
+    from jax.experimental import multihost_utils
+
+    n_local = np.asarray(first).shape[0]
+    counts = np.asarray(multihost_utils.process_allgather(np.int64(n_local)))
+    if not (counts == counts.flat[0]).all():
+        raise ValueError(
+            "multi-process streamed fit requires every host to yield the "
+            f"same local batch row count; got {counts.ravel().tolist()} on "
+            "the first batch — use host_shard_bounds with totals divisible "
+            "by the process count, or pad upstream"
+        )
+
+
+def _broadcast_init(init, mesh):
+    """Name-resolved inits come from the FIRST LOCAL batch, which differs per
+    host when the fit's mesh spans processes — broadcast process 0's so the
+    gang agrees. Host-local fits (mesh=None or single-process mesh) keep
+    their own init: broadcasting there would clobber independent per-host
+    fits and run a global collective some hosts might never reach."""
+    if mesh is not None and _mesh_layout(mesh)[0] > 1:
+        from jax.experimental import multihost_utils
+
+        init = multihost_utils.broadcast_one_to_all(np.asarray(init))
+    return init
 
 
 class _ResumeState(NamedTuple):
@@ -350,12 +424,13 @@ def streamed_kmeans_fit(
         first = jnp.asarray(first)
         if spherical:
             first = _normalize(first.astype(jnp.float32))
-        init = resolve_init(first, k, init, key)
+        init = _broadcast_init(resolve_init(first, k, init, key), mesh)
     c = jnp.asarray(init, jnp.float32)
     if c.shape != (k, d):
         raise ValueError(f"init shape {c.shape} != {(k, d)}")
     if spherical:
         c = _normalize(c)
+    _check_equal_local_rows(batches, first, mesh)
     if mesh is not None:
         c = mesh_lib.replicate(c, mesh)
 
@@ -385,8 +460,11 @@ def streamed_kmeans_fit(
 
     def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
         def step(acc, batch):
-            xb, n_valid = _prepare_batch(batch, mesh)
-            return _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical), n_valid
+            xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            return (
+                _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical),
+                n_local,
+            )
 
         return _run_pass(
             batches, prefetch, zero_stats, step,
@@ -505,7 +583,7 @@ def mean_combine_fit(
         sse=jnp.zeros((), jnp.float32),
     )
     for batch in _prefetched(batches(), prefetch):
-        xb, n_valid = _prepare_batch(batch, None)
+        xb, n_valid, _ = _prepare_batch(batch, None)
         acc = _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical)
     return KMeansResult(
         centroids=c,
@@ -556,12 +634,14 @@ def streamed_fuzzy_fit(
     per-iteration (objective, shift) history the reference never computed."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
+    first = None
     if not hasattr(init, "shape"):
         first = jnp.asarray(next(iter(batches())))
-        init = resolve_init(first, k, init, key)
+        init = _broadcast_init(resolve_init(first, k, init, key), mesh)
     c = jnp.asarray(init, jnp.float32)
     if c.shape != (k, d):
         raise ValueError(f"init shape {c.shape} != {(k, d)}")
+    _check_equal_local_rows(batches, first, mesh)
     if mesh is not None:
         c = mesh_lib.replicate(c, mesh)
 
@@ -595,8 +675,11 @@ def streamed_fuzzy_fit(
 
     def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
         def step(acc, batch):
-            xb, n_valid = _prepare_batch(batch, mesh)
-            return _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m), n_valid
+            xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            return (
+                _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m),
+                n_local,
+            )
 
         return _run_pass(
             batches, prefetch, zero_stats, step,

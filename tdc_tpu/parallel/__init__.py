@@ -8,6 +8,12 @@ from tdc_tpu.parallel.mesh import (
     replicated_sharding,
 )
 from tdc_tpu.parallel.collectives import distributed_lloyd_stats, distributed_fuzzy_stats
+from tdc_tpu.parallel.supervisor import (
+    GangFailed,
+    GangResult,
+    align_checkpoints,
+    run_gang,
+)
 
 __all__ = [
     "make_mesh",
@@ -17,4 +23,8 @@ __all__ = [
     "replicated_sharding",
     "distributed_lloyd_stats",
     "distributed_fuzzy_stats",
+    "GangFailed",
+    "GangResult",
+    "align_checkpoints",
+    "run_gang",
 ]

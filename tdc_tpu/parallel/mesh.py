@@ -63,5 +63,18 @@ def shard_points(x, mesh: Mesh, axis_name: str = DATA_AXIS) -> jax.Array:
 
 
 def replicate(x, mesh: Mesh) -> jax.Array:
-    """Place an array fully replicated on every device of the mesh."""
-    return jax.device_put(x, replicated_sharding(mesh))
+    """Place an array fully replicated on every device of the mesh.
+
+    On a multi-process mesh (devices this process cannot address) the value
+    is assembled per process via make_array_from_callback — every host holds
+    the same value by SPMD contract, so the result is a consistent global
+    replicated array.
+    """
+    sharding = replicated_sharding(mesh)
+    if any(d.process_index != jax.process_index()
+           for d in mesh.devices.ravel()):
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+    return jax.device_put(x, sharding)

@@ -45,6 +45,27 @@ def initialize_distributed(
     return jax.process_index(), jax.process_count()
 
 
+def initialize_from_env() -> tuple[int, int]:
+    """Initialize jax.distributed from the supervisor's TDC_* environment.
+
+    Workers launched by `parallel.supervisor.run_gang` call this first thing:
+    it reads TDC_COORDINATOR / TDC_NUM_PROCESSES / TDC_PROCESS_ID (absent →
+    single-process no-op, so the same worker script runs standalone too).
+    Returns (process_index, num_processes).
+    """
+    import os
+
+    coord = os.environ.get("TDC_COORDINATOR")
+    nproc = os.environ.get("TDC_NUM_PROCESSES")
+    pid = os.environ.get("TDC_PROCESS_ID")
+    if coord is None or nproc is None or pid is None or int(nproc) <= 1:
+        # A 1-process supervised gang needs no coordinator handshake (and
+        # initialize(coordinator_address=...) alone would try to autodetect
+        # a process count, which fails off managed TPU/SLURM machines).
+        return initialize_distributed()
+    return initialize_distributed(coord, int(nproc), int(pid))
+
+
 def global_mesh(axis_name: str = DATA_AXIS) -> Mesh:
     """1-D mesh over every device of every process."""
     return Mesh(np.asarray(jax.devices()), (axis_name,))

@@ -1,0 +1,260 @@
+"""Elastic gang supervision: worker-loss detection + restart-from-checkpoint.
+
+The reference's entire failure story is per-experiment subprocess isolation
+plus an OOM retry (SURVEY.md §5; scripts/new_experiment.py:59-64,
+scripts/distribuitedClustering.py:357-360) — a lost or hung process simply
+loses the run. This module adds the multi-host equivalent the SURVEY plan
+calls for: a gang of `jax.distributed` worker processes is supervised, and
+
+- a worker exiting nonzero, or going heartbeat-silent past a deadline, marks
+  the GANG failed — JAX collectives cannot survive a lost participant, so the
+  recovery unit is the whole gang, never a single worker;
+- the survivors are killed, the checkpoint directory is trimmed to the latest
+  fully-written step (orbax leaves *.orbax-checkpoint-tmp-* droppings when a
+  save is interrupted; with per-worker dirs, steps are additionally trimmed
+  to the latest step COMMON to all dirs — resuming from different steps
+  would diverge or deadlock in the first collective), and
+- the gang is relaunched on a fresh coordinator port; workers resume from
+  the aligned checkpoint (models/streaming.py persists centroids, iteration,
+  and optionally the mid-pass accumulator).
+
+Checkpoint-directory semantics: orbax writes array data only on the PRIMARY
+host of a jax.distributed gang (non-primary saves are coordination no-ops),
+so a gang must share ONE checkpoint directory — every worker passes the same
+path and restores the same step; on real pods that is the usual shared
+filesystem (GCS/NFS), here the local disk. Pass `ckpt_dirs=[shared_dir]` to
+run_gang (a single entry is broadcast to every worker); per-worker dirs
+remain supported for single-process gangs or non-orbax state.
+
+Scope: supervises the processes it spawned — one machine, e.g. the per-host
+launcher of a real pod deployment or the CPU-device simulation the tests use.
+The restart + checkpoint-alignment logic is the portable core.
+
+Workers receive their gang coordinates via environment variables
+(TDC_PROCESS_ID, TDC_NUM_PROCESSES, TDC_COORDINATOR, TDC_ATTEMPT, and
+optionally TDC_CKPT_DIR / TDC_HEARTBEAT_FILE) and should call
+`tdc_tpu.parallel.multihost.initialize_from_env()` first thing.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+
+class GangFailed(RuntimeError):
+    """All restart attempts exhausted; carries per-worker log tails."""
+
+
+@dataclass
+class GangResult:
+    attempts: int  # total launches (1 = no restart was needed)
+    returncodes: list[int]  # final attempt's per-worker exit codes (all 0)
+    log_paths: list[str]  # final attempt's per-worker stdout+stderr logs
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port for the jax.distributed coordinator."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _checkpoint_steps(ckpt_dir: str) -> set[int]:
+    if not os.path.isdir(ckpt_dir):
+        return set()
+    steps = set()
+    for name in os.listdir(ckpt_dir):
+        parts = name.split("_")
+        if name.startswith("step_") and len(parts) == 2 and parts[1].isdigit():
+            steps.add(int(parts[1]))
+    return steps
+
+
+def align_checkpoints(ckpt_dirs: list[str], log=lambda *_: None) -> int | None:
+    """Trim per-worker checkpoint dirs to the latest step present in ALL of
+    them; returns that step (None = no common step, all checkpoints removed
+    and the gang restarts from scratch).
+
+    Also removes orbax temp dirs (step_*.orbax-checkpoint-tmp-*) left by a
+    save that was interrupted mid-write.
+    """
+    per_dir = [_checkpoint_steps(d) for d in ckpt_dirs]
+    common = set.intersection(*per_dir) if per_dir else set()
+    target = max(common) if common else None
+    for d, steps in zip(ckpt_dirs, per_dir):
+        if not os.path.isdir(d):
+            continue
+        for name in os.listdir(d):
+            path = os.path.join(d, name)
+            if not name.startswith("step_"):
+                continue
+            parts = name.split("_")
+            is_step = len(parts) == 2 and parts[1].isdigit()
+            if is_step and (target is None or int(parts[1]) > target):
+                log(f"supervisor: dropping {path} (beyond common step {target})")
+                shutil.rmtree(path, ignore_errors=True)
+            elif not is_step:  # interrupted orbax tmp dir
+                shutil.rmtree(path, ignore_errors=True)
+    return target
+
+
+def _kill(procs, grace: float) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.monotonic() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+def run_gang(
+    cmd: list[str],
+    num_processes: int,
+    *,
+    max_restarts: int = 2,
+    heartbeat_timeout: float | None = None,
+    ckpt_dirs: list[str] | None = None,
+    log_dir: str,
+    env: dict | None = None,
+    poll_interval: float = 0.25,
+    grace: float = 5.0,
+    echo=lambda msg: print(msg, file=sys.stderr, flush=True),
+) -> GangResult:
+    """Run `cmd` as a gang of `num_processes` workers; restart on failure.
+
+    Args:
+      cmd: the worker command line, identical for every worker — workers read
+        their coordinates from the TDC_* environment.
+      max_restarts: restarts after the first launch (total attempts = 1 + this).
+      heartbeat_timeout: if set, a worker whose TDC_HEARTBEAT_FILE goes
+        untouched for this many seconds is treated as hung (the clock starts
+        at spawn, so slow startup counts against it — size accordingly, e.g.
+        several compile times).
+      ckpt_dirs: checkpoint directories, exported as TDC_CKPT_DIR and aligned
+        with `align_checkpoints` before every relaunch. A single entry is
+        shared by every worker (required for orbax state — see module
+        docstring); otherwise len must equal num_processes. Without it,
+        restarts are from scratch.
+      log_dir: per-attempt, per-worker stdout+stderr capture files.
+
+    Returns GangResult on success; raises GangFailed when attempts run out.
+    """
+    if ckpt_dirs is not None and len(ckpt_dirs) not in (1, num_processes):
+        raise ValueError(
+            f"need 1 (shared) or {num_processes} ckpt_dirs, got {len(ckpt_dirs)}"
+        )
+    if ckpt_dirs is not None and len(ckpt_dirs) == 1:
+        ckpt_dirs = ckpt_dirs * num_processes
+    os.makedirs(log_dir, exist_ok=True)
+    base_env = dict(os.environ if env is None else env)
+
+    for attempt in range(max_restarts + 1):
+        if attempt > 0 and ckpt_dirs is not None:
+            step = align_checkpoints(ckpt_dirs, log=echo)
+            echo(f"supervisor: attempt {attempt + 1}, resuming from "
+                 f"{'scratch' if step is None else f'common step {step}'}")
+        coordinator = f"127.0.0.1:{free_port()}"
+        procs, logs, hb_files, log_paths = [], [], [], []
+        failed_why = None
+        try:
+            # Spawn inside the try so a mid-loop Popen/open failure (fd or
+            # memory exhaustion) still kills the workers already started —
+            # they would otherwise block forever in the coordinator
+            # handshake waiting for peers that never came up.
+            for pid in range(num_processes):
+                worker_env = dict(base_env)
+                worker_env.update(
+                    TDC_PROCESS_ID=str(pid),
+                    TDC_NUM_PROCESSES=str(num_processes),
+                    TDC_COORDINATOR=coordinator,
+                    TDC_ATTEMPT=str(attempt),
+                )
+                hb = None
+                if heartbeat_timeout is not None:
+                    hb = os.path.join(log_dir, f"hb_a{attempt}_p{pid}")
+                    worker_env["TDC_HEARTBEAT_FILE"] = hb
+                hb_files.append(hb)
+                if ckpt_dirs is not None:
+                    worker_env["TDC_CKPT_DIR"] = ckpt_dirs[pid]
+                log_path = os.path.join(log_dir,
+                                        f"worker_a{attempt}_p{pid}.log")
+                log_paths.append(log_path)
+                logf = open(log_path, "w")
+                logs.append(logf)
+                procs.append(
+                    subprocess.Popen(cmd, env=worker_env, stdout=logf,
+                                     stderr=subprocess.STDOUT)
+                )
+            # Wall clock, not monotonic: heartbeat staleness compares against
+            # file mtimes, which are epoch seconds.
+            start = time.time()
+            while True:
+                codes = [p.poll() for p in procs]
+                bad = [(i, c) for i, c in enumerate(codes)
+                       if c is not None and c != 0]
+                if bad:
+                    failed_why = ", ".join(
+                        f"worker {i} exited {c}" for i, c in bad)
+                    break
+                if all(c == 0 for c in codes):
+                    for f in logs:
+                        f.close()
+                    return GangResult(
+                        attempts=attempt + 1,
+                        returncodes=[int(c) for c in codes],
+                        log_paths=log_paths,
+                    )
+                if heartbeat_timeout is not None:
+                    now = time.time()
+                    for i, (hb, c) in enumerate(zip(hb_files, codes)):
+                        if c is not None:
+                            continue  # already exited 0; not hung
+                        try:
+                            last = os.path.getmtime(hb)
+                        except OSError:
+                            last = start
+                        if now - max(last, start) > heartbeat_timeout:
+                            failed_why = (f"worker {i} heartbeat silent "
+                                          f"> {heartbeat_timeout}s")
+                            break
+                    if failed_why:
+                        break
+                time.sleep(poll_interval)
+        finally:
+            _kill(procs, grace)
+            for f in logs:
+                f.close()
+        echo(f"supervisor: gang attempt {attempt + 1} failed ({failed_why})")
+        if attempt == max_restarts:
+            tails = []
+            for i, path in enumerate(log_paths):
+                try:
+                    with open(path) as f:
+                        tails.append(f"--- worker {i} ---\n{f.read()[-2000:]}")
+                except OSError:
+                    pass
+            raise GangFailed(
+                f"gang failed after {max_restarts + 1} attempts "
+                f"(last: {failed_why})\n" + "\n".join(tails)
+            )
+    raise AssertionError("unreachable")
+
+
+__all__ = [
+    "GangFailed",
+    "GangResult",
+    "align_checkpoints",
+    "free_port",
+    "run_gang",
+]

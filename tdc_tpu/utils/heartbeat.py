@@ -1,0 +1,41 @@
+"""Worker liveness heartbeats for supervised (elastic) gangs.
+
+The reference has no liveness story at all — a hung run in its sweep just
+stalls the whole matrix until someone notices (scripts/new_experiment.py:60
+blocks in process.communicate() forever). Under the gang supervisor
+(parallel/supervisor.py) each worker touches a per-worker file as it makes
+progress; the supervisor treats a stale file as a hang and restarts the gang
+from checkpoint. Beats are a no-op unless the supervisor set
+TDC_HEARTBEAT_FILE, so library code can call maybe_beat() unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+_last_beat = 0.0
+
+
+def maybe_beat(min_interval: float = 1.0) -> None:
+    """Touch $TDC_HEARTBEAT_FILE, at most once per `min_interval` seconds.
+
+    Called from the streamed-fit batch loop (models/streaming.py) — i.e. at
+    the granularity of one device dispatch, the finest progress signal the
+    host sees. Never raises: a missing/unwritable file must not take down
+    the computation it is reporting on.
+    """
+    global _last_beat
+    path = os.environ.get("TDC_HEARTBEAT_FILE")
+    if not path:
+        return
+    now = time.monotonic()
+    if now - _last_beat < min_interval:
+        return
+    _last_beat = now
+    try:
+        with open(path, "a"):
+            pass
+        os.utime(path, None)
+    except OSError:
+        pass

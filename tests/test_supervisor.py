@@ -1,0 +1,301 @@
+"""Elastic gang supervisor: failure detection + gang restart from checkpoint
+(SURVEY.md §5 failure-detection row — the multi-host recovery the reference
+lacks; its only isolation was one unsupervised subprocess per experiment,
+scripts/new_experiment.py:59)."""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from tdc_tpu.parallel.supervisor import (
+    GangFailed,
+    align_checkpoints,
+    free_port,
+    run_gang,
+)
+
+
+def _mk_steps(d, steps):
+    for s in steps:
+        os.makedirs(os.path.join(d, f"step_{s:08d}"), exist_ok=True)
+
+
+def _steps_in(d):
+    return sorted(
+        int(n.split("_")[1]) for n in os.listdir(d) if n.startswith("step_")
+        and n.split("_")[1].isdigit()
+    )
+
+
+class TestAlignCheckpoints:
+    def test_trims_to_common_step(self, tmp_path):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        _mk_steps(a, [1, 2, 3, 4])  # this worker got ahead before the crash
+        _mk_steps(b, [1, 2, 3])
+        assert align_checkpoints([a, b]) == 3
+        assert _steps_in(a) == [1, 2, 3]
+        assert _steps_in(b) == [1, 2, 3]
+
+    def test_no_common_step_wipes_all(self, tmp_path):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        _mk_steps(a, [2])
+        os.makedirs(b)  # crashed before its first save
+        assert align_checkpoints([a, b]) is None
+        assert _steps_in(a) == []
+
+    def test_removes_orbax_tmp_dirs(self, tmp_path):
+        a = str(tmp_path / "a")
+        _mk_steps(a, [1])
+        tmp = os.path.join(a, "step_00000002.orbax-checkpoint-tmp-123")
+        os.makedirs(tmp)  # save interrupted mid-write
+        assert align_checkpoints([a]) == 1
+        assert not os.path.exists(tmp)
+        assert _steps_in(a) == [1]
+
+    def test_missing_dirs_are_empty(self, tmp_path):
+        assert align_checkpoints([str(tmp_path / "nope")]) is None
+
+
+class TestRunGangSmall:
+    def test_success_first_attempt(self, tmp_path):
+        res = run_gang(
+            [sys.executable, "-c",
+             "import os; print('pid', os.environ['TDC_PROCESS_ID'])"],
+            2, log_dir=str(tmp_path), echo=lambda _: None,
+        )
+        assert res.attempts == 1
+        assert res.returncodes == [0, 0]
+        for i, path in enumerate(res.log_paths):
+            assert f"pid {i}" in open(path).read()
+
+    def test_exhausted_restarts_raise(self, tmp_path):
+        with pytest.raises(GangFailed, match="worker 1 exited 3"):
+            run_gang(
+                [sys.executable, "-c", textwrap.dedent("""
+                    import os, sys
+                    sys.exit(3 if os.environ["TDC_PROCESS_ID"] == "1" else 0)
+                 """)],
+                2, max_restarts=1, log_dir=str(tmp_path),
+                echo=lambda _: None,
+            )
+        # both attempts left logs for both workers
+        logs = sorted(os.listdir(str(tmp_path)))
+        assert sum(n.startswith("worker_a") for n in logs) == 4
+
+    def test_crash_then_restart_succeeds(self, tmp_path):
+        # Worker 0 dies on attempt 0 only; the survivor blocks forever (as a
+        # real gang peer would, stuck in a collective) and must be killed.
+        script = textwrap.dedent("""
+            import os, sys, time
+            pid = os.environ["TDC_PROCESS_ID"]
+            attempt = int(os.environ["TDC_ATTEMPT"])
+            if attempt == 0:
+                if pid == "0":
+                    sys.exit(9)
+                time.sleep(600)
+            print("done", pid)
+        """)
+        res = run_gang(
+            [sys.executable, "-c", script], 2, max_restarts=2,
+            log_dir=str(tmp_path), echo=lambda _: None,
+        )
+        assert res.attempts == 2
+        assert res.returncodes == [0, 0]
+
+    def test_heartbeat_hang_detected(self, tmp_path):
+        # Attempt 0 never beats -> hang after heartbeat_timeout; attempt 1
+        # beats and finishes. Beats are written directly (importing the
+        # package would cost a jax import, racing the 2s timeout).
+        script = textwrap.dedent("""
+            import os, time
+            hb = os.environ["TDC_HEARTBEAT_FILE"]
+            if int(os.environ["TDC_ATTEMPT"]) == 0:
+                time.sleep(600)  # silent hang
+            for _ in range(3):
+                open(hb, "a").close(); os.utime(hb, None)
+                time.sleep(0.1)
+            print("alive")
+        """)
+        res = run_gang(
+            [sys.executable, "-c", script], 1, max_restarts=1,
+            heartbeat_timeout=8.0, log_dir=str(tmp_path),
+            echo=lambda _: None,
+        )
+        assert res.attempts == 2
+
+    def test_hang_after_first_beat_detected(self, tmp_path):
+        # Regression: staleness compares epoch mtimes against wall clock; a
+        # worker that beats once then hangs must still be detected.
+        script = textwrap.dedent("""
+            import os, time
+            hb = os.environ["TDC_HEARTBEAT_FILE"]
+            open(hb, "a").close(); os.utime(hb, None)  # one beat...
+            if int(os.environ["TDC_ATTEMPT"]) == 0:
+                time.sleep(600)  # ...then silence
+            print("alive")
+        """)
+        res = run_gang(
+            [sys.executable, "-c", script], 1, max_restarts=1,
+            heartbeat_timeout=8.0, log_dir=str(tmp_path),
+            echo=lambda _: None,
+        )
+        assert res.attempts == 2
+
+    def test_ckpt_dirs_length_validated(self, tmp_path):
+        # 1 (shared) or num_processes dirs are valid; anything else is not.
+        with pytest.raises(ValueError, match="ckpt_dirs"):
+            run_gang([sys.executable, "-c", "pass"], 2,
+                     ckpt_dirs=["a", "b", "c"], log_dir=str(tmp_path))
+
+    def test_shared_ckpt_dir_broadcast(self, tmp_path):
+        # A single ckpt dir is exported to every worker.
+        script = ("import os; print('dir', os.environ['TDC_CKPT_DIR'])")
+        res = run_gang([sys.executable, "-c", script], 2,
+                       ckpt_dirs=[str(tmp_path / "shared")],
+                       log_dir=str(tmp_path), echo=lambda _: None)
+        for path in res.log_paths:
+            assert f"dir {tmp_path / 'shared'}" in open(path).read()
+
+
+_ELASTIC_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from tdc_tpu.parallel.multihost import (
+        global_mesh, host_shard_bounds, initialize_from_env,
+    )
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    outdir = sys.argv[1]
+    pid, nproc = initialize_from_env()
+    attempt = int(os.environ["TDC_ATTEMPT"])
+    assert jax.process_count() == nproc
+
+    # Global dataset is derivable on every host; each host STREAMS only its
+    # own rows of each global batch (equal-size contract).
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 4)).astype(np.float32)
+    X[:256] += 4.0; X[256:512] -= 4.0  # separated blobs
+    n_batches, rows = 4, 1024
+    per_batch = rows // n_batches
+    passes = {"n": 0}
+
+    def batches():
+        passes["n"] += 1
+        for b in range(n_batches):
+            if attempt == 0 and pid == 1 and passes["n"] == 4 and b == 2:
+                os._exit(17)  # simulated worker loss mid-pass, mid-iteration
+            lo = b * per_batch
+            start, end = host_shard_bounds(per_batch)
+            yield X[lo + start : lo + end]
+
+    mesh = global_mesh()
+    res = streamed_kmeans_fit(
+        batches, 5, 4, init=X[:5], max_iters=6, tol=-1.0, mesh=mesh,
+        ckpt_dir=os.environ["TDC_CKPT_DIR"], ckpt_every=1,
+        ckpt_every_batches=1,  # mid-pass cursor: resume inside iteration 4
+    )
+    np.save(os.path.join(outdir, f"centroids_{pid}.npy"),
+            np.asarray(res.centroids))
+    with open(os.path.join(outdir, f"iters_run_{pid}_a{attempt}"), "w") as f:
+        f.write(str(res.n_iter_run))
+    print("ELASTIC_OK", pid, "attempt", attempt, flush=True)
+""")
+
+
+def test_gang_kill_and_resume_matches_uninterrupted(tmp_path):
+    """The full elastic story: a 2-process jax.distributed gang runs a
+    mesh-sharded streamed fit with per-iteration checkpoints; worker 1 is
+    killed mid-pass on the first attempt; the supervisor kills the hung
+    survivor, aligns the per-worker checkpoints to the common step, and
+    relaunches; the resumed gang's centroids must match an uninterrupted
+    single-process run."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_ELASTIC_WORKER)
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    # ONE shared checkpoint dir: orbax writes on the gang's primary host only.
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    echoes = []
+    res = run_gang(
+        [sys.executable, str(worker), str(outdir)], 2,
+        max_restarts=2, ckpt_dirs=[str(ckpt_dir)],
+        log_dir=str(tmp_path / "logs"),
+        heartbeat_timeout=180.0, env=env, echo=echoes.append,
+    )
+    assert res.attempts == 2  # exactly one restart
+    # The restart RESUMED rather than restarting from scratch. The crash hit
+    # in iteration 4 after per-iteration checkpoints 1..3, but mid-pass saves
+    # OVERWRITE step 3 (delete + rewrite), so a kill landing mid-overwrite
+    # legitimately falls back to step 2 — accept either resume point.
+    resumed = [m for m in echoes if "resuming from" in m]
+    assert resumed and "scratch" not in resumed[0], echoes
+    step = int(resumed[0].rsplit("common step", 1)[1])
+    assert step in (2, 3), echoes
+    for pid in range(2):
+        iters_run = int((outdir / f"iters_run_{pid}_a1").read_text())
+        assert iters_run == 6 - step  # ran only the iterations after resume
+        # The mid-pass cursor validated (local-row accounting): the pass was
+        # NOT restarted from its beginning.
+        log = (tmp_path / "logs" / f"worker_a1_p{pid}.log").read_text()
+        assert "restarting the interrupted pass" not in log
+    c0 = np.load(outdir / "centroids_0.npy")
+    c1 = np.load(outdir / "centroids_1.npy")
+    np.testing.assert_array_equal(c0, c1)  # replicated state agrees bitwise
+
+    # Uninterrupted single-process oracle over the same global stream.
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 4)).astype(np.float32)
+    X[:256] += 4.0
+    X[256:512] -= 4.0
+
+    def batches():
+        for b in range(4):
+            yield X[b * 256 : (b + 1) * 256]
+
+    want = streamed_kmeans_fit(batches, 5, 4, init=X[:5], max_iters=6,
+                               tol=-1.0)
+    np.testing.assert_allclose(c0, np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_free_port_is_bindable():
+    import socket
+
+    port = free_port()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", port))
+
+
+def test_maybe_beat_touches_file(tmp_path, monkeypatch):
+    from tdc_tpu.utils import heartbeat
+
+    hb = tmp_path / "hb"
+    monkeypatch.setenv("TDC_HEARTBEAT_FILE", str(hb))
+    monkeypatch.setattr(heartbeat, "_last_beat", 0.0)
+    heartbeat.maybe_beat(min_interval=0.0)
+    assert hb.exists()
+    first = hb.stat().st_mtime_ns
+    heartbeat.maybe_beat(min_interval=3600.0)  # throttled: no re-touch
+    assert hb.stat().st_mtime_ns == first
+
+
+def test_maybe_beat_noop_without_env(tmp_path, monkeypatch):
+    from tdc_tpu.utils import heartbeat
+
+    monkeypatch.delenv("TDC_HEARTBEAT_FILE", raising=False)
+    heartbeat.maybe_beat(min_interval=0.0)  # must not raise
