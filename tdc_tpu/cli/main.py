@@ -211,11 +211,37 @@ def run_experiment(args) -> dict:
         if args.data_file:
             x, _ = load_points(args.data_file)
             n_obs, n_dim = x.shape
-        else:
-            n_obs, n_dim = args.n_obs, args.n_dim
-            x, _ = make_blobs(args.seed + 1, n_obs, n_dim, max(args.K, 2),
-                              class_sep=args.class_sep)
         n_devices = args.n_devices or len(jax.devices())
+        if not args.data_file:
+            n_obs, n_dim = args.n_obs, args.n_dim
+            # Fully in-memory single-device fits keep the generated points on
+            # device: a host round trip of the whole dataset through a
+            # tunneled device link costs far more than generation + fit. The
+            # host-slicing paths (streaming/minibatch/shard_k/mean_combine,
+            # multi-device sharding) still get numpy, as do datasets big
+            # enough that the OOM-adaptive batching fallback is plausible
+            # (device-resident data would escape it). Generated directly in
+            # the fit dtype so bf16 runs hold one device copy, not two.
+            needs_host = (
+                args.streamed or args.num_batches > 1 or args.minibatch
+                or args.mean_combine or args.shard_k > 1 or n_devices > 1
+            )
+            gen_dtype = np.float32
+            if not needs_host:
+                try:
+                    hbm = int(jax.devices()[0].memory_stats()
+                              .get("bytes_limit", 16 << 30))
+                except Exception:
+                    hbm = 16 << 30
+                itemsize = 2 if args.dtype == "bfloat16" else 4
+                needs_host = n_obs * n_dim * itemsize > 0.4 * hbm
+                if not needs_host and args.dtype == "bfloat16":
+                    import jax.numpy as jnp
+
+                    gen_dtype = jnp.bfloat16
+            x, _ = make_blobs(args.seed + 1, n_obs, n_dim, max(args.K, 2),
+                              class_sep=args.class_sep, to_host=needs_host,
+                              dtype=gen_dtype)
         mesh2d = None
         if args.shard_k > 1:
             if n_devices % args.shard_k != 0:
@@ -230,6 +256,15 @@ def run_experiment(args) -> dict:
             mesh = make_mesh(n_devices) if n_devices > 1 else None
 
     key = jax.random.PRNGKey(args.seed)
+
+    def host_points():
+        # Streamed paths need numpy. After an OOM fallback from a
+        # device-resident dataset, convert once and REBIND x so the HBM copy
+        # is freed before the streamed retry doubles batches again.
+        nonlocal x
+        if not isinstance(x, np.ndarray):
+            x = np.asarray(x)
+        return x
 
     def fit(num_batches: int):
         import jax.numpy as jnp
@@ -251,7 +286,7 @@ def run_experiment(args) -> dict:
                 from tdc_tpu.data.native_loader import NativePrefetchStream
 
                 return NativePrefetchStream(args.data_file, rows)
-            return NpzStream(np.asarray(x), rows)
+            return NpzStream(host_points(), rows)
 
         if args.minibatch:
             from tdc_tpu.data.batching import auto_batch_size
@@ -293,7 +328,7 @@ def run_experiment(args) -> dict:
             if streamed:
                 rows = -(-n_obs // num_batches)
                 return streamed_fuzzy_fit(
-                    NpzStream(np.asarray(x), rows), args.K, n_dim,
+                    NpzStream(host_points(), rows), args.K, n_dim,
                     m=args.fuzzifier, init=args.init, key=key,
                     max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
                     ckpt_dir=args.ckpt_dir,

@@ -18,12 +18,17 @@ import numpy as np
 
 
 def make_blobs(
-    seed: int, n_obs: int, n_dim: int, k: int, *, class_sep: float = 1.5, dtype=np.float32
+    seed: int, n_obs: int, n_dim: int, k: int, *, class_sep: float = 1.5,
+    dtype=np.float32, to_host: bool = True
 ):
-    """Gaussian blobs: (X (n_obs, n_dim) dtype, y (n_obs,) int32) on host.
+    """Gaussian blobs: (X (n_obs, n_dim) dtype, y (n_obs,) int32).
 
     Generated in ≤2^24-row device chunks so 1B-row datasets don't need
-    1B-row device buffers.
+    1B-row device buffers. to_host=False keeps X/y on device (the whole
+    dataset must then fit in device memory) — for in-memory fits this skips
+    a device→host→device round trip of the full dataset, which through a
+    remote-tunnel device link costs orders of magnitude more than the
+    generation itself.
     """
     chunk = min(n_obs, 1 << 24)
     key = jax.random.PRNGKey(seed)
@@ -35,10 +40,17 @@ def make_blobs(
         # the rolling key.
         n = min(chunk, remaining)
         x, y = _blobs_chunk_fixed_centers(jax.random.PRNGKey(seed), kchunk, n, n_dim, k, class_sep)
-        xs.append(np.asarray(x, dtype=dtype))
-        ys.append(np.asarray(y))
+        if to_host:
+            x, y = np.asarray(x, dtype=dtype), np.asarray(y)
+        else:
+            x = x.astype(jnp.dtype(dtype)) if x.dtype != jnp.dtype(dtype) else x
+        xs.append(x)
+        ys.append(y)
         remaining -= n
-    return np.concatenate(xs), np.concatenate(ys)
+    if len(xs) == 1:
+        return xs[0], ys[0]
+    cat = np.concatenate if to_host else jnp.concatenate
+    return cat(xs), cat(ys)
 
 
 @partial(jax.jit, static_argnames=("n", "d", "k"))

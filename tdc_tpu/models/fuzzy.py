@@ -43,9 +43,9 @@ def _fuzzy_stats_fn(kernel: str, m: float, block_rows: int, mesh=None):
             return lambda x, c: distributed_fuzzy_stats(
                 x, c, mesh, m=m, kernel="pallas"
             )
-        from tdc_tpu.ops.pallas_kernels import fuzzy_stats_fused
+        from tdc_tpu.ops.pallas_kernels import fuzzy_stats_auto
 
-        return lambda x, c: fuzzy_stats_fused(x, c, m=m)
+        return lambda x, c: fuzzy_stats_auto(x, c, m=m)
     if kernel != "xla":
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
     if block_rows:

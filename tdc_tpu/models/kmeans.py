@@ -63,9 +63,9 @@ def _stats_fn(kernel: str, block_rows: int, mesh=None):
             return lambda x, c: distributed_lloyd_stats(
                 x, c, mesh, kernel="pallas"
             )
-        from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+        from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto
 
-        return lloyd_stats_fused
+        return lloyd_stats_auto
     raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
 
 

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from tdc_tpu.ops.assign import lloyd_stats
 from tdc_tpu.models.kmeans import resolve_init
+from tdc_tpu.utils.heartbeat import maybe_beat
 
 
 class MiniBatchState(NamedTuple):
@@ -68,6 +69,7 @@ class MiniBatchKMeans:
     Usage:
         mbk = MiniBatchKMeans(k=1024, d=128, init=c0)
         for batch in loader:
+            maybe_beat()  # supervised-gang liveness
             mbk.partial_fit(batch)
         labels = kmeans_predict(x, mbk.centroids)
     """
@@ -155,6 +157,7 @@ def minibatch_kmeans_fit(
     for n_epoch in range(1, epochs + 1):
         c_start = None
         for batch in _prefetched(batches(), prefetch):
+            maybe_beat()  # supervised-gang liveness
             if c_start is None and mbk._state is None:
                 mbk._ensure_init(jnp.asarray(np.asarray(batch)))
             if c_start is None:

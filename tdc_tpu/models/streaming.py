@@ -553,6 +553,7 @@ def mean_combine_fit(
     shift = 0.0
     converged = True
     for batch in _prefetched(batches(), prefetch):
+        maybe_beat()  # supervised-gang liveness
         batch = np.asarray(batch)
         bmesh = mesh
         if mesh is not None:
@@ -583,6 +584,7 @@ def mean_combine_fit(
         sse=jnp.zeros((), jnp.float32),
     )
     for batch in _prefetched(batches(), prefetch):
+        maybe_beat()  # supervised-gang liveness
         xb, n_valid, _ = _prepare_batch(batch, None)
         acc = _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical)
     return KMeansResult(

@@ -36,6 +36,40 @@ _PAD_CENTROID = 1e15
 _ARG_SENTINEL = 2**30  # masked-out i32 index value; > any real K
 
 
+def fused_block_n(
+    k: int,
+    d: int,
+    itemsize: int = 2,
+    *,
+    temps: int = 1,
+    budget: int = 14 << 20,
+    cap: int = 2048,
+) -> int:
+    """Largest N-block (multiple of 128, ≤ cap) whose fused-kernel VMEM
+    footprint fits the ~16 MB scoped-vmem limit, or 0 when the fused kernel
+    is infeasible at this K·d (the resident (K, d) accumulator + output +
+    centroid tile leave no room for even a 128-row block) — route to the
+    two-pass blockwise path instead.
+
+    Calibrated model (v5e): resident = f32 accumulator scratch + f32 output
+    block (both (K_pad, d_pad)) + centroid tile (itemsize) + per-K vectors,
+    plus per x-row: the x tile, ‖x‖², and `temps` live (BN, K) f32
+    temporaries Mosaic keeps across the fused chain — measured ≈1 for the
+    Lloyd kernel (distance → argmin → one-hot reuse buffers) and ≈3 for the
+    fuzzy kernel (d2 / u / u^m are all live across the normalize-pow chain;
+    matches the empirical K=1024 cap of ~1024 rows). `cap` defaults to the
+    tuned Lloyd optimum (RESULTS.md block_n sweep: 2048 beats 1024 and 3072).
+    """
+    k_pad = -(-k // 128) * 128
+    d_pad = -(-d // 128) * 128
+    fixed = k_pad * d_pad * (8 + itemsize) + 16 * k_pad
+    per_row = temps * k_pad * 4 + d_pad * itemsize + 8
+    avail = budget - fixed
+    if avail < 128 * per_row:
+        return 0
+    return int(min(cap, avail // per_row // 128 * 128))
+
+
 def _distance_argmin_kernel(x_ref, c_ref, c2_ref, mind_ref, arg_ref, *, block_k: int):
     j = pl.program_id(1)
     cross = jax.lax.dot_general(
@@ -190,13 +224,15 @@ def lloyd_stats_fused(
     x: jax.Array,
     centroids: jax.Array,
     *,
-    block_n: int = 512,
+    block_n: int | None = None,
     interpret: bool | None = None,
 ):
     """Fully-fused Lloyd sufficient stats: one kernel, one pass over x, no
     (N, K) intermediate anywhere (HBM or otherwise). Requires the (K, d)
     f32 accumulator + (BN, K) tiles to fit VMEM — the K·d ≲ 1M regime; use
-    lloyd_stats_pallas (two-pass) or ops.assign.lloyd_stats_blocked beyond.
+    lloyd_stats_pallas (two-pass) or ops.assign.lloyd_stats_blocked beyond
+    (lloyd_stats_auto routes by feasibility). block_n=None sizes the N-block
+    from the VMEM model (fused_block_n).
 
     Returns ops.assign.SufficientStats (sums (K,d) f32, counts (K,) f32,
     sse () f32 — true Σ min‖x−c‖², clamped at 0).
@@ -207,6 +243,14 @@ def lloyd_stats_fused(
         interpret = jax.devices()[0].platform != "tpu"
     n, d = x.shape
     k = centroids.shape[0]
+    if block_n is None:
+        block_n = fused_block_n(k, d, x.dtype.itemsize)
+        if block_n == 0:
+            raise ValueError(
+                f"lloyd_stats_fused: K={k}, d={d} does not fit VMEM "
+                "(accumulator alone exceeds the scope); use "
+                "lloyd_stats_pallas / lloyd_stats_auto"
+            )
     xp = _pad_axis(_pad_axis(x, 1, 128, 0), 0, block_n, 0)
     cp = _pad_axis(
         _pad_axis(centroids.astype(x.dtype), 1, 128, 0), 0, 128, _PAD_CENTROID
@@ -310,8 +354,9 @@ def fuzzy_stats_fused(
     m: float = 2.0,
     eps: float = 1e-9,
     *,
-    block_n: int = 512,  # (block_n, K) f32 temps x ~4 (d2/inv/u/mu) must fit
-    #                      the 16 MB VMEM scope: K=1024 caps block_n at ~1024
+    block_n: int | None = None,  # None = fused_block_n(..., temps=3): the
+    #                              d2/u/u^m chain keeps ~3 (BN, K) f32 temps
+    #                              live, so K=1024 caps block_n at ~1024
     interpret: bool | None = None,
 ):
     """Fully-fused fuzzy c-means sufficient stats: one kernel, one pass over
@@ -328,6 +373,13 @@ def fuzzy_stats_fused(
         interpret = jax.devices()[0].platform != "tpu"
     n, d = x.shape
     k = centroids.shape[0]
+    if block_n is None:
+        block_n = fused_block_n(k, d, x.dtype.itemsize, temps=3)
+        if block_n == 0:
+            raise ValueError(
+                f"fuzzy_stats_fused: K={k}, d={d} does not fit VMEM; use "
+                "fuzzy_stats_auto / ops.assign.fuzzy_stats_padded_blocked"
+            )
     xp = _pad_axis(_pad_axis(x, 1, 128, 0), 0, block_n, 0)
     cp = _pad_axis(
         _pad_axis(centroids.astype(x.dtype), 1, 128, 0), 0, 128, _PAD_CENTROID
@@ -380,6 +432,36 @@ def fuzzy_stats_fused(
         weights=weights,
         objective=jnp.maximum(obj, 0.0),
     )
+
+
+def lloyd_stats_auto(x: jax.Array, centroids: jax.Array, **kw):
+    """Pallas Lloyd stats routed by VMEM feasibility (decided at trace time
+    from the static shapes): the fully-fused single-pass kernel when the
+    (K, d) accumulator + block tiles fit the scope, else the two-pass
+    blockwise path (online-argmin kernel + one-hot-matmul stats) that works
+    at any K·d — so kernel='pallas' is safe at every shape, including the
+    K=4096·d=256 and K=16,384·d=768 regimes where the fused kernel cannot
+    compile."""
+    if fused_block_n(centroids.shape[0], x.shape[1], x.dtype.itemsize) > 0:
+        return lloyd_stats_fused(x, centroids, **kw)
+    return lloyd_stats_pallas(x, centroids, **kw)
+
+
+def fuzzy_stats_auto(x: jax.Array, centroids: jax.Array, m: float = 2.0, **kw):
+    """Pallas fuzzy stats routed by VMEM feasibility; beyond the fused
+    regime, falls back to XLA N-blocked stats (there is no two-pass fuzzy
+    kernel: memberships need every distance, so blocking the N axis is the
+    only memory lever)."""
+    k, d = centroids.shape[0], x.shape[1]
+    if fused_block_n(k, d, x.dtype.itemsize, temps=3) > 0:
+        return fuzzy_stats_fused(x, centroids, m=m, **kw)
+    from tdc_tpu.models.kmeans import auto_block_rows
+    from tdc_tpu.ops.assign import fuzzy_stats, fuzzy_stats_padded_blocked
+
+    block = auto_block_rows(x.shape[0], k)
+    if block:
+        return fuzzy_stats_padded_blocked(x, centroids, m, block)
+    return fuzzy_stats(x, centroids, m=m)
 
 
 def lloyd_stats_pallas(

@@ -44,9 +44,9 @@ def distributed_lloyd_stats(
     path, with only the (K, d) stats crossing ICI.
     """
     if kernel == "pallas":
-        from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+        from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto
 
-        local_fn = lloyd_stats_fused
+        local_fn = lloyd_stats_auto
     else:
         local_fn = lloyd_stats
 
@@ -76,9 +76,9 @@ def distributed_fuzzy_stats(
     kernel='pallas' runs the fused single-pass VMEM fuzzy kernel per shard
     (no (N, K) membership matrix anywhere)."""
     if kernel == "pallas":
-        from tdc_tpu.ops.pallas_kernels import fuzzy_stats_fused
+        from tdc_tpu.ops.pallas_kernels import fuzzy_stats_auto
 
-        local_fn = lambda x, c: fuzzy_stats_fused(x, c, m=m)
+        local_fn = lambda x, c: fuzzy_stats_auto(x, c, m=m)
     else:
         local_fn = lambda x, c: fuzzy_stats(x, c, m=m)
 

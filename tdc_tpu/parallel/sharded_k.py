@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tdc_tpu.ops.distance import pairwise_sq_dist
 from tdc_tpu.models.kmeans import KMeansResult, _normalize, resolve_init
+from tdc_tpu.utils.heartbeat import maybe_beat
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -408,6 +409,7 @@ def streamed_kmeans_fit_sharded(
 
         acc = zero_acc()
         for batch in _prefetched(batches(), prefetch):
+            maybe_beat()  # supervised-gang liveness
             xb, n_valid = put_batch(batch)
             acc = accumulate(acc, xb, c, n_valid)
         return acc

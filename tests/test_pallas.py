@@ -162,3 +162,79 @@ def test_bf16_inputs():
     d2 = cdist(x, c, "sqeuclidean")
     # bf16 rounding can flip near-ties; demand 99%+ agreement.
     assert (np.asarray(arg) == d2.argmin(1)).mean() > 0.99
+
+
+class TestFusedBlockN:
+    """VMEM-model block sizing + feasibility routing (the K=4096·d=256
+    regime OOM'd the fused kernel's scoped vmem before auto-sizing)."""
+
+    def test_tuned_shape_keeps_optimum(self):
+        from tdc_tpu.ops.pallas_kernels import fused_block_n
+
+        # K=1024, d=128 bf16: the RESULTS.md-tuned optimum (2048) survives.
+        assert fused_block_n(1024, 128, 2) == 2048
+
+    def test_large_kd_shrinks_block(self):
+        from tdc_tpu.ops.pallas_kernels import fused_block_n
+
+        bn = fused_block_n(4096, 256, 2)
+        assert 0 < bn <= 256  # fits, but far below the cap
+        assert bn % 128 == 0
+
+    def test_infeasible_kd_returns_zero(self):
+        from tdc_tpu.ops.pallas_kernels import fused_block_n
+
+        # K=16,384 x d=768: the f32 accumulator alone is 48 MB.
+        assert fused_block_n(16384, 768, 2) == 0
+        # Fuzzy keeps ~3 live (BN, K) temps -> infeasible earlier.
+        assert fused_block_n(4096, 256, 4, temps=3) == 0
+
+    def test_fused_raises_beyond_vmem(self, rng):
+        import jax.numpy as jnp
+        import pytest
+
+        from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+
+        x = jnp.asarray(rng.normal(size=(8, 768)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(16384, 768)).astype(np.float32))
+        with pytest.raises(ValueError, match="does not fit VMEM"):
+            lloyd_stats_fused(x, c)
+
+    def test_auto_routes_and_matches_oracle(self, rng):
+        import jax.numpy as jnp
+
+        from tdc_tpu.ops.assign import fuzzy_stats, lloyd_stats
+        from tdc_tpu.ops.pallas_kernels import (
+            fuzzy_stats_auto,
+            lloyd_stats_auto,
+        )
+
+        x = jnp.asarray(rng.normal(size=(512, 16)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(300, 16)).astype(np.float32))
+        a, b = lloyd_stats_auto(x, c), lloyd_stats(x, c)
+        np.testing.assert_allclose(a.sums, b.sums, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(a.counts, b.counts)
+        np.testing.assert_allclose(float(a.sse), float(b.sse), rtol=1e-5)
+        fa = fuzzy_stats_auto(x, c, m=2.0)
+        fb = fuzzy_stats(x, c, m=2.0)
+        np.testing.assert_allclose(fa.weighted_sums, fb.weighted_sums,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(fa.weights, fb.weights, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_auto_fallback_path_matches_oracle(self, rng):
+        """A shape the fused kernel cannot take must still produce correct
+        stats through the two-pass / blocked fallbacks."""
+        import jax.numpy as jnp
+
+        from tdc_tpu.ops.assign import lloyd_stats
+        from tdc_tpu.ops.pallas_kernels import fused_block_n, lloyd_stats_auto
+
+        # Tiny N so the interpret-mode fallback is cheap, but K*d big enough
+        # to be infeasible for the fused kernel.
+        x = jnp.asarray(rng.normal(size=(64, 768)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(2048, 768)).astype(np.float32))
+        assert fused_block_n(2048, 768, 4) == 0
+        a, b = lloyd_stats_auto(x, c), lloyd_stats(x, c)
+        np.testing.assert_allclose(a.counts, b.counts)
+        np.testing.assert_allclose(a.sums, b.sums, rtol=1e-4, atol=1e-4)
