@@ -1,0 +1,87 @@
+"""Elastic multi-process worker — template for supervised gang runs.
+
+Launch under the gang supervisor (any number of processes; CPU devices shown
+so the demo runs anywhere):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \\
+    python -m tdc_tpu.cli.supervise --num_processes=2 --max_restarts=2 \\
+        --heartbeat_timeout=300 --ckpt_root=/tmp/elastic_ck \\
+        --log_dir=/tmp/elastic_logs -- python examples/elastic_worker.py
+
+Kill any worker mid-run (kill -9 <pid>): the supervisor detects the loss,
+kills the survivors, trims the shared checkpoint to the last complete step,
+and relaunches; the fit resumes where it left off. On a TPU pod, drop the
+JAX_PLATFORMS/XLA_FLAGS overrides and run one process per host.
+
+The structure to copy:
+  1. initialize_from_env() first — joins the gang from $TDC_* variables and
+     works unchanged standalone (single process, no supervisor).
+  2. Each host streams ONLY its own rows of every global batch
+     (host_shard_bounds), same local count on every host.
+  3. ckpt_dir comes from $TDC_CKPT_DIR — one SHARED directory for the gang
+     (process 0 is the single writer, atomic state.npz per step; all hosts
+     restore the same step).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+# Honor $JAX_PLATFORMS even when a site hook pre-imported jax and pinned a
+# platform (the env var is only read at first import) — must run before any
+# device use.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from tdc_tpu.models.streaming import streamed_kmeans_fit
+from tdc_tpu.parallel.multihost import (
+    barrier,
+    global_mesh,
+    host_shard_bounds,
+    initialize_from_env,
+)
+
+
+def main() -> int:
+    pid, nproc = initialize_from_env()
+
+    # Demo data: derivable on every host so no distribution step is needed.
+    # Real workers load their own slice of a dataset here instead.
+    n_obs, n_dim, k, n_batches = 200_000, 16, 32, 8
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_obs, n_dim)).astype(np.float32)
+    x[: n_obs // 4] += 5.0
+
+    per_batch = n_obs // n_batches
+
+    def batches():
+        for b in range(n_batches):
+            lo = b * per_batch
+            start, end = host_shard_bounds(per_batch)
+            yield x[lo + start : lo + end]
+
+    res = streamed_kmeans_fit(
+        batches, k, n_dim,
+        init=x[:k],
+        max_iters=30, tol=1e-4,
+        mesh=global_mesh(),
+        ckpt_dir=os.environ.get("TDC_CKPT_DIR"),
+        ckpt_every=1,
+        ckpt_every_batches=2,
+    )
+    print(
+        f"worker {pid}/{nproc}: n_iter={int(res.n_iter)} "
+        f"sse={float(res.sse):.6g} converged={bool(res.converged)} "
+        f"(ran {res.n_iter_run} iterations this attempt)"
+    )
+    # 4. Synchronize before exit: the first process to tear down its
+    #    distributed runtime cancels its peers mid-shutdown otherwise.
+    barrier()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

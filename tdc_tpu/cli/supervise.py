@@ -15,8 +15,11 @@ Usage:
         -- python my_worker.py --flags...
 
 The worker should call `tdc_tpu.parallel.multihost.initialize_from_env()`
-first, read its checkpoint directory from $TDC_CKPT_DIR, and pass it as
-`ckpt_dir=` to a streamed fit (models/streaming.py) so resume works.
+first, read its checkpoint directory from $TDC_CKPT_DIR, pass it as
+`ckpt_dir=` to a streamed fit (models/streaming.py) so resume works, and call
+`tdc_tpu.parallel.multihost.barrier()` before exiting (an unsynchronized exit
+cancels peers mid-shutdown, which reads as a gang failure). Template:
+examples/elastic_worker.py.
 """
 
 from __future__ import annotations
@@ -43,9 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "allow for compile time)")
     p.add_argument("--ckpt_root", type=str, default=None,
                    help="shared checkpoint dir exported to every worker as "
-                        "$TDC_CKPT_DIR (orbax writes on the gang's primary "
-                        "host only, so the dir must be shared); trimmed to "
-                        "the latest complete step before every restart")
+                        "$TDC_CKPT_DIR (process 0 is the single writer — "
+                        "atomic state.npz per step — so the dir must be "
+                        "shared); trimmed to the latest complete step "
+                        "before every restart")
     p.add_argument("--log_dir", type=str, required=True,
                    help="per-attempt per-worker stdout+stderr capture")
     p.add_argument("cmd", nargs=argparse.REMAINDER,

@@ -71,6 +71,19 @@ def global_mesh(axis_name: str = DATA_AXIS) -> Mesh:
     return Mesh(np.asarray(jax.devices()), (axis_name,))
 
 
+def barrier(name: str = "tdc_exit") -> None:
+    """Cross-process barrier; no-op single-process.
+
+    Call before a gang worker exits: the first process to tear down its
+    jax.distributed runtime cancels its peers' in-flight RPCs, so an
+    unsynchronized exit turns a SUCCESSFUL run into a spurious nonzero peer
+    exit that the supervisor then 'recovers' with a pointless restart."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def host_shard_bounds(n_global: int) -> tuple[int, int]:
     """[start, end) of this host's contiguous row range; even split with the
     remainder spread over the first hosts (np.array_split semantics, matching

@@ -18,13 +18,13 @@ calls for: a gang of `jax.distributed` worker processes is supervised, and
   the aligned checkpoint (models/streaming.py persists centroids, iteration,
   and optionally the mid-pass accumulator).
 
-Checkpoint-directory semantics: orbax writes array data only on the PRIMARY
-host of a jax.distributed gang (non-primary saves are coordination no-ops),
-so a gang must share ONE checkpoint directory — every worker passes the same
-path and restores the same step; on real pods that is the usual shared
-filesystem (GCS/NFS), here the local disk. Pass `ckpt_dirs=[shared_dir]` to
-run_gang (a single entry is broadcast to every worker); per-worker dirs
-remain supported for single-process gangs or non-orbax state.
+Checkpoint-directory semantics: a gang shares ONE checkpoint directory —
+process 0 is the single writer (utils/checkpoint.py writes an atomic
+state.npz per step in multi-process mode), every worker restores the same
+step; on real pods that is the usual shared filesystem (GCS/NFS), here the
+local disk. Pass `ckpt_dirs=[shared_dir]` to run_gang (a single entry is
+broadcast to every worker); per-worker dirs remain supported for
+single-process gangs or non-shared state.
 
 Scope: supervises the processes it spawned — one machine, e.g. the per-host
 launcher of a real pod deployment or the CPU-device simulation the tests use.

@@ -32,18 +32,82 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save_checkpoint(ckpt_dir: str, state: ClusterState, step: int) -> str:
-    """Write state under ckpt_dir/step_<N>; returns the path."""
-    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
-    payload = {
-        "centroids": np.asarray(state.centroids),
-        "n_iter": np.asarray(state.n_iter),
-        "key": np.asarray(state.key) if state.key is not None else np.zeros(2, np.uint32),
-        "has_key": np.asarray(state.key is not None),
-        "batch_cursor": np.asarray(state.batch_cursor),
-        "meta": dict(state.meta),
+def _manual_save(path: str, payload: dict) -> None:
+    """Single-writer atomic save: one .npz in a tmp dir, renamed into place.
+
+    Used for multi-process gangs sharing one checkpoint directory. Orbax's
+    multiprocess choreography (primary-gated writes but all-process barriers,
+    plus non-gated force-rmtree and a deterministic tmp path) raced on a
+    shared posix dir whenever a save overwrote a step — observed as
+    FileNotFoundError in the force-delete and FileExistsError on the tmp
+    path — and gating orbax to one active process deadlocks its remaining
+    internal barriers. The state is four small arrays plus a numeric meta
+    dict; a tmp dir + atomic rename by a single writer is the entire
+    requirement.
+    """
+    import uuid
+
+    meta = payload.pop("meta")
+    # Overwrites must not window-delete the readable state (mid-pass saves
+    # rewrite the same step every few batches, and kill -9 during a save is
+    # exactly the scenario this format serves): the step dir is stable and
+    # state.npz is swapped with a file-level atomic os.replace, so a reader
+    # always sees either the old or the new state. A crash between mkdir and
+    # the first replace leaves a dir without state.npz; restore_checkpoint
+    # skips such steps when scanning for the latest valid one.
+    os.makedirs(path, exist_ok=True)
+    # np.savez appends .npz to names not already ending in it — keep the
+    # suffix so the written file is exactly `tmp`.
+    tmp = os.path.join(path, f"state.tmp-{uuid.uuid4().hex[:8]}.npz")
+    np.savez(
+        tmp,
+        **{k: np.asarray(v) for k, v in payload.items()},
+        **{f"meta_{k}": np.asarray(v) for k, v in meta.items()},
+    )
+    os.replace(tmp, os.path.join(path, "state.npz"))
+
+
+def _manual_restore(path: str) -> dict:
+    with np.load(os.path.join(path, "state.npz"), allow_pickle=False) as z:
+        payload = {k: z[k] for k in z.files}
+    meta = {
+        k[len("meta_"):]: payload.pop(k)
+        for k in list(payload)
+        if k.startswith("meta_")
     }
-    _checkpointer().save(path, payload, force=True)
+    payload["meta"] = meta
+    return payload
+
+
+def save_checkpoint(ckpt_dir: str, state: ClusterState, step: int) -> str:
+    """Write state under ckpt_dir/step_<N>; returns the path.
+
+    Multi-process: the gang shares ONE directory; process 0 is the single
+    writer (manual atomic format — see _manual_save), every other process
+    skips the write. All processes rendezvous before returning so a
+    subsequent restore on any process happens-after the write.
+    """
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    multiprocess = jax.process_count() > 1
+    if jax.process_index() == 0:
+        payload = {
+            "centroids": np.asarray(state.centroids),
+            "n_iter": np.asarray(state.n_iter),
+            "key": np.asarray(state.key)
+            if state.key is not None
+            else np.zeros(2, np.uint32),
+            "has_key": np.asarray(state.key is not None),
+            "batch_cursor": np.asarray(state.batch_cursor),
+            "meta": dict(state.meta),
+        }
+        if multiprocess:
+            _manual_save(path, payload)
+        else:
+            _checkpointer().save(path, payload, force=True)
+    if multiprocess:
+        from tdc_tpu.parallel.multihost import barrier
+
+        barrier(f"tdc_ckpt_{step}")
     return path
 
 
@@ -58,14 +122,43 @@ def latest_step(ckpt_dir: str) -> int | None:
     return max(steps) if steps else None
 
 
+def _all_steps(ckpt_dir: str) -> list[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(
+        int(name.split("_")[1])
+        for name in os.listdir(ckpt_dir)
+        if name.startswith("step_") and name.split("_")[1].isdigit()
+    )
+
+
 def restore_checkpoint(ckpt_dir: str, step: int | None = None) -> ClusterState | None:
-    """Load the given (default: latest) checkpoint, or None if none exists."""
+    """Load the given (default: latest VALID) checkpoint, or None if none.
+
+    With step=None, steps are tried newest-first: a crash can leave the
+    newest step dir truncated (created but its state not yet written), and a
+    resume must fall back to the previous complete one rather than die on
+    every restart. An explicitly requested step propagates its load error.
+    """
     if step is None:
-        step = latest_step(ckpt_dir)
-        if step is None:
-            return None
+        import sys
+
+        for cand in reversed(_all_steps(ckpt_dir)):
+            try:
+                return restore_checkpoint(ckpt_dir, cand)
+            except Exception as e:  # truncated/corrupt step: fall back
+                print(
+                    f"note: checkpoint step {cand} in {ckpt_dir} is "
+                    f"unreadable ({type(e).__name__}: {e}); trying the "
+                    "previous step",
+                    file=sys.stderr,
+                )
+        return None
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
-    payload = _checkpointer().restore(path)
+    if os.path.exists(os.path.join(path, "state.npz")):
+        payload = _manual_restore(path)  # gang single-writer format
+    else:
+        payload = _checkpointer().restore(path)
     key = (
         jax.numpy.asarray(payload["key"])
         if bool(np.asarray(payload["has_key"]))

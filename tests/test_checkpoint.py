@@ -345,3 +345,70 @@ def test_sweep_resume_migrates_legacy_csv(tmp_path):
     # The fallback migrated the completion: the plain hash branch covers it now.
     assert os.path.exists(_done_file(log))
     assert run_sweep(spec, isolate=False, resume=True) == []
+
+
+def test_restore_skips_truncated_latest_step(tmp_path, capsys):
+    """A crash can leave the newest step dir without its state (manual
+    format: created but state.npz not yet replaced in). Restore must fall
+    back to the previous complete step instead of dying on every restart."""
+    import os
+
+    d = str(tmp_path / "ck")
+    save_checkpoint(
+        d, ClusterState(np.ones((2, 2)), 3, None, 0, {"k": 2, "d": 2}), 3
+    )
+    os.makedirs(os.path.join(d, "step_00000004"))  # truncated: no state
+    st = restore_checkpoint(d)
+    assert st is not None and st.n_iter == 3
+
+
+def test_manual_format_roundtrip(tmp_path, monkeypatch):
+    """The gang single-writer format (state.npz) restores identically,
+    including meta arrays."""
+    import os
+
+    from tdc_tpu.utils import checkpoint as ckpt
+
+    d = str(tmp_path / "ck")
+    state = ClusterState(
+        np.arange(6, dtype=np.float32).reshape(3, 2), 7,
+        np.asarray([1, 2], np.uint32), 4,
+        {"k": 3, "d": 2, "shift": 0.25,
+         "history": np.ones((2, 2), np.float32)},
+    )
+    ckpt._manual_save(
+        os.path.join(d, "step_00000007"),
+        {
+            "centroids": state.centroids, "n_iter": state.n_iter,
+            "key": state.key, "has_key": True,
+            "batch_cursor": state.batch_cursor, "meta": dict(state.meta),
+        },
+    )
+    st = restore_checkpoint(d)
+    assert st.n_iter == 7 and st.batch_cursor == 4
+    np.testing.assert_array_equal(st.centroids, state.centroids)
+    np.testing.assert_array_equal(np.asarray(st.key), [1, 2])
+    assert float(st.meta["shift"]) == 0.25
+    np.testing.assert_array_equal(st.meta["history"], np.ones((2, 2)))
+
+
+def test_manual_save_overwrite_is_atomic_per_file(tmp_path):
+    """Overwriting a step swaps state.npz in place — the step dir never
+    loses its readable state (no rmtree window)."""
+    import os
+
+    from tdc_tpu.utils import checkpoint as ckpt
+
+    path = str(tmp_path / "step_00000001")
+    payload = lambda v: {
+        "centroids": np.full((2, 2), float(v)), "n_iter": v,
+        "key": np.zeros(2, np.uint32), "has_key": False,
+        "batch_cursor": 0, "meta": {"k": 2, "d": 2},
+    }
+    ckpt._manual_save(path, payload(1))
+    ckpt._manual_save(path, payload(2))
+    st = restore_checkpoint(str(tmp_path))
+    assert st.n_iter == 2
+    # no stray tmp files left behind
+    leftovers = [n for n in os.listdir(path) if "tmp" in n]
+    assert leftovers == []

@@ -168,7 +168,7 @@ _ELASTIC_WORKER = textwrap.dedent("""
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
     from tdc_tpu.parallel.multihost import (
-        global_mesh, host_shard_bounds, initialize_from_env,
+        barrier, global_mesh, host_shard_bounds, initialize_from_env,
     )
     from tdc_tpu.models.streaming import streamed_kmeans_fit
 
@@ -206,6 +206,7 @@ _ELASTIC_WORKER = textwrap.dedent("""
     with open(os.path.join(outdir, f"iters_run_{pid}_a{attempt}"), "w") as f:
         f.write(str(res.n_iter_run))
     print("ELASTIC_OK", pid, "attempt", attempt, flush=True)
+    barrier()  # don't cancel the peer's shutdown
 """)
 
 
@@ -299,3 +300,36 @@ def test_maybe_beat_noop_without_env(tmp_path, monkeypatch):
 
     monkeypatch.delenv("TDC_HEARTBEAT_FILE", raising=False)
     heartbeat.maybe_beat(min_interval=0.0)  # must not raise
+
+
+def test_supervise_cli_end_to_end(tmp_path, capsys):
+    """The CLI wrapper: arg parsing, shared ckpt dir export, gang run."""
+    from tdc_tpu.cli.supervise import main
+
+    rc = main([
+        "--num_processes=2", "--max_restarts=0",
+        f"--ckpt_root={tmp_path / 'ck'}", f"--log_dir={tmp_path / 'logs'}",
+        "--", sys.executable, "-c",
+        "import os; assert os.environ['TDC_CKPT_DIR']; print('ok')",
+    ])
+    assert rc == 0
+    assert "completed in 1 attempt(s)" in capsys.readouterr().out
+
+
+def test_supervise_cli_failure_exit_code(tmp_path, capsys):
+    from tdc_tpu.cli.supervise import main
+
+    rc = main([
+        "--num_processes=1", "--max_restarts=0",
+        f"--log_dir={tmp_path / 'logs'}",
+        "--", sys.executable, "-c", "import sys; sys.exit(4)",
+    ])
+    assert rc == 1
+    assert "exited 4" in capsys.readouterr().err
+
+
+def test_supervise_cli_requires_command(tmp_path):
+    from tdc_tpu.cli.supervise import main
+
+    with pytest.raises(SystemExit):
+        main(["--num_processes=1", f"--log_dir={tmp_path}"])
