@@ -45,7 +45,7 @@ class KMeans:
         self.mesh = mesh
         self.kernel = kernel
 
-    def fit(self, X, y=None) -> "KMeans":
+    def fit(self, X, y=None, sample_weight=None) -> "KMeans":
         res = kmeans_fit(
             X,
             self.n_clusters,
@@ -56,6 +56,7 @@ class KMeans:
             spherical=self.spherical,
             mesh=self.mesh,
             kernel=self.kernel,
+            sample_weight=sample_weight,
         )
         self.cluster_centers_ = np.asarray(res.centroids)
         self.inertia_ = float(res.sse)
@@ -72,8 +73,8 @@ class KMeans:
             kmeans_predict(X, self.cluster_centers_, spherical=self.spherical)
         )
 
-    def fit_predict(self, X, y=None) -> np.ndarray:
-        return self.fit(X).labels_
+    def fit_predict(self, X, y=None, sample_weight=None) -> np.ndarray:
+        return self.fit(X, sample_weight=sample_weight).labels_
 
     def transform(self, X) -> np.ndarray:
         """Distances to each center (sklearn semantics)."""
@@ -109,7 +110,7 @@ class FuzzyCMeans:
         self.random_state = random_state
         self.mesh = mesh
 
-    def fit(self, X, y=None) -> "FuzzyCMeans":
+    def fit(self, X, y=None, sample_weight=None) -> "FuzzyCMeans":
         res = fuzzy_cmeans_fit(
             X,
             self.n_clusters,
@@ -119,6 +120,7 @@ class FuzzyCMeans:
             max_iters=self.max_iter,
             tol=self.tol,
             mesh=self.mesh,
+            sample_weight=sample_weight,
         )
         self.cluster_centers_ = np.asarray(res.centroids)
         self.objective_ = float(res.objective)
@@ -138,8 +140,8 @@ class FuzzyCMeans:
             fuzzy_predict(X, self.cluster_centers_, m=self.m, soft=True)
         )
 
-    def fit_predict(self, X, y=None) -> np.ndarray:
-        return self.fit(X).labels_
+    def fit_predict(self, X, y=None, sample_weight=None) -> np.ndarray:
+        return self.fit(X, sample_weight=sample_weight).labels_
 
     def _check_fitted(self):
         if not hasattr(self, "cluster_centers_"):

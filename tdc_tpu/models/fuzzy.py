@@ -68,8 +68,22 @@ def _fcm_loop(
     block_rows: int = 0,
     kernel: str = "xla",
     mesh: jax.sharding.Mesh | None = None,
+    w: jax.Array | None = None,
 ) -> FuzzyCMeansResult:
-    stats_fn = _fuzzy_stats_fn(kernel, m, block_rows, mesh)
+    if w is not None:
+        from tdc_tpu.ops.assign import (
+            fuzzy_stats_weighted,
+            fuzzy_stats_weighted_blocked,
+        )
+
+        if block_rows:
+            stats_fn = lambda xx, c: fuzzy_stats_weighted_blocked(
+                xx, c, w, m, block_rows
+            )
+        else:
+            stats_fn = lambda xx, c: fuzzy_stats_weighted(xx, c, w, m=m)
+    else:
+        stats_fn = _fuzzy_stats_fn(kernel, m, block_rows, mesh)
 
     def body(carry):
         c, _, i, _ = carry
@@ -110,15 +124,25 @@ def fuzzy_cmeans_fit(
     tol: float = 1e-4,
     mesh: jax.sharding.Mesh | None = None,
     kernel: str = "xla",
+    sample_weight=None,
 ) -> FuzzyCMeansResult:
     """Fit Fuzzy C-Means. `tol < 0` forces exactly max_iters iterations
     (reference parity). With `mesh`, points are sharded over the data axis and
     XLA all-reduces the MU^T X contraction over ICI. kernel='pallas' uses the
     fused single-pass VMEM kernel (no (N, K) membership matrix anywhere;
-    inside a shard_map tower + psum when mesh is given)."""
+    inside a shard_map tower + psum when mesh is given). `sample_weight`
+    ((N,) nonnegative) scales each point's u^m mass (sklearn parity; the
+    weighted path runs the f32 XLA stats)."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     x = jnp.asarray(x)
+    w = None
+    if sample_weight is not None:
+        w = jnp.asarray(sample_weight, jnp.float32)
+        if w.shape != (x.shape[0],):
+            raise ValueError(
+                f"sample_weight shape {w.shape} != ({x.shape[0]},)"
+            )
     if mesh is not None:
         n_dev = int(np.prod(mesh.devices.shape))
         if x.shape[0] % n_dev != 0:
@@ -126,18 +150,20 @@ def fuzzy_cmeans_fit(
                 f"N={x.shape[0]} not divisible by mesh size {n_dev}"
             )
         x = mesh_lib.shard_points(x, mesh)
-        c_init = resolve_init(x, k, init, key)
+        if w is not None:
+            w = mesh_lib.shard_points(w, mesh)
+        c_init = resolve_init(x, k, init, key, w)
         c_init = mesh_lib.replicate(c_init, mesh)
     else:
-        c_init = resolve_init(x, k, init, key)
+        c_init = resolve_init(x, k, init, key, w)
     block_rows = 0
-    if mesh is None and kernel == "xla":
+    if mesh is None and (kernel == "xla" or w is not None):
         from tdc_tpu.models.kmeans import auto_block_rows
 
         block_rows = auto_block_rows(x.shape[0], k)
     return _fcm_loop(
         x, c_init, int(max_iters), float(tol), float(m), block_rows, kernel,
-        mesh if kernel == "pallas" else None,
+        mesh if (kernel == "pallas" and w is None) else None, w,
     )
 
 

@@ -100,12 +100,26 @@ def _lloyd_loop(
     kernel: str = "xla",
     block_rows: int = 0,
     mesh: jax.sharding.Mesh | None = None,
+    w: jax.Array | None = None,
 ) -> KMeansResult:
     """One traced Lloyd loop. tol < 0 disables the convergence test (reference
     fixed-iteration parity mode). `mesh` is only consulted by the pallas
     kernel (explicit shard_map body); the xla path distributes via the input
-    sharding."""
-    stats_fn = _stats_fn(kernel, block_rows, mesh)
+    sharding. `w` (sample weights) routes to the weighted XLA stats."""
+    if w is not None:
+        from tdc_tpu.ops.assign import (
+            lloyd_stats_weighted,
+            lloyd_stats_weighted_blocked,
+        )
+
+        if block_rows:
+            stats_fn = lambda xx, c: lloyd_stats_weighted_blocked(
+                xx, c, w, block_rows
+            )
+        else:
+            stats_fn = lambda xx, c: lloyd_stats_weighted(xx, c, w)
+    else:
+        stats_fn = _stats_fn(kernel, block_rows, mesh)
 
     def body(carry):
         c, _, i, _ = carry
@@ -139,9 +153,15 @@ def _lloyd_loop(
 
 
 def resolve_init(
-    x: jax.Array, k: int, init, key: jax.Array | None
+    x: jax.Array, k: int, init, key: jax.Array | None, sample_weight=None
 ) -> jax.Array:
-    """Turn an init spec ('first_k' | 'random' | 'kmeans++' | array) into (K, d)."""
+    """Turn an init spec ('first_k' | 'random' | 'kmeans++' | array) into (K, d).
+
+    sample_weight (if given) biases the stochastic inits the way sklearn's
+    do: centers are drawn ∝ w (random / first k-means++ center) or ∝ w·D²
+    (k-means++ rounds, k-means‖ oversampling), so zero-weight points never
+    seed a cluster.
+    """
     if isinstance(init, (jnp.ndarray, np.ndarray)) or hasattr(init, "shape"):
         c = jnp.asarray(init, jnp.float32)
         if c.shape[0] != k:
@@ -152,13 +172,13 @@ def resolve_init(
     if key is None:
         key = jax.random.PRNGKey(0)
     if init == "random":
-        return init_random(key, x, k)
+        return init_random(key, x, k, sample_weight)
     if init in ("kmeans++", "k-means++"):
-        return init_kmeans_pp(key, x, k)
+        return init_kmeans_pp(key, x, k, sample_weight)
     if init in ("kmeans||", "k-means||", "kmeans_parallel"):
         from tdc_tpu.ops.kmeans_parallel import init_kmeans_parallel
 
-        return init_kmeans_parallel(key, x, k)
+        return init_kmeans_parallel(key, x, k, sample_weight=sample_weight)
     raise ValueError(f"unknown init: {init!r}")
 
 
@@ -173,6 +193,7 @@ def kmeans_fit(
     spherical: bool = False,
     mesh: jax.sharding.Mesh | None = None,
     kernel: str = "xla",
+    sample_weight=None,
 ) -> KMeansResult:
     """Fit K-Means.
 
@@ -180,6 +201,11 @@ def kmeans_fit(
       x: (N, d) points (numpy or jax). With `mesh`, sharded over the data
         axis; N must be divisible by the mesh size (raises ValueError
         otherwise — uneven N is handled by streamed_kmeans_fit).
+      sample_weight: optional (N,) nonnegative per-point weights (sklearn
+        `sample_weight` parity — absent from the reference). Weighted runs
+        use the f32 XLA stats path (a weighted fused kernel would round the
+        mass in bf16); with `mesh`, weights are sharded alongside the
+        points.
       k: number of clusters.
       init: 'kmeans++' (device k-means++), 'random', 'first_k' (reference
         parity), or an explicit (K, d) array.
@@ -197,9 +223,16 @@ def kmeans_fit(
         stats (parallel/collectives.distributed_lloyd_stats).
     """
     block_rows = 0
-    if mesh is None and kernel == "xla":
+    if mesh is None and (kernel == "xla" or sample_weight is not None):
         block_rows = auto_block_rows(int(np.asarray(x.shape[0])), k)
     x = jnp.asarray(x)
+    w = None
+    if sample_weight is not None:
+        w = jnp.asarray(sample_weight, jnp.float32)
+        if w.shape != (x.shape[0],):
+            raise ValueError(
+                f"sample_weight shape {w.shape} != ({x.shape[0]},)"
+            )
     if spherical:
         x = _normalize(x.astype(jnp.float32))
     if mesh is not None:
@@ -212,13 +245,16 @@ def kmeans_fit(
                 "truncate/pad the data or use streamed_kmeans_fit"
             )
         x = mesh_lib.shard_points(x, mesh)
-        c_init = resolve_init(x, k, init, key)
+        if w is not None:
+            w = mesh_lib.shard_points(w, mesh)
+        c_init = resolve_init(x, k, init, key, w)
         c_init = mesh_lib.replicate(c_init, mesh)
     else:
-        c_init = resolve_init(x, k, init, key)
+        c_init = resolve_init(x, k, init, key, w)
     return _lloyd_loop(
         x, c_init, int(max_iters), float(tol), bool(spherical), kernel,
-        block_rows, mesh if kernel == "pallas" else None,
+        block_rows, mesh if (kernel == "pallas" and w is None) else None,
+        w,
     )
 
 

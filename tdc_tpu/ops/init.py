@@ -22,33 +22,56 @@ def init_first_k(x: jax.Array, k: int) -> jax.Array:
     return x[:k].astype(jnp.float32)
 
 
-def init_random(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
-    """K distinct uniform-random points as seeds."""
-    idx = jax.random.choice(key, x.shape[0], shape=(k,), replace=False)
+def init_random(
+    key: jax.Array, x: jax.Array, k: int, sample_weight=None
+) -> jax.Array:
+    """K distinct random points as seeds — uniform, or ∝ sample_weight
+    (sklearn ≥1.3 semantics: weighted datasets seed from weighted draws, so a
+    zero-weight point can never become a center)."""
+    p = None
+    if sample_weight is not None:
+        w = jnp.asarray(sample_weight, jnp.float32)
+        p = w / jnp.sum(w)
+    idx = jax.random.choice(key, x.shape[0], shape=(k,), replace=False, p=p)
     return x[idx].astype(jnp.float32)
 
 
 @partial(jax.jit, static_argnames=("k",))
-def init_kmeans_pp(key: jax.Array, x: jax.Array, k: int) -> jax.Array:
+def init_kmeans_pp(
+    key: jax.Array, x: jax.Array, k: int, sample_weight=None
+) -> jax.Array:
     """Device-resident k-means++ (D² sampling), jit-able via lax.fori_loop.
 
     Replaces the reference's CPU sklearn seeding. O(K·N·d) total; each round
     updates a running min-squared-distance vector instead of recomputing all
-    pairwise distances, and samples the next center ~ D².
+    pairwise distances, and samples the next center ~ D² (~ w·D² when
+    sample_weight is given; the first center ~ uniform / ~ w). The unweighted
+    path is bit-identical to the pre-weighting implementation, so seeded
+    results are stable.
     """
     n = x.shape[0]
     xf = x.astype(jnp.float32)
+    w = (
+        None
+        if sample_weight is None
+        else jnp.asarray(sample_weight, jnp.float32)
+    )
     key, k0 = jax.random.split(key)
-    first = jax.random.randint(k0, (), 0, n)
+    if w is None:
+        first = jax.random.randint(k0, (), 0, n)
+    else:
+        lw0 = jnp.where(w > 0, jnp.log(w), -jnp.inf)
+        first = jnp.argmax(lw0 + jax.random.gumbel(k0, (n,)))
     centers = jnp.zeros((k, x.shape[1]), jnp.float32).at[0].set(xf[first])
     d2 = pairwise_sq_dist(xf, xf[first][None, :])[:, 0]  # (N,)
 
     def body(i, carry):
         centers, d2, key = carry
         key, ki = jax.random.split(key)
-        # Sample proportional to D²; gumbel-top-1 on log weights is
+        # Sample proportional to (w·)D²; gumbel-top-1 on log weights is
         # categorical sampling without building a cumulative sum.
-        logw = jnp.where(d2 > 0, jnp.log(d2), -jnp.inf)
+        wd2 = d2 if w is None else w * d2
+        logw = jnp.where(wd2 > 0, jnp.log(wd2), -jnp.inf)
         g = jax.random.gumbel(ki, (n,))
         nxt = jnp.argmax(logw + g)
         c = xf[nxt]

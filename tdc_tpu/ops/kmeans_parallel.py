@@ -30,21 +30,33 @@ def init_kmeans_parallel(
     *,
     rounds: int = 5,
     oversample: int | None = None,
+    sample_weight=None,
 ) -> jax.Array:
     """k-means‖ seeding: returns (K, d) f32 centers.
 
     Candidate pool is fixed-size (rounds*oversample + 1, padded with the first
     center) so shapes are static under jit. Default oversampling factor 2K per
-    round, the paper's recommendation.
+    round, the paper's recommendation. With sample_weight, sampling
+    probabilities use w·d² and candidates are weighted by the point MASS they
+    attract (zero-weight points never seed; unweighted path unchanged).
     """
     n, d = x.shape
     if oversample is None:
         oversample = 2 * k
     xf = x.astype(jnp.float32)
+    sw = (
+        None
+        if sample_weight is None
+        else jnp.asarray(sample_weight, jnp.float32)
+    )
     pool_size = rounds * oversample + 1
 
     key, k0 = jax.random.split(key)
-    first_idx = jax.random.randint(k0, (), 0, n)
+    if sw is None:
+        first_idx = jax.random.randint(k0, (), 0, n)
+    else:
+        lw0 = jnp.where(sw > 0, jnp.log(sw), -jnp.inf)
+        first_idx = jnp.argmax(lw0 + jax.random.gumbel(k0, (n,)))
     first = xf[first_idx]
 
     # Candidate pool and weights; slot 0 = first center.
@@ -55,9 +67,10 @@ def init_kmeans_parallel(
     def round_body(r, carry):
         pool, pool_valid, d2, key = carry
         key, kr = jax.random.split(key)
-        cost = jnp.sum(d2)
-        # Bernoulli per point: p = min(1, l * d² / cost).
-        p = jnp.minimum(oversample * d2 / jnp.maximum(cost, 1e-30), 1.0)
+        wd2 = d2 if sw is None else sw * d2
+        cost = jnp.sum(wd2)
+        # Bernoulli per point: p = min(1, l * (w·)d² / cost).
+        p = jnp.minimum(oversample * wd2 / jnp.maximum(cost, 1e-30), 1.0)
         u = jax.random.uniform(kr, (n,))
         chosen = u < p
         # Keep at most `oversample` chosen points deterministically: rank
@@ -84,7 +97,8 @@ def init_kmeans_parallel(
     cand_d2 = pairwise_sq_dist(xf, pool)  # (N, pool)
     cand_d2 = jnp.where(pool_valid[None, :], cand_d2, jnp.inf)
     owner = jnp.argmin(cand_d2, axis=1)  # (N,)
-    weights = jnp.zeros((pool_size,), jnp.float32).at[owner].add(1.0)
+    mass = jnp.ones((n,), jnp.float32) if sw is None else sw
+    weights = jnp.zeros((pool_size,), jnp.float32).at[owner].add(mass)
     weights = jnp.where(pool_valid, weights, 0.0)
     key, kf = jax.random.split(key)
     return _weighted_kmeans_pp(kf, pool, weights, k)

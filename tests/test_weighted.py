@@ -1,0 +1,207 @@
+"""Sample-weighted K-Means / Fuzzy C-Means (sklearn `sample_weight` parity —
+a capability absent from the reference, which weights every point equally)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.models import fuzzy_cmeans_fit, kmeans_fit
+from tdc_tpu.models.estimators import KMeans
+from tdc_tpu.ops.assign import (
+    lloyd_stats_weighted,
+    lloyd_stats_weighted_blocked,
+    fuzzy_stats_weighted,
+    fuzzy_stats_weighted_blocked,
+)
+from tdc_tpu.parallel import make_mesh
+
+
+def test_integer_weights_equal_duplication(blobs_small):
+    """w=2 must give exactly the fit of the row-duplicated dataset."""
+    x, _, centers = blobs_small
+    w = np.ones(len(x), np.float32)
+    w[: len(x) // 3] = 2.0
+    dup = np.concatenate([x, x[: len(x) // 3]])
+    a = kmeans_fit(x, 3, init=centers, max_iters=15, tol=-1.0,
+                   sample_weight=w)
+    b = kmeans_fit(dup, 3, init=centers, max_iters=15, tol=-1.0)
+    np.testing.assert_allclose(
+        np.asarray(a.centroids), np.asarray(b.centroids), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(float(a.sse), float(b.sse), rtol=1e-4)
+
+
+def test_matches_sklearn_sample_weight(blobs_small):
+    x, _, centers = blobs_small
+    rng = np.random.default_rng(3)
+    w = rng.uniform(0.1, 5.0, size=len(x)).astype(np.float32)
+    ours = kmeans_fit(x, 3, init=centers, max_iters=50, tol=1e-6,
+                      sample_weight=w)
+    from sklearn.cluster import KMeans as SkKMeans
+
+    sk = SkKMeans(n_clusters=3, init=centers, n_init=1, max_iter=50,
+                  tol=1e-8, algorithm="lloyd").fit(x, sample_weight=w)
+    # Same fixed point on well-separated blobs (order preserved by the
+    # identical init).
+    np.testing.assert_allclose(
+        np.asarray(ours.centroids), sk.cluster_centers_, rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(float(ours.sse), sk.inertia_, rtol=1e-4)
+
+
+def test_fractional_mass_below_one(blobs_small):
+    """A cluster whose total weight is < 1 must divide by its true mass (the
+    old max(counts, 1.0) guard would return the raw weighted sum)."""
+    x = np.array([[0.0, 0.0], [10.0, 10.0]], np.float32)
+    w = np.array([0.3, 1.0], np.float32)
+    res = kmeans_fit(x, 2, init=x, max_iters=3, tol=-1.0, sample_weight=w)
+    np.testing.assert_allclose(np.asarray(res.centroids), x, atol=1e-6)
+
+
+def test_mesh_weighted_matches_single_device(blobs_small):
+    x, _, centers = blobs_small
+    rng = np.random.default_rng(5)
+    w = rng.uniform(0.5, 2.0, size=len(x)).astype(np.float32)
+    single = kmeans_fit(x, 3, init=centers, max_iters=12, tol=-1.0,
+                        sample_weight=w)
+    mesh = make_mesh(8)
+    sharded = kmeans_fit(x, 3, init=centers, max_iters=12, tol=-1.0,
+                         sample_weight=w, mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(single.centroids), np.asarray(sharded.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_fuzzy_integer_weights_equal_duplication(blobs_small):
+    x, _, centers = blobs_small
+    w = np.ones(len(x), np.float32)
+    w[:100] = 3.0
+    dup = np.concatenate([x, x[:100], x[:100]])
+    a = fuzzy_cmeans_fit(x, 3, m=2.0, init=centers, max_iters=10, tol=-1.0,
+                         sample_weight=w)
+    b = fuzzy_cmeans_fit(dup, 3, m=2.0, init=centers, max_iters=10, tol=-1.0)
+    np.testing.assert_allclose(
+        np.asarray(a.centroids), np.asarray(b.centroids), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(float(a.objective), float(b.objective),
+                               rtol=1e-3)
+
+
+def test_weighted_blocked_matches_unblocked(rng):
+    x = jnp.asarray(rng.normal(size=(130, 4)).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=(7, 4)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(0.1, 2.0, size=130).astype(np.float32))
+    a = lloyd_stats_weighted(x, c, w)
+    b = lloyd_stats_weighted_blocked(x, c, w, block_rows=32)  # ragged tail
+    np.testing.assert_allclose(a.sums, b.sums, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(a.counts, b.counts, rtol=1e-5)
+    np.testing.assert_allclose(float(a.sse), float(b.sse), rtol=1e-5)
+    fa = fuzzy_stats_weighted(x, c, w, m=2.0)
+    fb = fuzzy_stats_weighted_blocked(x, c, w, 2.0, 32)
+    np.testing.assert_allclose(fa.weighted_sums, fb.weighted_sums,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(fa.weights, fb.weights, rtol=1e-5)
+    np.testing.assert_allclose(float(fa.objective), float(fb.objective),
+                               rtol=1e-5)
+
+
+def test_unweighted_equals_weight_one(blobs_small):
+    """sample_weight=1 must be bit-compatible in behavior with no weights
+    (same assignments every iteration -> same trajectory within f32 noise)."""
+    x, _, centers = blobs_small
+    plain = kmeans_fit(x, 3, init=centers, max_iters=10, tol=-1.0)
+    ones = kmeans_fit(x, 3, init=centers, max_iters=10, tol=-1.0,
+                      sample_weight=np.ones(len(x), np.float32))
+    np.testing.assert_allclose(
+        np.asarray(plain.centroids), np.asarray(ones.centroids),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_estimator_sample_weight(blobs_small):
+    x, _, centers = blobs_small
+    w = np.ones(len(x), np.float32)
+    w[:50] = 10.0
+    est = KMeans(n_clusters=3, init=centers, max_iter=20).fit(
+        x, sample_weight=w
+    )
+    assert est.cluster_centers_.shape == (3, 2)
+    assert est.labels_.shape == (len(x),)
+
+
+def test_sample_weight_shape_validated(blobs_small):
+    import pytest
+
+    x, _, centers = blobs_small
+    with pytest.raises(ValueError, match="sample_weight"):
+        kmeans_fit(x, 3, init=centers, sample_weight=np.ones(5))
+    with pytest.raises(ValueError, match="sample_weight"):
+        fuzzy_cmeans_fit(x, 3, init=centers, sample_weight=np.ones(5))
+
+
+def test_zero_weight_points_never_seed():
+    """sklearn ≥1.3 semantics: stochastic inits draw ∝ sample_weight, so a
+    zero-weight point can never become an initial center — across every
+    stochastic init family."""
+    from tdc_tpu.ops.init import init_kmeans_pp, init_random
+    from tdc_tpu.ops.kmeans_parallel import init_kmeans_parallel
+
+    rng = np.random.default_rng(0)
+    good = np.array([[0.0, 0.0], [20.0, 0.0], [0.0, 20.0]], np.float32)
+    outliers = rng.normal(1000.0, 1.0, size=(40, 2)).astype(np.float32)
+    x = np.concatenate([good, outliers])
+    w = np.zeros(len(x), np.float32)
+    w[:3] = 1.0  # only the three real points carry mass
+    for seed in range(5):
+        key = jax.random.PRNGKey(seed)
+        for fn in (
+            lambda: init_random(key, jnp.asarray(x), 3, w),
+            lambda: init_kmeans_pp(key, jnp.asarray(x), 3, jnp.asarray(w)),
+            lambda: init_kmeans_parallel(
+                key, jnp.asarray(x), 3, sample_weight=jnp.asarray(w)
+            ),
+        ):
+            centers = np.asarray(fn())
+            # every center must be one of the three weighted points
+            dists = np.linalg.norm(centers[:, None] - good[None], axis=-1)
+            assert dists.min(axis=1).max() < 1e-5, centers
+
+
+def test_weighted_init_through_fit():
+    """End-to-end: a weighted fit with init='kmeans++' seeds from the mass."""
+    rng = np.random.default_rng(1)
+    x = np.concatenate([
+        rng.normal(0.0, 0.5, size=(500, 2)),
+        rng.normal(10.0, 0.5, size=(500, 2)),
+        np.full((1, 2), 1e4),  # zero-weight outlier
+    ]).astype(np.float32)
+    w = np.ones(len(x), np.float32)
+    w[-1] = 0.0
+    res = kmeans_fit(x, 2, init="kmeans++", key=jax.random.PRNGKey(0),
+                     max_iters=30, tol=1e-5, sample_weight=w)
+    c = np.asarray(res.centroids)
+    # Neither center is stuck on the outlier (which a weight-blind init could
+    # pick and weighted Lloyd could then never move).
+    assert np.linalg.norm(c - 1e4, axis=-1).min() > 100
+
+
+def test_unweighted_inits_unchanged():
+    """The unweighted paths must be bit-identical to before the weighting
+    feature (seeded golden stability)."""
+    from tdc_tpu.ops.init import init_kmeans_pp
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(200, 3)).astype(np.float32))
+    a = np.asarray(init_kmeans_pp(jax.random.PRNGKey(7), x, 4))
+    b = np.asarray(init_kmeans_pp(jax.random.PRNGKey(7), x, 4, None))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_tiny_cluster_mass_divides_exactly():
+    """Mass ~1e-20 in a cluster must divide by the true mass, not a floor
+    (regression: max(counts, eps) scaled centroids toward the origin)."""
+    x = np.array([[3.0, 4.0], [100.0, 100.0]], np.float32)
+    w = np.array([1e-20, 1.0], np.float32)
+    res = kmeans_fit(x, 2, init=x, max_iters=2, tol=-1.0, sample_weight=w)
+    np.testing.assert_allclose(np.asarray(res.centroids), x, rtol=1e-5)
