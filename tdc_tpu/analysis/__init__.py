@@ -1,1 +1,14 @@
-"""Results compilation and profile-trace parsing (reference L6)."""
+"""Results compilation, profile-trace parsing, and clustering quality
+metrics (reference L6)."""
+
+from tdc_tpu.analysis.metrics import (
+    calinski_harabasz_score,
+    davies_bouldin_score,
+    silhouette_score,
+)
+
+__all__ = [
+    "calinski_harabasz_score",
+    "davies_bouldin_score",
+    "silhouette_score",
+]

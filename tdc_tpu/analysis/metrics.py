@@ -1,0 +1,144 @@
+"""Clustering quality metrics, device-resident and blockwise.
+
+The reference validated clusterings by eyeballing scatter plots and an
+external-oracle center comparison (SURVEY.md §4) — it computed no quality
+metric at all beyond the SSE it commented out "for performance". This module
+provides the standard internal metrics, shaped for TPU:
+
+- silhouette_score: the O(N²) pairwise work is done in N-blocks, and the
+  per-cluster mean distances come from a (B, N) × (N, K) one-hot matmul per
+  block — the MXU does the reduction, and no N×N matrix ever exists.
+- davies_bouldin_score / calinski_harabasz_score: O(N·K) from one pass of
+  per-cluster sufficient statistics.
+
+All match sklearn.metrics (tests/test_metrics.py) to f32 tolerance.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tdc_tpu.ops.distance import pairwise_sq_dist
+
+
+@partial(jax.jit, static_argnames=("k", "block_rows"))
+def _silhouette_device(x, labels, k: int, block_rows: int):
+    from tdc_tpu.ops.assign import _pad_rows
+
+    n, d = x.shape
+    one_hot = jax.nn.one_hot(labels, k, dtype=jnp.float32)  # (N, K)
+    counts = jnp.sum(one_hot, axis=0)  # (K,)
+
+    xp, _ = _pad_rows(x, block_rows)
+    lp, _ = _pad_rows(labels, block_rows)
+    xb = xp.reshape(-1, block_rows, d)
+    lb = lp.reshape(-1, block_rows)
+
+    def block_sums(args):
+        blk, blab = args
+        # (B, N) true distances to every point, then per-cluster sums on the
+        # MXU; the (B, N) tile is the only large intermediate.
+        dist = jnp.sqrt(jnp.maximum(pairwise_sq_dist(blk, x), 0.0))
+        s = dist @ one_hot  # (B, K) sum of distances to each cluster
+        own = jnp.take_along_axis(s, blab[:, None], axis=1)[:, 0]
+        own_count = counts[blab]
+        # a(i): mean distance to OWN cluster, excluding self (dist 0).
+        a = own / jnp.maximum(own_count - 1.0, 1.0)
+        # b(i): min over OTHER clusters of mean distance.
+        mean_other = s / jnp.maximum(counts[None, :], 1.0)
+        mean_other = jnp.where(
+            jax.nn.one_hot(blab, k, dtype=bool), jnp.inf, mean_other
+        )
+        mean_other = jnp.where(counts[None, :] > 0, mean_other, jnp.inf)
+        b = jnp.min(mean_other, axis=1)
+        s_i = jnp.where(
+            own_count > 1.0,
+            (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30),
+            0.0,  # sklearn: singleton clusters contribute 0
+        )
+        return s_i
+
+    s_blocks = jax.lax.map(block_sums, (xb, lb))  # (n_blocks, B)
+    s_flat = s_blocks.reshape(-1)[:n]
+    return jnp.mean(s_flat)
+
+
+def _encode_labels(labels) -> tuple[jax.Array, int]:
+    """Contiguous 0..k-1 label encoding (sklearn does the same before
+    scoring): un-used label ids must not create phantom empty clusters."""
+    uniq, enc = np.unique(np.asarray(labels), return_inverse=True)
+    return jnp.asarray(enc, jnp.int32), len(uniq)
+
+
+def silhouette_score(x, labels, *, block_rows: int = 4096) -> float:
+    """Mean silhouette coefficient (sklearn.metrics.silhouette_score parity,
+    Euclidean). Blockwise: peak memory is (block_rows, N) f32."""
+    x = jnp.asarray(x, jnp.float32)
+    labels, k = _encode_labels(labels)
+    if k < 2:
+        raise ValueError("silhouette requires at least 2 clusters")
+    block_rows = min(block_rows, x.shape[0])
+    return float(_silhouette_device(x, labels, k, block_rows))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _cluster_moments(x, labels, k: int):
+    """(counts, centroids, within-dispersion per cluster Σ‖x−c‖²,
+    mean-dist-to-centroid per cluster)."""
+    one_hot = jax.nn.one_hot(labels, k, dtype=jnp.float32)
+    counts = jnp.sum(one_hot, axis=0)
+    sums = one_hot.T @ x.astype(jnp.float32)
+    centroids = sums / jnp.maximum(counts[:, None], 1.0)
+    d2 = pairwise_sq_dist(x, centroids)  # (N, K)
+    own_d2 = jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
+    within = jnp.zeros((k,), jnp.float32).at[labels].add(own_d2)
+    mean_dist = (
+        jnp.zeros((k,), jnp.float32).at[labels].add(jnp.sqrt(
+            jnp.maximum(own_d2, 0.0)))
+        / jnp.maximum(counts, 1.0)
+    )
+    return counts, centroids, within, mean_dist
+
+
+def davies_bouldin_score(x, labels) -> float:
+    """sklearn.metrics.davies_bouldin_score parity: mean over clusters of the
+    worst (S_i + S_j) / ‖c_i − c_j‖ ratio."""
+    x = jnp.asarray(x, jnp.float32)
+    labels, k = _encode_labels(labels)
+    if k < 2:
+        raise ValueError("davies_bouldin requires at least 2 clusters")
+    counts, centroids, _, s = _cluster_moments(x, labels, k)
+    m = jnp.sqrt(jnp.maximum(pairwise_sq_dist(centroids, centroids), 0.0))
+    ratio = (s[:, None] + s[None, :]) / jnp.where(m > 0, m, jnp.inf)
+    ratio = jnp.where(jnp.eye(k, dtype=bool), -jnp.inf, ratio)
+    return float(jnp.mean(jnp.max(ratio, axis=1)))
+
+
+def calinski_harabasz_score(x, labels) -> float:
+    """sklearn.metrics.calinski_harabasz_score parity:
+    (between / (k−1)) / (within / (n−k))."""
+    x = jnp.asarray(x, jnp.float32)
+    labels, k = _encode_labels(labels)
+    n = x.shape[0]
+    if k < 2:
+        raise ValueError("calinski_harabasz requires at least 2 clusters")
+    counts, centroids, within, _ = _cluster_moments(x, labels, k)
+    grand = jnp.mean(x.astype(jnp.float32), axis=0)
+    between = jnp.sum(
+        counts * jnp.sum((centroids - grand[None, :]) ** 2, axis=1)
+    )
+    w = float(jnp.sum(within))
+    if w == 0.0:
+        return 1.0  # sklearn sentinel: every point on its cluster mean
+    return float(between) * (n - k) / (w * max(k - 1, 1))
+
+
+__all__ = [
+    "silhouette_score",
+    "davies_bouldin_score",
+    "calinski_harabasz_score",
+]

@@ -143,6 +143,12 @@ def fuzzy_cmeans_fit(
             raise ValueError(
                 f"sample_weight shape {w.shape} != ({x.shape[0]},)"
             )
+        n_pos = int((np.asarray(sample_weight) > 0).sum())
+        if n_pos < k:
+            raise ValueError(
+                f"sample_weight has only {n_pos} positive entries; "
+                f"need at least K={k}"
+            )
     if mesh is not None:
         n_dev = int(np.prod(mesh.devices.shape))
         if x.shape[0] % n_dev != 0:

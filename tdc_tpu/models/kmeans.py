@@ -233,6 +233,15 @@ def kmeans_fit(
             raise ValueError(
                 f"sample_weight shape {w.shape} != ({x.shape[0]},)"
             )
+        n_pos = int((np.asarray(sample_weight) > 0).sum())
+        if n_pos < k:
+            # sklearn raises too: the weighted inits can only draw from
+            # positive-mass points, and fewer than K of them cannot seed K
+            # distinct clusters.
+            raise ValueError(
+                f"sample_weight has only {n_pos} positive entries; "
+                f"need at least K={k}"
+            )
     if spherical:
         x = _normalize(x.astype(jnp.float32))
     if mesh is not None:

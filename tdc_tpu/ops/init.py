@@ -30,6 +30,14 @@ def init_random(
     zero-weight point can never become a center)."""
     p = None
     if sample_weight is not None:
+        import numpy as np
+
+        if int((np.asarray(sample_weight) > 0).sum()) < k:
+            # jax.random.choice silently falls through to zero-p entries
+            # once positive mass is exhausted; fail loudly like sklearn.
+            raise ValueError(
+                f"fewer than k={k} points carry positive sample_weight"
+            )
         w = jnp.asarray(sample_weight, jnp.float32)
         p = w / jnp.sum(w)
     idx = jax.random.choice(key, x.shape[0], shape=(k,), replace=False, p=p)

@@ -1,0 +1,126 @@
+"""Clustering quality metrics vs sklearn oracles (the reference had no
+quality metric at all — validation was visual, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from tdc_tpu.analysis.metrics import (
+    calinski_harabasz_score,
+    davies_bouldin_score,
+    silhouette_score,
+)
+
+
+@pytest.fixture(scope="module")
+def labeled_blobs():
+    rng = np.random.default_rng(0)
+    centers = np.array([[0, 0], [8, 0], [0, 8], [8, 8]], np.float32)
+    x = np.concatenate(
+        [rng.normal(c, 1.0, size=(120, 2)).astype(np.float32)
+         for c in centers]
+    )
+    labels = np.repeat(np.arange(4), 120).astype(np.int32)
+    perm = rng.permutation(len(x))
+    return x[perm], labels[perm]
+
+
+def test_silhouette_matches_sklearn(labeled_blobs):
+    x, labels = labeled_blobs
+    from sklearn.metrics import silhouette_score as sk
+
+    ours = silhouette_score(x, labels)
+    np.testing.assert_allclose(ours, sk(x, labels), rtol=1e-4)
+
+
+def test_silhouette_blocked_matches_unblocked(labeled_blobs):
+    x, labels = labeled_blobs
+    a = silhouette_score(x, labels, block_rows=64)  # ragged: 480 % 64 != 0
+    b = silhouette_score(x, labels, block_rows=480)
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_silhouette_noisy_labels(labeled_blobs):
+    """Random labels must score near 0, true labels well above."""
+    x, labels = labeled_blobs
+    rng = np.random.default_rng(1)
+    bad = rng.integers(0, 4, size=len(x)).astype(np.int32)
+    assert silhouette_score(x, labels) > 0.5
+    assert abs(silhouette_score(x, bad)) < 0.1
+
+
+def test_davies_bouldin_matches_sklearn(labeled_blobs):
+    x, labels = labeled_blobs
+    from sklearn.metrics import davies_bouldin_score as sk
+
+    np.testing.assert_allclose(
+        davies_bouldin_score(x, labels), sk(x, labels), rtol=1e-4
+    )
+
+
+def test_calinski_harabasz_matches_sklearn(labeled_blobs):
+    x, labels = labeled_blobs
+    from sklearn.metrics import calinski_harabasz_score as sk
+
+    np.testing.assert_allclose(
+        calinski_harabasz_score(x, labels), sk(x, labels), rtol=1e-3
+    )
+
+
+def test_singleton_cluster_contributes_zero():
+    """sklearn semantics: a singleton cluster's points score 0."""
+    x = np.array([[0.0, 0.0], [0.1, 0.0], [10.0, 10.0]], np.float32)
+    labels = np.array([0, 0, 1], np.int32)
+    from sklearn.metrics import silhouette_score as sk
+
+    np.testing.assert_allclose(
+        silhouette_score(x, labels), sk(x, labels), rtol=1e-4
+    )
+
+
+def test_k_validation():
+    x = np.zeros((4, 2), np.float32)
+    with pytest.raises(ValueError):
+        silhouette_score(x, np.zeros(4, np.int32))
+    with pytest.raises(ValueError):
+        davies_bouldin_score(x, np.zeros(4, np.int32))
+    with pytest.raises(ValueError):
+        calinski_harabasz_score(x, np.zeros(4, np.int32))
+
+
+def test_end_to_end_with_fit(blobs_small):
+    """Metrics consume a real fit's labels (the workflow the reference did
+    with scatter plots)."""
+    from tdc_tpu.models import kmeans_fit, kmeans_predict
+
+    x, _, centers = blobs_small
+    res = kmeans_fit(x, 3, init=centers, max_iters=30, tol=1e-5)
+    labels = np.asarray(kmeans_predict(x, res.centroids))
+    assert silhouette_score(x, labels) > 0.5
+    assert davies_bouldin_score(x, labels) < 1.0
+    assert calinski_harabasz_score(x, labels) > 500
+
+
+def test_non_contiguous_labels_match_sklearn(labeled_blobs):
+    """Unused label ids (empty cluster after a fit) must not create phantom
+    origin clusters — sklearn label-encodes first, so do we."""
+    x, labels = labeled_blobs
+    gapped = np.where(labels >= 2, labels + 3, labels)  # ids {0,1,5,6}
+    from sklearn.metrics import (
+        calinski_harabasz_score as sk_ch,
+        davies_bouldin_score as sk_db,
+        silhouette_score as sk_s,
+    )
+
+    np.testing.assert_allclose(
+        davies_bouldin_score(x, gapped), sk_db(x, gapped), rtol=1e-4)
+    np.testing.assert_allclose(
+        calinski_harabasz_score(x, gapped), sk_ch(x, gapped), rtol=1e-3)
+    np.testing.assert_allclose(
+        silhouette_score(x, gapped), sk_s(x, gapped), rtol=1e-4)
+
+
+def test_calinski_zero_within_dispersion():
+    """Every point exactly on its cluster mean: sklearn sentinel 1.0."""
+    x = np.array([[0.0, 0.0], [0.0, 0.0], [5.0, 5.0], [5.0, 5.0]], np.float32)
+    labels = np.array([0, 0, 1, 1], np.int32)
+    assert calinski_harabasz_score(x, labels) == 1.0

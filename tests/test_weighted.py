@@ -51,8 +51,10 @@ def test_matches_sklearn_sample_weight(blobs_small):
 
 def test_fractional_mass_below_one(blobs_small):
     """A cluster whose total weight is < 1 must divide by its true mass (the
-    old max(counts, 1.0) guard would return the raw weighted sum)."""
-    x = np.array([[0.0, 0.0], [10.0, 10.0]], np.float32)
+    old max(counts, 1.0) guard would return the raw weighted sum 0.3·x)."""
+    # The low-mass point sits OFF the origin so the floored division is
+    # distinguishable from the correct one.
+    x = np.array([[3.0, 4.0], [10.0, 10.0]], np.float32)
     w = np.array([0.3, 1.0], np.float32)
     res = kmeans_fit(x, 2, init=x, max_iters=3, tol=-1.0, sample_weight=w)
     np.testing.assert_allclose(np.asarray(res.centroids), x, atol=1e-6)
@@ -205,3 +207,20 @@ def test_tiny_cluster_mass_divides_exactly():
     w = np.array([1e-20, 1.0], np.float32)
     res = kmeans_fit(x, 2, init=x, max_iters=2, tol=-1.0, sample_weight=w)
     np.testing.assert_allclose(np.asarray(res.centroids), x, rtol=1e-5)
+
+
+def test_fewer_positive_weights_than_k_raises():
+    """sklearn parity: k centers cannot be drawn from fewer than k
+    positive-mass points."""
+    import pytest
+
+    from tdc_tpu.ops.init import init_random
+
+    x = np.array([[0, 0], [1, 1], [50, 50], [60, 60]], np.float32)
+    w = np.array([1.0, 1.0, 0.0, 0.0], np.float32)
+    with pytest.raises(ValueError, match="positive"):
+        kmeans_fit(x, 3, init="kmeans++", sample_weight=w)
+    with pytest.raises(ValueError, match="positive"):
+        fuzzy_cmeans_fit(x, 3, init="kmeans++", sample_weight=w)
+    with pytest.raises(ValueError, match="positive"):
+        init_random(jax.random.PRNGKey(0), jnp.asarray(x), 3, w)
