@@ -132,6 +132,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persistent XLA compilation cache ('' disables)")
     p.add_argument("--history_file", type=str, default=None,
                    help="write per-iteration (sse, shift) CSV (streamed mode)")
+    p.add_argument("--weight_file", type=str, default=None,
+                   help=".npy of (N,) nonnegative per-point sample weights "
+                        "(sklearn sample_weight parity; in-memory fits only)")
+    p.add_argument("--metrics", action="store_true",
+                   help="after the fit, score the clustering (silhouette / "
+                        "Davies-Bouldin / Calinski-Harabasz; the reference "
+                        "validated visually only) and print + run-log them")
+    p.add_argument("--metrics_sample", type=int, default=10000,
+                   help="subsample size for the O(N²) silhouette "
+                        "(0 = use all points)")
     return p
 
 
@@ -157,6 +167,15 @@ def validate_args(parser, args):
             parser.error("--minibatch and --shard_k are mutually exclusive")
     if args.minibatch and args.method_name != "distributedKMeans":
         parser.error("--minibatch supports distributedKMeans only")
+    if args.metrics_sample < 0:
+        parser.error("--metrics_sample must be >= 0")
+    if args.weight_file:
+        if not os.path.exists(args.weight_file):
+            parser.error(f"weight file does not exist: {args.weight_file}")
+        if (args.streamed or args.num_batches > 1 or args.minibatch
+                or args.mean_combine or args.shard_k > 1):
+            parser.error("--weight_file supports in-memory fits only "
+                         "(weighted streaming is not implemented)")
     if args.mean_combine:
         if args.method_name != "distributedKMeans":
             parser.error("--mean_combine supports distributedKMeans only")
@@ -242,6 +261,14 @@ def run_experiment(args) -> dict:
             x, _ = make_blobs(args.seed + 1, n_obs, n_dim, max(args.K, 2),
                               class_sep=args.class_sep, to_host=needs_host,
                               dtype=gen_dtype)
+        weights = None
+        if args.weight_file:
+            weights = np.load(args.weight_file)
+            if weights.ndim != 1 or weights.shape[0] != n_obs:
+                raise ValueError(
+                    f"weight file has shape {weights.shape}; expected "
+                    f"({n_obs},)"
+                )
         mesh2d = None
         if args.shard_k > 1:
             if n_devices % args.shard_k != 0:
@@ -270,6 +297,14 @@ def run_experiment(args) -> dict:
         import jax.numpy as jnp
 
         streamed = args.streamed or num_batches > 1
+        if weights is not None and streamed:
+            # Only reachable via the OOM fallback (validate_args blocks the
+            # explicit flags): weighted streaming isn't implemented.
+            raise ValueError(
+                "dataset fell back to streamed batching but --weight_file "
+                "requires the in-memory fit; reduce the dataset or drop "
+                "the weights"
+            )
         # bf16 applies to the in-memory device paths; streamed batches keep
         # their on-disk dtype (stats accumulate in f32 either way).
         xx = (
@@ -338,7 +373,7 @@ def run_experiment(args) -> dict:
             return fuzzy_cmeans_fit(
                 xx, args.K, m=args.fuzzifier, init=args.init, key=key,
                 max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
-                kernel=args.kernel,
+                kernel=args.kernel, sample_weight=weights,
             )
         if streamed:
             rows = -(-n_obs // num_batches)
@@ -362,7 +397,7 @@ def run_experiment(args) -> dict:
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,
             tol=args.tol, spherical=args.spherical, mesh=mesh,
-            kernel=args.kernel,
+            kernel=args.kernel, sample_weight=weights,
         )
 
     if args.profile_dir:
@@ -413,6 +448,14 @@ def run_experiment(args) -> dict:
             for i, (cost_i, shift_i) in enumerate(np.asarray(result.history), 1):
                 w.writerow([i, cost_i, shift_i])
 
+    metrics = None
+    if args.metrics:
+        try:
+            metrics = _score_clustering(args, x, result, n_obs)
+        except Exception as e:  # scoring must not discard a completed fit
+            print(f"note: metrics scoring failed ({type(e).__name__}: {e}); "
+                  "fit result reported without metrics", file=sys.stderr)
+
     n_iter = int(result.n_iter)
     # Throughput from iterations THIS process executed (differs from n_iter
     # when resuming a checkpoint — a resume with nothing left to do reports 0,
@@ -441,7 +484,61 @@ def run_experiment(args) -> dict:
         "converged": bool(result.converged),
         "num_batches": num_batches,
         "status": "ok",
+        "_metrics": metrics,
     }
+
+
+def _score_clustering(args, x, result, n_obs: int) -> dict:
+    """Internal quality metrics on the fitted labels. Silhouette is O(N²), so
+    it scores a seeded subsample (--metrics_sample, sklearn's sample_size
+    approach); DB/CH score the same subsample for consistency."""
+    import jax.numpy as jnp
+
+    from tdc_tpu.analysis.metrics import (
+        calinski_harabasz_score,
+        davies_bouldin_score,
+        silhouette_score,
+    )
+    from tdc_tpu.models import kmeans_predict
+
+    sample = args.metrics_sample
+    if sample and n_obs > sample:
+        idx = np.sort(
+            np.random.default_rng(args.seed).choice(n_obs, sample,
+                                                    replace=False)
+        )
+        # Device-resident x: gather on device, transfer only the sample.
+        xs = (x[idx] if isinstance(x, np.ndarray)
+              else np.asarray(jnp.asarray(x)[jnp.asarray(idx)]))
+    else:
+        xs = np.asarray(x)
+    xs = xs.astype(np.float32)
+    if args.spherical:
+        # Score in the space the fit/predict operate in: cosine K-Means
+        # assigns on L2-normalized points, so Euclidean metrics on raw norms
+        # would mix metric spaces.
+        xs = xs / np.maximum(
+            np.linalg.norm(xs, axis=-1, keepdims=True), 1e-12
+        )
+    if args.method_name == "distributedFuzzyCMeans":
+        from tdc_tpu.models.fuzzy import fuzzy_predict
+
+        labels = np.asarray(
+            fuzzy_predict(xs, result.centroids, m=args.fuzzifier)
+        )
+    else:
+        labels = np.asarray(
+            kmeans_predict(xs, result.centroids, spherical=args.spherical)
+        )
+    out = {"n_scored": int(len(xs))}
+    if len(np.unique(labels)) < 2:
+        nan = float("nan")
+        out.update(silhouette=nan, davies_bouldin=nan, calinski_harabasz=nan)
+        return out
+    out["silhouette"] = round(silhouette_score(xs, labels), 6)
+    out["davies_bouldin"] = round(davies_bouldin_score(xs, labels), 6)
+    out["calinski_harabasz"] = round(calinski_harabasz_score(xs, labels), 3)
+    return out
 
 
 def main(argv=None) -> int:
@@ -474,6 +571,7 @@ def main(argv=None) -> int:
         runlog.event("run_error", error=type(e).__name__, message=str(e)[:500])
         print(f"FAILED: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
+    metrics = row.pop("_metrics", None)
     if args.log_file:
         append_result_row(args.log_file, row)
     runlog.event("run_ok", **{k: row[k] for k in
@@ -485,6 +583,14 @@ def main(argv=None) -> int:
         f"computation_time={row['computation_time']}s "
         f"({row['points_per_sec_per_chip']:.3g} pt·iter/s/chip)"
     )
+    if metrics is not None:
+        runlog.event("metrics", **metrics)
+        print(
+            f"metrics (n={metrics['n_scored']}): "
+            f"silhouette={metrics['silhouette']:.4f} "
+            f"davies_bouldin={metrics['davies_bouldin']:.4f} "
+            f"calinski_harabasz={metrics['calinski_harabasz']:.4g}"
+        )
     return 0
 
 

@@ -322,3 +322,96 @@ def test_parse_trace_file_splits_device_and_host(tmp_path):
     written = compile_traces(str(tmp_path), out)
     names = sorted(f.split("/")[-1] for f in written)
     assert names == ["API_calls_t.csv", "profiling_result_t.csv"]
+
+
+def test_cli_metrics_flag(tmp_path, capsys):
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=3000 --n_dim=4 --K=3 --n_max_iters=30 --seed=1 "
+        f"--log_file={log} --n_GPUs=1 --metrics --metrics_sample=1000".split()
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "silhouette=" in out and "davies_bouldin=" in out
+    # well-separated synthetic blobs score a high silhouette
+    sil = float(out.split("silhouette=")[1].split()[0])
+    assert sil > 0.3
+    # the private metrics payload never leaks into the CSV
+    header = open(log).readline()
+    assert "_metrics" not in header
+
+
+def test_cli_weight_file(tmp_path, capsys):
+    import numpy as np
+
+    log = str(tmp_path / "log.csv")
+    wf = str(tmp_path / "w.npy")
+    np.save(wf, np.ones(3000, np.float32))
+    rc = cli_main(
+        f"--n_obs=3000 --n_dim=4 --K=3 --n_max_iters=20 --seed=1 "
+        f"--log_file={log} --n_GPUs=1 --weight_file={wf}".split()
+    )
+    assert rc == 0
+    rows = list(csv.DictReader(open(log)))
+    assert rows[0]["status"] == "ok"
+
+
+def test_cli_weight_file_rejects_streamed(tmp_path):
+    import numpy as np
+    import pytest
+
+    wf = str(tmp_path / "w.npy")
+    np.save(wf, np.ones(100, np.float32))
+    with pytest.raises(SystemExit):
+        cli_main(
+            f"--n_obs=100 --n_dim=2 --K=2 --num_batches=2 "
+            f"--weight_file={wf}".split()
+        )
+
+
+def test_cli_weight_file_wrong_length_is_error_row(tmp_path):
+    import numpy as np
+
+    log = str(tmp_path / "log.csv")
+    wf = str(tmp_path / "w.npy")
+    np.save(wf, np.ones(7, np.float32))
+    rc = cli_main(
+        f"--n_obs=100 --n_dim=2 --K=2 --log_file={log} "
+        f"--weight_file={wf}".split()
+    )
+    assert rc == 1  # captured as an error row, reference semantics
+    rows = list(csv.DictReader(open(log)))
+    assert rows[0]["status"] != "ok"
+
+
+def test_cli_metrics_sample_validated():
+    import pytest
+
+    with pytest.raises(SystemExit):
+        cli_main("--n_obs=100 --n_dim=2 --K=2 --metrics "
+                 "--metrics_sample=-1".split())
+
+
+def test_cli_spherical_metrics_normalized_space(tmp_path, capsys):
+    """Cosine clusters with wildly varying norms must still score well —
+    metrics run in the normalized space the fit assigns in."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    dirs = np.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]], np.float32)
+    pts = []
+    for d in dirs:
+        u = rng.normal(d, 0.05, size=(500, 3)).astype(np.float32)
+        u /= np.linalg.norm(u, axis=1, keepdims=True)
+        pts.append(u * rng.uniform(0.1, 100.0, size=(500, 1)))  # norm spread
+    x = np.concatenate(pts).astype(np.float32)
+    df = str(tmp_path / "x.npy")
+    np.save(df, x)
+    rc = cli_main(
+        f"--data_file={df} --K=2 --n_max_iters=30 --seed=0 --spherical "
+        f"--metrics --metrics_sample=0".split()
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    sil = float(out.split("silhouette=")[1].split()[0])
+    assert sil > 0.5  # raw-space scoring would be ~0 under the norm spread
