@@ -8,7 +8,14 @@ from tdc_tpu.models.streaming import (
     streamed_fuzzy_fit,
     streamed_kmeans_fit,
 )
-from tdc_tpu.models.estimators import KMeans, FuzzyCMeans
+from tdc_tpu.models.estimators import KMeans, FuzzyCMeans, GaussianMixture
+from tdc_tpu.models.gmm import (
+    GMMResult,
+    gmm_fit,
+    gmm_predict,
+    gmm_predict_proba,
+    gmm_score,
+)
 
 __all__ = [
     "KMeansResult",
@@ -24,4 +31,10 @@ __all__ = [
     "streamed_fuzzy_fit",
     "KMeans",
     "FuzzyCMeans",
+    "GaussianMixture",
+    "GMMResult",
+    "gmm_fit",
+    "gmm_predict",
+    "gmm_predict_proba",
+    "gmm_score",
 ]

@@ -35,6 +35,7 @@ class KMeans:
         spherical: bool = False,
         mesh=None,
         kernel: str = "xla",
+        n_init: int = 1,
     ):
         self.n_clusters = n_clusters
         self.init = init
@@ -44,6 +45,7 @@ class KMeans:
         self.spherical = spherical
         self.mesh = mesh
         self.kernel = kernel
+        self.n_init = n_init
 
     def fit(self, X, y=None, sample_weight=None) -> "KMeans":
         res = kmeans_fit(
@@ -57,6 +59,7 @@ class KMeans:
             mesh=self.mesh,
             kernel=self.kernel,
             sample_weight=sample_weight,
+            n_init=self.n_init,
         )
         self.cluster_centers_ = np.asarray(res.centroids)
         self.inertia_ = float(res.sse)
@@ -145,4 +148,77 @@ class FuzzyCMeans:
 
     def _check_fitted(self):
         if not hasattr(self, "cluster_centers_"):
+            raise AttributeError("estimator is not fitted; call fit(X) first")
+
+
+class GaussianMixture:
+    """Diagonal-covariance GMM estimator (sklearn.mixture facade over
+    models/gmm.py — soft clustering beyond the reference's fuzzy C-Means)."""
+
+    def __init__(
+        self,
+        n_components: int = 1,
+        *,
+        init="kmeans",
+        max_iter: int = 100,
+        tol: float = 1e-4,
+        reg_covar: float = 1e-6,
+        random_state: int = 0,
+        mesh=None,
+    ):
+        self.n_components = n_components
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.reg_covar = reg_covar
+        self.random_state = random_state
+        self.mesh = mesh
+
+    def fit(self, X, y=None) -> "GaussianMixture":
+        from tdc_tpu.models.gmm import gmm_fit
+
+        res = gmm_fit(
+            X,
+            self.n_components,
+            init=self.init,
+            key=jax.random.PRNGKey(self.random_state),
+            max_iters=self.max_iter,
+            tol=self.tol,
+            reg_covar=self.reg_covar,
+            mesh=self.mesh,
+        )
+        self._result = res
+        self.means_ = np.asarray(res.means)
+        self.covariances_ = np.asarray(res.variances)
+        self.weights_ = np.asarray(res.weights)
+        self.n_iter_ = int(res.n_iter)
+        self.converged_ = bool(res.converged)
+        self.lower_bound_ = float(res.log_likelihood)
+        # No labels_ on fit (sklearn parity): labels cost a full extra
+        # E-step pass over X; fit_predict/predict compute them on demand.
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        from tdc_tpu.models.gmm import gmm_predict
+
+        self._check_fitted()
+        return np.asarray(gmm_predict(X, self._result))
+
+    def predict_proba(self, X) -> np.ndarray:
+        from tdc_tpu.models.gmm import gmm_predict_proba
+
+        self._check_fitted()
+        return np.asarray(gmm_predict_proba(X, self._result))
+
+    def score(self, X, y=None) -> float:
+        from tdc_tpu.models.gmm import gmm_score
+
+        self._check_fitted()
+        return gmm_score(X, self._result)
+
+    def fit_predict(self, X, y=None) -> np.ndarray:
+        return self.fit(X).predict(X)
+
+    def _check_fitted(self):
+        if not hasattr(self, "_result"):
             raise AttributeError("estimator is not fitted; call fit(X) first")

@@ -143,6 +143,8 @@ def fuzzy_cmeans_fit(
             raise ValueError(
                 f"sample_weight shape {w.shape} != ({x.shape[0]},)"
             )
+        if (np.asarray(sample_weight) < 0).any():
+            raise ValueError("sample_weight entries must be nonnegative")
         n_pos = int((np.asarray(sample_weight) > 0).sum())
         if n_pos < k:
             raise ValueError(

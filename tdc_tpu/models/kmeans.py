@@ -194,6 +194,7 @@ def kmeans_fit(
     mesh: jax.sharding.Mesh | None = None,
     kernel: str = "xla",
     sample_weight=None,
+    n_init: int = 1,
 ) -> KMeansResult:
     """Fit K-Means.
 
@@ -206,6 +207,11 @@ def kmeans_fit(
         use the f32 XLA stats path (a weighted fused kernel would round the
         mass in bf16); with `mesh`, weights are sharded alongside the
         points.
+      n_init: stochastic-init restarts; the fit with the lowest final SSE
+        wins (sklearn semantics — a single k-means++ draw can land a split/
+        merged-cluster optimum). Restarts reuse the compiled loop, so the
+        cost is n_init executions, not n_init compiles. Ignored for
+        deterministic inits (explicit array / 'first_k').
       k: number of clusters.
       init: 'kmeans++' (device k-means++), 'random', 'first_k' (reference
         parity), or an explicit (K, d) array.
@@ -222,10 +228,26 @@ def kmeans_fit(
         inside a shard_map tower per device with a psum of the sufficient
         stats (parallel/collectives.distributed_lloyd_stats).
     """
+    x = jnp.asarray(x)  # before the restart loop: one host→device transfer
+    stochastic = isinstance(init, str) and init != "first_k"
+    if n_init > 1 and stochastic:
+        keys = jax.random.split(
+            key if key is not None else jax.random.PRNGKey(0), n_init
+        )
+        best = None
+        for ki in keys:
+            res = kmeans_fit(
+                x, k, init=init, key=ki, max_iters=max_iters, tol=tol,
+                spherical=spherical, mesh=mesh, kernel=kernel,
+                sample_weight=sample_weight, n_init=1,
+            )
+            if best is None or float(res.sse) < float(best.sse):
+                best = res
+        return best
+
     block_rows = 0
     if mesh is None and (kernel == "xla" or sample_weight is not None):
         block_rows = auto_block_rows(int(np.asarray(x.shape[0])), k)
-    x = jnp.asarray(x)
     w = None
     if sample_weight is not None:
         w = jnp.asarray(sample_weight, jnp.float32)
@@ -233,6 +255,8 @@ def kmeans_fit(
             raise ValueError(
                 f"sample_weight shape {w.shape} != ({x.shape[0]},)"
             )
+        if (np.asarray(sample_weight) < 0).any():
+            raise ValueError("sample_weight entries must be nonnegative")
         n_pos = int((np.asarray(sample_weight) > 0).sum())
         if n_pos < k:
             # sklearn raises too: the weighted inits can only draw from

@@ -58,3 +58,30 @@ def test_estimator_mesh(blobs_small):
     np.testing.assert_allclose(
         est.cluster_centers_, single.cluster_centers_, rtol=1e-4, atol=1e-4
     )
+
+
+def test_gaussian_mixture_estimator(blobs_small):
+    from tdc_tpu.models import GaussianMixture
+
+    x, y, centers = blobs_small
+    gm = GaussianMixture(n_components=3, init=centers, max_iter=100).fit(x)
+    assert gm.means_.shape == (3, 2)
+    assert gm.covariances_.shape == (3, 2)
+    np.testing.assert_allclose(gm.weights_.sum(), 1.0, rtol=1e-5)
+    assert gm.converged_
+    p = gm.predict_proba(x[:10])
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+    assert gm.predict(x[:10]).shape == (10,)
+    assert np.isfinite(gm.score(x))
+    # means land on the true blob centers (order-free)
+    d = np.linalg.norm(gm.means_[:, None] - centers[None], axis=-1)
+    assert d.min(axis=1).max() < 0.5
+
+
+def test_gaussian_mixture_unfitted_raises():
+    import pytest
+
+    from tdc_tpu.models import GaussianMixture
+
+    with pytest.raises(AttributeError, match="not fitted"):
+        GaussianMixture(n_components=2).predict(np.zeros((4, 2), np.float32))

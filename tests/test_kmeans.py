@@ -95,3 +95,28 @@ def test_spherical_groups_by_direction(rng):
     labels = np.asarray(kmeans_predict(x, res.centroids, spherical=True))
     assert len(set(labels[:100])) == 1 and len(set(labels[100:])) == 1
     assert labels[0] != labels[100]
+
+
+def test_n_init_picks_best_sse(blobs_small):
+    """Multi-restart: best-of-R by SSE is never worse than any single draw
+    (and fixes split/merged-blob optima a single k-means++ draw can hit)."""
+    import jax
+
+    x, _, _ = blobs_small
+    single = [
+        float(kmeans_fit(x, 3, init="kmeans++", key=ki, max_iters=50,
+                         tol=1e-6).sse)
+        for ki in jax.random.split(jax.random.PRNGKey(0), 5)
+    ]
+    multi = float(kmeans_fit(x, 3, init="kmeans++",
+                             key=jax.random.PRNGKey(0), max_iters=50,
+                             tol=1e-6, n_init=5).sse)
+    assert multi <= min(single) + 1e-3
+
+
+def test_n_init_ignored_for_deterministic_init(blobs_small):
+    x, _, centers = blobs_small
+    a = kmeans_fit(x, 3, init=centers, max_iters=10, tol=-1.0, n_init=5)
+    b = kmeans_fit(x, 3, init=centers, max_iters=10, tol=-1.0)
+    np.testing.assert_array_equal(np.asarray(a.centroids),
+                                  np.asarray(b.centroids))

@@ -224,3 +224,15 @@ def test_fewer_positive_weights_than_k_raises():
         fuzzy_cmeans_fit(x, 3, init="kmeans++", sample_weight=w)
     with pytest.raises(ValueError, match="positive"):
         init_random(jax.random.PRNGKey(0), jnp.asarray(x), 3, w)
+
+
+def test_negative_weights_rejected(blobs_small):
+    import pytest
+
+    x, _, centers = blobs_small
+    w = np.ones(len(x), np.float32)
+    w[0] = -0.5
+    with pytest.raises(ValueError, match="nonnegative"):
+        kmeans_fit(x, 3, init=centers, sample_weight=w)
+    with pytest.raises(ValueError, match="nonnegative"):
+        fuzzy_cmeans_fit(x, 3, init=centers, sample_weight=w)
