@@ -415,3 +415,25 @@ def test_cli_spherical_metrics_normalized_space(tmp_path, capsys):
     out = capsys.readouterr().out
     sil = float(out.split("silhouette=")[1].split()[0])
     assert sil > 0.5  # raw-space scoring would be ~0 under the norm spread
+
+
+def test_cli_gaussian_mixture(tmp_path, capsys):
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--method_name=gaussianMixture --n_obs=3000 --n_dim=4 --K=3 "
+        f"--n_max_iters=100 --seed=0 --init=kmeans --metrics "
+        f"--metrics_sample=1000 --log_file={log}".split()
+    )
+    assert rc == 0
+    rows = list(csv.DictReader(open(log)))
+    assert rows[0]["method_name"] == "gaussianMixture"
+    assert rows[0]["status"] == "ok"
+    assert "silhouette=" in capsys.readouterr().out
+
+
+def test_cli_gaussian_mixture_rejects_streamed():
+    import pytest
+
+    with pytest.raises(SystemExit):
+        cli_main("--method_name=gaussianMixture --n_obs=100 --n_dim=2 "
+                 "--K=2 --num_batches=2".split())
