@@ -64,8 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", type=str, default=None,
                    help="jax platform override (tpu|cpu); default auto")
     p.add_argument("--tol", type=float, default=1e-4,
-                   help="centroid-shift convergence tolerance; negative = "
-                        "fixed n_max_iters (reference parity)")
+                   help="convergence tolerance: centroid shift (kmeans/"
+                        "fuzzy) or mean log-likelihood gain "
+                        "(gaussianMixture); negative = fixed n_max_iters "
+                        "(reference parity)")
     p.add_argument("--init", type=str, default="kmeans++",
                    choices=("kmeans++", "kmeans_parallel", "random", "first_k",
                             "kmeans"),
@@ -172,11 +174,13 @@ def validate_args(parser, args):
     if args.minibatch and args.method_name != "distributedKMeans":
         parser.error("--minibatch supports distributedKMeans only")
     if args.method_name == "gaussianMixture":
-        for flag in ("minibatch", "mean_combine", "spherical", "streamed"):
+        for flag in ("minibatch", "mean_combine", "spherical"):
             if getattr(args, flag):
                 parser.error(f"--{flag} is not supported with gaussianMixture")
-        if args.num_batches > 1 or args.shard_k > 1:
-            parser.error("gaussianMixture has no streamed/sharded-K mode")
+        if args.ckpt_dir:
+            parser.error("gaussianMixture streaming has no checkpointing yet")
+        if args.shard_k > 1:
+            parser.error("gaussianMixture has no sharded-K mode")
         if args.weight_file:
             parser.error("gaussianMixture does not support --weight_file")
     elif args.init == "kmeans":
@@ -375,9 +379,13 @@ def run_experiment(args) -> dict:
             )
         if args.method_name == "gaussianMixture":
             if streamed:
-                raise ValueError(
-                    "gaussianMixture has no streamed mode; the dataset must "
-                    "fit in device memory"
+                from tdc_tpu.models.gmm import streamed_gmm_fit
+
+                rows = -(-n_obs // num_batches)
+                return streamed_gmm_fit(
+                    make_stream(rows), args.K, n_dim, init=args.init,
+                    key=key, max_iters=args.n_max_iters, tol=args.tol,
+                    mesh=mesh, prefetch=args.prefetch,
                 )
             from tdc_tpu.models.gmm import gmm_fit
 

@@ -15,6 +15,7 @@ from tdc_tpu.models.gmm import (
     gmm_predict,
     gmm_predict_proba,
     gmm_score,
+    streamed_gmm_fit,
 )
 
 __all__ = [
@@ -37,4 +38,5 @@ __all__ = [
     "gmm_predict",
     "gmm_predict_proba",
     "gmm_score",
+    "streamed_gmm_fit",
 ]

@@ -58,6 +58,17 @@ def _log_prob(x, means, variances, log_weights):
     )
 
 
+def _m_step(nk, sx, sxx, n_rows, reg):
+    """Shared M-step (in-memory loop AND streamed fit — one copy so the
+    empty-component floors and variance clamp can never drift apart):
+    means, diag variances (clamped ≥ 0 + reg_covar), renormalized weights."""
+    safe = jnp.maximum(nk, 1e-12)[:, None]
+    means = sx / safe
+    variances = jnp.maximum(sxx / safe - means**2, 0.0) + reg
+    weights = jnp.maximum(nk / n_rows, 1e-12)
+    return means, variances, weights / jnp.sum(weights)
+
+
 @partial(jax.jit, static_argnames=("max_iters",))
 def _em_loop(x, means0, variances0, weights0, max_iters: int, tol: float,
              reg: float):
@@ -85,11 +96,7 @@ def _em_loop(x, means0, variances0, weights0, max_iters: int, tol: float,
     def body(carry):
         means, variances, weights, _, i, last_ll = carry
         ll, nk, sx, sxx = e_and_stats(means, variances, jnp.log(weights))
-        safe = jnp.maximum(nk, 1e-12)[:, None]
-        new_means = sx / safe
-        new_vars = jnp.maximum(sxx / safe - new_means**2, 0.0) + reg
-        new_weights = jnp.maximum(nk / n, 1e-12)
-        new_weights = new_weights / jnp.sum(new_weights)
+        new_means, new_vars, new_weights = _m_step(nk, sx, sxx, n, reg)
         return new_means, new_vars, new_weights, last_ll, i + 1, ll
 
     init = (
@@ -228,10 +235,152 @@ def gmm_score(x, result: GMMResult) -> float:
     return float(jnp.mean(jax.scipy.special.logsumexp(logp, axis=1)))
 
 
+class GMMStats(NamedTuple):
+    """EM sufficient statistics — plain sums over points, so exact
+    out-of-core streaming works the same way as Lloyd's (Σx, counts)."""
+
+    ll_sum: jax.Array  # () Σ log p(x)
+    nk: jax.Array  # (K,) Σ responsibilities
+    sx: jax.Array  # (K, d) Σ r·x
+    sxx: jax.Array  # (K, d) Σ r·x²
+
+
+@jax.jit
+def _accumulate_gmm(acc, batch, means, variances, weights, n_valid):
+    """Add one (possibly zero-padded) batch's EM stats; subtract the
+    padding's exact contribution (a zero row's responsibilities and
+    log-likelihood depend only on the parameters — same correction pattern
+    as the streamed fuzzy fit). Zero rows add exactly nothing to sx/sxx."""
+    log_w = jnp.log(weights)
+    logp = _log_prob(batch, means, variances, log_w)
+    norm = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
+    r = jnp.exp(logp - norm)
+    xf = batch.astype(jnp.float32)
+    n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(
+        jnp.float32
+    )
+    zlogp = _log_prob(jnp.zeros((1, batch.shape[1]), batch.dtype), means,
+                      variances, log_w)
+    znorm = jax.scipy.special.logsumexp(zlogp, axis=1)
+    zr = jnp.exp(zlogp - znorm[:, None])[0]
+    return GMMStats(
+        ll_sum=acc.ll_sum + jnp.sum(norm) - n_pad * znorm[0],
+        nk=acc.nk + jnp.sum(r, axis=0) - n_pad * zr,
+        sx=acc.sx + r.T @ xf,
+        sxx=acc.sxx + r.T @ xf**2,
+    )
+
+
+def streamed_gmm_fit(
+    batches,
+    k: int,
+    d: int,
+    *,
+    init="kmeans",
+    key: jax.Array | None = None,
+    max_iters: int = 100,
+    tol: float = 1e-4,
+    reg_covar: float = 1e-6,
+    mesh: jax.sharding.Mesh | None = None,
+    prefetch: int = 0,
+) -> GMMResult:
+    """Exact streamed EM over a re-iterable stream of (B, d) batches — the
+    same contract as streamed_kmeans_fit (one full pass per EM iteration,
+    bit-exact sufficient statistics, mesh batches padded with corrected
+    contributions; multi-process hosts stream their own slices).
+
+    Initialization (means via `init`, variances/weights via hard-assignment
+    moments) uses the FIRST batch only — document-sized seeding, matching
+    how the streamed K-Means resolves named inits. No checkpointing yet
+    (streamed kmeans/fuzzy have it); a crash restarts the fit.
+    """
+    from tdc_tpu.models.streaming import (
+        _broadcast_init,
+        _check_equal_local_rows,
+        _prepare_batch,
+        _run_pass,
+    )
+
+    first = jnp.asarray(next(iter(batches())))
+    if isinstance(init, str) and init == "kmeans":
+        means = kmeans_fit(
+            first, k, init="kmeans++", key=key, max_iters=10, tol=1e-3,
+            n_init=3,
+        ).centroids
+    else:
+        means = resolve_init(first, k, init, key)
+    means = jnp.asarray(means, jnp.float32)
+    if means.shape != (k, d):
+        raise ValueError(f"init means shape {means.shape} != {(k, d)}")
+    variances, weights = _moments_from_hard_assign(first, means, reg_covar)
+    # First-batch-derived params differ per host in a multi-process run —
+    # broadcast process 0's so the gang starts EM from identical state
+    # (replicate()'s SPMD contract).
+    means = _broadcast_init(means, mesh)
+    variances = _broadcast_init(variances, mesh)
+    weights = _broadcast_init(weights, mesh)
+    _check_equal_local_rows(batches, first, mesh)
+    if mesh is not None:
+        means = mesh_lib.replicate(means, mesh)
+        variances = mesh_lib.replicate(variances, mesh)
+        weights = mesh_lib.replicate(weights, mesh)
+
+    def zero_stats():
+        z = GMMStats(
+            ll_sum=jnp.zeros((), jnp.float32),
+            nk=jnp.zeros((k,), jnp.float32),
+            sx=jnp.zeros((k, d), jnp.float32),
+            sxx=jnp.zeros((k, d), jnp.float32),
+        )
+        if mesh is not None:
+            z = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), z)
+        return z
+
+    def full_pass(means, variances, weights):
+        rows_total = [0]
+
+        def step(acc, batch):
+            xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            rows_total[0] += n_valid
+            return (
+                _accumulate_gmm(acc, xb, means, variances, weights,
+                                jnp.asarray(n_valid)),
+                n_local,
+            )
+
+        acc = _run_pass(batches, prefetch, zero_stats, step)
+        return acc, rows_total[0]
+
+    prev_ll = -float("inf")
+    ll = -float("inf")
+    n_iter = 0
+    converged = False
+    for n_iter in range(1, max_iters + 1):
+        acc, n_rows = full_pass(means, variances, weights)
+        ll = float(acc.ll_sum) / max(n_rows, 1)
+        means, variances, weights = _m_step(acc.nk, acc.sx, acc.sxx,
+                                            n_rows, reg_covar)
+        if n_iter > 1 and ll - prev_ll <= tol:
+            converged = True
+            break
+        prev_ll = ll
+    # Final log-likelihood of the returned parameters.
+    acc, n_rows = full_pass(means, variances, weights)
+    final_ll = float(acc.ll_sum) / max(n_rows, 1)
+    return GMMResult(
+        means=means, variances=variances, weights=weights,
+        n_iter=jnp.asarray(n_iter, jnp.int32),
+        log_likelihood=jnp.asarray(final_ll, jnp.float32),
+        converged=jnp.asarray(converged),
+    )
+
+
 __all__ = [
     "GMMResult",
+    "GMMStats",
     "gmm_fit",
     "gmm_predict",
     "gmm_predict_proba",
     "gmm_score",
+    "streamed_gmm_fit",
 ]

@@ -431,9 +431,22 @@ def test_cli_gaussian_mixture(tmp_path, capsys):
     assert "silhouette=" in capsys.readouterr().out
 
 
-def test_cli_gaussian_mixture_rejects_streamed():
+def test_cli_gaussian_mixture_streamed(tmp_path):
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--method_name=gaussianMixture --n_obs=2000 --n_dim=4 --K=3 "
+        f"--n_max_iters=50 --num_batches=4 --seed=0 "
+        f"--log_file={log}".split()
+    )
+    assert rc == 0
+    rows = list(csv.DictReader(open(log)))
+    assert rows[0]["status"] == "ok"
+    assert int(rows[0]["num_batches"]) == 4
+
+
+def test_cli_gaussian_mixture_rejects_ckpt():
     import pytest
 
     with pytest.raises(SystemExit):
         cli_main("--method_name=gaussianMixture --n_obs=100 --n_dim=2 "
-                 "--K=2 --num_batches=2".split())
+                 "--K=2 --ckpt_dir=/tmp/x".split())
