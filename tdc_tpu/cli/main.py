@@ -177,8 +177,7 @@ def validate_args(parser, args):
         for flag in ("minibatch", "mean_combine", "spherical"):
             if getattr(args, flag):
                 parser.error(f"--{flag} is not supported with gaussianMixture")
-        if args.ckpt_dir:
-            parser.error("gaussianMixture streaming has no checkpointing yet")
+
         if args.shard_k > 1:
             parser.error("gaussianMixture has no sharded-K mode")
         if args.weight_file:
@@ -386,6 +385,7 @@ def run_experiment(args) -> dict:
                     make_stream(rows), args.K, n_dim, init=args.init,
                     key=key, max_iters=args.n_max_iters, tol=args.tol,
                     mesh=mesh, prefetch=args.prefetch,
+                    ckpt_dir=args.ckpt_dir,
                 )
             from tdc_tpu.models.gmm import gmm_fit
 

@@ -283,6 +283,8 @@ def streamed_gmm_fit(
     reg_covar: float = 1e-6,
     mesh: jax.sharding.Mesh | None = None,
     prefetch: int = 0,
+    ckpt_dir: str | None = None,
+    ckpt_every: int = 5,
 ) -> GMMResult:
     """Exact streamed EM over a re-iterable stream of (B, d) batches — the
     same contract as streamed_kmeans_fit (one full pass per EM iteration,
@@ -291,8 +293,12 @@ def streamed_gmm_fit(
 
     Initialization (means via `init`, variances/weights via hard-assignment
     moments) uses the FIRST batch only — document-sized seeding, matching
-    how the streamed K-Means resolves named inits. No checkpointing yet
-    (streamed kmeans/fuzzy have it); a crash restarts the fit.
+    how the streamed K-Means resolves named inits.
+
+    ckpt_dir: per-iteration checkpoint/resume (means + variances + weights +
+    log-likelihood trajectory persisted; restore validates k/d/reg_covar).
+    Iteration-granular only — an interrupted pass is re-run, unlike the
+    streamed K-Means' mid-pass cursor.
     """
     from tdc_tpu.models.streaming import (
         _broadcast_init,
@@ -325,6 +331,61 @@ def streamed_gmm_fit(
         variances = mesh_lib.replicate(variances, mesh)
         weights = mesh_lib.replicate(weights, mesh)
 
+    start_iter = 0
+    prev_ll = -float("inf")
+    resume_converged = False
+    if ckpt_dir is not None:
+        from tdc_tpu.utils.checkpoint import restore_checkpoint
+
+        saved = restore_checkpoint(ckpt_dir)
+        if saved is not None:
+            if saved.meta.get("model") != "gmm":
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir} is not a GMM checkpoint"
+                )
+            if (int(saved.meta.get("k")) != k
+                    or int(saved.meta.get("d")) != d
+                    or float(saved.meta.get("reg")) != float(reg_covar)):
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir} was written with "
+                    f"k={saved.meta.get('k')}, d={saved.meta.get('d')}, "
+                    f"reg_covar={saved.meta.get('reg')} — refusing to mix "
+                    "state"
+                )
+            means = jnp.asarray(saved.centroids, jnp.float32)
+            variances = jnp.asarray(saved.meta["variances"], jnp.float32)
+            weights = jnp.asarray(saved.meta["weights"], jnp.float32)
+            start_iter = saved.n_iter
+            # The next iteration's gain compares against the checkpointed
+            # iteration's ll (the uninterrupted loop assigns prev_ll = ll
+            # after each step).
+            prev_ll = float(saved.meta.get("ll", -float("inf")))
+            resume_converged = bool(
+                np.asarray(saved.meta.get("converged", False))
+            )
+            if mesh is not None:
+                means = mesh_lib.replicate(means, mesh)
+                variances = mesh_lib.replicate(variances, mesh)
+                weights = mesh_lib.replicate(weights, mesh)
+
+    def save(n_iter, ll, done):
+        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
+
+        save_checkpoint(
+            ckpt_dir,
+            ClusterState(
+                centroids=np.asarray(means), n_iter=n_iter, key=None,
+                batch_cursor=0,
+                meta={
+                    "model": "gmm", "k": k, "d": d, "reg": float(reg_covar),
+                    "variances": np.asarray(variances),
+                    "weights": np.asarray(weights),
+                    "ll": float(ll), "converged": bool(done),
+                },
+            ),
+            step=n_iter,
+        )
+
     def zero_stats():
         z = GMMStats(
             ll_sum=jnp.zeros((), jnp.float32),
@@ -351,16 +412,20 @@ def streamed_gmm_fit(
         acc = _run_pass(batches, prefetch, zero_stats, step)
         return acc, rows_total[0]
 
-    prev_ll = -float("inf")
-    ll = -float("inf")
-    n_iter = 0
-    converged = False
-    for n_iter in range(1, max_iters + 1):
+    ll = prev_ll
+    n_iter = start_iter
+    converged = resume_converged
+    iters = () if resume_converged else range(start_iter + 1, max_iters + 1)
+    for n_iter in iters:
         acc, n_rows = full_pass(means, variances, weights)
         ll = float(acc.ll_sum) / max(n_rows, 1)
         means, variances, weights = _m_step(acc.nk, acc.sx, acc.sxx,
                                             n_rows, reg_covar)
-        if n_iter > 1 and ll - prev_ll <= tol:
+        done = n_iter > 1 and ll - prev_ll <= tol
+        if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
+                                     or n_iter == max_iters):
+            save(n_iter, ll, done)
+        if done:
             converged = True
             break
         prev_ll = ll

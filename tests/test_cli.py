@@ -444,9 +444,14 @@ def test_cli_gaussian_mixture_streamed(tmp_path):
     assert int(rows[0]["num_batches"]) == 4
 
 
-def test_cli_gaussian_mixture_rejects_ckpt():
-    import pytest
+def test_cli_gaussian_mixture_streamed_ckpt(tmp_path):
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--method_name=gaussianMixture --n_obs=2000 --n_dim=4 --K=3 "
+        f"--n_max_iters=30 --num_batches=4 --seed=0 "
+        f"--ckpt_dir={tmp_path / 'ck'} --log_file={log}".split()
+    )
+    assert rc == 0
+    import os
 
-    with pytest.raises(SystemExit):
-        cli_main("--method_name=gaussianMixture --n_obs=100 --n_dim=2 "
-                 "--K=2 --ckpt_dir=/tmp/x".split())
+    assert any(n.startswith("step_") for n in os.listdir(tmp_path / "ck"))

@@ -187,3 +187,73 @@ class TestStreamedGMM:
                                    rtol=1e-3, atol=1e-3)
         np.testing.assert_allclose(float(plain.log_likelihood),
                                    float(meshed.log_likelihood), rtol=1e-4)
+
+
+class TestStreamedGMMCheckpoint:
+    def _batches(self, x, bs=250):
+        def gen():
+            for i in range(0, len(x), bs):
+                yield x[i:i + bs]
+        return gen
+
+    def test_resume_matches_uninterrupted(self, aniso_blobs, tmp_path):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+        batches = self._batches(x)
+        full = streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=12,
+                                tol=-1.0)
+        # Interrupted run: stop at iteration 6 (checkpointed), then resume.
+        d = str(tmp_path / "ck")
+        streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=6, tol=-1.0,
+                         ckpt_dir=d, ckpt_every=2)
+        resumed = streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=12,
+                                   tol=-1.0, ckpt_dir=d, ckpt_every=2)
+        assert int(resumed.n_iter) == 12
+        np.testing.assert_allclose(np.asarray(resumed.means),
+                                   np.asarray(full.means),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(resumed.variances),
+                                   np.asarray(full.variances),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_converged_checkpoint_runs_nothing(self, aniso_blobs, tmp_path):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+        batches = self._batches(x)
+        d = str(tmp_path / "ck")
+        first = streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=100,
+                                 tol=1e-4, ckpt_dir=d)
+        assert bool(first.converged)
+        again = streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=100,
+                                 tol=1e-4, ckpt_dir=d)
+        assert bool(again.converged)
+        assert int(again.n_iter) == int(first.n_iter)
+        np.testing.assert_allclose(np.asarray(again.means),
+                                   np.asarray(first.means), rtol=1e-6)
+
+    def test_mismatched_params_refused(self, aniso_blobs, tmp_path):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+        batches = self._batches(x)
+        d = str(tmp_path / "ck")
+        streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=2, tol=-1.0,
+                         ckpt_dir=d)
+        with pytest.raises(ValueError, match="refusing to mix"):
+            streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=2,
+                             tol=-1.0, reg_covar=1e-3, ckpt_dir=d)
+
+    def test_kmeans_checkpoint_refused(self, aniso_blobs, tmp_path):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+        from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+        x, _, centers = aniso_blobs
+        d = str(tmp_path / "ck")
+        batches = self._batches(x[:1000])
+        streamed_kmeans_fit(batches, 3, 2, init=centers, max_iters=2,
+                            tol=-1.0, ckpt_dir=d)
+        with pytest.raises(ValueError, match="not a GMM"):
+            streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=2,
+                             tol=-1.0, ckpt_dir=d)
