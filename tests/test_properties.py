@@ -1,0 +1,118 @@
+"""Property-based invariants of the core ops (hypothesis).
+
+The oracle tests pin exact values against sklearn/numpy; these pin the
+ALGEBRA — invariances that must hold for any input, which catch classes of
+bug (padding leaks, order dependence, broken equivariance) that fixed
+fixtures can miss. Shapes are fixed per test so every example reuses the
+same jit executable.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+from hypothesis import given, settings, strategies as st
+from hypothesis.extra.numpy import arrays
+
+from tdc_tpu.ops.assign import (
+    apply_centroid_update,
+    fuzzy_memberships,
+    lloyd_stats,
+    lloyd_stats_padded_blocked,
+    lloyd_stats_weighted,
+    SufficientStats,
+)
+from tdc_tpu.ops.distance import pairwise_sq_dist
+
+_SETTINGS = dict(max_examples=15, deadline=None)
+
+_pts = arrays(np.float32, (50, 3),
+              elements=st.floats(-50, 50, width=32, allow_nan=False))
+_ctr = arrays(np.float32, (4, 3),
+              elements=st.floats(-50, 50, width=32, allow_nan=False))
+_wts = arrays(np.float32, (50,),
+              elements=st.floats(0.015625, 10, width=32, allow_nan=False))
+
+
+@given(x=_pts, c=_ctr)
+@settings(**_SETTINGS)
+def test_pairwise_sq_dist_nonnegative_and_self_zero(x, c):
+    d2 = np.asarray(pairwise_sq_dist(jnp.asarray(x), jnp.asarray(c)))
+    assert (d2 >= 0).all()
+    # distance of each centroid to itself is ~0
+    dc = np.asarray(pairwise_sq_dist(jnp.asarray(c), jnp.asarray(c)))
+    scale = max(float(np.abs(c).max()) ** 2, 1.0)
+    assert np.abs(np.diag(dc)).max() <= 1e-3 * scale
+
+
+@given(x=_pts, c=_ctr, seed=st.integers(0, 2**31 - 1))
+@settings(**_SETTINGS)
+def test_lloyd_stats_permutation_invariant(x, c, seed):
+    """Sufficient statistics must not depend on point order."""
+    perm = np.random.default_rng(seed).permutation(len(x))
+    a = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    b = lloyd_stats(jnp.asarray(x[perm]), jnp.asarray(c))
+    scale = max(float(np.abs(np.asarray(a.sums)).max()), 1.0)
+    np.testing.assert_allclose(a.sums, b.sums, atol=2e-4 * scale)
+    np.testing.assert_allclose(a.counts, b.counts)
+
+
+@given(x=_pts, c=_ctr,
+       t=arrays(np.float32, (3,),
+                elements=st.floats(-20, 20, width=32, allow_nan=False)))
+@settings(**_SETTINGS)
+def test_lloyd_stats_translation_equivariant(x, c, t):
+    """Shifting points AND centroids by t shifts Σx by count·t and leaves
+    counts/SSE unchanged (assignments are translation-invariant)."""
+    a = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    b = lloyd_stats(jnp.asarray(x + t), jnp.asarray(c + t))
+    np.testing.assert_allclose(a.counts, b.counts)
+    want = np.asarray(a.sums) + np.asarray(a.counts)[:, None] * t
+    scale = max(float(np.abs(want).max()), 1.0)
+    np.testing.assert_allclose(b.sums, want, atol=3e-3 * scale)
+    sse_scale = max(float(a.sse), 1.0)
+    np.testing.assert_allclose(float(a.sse), float(b.sse),
+                               atol=5e-2 * sse_scale)
+
+
+@given(x=_pts, c=_ctr, block=st.sampled_from([7, 16, 50, 64]))
+@settings(**_SETTINGS)
+def test_blocked_stats_match_for_any_block_size(x, c, block):
+    a = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    b = lloyd_stats_padded_blocked(jnp.asarray(x), jnp.asarray(c), block)
+    scale = max(float(np.abs(np.asarray(a.sums)).max()), 1.0)
+    np.testing.assert_allclose(a.sums, b.sums, atol=2e-4 * scale)
+    np.testing.assert_allclose(a.counts, b.counts)
+
+
+@given(x=_pts, c=_ctr, w=_wts)
+@settings(**_SETTINGS)
+def test_weighted_stats_scale_linearly(x, c, w):
+    """Scaling all weights by a constant scales sums/counts/sse by it."""
+    a = lloyd_stats_weighted(jnp.asarray(x), jnp.asarray(c), jnp.asarray(w))
+    b = lloyd_stats_weighted(jnp.asarray(x), jnp.asarray(c),
+                             jnp.asarray(3.0 * w))
+    np.testing.assert_allclose(3.0 * np.asarray(a.counts), b.counts,
+                               rtol=1e-5)
+    scale = max(float(np.abs(np.asarray(b.sums)).max()), 1.0)
+    np.testing.assert_allclose(3.0 * np.asarray(a.sums), b.sums,
+                               atol=2e-4 * scale, rtol=1e-4)
+    np.testing.assert_allclose(3.0 * float(a.sse), float(b.sse), rtol=1e-4)
+
+
+@given(x=_pts, c=_ctr)
+@settings(**_SETTINGS)
+def test_fuzzy_memberships_are_a_distribution(x, c):
+    u = np.asarray(fuzzy_memberships(jnp.asarray(x), jnp.asarray(c), m=2.0))
+    assert (u >= 0).all() and (u <= 1.0 + 1e-6).all()
+    np.testing.assert_allclose(u.sum(axis=1), 1.0, rtol=1e-5)
+
+
+@given(c=_ctr)
+@settings(**_SETTINGS)
+def test_empty_clusters_keep_previous_centroids(c):
+    stats = SufficientStats(
+        sums=jnp.zeros((4, 3), jnp.float32),
+        counts=jnp.zeros((4,), jnp.float32),
+        sse=jnp.zeros((), jnp.float32),
+    )
+    out = np.asarray(apply_centroid_update(stats, jnp.asarray(c)))
+    np.testing.assert_array_equal(out, c)
