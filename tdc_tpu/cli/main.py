@@ -140,7 +140,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write per-iteration (sse, shift) CSV (streamed mode)")
     p.add_argument("--weight_file", type=str, default=None,
                    help=".npy of (N,) nonnegative per-point sample weights "
-                        "(sklearn sample_weight parity; in-memory fits only)")
+                        "(sklearn sample_weight parity; in-memory and "
+                        "streamed kmeans/fuzzy fits)")
     p.add_argument("--metrics", action="store_true",
                    help="after the fit, score the clustering (silhouette / "
                         "Davies-Bouldin / Calinski-Harabasz; the reference "
@@ -189,10 +190,9 @@ def validate_args(parser, args):
     if args.weight_file:
         if not os.path.exists(args.weight_file):
             parser.error(f"weight file does not exist: {args.weight_file}")
-        if (args.streamed or args.num_batches > 1 or args.minibatch
-                or args.mean_combine or args.shard_k > 1):
-            parser.error("--weight_file supports in-memory fits only "
-                         "(weighted streaming is not implemented)")
+        if args.minibatch or args.mean_combine or args.shard_k > 1:
+            parser.error("--weight_file is not supported with "
+                         "--minibatch/--mean_combine/--shard_k")
     if args.mean_combine:
         if args.method_name != "distributedKMeans":
             parser.error("--mean_combine supports distributedKMeans only")
@@ -314,14 +314,10 @@ def run_experiment(args) -> dict:
         import jax.numpy as jnp
 
         streamed = args.streamed or num_batches > 1
-        if weights is not None and streamed:
-            # Only reachable via the OOM fallback (validate_args blocks the
-            # explicit flags): weighted streaming isn't implemented.
-            raise ValueError(
-                "dataset fell back to streamed batching but --weight_file "
-                "requires the in-memory fit; reduce the dataset or drop "
-                "the weights"
-            )
+
+        def weight_stream(rows):
+            # aligned batch-for-batch with make_stream's row slicing
+            return NpzStream(np.asarray(weights, np.float32), rows)
         # bf16 applies to the in-memory device paths; streamed batches keep
         # their on-disk dtype (stats accumulate in f32 either way).
         xx = (
@@ -403,6 +399,9 @@ def run_experiment(args) -> dict:
                     ckpt_dir=args.ckpt_dir,
                     ckpt_every_batches=args.ckpt_every_batches,
                     prefetch=args.prefetch,
+                    sample_weight_batches=(
+                        weight_stream(rows) if weights is not None else None
+                    ),
                 )
             return fuzzy_cmeans_fit(
                 xx, args.K, m=args.fuzzifier, init=args.init, key=key,
@@ -427,6 +426,9 @@ def run_experiment(args) -> dict:
                 ckpt_dir=args.ckpt_dir,
                 ckpt_every_batches=args.ckpt_every_batches,
                 prefetch=args.prefetch,
+                sample_weight_batches=(
+                    weight_stream(rows) if weights is not None else None
+                ),
             )
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,

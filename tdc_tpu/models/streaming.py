@@ -147,7 +147,9 @@ def _run_pass(
             # skipped batches is real progress, and a silent replay would trip
             # the supervisor's hang detector and loop the gang restart
             if i < skip:
-                skipped_rows += np.asarray(batch).shape[0]
+                # Weighted streams yield (x, w) pairs; rows come from x.
+                xb = batch[0] if isinstance(batch, tuple) else batch
+                skipped_rows += np.asarray(xb).shape[0]
                 if i == skip - 1:
                     if skipped_rows != rows0:
                         mismatch = True
@@ -235,6 +237,8 @@ def _check_equal_local_rows(batches, first, mesh):
         return
     if first is None:
         first = next(iter(batches()))
+    if isinstance(first, tuple):  # weighted stream: rows come from x
+        first = first[0]
     from jax.experimental import multihost_utils
 
     n_local = np.asarray(first).shape[0]
@@ -246,6 +250,77 @@ def _check_equal_local_rows(batches, first, mesh):
             "the first batch — use host_shard_bounds with totals divisible "
             "by the process count, or pad upstream"
         )
+
+
+@partial(jax.jit, static_argnames=("spherical",))
+def _accumulate_weighted(
+    acc: SufficientStats,
+    batch: jax.Array,
+    w: jax.Array,
+    centroids: jax.Array,
+    spherical: bool,
+) -> SufficientStats:
+    """Weighted batch stats. No padding correction needed: pad rows carry
+    ZERO WEIGHT, so they contribute exactly nothing to sums/mass/sse."""
+    from tdc_tpu.ops.assign import lloyd_stats_weighted
+
+    if spherical:
+        norms = jnp.linalg.norm(batch, axis=-1, keepdims=True)
+        batch = jnp.where(norms > 0, batch / jnp.maximum(norms, 1e-12), batch)
+    s = lloyd_stats_weighted(batch, centroids, w)
+    return SufficientStats(
+        sums=acc.sums + s.sums, counts=acc.counts + s.counts,
+        sse=acc.sse + s.sse,
+    )
+
+
+@jax.jit
+def _accumulate_fuzzy_weighted(acc, batch, w, centroids, m: float):
+    from tdc_tpu.ops.assign import fuzzy_stats_weighted
+
+    s = fuzzy_stats_weighted(batch, centroids, w, m=m)
+    return FuzzyStats(
+        weighted_sums=acc.weighted_sums + s.weighted_sums,
+        weights=acc.weights + s.weights,
+        objective=acc.objective + s.objective,
+    )
+
+
+def _prepare_weighted_batch(batch, w, mesh):
+    """(x_device, w_device, n_local): like _prepare_batch but for (x, w)
+    pairs — both padded with ZEROS (zero weight ⇒ exact, no correction)."""
+    batch = np.asarray(batch)
+    w = np.asarray(w, np.float32)
+    if w.shape != (batch.shape[0],):
+        raise ValueError(
+            f"weight batch shape {w.shape} != ({batch.shape[0]},) — the "
+            "weight stream must yield one weight row per point row, batch "
+            "for batch"
+        )
+    if (w < 0).any():
+        # Same validation the in-memory fits apply up front; a stream can
+        # only be checked batch by batch.
+        raise ValueError("sample weights must be nonnegative")
+    n_local = batch.shape[0]
+    if mesh is None:
+        return jnp.asarray(batch), jnp.asarray(w), n_local
+    nproc, local_dev = _mesh_layout(mesh)
+    if nproc > 1:
+        pb, _ = mesh_lib.pad_to_multiple(batch, max(local_dev, 1), 0.0)
+        pw, _ = mesh_lib.pad_to_multiple(w, max(local_dev, 1), 0.0)
+        sharding = mesh_lib.data_sharding(mesh)
+        gx = jax.make_array_from_process_local_data(
+            sharding, pb, (pb.shape[0] * nproc,) + pb.shape[1:]
+        )
+        gw = jax.make_array_from_process_local_data(
+            sharding, pw, (pw.shape[0] * nproc,)
+        )
+        return gx, gw, n_local
+    n_dev = int(np.prod(mesh.devices.shape))
+    pb, _ = mesh_lib.pad_to_multiple(batch, n_dev, 0.0)
+    pw, _ = mesh_lib.pad_to_multiple(w, n_dev, 0.0)
+    return (mesh_lib.shard_points(pb, mesh),
+            mesh_lib.shard_points(pw, mesh), n_local)
 
 
 def _broadcast_init(init, mesh):
@@ -304,7 +379,8 @@ class _StreamCheckpointer:
                 f"d={saved.meta.get('d')}, not ({self.k}, {self.d})"
             )
         for name, want in self.params.items():
-            got = saved.meta.get(name, want)
+            legacy = {"weighted": False}
+            got = saved.meta.get(name, legacy.get(name, want))
             if isinstance(want, bool):
                 mismatch = bool(got) != want
             else:
@@ -395,6 +471,7 @@ def streamed_kmeans_fit(
     ckpt_every: int = 5,
     ckpt_every_batches: int | None = None,
     prefetch: int = 0,
+    sample_weight_batches: Callable[[], Iterable] | None = None,
 ) -> KMeansResult:
     """Exact Lloyd over a re-iterable stream of (B, d) batches.
 
@@ -417,20 +494,37 @@ def streamed_kmeans_fit(
         preserved).
       prefetch: background-thread batch prefetch depth (0 disables) —
         overlaps host staging with device compute.
+      sample_weight_batches: optional zero-arg callable returning a fresh
+        iterator of (B,) weight rows aligned batch-for-batch with `batches`
+        (sklearn sample_weight, streamed). Mass-weighted stats; pad rows
+        carry zero weight so all padding is exact with no correction.
     """
+    weighted = sample_weight_batches is not None
+    stream = (
+        batches if not weighted
+        # strict: a weight stream that runs short would otherwise silently
+        # drop the remaining point batches from the fit.
+        else (lambda: zip(batches(), sample_weight_batches(), strict=True))
+    )
     first = None
     if not hasattr(init, "shape"):
-        first = next(iter(batches()))
-        first = jnp.asarray(first)
+        fb = next(iter(stream()))
+        first_w = None
+        if weighted:
+            fb, first_w = fb
+            first_w = jnp.asarray(first_w, jnp.float32)
+        first = jnp.asarray(fb)
         if spherical:
             first = _normalize(first.astype(jnp.float32))
-        init = _broadcast_init(resolve_init(first, k, init, key), mesh)
+        init = _broadcast_init(
+            resolve_init(first, k, init, key, first_w), mesh
+        )
     c = jnp.asarray(init, jnp.float32)
     if c.shape != (k, d):
         raise ValueError(f"init shape {c.shape} != {(k, d)}")
     if spherical:
         c = _normalize(c)
-    _check_equal_local_rows(batches, first, mesh)
+    _check_equal_local_rows(stream, first, mesh)
     if mesh is not None:
         c = mesh_lib.replicate(c, mesh)
 
@@ -445,7 +539,8 @@ def streamed_kmeans_fit(
         return z
 
     ckpt = _StreamCheckpointer(
-        ckpt_dir, k, d, params={"spherical": bool(spherical)},
+        ckpt_dir, k, d,
+        params={"spherical": bool(spherical), "weighted": weighted},
         acc_map={"acc_sums": "sums", "acc_counts": "counts", "acc_sse": "sse"},
         key=key,
     )
@@ -460,6 +555,13 @@ def streamed_kmeans_fit(
 
     def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
         def step(acc, batch):
+            if weighted:
+                xb, wb, n_local = _prepare_weighted_batch(
+                    batch[0], batch[1], mesh
+                )
+                return (
+                    _accumulate_weighted(acc, xb, wb, c, spherical), n_local
+                )
             xb, n_valid, n_local = _prepare_batch(batch, mesh)
             return (
                 _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical),
@@ -467,7 +569,7 @@ def streamed_kmeans_fit(
             )
 
         return _run_pass(
-            batches, prefetch, zero_stats, step,
+            stream, prefetch, zero_stats, step,
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
         )
@@ -480,6 +582,11 @@ def streamed_kmeans_fit(
         acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
                         rows0=state.rows_seen if resume_cursor else 0)
         resume_cursor, resume_acc = 0, None
+        if weighted and n_iter == start_iter + 1 \
+                and float(jnp.sum(acc.counts)) <= 0.0:
+            raise ValueError(
+                "all sample weights are zero — the weighted fit has no mass"
+            )
         new_c = apply_centroid_update(acc, c)
         if spherical:
             new_c = _normalize(new_c)
@@ -630,20 +737,34 @@ def streamed_fuzzy_fit(
     ckpt_every: int = 5,
     ckpt_every_batches: int | None = None,
     prefetch: int = 0,
+    sample_weight_batches: Callable[[], Iterable] | None = None,
 ) -> FuzzyCMeansResult:
     """Exact streamed Fuzzy C-Means — same contract as streamed_kmeans_fit,
-    including checkpoint/resume (per-iteration and mid-pass) and the
-    per-iteration (objective, shift) history the reference never computed."""
+    including checkpoint/resume (per-iteration and mid-pass), streamed
+    sample weights, and the per-iteration (objective, shift) history the
+    reference never computed."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
+    weighted = sample_weight_batches is not None
+    stream = (
+        batches if not weighted
+        else (lambda: zip(batches(), sample_weight_batches(), strict=True))
+    )
     first = None
     if not hasattr(init, "shape"):
-        first = jnp.asarray(next(iter(batches())))
-        init = _broadcast_init(resolve_init(first, k, init, key), mesh)
+        fb = next(iter(stream()))
+        first_w = None
+        if weighted:
+            fb, first_w = fb
+            first_w = jnp.asarray(first_w, jnp.float32)
+        first = jnp.asarray(fb)
+        init = _broadcast_init(
+            resolve_init(first, k, init, key, first_w), mesh
+        )
     c = jnp.asarray(init, jnp.float32)
     if c.shape != (k, d):
         raise ValueError(f"init shape {c.shape} != {(k, d)}")
-    _check_equal_local_rows(batches, first, mesh)
+    _check_equal_local_rows(stream, first, mesh)
     if mesh is not None:
         c = mesh_lib.replicate(c, mesh)
 
@@ -658,7 +779,7 @@ def streamed_fuzzy_fit(
         return acc
 
     ckpt = _StreamCheckpointer(
-        ckpt_dir, k, d, params={"m": float(m)},
+        ckpt_dir, k, d, params={"m": float(m), "weighted": weighted},
         acc_map={
             "acc_wsums": "weighted_sums",
             "acc_weights": "weights",
@@ -677,6 +798,11 @@ def streamed_fuzzy_fit(
 
     def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
         def step(acc, batch):
+            if weighted:
+                xb, wb, n_local = _prepare_weighted_batch(
+                    batch[0], batch[1], mesh
+                )
+                return _accumulate_fuzzy_weighted(acc, xb, wb, c, m), n_local
             xb, n_valid, n_local = _prepare_batch(batch, mesh)
             return (
                 _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m),
@@ -684,7 +810,7 @@ def streamed_fuzzy_fit(
             )
 
         return _run_pass(
-            batches, prefetch, zero_stats, step,
+            stream, prefetch, zero_stats, step,
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
         )
@@ -695,6 +821,11 @@ def streamed_fuzzy_fit(
         acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
                         rows0=state.rows_seen if resume_cursor else 0)
         resume_cursor, resume_acc = 0, None
+        if weighted and n_iter == start_iter + 1 \
+                and float(jnp.sum(acc.weights)) <= 0.0:
+            raise ValueError(
+                "all sample weights are zero — the weighted fit has no mass"
+            )
         new_c = acc.weighted_sums / jnp.maximum(acc.weights[:, None], 1e-12)
         shift = float(jnp.max(jnp.linalg.norm(new_c - c, axis=-1)))
         history.append((float(acc.objective), shift))

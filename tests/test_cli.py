@@ -356,7 +356,22 @@ def test_cli_weight_file(tmp_path, capsys):
     assert rows[0]["status"] == "ok"
 
 
-def test_cli_weight_file_rejects_streamed(tmp_path):
+def test_cli_weight_file_streamed(tmp_path):
+    import numpy as np
+
+    log = str(tmp_path / "log.csv")
+    wf = str(tmp_path / "w.npy")
+    np.save(wf, np.ones(3000, np.float32))
+    rc = cli_main(
+        f"--n_obs=3000 --n_dim=4 --K=3 --n_max_iters=15 --seed=1 "
+        f"--num_batches=3 --log_file={log} --weight_file={wf}".split()
+    )
+    assert rc == 0
+    rows = list(csv.DictReader(open(log)))
+    assert rows[0]["status"] == "ok"
+
+
+def test_cli_weight_file_rejects_minibatch(tmp_path):
     import numpy as np
     import pytest
 
@@ -364,7 +379,7 @@ def test_cli_weight_file_rejects_streamed(tmp_path):
     np.save(wf, np.ones(100, np.float32))
     with pytest.raises(SystemExit):
         cli_main(
-            f"--n_obs=100 --n_dim=2 --K=2 --num_batches=2 "
+            f"--n_obs=100 --n_dim=2 --K=2 --minibatch "
             f"--weight_file={wf}".split()
         )
 

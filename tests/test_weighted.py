@@ -236,3 +236,178 @@ def test_negative_weights_rejected(blobs_small):
         kmeans_fit(x, 3, init=centers, sample_weight=w)
     with pytest.raises(ValueError, match="nonnegative"):
         fuzzy_cmeans_fit(x, 3, init=centers, sample_weight=w)
+
+
+class TestWeightedStreaming:
+    def _streams(self, x, w, bs):
+        def xs():
+            for i in range(0, len(x), bs):
+                yield x[i:i + bs]
+
+        def ws():
+            for i in range(0, len(w), bs):
+                yield w[i:i + bs]
+
+        return xs, ws
+
+    def test_streamed_matches_in_memory(self, blobs_small):
+        from tdc_tpu.models import streamed_kmeans_fit
+
+        x, _, centers = blobs_small
+        rng = np.random.default_rng(7)
+        w = rng.uniform(0.2, 3.0, size=len(x)).astype(np.float32)
+        xs, ws = self._streams(x, w, 151)  # ragged batches
+        mem = kmeans_fit(x, 3, init=centers, max_iters=12, tol=-1.0,
+                         sample_weight=w)
+        st = streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=12,
+                                 tol=-1.0, sample_weight_batches=ws)
+        np.testing.assert_allclose(np.asarray(st.centroids),
+                                   np.asarray(mem.centroids),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(float(st.sse), float(mem.sse), rtol=1e-4)
+
+    def test_streamed_weighted_mesh_ragged(self, blobs_small):
+        """Zero-weight padding is exact even when every mesh batch is
+        ragged."""
+        from tdc_tpu.models import streamed_kmeans_fit
+
+        x, _, centers = blobs_small
+        x = x[:1101]
+        rng = np.random.default_rng(8)
+        w = rng.uniform(0.2, 3.0, size=len(x)).astype(np.float32)
+        xs, ws = self._streams(x, w, 211)
+        plain = streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=10,
+                                    tol=-1.0, sample_weight_batches=ws)
+        meshed = streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=10,
+                                     tol=-1.0, sample_weight_batches=ws,
+                                     mesh=make_mesh(8))
+        np.testing.assert_allclose(np.asarray(plain.centroids),
+                                   np.asarray(meshed.centroids),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_streamed_fuzzy_weighted_matches_in_memory(self, blobs_small):
+        from tdc_tpu.models import streamed_fuzzy_fit
+
+        x, _, centers = blobs_small
+        rng = np.random.default_rng(9)
+        w = rng.uniform(0.2, 3.0, size=len(x)).astype(np.float32)
+        xs, ws = self._streams(x, w, 173)
+        mem = fuzzy_cmeans_fit(x, 3, m=2.0, init=centers, max_iters=8,
+                               tol=-1.0, sample_weight=w)
+        st = streamed_fuzzy_fit(xs, 3, 2, m=2.0, init=centers, max_iters=8,
+                                tol=-1.0, sample_weight_batches=ws)
+        np.testing.assert_allclose(np.asarray(st.centroids),
+                                   np.asarray(mem.centroids),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_weighted_ckpt_mismatch_refused(self, blobs_small, tmp_path):
+        """A weighted checkpoint cannot resume an unweighted run (the mass
+        semantics differ)."""
+        import pytest
+
+        from tdc_tpu.models import streamed_kmeans_fit
+
+        x, _, centers = blobs_small
+        w = np.ones(len(x), np.float32)
+        xs, ws = self._streams(x, w, 300)
+        d = str(tmp_path / "ck")
+        streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=3, tol=-1.0,
+                            sample_weight_batches=ws, ckpt_dir=d)
+        with pytest.raises(ValueError, match="weighted"):
+            streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=6,
+                                tol=-1.0, ckpt_dir=d)
+
+    def test_weighted_midpass_resume(self, blobs_small, tmp_path):
+        """Mid-pass checkpoint + resume with a weighted stream is exact."""
+        from tdc_tpu.models import streamed_kmeans_fit
+
+        x, _, centers = blobs_small
+        rng = np.random.default_rng(10)
+        w = rng.uniform(0.2, 3.0, size=len(x)).astype(np.float32)
+        xs, ws = self._streams(x, w, 300)
+        full = streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=8,
+                                   tol=-1.0, sample_weight_batches=ws)
+        d = str(tmp_path / "ck")
+        streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=4, tol=-1.0,
+                            sample_weight_batches=ws, ckpt_dir=d,
+                            ckpt_every=1, ckpt_every_batches=1)
+        resumed = streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=8,
+                                      tol=-1.0, sample_weight_batches=ws,
+                                      ckpt_dir=d, ckpt_every=1,
+                                      ckpt_every_batches=1)
+        np.testing.assert_allclose(np.asarray(resumed.centroids),
+                                   np.asarray(full.centroids),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_misaligned_weight_batches_raise(self, blobs_small):
+        import pytest
+
+        from tdc_tpu.models import streamed_kmeans_fit
+
+        x, _, centers = blobs_small
+        w = np.ones(len(x), np.float32)
+        xs, _ = self._streams(x, w, 300)
+        _, ws_bad = self._streams(x, w, 200)  # different batch layout
+        with pytest.raises(ValueError, match="weight batch"):
+            streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=2,
+                                tol=-1.0, sample_weight_batches=ws_bad)
+
+
+def test_streamed_negative_weights_rejected(blobs_small):
+    import pytest
+
+    from tdc_tpu.models import streamed_kmeans_fit
+
+    x, _, centers = blobs_small
+    w = np.ones(len(x), np.float32)
+    w[5] = -1.0
+
+    def xs():
+        yield x
+
+    def ws():
+        yield w
+
+    with pytest.raises(ValueError, match="nonnegative"):
+        streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=2, tol=-1.0,
+                            sample_weight_batches=ws)
+
+
+def test_streamed_short_weight_stream_rejected(blobs_small):
+    """A weight stream with fewer batches than the point stream must raise,
+    not silently drop the tail of the data."""
+    import pytest
+
+    from tdc_tpu.models import streamed_kmeans_fit
+
+    x, _, centers = blobs_small
+
+    def xs():
+        for i in range(0, len(x), 300):
+            yield x[i:i + 300]
+
+    def ws():  # one batch short
+        for i in range(0, len(x) - 300, 300):
+            yield np.ones(min(300, len(x) - 300 - i), np.float32)
+
+    with pytest.raises(ValueError):
+        streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=2, tol=-1.0,
+                            sample_weight_batches=ws)
+
+
+def test_streamed_all_zero_weights_rejected(blobs_small):
+    import pytest
+
+    from tdc_tpu.models import streamed_kmeans_fit
+
+    x, _, centers = blobs_small
+
+    def xs():
+        yield x
+
+    def ws():
+        yield np.zeros(len(x), np.float32)
+
+    with pytest.raises(ValueError, match="no mass"):
+        streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=3, tol=-1.0,
+                            sample_weight_batches=ws)
