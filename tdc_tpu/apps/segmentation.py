@@ -48,9 +48,17 @@ def segment_pixels(
             x, k, m=fuzzifier, init="kmeans++", key=key, max_iters=max_iters
         )
         labels = np.asarray(fuzzy_predict(x, res.centroids, m=fuzzifier))
+    elif method == "gmm":
+        # Probabilistic segmentation: per-component color scales let GMM
+        # separate regions K-Means merges (e.g. a textured region with high
+        # variance vs a flat one at a nearby mean color).
+        from tdc_tpu.models.gmm import gmm_fit, gmm_predict
+
+        res = gmm_fit(x, k, init="kmeans", key=key, max_iters=max_iters)
+        labels = np.asarray(gmm_predict(x, res))
     else:
         raise ValueError(f"unknown method {method!r}")
-    centers = np.asarray(res.centroids)
+    centers = np.asarray(getattr(res, "centroids", getattr(res, "means", None)))
     if np.isnan(centers).any():  # the reference's NaN sentinel (#cell12)
         raise FloatingPointError("NaN centers after fit")
     return labels, centers, res
@@ -150,7 +158,8 @@ def main(argv=None) -> int:
                                       "compile (reference video loop, "
                                       "Testing Images.ipynb#cell12-13)")
     p.add_argument("--K", type=int, default=3)
-    p.add_argument("--method", choices=("kmeans", "fuzzy"), default="kmeans")
+    p.add_argument("--method", choices=("kmeans", "fuzzy", "gmm"),
+                   default="kmeans")
     p.add_argument("--out", default=None, help="write recolored image here "
                                                "(--image mode)")
     p.add_argument("--out_dir", default=None,

@@ -122,3 +122,21 @@ def test_plots_write_files(tmp_path, blobs_small):
     import os
 
     assert os.path.getsize(p1) > 1000 and os.path.getsize(p2) > 1000
+
+
+def test_segment_image_gmm():
+    """GMM segmentation: posterior-argmax labels, component-mean recoloring."""
+    from tdc_tpu.apps.segmentation import segment_image
+
+    rng = np.random.default_rng(0)
+    img = np.zeros((24, 24, 3), np.float32)
+    img[:, :12] = [200, 30, 30] + rng.normal(0, 2, (24, 12, 3))
+    img[:, 12:] = [30, 30, 200] + rng.normal(0, 12, (24, 12, 3))
+    recolored, labels, centers = segment_image(img, 2, method="gmm",
+                                               max_iters=50)
+    assert recolored.shape == img.shape and recolored.dtype == np.uint8
+    # halves land in different components
+    left, right = labels[:, :12], labels[:, 12:]
+    assert (left == left[0, 0]).mean() > 0.95
+    assert (right == right[0, 0]).mean() > 0.95
+    assert left[0, 0] != right[0, 0]
