@@ -9,6 +9,13 @@ __version__ = "0.1.0"
 
 from tdc_tpu.models.kmeans import KMeansResult, kmeans_fit, kmeans_predict
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult, fuzzy_cmeans_fit
+from tdc_tpu.models.gmm import GMMResult, gmm_fit, gmm_predict
+from tdc_tpu.models.estimators import FuzzyCMeans, GaussianMixture, KMeans
+from tdc_tpu.analysis.metrics import (
+    calinski_harabasz_score,
+    davies_bouldin_score,
+    silhouette_score,
+)
 from tdc_tpu.parallel.mesh import make_mesh
 
 __all__ = [
@@ -17,6 +24,15 @@ __all__ = [
     "kmeans_predict",
     "FuzzyCMeansResult",
     "fuzzy_cmeans_fit",
+    "GMMResult",
+    "gmm_fit",
+    "gmm_predict",
+    "KMeans",
+    "FuzzyCMeans",
+    "GaussianMixture",
+    "silhouette_score",
+    "davies_bouldin_score",
+    "calinski_harabasz_score",
     "make_mesh",
     "__version__",
 ]
