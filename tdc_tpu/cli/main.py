@@ -183,6 +183,9 @@ def validate_args(parser, args):
             parser.error("gaussianMixture has no sharded-K mode")
         if args.weight_file:
             parser.error("gaussianMixture does not support --weight_file")
+        if args.ckpt_every_batches:
+            parser.error("gaussianMixture checkpoints per iteration only "
+                         "(--ckpt_every_batches is kmeans/fuzzy)")
     elif args.init == "kmeans":
         parser.error("--init=kmeans is a gaussianMixture seeding mode")
     if args.metrics_sample < 0:

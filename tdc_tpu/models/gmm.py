@@ -37,9 +37,13 @@ class GMMResult(NamedTuple):
     means: jax.Array  # (K, d) f32
     variances: jax.Array  # (K, d) f32 diagonal covariances
     weights: jax.Array  # (K,) mixing proportions, sum to 1
-    n_iter: jax.Array  # () int32
+    n_iter: jax.Array  # () int32 — cumulative EM iterations (incl. resumed)
     log_likelihood: jax.Array  # () f32 — mean per-point log-likelihood
     converged: jax.Array  # () bool
+    # Iterations executed by THIS fit call (None = same as n_iter); CLI
+    # throughput must use this so a checkpoint resume with nothing left to
+    # do reports 0, not an inflated rate from timing a bare scoring pass.
+    n_iter_run: object = None
 
 
 def _log_prob(x, means, variances, log_weights):
@@ -307,33 +311,14 @@ def streamed_gmm_fit(
         _run_pass,
     )
 
-    first = jnp.asarray(next(iter(batches())))
-    if isinstance(init, str) and init == "kmeans":
-        means = kmeans_fit(
-            first, k, init="kmeans++", key=key, max_iters=10, tol=1e-3,
-            n_init=3,
-        ).centroids
-    else:
-        means = resolve_init(first, k, init, key)
-    means = jnp.asarray(means, jnp.float32)
-    if means.shape != (k, d):
-        raise ValueError(f"init means shape {means.shape} != {(k, d)}")
-    variances, weights = _moments_from_hard_assign(first, means, reg_covar)
-    # First-batch-derived params differ per host in a multi-process run —
-    # broadcast process 0's so the gang starts EM from identical state
-    # (replicate()'s SPMD contract).
-    means = _broadcast_init(means, mesh)
-    variances = _broadcast_init(variances, mesh)
-    weights = _broadcast_init(weights, mesh)
-    _check_equal_local_rows(batches, first, mesh)
-    if mesh is not None:
-        means = mesh_lib.replicate(means, mesh)
-        variances = mesh_lib.replicate(variances, mesh)
-        weights = mesh_lib.replicate(weights, mesh)
-
+    # Restore FIRST: a resume must not pay (and then discard) the
+    # first-batch seeding — a multi-restart Lloyd fit plus broadcasts —
+    # on every supervised-gang relaunch.
     start_iter = 0
     prev_ll = -float("inf")
     resume_converged = False
+    restored = False
+    means = variances = weights = None
     if ckpt_dir is not None:
         from tdc_tpu.utils.checkpoint import restore_checkpoint
 
@@ -363,10 +348,41 @@ def streamed_gmm_fit(
             resume_converged = bool(
                 np.asarray(saved.meta.get("converged", False))
             )
+            restored = True
             if mesh is not None:
                 means = mesh_lib.replicate(means, mesh)
                 variances = mesh_lib.replicate(variances, mesh)
                 weights = mesh_lib.replicate(weights, mesh)
+
+    first = None
+    if not restored:
+        first = jnp.asarray(next(iter(batches())))
+        if isinstance(init, str) and init == "kmeans":
+            means = kmeans_fit(
+                first, k, init="kmeans++", key=key, max_iters=10, tol=1e-3,
+                n_init=3,
+            ).centroids
+        else:
+            means = resolve_init(first, k, init, key)
+        means = jnp.asarray(means, jnp.float32)
+        if means.shape != (k, d):
+            raise ValueError(f"init means shape {means.shape} != {(k, d)}")
+        variances, weights = _moments_from_hard_assign(first, means,
+                                                       reg_covar)
+        # First-batch-derived params differ per host in a multi-process
+        # run — broadcast process 0's so the gang starts EM from identical
+        # state (replicate()'s SPMD contract).
+        means = _broadcast_init(means, mesh)
+        variances = _broadcast_init(variances, mesh)
+        weights = _broadcast_init(weights, mesh)
+        if mesh is not None:
+            means = mesh_lib.replicate(means, mesh)
+            variances = mesh_lib.replicate(variances, mesh)
+            weights = mesh_lib.replicate(weights, mesh)
+    _check_equal_local_rows(batches, first, mesh)
+    gang = mesh is not None and len(
+        {dev.process_index for dev in mesh.devices.ravel()}
+    ) > 1
 
     def save(n_iter, ll, done):
         from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
@@ -384,6 +400,7 @@ def streamed_gmm_fit(
                 },
             ),
             step=n_iter,
+            gang=gang,
         )
 
     def zero_stats():
@@ -437,6 +454,7 @@ def streamed_gmm_fit(
         n_iter=jnp.asarray(n_iter, jnp.int32),
         log_likelihood=jnp.asarray(final_ll, jnp.float32),
         converged=jnp.asarray(converged),
+        n_iter_run=n_iter - start_iter,
     )
 
 

@@ -357,12 +357,18 @@ class _StreamCheckpointer:
     silently mix incompatible state).
     """
 
-    def __init__(self, ckpt_dir, k, d, params: dict, acc_map: dict, key):
+    def __init__(self, ckpt_dir, k, d, params: dict, acc_map: dict, key,
+                 gang: bool = False):
         self.dir = ckpt_dir
         self.k, self.d = k, d
         self.params = params
         self.acc_map = acc_map
         self.key = key
+        # True only when the FIT spans processes (mesh covers >1 process):
+        # then the gang shares one dir via the single-writer protocol.
+        # Host-local fits inside a jax.distributed runtime checkpoint
+        # independently (see utils/checkpoint.save_checkpoint).
+        self.gang = gang
 
     def restore(self, acc_cls, mesh) -> _ResumeState:
         from tdc_tpu.utils.checkpoint import restore_checkpoint
@@ -453,6 +459,7 @@ class _StreamCheckpointer:
             # logical checkpoint enriched with pass progress — step numbering
             # stays monotone in completed iterations.
             step=n_iter,
+            gang=self.gang,
         )
 
 
@@ -543,6 +550,7 @@ def streamed_kmeans_fit(
         params={"spherical": bool(spherical), "weighted": weighted},
         acc_map={"acc_sums": "sums", "acc_counts": "counts", "acc_sse": "sse"},
         key=key,
+        gang=mesh is not None and _mesh_layout(mesh)[0] > 1,
     )
     state = ckpt.restore(SufficientStats, mesh)
     if state.centroids is not None:
@@ -786,6 +794,7 @@ def streamed_fuzzy_fit(
             "acc_obj": "objective",
         },
         key=key,
+        gang=mesh is not None and _mesh_layout(mesh)[0] > 1,
     )
     state = ckpt.restore(FuzzyStats, mesh)
     if state.centroids is not None:

@@ -66,6 +66,10 @@ def free_port() -> int:
 
 
 def _checkpoint_steps(ckpt_dir: str) -> set[int]:
+    # Deliberately duplicates utils/checkpoint._all_steps' step_<N> parsing:
+    # the supervisor stays stdlib-only (importing tdc_tpu.utils.checkpoint
+    # would pull jax into the supervising process). Keep the two in sync if
+    # the on-disk step layout ever changes.
     if not os.path.isdir(ckpt_dir):
         return set()
     steps = set()
@@ -156,6 +160,12 @@ def run_gang(
         )
     if ckpt_dirs is not None and len(ckpt_dirs) == 1:
         ckpt_dirs = ckpt_dirs * num_processes
+    elif ckpt_dirs is not None and num_processes > 1:
+        echo("supervisor: warning — per-worker ckpt_dirs with a "
+             "jax.distributed gang will not recover (the gang's checkpoints "
+             "are written by process 0 only; non-primary dirs stay empty and "
+             "align_checkpoints then wipes everything). Use one shared dir "
+             "unless the workers run independent single-process fits.")
     os.makedirs(log_dir, exist_ok=True)
     base_env = dict(os.environ if env is None else env)
 

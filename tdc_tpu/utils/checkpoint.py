@@ -79,17 +79,27 @@ def _manual_restore(path: str) -> dict:
     return payload
 
 
-def save_checkpoint(ckpt_dir: str, state: ClusterState, step: int) -> str:
+def save_checkpoint(
+    ckpt_dir: str, state: ClusterState, step: int, *, gang: bool | None = None
+) -> str:
     """Write state under ckpt_dir/step_<N>; returns the path.
 
-    Multi-process: the gang shares ONE directory; process 0 is the single
-    writer (manual atomic format — see _manual_save), every other process
-    skips the write. All processes rendezvous before returning so a
-    subsequent restore on any process happens-after the write.
+    gang=True: a multi-process gang shares ONE directory — process 0 is the
+    single writer (manual atomic format — see _manual_save), every other
+    process skips the write, and all processes rendezvous before returning
+    so a subsequent restore on any process happens-after the write. Callers
+    whose fit actually spans processes (mesh covers >1 process) must pass
+    True; a fit that is host-local inside a jax.distributed runtime must
+    pass False — its processes checkpoint independently (own directories,
+    no barrier; a global rendezvous here would deadlock hosts that converge
+    after different iteration counts). gang=None infers from
+    jax.process_count() (legacy behavior; correct only when every process
+    participates in the same fit).
     """
+    if gang is None:
+        gang = jax.process_count() > 1
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
-    multiprocess = jax.process_count() > 1
-    if jax.process_index() == 0:
+    if (not gang) or jax.process_index() == 0:
         payload = {
             "centroids": np.asarray(state.centroids),
             "n_iter": np.asarray(state.n_iter),
@@ -100,11 +110,15 @@ def save_checkpoint(ckpt_dir: str, state: ClusterState, step: int) -> str:
             "batch_cursor": np.asarray(state.batch_cursor),
             "meta": dict(state.meta),
         }
-        if multiprocess:
+        if jax.process_count() > 1:
+            # Any multi-process runtime uses the barrier-free manual writer:
+            # orbax's internal all-process rendezvous would desync (gang
+            # writes are process-0-only; independent writes happen at
+            # per-host times).
             _manual_save(path, payload)
         else:
             _checkpointer().save(path, payload, force=True)
-    if multiprocess:
+    if gang:
         from tdc_tpu.parallel.multihost import barrier
 
         barrier(f"tdc_ckpt_{step}")

@@ -412,3 +412,63 @@ def test_manual_save_overwrite_is_atomic_per_file(tmp_path):
     # no stray tmp files left behind
     leftovers = [n for n in os.listdir(path) if "tmp" in n]
     assert leftovers == []
+
+
+def test_independent_per_host_checkpoints_no_deadlock(tmp_path):
+    """Two jax.distributed processes each running their OWN host-local
+    streamed fit (mesh=None) with different iteration counts must both
+    checkpoint independently — no gang barrier (which would deadlock on the
+    mismatched save counts) and no process-0-only write gating."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    worker = textwrap.dedent("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        port, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+        jax.distributed.initialize(f"127.0.0.1:{port}", 2, pid)
+        import numpy as np
+        from tdc_tpu.models.streaming import streamed_kmeans_fit
+        rng = np.random.default_rng(pid)
+        X = rng.normal(size=(400, 3)).astype(np.float32)
+        def batches():
+            for i in range(0, 400, 100):
+                yield X[i:i + 100]
+        d = os.path.join(outdir, f"ck_{pid}")
+        # Different per-host iteration counts: a gang barrier would hang.
+        res = streamed_kmeans_fit(batches, 3, 3, init=X[:3],
+                                  max_iters=3 if pid == 0 else 7, tol=-1.0,
+                                  ckpt_dir=d, ckpt_every=1)
+        steps = [n for n in os.listdir(d) if n.startswith("step_")]
+        assert steps, f"process {pid} wrote no checkpoints: {os.listdir(d)}"
+        print("INDEP_OK", pid, len(steps), flush=True)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("exit")
+    """)
+    wf = tmp_path / "worker.py"
+    wf.write_text(worker)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(wf), str(port), str(i), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i}:\n{out[-2500:]}"
+        assert f"INDEP_OK {i}" in out
