@@ -93,11 +93,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference :310 approximation, for apples-to-apples "
                         "iters-to-converge comparisons; kmeans only)")
     p.add_argument("--class_sep", type=float, default=1.5)
-    p.add_argument("--kernel", type=str, default="xla", choices=("xla", "pallas"),
+    p.add_argument("--kernel", type=str, default=None,
+                   choices=("xla", "pallas"),
                    help="sufficient-stats kernel for K-Means: 'pallas' = "
                         "fused single-pass VMEM kernel (single-device and "
                         "mesh; with --shard_k, the blockwise online-argmin "
-                        "kernel runs inside each shard)")
+                        "kernel runs inside each shard). Default: 'xla', "
+                        "except --layout=auto may route narrow-d in-memory "
+                        "fits to the feature-major tall kernel; passing "
+                        "--kernel explicitly pins the sample-major layout")
     p.add_argument("--shard_k", type=int, default=1,
                    help="model-axis size: shard the K centroids this many "
                         "ways over a 2-D (data x model) mesh (the K=16,384 "
@@ -116,6 +120,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", type=str, default="float32",
                    choices=("float32", "bfloat16"),
                    help="device dtype for the points (bfloat16 = MXU fast path)")
+    p.add_argument("--layout", type=str, default="auto",
+                   choices=("auto", "samples", "features"),
+                   help="device storage layout for synthetic in-memory fits: "
+                        "'features' stores points (d, N) — the TPU-native "
+                        "layout for narrow d, where sample-major (N, d) "
+                        "storage pads d to 128 lanes (25.6x HBM at d=5; see "
+                        "ops/tall.py). 'auto' picks features on TPU when "
+                        "d <= 32 and the fit is an in-memory kmeans/fuzzy")
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="checkpoint/resume directory (streamed mode): saves "
                         "centroids+iteration via orbax and resumes if present")
@@ -205,6 +217,25 @@ def validate_args(parser, args):
         # These drivers have no checkpoint support; accepting the flag would
         # silently skip checkpointing AND corrupt the computation timing.
         parser.error("--ckpt_dir is not supported with --minibatch/--mean_combine")
+    if args.layout == "features":
+        if args.method_name not in ("distributedKMeans",
+                                    "distributedFuzzyCMeans"):
+            parser.error("--layout=features supports kmeans/fuzzy only")
+        for flag in ("streamed", "minibatch", "mean_combine", "native_loader"):
+            if getattr(args, flag):
+                parser.error(f"--layout=features is an in-memory device "
+                             f"layout; --{flag} is not supported with it")
+        if args.num_batches > 1 or args.shard_k > 1:
+            parser.error("--layout=features is single-batch, single-shard "
+                         "(it exists to make the full dataset fit in HBM)")
+        if args.weight_file:
+            parser.error("--layout=features does not support --weight_file")
+        if args.data_file:
+            parser.error("--layout=features requires synthetic data "
+                         "(on-device feature-major generation)")
+        if args.kernel is not None:
+            parser.error("--layout=features selects the tall kernel; "
+                         "--kernel cannot be combined with it")
 
 
 def run_experiment(args) -> dict:
@@ -246,6 +277,7 @@ def run_experiment(args) -> dict:
 
     timers = PhaseTimers()
 
+    use_features = False
     with timers.phase("setup"):
         if args.data_file:
             x, _ = load_points(args.data_file)
@@ -261,10 +293,41 @@ def run_experiment(args) -> dict:
             # enough that the OOM-adaptive batching fallback is plausible
             # (device-resident data would escape it). Generated directly in
             # the fit dtype so bf16 runs hold one device copy, not two.
-            needs_host = (
+            on_tpu = jax.devices()[0].platform == "tpu"
+            streamy = (
                 args.streamed or args.num_batches > 1 or args.minibatch
                 or args.mean_combine or args.shard_k > 1 or n_devices > 1
             )
+            feat_ok = (
+                args.method_name in ("distributedKMeans",
+                                     "distributedFuzzyCMeans")
+                and not streamy and not args.weight_file
+                # An explicit --kernel (even 'xla') pins the sample-major
+                # layout so benchmark runs stay comparable across flags.
+                and args.kernel is None
+            )
+            if args.layout == "features":
+                if not feat_ok:
+                    raise ValueError(
+                        "--layout=features requires an in-memory single-"
+                        "device kmeans/fuzzy fit with the default kernel"
+                    )
+                use_features = True
+            elif args.layout == "auto":
+                use_features = feat_ok and on_tpu and n_dim <= 32
+            itemsize = 2 if args.dtype == "bfloat16" else 4
+            if on_tpu:
+                # TPU HBM stores (sublane, lane) = (8·4/itemsize, 128) tiles:
+                # sample-major rows pad d to 128 lanes, feature-major columns
+                # pad d to the sublane multiple (ops/tall.py rationale).
+                sub = 8 * 4 // itemsize
+                per_pt = itemsize * (
+                    -(-n_dim // sub) * sub if use_features
+                    else -(-n_dim // 128) * 128
+                )
+            else:
+                per_pt = itemsize * n_dim
+            needs_host = streamy
             gen_dtype = np.float32
             if not needs_host:
                 try:
@@ -272,15 +335,24 @@ def run_experiment(args) -> dict:
                               .get("bytes_limit", 16 << 30))
                 except Exception:
                     hbm = 16 << 30
-                itemsize = 2 if args.dtype == "bfloat16" else 4
-                needs_host = n_obs * n_dim * itemsize > 0.4 * hbm
+                needs_host = n_obs * per_pt > 0.4 * hbm
                 if not needs_host and args.dtype == "bfloat16":
                     import jax.numpy as jnp
 
                     gen_dtype = jnp.bfloat16
+            if needs_host and use_features:
+                if args.layout == "features":
+                    raise ValueError(
+                        f"n_obs={n_obs} x d={n_dim} exceeds the HBM budget "
+                        "even feature-major; drop --layout=features and "
+                        "stream (--num_batches)"
+                    )
+                # Too big even feature-major → host generation + streaming.
+                use_features = False
             x, _ = make_blobs(args.seed + 1, n_obs, n_dim, max(args.K, 2),
                               class_sep=args.class_sep, to_host=needs_host,
-                              dtype=gen_dtype)
+                              dtype=gen_dtype,
+                              layout="features" if use_features else "samples")
         weights = None
         if args.weight_file:
             weights = np.load(args.weight_file)
@@ -307,10 +379,13 @@ def run_experiment(args) -> dict:
     def host_points():
         # Streamed paths need numpy. After an OOM fallback from a
         # device-resident dataset, convert once and REBIND x so the HBM copy
-        # is freed before the streamed retry doubles batches again.
-        nonlocal x
+        # is freed before the streamed retry doubles batches again. A
+        # feature-major device array comes back sample-major (the streamed
+        # drivers slice rows).
+        nonlocal x, use_features
         if not isinstance(x, np.ndarray):
-            x = np.asarray(x)
+            x = np.asarray(x).T if use_features else np.asarray(x)
+            use_features = False
         return x
 
     def fit(num_batches: int):
@@ -370,7 +445,8 @@ def run_experiment(args) -> dict:
             return streamed_kmeans_fit_sharded(
                 make_stream(rows), args.K, n_dim, mesh2d,
                 init=args.init, key=key, max_iters=args.n_max_iters,
-                tol=args.tol, spherical=args.spherical, kernel=args.kernel,
+                tol=args.tol, spherical=args.spherical,
+                kernel=args.kernel or "xla",
                 block_rows=block,
                 dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
                 prefetch=args.prefetch,
@@ -409,7 +485,10 @@ def run_experiment(args) -> dict:
             return fuzzy_cmeans_fit(
                 xx, args.K, m=args.fuzzifier, init=args.init, key=key,
                 max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
-                kernel=args.kernel, sample_weight=weights,
+                kernel=args.kernel or "xla",
+                sample_weight=weights,
+                layout="features" if use_features else "samples",
+                history=args.history_file is not None,
             )
         if streamed:
             rows = -(-n_obs // num_batches)
@@ -436,7 +515,10 @@ def run_experiment(args) -> dict:
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,
             tol=args.tol, spherical=args.spherical, mesh=mesh,
-            kernel=args.kernel, sample_weight=weights,
+            kernel=args.kernel or "xla",
+            sample_weight=weights,
+            layout="features" if use_features else "samples",
+            history=args.history_file is not None,
         )
 
     if args.profile_dir:
@@ -492,7 +574,8 @@ def run_experiment(args) -> dict:
     metrics = None
     if args.metrics:
         try:
-            metrics = _score_clustering(args, x, result, n_obs)
+            metrics = _score_clustering(args, x, result, n_obs,
+                                        features=use_features)
         except Exception as e:  # scoring must not discard a completed fit
             print(f"note: metrics scoring failed ({type(e).__name__}: {e}); "
                   "fit result reported without metrics", file=sys.stderr)
@@ -528,15 +611,19 @@ def run_experiment(args) -> dict:
         ),
         "converged": bool(result.converged),
         "num_batches": num_batches,
+        "tol": args.tol,
         "status": "ok",
         "_metrics": metrics,
     }
 
 
-def _score_clustering(args, x, result, n_obs: int) -> dict:
+def _score_clustering(args, x, result, n_obs: int, *,
+                      features: bool = False) -> dict:
     """Internal quality metrics on the fitted labels. Silhouette is O(N²), so
     it scores a seeded subsample (--metrics_sample, sklearn's sample_size
-    approach); DB/CH score the same subsample for consistency."""
+    approach); DB/CH score the same subsample for consistency. features=True
+    means x is the feature-major (d, N) device array (--layout=features);
+    the subsample comes back sample-major either way."""
     import jax.numpy as jnp
 
     from tdc_tpu.analysis.metrics import (
@@ -553,10 +640,14 @@ def _score_clustering(args, x, result, n_obs: int) -> dict:
                                                     replace=False)
         )
         # Device-resident x: gather on device, transfer only the sample.
-        xs = (x[idx] if isinstance(x, np.ndarray)
-              else np.asarray(jnp.asarray(x)[jnp.asarray(idx)]))
+        if features:
+            xs = np.asarray(jnp.asarray(x)[:, jnp.asarray(idx)].T)
+        elif isinstance(x, np.ndarray):
+            xs = x[idx]
+        else:
+            xs = np.asarray(jnp.asarray(x)[jnp.asarray(idx)])
     else:
-        xs = np.asarray(x)
+        xs = np.asarray(x).T if features else np.asarray(x)
     xs = xs.astype(np.float32)
     if args.spherical:
         # Score in the space the fit/predict operate in: cosine K-Means

@@ -49,8 +49,16 @@ def auto_batch_size(
 
 
 def is_oom_error(e: BaseException) -> bool:
+    # "would exceed memory": the tunneled-TPU (axon) backend reports
+    # compile-time HBM exhaustion as an Internal error with this message
+    # instead of RESOURCE_EXHAUSTED.
     msg = str(e)
-    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "out of memory" in msg
+    return (
+        "RESOURCE_EXHAUSTED" in msg
+        or "Out of memory" in msg
+        or "out of memory" in msg
+        or "would exceed memory" in msg
+    )
 
 
 def oom_adaptive(

@@ -19,18 +19,30 @@ import numpy as np
 
 def make_blobs(
     seed: int, n_obs: int, n_dim: int, k: int, *, class_sep: float = 1.5,
-    dtype=np.float32, to_host: bool = True
+    dtype=np.float32, to_host: bool = True, layout: str = "samples"
 ):
-    """Gaussian blobs: (X (n_obs, n_dim) dtype, y (n_obs,) int32).
+    """Gaussian blobs: (X, y (n_obs,) int32) with X (n_obs, n_dim) for
+    layout='samples' or (n_dim, n_obs) for layout='features'.
 
-    Generated in ≤2^24-row device chunks so 1B-row datasets don't need
-    1B-row device buffers. to_host=False keeps X/y on device (the whole
-    dataset must then fit in device memory) — for in-memory fits this skips
-    a device→host→device round trip of the full dataset, which through a
-    remote-tunnel device link costs orders of magnitude more than the
-    generation itself.
+    Generated in device chunks so 1B-row datasets don't need 1B-row device
+    buffers. to_host=False keeps X/y on device (the whole dataset must then
+    fit in device memory) — for in-memory fits this skips a device→host→device
+    round trip of the full dataset, which through a remote-tunnel device link
+    costs orders of magnitude more than the generation itself.
+
+    layout='features' generates the feature-major (d, N) storage directly on
+    device (no transposition of a sample-major buffer, which could not exist
+    at the sizes this layout is for — see ops/tall.py). The noise draw
+    differs from layout='samples' (the PRNG fills a transposed shape), so the
+    two layouts give different (equally-distributed, seed-deterministic)
+    datasets; centers match across layouts and chunkings.
     """
-    chunk = min(n_obs, 1 << 24)
+    features = layout == "features"
+    if not features and layout != "samples":
+        raise ValueError(f"unknown layout {layout!r}")
+    # Feature-major chunks cost pad8(d)·n bytes instead of pad128(d)·n, so
+    # they can be much longer.
+    chunk = min(n_obs, (1 << 26) if features else (1 << 24))
     key = jax.random.PRNGKey(seed)
     xs, ys = [], []
     remaining = n_obs
@@ -39,7 +51,8 @@ def make_blobs(
         # centers must match across chunks: derive them from the *seed*, not
         # the rolling key.
         n = min(chunk, remaining)
-        x, y = _blobs_chunk_fixed_centers(jax.random.PRNGKey(seed), kchunk, n, n_dim, k, class_sep)
+        gen = _blobs_chunk_fixed_centers_t if features else _blobs_chunk_fixed_centers
+        x, y = gen(jax.random.PRNGKey(seed), kchunk, n, n_dim, k, class_sep)
         if to_host:
             x, y = np.asarray(x, dtype=dtype), np.asarray(y)
         else:
@@ -50,7 +63,7 @@ def make_blobs(
     if len(xs) == 1:
         return xs[0], ys[0]
     cat = np.concatenate if to_host else jnp.concatenate
-    return cat(xs), cat(ys)
+    return cat(xs, axis=1) if features else cat(xs), cat(ys)
 
 
 @partial(jax.jit, static_argnames=("n", "d", "k"))
@@ -64,6 +77,32 @@ def _blobs_chunk_fixed_centers(
     labels = jax.random.randint(kl, (n,), 0, k)
     noise = jax.random.normal(kn, (n, d))
     return centers[labels] + noise, labels.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("n", "d", "k"))
+def _blobs_chunk_fixed_centers_t(
+    center_key: jax.Array, chunk_key: jax.Array, n: int, d: int, k: int, class_sep: float
+):
+    """Feature-major chunk: (d, n) built without any (n, d) intermediate.
+
+    The obvious `centers.T[:, labels]` lowers to an XLA gather whose output
+    is batch-major (n, d) + a transpose — exactly the 128-lane-padded buffer
+    this layout exists to avoid (51 GB at n=100M, d=5). A k-step scan of
+    masked adds keeps everything in (d, n) orientation; k is tiny here.
+    """
+    centers = (
+        jax.random.uniform(center_key, (k, d), minval=-1.0, maxval=1.0) * 2.0 * class_sep
+    )
+    kl, kn = jax.random.split(chunk_key)
+    labels = jax.random.randint(kl, (n,), 0, k)
+    noise = jax.random.normal(kn, (d, n))
+
+    def body(x, j):
+        mask = jnp.where(labels[None, :] == j, 1.0, 0.0)
+        return x + mask * centers.T[:, j][:, None], None
+
+    x, _ = jax.lax.scan(body, noise, jnp.arange(k))
+    return x, labels.astype(jnp.int32)
 
 
 def make_classification_data(seed: int, n_obs: int, n_dim: int, *, class_sep: float = 1.5):

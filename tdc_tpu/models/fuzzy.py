@@ -36,6 +36,10 @@ class FuzzyCMeansResult(NamedTuple):
 
 
 def _fuzzy_stats_fn(kernel: str, m: float, block_rows: int, mesh=None):
+    if kernel == "tall":
+        from tdc_tpu.ops.tall import fuzzy_stats_tall
+
+        return lambda x, c: fuzzy_stats_tall(x, c, m=m)
     if kernel == "pallas":
         if mesh is not None:
             from tdc_tpu.parallel.collectives import distributed_fuzzy_stats
@@ -57,7 +61,8 @@ def _fuzzy_stats_fn(kernel: str, m: float, block_rows: int, mesh=None):
 
 @partial(
     jax.jit,
-    static_argnames=("max_iters", "m", "block_rows", "kernel", "mesh"),
+    static_argnames=("max_iters", "m", "block_rows", "kernel", "mesh",
+                     "history"),
 )
 def _fcm_loop(
     x: jax.Array,
@@ -69,6 +74,7 @@ def _fcm_loop(
     kernel: str = "xla",
     mesh: jax.sharding.Mesh | None = None,
     w: jax.Array | None = None,
+    history: bool = False,
 ) -> FuzzyCMeansResult:
     if w is not None:
         from tdc_tpu.ops.assign import (
@@ -86,23 +92,33 @@ def _fcm_loop(
         stats_fn = _fuzzy_stats_fn(kernel, m, block_rows, mesh)
 
     def body(carry):
-        c, _, i, _ = carry
+        c, _, i, _, hist = carry
         stats = stats_fn(x, c)
         new_c = stats.weighted_sums / jnp.maximum(stats.weights[:, None], 1e-12)
         shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
-        return new_c, shift, i + 1, stats.objective
+        if history:
+            hist = jax.lax.dynamic_update_slice(
+                hist, jnp.stack([stats.objective, shift])[None, :], (i, 0)
+            )
+        return new_c, shift, i + 1, stats.objective, hist
 
     def cond(carry):
-        _, shift, i, _ = carry
+        _, shift, i, _, _ = carry
         return jnp.logical_and(i < max_iters, shift > tol)
 
+    hist0 = (
+        jnp.full((max_iters, 2), jnp.nan, jnp.float32)
+        if history
+        else jnp.zeros((0, 2), jnp.float32)
+    )
     init = (
         init_centroids.astype(jnp.float32),
         jnp.asarray(jnp.inf, jnp.float32),
         jnp.asarray(0, jnp.int32),
         jnp.asarray(jnp.inf, jnp.float32),
+        hist0,
     )
-    c, shift, n_iter, _ = jax.lax.while_loop(cond, body, init)
+    c, shift, n_iter, _, hist = jax.lax.while_loop(cond, body, init)
     final_obj = stats_fn(x, c).objective
     return FuzzyCMeansResult(
         centroids=c,
@@ -110,6 +126,7 @@ def _fcm_loop(
         objective=final_obj,
         shift=shift,
         converged=jnp.logical_and(shift <= jnp.maximum(tol, 0.0), n_iter > 0),
+        history=hist if history else None,
     )
 
 
@@ -125,6 +142,9 @@ def fuzzy_cmeans_fit(
     mesh: jax.sharding.Mesh | None = None,
     kernel: str = "xla",
     sample_weight=None,
+    layout: str = "samples",
+    history: bool = False,
+    init_sample: int = 1 << 18,
 ) -> FuzzyCMeansResult:
     """Fit Fuzzy C-Means. `tol < 0` forces exactly max_iters iterations
     (reference parity). With `mesh`, points are sharded over the data axis and
@@ -132,10 +152,38 @@ def fuzzy_cmeans_fit(
     fused single-pass VMEM kernel (no (N, K) membership matrix anywhere;
     inside a shard_map tower + psum when mesh is given). `sample_weight`
     ((N,) nonnegative) scales each point's u^m mass (sklearn parity; the
-    weighted path runs the f32 XLA stats)."""
+    weighted path runs the f32 XLA stats). layout='features' takes x as
+    (d, N) and runs the tall Pallas kernel (ops/tall.py — the TPU-native
+    storage for narrow d); history=True records (objective, shift) per
+    iteration; init_sample bounds the init subsample in 'features' layout
+    (see kmeans_fit)."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     x = jnp.asarray(x)
+    if layout not in ("samples", "features"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "features":
+        if mesh is not None or sample_weight is not None:
+            raise ValueError(
+                "layout='features' does not support mesh/sample_weight yet"
+            )
+        if kernel not in ("xla", "tall"):
+            # 'xla' (the signature default) is accepted and means "unset".
+            raise ValueError(
+                f"layout='features' runs the tall kernel; kernel={kernel!r} "
+                "is not supported with it"
+            )
+        xs = x[:, : min(x.shape[1], init_sample)].T.astype(jnp.float32)
+        c_init = resolve_init(xs, k, init, key)
+        res = _fcm_loop(
+            x, c_init, int(max_iters), float(tol), float(m), 0, "tall",
+            None, None, bool(history),
+        )
+        if history:
+            res = res._replace(
+                history=np.asarray(res.history)[: int(res.n_iter)]
+            )
+        return res
     w = None
     if sample_weight is not None:
         w = jnp.asarray(sample_weight, jnp.float32)
@@ -169,10 +217,14 @@ def fuzzy_cmeans_fit(
         from tdc_tpu.models.kmeans import auto_block_rows
 
         block_rows = auto_block_rows(x.shape[0], k)
-    return _fcm_loop(
+    res = _fcm_loop(
         x, c_init, int(max_iters), float(tol), float(m), block_rows, kernel,
         mesh if (kernel == "pallas" and w is None) else None, w,
+        bool(history),
     )
+    if history:
+        res = res._replace(history=np.asarray(res.history)[: int(res.n_iter)])
+    return res
 
 
 def fuzzy_predict(x, centroids, *, m: float = 2.0, soft: bool = False,

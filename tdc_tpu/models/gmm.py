@@ -316,6 +316,7 @@ def streamed_gmm_fit(
     # on every supervised-gang relaunch.
     start_iter = 0
     prev_ll = -float("inf")
+    saved_final_ll = None
     resume_converged = False
     restored = False
     means = variances = weights = None
@@ -345,6 +346,10 @@ def streamed_gmm_fit(
             # iteration's ll (the uninterrupted loop assigns prev_ll = ll
             # after each step).
             prev_ll = float(saved.meta.get("ll", -float("inf")))
+            # The ll of the RETURNED parameters, written by the finishing
+            # run's final scoring pass (meta "ll" is the E-step ll of the
+            # pre-M-step params and must not stand in for it).
+            saved_final_ll = saved.meta.get("final_ll")
             resume_converged = bool(
                 np.asarray(saved.meta.get("converged", False))
             )
@@ -384,7 +389,7 @@ def streamed_gmm_fit(
         {dev.process_index for dev in mesh.devices.ravel()}
     ) > 1
 
-    def save(n_iter, ll, done):
+    def save(n_iter, ll, done, final_ll=None):
         from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
 
         save_checkpoint(
@@ -397,6 +402,8 @@ def streamed_gmm_fit(
                     "variances": np.asarray(variances),
                     "weights": np.asarray(weights),
                     "ll": float(ll), "converged": bool(done),
+                    **({"final_ll": float(final_ll)}
+                       if final_ll is not None else {}),
                 },
             ),
             step=n_iter,
@@ -414,6 +421,8 @@ def streamed_gmm_fit(
             z = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), z)
         return z
 
+    crosschecked = [False]
+
     def full_pass(means, variances, weights):
         rows_total = [0]
 
@@ -426,7 +435,12 @@ def streamed_gmm_fit(
                 n_local,
             )
 
-        acc = _run_pass(batches, prefetch, zero_stats, step)
+        # Cross-host per-pass row-total validation on the first pass only
+        # (same protection as the streamed kmeans/fuzzy drivers).
+        cm = None if crosschecked[0] else mesh
+        crosschecked[0] = True
+        acc = _run_pass(batches, prefetch, zero_stats, step,
+                        crosscheck_mesh=cm)
         return acc, rows_total[0]
 
     ll = prev_ll
@@ -446,9 +460,22 @@ def streamed_gmm_fit(
             converged = True
             break
         prev_ll = ll
-    # Final log-likelihood of the returned parameters.
-    acc, n_rows = full_pass(means, variances, weights)
-    final_ll = float(acc.ll_sum) / max(n_rows, 1)
+    resume_done = resume_converged or start_iter >= max_iters
+    if resume_done and saved_final_ll is not None:
+        # No-op resume of a finished checkpoint: the finishing run already
+        # scored the returned parameters and persisted that ll — reuse it
+        # instead of re-streaming the entire dataset (round-2 advisor
+        # finding; the extra pass doubled no-op-resume wall-clock on
+        # out-of-core data). Old checkpoints without final_ll fall through
+        # to the (correct, slower) scoring pass.
+        final_ll = float(saved_final_ll)
+    else:
+        # Final log-likelihood of the returned parameters.
+        acc, n_rows = full_pass(means, variances, weights)
+        final_ll = float(acc.ll_sum) / max(n_rows, 1)
+        if ckpt_dir is not None and (converged or n_iter >= max_iters):
+            # Persist it so the next no-op resume can skip this pass.
+            save(n_iter, ll, converged, final_ll=final_ll)
     return GMMResult(
         means=means, variances=variances, weights=weights,
         n_iter=jnp.asarray(n_iter, jnp.int32),

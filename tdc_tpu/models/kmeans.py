@@ -45,6 +45,10 @@ def _normalize(c: jax.Array) -> jax.Array:
 
 
 def _stats_fn(kernel: str, block_rows: int, mesh=None):
+    if kernel == "tall":
+        from tdc_tpu.ops.tall import lloyd_stats_tall
+
+        return lloyd_stats_tall
     if kernel == "xla":
         if block_rows:
             from tdc_tpu.ops.assign import lloyd_stats_padded_blocked
@@ -89,7 +93,9 @@ def auto_block_rows(n: int, k: int, *, budget_bytes: int | None = None) -> int:
 
 @partial(
     jax.jit,
-    static_argnames=("max_iters", "spherical", "kernel", "block_rows", "mesh"),
+    static_argnames=(
+        "max_iters", "spherical", "kernel", "block_rows", "mesh", "history"
+    ),
 )
 def _lloyd_loop(
     x: jax.Array,
@@ -101,11 +107,17 @@ def _lloyd_loop(
     block_rows: int = 0,
     mesh: jax.sharding.Mesh | None = None,
     w: jax.Array | None = None,
+    history: bool = False,
 ) -> KMeansResult:
     """One traced Lloyd loop. tol < 0 disables the convergence test (reference
     fixed-iteration parity mode). `mesh` is only consulted by the pallas
     kernel (explicit shard_map body); the xla path distributes via the input
-    sharding. `w` (sample weights) routes to the weighted XLA stats."""
+    sharding. `w` (sample weights) routes to the weighted XLA stats.
+    history=True additionally records (sse, shift) per iteration into a
+    (max_iters, 2) buffer (NaN rows beyond n_iter) — the curve the reference
+    commented out "for performance" (visualization.ipynb#cell5), same row
+    semantics as the streamed fit: row i = cost at the iteration's *input*
+    centroids + that iteration's shift."""
     if w is not None:
         from tdc_tpu.ops.assign import (
             lloyd_stats_weighted,
@@ -122,24 +134,33 @@ def _lloyd_loop(
         stats_fn = _stats_fn(kernel, block_rows, mesh)
 
     def body(carry):
-        c, _, i, _ = carry
+        c, _, i, _, hist = carry
         stats = stats_fn(x, c)
         new_c = apply_centroid_update(stats, c)
         if spherical:
             new_c = _normalize(new_c)
         shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
-        return new_c, shift, i + 1, stats.sse
+        if history:
+            hist = jax.lax.dynamic_update_slice(
+                hist, jnp.stack([stats.sse, shift])[None, :], (i, 0)
+            )
+        return new_c, shift, i + 1, stats.sse, hist
 
     def cond(carry):
-        _, shift, i, _ = carry
+        _, shift, i, _, _ = carry
         return jnp.logical_and(i < max_iters, shift > tol)
 
     c0 = init_centroids.astype(jnp.float32)
     if spherical:
         c0 = _normalize(c0)
+    hist0 = (
+        jnp.full((max_iters, 2), jnp.nan, jnp.float32)
+        if history
+        else jnp.zeros((0, 2), jnp.float32)
+    )
     init = (c0, jnp.asarray(jnp.inf, jnp.float32), jnp.asarray(0, jnp.int32),
-            jnp.asarray(jnp.inf, jnp.float32))
-    c, shift, n_iter, sse = jax.lax.while_loop(cond, body, init)
+            jnp.asarray(jnp.inf, jnp.float32), hist0)
+    c, shift, n_iter, sse, hist = jax.lax.while_loop(cond, body, init)
     # The SSE in the carry is measured *before* the final update; recompute the
     # final cost once so the reported SSE matches the returned centroids.
     final_sse = stats_fn(x, c).sse
@@ -149,6 +170,7 @@ def _lloyd_loop(
         sse=final_sse,
         shift=shift,
         converged=jnp.logical_and(shift <= jnp.maximum(tol, 0.0), n_iter > 0),
+        history=hist if history else None,
     )
 
 
@@ -195,6 +217,9 @@ def kmeans_fit(
     kernel: str = "xla",
     sample_weight=None,
     n_init: int = 1,
+    layout: str = "samples",
+    history: bool = False,
+    init_sample: int = 1 << 18,
 ) -> KMeansResult:
     """Fit K-Means.
 
@@ -227,8 +252,35 @@ def kmeans_fit(
         ops/pallas_kernels.lloyd_stats_fused). With `mesh`, pallas runs
         inside a shard_map tower per device with a psum of the sufficient
         stats (parallel/collectives.distributed_lloyd_stats).
+      layout: 'samples' (x is (N, d), default) or 'features' (x is (d, N),
+        the TPU-native storage for narrow d — see ops/tall.py: at d=5 the
+        sample-major layout pads 25.6× in HBM, feature-major 1.6×). The
+        'features' path runs the tall Pallas kernels; mesh/sample_weight are
+        not yet supported there.
+      history: also record (sse, shift) per iteration (see _lloyd_loop);
+        result.history has exactly n_iter rows.
+      init_sample: 'features' layout only — stochastic inits run on the
+        first `init_sample` points (transposed to a small sample-major
+        block); full-data init would need the sample-major buffer the layout
+        exists to avoid.
     """
     x = jnp.asarray(x)  # before the restart loop: one host→device transfer
+    if layout not in ("samples", "features"):
+        raise ValueError(f"unknown layout {layout!r}")
+    features = layout == "features"
+    if features:
+        if mesh is not None or sample_weight is not None:
+            raise ValueError(
+                "layout='features' does not support mesh/sample_weight yet"
+            )
+        if kernel not in ("xla", "tall"):
+            # 'xla' (the signature default) is accepted and means "unset";
+            # an explicit different kernel must not be silently discarded.
+            raise ValueError(
+                f"layout='features' runs the tall kernel; kernel={kernel!r} "
+                "is not supported with it"
+            )
+        kernel = "tall"
     stochastic = isinstance(init, str) and init != "first_k"
     if n_init > 1 and stochastic:
         keys = jax.random.split(
@@ -239,11 +291,28 @@ def kmeans_fit(
             res = kmeans_fit(
                 x, k, init=init, key=ki, max_iters=max_iters, tol=tol,
                 spherical=spherical, mesh=mesh, kernel=kernel,
-                sample_weight=sample_weight, n_init=1,
+                sample_weight=sample_weight, n_init=1, layout=layout,
+                history=history, init_sample=init_sample,
             )
             if best is None or float(res.sse) < float(best.sse):
                 best = res
         return best
+
+    if features:
+        if spherical:
+            x = x.astype(jnp.float32)
+            x = x / jnp.maximum(jnp.linalg.norm(x, axis=0, keepdims=True), 1e-12)
+        xs = x[:, : min(x.shape[1], init_sample)].T.astype(jnp.float32)
+        c_init = resolve_init(xs, k, init, key)
+        res = _lloyd_loop(
+            x, c_init, int(max_iters), float(tol), bool(spherical), "tall",
+            0, None, None, bool(history),
+        )
+        if history:
+            res = res._replace(
+                history=np.asarray(res.history)[: int(res.n_iter)]
+            )
+        return res
 
     block_rows = 0
     if mesh is None and (kernel == "xla" or sample_weight is not None):
@@ -284,11 +353,14 @@ def kmeans_fit(
         c_init = mesh_lib.replicate(c_init, mesh)
     else:
         c_init = resolve_init(x, k, init, key, w)
-    return _lloyd_loop(
+    res = _lloyd_loop(
         x, c_init, int(max_iters), float(tol), bool(spherical), kernel,
         block_rows, mesh if (kernel == "pallas" and w is None) else None,
-        w,
+        w, bool(history),
     )
+    if history:
+        res = res._replace(history=np.asarray(res.history)[: int(res.n_iter)])
+    return res
 
 
 def kmeans_predict(

@@ -118,6 +118,7 @@ def _run_pass(
     acc0=None,
     rows0: int = 0,
     save_args=None,
+    crosscheck_mesh=None,
 ):
     """One accumulation pass over the stream — the loop shared by the
     streamed kmeans and fuzzy fits.
@@ -170,6 +171,8 @@ def _run_pass(
             # cursor — layout definitely changed.
             mismatch = True
         if not mismatch:
+            if crosscheck_mesh is not None:
+                _crosscheck_pass_rows(crosscheck_mesh, rows)
             return acc
         import sys
 
@@ -226,6 +229,30 @@ def _prepare_batch(batch, mesh):
     n_dev = int(np.prod(mesh.devices.shape))
     padded, _ = mesh_lib.pad_to_multiple(batch, n_dev, fill_value=0.0)
     return mesh_lib.shard_points(padded, mesh), n_local, n_local
+
+
+def _crosscheck_pass_rows(mesh, rows: int) -> None:
+    """End-of-pass counterpart of _check_equal_local_rows: a host whose
+    stream diverges in ROW TOTALS on a later batch (ragged tail) gets a
+    clear error pointing at batch sizing instead of a wrong accumulation
+    (round-2 advisor finding). One cheap allgather of this host's per-pass
+    row total, run on the first full pass only. Limitation: hosts with
+    different BATCH COUNTS still hang/die inside the per-batch collective
+    before reaching this check — only equal-batch-count divergence is
+    diagnosable post-pass."""
+    if mesh is None or _mesh_layout(mesh)[0] <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    counts = np.asarray(multihost_utils.process_allgather(np.int64(rows)))
+    if not (counts == counts.flat[0]).all():
+        raise ValueError(
+            "multi-process streamed fit: per-pass row totals diverge "
+            f"across hosts ({counts.ravel().tolist()}) — every host must "
+            "stream the same local row count per pass (ragged tail or "
+            "unequal batch counts somewhere after the first batch); use "
+            "host_shard_bounds with totals divisible by the process count"
+        )
 
 
 def _check_equal_local_rows(batches, first, mesh):
@@ -580,6 +607,7 @@ def streamed_kmeans_fit(
             stream, prefetch, zero_stats, step,
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
+            crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
         )
 
     n_iter = start_iter
@@ -822,6 +850,7 @@ def streamed_fuzzy_fit(
             stream, prefetch, zero_stats, step,
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
+            crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
         )
 
     n_iter = start_iter

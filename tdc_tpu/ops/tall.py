@@ -1,0 +1,331 @@
+"""Feature-major (tall) kernels for the narrow-d regime — the reference's own
+benchmark shape (d=5).
+
+Why a second layout exists: TPU HBM stores a 2-D f32 array in (8, 128)
+sublane×lane tiles, so a sample-major (N, d) buffer pads the minor axis
+d → 128. At the reference grid's d=5 that is a 25.6× memory (and bandwidth)
+blow-up — f32[100M, 5] costs 51.2 GB and cannot exist on a 16 GB chip, which
+is structurally the same wall the reference hit (its n_obs ≥ 50M rows all
+died, scripts/executions_log.csv). Storing the points feature-major as (d, N)
+pads d only to the 8-sublane multiple: 1.6× at d=5, so 100M×5 is 3.2 GB and a
+full Lloyd iteration is one bandwidth-bound pass over it.
+
+These kernels are the fused single-pass sufficient-stats kernels
+(pallas_kernels.lloyd_stats_fused / fuzzy_stats_fused) transposed: the grid
+walks N-blocks of the (d, N) array, distances are computed as a
+(K, d) × (d, BN) MXU contraction giving (K, BN) tiles, the argmin/membership
+reductions run over the K sublane axis, and the (K, d) accumulators live in
+VMEM scratch. No (N, K) or (K, N) buffer ever exists in HBM.
+
+Reference counterpart: the per-tower tile/subtract/square/reduce/argmin body
+(scripts/distribuitedClustering.py:207-251 for K-Means, :117-148 for fuzzy) —
+re-laid-out for the TPU memory system instead of translated.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tdc_tpu.ops.pallas_kernels import _PAD_CENTROID, _ARG_SENTINEL, _pad_axis
+
+
+def tall_block_n(
+    k: int,
+    d: int,
+    itemsize: int = 4,
+    *,
+    temps: int = 3,
+    budget: int = 14 << 20,
+    cap: int = 1 << 15,
+) -> int:
+    """Largest N-block (multiple of 128, ≤ cap) whose tall-kernel VMEM
+    footprint fits the scoped-vmem budget, or 0 if even a 128-column block
+    does not fit (huge K·d — use the sample-major kernels there; tall layout
+    only wins at small d anyway).
+
+    Footprint model: resident (K_s, d8) f32 accumulator + output + centroid
+    tile + per-K vectors, plus per point column: the x tile (d8 sublanes ×
+    itemsize) and `temps` live (K_s, BN) f32 temporaries across the
+    distance → reduce → accumulate chain (≈3 for Lloyd: cross/d2, masked
+    iota, one-hot; ≈5 for fuzzy: cross/d2, inv, u, mu + one live extra).
+    """
+    k_s = -(-k // 8) * 8
+    d8 = -(-d // 8) * 8
+    fixed = k_s * max(d8, 128) * (8 + itemsize) + 32 * k_s
+    per_col = temps * k_s * 4 + d8 * itemsize + 8
+    avail = budget - fixed
+    if avail < 128 * per_col:
+        return 0
+    return int(min(cap, avail // per_col // 128 * 128))
+
+
+def _tall_lloyd_kernel(
+    xt_ref, c_ref, c2_ref, sums_ref, counts_ref, sse_ref,
+    acc_sums, acc_counts, acc_sse,
+):
+    """Grid over N-blocks of the (d8, N) array; K fully VMEM-resident.
+    Per block: (K_s, BN) distance tile via one MXU contraction → argmin over
+    the K sublane axis (masked-iota trick; jnp.argmin doesn't legalize) →
+    exact one-hot → MXU accumulate into (K_s, d8) VMEM scratch."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_sums[...] = jnp.zeros_like(acc_sums)
+        acc_counts[...] = jnp.zeros_like(acc_counts)
+        acc_sse[...] = jnp.zeros_like(acc_sse)
+
+    xt = xt_ref[...]  # (d8, BN)
+    xf = xt.astype(jnp.float32)
+    x2 = jnp.sum(xf * xf, axis=0, keepdims=True)  # (1, BN)
+    # Same formulation/order/precision as ops.distance.pairwise_sq_dist so
+    # boundary points assign identically to the XLA path.
+    prec = (
+        jax.lax.Precision.DEFAULT
+        if xt.dtype == jnp.bfloat16
+        else jax.lax.Precision.HIGHEST
+    )
+    cross = jax.lax.dot_general(
+        c_ref[...],
+        xt,
+        (((1,), (0,)), ((), ())),
+        precision=prec,
+        preferred_element_type=jnp.float32,
+    )  # (K_s, BN)
+    d2 = jnp.maximum(x2 - 2.0 * cross + c2_ref[...], 0.0)
+    tile_min = jnp.min(d2, axis=0, keepdims=True)  # (1, BN)
+    row = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 0)
+    masked = jnp.where(d2 <= tile_min, row, _ARG_SENTINEL)
+    tile_arg = jnp.min(masked, axis=0, keepdims=True)  # (1, BN)
+    one_hot = (row == tile_arg).astype(jnp.float32)  # (K_s, BN), single 1/col
+    acc_sums[...] += jax.lax.dot_general(
+        one_hot,
+        xf,
+        (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # (K_s, d8)
+    acc_counts[...] += jnp.sum(one_hot, axis=1, keepdims=True)
+    acc_sse[...] += jnp.sum(tile_min)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        sums_ref[...] = acc_sums[...]
+        counts_ref[...] = acc_counts[...]
+        sse_ref[...] = acc_sse[...]
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def lloyd_stats_tall(
+    xt: jax.Array,
+    centroids: jax.Array,
+    *,
+    block_n: int | None = None,
+    interpret: bool | None = None,
+):
+    """Lloyd sufficient stats over feature-major points.
+
+    Args:
+      xt: (d, N) points — note the transposed storage; this is the layout
+        that makes narrow-d datasets (d ≲ 32) fit TPU HBM without the
+        128-lane padding blow-up.
+      centroids: (K, d), standard orientation (API-compatible with
+        ops.assign.lloyd_stats).
+
+    Returns ops.assign.SufficientStats (sums (K, d) f32, counts (K,) f32,
+    sse () f32), matching lloyd_stats(xt.T, centroids) exactly in f32.
+    """
+    from tdc_tpu.ops.assign import SufficientStats
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    d, n = xt.shape
+    k = centroids.shape[0]
+    if block_n is None:
+        block_n = tall_block_n(k, d, xt.dtype.itemsize)
+        if block_n == 0:
+            raise ValueError(
+                f"lloyd_stats_tall: K={k} too large for VMEM; use the "
+                "sample-major kernels (tall layout only wins at small d)"
+            )
+    xp = _pad_axis(_pad_axis(xt, 0, 8, 0), 1, block_n, 0)
+    cp = _pad_axis(
+        _pad_axis(centroids.astype(xt.dtype), 1, 8, 0), 0, 8, _PAD_CENTROID
+    )
+    c2 = jnp.sum(cp.astype(jnp.float32) ** 2, axis=1, keepdims=True)  # (K_s, 1)
+    d8, n_pad = xp.shape
+    k_s = cp.shape[0]
+
+    sums, counts, sse = pl.pallas_call(
+        _tall_lloyd_kernel,
+        grid=(n_pad // block_n,),
+        in_specs=[
+            pl.BlockSpec((d8, block_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_s, d8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_s, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_s, d8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_s, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_s, d8), jnp.float32),
+            jax.ShapeDtypeStruct((k_s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k_s, d8), jnp.float32),
+            pltpu.VMEM((k_s, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp, cp, c2)
+    # Padded zero columns land on the argmin-‖c‖² cluster with zero Σx but
+    # count/sse pollution — subtract exactly (same correction as the fused
+    # sample-major kernel).
+    n_fake = n_pad - n
+    counts = counts[:k, 0]
+    sse = sse[0, 0]
+    if n_fake:
+        c2v = c2[:k, 0]
+        j = jnp.argmin(c2v)
+        counts = counts.at[j].add(-float(n_fake))
+        sse = sse - n_fake * c2v[j]
+    return SufficientStats(
+        sums=sums[:k, :d],
+        counts=counts,
+        sse=jnp.maximum(sse, 0.0),
+    )
+
+
+def _tall_fuzzy_kernel(
+    xt_ref, c_ref, c2_ref, wsums_ref, weights_ref, obj_ref,
+    acc_wsums, acc_weights, acc_obj, *, m: float, eps: float,
+):
+    """Fuzzy counterpart: true distances (‖x‖² recovered as the block's
+    column sums) → memberships normalized over the K sublane axis →
+    u^m-weighted MXU accumulate. The (N, K) membership matrix never exists."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_wsums[...] = jnp.zeros_like(acc_wsums)
+        acc_weights[...] = jnp.zeros_like(acc_weights)
+        acc_obj[...] = jnp.zeros_like(acc_obj)
+
+    xt = xt_ref[...]  # (d8, BN)
+    xf = xt.astype(jnp.float32)
+    x2 = jnp.sum(xf * xf, axis=0, keepdims=True)  # (1, BN)
+    prec = (
+        jax.lax.Precision.DEFAULT
+        if xt.dtype == jnp.bfloat16
+        else jax.lax.Precision.HIGHEST
+    )
+    cross = jax.lax.dot_general(
+        c_ref[...],
+        xt,
+        (((1,), (0,)), ((), ())),
+        precision=prec,
+        preferred_element_type=jnp.float32,
+    )  # (K_s, BN)
+    d2 = jnp.maximum(x2 - 2.0 * cross + c2_ref[...], 0.0)
+    inv = (d2 + eps) ** (-1.0 / (m - 1.0))  # padded-centroid rows → ~0
+    u = inv / jnp.sum(inv, axis=0, keepdims=True)
+    mu = u**m  # (K_s, BN)
+    acc_wsums[...] += jax.lax.dot_general(
+        mu,
+        xf,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (K_s, d8)
+    acc_weights[...] += jnp.sum(mu, axis=1, keepdims=True)
+    acc_obj[...] += jnp.sum(mu * d2)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        wsums_ref[...] = acc_wsums[...]
+        weights_ref[...] = acc_weights[...]
+        obj_ref[...] = acc_obj[...]
+
+
+@functools.partial(jax.jit, static_argnames=("m", "eps", "block_n", "interpret"))
+def fuzzy_stats_tall(
+    xt: jax.Array,
+    centroids: jax.Array,
+    m: float = 2.0,
+    eps: float = 1e-9,
+    *,
+    block_n: int | None = None,
+    interpret: bool | None = None,
+):
+    """Fuzzy c-means sufficient stats over feature-major (d, N) points —
+    matches ops.assign.fuzzy_stats(xt.T, centroids, m) in f32. Same storage
+    rationale as lloyd_stats_tall."""
+    from tdc_tpu.ops.assign import FuzzyStats
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    d, n = xt.shape
+    k = centroids.shape[0]
+    if block_n is None:
+        block_n = tall_block_n(k, d, xt.dtype.itemsize, temps=5)
+        if block_n == 0:
+            raise ValueError(
+                f"fuzzy_stats_tall: K={k} too large for VMEM; use the "
+                "sample-major kernels (tall layout only wins at small d)"
+            )
+    xp = _pad_axis(_pad_axis(xt, 0, 8, 0), 1, block_n, 0)
+    cp = _pad_axis(
+        _pad_axis(centroids.astype(xt.dtype), 1, 8, 0), 0, 8, _PAD_CENTROID
+    )
+    c2 = jnp.sum(cp.astype(jnp.float32) ** 2, axis=1, keepdims=True)  # (K_s, 1)
+    d8, n_pad = xp.shape
+    k_s = cp.shape[0]
+
+    wsums, weights, obj = pl.pallas_call(
+        functools.partial(_tall_fuzzy_kernel, m=float(m), eps=float(eps)),
+        grid=(n_pad // block_n,),
+        in_specs=[
+            pl.BlockSpec((d8, block_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_s, d8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_s, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_s, d8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_s, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_s, d8), jnp.float32),
+            jax.ShapeDtypeStruct((k_s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k_s, d8), jnp.float32),
+            pltpu.VMEM((k_s, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp, cp, c2)
+    # Padded zero columns contribute ‖c‖²-softmin memberships (zero Σ u^m x
+    # but nonzero weights/objective) — subtract their exact contribution.
+    n_fake = n_pad - n
+    weights = weights[:k, 0]
+    obj = obj[0, 0]
+    if n_fake:
+        from tdc_tpu.ops.assign import fuzzy_stats
+
+        zs = fuzzy_stats(jnp.zeros((1, d), jnp.float32), centroids, m=m, eps=eps)
+        weights = weights - n_fake * zs.weights
+        obj = obj - n_fake * zs.objective
+    return FuzzyStats(
+        weighted_sums=wsums[:k, :d],
+        weights=weights,
+        objective=jnp.maximum(obj, 0.0),
+    )

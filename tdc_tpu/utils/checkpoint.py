@@ -157,16 +157,38 @@ def restore_checkpoint(ckpt_dir: str, step: int | None = None) -> ClusterState |
     if step is None:
         import sys
 
-        for cand in reversed(_all_steps(ckpt_dir)):
+        # The per-step catch stays broad: a truncated orbax step can raise
+        # types well outside OSError/ValueError (msgpack/orbax internals),
+        # and aborting the scan would skip an older valid step. Systematic
+        # failure is detected AFTER the scan instead: several steps, none
+        # loadable, cannot be crash truncation.
+        steps = _all_steps(ckpt_dir)
+        errors = []
+        for cand in reversed(steps):
             try:
                 return restore_checkpoint(ckpt_dir, cand)
             except Exception as e:  # truncated/corrupt step: fall back
+                errors.append((cand, e))
                 print(
                     f"note: checkpoint step {cand} in {ckpt_dir} is "
                     f"unreadable ({type(e).__name__}: {e}); trying the "
                     "previous step",
                     file=sys.stderr,
                 )
+        if len(steps) > 1:
+            # Several checkpoints exist and NONE load: that is a systematic
+            # error (permissions, format drift), not crash truncation — fail
+            # fast rather than silently recompute a multi-hour fit (round-2
+            # advisor finding). A SINGLE unreadable step stays a warn-and-
+            # restart: a crash while writing the very first checkpoint is the
+            # expected truncation case, and raising would crash-loop the gang
+            # supervisor's relaunches forever.
+            raise RuntimeError(
+                f"checkpoint dir {ckpt_dir} has {len(steps)} steps but "
+                "none could be loaded — refusing to silently restart from "
+                f"scratch; last error: {type(errors[-1][1]).__name__}: "
+                f"{errors[-1][1]} (delete the directory to start fresh)"
+            )
         return None
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
     if os.path.exists(os.path.join(path, "state.npz")):

@@ -33,6 +33,7 @@ EXTENDED_COLUMNS = REFERENCE_COLUMNS + [
     "sse",
     "converged",
     "num_batches",
+    "tol",  # convergence tolerance; negative = fixed-iteration parity mode
     "status",
 ]
 
@@ -48,8 +49,15 @@ def ensure_log_file(path: str, columns=None) -> None:
 
 
 def append_result_row(path: str, row: dict, columns=None) -> None:
+    """Append one row. An existing file's header wins over the current
+    schema: appending EXTENDED_COLUMNS-shaped rows to a CSV created under an
+    older (shorter) schema would silently shift cells under wrong headers."""
     columns = columns or EXTENDED_COLUMNS
     ensure_log_file(path, columns)
+    with open(path, newline="") as f:
+        existing = next(csv.reader(f), None)
+    if existing:
+        columns = existing
     with open(path, "a", newline="") as f:
         csv.writer(f).writerow([row.get(c, "") for c in columns])
 

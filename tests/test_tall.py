@@ -1,0 +1,200 @@
+"""Feature-major (tall) kernel + layout tests — interpret mode on CPU; the
+same kernels run compiled on TPU (the committed reference-grid dataset)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tdc_tpu.ops.assign import fuzzy_stats, lloyd_stats
+from tdc_tpu.ops.tall import (
+    fuzzy_stats_tall,
+    lloyd_stats_tall,
+    tall_block_n,
+)
+
+
+@pytest.mark.parametrize("n,d,k", [(1000, 5, 15), (777, 3, 7), (1300, 12, 3)])
+def test_lloyd_tall_matches_sample_major(rng, n, d, k):
+    x = (rng.normal(size=(n, d)) * 2).astype(np.float32)
+    c = rng.normal(size=(k, d)).astype(np.float32)
+    want = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    got = lloyd_stats_tall(jnp.asarray(x.T), jnp.asarray(c), block_n=256)
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+    np.testing.assert_allclose(np.asarray(got.sums), np.asarray(want.sums),
+                               rtol=1e-5, atol=1e-3)
+    np.testing.assert_allclose(float(got.sse), float(want.sse), rtol=1e-5)
+
+
+def test_lloyd_tall_pad_correction(rng):
+    # N not a block multiple and no point near the origin: the zero-column
+    # correction must remove the padding exactly.
+    x = (rng.normal(size=(130, 5)) + 5.0).astype(np.float32)
+    c = np.array([[5.0] * 5, [0.1] * 5], np.float32)
+    want = lloyd_stats(jnp.asarray(x), jnp.asarray(c))
+    got = lloyd_stats_tall(jnp.asarray(x.T), jnp.asarray(c), block_n=128)
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(want.counts))
+    np.testing.assert_allclose(float(got.sse), float(want.sse), rtol=1e-5)
+
+
+@pytest.mark.parametrize("n,d,k", [(1000, 5, 15), (777, 3, 7)])
+def test_fuzzy_tall_matches_sample_major(rng, n, d, k):
+    x = (rng.normal(size=(n, d)) * 2).astype(np.float32)
+    c = rng.normal(size=(k, d)).astype(np.float32)
+    want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=2.0)
+    got = fuzzy_stats_tall(jnp.asarray(x.T), jnp.asarray(c), m=2.0, block_n=256)
+    np.testing.assert_allclose(np.asarray(got.weighted_sums),
+                               np.asarray(want.weighted_sums),
+                               rtol=1e-3, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(got.weights),
+                               np.asarray(want.weights), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(float(got.objective), float(want.objective),
+                               rtol=1e-4)
+
+
+def test_fuzzy_tall_fuzzifier(rng):
+    x = rng.normal(size=(400, 4)).astype(np.float32)
+    c = rng.normal(size=(5, 4)).astype(np.float32)
+    for m in (1.5, 3.0):
+        want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=m)
+        got = fuzzy_stats_tall(jnp.asarray(x.T), jnp.asarray(c), m=m,
+                               block_n=128)
+        np.testing.assert_allclose(np.asarray(got.weights),
+                                   np.asarray(want.weights),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_tall_block_n_model():
+    assert tall_block_n(15, 5) > 0
+    assert tall_block_n(15, 5) % 128 == 0
+    # Huge K: infeasible — callers must route to sample-major kernels.
+    assert tall_block_n(1 << 20, 5) == 0
+
+
+def test_kmeans_fit_features_layout_matches(rng):
+    from tdc_tpu.models import kmeans_fit
+
+    x = (rng.normal(size=(2000, 5)) * 2).astype(np.float32)
+    c0 = x[:7].copy()  # explicit init removes subsample-init divergence
+    a = kmeans_fit(x, 7, init=c0, max_iters=10, tol=-1.0)
+    b = kmeans_fit(x.T, 7, init=c0, max_iters=10, tol=-1.0, layout="features")
+    np.testing.assert_allclose(np.asarray(a.centroids), np.asarray(b.centroids),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(a.sse), float(b.sse), rtol=1e-4)
+    assert int(a.n_iter) == int(b.n_iter)
+
+
+def test_fuzzy_fit_features_layout_matches(rng):
+    from tdc_tpu.models import fuzzy_cmeans_fit
+
+    x = (rng.normal(size=(1500, 4)) * 2).astype(np.float32)
+    c0 = x[:5].copy()
+    a = fuzzy_cmeans_fit(x, 5, init=c0, max_iters=8, tol=-1.0)
+    b = fuzzy_cmeans_fit(x.T, 5, init=c0, max_iters=8, tol=-1.0,
+                         layout="features")
+    np.testing.assert_allclose(np.asarray(a.centroids), np.asarray(b.centroids),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(float(a.objective), float(b.objective),
+                               rtol=1e-3)
+
+
+def test_features_layout_validations(rng):
+    from tdc_tpu.models import kmeans_fit
+    from tdc_tpu.parallel import make_mesh
+
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    with pytest.raises(ValueError, match="layout"):
+        kmeans_fit(x, 4, layout="columns")
+    with pytest.raises(ValueError, match="features"):
+        kmeans_fit(x.T, 4, layout="features", sample_weight=np.ones(64))
+    with pytest.raises(ValueError, match="features"):
+        kmeans_fit(x.T, 4, layout="features", mesh=make_mesh(2))
+
+
+def test_features_layout_spherical(rng):
+    from tdc_tpu.models import kmeans_fit
+
+    x = rng.normal(size=(512, 6)).astype(np.float32)
+    c0 = x[:4].copy()
+    a = kmeans_fit(x, 4, init=c0, max_iters=6, tol=-1.0, spherical=True)
+    b = kmeans_fit(x.T, 4, init=c0, max_iters=6, tol=-1.0, spherical=True,
+                   layout="features")
+    np.testing.assert_allclose(np.asarray(a.centroids), np.asarray(b.centroids),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_make_blobs_features_layout():
+    from tdc_tpu.data import make_blobs
+
+    xs, ys = make_blobs(7, 1000, 5, 3, layout="samples")
+    xf, yf = make_blobs(7, 1000, 5, 3, layout="features")
+    assert xs.shape == (1000, 5) and xf.shape == (5, 1000)
+    assert ys.shape == yf.shape == (1000,)
+    # Same centers across layouts: per-cluster means agree loosely.
+    for j in range(3):
+        mu_s = xs[ys == j].mean(0)
+        mu_f = xf[:, yf == j].mean(1)
+        np.testing.assert_allclose(mu_s, mu_f, atol=0.2)
+
+
+def test_make_blobs_features_chunked_matches_single():
+    from tdc_tpu.data.synthetic import make_blobs
+
+    # Chunk boundary behavior: same seed, total split across chunks, centers
+    # fixed — the concatenated shape and label range are right.
+    x, y = make_blobs(3, 300, 4, 2, layout="features")
+    assert x.shape == (4, 300) and set(np.unique(y)) <= {0, 1}
+
+
+def test_history_in_memory_kmeans(rng):
+    from tdc_tpu.models import kmeans_fit
+
+    x = (rng.normal(size=(800, 6)) * 2).astype(np.float32)
+    res = kmeans_fit(x, 5, init=x[:5].copy(), max_iters=12, tol=-1.0,
+                     history=True)
+    h = np.asarray(res.history)
+    assert h.shape == (int(res.n_iter), 2)
+    assert not np.isnan(h).any()
+    # SSE column decreases (Lloyd monotonicity) and the first row's cost is
+    # the cost at the init centroids.
+    assert (np.diff(h[:, 0]) <= 1e-3 * h[0, 0]).all()
+    want0 = float(lloyd_stats(jnp.asarray(x), jnp.asarray(x[:5])).sse)
+    np.testing.assert_allclose(h[0, 0], want0, rtol=1e-5)
+
+
+def test_history_matches_streamed_curve(rng):
+    from tdc_tpu.data.loader import NpzStream
+    from tdc_tpu.models import kmeans_fit
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    x = (rng.normal(size=(900, 4)) * 2).astype(np.float32)
+    c0 = x[:6].copy()
+    mem = kmeans_fit(x, 6, init=c0, max_iters=8, tol=-1.0, history=True)
+    st = streamed_kmeans_fit(NpzStream(x, 300), 6, 4, init=c0, max_iters=8,
+                             tol=-1.0)
+    np.testing.assert_allclose(np.asarray(mem.history),
+                               np.asarray(st.history), rtol=1e-3, atol=1e-3)
+
+
+def test_history_in_memory_fuzzy(rng):
+    from tdc_tpu.models import fuzzy_cmeans_fit
+
+    x = (rng.normal(size=(600, 5)) * 2).astype(np.float32)
+    res = fuzzy_cmeans_fit(x, 4, init=x[:4].copy(), max_iters=9, tol=-1.0,
+                           history=True)
+    h = np.asarray(res.history)
+    assert h.shape == (int(res.n_iter), 2)
+    assert not np.isnan(h).any()
+
+
+def test_history_with_convergence_stops_early(rng):
+    from tdc_tpu.models import kmeans_fit
+
+    # Well-separated blobs converge long before max_iters; history must have
+    # exactly n_iter rows, not max_iters.
+    centers = np.array([[0, 0], [30, 30], [-30, 30]], np.float32)
+    x = (centers[rng.integers(0, 3, 600)]
+         + rng.normal(size=(600, 2)).astype(np.float32)).astype(np.float32)
+    res = kmeans_fit(x, 3, init=centers + 0.5, max_iters=50, tol=1e-4,
+                     history=True)
+    assert int(res.n_iter) < 50
+    assert np.asarray(res.history).shape == (int(res.n_iter), 2)
