@@ -76,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fuzzifier", type=float, default=2.0,
                    help="fuzzy c-means m (explicit; reference bound it to "
                         "n_dim, defect 7)")
+    p.add_argument("--covariance_type", type=str, default="diag",
+                   choices=("diag", "spherical", "tied", "full"),
+                   help="gaussianMixture covariance parameterization "
+                        "(sklearn parity); streamed GMM fits are diag-only")
     p.add_argument("--spherical", action="store_true",
                    help="cosine K-Means (normalize points and centroids)")
     p.add_argument("--num_batches", type=int, default=1,
@@ -179,9 +183,6 @@ def validate_args(parser, args):
             parser.error(f"--K={args.K} not divisible by --shard_k={args.shard_k}")
         if args.method_name != "distributedKMeans":
             parser.error("--shard_k supports distributedKMeans only")
-        if args.ckpt_dir:
-            parser.error("--ckpt_dir is not yet supported with --shard_k "
-                         "(the K-sharded driver has no checkpointing)")
         if args.minibatch:
             parser.error("--minibatch and --shard_k are mutually exclusive")
     if args.minibatch and args.method_name != "distributedKMeans":
@@ -193,13 +194,31 @@ def validate_args(parser, args):
 
         if args.shard_k > 1:
             parser.error("gaussianMixture has no sharded-K mode")
-        if args.weight_file:
-            parser.error("gaussianMixture does not support --weight_file")
+        if args.weight_file and (args.streamed or args.num_batches > 1):
+            parser.error("gaussianMixture supports --weight_file for "
+                         "in-memory fits only (the streamed GMM has no "
+                         "weighted accumulator)")
         if args.ckpt_every_batches:
             parser.error("gaussianMixture checkpoints per iteration only "
                          "(--ckpt_every_batches is kmeans/fuzzy)")
+        if args.covariance_type != "diag" and (args.streamed
+                                               or args.num_batches > 1):
+            parser.error("streamed gaussianMixture is diag-only; "
+                         f"--covariance_type={args.covariance_type} needs "
+                         "an in-memory fit")
+        if args.kernel == "pallas":
+            # Reject rather than silently downgrade to the XLA E-step — an
+            # explicit kernel request must not record XLA numbers as Pallas.
+            if args.covariance_type != "diag" or args.weight_file:
+                parser.error("--kernel=pallas gaussianMixture supports the "
+                             "diag, unweighted E-step only")
+            if args.n_devices and args.n_devices > 1:
+                parser.error("--kernel=pallas gaussianMixture is "
+                             "single-device")
     elif args.init == "kmeans":
         parser.error("--init=kmeans is a gaussianMixture seeding mode")
+    elif args.covariance_type != "diag":
+        parser.error("--covariance_type applies to gaussianMixture only")
     if args.metrics_sample < 0:
         parser.error("--metrics_sample must be >= 0")
     if args.weight_file:
@@ -315,6 +334,21 @@ def run_experiment(args) -> dict:
                 use_features = True
             elif args.layout == "auto":
                 use_features = feat_ok and on_tpu and n_dim <= 32
+                if use_features:
+                    # The tall kernels keep (K_s, BN) tiles + the (K, d)
+                    # accumulator in VMEM; beyond their feasibility the
+                    # sample-major kernels must keep working unchanged.
+                    from tdc_tpu.ops.tall import tall_block_n
+
+                    temps = (
+                        5 if args.method_name == "distributedFuzzyCMeans"
+                        else 3
+                    )
+                    use_features = tall_block_n(
+                        args.K, n_dim,
+                        2 if args.dtype == "bfloat16" else 4,
+                        temps=temps,
+                    ) > 0
             itemsize = 2 if args.dtype == "bfloat16" else 4
             if on_tpu:
                 # TPU HBM stores (sublane, lane) = (8·4/itemsize, 128) tiles:
@@ -450,9 +484,21 @@ def run_experiment(args) -> dict:
                 block_rows=block,
                 dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
                 prefetch=args.prefetch,
+                ckpt_dir=args.ckpt_dir,
+                ckpt_every_batches=args.ckpt_every_batches,
             )
         if args.method_name == "gaussianMixture":
             if streamed:
+                if weights is not None or args.covariance_type != "diag":
+                    # Reachable only via the OOM fallback (validate_args
+                    # rejects the explicit flag combinations): the streamed
+                    # GMM must not silently drop weights/covariance type.
+                    raise ValueError(
+                        "gaussianMixture fell back to streaming but "
+                        "--weight_file/--covariance_type!=diag support "
+                        "in-memory fits only; shrink the dataset or drop "
+                        "the flag"
+                    )
                 from tdc_tpu.models.gmm import streamed_gmm_fit
 
                 rows = -(-n_obs // num_batches)
@@ -461,12 +507,16 @@ def run_experiment(args) -> dict:
                     key=key, max_iters=args.n_max_iters, tol=args.tol,
                     mesh=mesh, prefetch=args.prefetch,
                     ckpt_dir=args.ckpt_dir,
+                    kernel=args.kernel or "xla",
                 )
             from tdc_tpu.models.gmm import gmm_fit
 
             return gmm_fit(
                 xx, args.K, init=args.init, key=key,
                 max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
+                covariance_type=args.covariance_type,
+                sample_weight=weights,
+                kernel=args.kernel or "xla",
             )
         if args.method_name == "distributedFuzzyCMeans":
             if streamed:
@@ -545,7 +595,8 @@ def run_experiment(args) -> dict:
         # included; the honest number for a checkpointed run). Non-streamed
         # fits never receive ckpt_dir, so they keep the warm re-fit.
         checkpointed = bool(
-            args.ckpt_dir and (args.streamed or num_batches > 1)
+            args.ckpt_dir
+            and (args.streamed or num_batches > 1 or args.shard_k > 1)
         )
         if checkpointed:
             timers.set("computation", timers.get("initialization"))

@@ -159,13 +159,16 @@ class FuzzyCMeans:
 
 
 class GaussianMixture:
-    """Diagonal-covariance GMM estimator (sklearn.mixture facade over
-    models/gmm.py — soft clustering beyond the reference's fuzzy C-Means)."""
+    """GMM estimator (sklearn.mixture facade over models/gmm.py — soft
+    clustering beyond the reference's fuzzy C-Means). All four sklearn
+    covariance types; covariances_ takes the sklearn shape for the type.
+    Beyond sklearn: fit() accepts sample_weight."""
 
     def __init__(
         self,
         n_components: int = 1,
         *,
+        covariance_type: str = "diag",
         init="kmeans",
         max_iter: int = 100,
         tol: float = 1e-4,
@@ -174,6 +177,7 @@ class GaussianMixture:
         mesh=None,
     ):
         self.n_components = n_components
+        self.covariance_type = covariance_type
         self.init = init
         self.max_iter = max_iter
         self.tol = tol
@@ -181,7 +185,7 @@ class GaussianMixture:
         self.random_state = random_state
         self.mesh = mesh
 
-    def fit(self, X, y=None) -> "GaussianMixture":
+    def fit(self, X, y=None, sample_weight=None) -> "GaussianMixture":
         from tdc_tpu.models.gmm import gmm_fit
 
         res = gmm_fit(
@@ -193,6 +197,8 @@ class GaussianMixture:
             tol=self.tol,
             reg_covar=self.reg_covar,
             mesh=self.mesh,
+            covariance_type=self.covariance_type,
+            sample_weight=sample_weight,
         )
         self._result = res
         self.means_ = np.asarray(res.means)
@@ -223,8 +229,8 @@ class GaussianMixture:
         self._check_fitted()
         return gmm_score(X, self._result)
 
-    def fit_predict(self, X, y=None) -> np.ndarray:
-        return self.fit(X).predict(X)
+    def fit_predict(self, X, y=None, sample_weight=None) -> np.ndarray:
+        return self.fit(X, sample_weight=sample_weight).predict(X)
 
     def _check_fitted(self):
         if not hasattr(self, "_result"):

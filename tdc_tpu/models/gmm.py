@@ -1,21 +1,33 @@
-"""Diagonal-covariance Gaussian Mixture Models via EM, TPU-shaped.
+"""Gaussian Mixture Models via EM, TPU-shaped — all four sklearn covariance
+types plus sample weights.
 
 A capability step beyond the reference (hard K-Means and fuzzy memberships):
 full probabilistic soft clustering with per-cluster weights and scales. The
 reference's fuzzy C-Means (scripts/distribuitedClustering.py:72-178) is the
 closest thing it has; GMM generalizes it with learned mixing weights and
-per-dimension variances, and everything maps onto the same hardware story:
+covariances, and everything maps onto the same hardware story:
 
-- E-step: log N(x | μ, diag σ²) assembled in matmul form —
-  Σ_d (x−μ)²/σ² = (x²)@(1/σ²)ᵀ − 2·x@(μ/σ²)ᵀ + Σ μ²/σ² — two (N,d)×(d,K)
-  MXU matmuls, never a rank-3 tensor (the same trick as ops/distance.py).
-- M-step: responsibilities Rᵀ@x and Rᵀ@x² — two more MXU matmuls.
+- E-step: log N(x | μ, Σ) assembled in matmul form, never a rank-3 (N, K, d)
+  tensor (the same trick as ops/distance.py):
+    diag/spherical — Σ_d (x−μ)²/σ² = (x²)@(1/σ²)ᵀ − 2·x@(μ/σ²)ᵀ + Σ μ²/σ²,
+    two (N,d)×(d,K) MXU matmuls;
+    tied — whiten once through the shared Cholesky, then the SAME matmul
+    expansion in whitened space;
+    full — a lax.map over K of per-component triangular solves (K small
+    whenever full covariance is statistically sane).
+- M-step: responsibilities Rᵀ@x and Rᵀ@x² — more MXU matmuls; the tied
+  second moment Σ wᵢxxᵀ is iteration-constant and computed once.
 - The whole EM loop is one jit'd lax.while_loop on the log-likelihood gain;
-  with `mesh`, points shard over the data axis and XLA all-reduces the
-  R-contractions (identical mechanism to models/kmeans.py).
+  with `mesh` (diag), points shard over the data axis and XLA all-reduces
+  the R-contractions (identical mechanism to models/kmeans.py).
 
-Matches sklearn.mixture.GaussianMixture(covariance_type='diag') on oracle
-tests (tests/test_gmm.py).
+Matches sklearn.mixture.GaussianMixture(covariance_type=...) for all four
+types on oracle tests (tests/test_gmm.py); sample_weight matches the
+repeated-rows construction sklearn's API lacks.
+
+The exact out-of-core streamed fit (streamed_gmm_fit) is diag-only: diag
+sufficient statistics are O(K·d) device state, which is what makes the
+streaming exact and cheap.
 """
 
 from __future__ import annotations
@@ -35,7 +47,9 @@ _LOG_2PI = float(np.log(2.0 * np.pi))
 
 class GMMResult(NamedTuple):
     means: jax.Array  # (K, d) f32
-    variances: jax.Array  # (K, d) f32 diagonal covariances
+    # Covariance parameters, shaped by covariance_type (sklearn convention):
+    # diag (K, d), spherical (K,), tied (d, d), full (K, d, d).
+    variances: jax.Array
     weights: jax.Array  # (K,) mixing proportions, sum to 1
     n_iter: jax.Array  # () int32 — cumulative EM iterations (incl. resumed)
     log_likelihood: jax.Array  # () f32 — mean per-point log-likelihood
@@ -44,6 +58,10 @@ class GMMResult(NamedTuple):
     # throughput must use this so a checkpoint resume with nothing left to
     # do reports 0, not an inflated rate from timing a bare scoring pass.
     n_iter_run: object = None
+    covariance_type: str = "diag"
+
+
+COVARIANCE_TYPES = ("diag", "spherical", "tied", "full")
 
 
 def _log_prob(x, means, variances, log_weights):
@@ -62,6 +80,96 @@ def _log_prob(x, means, variances, log_weights):
     )
 
 
+def _log_prob_spherical(x, means, variances, log_weights):
+    """(N, K) log-prob, one shared σ²_k per component: the plain squared
+    distance matmul scaled per component."""
+    xf = x.astype(jnp.float32)
+    d2 = (
+        jnp.sum(xf**2, axis=1, keepdims=True)
+        - 2.0 * (xf @ means.T)
+        + jnp.sum(means**2, axis=1)[None, :]
+    )  # (N, K)
+    d = x.shape[1]
+    maha = d2 / variances[None, :]
+    log_det = d * jnp.log(variances)  # (K,)
+    return (
+        -0.5 * (maha + log_det[None, :] + d * _LOG_2PI) + log_weights[None, :]
+    )
+
+
+def _log_prob_tied(x, means, cov, log_weights):
+    """(N, K) log-prob with one shared (d, d) covariance: whiten x and the
+    means once through the Cholesky, then the diag matmul expansion in
+    whitened space (no per-point solves in the K loop)."""
+    L = jnp.linalg.cholesky(cov)
+    xf = x.astype(jnp.float32)
+    z = jax.scipy.linalg.solve_triangular(L, xf.T, lower=True).T  # (N, d)
+    zm = jax.scipy.linalg.solve_triangular(L, means.T, lower=True).T  # (K, d)
+    maha = (
+        jnp.sum(z**2, axis=1, keepdims=True)
+        - 2.0 * (z @ zm.T)
+        + jnp.sum(zm**2, axis=1)[None, :]
+    )
+    log_det = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L)))
+    d = x.shape[1]
+    return -0.5 * (maha + log_det + d * _LOG_2PI) + log_weights[None, :]
+
+
+def _log_prob_full(x, means, covs, log_weights):
+    """(N, K) log-prob with per-component (d, d) covariances: a lax.map over
+    K of triangular solves — K sequential (d, d)×(d, N) solves, never an
+    (N, K, d) tensor."""
+    chol = jnp.linalg.cholesky(covs)  # (K, d, d)
+    xf = x.astype(jnp.float32)
+
+    def per_k(args):
+        mu, L = args
+        y = jax.scipy.linalg.solve_triangular(L, (xf - mu).T, lower=True)
+        return jnp.sum(y * y, axis=0)  # (N,)
+
+    maha = jax.lax.map(per_k, (means, chol)).T  # (N, K)
+    log_det = 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(chol, axis1=1, axis2=2)), axis=1
+    )  # (K,)
+    d = x.shape[1]
+    return (
+        -0.5 * (maha + log_det[None, :] + d * _LOG_2PI) + log_weights[None, :]
+    )
+
+
+def _log_prob_t(x, means, cov, log_weights, cov_type: str):
+    if cov_type == "diag":
+        return _log_prob(x, means, cov, log_weights)
+    if cov_type == "spherical":
+        return _log_prob_spherical(x, means, cov, log_weights)
+    if cov_type == "tied":
+        return _log_prob_tied(x, means, cov, log_weights)
+    if cov_type == "full":
+        return _log_prob_full(x, means, cov, log_weights)
+    raise ValueError(f"unknown covariance_type {cov_type!r}")
+
+
+def gmm_stats_auto(x, means, variances, weights):
+    """Diag-GMM E-step sufficient stats (ll_sum, nk (K,), sx (K,d),
+    sxx (K,d)) — the fused single-pass Pallas kernel when the (K, d) tiles
+    fit VMEM (no (N, K) responsibility matrix anywhere), the XLA matmul
+    E-step beyond."""
+    from tdc_tpu.ops.pallas_kernels import gmm_block_n, gmm_stats_fused
+
+    if gmm_block_n(means.shape[0], x.shape[1], x.dtype.itemsize) > 0:
+        return gmm_stats_fused(x, means, variances, weights)
+    logp = _log_prob(x, means, variances, jnp.log(weights))
+    norm = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
+    r = jnp.exp(logp - norm)
+    xf = x.astype(jnp.float32)
+    return (
+        jnp.sum(norm),
+        jnp.sum(r, axis=0),
+        r.T @ xf,
+        r.T @ xf**2,
+    )
+
+
 def _m_step(nk, sx, sxx, n_rows, reg):
     """Shared M-step (in-memory loop AND streamed fit — one copy so the
     empty-component floors and variance clamp can never drift apart):
@@ -73,20 +181,66 @@ def _m_step(nk, sx, sxx, n_rows, reg):
     return means, variances, weights / jnp.sum(weights)
 
 
-@partial(jax.jit, static_argnames=("max_iters",))
-def _em_loop(x, means0, variances0, weights0, max_iters: int, tol: float,
-             reg: float):
+@partial(jax.jit, static_argnames=("max_iters", "cov_type", "kernel"))
+def _em_loop(x, means0, cov0, weights0, max_iters: int, tol: float,
+             reg: float, cov_type: str = "diag", w=None,
+             kernel: str = "xla"):
     n = x.shape[0]
+    d = x.shape[1]
+    xf = x.astype(jnp.float32)
+    wsum = (
+        jnp.sum(w) if w is not None else jnp.asarray(float(n), jnp.float32)
+    )
+    if cov_type == "tied":
+        # Σ wᵢ xxᵀ is iteration-constant (responsibilities sum to 1 per
+        # point), so the tied M-step needs only nk and sx per iteration.
+        xw = xf if w is None else xf * w[:, None]
+        s_total = xw.T @ xf  # (d, d)
 
-    def e_and_stats(means, variances, log_weights):
-        logp = _log_prob(x, means, variances, log_weights)  # (N, K)
+    def e_and_stats(means, cov, log_weights):
+        if kernel == "pallas":
+            # Fused Pallas E-step (diag, unweighted — validated upstream).
+            ll_sum, nk, sx, s2 = gmm_stats_auto(
+                x, means, cov, jnp.exp(log_weights)
+            )
+            return ll_sum / n, nk, sx, s2
+        logp = _log_prob_t(x, means, cov, log_weights, cov_type)  # (N, K)
         norm = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
         r = jnp.exp(logp - norm)  # responsibilities (N, K)
-        ll = jnp.mean(norm)
+        if w is not None:
+            r = r * w[:, None]
+            ll = jnp.sum(w * norm[:, 0]) / wsum
+        else:
+            ll = jnp.mean(norm)
         nk = jnp.sum(r, axis=0)  # (K,) — all-reduced by XLA when sharded
-        sx = r.T @ x.astype(jnp.float32)  # (K, d)
-        sxx = r.T @ (x.astype(jnp.float32) ** 2)  # (K, d)
-        return ll, nk, sx, sxx
+        sx = r.T @ xf  # (K, d)
+        if cov_type in ("diag", "spherical"):
+            s2 = r.T @ xf**2  # (K, d)
+        elif cov_type == "full":
+            # K sequential (d, N)×(N, d) matmuls — no (N, K, d) tensor.
+            s2 = jax.lax.map(lambda rk: (xf * rk[:, None]).T @ xf, r.T)
+        else:  # tied: second moment is the precomputed constant
+            s2 = jnp.zeros((), jnp.float32)
+        return ll, nk, sx, s2
+
+    def m_step(nk, sx, s2):
+        safe = jnp.maximum(nk, 1e-12)[:, None]
+        means = sx / safe
+        if cov_type == "diag":
+            cov = jnp.maximum(s2 / safe - means**2, 0.0) + reg
+        elif cov_type == "spherical":
+            # sklearn: the mean of the (reg-floored) diag variances.
+            cov = jnp.mean(jnp.maximum(s2 / safe - means**2, 0.0) + reg,
+                           axis=1)
+        elif cov_type == "full":
+            outer = means[:, :, None] * means[:, None, :]
+            cov = s2 / jnp.maximum(nk, 1e-12)[:, None, None] - outer
+            cov = cov + reg * jnp.eye(d, dtype=jnp.float32)[None]
+        else:  # tied: Σ_k nk μμᵀ == sxᵀ @ means since nk·μ = sx
+            cov = (s_total - sx.T @ means) / wsum
+            cov = cov + reg * jnp.eye(d, dtype=jnp.float32)
+        weights = jnp.maximum(nk / wsum, 1e-12)
+        return means, cov, weights / jnp.sum(weights)
 
     # Convergence: stop when the mean-log-likelihood gain of the latest EM
     # step drops to tol (sklearn's lower_bound_ criterion); always run at
@@ -98,24 +252,24 @@ def _em_loop(x, means0, variances0, weights0, max_iters: int, tol: float,
                                jnp.logical_or(i < 1, ll - prev_ll > tol))
 
     def body(carry):
-        means, variances, weights, _, i, last_ll = carry
-        ll, nk, sx, sxx = e_and_stats(means, variances, jnp.log(weights))
-        new_means, new_vars, new_weights = _m_step(nk, sx, sxx, n, reg)
-        return new_means, new_vars, new_weights, last_ll, i + 1, ll
+        means, cov, weights, _, i, last_ll = carry
+        ll, nk, sx, s2 = e_and_stats(means, cov, jnp.log(weights))
+        new_means, new_cov, new_weights = m_step(nk, sx, s2)
+        return new_means, new_cov, new_weights, last_ll, i + 1, ll
 
     init = (
-        means0, variances0, weights0,
+        means0, cov0, weights0,
         jnp.asarray(-jnp.inf, jnp.float32), jnp.asarray(0, jnp.int32),
         jnp.asarray(-jnp.inf, jnp.float32),
     )
-    means, variances, weights, prev_ll, n_iter, ll = jax.lax.while_loop(
+    means, cov, weights, prev_ll, n_iter, ll = jax.lax.while_loop(
         cond, body, init
     )
     # Final log-likelihood of the RETURNED parameters (the loop's ll is
     # pre-update, one step stale — same convention as kmeans_fit's final SSE).
-    final_ll, *_ = e_and_stats(means, variances, jnp.log(weights))
+    final_ll, *_ = e_and_stats(means, cov, jnp.log(weights))
     converged = jnp.logical_and(n_iter > 1, ll - prev_ll <= tol)
-    return means, variances, weights, n_iter, final_ll, converged
+    return means, cov, weights, n_iter, final_ll, converged
 
 
 def gmm_fit(
@@ -128,8 +282,11 @@ def gmm_fit(
     tol: float = 1e-4,
     reg_covar: float = 1e-6,
     mesh: jax.sharding.Mesh | None = None,
+    covariance_type: str = "diag",
+    sample_weight=None,
+    kernel: str = "xla",
 ) -> GMMResult:
-    """Fit a diagonal-covariance GMM with EM.
+    """Fit a GMM with EM.
 
     Args:
       x: (N, d) points. With `mesh`, sharded over the data axis (N divisible
@@ -141,9 +298,50 @@ def gmm_fit(
       tol: convergence threshold on the mean per-point log-likelihood gain
         (sklearn semantics).
       reg_covar: variance floor added every M-step (sklearn parity).
+      covariance_type: 'diag' | 'spherical' | 'tied' | 'full'
+        (sklearn.mixture parity; result.variances takes the matching shape).
+        mesh is diag-only: the non-diag E-steps use Cholesky solves that do
+        not shard over the data axis.
+      sample_weight: optional (N,) nonnegative per-point weights — scales
+        each point's responsibilities (equivalent to repeating rows; an API
+        sklearn.mixture itself lacks).
+      kernel: 'xla' (default) or 'pallas' — the fused single-pass E-step
+        kernel (ops/pallas_kernels.gmm_stats_fused); diag, unweighted,
+        single-device only; auto-falls-back to the XLA E-step beyond the
+        VMEM-feasible K·d.
     """
     x = jnp.asarray(x)
     n, d = x.shape
+    if covariance_type not in COVARIANCE_TYPES:
+        raise ValueError(
+            f"covariance_type must be one of {COVARIANCE_TYPES}, "
+            f"got {covariance_type!r}"
+        )
+    if mesh is not None and covariance_type != "diag":
+        raise ValueError(
+            "mesh-sharded gmm_fit supports covariance_type='diag' only"
+        )
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
+    if kernel == "pallas" and (
+        covariance_type != "diag" or sample_weight is not None
+        or mesh is not None
+    ):
+        raise ValueError(
+            "kernel='pallas' supports the diag, unweighted, single-device "
+            "E-step only"
+        )
+    w = None
+    if sample_weight is not None:
+        w = jnp.asarray(sample_weight, jnp.float32)
+        if w.shape != (n,):
+            raise ValueError(f"sample_weight shape {w.shape} != ({n},)")
+        if (np.asarray(sample_weight) < 0).any():
+            raise ValueError("sample_weight entries must be nonnegative")
+        if int((np.asarray(sample_weight) > 0).sum()) < k:
+            raise ValueError(
+                f"sample_weight has fewer than K={k} positive entries"
+            )
     if mesh is not None:
         n_dev = int(np.prod(mesh.devices.shape))
         if n % n_dev != 0:
@@ -151,16 +349,18 @@ def gmm_fit(
                 f"N={n} not divisible by mesh size {n_dev}"
             )
         x = mesh_lib.shard_points(x, mesh)
+        if w is not None:
+            w = mesh_lib.shard_points(w, mesh)
     if isinstance(init, str) and init == "kmeans":
         # Multi-restart seeding: one k-means++ draw can split/merge blobs
         # and EM inherits that basin; best-of-3 by SSE is cheap (the Lloyd
         # loop compiles once) and measurably improves the EM optimum.
         means0 = kmeans_fit(
             x, k, init="kmeans++", key=key, max_iters=10, tol=1e-3,
-            mesh=mesh, n_init=3,
+            mesh=mesh, n_init=3, sample_weight=sample_weight,
         ).centroids
     else:
-        means0 = resolve_init(x, k, init, key)
+        means0 = resolve_init(x, k, init, key, w)
     means0 = jnp.asarray(means0, jnp.float32)
     if mesh is not None:
         means0 = mesh_lib.replicate(means0, mesh)
@@ -170,17 +370,34 @@ def gmm_fit(
     # lets early E-steps merge well-separated components into one broad
     # Gaussian — a measurably worse local optimum.
     variances0, weights0 = _moments_from_hard_assign(x, means0, reg_covar)
+    cov0 = _diag_to_cov(variances0, weights0, covariance_type)
     if mesh is not None:
-        variances0 = mesh_lib.replicate(variances0, mesh)
+        cov0 = mesh_lib.replicate(cov0, mesh)
         weights0 = mesh_lib.replicate(weights0, mesh)
-    means, variances, weights, n_iter, ll, converged = _em_loop(
-        x, jnp.asarray(means0, jnp.float32), variances0, weights0,
-        int(max_iters), float(tol), float(reg_covar),
+    means, cov, weights, n_iter, ll, converged = _em_loop(
+        x, jnp.asarray(means0, jnp.float32), cov0, weights0,
+        int(max_iters), float(tol), float(reg_covar), covariance_type, w,
+        kernel,
     )
     return GMMResult(
-        means=means, variances=variances, weights=weights, n_iter=n_iter,
+        means=means, variances=cov, weights=weights, n_iter=n_iter,
         log_likelihood=ll, converged=converged,
+        covariance_type=covariance_type,
     )
+
+
+def _diag_to_cov(var, weights, cov_type: str):
+    """Project the hard-assignment diag variance estimate (K, d) into the
+    requested covariance parameterization for the EM start."""
+    if cov_type == "diag":
+        return var
+    if cov_type == "spherical":
+        return jnp.mean(var, axis=1)
+    if cov_type == "tied":
+        return jnp.diag(jnp.sum(weights[:, None] * var, axis=0))
+    # full: embed the diagonals
+    k, d = var.shape
+    return var[:, :, None] * jnp.eye(d, dtype=var.dtype)[None]
 
 
 @jax.jit
@@ -207,9 +424,9 @@ def _moments_from_hard_assign(x, means, reg):
     return var, w / jnp.sum(w)
 
 
-@jax.jit
-def _posteriors(x, means, variances, weights):
-    logp = _log_prob(x, means, variances, jnp.log(weights))
+@partial(jax.jit, static_argnames=("cov_type",))
+def _posteriors(x, means, cov, weights, cov_type: str = "diag"):
+    logp = _log_prob_t(x, means, cov, jnp.log(weights), cov_type)
     norm = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
     return jnp.exp(logp - norm)
 
@@ -217,8 +434,9 @@ def _posteriors(x, means, variances, weights):
 def gmm_predict(x, result: GMMResult) -> jax.Array:
     """Hard component labels (argmax posterior)."""
     x = jnp.asarray(x)
-    logp = _log_prob(
-        x, result.means, result.variances, jnp.log(result.weights)
+    logp = _log_prob_t(
+        x, result.means, result.variances, jnp.log(result.weights),
+        result.covariance_type,
     )
     return jnp.argmax(logp, axis=1).astype(jnp.int32)
 
@@ -226,15 +444,17 @@ def gmm_predict(x, result: GMMResult) -> jax.Array:
 def gmm_predict_proba(x, result: GMMResult) -> jax.Array:
     """(N, K) posterior responsibilities."""
     return _posteriors(
-        jnp.asarray(x), result.means, result.variances, result.weights
+        jnp.asarray(x), result.means, result.variances, result.weights,
+        result.covariance_type,
     )
 
 
 def gmm_score(x, result: GMMResult) -> float:
     """Mean per-point log-likelihood (sklearn .score parity)."""
     x = jnp.asarray(x)
-    logp = _log_prob(
-        x, result.means, result.variances, jnp.log(result.weights)
+    logp = _log_prob_t(
+        x, result.means, result.variances, jnp.log(result.weights),
+        result.covariance_type,
     )
     return float(jnp.mean(jax.scipy.special.logsumexp(logp, axis=1)))
 
@@ -249,17 +469,29 @@ class GMMStats(NamedTuple):
     sxx: jax.Array  # (K, d) Σ r·x²
 
 
-@jax.jit
-def _accumulate_gmm(acc, batch, means, variances, weights, n_valid):
+@partial(jax.jit, static_argnames=("kernel",))
+def _accumulate_gmm(acc, batch, means, variances, weights, n_valid,
+                    kernel: str = "xla"):
     """Add one (possibly zero-padded) batch's EM stats; subtract the
     padding's exact contribution (a zero row's responsibilities and
     log-likelihood depend only on the parameters — same correction pattern
-    as the streamed fuzzy fit). Zero rows add exactly nothing to sx/sxx."""
+    as the streamed fuzzy fit). Zero rows add exactly nothing to sx/sxx.
+    kernel='pallas' computes the batch stats with the fused E-step kernel
+    (single-device streams only)."""
     log_w = jnp.log(weights)
-    logp = _log_prob(batch, means, variances, log_w)
-    norm = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
-    r = jnp.exp(logp - norm)
-    xf = batch.astype(jnp.float32)
+    if kernel == "pallas":
+        ll_b, nk_b, sx_b, sxx_b = gmm_stats_auto(
+            batch, means, variances, weights
+        )
+    else:
+        logp = _log_prob(batch, means, variances, log_w)
+        norm = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
+        r = jnp.exp(logp - norm)
+        xf = batch.astype(jnp.float32)
+        ll_b = jnp.sum(norm)
+        nk_b = jnp.sum(r, axis=0)
+        sx_b = r.T @ xf
+        sxx_b = r.T @ xf**2
     n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(
         jnp.float32
     )
@@ -268,10 +500,10 @@ def _accumulate_gmm(acc, batch, means, variances, weights, n_valid):
     znorm = jax.scipy.special.logsumexp(zlogp, axis=1)
     zr = jnp.exp(zlogp - znorm[:, None])[0]
     return GMMStats(
-        ll_sum=acc.ll_sum + jnp.sum(norm) - n_pad * znorm[0],
-        nk=acc.nk + jnp.sum(r, axis=0) - n_pad * zr,
-        sx=acc.sx + r.T @ xf,
-        sxx=acc.sxx + r.T @ xf**2,
+        ll_sum=acc.ll_sum + ll_b - n_pad * znorm[0],
+        nk=acc.nk + nk_b - n_pad * zr,
+        sx=acc.sx + sx_b,
+        sxx=acc.sxx + sxx_b,
     )
 
 
@@ -289,6 +521,7 @@ def streamed_gmm_fit(
     prefetch: int = 0,
     ckpt_dir: str | None = None,
     ckpt_every: int = 5,
+    kernel: str = "xla",
 ) -> GMMResult:
     """Exact streamed EM over a re-iterable stream of (B, d) batches — the
     same contract as streamed_kmeans_fit (one full pass per EM iteration,
@@ -311,6 +544,12 @@ def streamed_gmm_fit(
         _run_pass,
     )
 
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
+    if kernel == "pallas" and mesh is not None:
+        raise ValueError(
+            "streamed kernel='pallas' supports single-device streams only"
+        )
     # Restore FIRST: a resume must not pay (and then discard) the
     # first-batch seeding — a multi-restart Lloyd fit plus broadcasts —
     # on every supervised-gang relaunch.
@@ -431,7 +670,7 @@ def streamed_gmm_fit(
             rows_total[0] += n_valid
             return (
                 _accumulate_gmm(acc, xb, means, variances, weights,
-                                jnp.asarray(n_valid)),
+                                jnp.asarray(n_valid), kernel),
                 n_local,
             )
 

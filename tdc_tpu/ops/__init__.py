@@ -18,6 +18,10 @@ from tdc_tpu.ops.init import (
     init_kmeans_pp,
 )
 
+# NOTE: ops.tall (Pallas) is deliberately NOT re-exported here — pallas
+# imports stay function-local/lazy across the package; import
+# tdc_tpu.ops.tall directly.
+
 __all__ = [
     "pairwise_sq_dist",
     "pairwise_dist",

@@ -257,16 +257,27 @@ def kmeans_fit_sharded(
     """Lloyd K-Means with points sharded over 'data' and centroids over
     'model' (the K=16,384 regime). init may be a (K, d) array or an init name
     ('kmeans++'/'random'/'first_k'/'kmeans||'), resolved on a host subsample.
+
+    Multi-process meshes (SURVEY §7 step 7: sharded centroid tiles at pod
+    scale) are supported by passing `x` as the full NUMPY array, identical on
+    every process: numpy stays host-side until the global device_put, which
+    places only this process's addressable shards. (A jnp input would commit
+    to one local device first and cannot be resharded across processes.)
     """
     n_data = mesh.devices.shape[0]
     n_model = mesh.devices.shape[1]
-    x = jnp.asarray(x)
+    if not isinstance(x, np.ndarray):
+        x = jnp.asarray(x)
     if x.shape[0] % n_data != 0:
         raise ValueError(f"N={x.shape[0]} not divisible by data axis {n_data}")
     if k % n_model != 0:
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
     if spherical:
-        x = _normalize(x.astype(jnp.float32))
+        if isinstance(x, np.ndarray):
+            norms = np.linalg.norm(x, axis=-1, keepdims=True)
+            x = (x / np.maximum(norms, 1e-12)).astype(np.float32)
+        else:
+            x = _normalize(x.astype(jnp.float32))
     c = _resolve_init_sharded(x, k, init, key)
     if spherical:
         c = _normalize(c)
@@ -319,6 +330,9 @@ def streamed_kmeans_fit_sharded(
     block_rows: int = 0,
     dtype=None,
     prefetch: int = 0,
+    ckpt_dir: str | None = None,
+    ckpt_every: int = 1,
+    ckpt_every_batches: int | None = None,
 ) -> KMeansResult:
     """Exact out-of-core Lloyd under the 2-D (data × model) layout — the
     1B×768, K=16,384 configuration: batches stream host→device, each batch's
@@ -329,25 +343,74 @@ def streamed_kmeans_fit_sharded(
     returning a fresh iterator of (rows, d) arrays per Lloyd iteration.
     `dtype` (e.g. jnp.bfloat16) converts batches host-side before transfer —
     the MXU fast path for the bf16 K=16,384 regime; stats stay f32.
+
+    ckpt_dir enables checkpoint/resume with the models/streaming contract
+    (per-iteration saves every `ckpt_every` iterations; mid-pass accumulator
+    + batch-cursor saves every `ckpt_every_batches` batches; resume is
+    bit-identical to the uninterrupted fit). Checkpoint I/O gathers the
+    (K, d) centroids/accumulator to THIS host, so it is single-process-mesh
+    only — the multi-hour 1B-row single-host regime this driver targets.
     """
+    from tdc_tpu.models.streaming import (
+        _StreamCheckpointer,
+        _mesh_layout,
+        _run_pass,
+    )
+
     n_data = int(mesh.devices.shape[0])
     n_model = int(mesh.devices.shape[1])
     if k % n_model != 0:
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
+    if ckpt_dir is not None and _mesh_layout(mesh)[0] > 1:
+        raise ValueError(
+            "K-sharded checkpointing gathers state to one host and supports "
+            "single-process meshes only (multi-process gang checkpointing "
+            "of K-sharded state is not implemented)"
+        )
     pad_multiple = n_data * max(block_rows, 1)
 
-    first = None
-    if not hasattr(init, "shape"):
-        first = np.asarray(next(iter(batches())))
+    ckpt = _StreamCheckpointer(
+        ckpt_dir, k, d,
+        params={"spherical": bool(spherical), "shard_model": float(n_model)},
+        acc_map={"acc_sums": "sums", "acc_counts": "counts",
+                 "acc_sse": "sse"},
+        key=key,
+    )
+    # Restore FIRST (models/streaming convention): a resume must not re-pay
+    # init resolution, and must report the checkpointed state faithfully.
+    state = ckpt.restore(_ShardedAcc, None)
+    shift = state.shift
+    history = state.history
+    start_iter = state.start_iter
+    resume_cursor, resume_rows = state.cursor, state.rows_seen
+    resume_acc = state.acc
+    if state.centroids is not None:
+        c = jnp.asarray(state.centroids, jnp.float32)
+    else:
+        first = None
+        if not hasattr(init, "shape"):
+            first = np.asarray(next(iter(batches())))
+            if spherical:
+                first = np.asarray(
+                    _normalize(jnp.asarray(first, jnp.float32))
+                )
+            init = _resolve_init_sharded(first, k, init, key)
+        c = jnp.asarray(init, jnp.float32)
+        if c.shape != (k, d):
+            raise ValueError(f"init shape {c.shape} != {(k, d)}")
         if spherical:
-            first = np.asarray(_normalize(jnp.asarray(first, jnp.float32)))
-        init = _resolve_init_sharded(first, k, init, key)
-    c = jnp.asarray(init, jnp.float32)
-    if c.shape != (k, d):
-        raise ValueError(f"init shape {c.shape} != {(k, d)}")
-    if spherical:
-        c = _normalize(c)
+            c = _normalize(c)
     c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    if resume_acc is not None:
+        resume_acc = _ShardedAcc(
+            sums=jax.device_put(
+                resume_acc.sums, NamedSharding(mesh, P(MODEL_AXIS, None))
+            ),
+            counts=jax.device_put(
+                resume_acc.counts, NamedSharding(mesh, P(MODEL_AXIS))
+            ),
+            sse=resume_acc.sse,
+        )
 
     stats_fn = make_sharded_stats(mesh, kernel, block_rows)
 
@@ -404,24 +467,37 @@ def streamed_kmeans_fit_sharded(
         norms = jnp.linalg.norm(xb, axis=-1, keepdims=True)
         return jnp.where(norms > 0, xb / jnp.maximum(norms, 1e-12), xb)
 
-    def full_pass(c):
-        from tdc_tpu.models.streaming import _prefetched
-
-        acc = zero_acc()
-        for batch in _prefetched(batches(), prefetch):
+    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
+        def step(acc, batch):
             maybe_beat()  # supervised-gang liveness
             xb, n_valid = put_batch(batch)
-            acc = accumulate(acc, xb, c, n_valid)
-        return acc
+            return accumulate(acc, xb, c, n_valid), n_valid
 
-    shift = float("inf")
-    n_iter = 0
-    converged = False
-    for n_iter in range(1, max_iters + 1):
-        acc = full_pass(c)
+        return _run_pass(
+            batches, prefetch, zero_acc, step,
+            ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
+            skip=skip, acc0=acc0, rows0=rows0,
+            save_args=(c, shift, history),
+        )
+
+    n_iter = start_iter
+    resume_converged = tol >= 0 and shift <= tol
+    converged = resume_converged
+    iters = (
+        () if resume_converged else range(start_iter + 1, max_iters + 1)
+    )
+    for n_iter in iters:
+        acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
+                        rows0=resume_rows)
+        resume_cursor, resume_acc, resume_rows = 0, None, 0
         c, shift_dev = update(acc, c)
         shift = float(shift_dev)
-        if tol >= 0 and shift <= tol:
+        history.append((float(acc.sse), shift))
+        done = tol >= 0 and shift <= tol
+        if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
+                                     or n_iter == max_iters):
+            ckpt.save(n_iter, c, shift, history)
+        if done:
             converged = True
             break
     # Extra stats pass: report the SSE of the returned centroids, not the
@@ -433,4 +509,6 @@ def streamed_kmeans_fit_sharded(
         sse=jnp.asarray(sse, jnp.float32),
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(converged),
+        history=np.asarray(history, np.float32),
+        n_iter_run=n_iter - start_iter,
     )

@@ -257,3 +257,150 @@ class TestStreamedGMMCheckpoint:
         with pytest.raises(ValueError, match="not a GMM"):
             streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=2,
                              tol=-1.0, ckpt_dir=d)
+
+
+@pytest.mark.parametrize("ct", ["spherical", "tied", "full"])
+def test_matches_sklearn_other_covariance_types(aniso_blobs, ct):
+    x, _, means_init = aniso_blobs
+    res = gmm_fit(x, 3, init=means_init, max_iters=200, tol=1e-5,
+                  covariance_type=ct)
+    from sklearn.mixture import GaussianMixture
+
+    sk = GaussianMixture(
+        n_components=3, covariance_type=ct, means_init=means_init,
+        max_iter=200, tol=1e-5, reg_covar=1e-6, n_init=1,
+    ).fit(x)
+    perm = _match(np.asarray(res.means), sk.means_)
+    assert len(set(perm)) == 3
+    np.testing.assert_allclose(np.asarray(res.means), sk.means_[perm],
+                               rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(res.weights), sk.weights_[perm],
+                               rtol=5e-2, atol=1e-2)
+    cov = np.asarray(res.variances)
+    if ct == "spherical":
+        np.testing.assert_allclose(cov, sk.covariances_[perm],
+                                   rtol=0.1, atol=5e-2)
+    elif ct == "tied":
+        np.testing.assert_allclose(cov, sk.covariances_, rtol=0.1, atol=0.1)
+    else:  # full
+        np.testing.assert_allclose(cov, sk.covariances_[perm],
+                                   rtol=0.15, atol=0.1)
+    # Score parity on held-in data.
+    ours = gmm_score(x, res)
+    np.testing.assert_allclose(ours, sk.score(x), rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("ct", ["diag", "full"])
+def test_gmm_sample_weight_matches_repeated_rows(aniso_blobs, ct):
+    x, _, means_init = aniso_blobs
+    rng = np.random.default_rng(7)
+    w = rng.integers(0, 3, len(x)).astype(np.float32)
+    x_rep = np.repeat(x, w.astype(int), axis=0)
+    a = gmm_fit(x, 3, init=means_init, max_iters=100, tol=1e-5,
+                covariance_type=ct, sample_weight=w)
+    b = gmm_fit(x_rep, 3, init=means_init, max_iters=100, tol=1e-5,
+                covariance_type=ct)
+    np.testing.assert_allclose(np.asarray(a.means), np.asarray(b.means),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(a.weights), np.asarray(b.weights),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(a.variances),
+                               np.asarray(b.variances), rtol=1e-2, atol=1e-2)
+
+
+def test_gmm_predict_proba_nondiag(aniso_blobs):
+    x, y, means_init = aniso_blobs
+    res = gmm_fit(x, 3, init=means_init, max_iters=100, tol=1e-5,
+                  covariance_type="full")
+    p = np.asarray(gmm_predict_proba(x[:50], res))
+    assert p.shape == (50, 3)
+    np.testing.assert_allclose(p.sum(1), 1.0, rtol=1e-5)
+    labels = np.asarray(gmm_predict(x, res))
+    # Separated blobs: predicted partition should align with truth (up to
+    # permutation) for nearly all points.
+    from scipy.stats import mode as _mode
+    agree = sum(
+        (labels[y == j] == _mode(labels[y == j], keepdims=False).mode).mean()
+        for j in range(3)
+    ) / 3
+    assert agree > 0.95
+
+
+def test_gmm_covariance_validations(aniso_blobs):
+    x, _, _ = aniso_blobs
+    with pytest.raises(ValueError, match="covariance_type"):
+        gmm_fit(x, 3, covariance_type="banana")
+    with pytest.raises(ValueError, match="diag"):
+        gmm_fit(x[:512], 2, covariance_type="full", mesh=make_mesh(2))
+    with pytest.raises(ValueError, match="nonnegative"):
+        gmm_fit(x, 3, sample_weight=-np.ones(len(x)))
+
+
+def test_gmm_estimator_covariance_type(aniso_blobs):
+    from tdc_tpu.models import GaussianMixture as Est
+
+    x, _, _ = aniso_blobs
+    est = Est(n_components=3, covariance_type="tied", random_state=0).fit(x)
+    assert est.covariances_.shape == (2, 2)
+    assert est.predict(x[:10]).shape == (10,)
+
+
+def test_gmm_stats_fused_matches_xla(aniso_blobs):
+    from tdc_tpu.ops.pallas_kernels import gmm_stats_fused
+
+    x, _, means_init = aniso_blobs
+    res = gmm_fit(x, 3, init=means_init, max_iters=5, tol=1e-5)
+    means, var, w = (np.asarray(res.means), np.asarray(res.variances),
+                     np.asarray(res.weights))
+    ll, nk, sx, sxx = gmm_stats_fused(
+        jnp.asarray(x), jnp.asarray(means), jnp.asarray(var), jnp.asarray(w),
+        block_n=256,
+    )
+    from tdc_tpu.models.gmm import _log_prob
+    import jax.scipy.special as jsp
+
+    logp = _log_prob(jnp.asarray(x), jnp.asarray(means), jnp.asarray(var),
+                     jnp.log(jnp.asarray(w)))
+    norm = jsp.logsumexp(logp, axis=1, keepdims=True)
+    r = np.asarray(jnp.exp(logp - norm))
+    np.testing.assert_allclose(float(ll), float(jnp.sum(norm)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(nk), r.sum(0), rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sx), r.T @ x, rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(sxx), r.T @ (x**2),
+                               rtol=1e-4, atol=1e-2)
+
+
+def test_gmm_fit_pallas_kernel_matches_xla(aniso_blobs):
+    x, _, means_init = aniso_blobs
+    a = gmm_fit(x, 3, init=means_init, max_iters=50, tol=1e-5, kernel="xla")
+    b = gmm_fit(x, 3, init=means_init, max_iters=50, tol=1e-5,
+                kernel="pallas")
+    np.testing.assert_allclose(np.asarray(a.means), np.asarray(b.means),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(a.variances),
+                               np.asarray(b.variances), rtol=1e-2, atol=1e-3)
+    np.testing.assert_allclose(float(a.log_likelihood),
+                               float(b.log_likelihood), rtol=1e-4)
+
+
+def test_streamed_gmm_pallas_kernel_matches(aniso_blobs):
+    from tdc_tpu.data.loader import NpzStream
+    from tdc_tpu.models.gmm import streamed_gmm_fit
+
+    x, _, means_init = aniso_blobs
+    a = streamed_gmm_fit(NpzStream(x, 250), 3, 2, init=means_init,
+                         max_iters=15, tol=1e-5)
+    b = streamed_gmm_fit(NpzStream(x, 250), 3, 2, init=means_init,
+                         max_iters=15, tol=1e-5, kernel="pallas")
+    np.testing.assert_allclose(np.asarray(a.means), np.asarray(b.means),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(float(a.log_likelihood),
+                               float(b.log_likelihood), rtol=1e-4)
+
+
+def test_gmm_pallas_kernel_validations(aniso_blobs):
+    x, _, _ = aniso_blobs
+    with pytest.raises(ValueError, match="pallas"):
+        gmm_fit(x, 3, kernel="pallas", covariance_type="full")
+    with pytest.raises(ValueError, match="pallas"):
+        gmm_fit(x, 3, kernel="pallas", sample_weight=np.ones(len(x)))

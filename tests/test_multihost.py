@@ -119,3 +119,79 @@ def test_points_from_host_shards_roundtrip(blobs_small):
     res = kmeans_fit(arr, 3, init=x[:3], max_iters=30, tol=1e-6,
                      mesh=mesh)
     assert bool(res.converged)
+
+
+_WORKER_SHARDED_K = textwrap.dedent(
+    """
+    import os, sys
+    port, pid, nproc, outdir = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tdc_tpu.parallel.multihost import initialize_distributed
+    initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
+    assert jax.process_count() == nproc
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tdc_tpu.parallel.sharded_k import kmeans_fit_sharded, make_mesh_2d
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1600, 6)).astype(np.float32)  # identical on all procs
+    # Global mesh: data axis spans the 2 processes, model axis is the 2
+    # local devices of each — centroids live as K-shards ACROSS processes.
+    mesh = make_mesh_2d(2, 2)
+    procs_on_data_axis = {
+        d.process_index for d in mesh.devices[:, 0].ravel()
+    }
+    assert len(procs_on_data_axis) == nproc, mesh.devices
+    res = kmeans_fit_sharded(X, 8, mesh, init=X[:8], max_iters=12, tol=-1.0)
+    # Gather the K-sharded centroids: reshard to replicated, then to host.
+    c_rep = jax.jit(
+        lambda c: c, out_shardings=NamedSharding(mesh, P())
+    )(res.centroids)
+    np.save(os.path.join(outdir, f"sharded_c_{pid}.npy"), np.asarray(c_rep))
+    print("WORKER_OK", pid, flush=True)
+    """
+)
+
+
+def test_two_process_k_sharded_fit_matches_single(tmp_path):
+    """SURVEY §7 step 7 composed: a 2-process jax.distributed run whose 2-D
+    mesh is (data=2 hosts x model=2 local devices), running
+    kmeans_fit_sharded with the centroid tiles resident as K-shards across
+    processes. Must match the single-process in-memory fit (round-2 VERDICT
+    item 4 — K-sharding and multi-host were only proven separately)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER_SHARDED_K)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(i), "2", str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {i}" in out
+    c0 = np.load(tmp_path / "sharded_c_0.npy")
+    c1 = np.load(tmp_path / "sharded_c_1.npy")
+    np.testing.assert_array_equal(c0, c1)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1600, 6)).astype(np.float32)
+    want = kmeans_fit(X, 8, init=X[:8], max_iters=12, tol=-1.0)
+    np.testing.assert_allclose(c0, np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)

