@@ -1,0 +1,113 @@
+"""Fused-Lloyd kernel tuning harness: (block_n, halves) sweep + trace capture.
+
+Run on the bench chip to (a) re-tune bench.py's FUSED_BLOCK_N and the
+sub-block split (`halves`, the MXU/VPU-overlap lever in
+ops/pallas_kernels.py:_fused_lloyd_kernel), and (b) capture a profiler trace
+of the winner for the roofline analysis (benchmarks/ROOFLINE.md).
+
+Timing protocol matches bench.py: slope between a short and a long
+data-dependent chain of Lloyd iterations, so constant dispatch/fetch/tunnel
+overhead cancels; per chain length the MIN over repetitions is taken first —
+tunnel hiccups only ever ADD time, so min-per-length is robust where a
+min-over-paired-slopes keeps exactly the pairs whose short chain was
+inflated (observed as negative slopes).
+
+Usage: python benchmarks/kernel_tuning.py [--trace_dir DIR] [--iters 24]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tdc_tpu.ops.assign import apply_centroid_update
+from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+
+K = 1024
+D = 128
+
+# (block_n, halves) grid: halves=1 is the strictly sequential kernel; the
+# larger splits overflowed VMEM (JaxRuntimeError) in the round-3 sweep and
+# stay here so regressions in the VMEM model are noticed.
+CONFIGS = [
+    (1024, 1), (1024, 2), (2048, 1), (2048, 2), (2048, 4), (2048, 8),
+    (4096, 4), (4096, 8),
+]
+
+
+def chain_time(step, x, c, iters):
+    ci = c
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ci = step(x, ci.astype(jnp.bfloat16))
+    np.asarray(ci)
+    return time.perf_counter() - t0
+
+
+def measure(step, x, c, iters_long, n, reps=3):
+    np.asarray(step(x, c.astype(jnp.bfloat16)))  # compile + warm
+    t_short = min(chain_time(step, x, c, 4) for _ in range(reps))
+    t_long = min(chain_time(step, x, c, iters_long) for _ in range(reps))
+    return n / ((t_long - t_short) / (iters_long - 4))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--trace_dir", default=None)
+    p.add_argument("--iters", type=int, default=24)
+    p.add_argument("--n", type=int, default=8 << 20)
+    args = p.parse_args()
+
+    key = jax.random.PRNGKey(0)
+    kx, kc = jax.random.split(key)
+    c = jax.random.normal(kc, (K, D), jnp.bfloat16)
+    x = jax.random.normal(kx, (args.n, D), jnp.bfloat16)
+
+    results = {}
+    for bn, halves in CONFIGS:
+        @jax.jit
+        def step(x, c, bn=bn, halves=halves):
+            return apply_centroid_update(
+                lloyd_stats_fused(x, c, block_n=bn, halves=halves), c
+            )
+
+        try:
+            rate = measure(step, x, c, args.iters, args.n)
+        except Exception as e:  # VMEM overflow at large bn*halves
+            print(f"bn={bn} halves={halves}: {type(e).__name__}")
+            continue
+        results[(bn, halves)] = rate
+        print(f"bn={bn} halves={halves}: {rate / 1e6:.1f} M pt*iter/s")
+
+    best = max(results, key=results.get)
+    print(f"best: bn={best[0]} halves={best[1]} "
+          f"at {results[best] / 1e6:.1f} M pt*iter/s")
+
+    if args.trace_dir:
+        bn, halves = best
+
+        @jax.jit
+        def step(x, c):
+            return apply_centroid_update(
+                lloyd_stats_fused(x, c, block_n=bn, halves=halves), c
+            )
+
+        np.asarray(step(x, c.astype(jnp.bfloat16)))
+        with jax.profiler.trace(args.trace_dir):
+            ci = c
+            for _ in range(8):
+                ci = step(x, ci.astype(jnp.bfloat16))
+            np.asarray(ci)
+        print(f"trace written to {args.trace_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -3,9 +3,11 @@
 Reference: Testing Images.ipynb — video frames reshaped (-1, 3) (#cell3),
 K=2/3 k-means++ clustering with full per-pixel labels (#cell1), recoloring via
 center[cluster_idx].reshape(H, W, 3) (#cell13), cross-validated against
-cv2.kmeans centers and timing (#cell5-6). Here the oracle is sklearn (cv2 is
-not in the image), the seeding is our device-resident k-means++, and both hard
-(K-Means) and soft (Fuzzy C-Means argmax) segmentation are supported.
+cv2.kmeans centers and timing (#cell5-6). The oracle here is cv2.kmeans when
+OpenCV is importable — the reference's exact oracle, same criteria and 10
+attempts — with sklearn.KMeans as the fallback; the seeding is our
+device-resident k-means++, and hard (K-Means), soft (Fuzzy C-Means argmax)
+and probabilistic (GMM posterior-argmax) segmentation are supported.
 
 CLI: python -m tdc_tpu.apps.segmentation --image in.png --K 3 --out seg.png
 """
@@ -83,6 +85,7 @@ def segment_frames(
     max_iters: int = 20,
     fuzzifier: float = 2.0,
     crosscheck_every: int = 0,
+    oracle: str = "auto",
 ):
     """Segment a sequence of same-shape frames (the reference's video loop,
     Testing Images.ipynb#cell12-13: per-frame segmentation, NaN sentinel, and
@@ -110,34 +113,20 @@ def segment_frames(
         row = {"frame": idx, "seconds": round(dt, 4), "K": k, "method": method}
         if crosscheck_every and idx % crosscheck_every == 0:
             c = frame.shape[2] if frame.ndim == 3 else 1
-            _, _, t_ours, t_sk, worst = crosscheck_sklearn(
-                frame.reshape(-1, c), k, seed + idx
+            name, _, _, t_ours, t_orc, worst = crosscheck_oracle(
+                frame.reshape(-1, c), k, seed + idx, oracle=oracle
             )
             row.update(
-                oracle_seconds=round(t_sk, 4),
+                oracle=name,
+                oracle_seconds=round(t_orc, 4),
                 refit_seconds=round(t_ours, 4),
                 max_center_dist=round(worst, 4),
             )
         yield recolored, labels, centers, row
 
 
-def crosscheck_sklearn(pixels: np.ndarray, k: int, seed: int = 0):
-    """Oracle comparison (reference compared against cv2.kmeans; we use
-    sklearn). Returns (our_centers, sk_centers, our_time_s, sk_time_s,
-    max_matched_center_dist)."""
-    from sklearn.cluster import KMeans
-
-    t0 = time.perf_counter()
-    _, ours, res = segment_pixels(pixels, k, seed=seed, max_iters=20)
-    jax.block_until_ready(res.centroids)
-    t_ours = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    sk = KMeans(n_clusters=k, n_init=3, max_iter=20, random_state=seed).fit(
-        pixels.astype(np.float32)
-    )
-    t_sk = time.perf_counter() - t0
-    theirs = sk.cluster_centers_
-    # Greedy-match (cluster order arbitrary).
+def _match_centers(ours: np.ndarray, theirs: np.ndarray) -> float:
+    """Greedy-match centers (cluster order arbitrary); worst matched dist."""
     used, worst = set(), 0.0
     for row in ours:
         dist = np.linalg.norm(theirs - row, axis=1)
@@ -146,7 +135,64 @@ def crosscheck_sklearn(pixels: np.ndarray, k: int, seed: int = 0):
                 used.add(i)
                 worst = max(worst, float(dist[i]))
                 break
-    return ours, theirs, t_ours, t_sk, worst
+    return worst
+
+
+def _our_centers_timed(pixels: np.ndarray, k: int, seed: int):
+    t0 = time.perf_counter()
+    _, ours, res = segment_pixels(pixels, k, seed=seed, max_iters=20)
+    jax.block_until_ready(res.centroids)
+    return ours, time.perf_counter() - t0
+
+
+def crosscheck_sklearn(pixels: np.ndarray, k: int, seed: int = 0):
+    """sklearn-oracle comparison. Returns (our_centers, sk_centers,
+    our_time_s, sk_time_s, max_matched_center_dist)."""
+    from sklearn.cluster import KMeans
+
+    ours, t_ours = _our_centers_timed(pixels, k, seed)
+    t0 = time.perf_counter()
+    sk = KMeans(n_clusters=k, n_init=3, max_iter=20, random_state=seed).fit(
+        pixels.astype(np.float32)
+    )
+    t_sk = time.perf_counter() - t0
+    theirs = sk.cluster_centers_
+    return ours, theirs, t_ours, t_sk, _match_centers(ours, theirs)
+
+
+def crosscheck_cv2(pixels: np.ndarray, k: int, seed: int = 0):
+    """cv2.kmeans-oracle comparison — the reference's exact oracle
+    (Testing Images.ipynb#cell5-6,#cell13: TERM_CRITERIA_EPS+MAX_ITER,
+    10 iterations, eps 1.0, 10 attempts, random centers). Same return shape
+    as crosscheck_sklearn."""
+    import cv2
+
+    ours, t_ours = _our_centers_timed(pixels, k, seed)
+    cv2.setRNGSeed(seed)  # KMEANS_RANDOM_CENTERS draws from cv2's global RNG
+    criteria = (cv2.TERM_CRITERIA_EPS + cv2.TERM_CRITERIA_MAX_ITER, 10, 1.0)
+    t0 = time.perf_counter()
+    _, _, theirs = cv2.kmeans(
+        pixels.astype(np.float32), k, None, criteria, 10,
+        cv2.KMEANS_RANDOM_CENTERS,
+    )
+    t_cv = time.perf_counter() - t0
+    return ours, theirs, t_ours, t_cv, _match_centers(ours, theirs)
+
+
+def crosscheck_oracle(pixels: np.ndarray, k: int, seed: int = 0,
+                      oracle: str = "auto"):
+    """Dispatch to the cv2 oracle (reference parity) when importable, else
+    sklearn. Returns (name, our_centers, oracle_centers, t_ours, t_oracle,
+    max_matched_center_dist)."""
+    if oracle == "auto":
+        try:
+            import cv2  # noqa: F401
+
+            oracle = "cv2"
+        except ImportError:
+            oracle = "sklearn"
+    fn = crosscheck_cv2 if oracle == "cv2" else crosscheck_sklearn
+    return (oracle, *fn(pixels, k, seed))
 
 
 def main(argv=None) -> int:
@@ -166,9 +212,14 @@ def main(argv=None) -> int:
                    help="write per-frame recolored images here (--frames mode)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--crosscheck", action="store_true",
-                   help="compare centers/timing vs sklearn (reference #cell13)")
+                   help="compare centers/timing vs the CPU oracle "
+                        "(reference #cell13)")
     p.add_argument("--crosscheck_every", type=int, default=0,
                    help="--frames mode: oracle-check every Nth frame")
+    p.add_argument("--oracle", choices=("auto", "cv2", "sklearn"),
+                   default="auto",
+                   help="CPU oracle: cv2.kmeans (the reference's, "
+                        "Testing Images.ipynb#cell5) or sklearn")
     args = p.parse_args(argv)
 
     from PIL import Image
@@ -190,7 +241,7 @@ def main(argv=None) -> int:
         for (recolored, _, _, row), path in zip(
             segment_frames(
                 load(), args.K, method=args.method, seed=args.seed,
-                crosscheck_every=args.crosscheck_every,
+                crosscheck_every=args.crosscheck_every, oracle=args.oracle,
             ),
             paths,
         ):
@@ -213,10 +264,10 @@ def main(argv=None) -> int:
         Image.fromarray(recolored).save(args.out)
         print(f"wrote {args.out}")
     if args.crosscheck:
-        ours, theirs, t_ours, t_sk, worst = crosscheck_sklearn(
-            img.reshape(-1, 3), args.K, args.seed
+        name, ours, theirs, t_ours, t_orc, worst = crosscheck_oracle(
+            img.reshape(-1, 3), args.K, args.seed, oracle=args.oracle
         )
-        print(f"tdc_tpu: {t_ours:.3f}s  sklearn: {t_sk:.3f}s  "
+        print(f"tdc_tpu: {t_ours:.3f}s  {name}: {t_orc:.3f}s  "
               f"max matched-center distance: {worst:.3f}")
     return 0
 

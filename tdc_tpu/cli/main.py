@@ -212,9 +212,38 @@ def validate_args(parser, args):
             if args.covariance_type != "diag" or args.weight_file:
                 parser.error("--kernel=pallas gaussianMixture supports the "
                              "diag, unweighted E-step only")
-            if args.n_devices and args.n_devices > 1:
+            # n_devices=None defaults to every local device at run time, so
+            # the single-device rule must check the resolved count, not just
+            # an explicit flag.
+            n_dev = args.n_devices
+            if n_dev is None:
+                import jax
+
+                n_dev = jax.device_count()
+            if n_dev > 1:
                 parser.error("--kernel=pallas gaussianMixture is "
-                             "single-device")
+                             "single-device (resolved n_devices="
+                             f"{n_dev})")
+            # Fail fast when the shape is known here (--n_dim given).
+            # --data_file runs (n_dim unknown until load) are covered by the
+            # same check inside gmm_fit/streamed_gmm_fit, which raises into
+            # the CSV error row. Streamed batches stay f32 regardless of
+            # --dtype (bf16 applies to in-memory device arrays only), so the
+            # itemsize must match what the fit will actually see.
+            if args.n_dim is not None:
+                from tdc_tpu.ops.pallas_kernels import gmm_block_n
+
+                streamed = args.streamed or args.num_batches > 1
+                itemsize = (
+                    2 if (args.dtype == "bfloat16" and not streamed) else 4
+                )
+                if gmm_block_n(args.K, args.n_dim, itemsize) == 0:
+                    parser.error(
+                        f"--kernel=pallas gaussianMixture: K={args.K}, "
+                        f"n_dim={args.n_dim} exceeds the fused E-step's VMEM "
+                        "feasibility (gmm_stats_auto would silently run the "
+                        "XLA E-step); drop --kernel=pallas"
+                    )
     elif args.init == "kmeans":
         parser.error("--init=kmeans is a gaussianMixture seeding mode")
     elif args.covariance_type != "diag":

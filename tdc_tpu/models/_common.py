@@ -1,0 +1,29 @@
+"""Validation helpers shared across the model fits."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def validate_sample_weight(sample_weight, n: int, k: int) -> jnp.ndarray:
+    """Validate per-point weights and return them as a device (N,) f32 array.
+
+    One copy for kmeans/fuzzy/gmm so the error contract can't drift.
+    Rejects wrong shape, negative entries, and fewer than K positive entries
+    (sklearn raises too: weighted inits can only draw from positive-mass
+    points, and fewer than K of them cannot seed K distinct clusters).
+    """
+    host = np.asarray(sample_weight)
+    w = jnp.asarray(host, jnp.float32)
+    if w.shape != (n,):
+        raise ValueError(f"sample_weight shape {w.shape} != ({n},)")
+    if (host < 0).any():
+        raise ValueError("sample_weight entries must be nonnegative")
+    n_pos = int((host > 0).sum())
+    if n_pos < k:
+        raise ValueError(
+            f"sample_weight has only {n_pos} positive entries; "
+            f"need at least K={k}"
+        )
+    return w

@@ -186,19 +186,9 @@ def fuzzy_cmeans_fit(
         return res
     w = None
     if sample_weight is not None:
-        w = jnp.asarray(sample_weight, jnp.float32)
-        if w.shape != (x.shape[0],):
-            raise ValueError(
-                f"sample_weight shape {w.shape} != ({x.shape[0]},)"
-            )
-        if (np.asarray(sample_weight) < 0).any():
-            raise ValueError("sample_weight entries must be nonnegative")
-        n_pos = int((np.asarray(sample_weight) > 0).sum())
-        if n_pos < k:
-            raise ValueError(
-                f"sample_weight has only {n_pos} positive entries; "
-                f"need at least K={k}"
-            )
+        from tdc_tpu.models._common import validate_sample_weight
+
+        w = validate_sample_weight(sample_weight, int(x.shape[0]), k)
     if mesh is not None:
         n_dev = int(np.prod(mesh.devices.shape))
         if x.shape[0] % n_dev != 0:

@@ -224,11 +224,13 @@ def _em_loop(x, means0, cov0, weights0, max_iters: int, tol: float,
         return ll, nk, sx, s2
 
     def m_step(nk, sx, s2):
+        if cov_type == "diag":
+            # Delegate to the single shared diag M-step (streamed fit uses
+            # the same copy — floors/clamps can never drift apart).
+            return _m_step(nk, sx, s2, wsum, reg)
         safe = jnp.maximum(nk, 1e-12)[:, None]
         means = sx / safe
-        if cov_type == "diag":
-            cov = jnp.maximum(s2 / safe - means**2, 0.0) + reg
-        elif cov_type == "spherical":
+        if cov_type == "spherical":
             # sklearn: the mean of the (reg-floored) diag variances.
             cov = jnp.mean(jnp.maximum(s2 / safe - means**2, 0.0) + reg,
                            axis=1)
@@ -307,8 +309,8 @@ def gmm_fit(
         sklearn.mixture itself lacks).
       kernel: 'xla' (default) or 'pallas' — the fused single-pass E-step
         kernel (ops/pallas_kernels.gmm_stats_fused); diag, unweighted,
-        single-device only; auto-falls-back to the XLA E-step beyond the
-        VMEM-feasible K·d.
+        single-device only, and raises beyond the VMEM-feasible K·d (an
+        explicit 'pallas' request must not silently record XLA numbers).
     """
     x = jnp.asarray(x)
     n, d = x.shape
@@ -331,17 +333,21 @@ def gmm_fit(
             "kernel='pallas' supports the diag, unweighted, single-device "
             "E-step only"
         )
+    if kernel == "pallas":
+        # Reject infeasible K·d up front: gmm_stats_auto would otherwise
+        # silently run the XLA E-step under a 'pallas' label.
+        from tdc_tpu.ops.pallas_kernels import gmm_block_n
+
+        if gmm_block_n(k, d, x.dtype.itemsize) == 0:
+            raise ValueError(
+                f"kernel='pallas': K={k}, d={d} exceeds the fused E-step's "
+                "VMEM feasibility; use kernel='xla'"
+            )
     w = None
     if sample_weight is not None:
-        w = jnp.asarray(sample_weight, jnp.float32)
-        if w.shape != (n,):
-            raise ValueError(f"sample_weight shape {w.shape} != ({n},)")
-        if (np.asarray(sample_weight) < 0).any():
-            raise ValueError("sample_weight entries must be nonnegative")
-        if int((np.asarray(sample_weight) > 0).sum()) < k:
-            raise ValueError(
-                f"sample_weight has fewer than K={k} positive entries"
-            )
+        from tdc_tpu.models._common import validate_sample_weight
+
+        w = validate_sample_weight(sample_weight, n, k)
     if mesh is not None:
         n_dev = int(np.prod(mesh.devices.shape))
         if n % n_dev != 0:
@@ -550,6 +556,17 @@ def streamed_gmm_fit(
         raise ValueError(
             "streamed kernel='pallas' supports single-device streams only"
         )
+    if kernel == "pallas":
+        # Streamed batches stay f32 (itemsize 4) regardless of any in-memory
+        # bf16 preference; reject infeasible K·d rather than let
+        # gmm_stats_auto silently run the XLA E-step per batch.
+        from tdc_tpu.ops.pallas_kernels import gmm_block_n
+
+        if gmm_block_n(k, d, 4) == 0:
+            raise ValueError(
+                f"kernel='pallas': K={k}, d={d} exceeds the fused E-step's "
+                "VMEM feasibility; use kernel='xla'"
+            )
     # Restore FIRST: a resume must not pay (and then discard) the
     # first-batch seeding — a multi-restart Lloyd fit plus broadcasts —
     # on every supervised-gang relaunch.

@@ -319,22 +319,9 @@ def kmeans_fit(
         block_rows = auto_block_rows(int(np.asarray(x.shape[0])), k)
     w = None
     if sample_weight is not None:
-        w = jnp.asarray(sample_weight, jnp.float32)
-        if w.shape != (x.shape[0],):
-            raise ValueError(
-                f"sample_weight shape {w.shape} != ({x.shape[0]},)"
-            )
-        if (np.asarray(sample_weight) < 0).any():
-            raise ValueError("sample_weight entries must be nonnegative")
-        n_pos = int((np.asarray(sample_weight) > 0).sum())
-        if n_pos < k:
-            # sklearn raises too: the weighted inits can only draw from
-            # positive-mass points, and fewer than K of them cannot seed K
-            # distinct clusters.
-            raise ValueError(
-                f"sample_weight has only {n_pos} positive entries; "
-                f"need at least K={k}"
-            )
+        from tdc_tpu.models._common import validate_sample_weight
+
+        w = validate_sample_weight(sample_weight, int(x.shape[0]), k)
     if spherical:
         x = _normalize(x.astype(jnp.float32))
     if mesh is not None:

@@ -177,12 +177,24 @@ def distance_argmin(
 
 
 def _fused_lloyd_kernel(
-    x_ref, c_ref, c2_ref, x2_ref, sums_ref, counts_ref, sse_ref,
-    acc_sums, acc_counts, acc_sse,
+    x_ref, c_ref, c2_ref, sums_ref, counts_ref, sse_ref,
+    acc_sums, acc_counts, acc_sse, *, halves: int,
 ):
     """Grid over N-blocks; K fully VMEM-resident. Per block: distances →
     argmin (iota trick) → exact one-hot (col == argmin) → MXU accumulate into
-    VMEM scratch; outputs written once at the last block."""
+    VMEM scratch; outputs written once at the last block.
+
+    `halves` > 1 splits the block into sub-blocks whose cross matmuls are all
+    issued before any VPU work, so Mosaic can overlap sub-block i's K-wide
+    VPU chain (min/argmin/one-hot) with sub-block i+1's MXU matmul — worth
+    ~10% at the K=1024, d=128 bench shape (benchmarks/kernel_tuning.py;
+    halves=1 reproduces the strictly sequential kernel bit-for-bit).
+
+    Σ‖x‖² (needed only for the SSE) is computed here from the already-loaded
+    x tile — a d-wide pass, ~d/K of the K-wide VPU work — NOT passed in as an
+    (N, 1) input: profiling showed the host-side Σx² reduce plus the
+    T(1,128)→T(8,128) relayout copy XLA inserts for an (N, 1) custom-call
+    operand cost 22% of the whole iteration (benchmarks/ROOFLINE.md)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -191,27 +203,36 @@ def _fused_lloyd_kernel(
         acc_counts[...] = jnp.zeros_like(acc_counts)
         acc_sse[...] = jnp.zeros_like(acc_sse)
 
-    cross = jax.lax.dot_general(
-        x_ref[...],
-        c_ref[...],
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (BN, K)
-    d2 = c2_ref[...] - 2.0 * cross
-    tile_min = jnp.min(d2, axis=1, keepdims=True)  # (BN, 1)
-    col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
-    masked = jnp.where(d2 <= tile_min, col, _ARG_SENTINEL)
-    tile_arg = jnp.min(masked, axis=1, keepdims=True)  # (BN, 1)
-    one_hot = (col == tile_arg).astype(x_ref.dtype)  # exact single 1 per row
-    acc_sums[...] += jax.lax.dot_general(
-        one_hot,
-        x_ref[...],
-        (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_counts[...] += jnp.sum(one_hot.astype(jnp.float32), axis=0, keepdims=True)
-    # True SSE needs the dropped ‖x‖² back: Σ(min d2') + Σ‖x‖² per block.
-    acc_sse[...] += jnp.sum(tile_min) + jnp.sum(x2_ref[...])
+    sub = x_ref.shape[0] // halves
+    xs = [x_ref[h * sub:(h + 1) * sub, :] for h in range(halves)]
+    crosses = [
+        jax.lax.dot_general(
+            xh,
+            c_ref[...],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BN/halves, K)
+        for xh in xs
+    ]
+    for xh, cross in zip(xs, crosses):
+        d2 = c2_ref[...] - 2.0 * cross
+        tile_min = jnp.min(d2, axis=1, keepdims=True)  # (sub, 1)
+        col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+        masked = jnp.where(d2 <= tile_min, col, _ARG_SENTINEL)
+        tile_arg = jnp.min(masked, axis=1, keepdims=True)  # (sub, 1)
+        one_hot = (col == tile_arg).astype(xh.dtype)  # exact single 1 per row
+        acc_sums[...] += jax.lax.dot_general(
+            one_hot,
+            xh,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_counts[...] += jnp.sum(
+            one_hot.astype(jnp.float32), axis=0, keepdims=True
+        )
+        # True SSE needs the dropped ‖x‖² back: Σ(min d2') + Σ‖x‖².
+        xf = xh.astype(jnp.float32)
+        acc_sse[...] += jnp.sum(tile_min) + jnp.sum(xf * xf)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -220,12 +241,13 @@ def _fused_lloyd_kernel(
         sse_ref[...] = acc_sse[...]
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block_n", "halves", "interpret"))
 def lloyd_stats_fused(
     x: jax.Array,
     centroids: jax.Array,
     *,
     block_n: int | None = None,
+    halves: int | None = None,
     interpret: bool | None = None,
 ):
     """Fully-fused Lloyd sufficient stats: one kernel, one pass over x, no
@@ -234,6 +256,12 @@ def lloyd_stats_fused(
     lloyd_stats_pallas (two-pass) or ops.assign.lloyd_stats_blocked beyond
     (lloyd_stats_auto routes by feasibility). block_n=None sizes the N-block
     from the VMEM model (fused_block_n).
+
+    halves=None auto-enables the MXU/VPU-overlap sub-block split only at the
+    empirically validated block size (2048 → 4 sub-blocks of 512; measured
+    +10% on v5e, and VMEM-safe — larger splits overflowed the scope in the
+    benchmarks/kernel_tuning.py sweep); any other block keeps the strictly
+    sequential kernel. The math is identical either way.
 
     Returns ops.assign.SufficientStats (sums (K,d) f32, counts (K,) f32,
     sse () f32 — true Σ min‖x−c‖², clamped at 0).
@@ -252,24 +280,29 @@ def lloyd_stats_fused(
                 "(accumulator alone exceeds the scope); use "
                 "lloyd_stats_pallas / lloyd_stats_auto"
             )
+    if halves is None:
+        halves = 4 if block_n == 2048 else 1
+    elif block_n % halves:
+        raise ValueError(
+            f"lloyd_stats_fused: halves={halves} must divide "
+            f"block_n={block_n} (a remainder would silently drop rows)"
+        )
     xp = _pad_axis(_pad_axis(x, 1, 128, 0), 0, block_n, 0)
     cp = _pad_axis(
         _pad_axis(centroids.astype(x.dtype), 1, 128, 0), 0, 128, _PAD_CENTROID
     )
     c2 = jnp.sum(cp.astype(jnp.float32) ** 2, axis=1)[None, :]  # (1, K_pad)
-    x2 = jnp.sum(xp.astype(jnp.float32) ** 2, axis=1, keepdims=True)  # (N_pad, 1)
     n_pad, k_pad = xp.shape[0], cp.shape[0]
     d_pad = xp.shape[1]
     n_blocks = n_pad // block_n
 
     sums, counts, sse = pl.pallas_call(
-        _fused_lloyd_kernel,
+        functools.partial(_fused_lloyd_kernel, halves=halves),
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec((block_n, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_n, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -287,7 +320,7 @@ def lloyd_stats_fused(
             pltpu.VMEM((1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(xp, cp, c2, x2)
+    )(xp, cp, c2)
     # Padded x rows are all-zero: they land on some real cluster (the smallest
     # ‖c‖²) with zero Σx contribution but count/sse pollution — correct it.
     n_fake = n_pad - n

@@ -40,7 +40,7 @@ def tall_block_n(
     itemsize: int = 4,
     *,
     temps: int = 3,
-    budget: int = 14 << 20,
+    budget: int = 10 << 20,
     cap: int = 1 << 15,
 ) -> int:
     """Largest N-block (multiple of 128, ≤ cap) whose tall-kernel VMEM
@@ -53,6 +53,14 @@ def tall_block_n(
     itemsize) and `temps` live (K_s, BN) f32 temporaries across the
     distance → reduce → accumulate chain (≈3 for Lloyd: cross/d2, masked
     iota, one-hot; ≈5 for fuzzy: cross/d2, inv, u, mu + one live extra).
+
+    The budget is deliberately ~64% of the 16 MB scope: measured on v5e, the
+    model's 14 MB-budget pick at K=32, d=16 (block 32000, modeled 14.6 MB)
+    actually allocated 16.30 MB and failed Mosaic's scoped-vmem check by
+    305 KB — an ~11% model underestimate that then mis-routed the CLI's
+    auto layout into a needless streamed fallback. 10 MB keeps ≥30%
+    headroom over that worst observed error; the reference-grid shapes
+    (K ≤ 15, d = 5) are cap-limited and unaffected.
     """
     k_s = -(-k // 8) * 8
     d8 = -(-d // 8) * 8

@@ -96,6 +96,27 @@ def test_crosscheck_sklearn_centers_close(toy_image):
     assert worst < 10.0  # color units out of 255; same clusters found
 
 
+def test_crosscheck_cv2_centers_close(toy_image):
+    """The reference's exact oracle (Testing Images.ipynb#cell5-6)."""
+    pytest.importorskip("cv2")
+    from tdc_tpu.apps.segmentation import crosscheck_cv2
+
+    pixels = toy_image.reshape(-1, 3)
+    ours, theirs, t_ours, t_cv, worst = crosscheck_cv2(pixels, 3)
+    assert theirs.shape == (3, 3)
+    assert worst < 10.0
+
+
+def test_crosscheck_oracle_dispatch(toy_image):
+    from tdc_tpu.apps.segmentation import crosscheck_oracle
+
+    pixels = toy_image.reshape(-1, 3)
+    name, *rest = crosscheck_oracle(pixels, 3, oracle="sklearn")
+    assert name == "sklearn" and rest[-1] < 10.0
+    name, *rest = crosscheck_oracle(pixels, 3, oracle="auto")
+    assert name in ("cv2", "sklearn")
+
+
 def test_nan_sentinel():
     with pytest.raises(ValueError):
         segment_pixels(np.zeros((10, 3), np.float32), 3, method="bogus")

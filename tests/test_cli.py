@@ -470,3 +470,55 @@ def test_cli_gaussian_mixture_streamed_ckpt(tmp_path):
     import os
 
     assert any(n.startswith("step_") for n in os.listdir(tmp_path / "ck"))
+
+
+def test_validate_rejects_gmm_pallas_vmem_infeasible(tmp_path, capsys):
+    """--kernel=pallas gaussianMixture must reject (not silently downgrade
+    to the XLA E-step) when K*d exceeds the fused kernel's VMEM bound."""
+    p = build_parser()
+    args = p.parse_args(
+        f"--K=2048 --n_obs=10000 --n_dim=256 --seed=0 --n_GPUs=1 "
+        f"--method_name=gaussianMixture --kernel=pallas "
+        f"--log_file={tmp_path}/log.csv".split()
+    )
+    with pytest.raises(SystemExit):
+        validate_args(p, args)
+    # Must be THIS gate, not an earlier unrelated parser.error.
+    assert "VMEM" in capsys.readouterr().err
+
+
+def test_validate_rejects_gmm_pallas_implicit_multidevice(tmp_path):
+    """Without --n_GPUs the run would use every local device (8 on the test
+    mesh) — the single-device rule must catch the resolved count, not just
+    an explicit flag."""
+    p = build_parser()
+    args = p.parse_args(
+        f"--K=4 --n_obs=1000 --n_dim=8 --seed=0 "
+        f"--method_name=gaussianMixture --kernel=pallas "
+        f"--log_file={tmp_path}/log.csv".split()
+    )
+    with pytest.raises(SystemExit):
+        validate_args(p, args)
+
+
+def test_gmm_fit_rejects_pallas_vmem_infeasible(rng):
+    """The runtime copy of the gate (covers --data_file runs where n_dim is
+    unknown at CLI-validation time)."""
+    import jax
+
+    from tdc_tpu.models.gmm import gmm_fit
+
+    x = rng.normal(size=(2048, 768)).astype("float32")
+    with pytest.raises(ValueError, match="VMEM"):
+        gmm_fit(x, 1024, kernel="pallas", key=jax.random.PRNGKey(0))
+
+
+def test_streamed_gmm_rejects_pallas_vmem_infeasible(rng):
+    import jax
+
+    from tdc_tpu.models.gmm import streamed_gmm_fit
+
+    batches = [rng.normal(size=(2048, 768)).astype("float32")]
+    with pytest.raises(ValueError, match="VMEM"):
+        streamed_gmm_fit(lambda: iter(batches), 1024, 768, kernel="pallas",
+                         key=jax.random.PRNGKey(0))

@@ -294,3 +294,30 @@ def test_twopass_fuzzy_fuzzifier_variants(rng):
         want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=m)
         np.testing.assert_allclose(np.asarray(got.weights),
                                    np.asarray(want.weights), rtol=1e-2)
+
+
+def test_fused_lloyd_rejects_nondividing_halves(rng):
+    """halves must divide block_n: a remainder would silently drop rows
+    from the accumulated stats."""
+    import pytest
+
+    from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+
+    x = jnp.asarray(rng.normal(size=(256, 8)), jnp.float32)
+    c = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+    with pytest.raises(ValueError, match="halves"):
+        lloyd_stats_fused(x, c, block_n=128, halves=3)
+
+
+def test_fused_lloyd_halves_matches_sequential(rng):
+    """halves>1 is a scheduling change only — identical sufficient stats."""
+    from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused
+
+    x = jnp.asarray(rng.normal(size=(512, 8)), jnp.float32)
+    c = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+    a = lloyd_stats_fused(x, c, block_n=128, halves=1)
+    b = lloyd_stats_fused(x, c, block_n=128, halves=4)
+    np.testing.assert_allclose(np.asarray(a.sums), np.asarray(b.sums),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(a.counts), np.asarray(b.counts))
+    np.testing.assert_allclose(float(a.sse), float(b.sse), rtol=1e-6)

@@ -68,6 +68,14 @@ def test_tall_block_n_model():
     assert tall_block_n(15, 5) % 128 == 0
     # Huge K: infeasible — callers must route to sample-major kernels.
     assert tall_block_n(1 << 20, 5) == 0
+    # v5e calibration regression: at K=32, d=16 a block of 32000 (the old
+    # 14 MB-budget pick) measured 16.30 MB of scoped VMEM and failed Mosaic
+    # compile; 24576 compiled. The model must stay below the known-bad size
+    # — the CLI's auto-layout gate trusts it, and an optimistic pick turns
+    # a fast in-memory fit into a needless streamed fallback.
+    assert 0 < tall_block_n(32, 16, 4) <= 24576
+    # The reference-grid shapes stay cap-limited (unaffected by the budget).
+    assert tall_block_n(15, 5) == 1 << 15
 
 
 def test_kmeans_fit_features_layout_matches(rng):
