@@ -79,7 +79,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--covariance_type", type=str, default="diag",
                    choices=("diag", "spherical", "tied", "full"),
                    help="gaussianMixture covariance parameterization "
-                        "(sklearn parity); streamed GMM fits are diag-only")
+                        "(sklearn parity); all four types work in-memory "
+                        "AND streamed (--num_batches/--streamed)")
     p.add_argument("--spherical", action="store_true",
                    help="cosine K-Means (normalize points and centroids)")
     p.add_argument("--num_batches", type=int, default=1,
@@ -201,29 +202,21 @@ def validate_args(parser, args):
         if args.ckpt_every_batches:
             parser.error("gaussianMixture checkpoints per iteration only "
                          "(--ckpt_every_batches is kmeans/fuzzy)")
-        if args.covariance_type != "diag" and (args.streamed
-                                               or args.num_batches > 1):
-            parser.error("streamed gaussianMixture is diag-only; "
-                         f"--covariance_type={args.covariance_type} needs "
-                         "an in-memory fit")
         if args.kernel == "pallas":
             # Reject rather than silently downgrade to the XLA E-step — an
             # explicit kernel request must not record XLA numbers as Pallas.
             if args.covariance_type != "diag" or args.weight_file:
                 parser.error("--kernel=pallas gaussianMixture supports the "
                              "diag, unweighted E-step only")
-            # n_devices=None defaults to every local device at run time, so
-            # the single-device rule must check the resolved count, not just
-            # an explicit flag.
-            n_dev = args.n_devices
-            if n_dev is None:
-                import jax
-
-                n_dev = jax.device_count()
-            if n_dev > 1:
+            # Only the EXPLICIT flag is checkable here: resolving the
+            # implicit every-local-device default needs jax.device_count(),
+            # which would initialize the backend before run_experiment's
+            # jax.config.update('jax_platforms', --backend) and resolve the
+            # count on the wrong platform. The implicit case is guarded in
+            # run_experiment after n_devices resolves.
+            if args.n_devices and args.n_devices > 1:
                 parser.error("--kernel=pallas gaussianMixture is "
-                             "single-device (resolved n_devices="
-                             f"{n_dev})")
+                             "single-device")
             # Fail fast when the shape is known here (--n_dim given).
             # --data_file runs (n_dim unknown until load) are covered by the
             # same check inside gmm_fit/streamed_gmm_fit, which raises into
@@ -331,6 +324,16 @@ def run_experiment(args) -> dict:
             x, _ = load_points(args.data_file)
             n_obs, n_dim = x.shape
         n_devices = args.n_devices or len(jax.devices())
+        if (args.method_name == "gaussianMixture" and args.kernel == "pallas"
+                and n_devices > 1):
+            # The parse-time copy of this rule can only see an explicit
+            # --n_GPUs (resolving the default would initialize the wrong
+            # backend); the implicit every-local-device case lands here and
+            # is captured as a CSV error row like any other runtime error.
+            raise ValueError(
+                "--kernel=pallas gaussianMixture is single-device "
+                f"(resolved n_devices={n_devices}); pass --n_GPUs=1"
+            )
         if not args.data_file:
             n_obs, n_dim = args.n_obs, args.n_dim
             # Fully in-memory single-device fits keep the generated points on
@@ -518,15 +521,14 @@ def run_experiment(args) -> dict:
             )
         if args.method_name == "gaussianMixture":
             if streamed:
-                if weights is not None or args.covariance_type != "diag":
+                if weights is not None:
                     # Reachable only via the OOM fallback (validate_args
-                    # rejects the explicit flag combinations): the streamed
-                    # GMM must not silently drop weights/covariance type.
+                    # rejects the explicit flag combination): the streamed
+                    # GMM must not silently drop the weights.
                     raise ValueError(
                         "gaussianMixture fell back to streaming but "
-                        "--weight_file/--covariance_type!=diag support "
-                        "in-memory fits only; shrink the dataset or drop "
-                        "the flag"
+                        "--weight_file supports in-memory fits only; "
+                        "shrink the dataset or drop the flag"
                     )
                 from tdc_tpu.models.gmm import streamed_gmm_fit
 
@@ -537,6 +539,7 @@ def run_experiment(args) -> dict:
                     mesh=mesh, prefetch=args.prefetch,
                     ckpt_dir=args.ckpt_dir,
                     kernel=args.kernel or "xla",
+                    covariance_type=args.covariance_type,
                 )
             from tdc_tpu.models.gmm import gmm_fit
 

@@ -14,7 +14,11 @@ from tdc_tpu.models.gmm import (
     gmm_fit,
     gmm_predict,
     gmm_predict_proba,
+    gmm_sample,
     gmm_score,
+    gmm_score_samples,
+    gmm_bic,
+    gmm_aic,
     streamed_gmm_fit,
 )
 
@@ -37,6 +41,10 @@ __all__ = [
     "gmm_fit",
     "gmm_predict",
     "gmm_predict_proba",
+    "gmm_sample",
     "gmm_score",
+    "gmm_score_samples",
+    "gmm_bic",
+    "gmm_aic",
     "streamed_gmm_fit",
 ]

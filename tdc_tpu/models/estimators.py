@@ -92,6 +92,16 @@ class KMeans:
         return np.asarray(pairwise_dist(np.asarray(X, np.float32),
                                         self.cluster_centers_))
 
+    def score(self, X, y=None) -> float:
+        """Negative sum of squared distances to the closest center on X
+        (sklearn semantics: higher is better)."""
+        from tdc_tpu.ops.distance import pairwise_sq_dist
+
+        self._check_fitted()
+        d2 = np.asarray(pairwise_sq_dist(np.asarray(X, np.float32),
+                                         self.cluster_centers_))
+        return -float(np.sum(np.min(d2, axis=1)))
+
     def _check_fitted(self):
         if not hasattr(self, "cluster_centers_"):
             raise AttributeError("estimator is not fitted; call fit(X) first")
@@ -228,6 +238,35 @@ class GaussianMixture:
 
         self._check_fitted()
         return gmm_score(X, self._result)
+
+    def score_samples(self, X) -> np.ndarray:
+        from tdc_tpu.models.gmm import gmm_score_samples
+
+        self._check_fitted()
+        return np.asarray(gmm_score_samples(X, self._result))
+
+    def bic(self, X) -> float:
+        from tdc_tpu.models.gmm import gmm_bic
+
+        self._check_fitted()
+        return gmm_bic(X, self._result)
+
+    def aic(self, X) -> float:
+        from tdc_tpu.models.gmm import gmm_aic
+
+        self._check_fitted()
+        return gmm_aic(X, self._result)
+
+    def sample(self, n_samples: int = 1):
+        """(X (n, d), labels (n,)) drawn from the fitted mixture."""
+        from tdc_tpu.models.gmm import gmm_sample
+
+        self._check_fitted()
+        x, labels = gmm_sample(
+            self._result, n_samples,
+            jax.random.PRNGKey(self.random_state + 1),
+        )
+        return np.asarray(x), np.asarray(labels)
 
     def fit_predict(self, X, y=None, sample_weight=None) -> np.ndarray:
         return self.fit(X, sample_weight=sample_weight).predict(X)

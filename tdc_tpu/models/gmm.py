@@ -25,9 +25,12 @@ Matches sklearn.mixture.GaussianMixture(covariance_type=...) for all four
 types on oracle tests (tests/test_gmm.py); sample_weight matches the
 repeated-rows construction sklearn's API lacks.
 
-The exact out-of-core streamed fit (streamed_gmm_fit) is diag-only: diag
-sufficient statistics are O(K·d) device state, which is what makes the
-streaming exact and cheap.
+The exact out-of-core streamed fit (streamed_gmm_fit) covers all four
+covariance types: every type's sufficient statistics are plain sums over
+points (Σ r·x² for diag/spherical, Σ r·xxᵀ for full, the
+responsibility-free Σ xxᵀ for tied), so one full pass per EM iteration
+accumulates them exactly. Only the full type's (K, d, d) accumulator grows
+beyond O(K·d) device state.
 """
 
 from __future__ import annotations
@@ -181,6 +184,31 @@ def _m_step(nk, sx, sxx, n_rows, reg):
     return means, variances, weights / jnp.sum(weights)
 
 
+def _m_step_t(nk, sx, second, wsum, reg, cov_type: str):
+    """Covariance-type-aware M-step — the single copy shared by the
+    in-memory loop and the streamed fit. `second` is the type's second
+    moment: Σ r·x² (K, d) for diag/spherical, Σ r·xxᵀ (K, d, d) for full,
+    the iteration-constant Σ xxᵀ (d, d) for tied."""
+    if cov_type == "diag":
+        return _m_step(nk, sx, second, wsum, reg)
+    safe = jnp.maximum(nk, 1e-12)[:, None]
+    means = sx / safe
+    d = means.shape[1]
+    if cov_type == "spherical":
+        # sklearn: the mean of the (reg-floored) diag variances.
+        cov = jnp.mean(jnp.maximum(second / safe - means**2, 0.0) + reg,
+                       axis=1)
+    elif cov_type == "full":
+        outer = means[:, :, None] * means[:, None, :]
+        cov = second / jnp.maximum(nk, 1e-12)[:, None, None] - outer
+        cov = cov + reg * jnp.eye(d, dtype=jnp.float32)[None]
+    else:  # tied: Σ_k nk μμᵀ == sxᵀ @ means since nk·μ = sx
+        cov = (second - sx.T @ means) / wsum
+        cov = cov + reg * jnp.eye(d, dtype=jnp.float32)
+    weights = jnp.maximum(nk / wsum, 1e-12)
+    return means, cov, weights / jnp.sum(weights)
+
+
 @partial(jax.jit, static_argnames=("max_iters", "cov_type", "kernel"))
 def _em_loop(x, means0, cov0, weights0, max_iters: int, tol: float,
              reg: float, cov_type: str = "diag", w=None,
@@ -224,25 +252,10 @@ def _em_loop(x, means0, cov0, weights0, max_iters: int, tol: float,
         return ll, nk, sx, s2
 
     def m_step(nk, sx, s2):
-        if cov_type == "diag":
-            # Delegate to the single shared diag M-step (streamed fit uses
-            # the same copy — floors/clamps can never drift apart).
-            return _m_step(nk, sx, s2, wsum, reg)
-        safe = jnp.maximum(nk, 1e-12)[:, None]
-        means = sx / safe
-        if cov_type == "spherical":
-            # sklearn: the mean of the (reg-floored) diag variances.
-            cov = jnp.mean(jnp.maximum(s2 / safe - means**2, 0.0) + reg,
-                           axis=1)
-        elif cov_type == "full":
-            outer = means[:, :, None] * means[:, None, :]
-            cov = s2 / jnp.maximum(nk, 1e-12)[:, None, None] - outer
-            cov = cov + reg * jnp.eye(d, dtype=jnp.float32)[None]
-        else:  # tied: Σ_k nk μμᵀ == sxᵀ @ means since nk·μ = sx
-            cov = (s_total - sx.T @ means) / wsum
-            cov = cov + reg * jnp.eye(d, dtype=jnp.float32)
-        weights = jnp.maximum(nk / wsum, 1e-12)
-        return means, cov, weights / jnp.sum(weights)
+        # Delegate to the single shared type-aware M-step (streamed fit
+        # uses the same copy — floors/clamps can never drift apart).
+        second = s_total if cov_type == "tied" else s2
+        return _m_step_t(nk, sx, second, wsum, reg, cov_type)
 
     # Convergence: stop when the mean-log-likelihood gain of the latest EM
     # step drops to tol (sklearn's lower_bound_ criterion); always run at
@@ -457,52 +470,118 @@ def gmm_predict_proba(x, result: GMMResult) -> jax.Array:
 
 def gmm_score(x, result: GMMResult) -> float:
     """Mean per-point log-likelihood (sklearn .score parity)."""
+    return float(jnp.mean(gmm_score_samples(x, result)))
+
+
+def gmm_score_samples(x, result: GMMResult) -> jax.Array:
+    """(N,) per-point log p(x) under the mixture (sklearn .score_samples)."""
     x = jnp.asarray(x)
     logp = _log_prob_t(
         x, result.means, result.variances, jnp.log(result.weights),
         result.covariance_type,
     )
-    return float(jnp.mean(jax.scipy.special.logsumexp(logp, axis=1)))
+    return jax.scipy.special.logsumexp(logp, axis=1)
+
+
+def gmm_n_parameters(result: GMMResult) -> int:
+    """Free-parameter count for BIC/AIC (sklearn._n_parameters formulas)."""
+    k, d = result.means.shape
+    cov_params = {
+        "diag": k * d,
+        "spherical": k,
+        "tied": d * (d + 1) // 2,
+        "full": k * d * (d + 1) // 2,
+    }[result.covariance_type]
+    return int(cov_params + k * d + k - 1)
+
+
+def gmm_bic(x, result: GMMResult) -> float:
+    """Bayesian information criterion on x (lower is better)."""
+    n = jnp.asarray(x).shape[0]
+    return float(
+        -2.0 * gmm_score(x, result) * n
+        + gmm_n_parameters(result) * float(np.log(n))
+    )
+
+
+def gmm_aic(x, result: GMMResult) -> float:
+    """Akaike information criterion on x (lower is better)."""
+    n = jnp.asarray(x).shape[0]
+    return float(-2.0 * gmm_score(x, result) * n + 2 * gmm_n_parameters(result))
+
+
+def gmm_sample(result: GMMResult, n_samples: int, key: jax.Array):
+    """Draw (X (n, d), labels (n,)) from the fitted mixture (sklearn
+    .sample parity; components drawn by weight, then the matching
+    per-component Gaussian)."""
+    k, d = result.means.shape
+    kc, kx = jax.random.split(key)
+    comp = jax.random.categorical(
+        kc, jnp.log(result.weights)[None, :], shape=(1, n_samples)
+    )[0]
+    z = jax.random.normal(kx, (n_samples, d), jnp.float32)
+    means = result.means[comp]  # (n, d)
+    cov_type = result.covariance_type
+    if cov_type == "diag":
+        x = means + z * jnp.sqrt(result.variances)[comp]
+    elif cov_type == "spherical":
+        x = means + z * jnp.sqrt(result.variances)[comp][:, None]
+    elif cov_type == "tied":
+        chol = jnp.linalg.cholesky(result.variances)  # (d, d)
+        x = means + z @ chol.T
+    else:  # full: per-component Cholesky, gathered per sample
+        chols = jnp.linalg.cholesky(result.variances)  # (K, d, d)
+        x = means + jnp.einsum("nd,ned->ne", z, chols[comp])
+    return x, comp.astype(jnp.int32)
 
 
 class GMMStats(NamedTuple):
     """EM sufficient statistics — plain sums over points, so exact
-    out-of-core streaming works the same way as Lloyd's (Σx, counts)."""
+    out-of-core streaming works the same way as Lloyd's (Σx, counts).
+    `sxx` is the covariance type's second moment: Σ r·x² (K, d) for
+    diag/spherical, Σ r·xxᵀ (K, d, d) for full, the iteration-constant
+    Σ xxᵀ (d, d) for tied (zero rows add nothing to any of them)."""
 
     ll_sum: jax.Array  # () Σ log p(x)
     nk: jax.Array  # (K,) Σ responsibilities
     sx: jax.Array  # (K, d) Σ r·x
-    sxx: jax.Array  # (K, d) Σ r·x²
+    sxx: jax.Array  # second moment, shape per covariance type (see above)
 
 
-@partial(jax.jit, static_argnames=("kernel",))
+@partial(jax.jit, static_argnames=("kernel", "cov_type"))
 def _accumulate_gmm(acc, batch, means, variances, weights, n_valid,
-                    kernel: str = "xla"):
+                    kernel: str = "xla", cov_type: str = "diag"):
     """Add one (possibly zero-padded) batch's EM stats; subtract the
     padding's exact contribution (a zero row's responsibilities and
     log-likelihood depend only on the parameters — same correction pattern
     as the streamed fuzzy fit). Zero rows add exactly nothing to sx/sxx.
     kernel='pallas' computes the batch stats with the fused E-step kernel
-    (single-device streams only)."""
+    (single-device diag streams only)."""
     log_w = jnp.log(weights)
     if kernel == "pallas":
         ll_b, nk_b, sx_b, sxx_b = gmm_stats_auto(
             batch, means, variances, weights
         )
     else:
-        logp = _log_prob(batch, means, variances, log_w)
+        logp = _log_prob_t(batch, means, variances, log_w, cov_type)
         norm = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
         r = jnp.exp(logp - norm)
         xf = batch.astype(jnp.float32)
         ll_b = jnp.sum(norm)
         nk_b = jnp.sum(r, axis=0)
         sx_b = r.T @ xf
-        sxx_b = r.T @ xf**2
+        if cov_type in ("diag", "spherical"):
+            sxx_b = r.T @ xf**2  # (K, d)
+        elif cov_type == "full":
+            # K sequential (d, B)×(B, d) matmuls — no (B, K, d) tensor.
+            sxx_b = jax.lax.map(lambda rk: (xf * rk[:, None]).T @ xf, r.T)
+        else:  # tied: Σ xxᵀ, responsibility-free (Σ_k r = 1 per point)
+            sxx_b = xf.T @ xf  # (d, d)
     n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(
         jnp.float32
     )
-    zlogp = _log_prob(jnp.zeros((1, batch.shape[1]), batch.dtype), means,
-                      variances, log_w)
+    zlogp = _log_prob_t(jnp.zeros((1, batch.shape[1]), batch.dtype), means,
+                        variances, log_w, cov_type)
     znorm = jax.scipy.special.logsumexp(zlogp, axis=1)
     zr = jnp.exp(zlogp - znorm[:, None])[0]
     return GMMStats(
@@ -528,6 +607,7 @@ def streamed_gmm_fit(
     ckpt_dir: str | None = None,
     ckpt_every: int = 5,
     kernel: str = "xla",
+    covariance_type: str = "diag",
 ) -> GMMResult:
     """Exact streamed EM over a re-iterable stream of (B, d) batches — the
     same contract as streamed_kmeans_fit (one full pass per EM iteration,
@@ -538,10 +618,17 @@ def streamed_gmm_fit(
     moments) uses the FIRST batch only — document-sized seeding, matching
     how the streamed K-Means resolves named inits.
 
+    covariance_type: all four sklearn parameterizations stream exactly —
+    the second moments are plain sums over points (Σ r·x² for
+    diag/spherical, Σ r·xxᵀ (K, d, d) for full, the responsibility-free
+    Σ xxᵀ for tied). mesh streams stay diag-only (the non-diag E-steps use
+    Cholesky solves that do not shard over the data axis, like gmm_fit).
+
     ckpt_dir: per-iteration checkpoint/resume (means + variances + weights +
-    log-likelihood trajectory persisted; restore validates k/d/reg_covar).
-    Iteration-granular only — an interrupted pass is re-run, unlike the
-    streamed K-Means' mid-pass cursor.
+    log-likelihood trajectory persisted; restore validates
+    k/d/reg_covar/covariance_type). Iteration-granular only — an
+    interrupted pass is re-run, unlike the streamed K-Means' mid-pass
+    cursor.
     """
     from tdc_tpu.models.streaming import (
         _broadcast_init,
@@ -550,11 +637,25 @@ def streamed_gmm_fit(
         _run_pass,
     )
 
+    if covariance_type not in COVARIANCE_TYPES:
+        raise ValueError(
+            f"covariance_type must be one of {COVARIANCE_TYPES}, "
+            f"got {covariance_type!r}"
+        )
+    if mesh is not None and covariance_type != "diag":
+        raise ValueError(
+            "mesh-sharded streamed_gmm_fit supports covariance_type='diag' "
+            "only"
+        )
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
     if kernel == "pallas" and mesh is not None:
         raise ValueError(
             "streamed kernel='pallas' supports single-device streams only"
+        )
+    if kernel == "pallas" and covariance_type != "diag":
+        raise ValueError(
+            "streamed kernel='pallas' supports covariance_type='diag' only"
         )
     if kernel == "pallas":
         # Streamed batches stay f32 (itemsize 4) regardless of any in-memory
@@ -594,6 +695,13 @@ def streamed_gmm_fit(
                     f"reg_covar={saved.meta.get('reg')} — refusing to mix "
                     "state"
                 )
+            saved_ct = str(saved.meta.get("cov_type", "diag"))
+            if saved_ct != covariance_type:
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir} was written with "
+                    f"covariance_type={saved_ct!r}, requested "
+                    f"{covariance_type!r} — refusing to mix state"
+                )
             means = jnp.asarray(saved.centroids, jnp.float32)
             variances = jnp.asarray(saved.meta["variances"], jnp.float32)
             weights = jnp.asarray(saved.meta["weights"], jnp.float32)
@@ -630,6 +738,7 @@ def streamed_gmm_fit(
             raise ValueError(f"init means shape {means.shape} != {(k, d)}")
         variances, weights = _moments_from_hard_assign(first, means,
                                                        reg_covar)
+        variances = _diag_to_cov(variances, weights, covariance_type)
         # First-batch-derived params differ per host in a multi-process
         # run — broadcast process 0's so the gang starts EM from identical
         # state (replicate()'s SPMD contract).
@@ -655,6 +764,7 @@ def streamed_gmm_fit(
                 batch_cursor=0,
                 meta={
                     "model": "gmm", "k": k, "d": d, "reg": float(reg_covar),
+                    "cov_type": covariance_type,
                     "variances": np.asarray(variances),
                     "weights": np.asarray(weights),
                     "ll": float(ll), "converged": bool(done),
@@ -667,11 +777,15 @@ def streamed_gmm_fit(
         )
 
     def zero_stats():
+        sxx_shape = {
+            "diag": (k, d), "spherical": (k, d),
+            "tied": (d, d), "full": (k, d, d),
+        }[covariance_type]
         z = GMMStats(
             ll_sum=jnp.zeros((), jnp.float32),
             nk=jnp.zeros((k,), jnp.float32),
             sx=jnp.zeros((k, d), jnp.float32),
-            sxx=jnp.zeros((k, d), jnp.float32),
+            sxx=jnp.zeros(sxx_shape, jnp.float32),
         )
         if mesh is not None:
             z = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), z)
@@ -687,7 +801,8 @@ def streamed_gmm_fit(
             rows_total[0] += n_valid
             return (
                 _accumulate_gmm(acc, xb, means, variances, weights,
-                                jnp.asarray(n_valid), kernel),
+                                jnp.asarray(n_valid), kernel,
+                                covariance_type),
                 n_local,
             )
 
@@ -706,8 +821,9 @@ def streamed_gmm_fit(
     for n_iter in iters:
         acc, n_rows = full_pass(means, variances, weights)
         ll = float(acc.ll_sum) / max(n_rows, 1)
-        means, variances, weights = _m_step(acc.nk, acc.sx, acc.sxx,
-                                            n_rows, reg_covar)
+        means, variances, weights = _m_step_t(acc.nk, acc.sx, acc.sxx,
+                                              n_rows, reg_covar,
+                                              covariance_type)
         done = n_iter > 1 and ll - prev_ll <= tol
         if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
                                      or n_iter == max_iters):
@@ -738,6 +854,7 @@ def streamed_gmm_fit(
         log_likelihood=jnp.asarray(final_ll, jnp.float32),
         converged=jnp.asarray(converged),
         n_iter_run=n_iter - start_iter,
+        covariance_type=covariance_type,
     )
 
 

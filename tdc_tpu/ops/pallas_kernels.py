@@ -337,14 +337,21 @@ def lloyd_stats_fused(
 
 
 def _fused_fuzzy_kernel(
-    x_ref, c_ref, c2_ref, x2_ref, wsums_ref, weights_ref, obj_ref,
-    acc_wsums, acc_weights, acc_obj, *, m: float, eps: float,
+    x_ref, c_ref, c2_ref, wsums_ref, weights_ref, obj_ref,
+    acc_wsums, acc_weights, acc_obj, *, m: float, eps: float, halves: int,
 ):
     """Grid over N-blocks; K fully VMEM-resident. Per block: distances →
     memberships u = (d²+eps)^(-1/(m-1)) normalized → MU = u^m → MXU-weighted
     sums into VMEM scratch; outputs written once at the last block. The (N, K)
     membership matrix never exists anywhere (the reference materialized it
-    per tower, scripts/distribuitedClustering.py:117-137)."""
+    per tower, scripts/distribuitedClustering.py:117-137).
+
+    Per-row ‖x‖² (memberships need true distance magnitudes — the argmin
+    shift trick does not apply here) is computed from the VMEM-resident x
+    tile: a d-wide pass instead of an (N, 1) custom-call operand, whose HBM
+    reduce + relayout copy cost 22% per iteration on the Lloyd kernel
+    (benchmarks/ROOFLINE.md). `halves` interleaves sub-blocks exactly like
+    _fused_lloyd_kernel."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -353,26 +360,33 @@ def _fused_fuzzy_kernel(
         acc_weights[...] = jnp.zeros_like(acc_weights)
         acc_obj[...] = jnp.zeros_like(acc_obj)
 
-    cross = jax.lax.dot_general(
-        x_ref[...],
-        c_ref[...],
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (BN, K)
-    # True squared distances (the argmin shift trick doesn't apply here:
-    # memberships need actual magnitudes), clamped at 0 like pairwise_sq_dist.
-    d2 = jnp.maximum(x2_ref[...] + c2_ref[...] - 2.0 * cross, 0.0)
-    inv = (d2 + eps) ** (-1.0 / (m - 1.0))  # padded-centroid rows → ~0
-    u = inv / jnp.sum(inv, axis=1, keepdims=True)
-    mu = u**m  # (BN, K)
-    acc_wsums[...] += jax.lax.dot_general(
-        mu,
-        x_ref[...].astype(jnp.float32),
-        (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_weights[...] += jnp.sum(mu, axis=0, keepdims=True)
-    acc_obj[...] += jnp.sum(mu * d2)
+    sub = x_ref.shape[0] // halves
+    xs = [x_ref[h * sub:(h + 1) * sub, :] for h in range(halves)]
+    crosses = [
+        jax.lax.dot_general(
+            xh,
+            c_ref[...],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BN/halves, K)
+        for xh in xs
+    ]
+    for xh, cross in zip(xs, crosses):
+        xf = xh.astype(jnp.float32)
+        x2 = jnp.sum(xf * xf, axis=1, keepdims=True)  # (sub, 1)
+        # True squared distances, clamped at 0 like pairwise_sq_dist.
+        d2 = jnp.maximum(x2 + c2_ref[...] - 2.0 * cross, 0.0)
+        inv = (d2 + eps) ** (-1.0 / (m - 1.0))  # padded-centroid rows → ~0
+        u = inv / jnp.sum(inv, axis=1, keepdims=True)
+        mu = u**m  # (sub, K)
+        acc_wsums[...] += jax.lax.dot_general(
+            mu,
+            xf,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_weights[...] += jnp.sum(mu, axis=0, keepdims=True)
+        acc_obj[...] += jnp.sum(mu * d2)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -381,7 +395,9 @@ def _fused_fuzzy_kernel(
         obj_ref[...] = acc_obj[...]
 
 
-@functools.partial(jax.jit, static_argnames=("m", "eps", "block_n", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("m", "eps", "block_n", "halves", "interpret")
+)
 def fuzzy_stats_fused(
     x: jax.Array,
     centroids: jax.Array,
@@ -391,11 +407,14 @@ def fuzzy_stats_fused(
     block_n: int | None = None,  # None = fused_block_n(..., temps=3): the
     #                              d2/u/u^m chain keeps ~3 (BN, K) f32 temps
     #                              live, so K=1024 caps block_n at ~1024
+    halves: int | None = None,
     interpret: bool | None = None,
 ):
     """Fully-fused fuzzy c-means sufficient stats: one kernel, one pass over
     x, no (N, K) membership matrix anywhere. Same VMEM regime as
     lloyd_stats_fused (K·d accumulator must fit); matches ops.assign.fuzzy_stats.
+    halves=None auto-enables the MXU/VPU-overlap sub-block split at
+    128-divisible sub-blocks (identical math; see _fused_lloyd_kernel).
 
     Reference counterpart: the fuzzy tower at
     scripts/distribuitedClustering.py:117-148 — its fastest algorithm (326 M
@@ -414,23 +433,29 @@ def fuzzy_stats_fused(
                 f"fuzzy_stats_fused: K={k}, d={d} does not fit VMEM; use "
                 "fuzzy_stats_auto / ops.assign.fuzzy_stats_padded_blocked"
             )
+    if halves is None:
+        halves = 4 if block_n % 512 == 0 else (2 if block_n % 256 == 0 else 1)
+    elif block_n % halves:
+        raise ValueError(
+            f"fuzzy_stats_fused: halves={halves} must divide "
+            f"block_n={block_n} (a remainder would silently drop rows)"
+        )
     xp = _pad_axis(_pad_axis(x, 1, 128, 0), 0, block_n, 0)
     cp = _pad_axis(
         _pad_axis(centroids.astype(x.dtype), 1, 128, 0), 0, 128, _PAD_CENTROID
     )
     c2 = jnp.sum(cp.astype(jnp.float32) ** 2, axis=1)[None, :]  # (1, K_pad)
-    x2 = jnp.sum(xp.astype(jnp.float32) ** 2, axis=1, keepdims=True)  # (N_pad, 1)
     n_pad, k_pad = xp.shape[0], cp.shape[0]
     d_pad = xp.shape[1]
 
     wsums, weights, obj = pl.pallas_call(
-        functools.partial(_fused_fuzzy_kernel, m=float(m), eps=float(eps)),
+        functools.partial(_fused_fuzzy_kernel, m=float(m), eps=float(eps),
+                          halves=halves),
         grid=(n_pad // block_n,),
         in_specs=[
             pl.BlockSpec((block_n, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_n, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -448,7 +473,7 @@ def fuzzy_stats_fused(
             pltpu.VMEM((1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(xp, cp, c2, x2)
+    )(xp, cp, c2)
     # Padded zero x-rows contribute ‖c‖²-softmin memberships (zero Σ u^m x but
     # nonzero weights/objective) — subtract their exact contribution, same as
     # the streaming path's zero-row correction (models/streaming.py).
@@ -504,7 +529,13 @@ def fuzzy_stats_auto(x: jax.Array, centroids: jax.Array, m: float = 2.0, **kw):
 def _fuzzy_norm_kernel(x_ref, c_ref, c2_ref, x2_ref, s_ref, *, m, eps):
     """Pass 1 of the two-pass fuzzy kernel: the per-point membership
     normalizer Σ_k (d²+eps)^(-1/(m-1)), accumulated online over K-tiles —
-    the same streaming trick as the online argmin, applied to a sum."""
+    the same streaming trick as the online argmin, applied to a sum.
+
+    Unlike the fused kernels, ‖x‖² stays an (N, 1) OPERAND here: computing
+    it in-kernel materializes an f32 (BN, d_pad) tile that blew the VMEM
+    budget by 2.6 MB at K=16,384·d=768 (measured — this kernel's whole
+    regime is VMEM-starved), while the operand's relayout cost is amortized
+    over the K-tile grid axis."""
     j = pl.program_id(1)
     cross = jax.lax.dot_general(
         x_ref[...],
@@ -532,7 +563,8 @@ def _fuzzy_accum_kernel(
     N-block) pair and folded into K-tile accumulators — the (N, K)
     membership matrix never exists. Grid is (K-tiles outer, N-blocks inner)
     so each K-tile's accumulator completes before moving on; the objective
-    accumulates across the whole grid."""
+    accumulates across the whole grid. ‖x‖² stays an operand here — see
+    _fuzzy_norm_kernel."""
     j, i = pl.program_id(0), pl.program_id(1)
     nj, ni = pl.num_programs(0), pl.num_programs(1)
 
@@ -575,14 +607,20 @@ def _fuzzy_accum_kernel(
 
 
 def twopass_blocks(
-    k: int, d: int, itemsize: int = 2, *, budget: int = 14 << 20
+    k: int, d: int, itemsize: int = 2, *, budget: int = 11 << 20
 ) -> tuple[int, int]:
     """(block_n, block_k) for the two-pass fuzzy kernel, or (0, 0) when even
     the smallest tiling exceeds VMEM (astronomically large d only).
 
     Resident: f32 accumulator + output (BK, d_pad) pair, the centroid tile
     (BK, d_pad), per-K vectors. Per x-row: the x tile, x², s, and ~3 live
-    (BN, BK) f32 temporaries (d2 / inv / u-chain)."""
+    (BN, BK) f32 temporaries (d2 / inv / u-chain).
+
+    The budget is deliberately ~69% of the 16 MB scope: the 14 MB model's
+    pick at K=16,384·d=768 (block 1280×512) measured 16.55 MB of scoped
+    VMEM on v5e and failed Mosaic compile by 559 KB — the same ~11-15%
+    systematic underestimate seen on the tall kernel (ops/tall.py). 11 MB
+    keeps ≥25% headroom over the worst observed model error."""
     d_pad = -(-d // 128) * 128
     for block_k in (512, 256, 128):
         fixed = block_k * d_pad * (8 + itemsize) + 16 * block_k
@@ -769,12 +807,17 @@ def _fused_gmm_kernel(
 
 
 def gmm_block_n(
-    k: int, d: int, itemsize: int = 4, *, budget: int = 14 << 20,
+    k: int, d: int, itemsize: int = 4, *, budget: int = 11 << 20,
     cap: int = 2048,
 ) -> int:
     """Largest N-block for the fused GMM E-step kernel, or 0 when the
     resident (K, d) tiles (inv + μ/σ² inputs, sx + sxx accumulators and
-    outputs) exceed VMEM — route to the XLA E-step there."""
+    outputs) exceed VMEM — route to the XLA E-step there.
+
+    Budget derated 14 → 11 MB alongside twopass_blocks/tall_block_n: both
+    sibling models measured ~11-15% optimistic against Mosaic's scoped-vmem
+    check on v5e, and the CLI/gmm_fit feasibility gates treat this model's
+    accept answer as a promise that the fused kernel will really compile."""
     k_pad = -(-k // 128) * 128
     d_pad = -(-d // 128) * 128
     fixed = k_pad * d_pad * 4 * 6 + 48 * k_pad

@@ -459,6 +459,22 @@ def test_cli_gaussian_mixture_streamed(tmp_path):
     assert int(rows[0]["num_batches"]) == 4
 
 
+def test_cli_gaussian_mixture_streamed_full_covariance(tmp_path):
+    """The streamed path accepts every covariance type from the CLI (the
+    round-3 integration gap: validate_args allowed it but a stale runtime
+    guard in fit() rejected it)."""
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--method_name=gaussianMixture --n_obs=2000 --n_dim=4 --K=3 "
+        f"--n_max_iters=20 --num_batches=4 --seed=0 --n_GPUs=1 "
+        f"--covariance_type=full --log_file={log}".split()
+    )
+    assert rc == 0
+    rows = list(csv.DictReader(open(log)))
+    assert rows[0]["status"] == "ok"
+    assert int(rows[0]["num_batches"]) == 4
+
+
 def test_cli_gaussian_mixture_streamed_ckpt(tmp_path):
     log = str(tmp_path / "log.csv")
     rc = cli_main(
@@ -487,18 +503,21 @@ def test_validate_rejects_gmm_pallas_vmem_infeasible(tmp_path, capsys):
     assert "VMEM" in capsys.readouterr().err
 
 
-def test_validate_rejects_gmm_pallas_implicit_multidevice(tmp_path):
-    """Without --n_GPUs the run would use every local device (8 on the test
-    mesh) — the single-device rule must catch the resolved count, not just
-    an explicit flag."""
-    p = build_parser()
-    args = p.parse_args(
+def test_gmm_pallas_implicit_multidevice_rejected_at_runtime(tmp_path):
+    """Without --n_GPUs the run uses every local device (8 on the test
+    mesh). validate_args must NOT resolve that default (it would initialize
+    the backend before --backend applies), so the rejection happens in
+    run_experiment and lands as a CSV error row + exit 1."""
+    from tdc_tpu.cli.main import main as cli_main
+
+    log = tmp_path / "log.csv"
+    rc = cli_main(
         f"--K=4 --n_obs=1000 --n_dim=8 --seed=0 "
         f"--method_name=gaussianMixture --kernel=pallas "
-        f"--log_file={tmp_path}/log.csv".split()
+        f"--log_file={log}".split()
     )
-    with pytest.raises(SystemExit):
-        validate_args(p, args)
+    assert rc != 0
+    assert "ValueError" in log.read_text()
 
 
 def test_gmm_fit_rejects_pallas_vmem_infeasible(rng):

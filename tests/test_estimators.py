@@ -85,3 +85,55 @@ def test_gaussian_mixture_unfitted_raises():
 
     with pytest.raises(AttributeError, match="not fitted"):
         GaussianMixture(n_components=2).predict(np.zeros((4, 2), np.float32))
+
+
+def test_kmeans_score_matches_sklearn_semantics(blobs_small):
+    from sklearn.cluster import KMeans as SKKMeans
+
+    from tdc_tpu.models import KMeans
+
+    x, _, _ = blobs_small
+    km = KMeans(n_clusters=3, random_state=0).fit(x)
+    # score = negative inertia on the same data, to fit tolerance
+    assert km.score(x) < 0
+    np.testing.assert_allclose(-km.score(x), km.inertia_, rtol=1e-3)
+    sk = SKKMeans(n_clusters=3, n_init=3, random_state=0).fit(x)
+    np.testing.assert_allclose(km.score(x), sk.score(x), rtol=0.05)
+
+
+def test_gmm_bic_aic_score_samples_vs_sklearn(blobs_small):
+    from sklearn.mixture import GaussianMixture as SKGMM
+
+    from tdc_tpu.models import GaussianMixture
+
+    x, _, _ = blobs_small
+    gm = GaussianMixture(n_components=3, covariance_type="diag",
+                         random_state=0, max_iter=200).fit(x)
+    sk = SKGMM(n_components=3, covariance_type="diag", random_state=0,
+               max_iter=200).fit(x)
+    # Same converged optimum on well-separated blobs -> same criteria.
+    np.testing.assert_allclose(gm.bic(x), sk.bic(x), rtol=0.02)
+    np.testing.assert_allclose(gm.aic(x), sk.aic(x), rtol=0.02)
+    ss = gm.score_samples(x)
+    assert ss.shape == (x.shape[0],)
+    np.testing.assert_allclose(ss.mean(), gm.score(x), rtol=1e-5)
+
+
+def test_gmm_sample_all_covariance_types(blobs_small):
+    from tdc_tpu.models import GaussianMixture
+
+    x, _, centers = blobs_small
+    for cov in ("diag", "spherical", "tied", "full"):
+        gm = GaussianMixture(n_components=3, covariance_type=cov,
+                             random_state=0, max_iter=100).fit(x)
+        xs, labels = gm.sample(2000)
+        assert xs.shape == (2000, x.shape[1]) and labels.shape == (2000,)
+        assert np.isfinite(xs).all()
+        # Samples cluster near the fitted means: every component's sampled
+        # points average close to its mean.
+        for c in range(3):
+            if (labels == c).sum() > 50:
+                err = np.linalg.norm(
+                    xs[labels == c].mean(axis=0) - gm.means_[c]
+                )
+                assert err < 1.0

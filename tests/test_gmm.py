@@ -404,3 +404,80 @@ def test_gmm_pallas_kernel_validations(aniso_blobs):
         gmm_fit(x, 3, kernel="pallas", covariance_type="full")
     with pytest.raises(ValueError, match="pallas"):
         gmm_fit(x, 3, kernel="pallas", sample_weight=np.ones(len(x)))
+
+
+class TestStreamedGMMCovarianceTypes:
+    @pytest.mark.parametrize("cov", ["spherical", "tied", "full"])
+    def test_streamed_matches_in_memory(self, aniso_blobs, cov):
+        """All four sklearn covariance types stream exactly (diag is covered
+        by TestStreamedGMM); the sufficient statistics are plain sums, so
+        streamed EM must land on the in-memory optimum."""
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+
+        def batches():
+            for i in range(0, len(x), 250):
+                yield x[i:i + 250]
+
+        mem = gmm_fit(x, 3, init=centers, max_iters=60, tol=1e-5,
+                      covariance_type=cov)
+        st = streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=60,
+                              tol=1e-5, covariance_type=cov)
+        assert st.covariance_type == cov
+        assert np.asarray(st.variances).shape == \
+            np.asarray(mem.variances).shape
+        np.testing.assert_allclose(np.asarray(st.means),
+                                   np.asarray(mem.means),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(st.variances),
+                                   np.asarray(mem.variances),
+                                   rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(float(st.log_likelihood),
+                                   float(mem.log_likelihood), rtol=1e-4)
+
+    def test_streamed_batch_count_invariance_tied(self, aniso_blobs):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+
+        def batches(size):
+            def gen():
+                for i in range(0, len(x), size):
+                    yield x[i:i + size]
+            return gen
+
+        a = streamed_gmm_fit(batches(100), 3, 2, init=centers, max_iters=10,
+                             tol=-1.0, covariance_type="tied")
+        b = streamed_gmm_fit(batches(333), 3, 2, init=centers, max_iters=10,
+                             tol=-1.0, covariance_type="tied")
+        np.testing.assert_allclose(np.asarray(a.means), np.asarray(b.means),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a.variances),
+                                   np.asarray(b.variances),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mesh_non_diag_rejected(self, aniso_blobs):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+        with pytest.raises(ValueError, match="diag"):
+            streamed_gmm_fit(lambda: iter([x]), 3, 2, init=centers,
+                             covariance_type="full", mesh=make_mesh(8))
+
+    def test_ckpt_covariance_type_mismatch_rejected(self, aniso_blobs,
+                                                    tmp_path):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+
+        def batches():
+            yield x
+
+        streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=3, tol=-1.0,
+                         covariance_type="spherical",
+                         ckpt_dir=str(tmp_path / "ck"))
+        with pytest.raises(ValueError, match="covariance_type"):
+            streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=3,
+                             tol=-1.0, covariance_type="full",
+                             ckpt_dir=str(tmp_path / "ck"))

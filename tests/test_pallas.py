@@ -321,3 +321,38 @@ def test_fused_lloyd_halves_matches_sequential(rng):
                                rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(a.counts), np.asarray(b.counts))
     np.testing.assert_allclose(float(a.sse), float(b.sse), rtol=1e-6)
+
+
+def test_twopass_blocks_calibration_regression():
+    """v5e calibration: at K=16,384, d=768 (bf16) the 14MB-budget model
+    picked (1280, 512), which measured 16.55MB of scoped VMEM and failed
+    Mosaic compile; 11MB picks (896, 512), which compiles and runs. The
+    model must stay at or below the known-good pick."""
+    from tdc_tpu.ops.pallas_kernels import twopass_blocks
+
+    bn, bk = twopass_blocks(16384, 768, 2)
+    assert 0 < bn <= 896 and bk == 512
+
+
+def test_fused_fuzzy_halves_matches_sequential(rng):
+    import pytest
+
+    from tdc_tpu.ops.assign import fuzzy_stats
+    from tdc_tpu.ops.pallas_kernels import fuzzy_stats_fused
+
+    x = rng.normal(size=(512, 8)).astype(np.float32)
+    c = rng.normal(size=(5, 8)).astype(np.float32)
+    a = fuzzy_stats_fused(jnp.asarray(x), jnp.asarray(c), block_n=128,
+                          halves=1)
+    b = fuzzy_stats_fused(jnp.asarray(x), jnp.asarray(c), block_n=128,
+                          halves=4)
+    want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c))
+    for got in (a, b):
+        np.testing.assert_allclose(np.asarray(got.weighted_sums),
+                                   np.asarray(want.weighted_sums),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(got.weights),
+                                   np.asarray(want.weights), rtol=1e-4)
+    with pytest.raises(ValueError, match="halves"):
+        fuzzy_stats_fused(jnp.asarray(x), jnp.asarray(c), block_n=128,
+                          halves=3)
