@@ -158,7 +158,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weight_file", type=str, default=None,
                    help=".npy of (N,) nonnegative per-point sample weights "
                         "(sklearn sample_weight parity; in-memory and "
-                        "streamed kmeans/fuzzy fits)")
+                        "streamed kmeans/fuzzy/gaussianMixture fits)")
     p.add_argument("--metrics", action="store_true",
                    help="after the fit, score the clustering (silhouette / "
                         "Davies-Bouldin / Calinski-Harabasz; the reference "
@@ -195,10 +195,6 @@ def validate_args(parser, args):
 
         if args.shard_k > 1:
             parser.error("gaussianMixture has no sharded-K mode")
-        if args.weight_file and (args.streamed or args.num_batches > 1):
-            parser.error("gaussianMixture supports --weight_file for "
-                         "in-memory fits only (the streamed GMM has no "
-                         "weighted accumulator)")
         if args.ckpt_every_batches:
             parser.error("gaussianMixture checkpoints per iteration only "
                          "(--ckpt_every_batches is kmeans/fuzzy)")
@@ -521,15 +517,6 @@ def run_experiment(args) -> dict:
             )
         if args.method_name == "gaussianMixture":
             if streamed:
-                if weights is not None:
-                    # Reachable only via the OOM fallback (validate_args
-                    # rejects the explicit flag combination): the streamed
-                    # GMM must not silently drop the weights.
-                    raise ValueError(
-                        "gaussianMixture fell back to streaming but "
-                        "--weight_file supports in-memory fits only; "
-                        "shrink the dataset or drop the flag"
-                    )
                 from tdc_tpu.models.gmm import streamed_gmm_fit
 
                 rows = -(-n_obs // num_batches)
@@ -540,6 +527,9 @@ def run_experiment(args) -> dict:
                     ckpt_dir=args.ckpt_dir,
                     kernel=args.kernel or "xla",
                     covariance_type=args.covariance_type,
+                    sample_weight_batches=(
+                        weight_stream(rows) if weights is not None else None
+                    ),
                 )
             from tdc_tpu.models.gmm import gmm_fit
 

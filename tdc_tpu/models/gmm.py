@@ -592,6 +592,34 @@ def _accumulate_gmm(acc, batch, means, variances, weights, n_valid,
     )
 
 
+@partial(jax.jit, static_argnames=("cov_type",))
+def _accumulate_gmm_weighted(acc, batch, w, means, variances, weights,
+                             cov_type: str = "diag"):
+    """Weighted batch EM stats. No padding correction needed: pad rows
+    carry ZERO WEIGHT, so they contribute exactly nothing to
+    ll/nk/sx/sxx (same pattern as the streamed weighted K-Means)."""
+    log_w = jnp.log(weights)
+    logp = _log_prob_t(batch, means, variances, log_w, cov_type)
+    norm = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
+    r = jnp.exp(logp - norm) * w[:, None]
+    xf = batch.astype(jnp.float32)
+    ll_b = jnp.sum(w * norm[:, 0])
+    nk_b = jnp.sum(r, axis=0)
+    sx_b = r.T @ xf
+    if cov_type in ("diag", "spherical"):
+        sxx_b = r.T @ xf**2
+    elif cov_type == "full":
+        sxx_b = jax.lax.map(lambda rk: (xf * rk[:, None]).T @ xf, r.T)
+    else:  # tied: Σ w·xxᵀ (responsibility-free)
+        sxx_b = (xf * w[:, None]).T @ xf
+    return GMMStats(
+        ll_sum=acc.ll_sum + ll_b,
+        nk=acc.nk + nk_b,
+        sx=acc.sx + sx_b,
+        sxx=acc.sxx + sxx_b,
+    )
+
+
 def streamed_gmm_fit(
     batches,
     k: int,
@@ -608,6 +636,7 @@ def streamed_gmm_fit(
     ckpt_every: int = 5,
     kernel: str = "xla",
     covariance_type: str = "diag",
+    sample_weight_batches=None,
 ) -> GMMResult:
     """Exact streamed EM over a re-iterable stream of (B, d) batches — the
     same contract as streamed_kmeans_fit (one full pass per EM iteration,
@@ -624,6 +653,14 @@ def streamed_gmm_fit(
     Σ xxᵀ for tied). mesh streams stay diag-only (the non-diag E-steps use
     Cholesky solves that do not shard over the data axis, like gmm_fit).
 
+    sample_weight_batches: optional zero-arg callable returning a fresh
+    iterator of (B,) weight rows aligned batch-for-batch with `batches`
+    (same contract as streamed_kmeans_fit). Responsibilities scale by the
+    weights; pad rows carry zero weight, so padding is exact with no
+    correction, and the log-likelihood/M-step normalize by Σw. The
+    first-batch seeding moments stay unweighted (initialization heuristic
+    only; the EM itself is exactly weighted).
+
     ckpt_dir: per-iteration checkpoint/resume (means + variances + weights +
     log-likelihood trajectory persisted; restore validates
     k/d/reg_covar/covariance_type). Iteration-granular only — an
@@ -634,6 +671,7 @@ def streamed_gmm_fit(
         _broadcast_init,
         _check_equal_local_rows,
         _prepare_batch,
+        _prepare_weighted_batch,
         _run_pass,
     )
 
@@ -657,6 +695,18 @@ def streamed_gmm_fit(
         raise ValueError(
             "streamed kernel='pallas' supports covariance_type='diag' only"
         )
+    weighted = sample_weight_batches is not None
+    if kernel == "pallas" and weighted:
+        raise ValueError(
+            "streamed kernel='pallas' supports unweighted streams only "
+            "(the fused E-step kernel has no weight input)"
+        )
+    stream = (
+        batches if not weighted
+        # strict: a weight stream that runs short would otherwise silently
+        # drop the remaining point batches from the fit.
+        else (lambda: zip(batches(), sample_weight_batches(), strict=True))
+    )
     if kernel == "pallas":
         # Streamed batches stay f32 (itemsize 4) regardless of any in-memory
         # bf16 preference; reject infeasible K·d rather than let
@@ -702,6 +752,13 @@ def streamed_gmm_fit(
                     f"covariance_type={saved_ct!r}, requested "
                     f"{covariance_type!r} — refusing to mix state"
                 )
+            saved_w = bool(np.asarray(saved.meta.get("weighted", False)))
+            if saved_w != (sample_weight_batches is not None):
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir} was written with "
+                    f"weighted={saved_w} — refusing to resume with a "
+                    "different weighting"
+                )
             means = jnp.asarray(saved.centroids, jnp.float32)
             variances = jnp.asarray(saved.meta["variances"], jnp.float32)
             weights = jnp.asarray(saved.meta["weights"], jnp.float32)
@@ -725,7 +782,10 @@ def streamed_gmm_fit(
 
     first = None
     if not restored:
-        first = jnp.asarray(next(iter(batches())))
+        first = next(iter(stream()))
+        if weighted:
+            first = first[0]  # seeding moments stay unweighted (docstring)
+        first = jnp.asarray(first)
         if isinstance(init, str) and init == "kmeans":
             means = kmeans_fit(
                 first, k, init="kmeans++", key=key, max_iters=10, tol=1e-3,
@@ -749,7 +809,7 @@ def streamed_gmm_fit(
             means = mesh_lib.replicate(means, mesh)
             variances = mesh_lib.replicate(variances, mesh)
             weights = mesh_lib.replicate(weights, mesh)
-    _check_equal_local_rows(batches, first, mesh)
+    _check_equal_local_rows(stream, first, mesh)
     gang = mesh is not None and len(
         {dev.process_index for dev in mesh.devices.ravel()}
     ) > 1
@@ -764,7 +824,7 @@ def streamed_gmm_fit(
                 batch_cursor=0,
                 meta={
                     "model": "gmm", "k": k, "d": d, "reg": float(reg_covar),
-                    "cov_type": covariance_type,
+                    "cov_type": covariance_type, "weighted": weighted,
                     "variances": np.asarray(variances),
                     "weights": np.asarray(weights),
                     "ll": float(ll), "converged": bool(done),
@@ -797,6 +857,16 @@ def streamed_gmm_fit(
         rows_total = [0]
 
         def step(acc, batch):
+            if weighted:
+                xb, wb, n_local = _prepare_weighted_batch(
+                    batch[0], batch[1], mesh
+                )
+                rows_total[0] += n_local
+                return (
+                    _accumulate_gmm_weighted(acc, xb, wb, means, variances,
+                                             weights, covariance_type),
+                    n_local,
+                )
             xb, n_valid, n_local = _prepare_batch(batch, mesh)
             rows_total[0] += n_valid
             return (
@@ -810,9 +880,18 @@ def streamed_gmm_fit(
         # (same protection as the streamed kmeans/fuzzy drivers).
         cm = None if crosschecked[0] else mesh
         crosschecked[0] = True
-        acc = _run_pass(batches, prefetch, zero_stats, step,
+        acc = _run_pass(stream, prefetch, zero_stats, step,
                         crosscheck_mesh=cm)
-        return acc, rows_total[0]
+        # Weighted normalizer: Σw == Σ_k nk exactly (Σ_k r = 1 per unit
+        # weight), so no separate weight-sum accumulator is needed. Floor
+        # only against division by zero — clamping to 1 would mis-scale
+        # fits whose total weight is legitimately below 1 (the in-memory
+        # weighted path divides by wsum exactly).
+        norm = (
+            max(float(jnp.sum(acc.nk)), 1e-12) if weighted
+            else max(rows_total[0], 1)
+        )
+        return acc, norm
 
     ll = prev_ll
     n_iter = start_iter
@@ -820,7 +899,7 @@ def streamed_gmm_fit(
     iters = () if resume_converged else range(start_iter + 1, max_iters + 1)
     for n_iter in iters:
         acc, n_rows = full_pass(means, variances, weights)
-        ll = float(acc.ll_sum) / max(n_rows, 1)
+        ll = float(acc.ll_sum) / n_rows  # full_pass floors the norm
         means, variances, weights = _m_step_t(acc.nk, acc.sx, acc.sxx,
                                               n_rows, reg_covar,
                                               covariance_type)
@@ -844,7 +923,7 @@ def streamed_gmm_fit(
     else:
         # Final log-likelihood of the returned parameters.
         acc, n_rows = full_pass(means, variances, weights)
-        final_ll = float(acc.ll_sum) / max(n_rows, 1)
+        final_ll = float(acc.ll_sum) / n_rows  # floored in full_pass
         if ckpt_dir is not None and (converged or n_iter >= max_iters):
             # Persist it so the next no-op resume can skip this pass.
             save(n_iter, ll, converged, final_ll=final_ll)

@@ -371,6 +371,24 @@ def test_cli_weight_file_streamed(tmp_path):
     assert rows[0]["status"] == "ok"
 
 
+def test_cli_weight_file_streamed_gmm(tmp_path):
+    """Streamed GMM accepts --weight_file (round-3: the weighted streamed
+    EM accumulator replaced the in-memory-only restriction)."""
+    import numpy as np
+
+    log = str(tmp_path / "log.csv")
+    wf = str(tmp_path / "w.npy")
+    np.save(wf, np.ones(2000, np.float32))
+    rc = cli_main(
+        f"--method_name=gaussianMixture --n_obs=2000 --n_dim=4 --K=3 "
+        f"--n_max_iters=15 --num_batches=4 --seed=0 --n_GPUs=1 "
+        f"--log_file={log} --weight_file={wf}".split()
+    )
+    assert rc == 0
+    rows = list(csv.DictReader(open(log)))
+    assert rows[0]["status"] == "ok"
+
+
 def test_cli_weight_file_rejects_minibatch(tmp_path):
     import numpy as np
     import pytest

@@ -481,3 +481,70 @@ class TestStreamedGMMCovarianceTypes:
             streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=3,
                              tol=-1.0, covariance_type="full",
                              ckpt_dir=str(tmp_path / "ck"))
+
+
+class TestStreamedWeightedGMM:
+    @pytest.mark.parametrize("cov", ["diag", "spherical", "tied", "full"])
+    def test_matches_in_memory_weighted(self, aniso_blobs, cov):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+        rng = np.random.default_rng(5)
+        w = rng.uniform(0.2, 3.0, len(x)).astype(np.float32)
+
+        def batches():
+            for i in range(0, len(x), 250):
+                yield x[i:i + 250]
+
+        def wbatches():
+            for i in range(0, len(x), 250):
+                yield w[i:i + 250]
+
+        mem = gmm_fit(x, 3, init=centers, max_iters=60, tol=1e-5,
+                      covariance_type=cov, sample_weight=w)
+        st = streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=60,
+                              tol=1e-5, covariance_type=cov,
+                              sample_weight_batches=wbatches)
+        np.testing.assert_allclose(np.asarray(st.means),
+                                   np.asarray(mem.means),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(st.variances),
+                                   np.asarray(mem.variances),
+                                   rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(np.asarray(st.weights),
+                                   np.asarray(mem.weights),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(float(st.log_likelihood),
+                                   float(mem.log_likelihood), rtol=1e-4)
+
+    def test_short_weight_stream_raises(self, aniso_blobs):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+        with pytest.raises(ValueError):
+            streamed_gmm_fit(
+                lambda: iter([x[:500], x[500:]]), 3, 2, init=centers,
+                max_iters=3, tol=-1.0,
+                sample_weight_batches=lambda: iter(
+                    [np.ones(500, np.float32)]  # one batch short
+                ),
+            )
+
+    def test_ckpt_weighted_mismatch_rejected(self, aniso_blobs, tmp_path):
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+
+        x, _, centers = aniso_blobs
+
+        def batches():
+            yield x
+
+        streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=3, tol=-1.0,
+                         ckpt_dir=str(tmp_path / "ck"))
+        with pytest.raises(ValueError, match="weighted"):
+            streamed_gmm_fit(
+                batches, 3, 2, init=centers, max_iters=3, tol=-1.0,
+                ckpt_dir=str(tmp_path / "ck"),
+                sample_weight_batches=lambda: iter(
+                    [np.ones(len(x), np.float32)]
+                ),
+            )
