@@ -31,12 +31,18 @@ from tdc_tpu.parallel.sharded_k import make_mesh_2d, make_sharded_lloyd_step
 BASE_RATE = 22.2e6 * (3 * 5)  # reference best per-GPU rate x (K*d) it ran at
 
 
-def measure(step, x, c, iters_short=3, iters_long=13, repeats=3):
-    """Per-iteration seconds from the slope of two chained runs (constant
-    dispatch/fetch overhead cancels; see bench.py timing notes). Median of
-    several slopes with a wide iteration spread — short spreads are swamped
-    by the variance of the tunnel's constant overhead and can report
-    physically impossible rates (> chip peak FLOP/s)."""
+def measure(step, x, c, iters_short=13, iters_long=43, repeats=3):
+    """Per-iteration seconds from the slope between per-length MIN times
+    (constant dispatch/fetch overhead cancels; see bench.py timing notes).
+    Tunnel hiccups only ever ADD time, so min-per-length is the robust
+    estimator; pairing chains into per-repeat slopes instead keeps exactly
+    the pairs whose short chain was inflated and can report physically
+    impossible rates (> chip peak FLOP/s — observed in round 2). BOTH
+    chains must sit past the host-dispatch pipelining knee (~10 dispatches
+    on the tunnel): chain(iters) is sublinear below it, so a short-chain
+    baseline curves the slope and under- or over-reports by 2-3×
+    (measured round 3: 1/3/9/17/33-iter chains gave asymptotic slope only
+    from 17→33)."""
 
     def chain(iters):
         ci = c
@@ -46,11 +52,9 @@ def measure(step, x, c, iters_short=3, iters_long=13, repeats=3):
         np.asarray(ci)  # true sync: D2H fetch
         return time.perf_counter() - t0
 
-    slopes = sorted(
-        (chain(iters_long) - chain(iters_short)) / (iters_long - iters_short)
-        for _ in range(repeats)
-    )
-    return max(slopes[len(slopes) // 2], 1e-9)
+    t_short = min(chain(iters_short) for _ in range(repeats))
+    t_long = min(chain(iters_long) for _ in range(repeats))
+    return max((t_long - t_short) / (iters_long - iters_short), 1e-9)
 
 
 def run(tag, mesh, n, k, d, kernel, block_rows):

@@ -18,8 +18,9 @@ covariances, and everything maps onto the same hardware story:
 - M-step: responsibilities Rᵀ@x and Rᵀ@x² — more MXU matmuls; the tied
   second moment Σ wᵢxxᵀ is iteration-constant and computed once.
 - The whole EM loop is one jit'd lax.while_loop on the log-likelihood gain;
-  with `mesh` (diag), points shard over the data axis and XLA all-reduces
-  the R-contractions (identical mechanism to models/kmeans.py).
+  with `mesh` (diag/spherical — the matmul-form E-steps), points shard over
+  the data axis and XLA all-reduces the R-contractions (identical mechanism
+  to models/kmeans.py).
 
 Matches sklearn.mixture.GaussianMixture(covariance_type=...) for all four
 types on oracle tests (tests/test_gmm.py); sample_weight matches the
@@ -315,8 +316,8 @@ def gmm_fit(
       reg_covar: variance floor added every M-step (sklearn parity).
       covariance_type: 'diag' | 'spherical' | 'tied' | 'full'
         (sklearn.mixture parity; result.variances takes the matching shape).
-        mesh is diag-only: the non-diag E-steps use Cholesky solves that do
-        not shard over the data axis.
+        mesh supports diag and spherical (matmul-form E-steps); tied/full
+        use Cholesky solves that do not shard over the data axis.
       sample_weight: optional (N,) nonnegative per-point weights — scales
         each point's responsibilities (equivalent to repeating rows; an API
         sklearn.mixture itself lacks).
@@ -332,9 +333,11 @@ def gmm_fit(
             f"covariance_type must be one of {COVARIANCE_TYPES}, "
             f"got {covariance_type!r}"
         )
-    if mesh is not None and covariance_type != "diag":
+    if mesh is not None and covariance_type not in ("diag", "spherical"):
         raise ValueError(
-            "mesh-sharded gmm_fit supports covariance_type='diag' only"
+            "mesh-sharded gmm_fit supports covariance_type 'diag' or "
+            "'spherical' only (tied/full E-steps use Cholesky solves that "
+            "do not shard over the data axis)"
         )
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
@@ -650,8 +653,9 @@ def streamed_gmm_fit(
     covariance_type: all four sklearn parameterizations stream exactly —
     the second moments are plain sums over points (Σ r·x² for
     diag/spherical, Σ r·xxᵀ (K, d, d) for full, the responsibility-free
-    Σ xxᵀ for tied). mesh streams stay diag-only (the non-diag E-steps use
-    Cholesky solves that do not shard over the data axis, like gmm_fit).
+    Σ xxᵀ for tied). mesh streams support diag and spherical (matmul-form
+    E-steps); tied/full use Cholesky solves that do not shard over the data
+    axis, like gmm_fit.
 
     sample_weight_batches: optional zero-arg callable returning a fresh
     iterator of (B,) weight rows aligned batch-for-batch with `batches`
@@ -673,6 +677,7 @@ def streamed_gmm_fit(
         _prepare_batch,
         _prepare_weighted_batch,
         _run_pass,
+        _weighted_stream,
     )
 
     if covariance_type not in COVARIANCE_TYPES:
@@ -680,10 +685,11 @@ def streamed_gmm_fit(
             f"covariance_type must be one of {COVARIANCE_TYPES}, "
             f"got {covariance_type!r}"
         )
-    if mesh is not None and covariance_type != "diag":
+    if mesh is not None and covariance_type not in ("diag", "spherical"):
         raise ValueError(
-            "mesh-sharded streamed_gmm_fit supports covariance_type='diag' "
-            "only"
+            "mesh-sharded streamed_gmm_fit supports covariance_type 'diag' "
+            "or 'spherical' only (tied/full E-steps use Cholesky solves "
+            "that do not shard over the data axis)"
         )
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
@@ -701,12 +707,7 @@ def streamed_gmm_fit(
             "streamed kernel='pallas' supports unweighted streams only "
             "(the fused E-step kernel has no weight input)"
         )
-    stream = (
-        batches if not weighted
-        # strict: a weight stream that runs short would otherwise silently
-        # drop the remaining point batches from the fit.
-        else (lambda: zip(batches(), sample_weight_batches(), strict=True))
-    )
+    stream = _weighted_stream(batches, sample_weight_batches)
     if kernel == "pallas":
         # Streamed batches stay f32 (itemsize 4) regardless of any in-memory
         # bf16 preference; reject infeasible K·d rather than let

@@ -313,6 +313,16 @@ def _accumulate_fuzzy_weighted(acc, batch, w, centroids, m: float):
     )
 
 
+def _weighted_stream(batches, sample_weight_batches):
+    """Pair a point stream with an optional weight stream: the shared
+    strict-zip wrapper for every streamed driver (kmeans/fuzzy/gmm).
+    strict: a weight stream that runs short would otherwise silently drop
+    the remaining point batches from the fit."""
+    if sample_weight_batches is None:
+        return batches
+    return lambda: zip(batches(), sample_weight_batches(), strict=True)
+
+
 def _prepare_weighted_batch(batch, w, mesh):
     """(x_device, w_device, n_local): like _prepare_batch but for (x, w)
     pairs — both padded with ZEROS (zero weight ⇒ exact, no correction)."""
@@ -534,12 +544,7 @@ def streamed_kmeans_fit(
         carry zero weight so all padding is exact with no correction.
     """
     weighted = sample_weight_batches is not None
-    stream = (
-        batches if not weighted
-        # strict: a weight stream that runs short would otherwise silently
-        # drop the remaining point batches from the fit.
-        else (lambda: zip(batches(), sample_weight_batches(), strict=True))
-    )
+    stream = _weighted_stream(batches, sample_weight_batches)
     first = None
     if not hasattr(init, "shape"):
         fb = next(iter(stream()))
@@ -782,10 +787,7 @@ def streamed_fuzzy_fit(
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     weighted = sample_weight_batches is not None
-    stream = (
-        batches if not weighted
-        else (lambda: zip(batches(), sample_weight_batches(), strict=True))
-    )
+    stream = _weighted_stream(batches, sample_weight_batches)
     first = None
     if not hasattr(init, "shape"):
         fb = next(iter(stream()))

@@ -548,3 +548,27 @@ class TestStreamedWeightedGMM:
                     [np.ones(len(x), np.float32)]
                 ),
             )
+
+
+def test_mesh_spherical_matches_single_device(aniso_blobs):
+    """Spherical's E-step is pure matmuls (no Cholesky), so it shards over
+    the data axis like diag — mesh parity must hold."""
+    x, _, _ = aniso_blobs
+    x = x[:992]
+    means_init = x[:3]
+    single = gmm_fit(x, 3, init=means_init, max_iters=40, tol=-1.0,
+                     covariance_type="spherical")
+    sharded = gmm_fit(x, 3, init=means_init, max_iters=40, tol=-1.0,
+                      covariance_type="spherical", mesh=make_mesh(8))
+    np.testing.assert_allclose(np.asarray(single.means),
+                               np.asarray(sharded.means),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.variances),
+                               np.asarray(sharded.variances),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_mesh_tied_still_rejected(aniso_blobs):
+    x, _, _ = aniso_blobs
+    with pytest.raises(ValueError, match="spherical"):
+        gmm_fit(x[:992], 3, covariance_type="tied", mesh=make_mesh(8))
