@@ -195,3 +195,82 @@ def test_two_process_k_sharded_fit_matches_single(tmp_path):
     want = kmeans_fit(X, 8, init=X[:8], max_iters=12, tol=-1.0)
     np.testing.assert_allclose(c0, np.asarray(want.centroids),
                                rtol=1e-4, atol=1e-4)
+
+
+_GMM_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    port, pid, nproc, outdir = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tdc_tpu.parallel.multihost import (
+        global_mesh, host_shard_bounds, initialize_distributed,
+    )
+    initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
+
+    import numpy as np
+    from tdc_tpu.models.gmm import streamed_gmm_fit
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1600, 4)).astype(np.float32)  # identical on all procs
+    start, end = host_shard_bounds(1600)
+    local = X[start:end]
+
+    def batches():
+        for i in range(0, len(local), 200):
+            yield local[i:i + 200]
+
+    res = streamed_gmm_fit(batches, 3, 4, init=X[:3], max_iters=8, tol=-1.0,
+                           mesh=global_mesh())
+    np.save(os.path.join(outdir, f"means_{pid}.npy"), np.asarray(res.means))
+    print("WORKER_OK", pid, flush=True)
+    """
+)
+
+
+def test_two_process_streamed_gmm_matches_single(tmp_path):
+    """2-process streamed GMM EM over a global mesh (each host streams its
+    own slice) must match the single-process streamed fit — same init
+    (both seed from the identical first batch, X[:200]) and exact
+    accumulation, so only f32 reduction order differs."""
+    from tdc_tpu.models.gmm import streamed_gmm_fit
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = tmp_path / "worker.py"
+    worker.write_text(_GMM_WORKER)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(i), "2", str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {i}" in out
+    m0 = np.load(tmp_path / "means_0.npy")
+    m1 = np.load(tmp_path / "means_1.npy")
+    np.testing.assert_array_equal(m0, m1)  # replicated params agree bitwise
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1600, 4)).astype(np.float32)
+
+    def batches():
+        for i in range(0, len(X), 200):
+            yield X[i:i + 200]
+
+    want = streamed_gmm_fit(batches, 3, 4, init=X[:3], max_iters=8,
+                            tol=-1.0)
+    np.testing.assert_allclose(m0, np.asarray(want.means), rtol=1e-3,
+                               atol=1e-3)
