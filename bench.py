@@ -92,21 +92,44 @@ def main():
     # time, so the min of each chain length is the robust estimator; a
     # min-over-paired-slopes instead keeps exactly the pairs whose t_short
     # was inflated by a hiccup (observed as negative slopes on the tunnel).
-    t_short = min(chain(x, c, ITERS_SHORT) for _ in range(3))
-    t_long = min(chain(x, c, ITERS_LONG) for _ in range(3))
-    per_iter = max((t_long - t_short) / (ITERS_LONG - ITERS_SHORT), 1e-9)
-
-    value = n / per_iter
-    print(
-        json.dumps(
-            {
-                "metric": f"lloyd_points_per_sec_per_chip_K{K}_d{D}",
-                "value": round(value, 1),
-                "unit": "pt*iter/s/chip",
-                "vs_baseline": round(value / BASELINE_PT_ITER_PER_S, 2),
-            }
-        )
+    # Sanity ceiling: 4*K*D MXU FLOPs/pt against the device's bf16 peak
+    # bounds the physically possible rate (~376M pt*iter/s on v5e); a value
+    # above it means the short chain absorbed a burst of host contention
+    # that min-of-3 couldn't shed (observed once: slope <= 0 -> 1.7e16) —
+    # retry the measurement, and FLAG the record if every retry is still
+    # impossible rather than let garbage pass as a clean number.
+    kind = getattr(dev, "device_kind", "").lower()
+    peak_flops = next(
+        (
+            peak
+            for tag, peak in (
+                ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
+                ("v4", 275e12),
+            )
+            if tag in kind
+        ),
+        1e15,  # unknown part: ceiling only catches the truly absurd
     )
+    phys_max = peak_flops / (4 * K * D)
+    suspect = False
+    for _ in range(3):
+        t_short = min(chain(x, c, ITERS_SHORT) for _ in range(3))
+        t_long = min(chain(x, c, ITERS_LONG) for _ in range(3))
+        per_iter = max((t_long - t_short) / (ITERS_LONG - ITERS_SHORT), 1e-9)
+        value = n / per_iter
+        suspect = value > phys_max
+        if not suspect:
+            break
+    record = {
+        "metric": f"lloyd_points_per_sec_per_chip_K{K}_d{D}",
+        "value": round(value, 1),
+        "unit": "pt*iter/s/chip",
+        "vs_baseline": round(value / BASELINE_PT_ITER_PER_S, 2),
+    }
+    if suspect:
+        record["suspect"] = ("exceeds the device's physical rate ceiling "
+                             "on every retry — measurement invalid")
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
