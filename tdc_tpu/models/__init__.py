@@ -8,7 +8,13 @@ from tdc_tpu.models.streaming import (
     streamed_fuzzy_fit,
     streamed_kmeans_fit,
 )
-from tdc_tpu.models.estimators import KMeans, FuzzyCMeans, GaussianMixture
+from tdc_tpu.models.bisecting import bisecting_kmeans_fit
+from tdc_tpu.models.estimators import (
+    BisectingKMeans,
+    KMeans,
+    FuzzyCMeans,
+    GaussianMixture,
+)
 from tdc_tpu.models.gmm import (
     GMMResult,
     gmm_fit,
@@ -35,6 +41,8 @@ __all__ = [
     "streamed_kmeans_fit",
     "streamed_fuzzy_fit",
     "KMeans",
+    "BisectingKMeans",
+    "bisecting_kmeans_fit",
     "FuzzyCMeans",
     "GaussianMixture",
     "GMMResult",

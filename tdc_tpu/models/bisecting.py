@@ -1,0 +1,171 @@
+"""Bisecting K-Means — divisive hierarchical clustering, TPU-shaped.
+
+A beyond-the-reference model family (sklearn.cluster.BisectingKMeans
+parity): start from one cluster and repeatedly split the worst cluster
+with a 2-means fit until K clusters exist. Splitting is TPU-native via
+**mask-weighted 2-means over the full array**: the candidate cluster's
+membership becomes `sample_weight`, so every split reuses ONE compiled
+(N, d) weighted-Lloyd executable instead of recompiling per dynamic
+subset shape — the idiomatic way to express ragged subproblems under XLA's
+static-shape model (same trick as the zero-weight batch padding in
+models/streaming.py).
+
+Reference context: the reference has no hierarchical clustering; its
+closest structure is repeated flat K-Means runs
+(scripts/new_experiment.py:44-50 sweeps K externally). Bisecting K-Means
+gives the dendrogram-style alternative sklearn users expect. The estimator
+facade lives with its siblings in models/estimators.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tdc_tpu.models.kmeans import KMeansResult, kmeans_fit, kmeans_predict
+
+STRATEGIES = ("biggest_inertia", "largest_cluster")
+
+
+def _per_cluster_sse(x, labels, centers, w=None):
+    """(K,) within-cluster (optionally weighted) SSE — gathered own-center
+    distances, O(N·d)."""
+    xf = jnp.asarray(x, jnp.float32)
+    diff = xf - jnp.asarray(centers, jnp.float32)[labels]
+    d2 = jnp.sum(diff * diff, axis=1)
+    if w is not None:
+        d2 = d2 * w
+    return jax.ops.segment_sum(d2, labels, num_segments=len(centers))
+
+
+def bisecting_kmeans_fit(
+    x,
+    k: int,
+    *,
+    key: jax.Array | None = None,
+    max_iters: int = 20,
+    tol: float = 1e-4,
+    n_init: int = 1,
+    bisecting_strategy: str = "biggest_inertia",
+    sample_weight=None,
+    return_labels: bool = False,
+):
+    """Fit K clusters by K−1 successive 2-means splits.
+
+    Args:
+      bisecting_strategy: 'biggest_inertia' (split the cluster with the
+        largest within-cluster SSE — sklearn's default) or
+        'largest_cluster' (most points / most weight).
+      n_init: k-means++ restarts per split (each split is a full weighted
+        2-means fit).
+      sample_weight: optional (N,) nonnegative per-point weights (sklearn
+        parity) — combined multiplicatively with each split's membership
+        mask.
+      return_labels: also return the (N,) hierarchical training labels —
+        the assignment produced by the splits themselves, which `sse`
+        is computed from (a flat nearest-center predict can differ on
+        boundary points, exactly as sklearn's tree-based predict can).
+
+    Returns KMeansResult (or (KMeansResult, labels) with return_labels):
+    centroids (K, d); sse = final within-cluster total over the
+    hierarchical labels; n_iter = number of splits (K−1); converged = True
+    (the procedure always terminates).
+
+    Raises ValueError when no cluster with ≥2 distinct positive-weight
+    points remains to split before reaching K (sklearn errors likewise on
+    unsplittable data).
+    """
+    if bisecting_strategy not in STRATEGIES:
+        raise ValueError(
+            f"bisecting_strategy must be one of {STRATEGIES}, "
+            f"got {bisecting_strategy!r}"
+        )
+    x = jnp.asarray(x)
+    n, d = x.shape
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if n < k:
+        raise ValueError(f"n_obs={n} < K={k}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    base_w = None
+    if sample_weight is not None:
+        from tdc_tpu.models._common import validate_sample_weight
+
+        base_w = np.asarray(validate_sample_weight(sample_weight, n, k))
+
+    labels = np.zeros(n, np.int64)
+    if base_w is None:
+        mean0 = jnp.mean(x, axis=0)
+    else:
+        mean0 = (
+            jnp.sum(x * jnp.asarray(base_w)[:, None], axis=0)
+            / max(float(base_w.sum()), 1e-12)
+        )
+    centers = np.array(mean0, np.float32, copy=True)[None, :]
+    wj = None if base_w is None else jnp.asarray(base_w)
+    sse = np.asarray(_per_cluster_sse(x, jnp.asarray(labels), centers, wj))
+    splittable = np.ones(1, bool)
+
+    for next_label in range(1, k):
+        while True:
+            candidates = np.where(splittable)[0]
+            if candidates.size == 0:
+                raise ValueError(
+                    f"no splittable cluster left after {next_label} "
+                    f"clusters (need K={k}); the data has too few distinct "
+                    "points"
+                )
+            if bisecting_strategy == "biggest_inertia":
+                score = sse
+            else:
+                score = np.bincount(
+                    labels, weights=base_w, minlength=len(centers)
+                )
+            target = candidates[int(np.argmax(score[candidates]))]
+            w = (labels == target).astype(np.float32)
+            if base_w is not None:
+                w = w * base_w
+            if (w > 0).sum() < 2:
+                splittable[target] = False
+                continue
+            key, sub = jax.random.split(key)
+            try:
+                res = kmeans_fit(
+                    x, 2, init="kmeans++", key=sub, max_iters=max_iters,
+                    tol=tol, sample_weight=w, n_init=n_init,
+                )
+            except ValueError:
+                # fewer than 2 positive-weight DISTINCT seeds available
+                splittable[target] = False
+                continue
+            side = np.asarray(kmeans_predict(x, res.centroids))
+            mask = labels == target
+            left = mask & (side == 0)
+            right = mask & (side == 1)
+            if not left.any() or not right.any():
+                # Degenerate split (duplicate points): this cluster cannot
+                # be divided — mark it and pick another candidate.
+                splittable[target] = False
+                continue
+            break
+        labels[right] = next_label
+        new_centers = np.asarray(res.centroids, np.float32)
+        centers[target] = new_centers[0]
+        centers = np.concatenate([centers, new_centers[1:2]], axis=0)
+        splittable = np.concatenate([splittable, [True]])
+        sse = np.asarray(
+            _per_cluster_sse(x, jnp.asarray(labels), centers, wj)
+        )
+
+    result = KMeansResult(
+        centroids=jnp.asarray(centers),
+        n_iter=jnp.asarray(k - 1, jnp.int32),
+        sse=jnp.asarray(float(sse.sum()), jnp.float32),
+        shift=jnp.asarray(0.0, jnp.float32),  # no global Lloyd loop ran
+        converged=jnp.asarray(True),
+    )
+    if return_labels:
+        return result, labels.astype(np.int32)
+    return result

@@ -1,0 +1,124 @@
+"""Bisecting K-Means vs the sklearn.cluster.BisectingKMeans oracle."""
+
+import numpy as np
+import pytest
+
+from tdc_tpu.models import BisectingKMeans, bisecting_kmeans_fit
+from tdc_tpu.models.kmeans import kmeans_predict
+
+
+@pytest.fixture(scope="module")
+def four_blobs():
+    rng = np.random.default_rng(0)
+    centers = np.array([[0, 0], [10, 0], [0, 10], [10, 10]], np.float32)
+    x = (centers[rng.integers(0, 4, 2000)]
+         + rng.normal(0, 0.5, (2000, 2))).astype(np.float32)
+    return x, centers
+
+
+def test_matches_sklearn_inertia(four_blobs):
+    from sklearn.cluster import BisectingKMeans as SKBisecting
+
+    x, _ = four_blobs
+    est = BisectingKMeans(n_clusters=4, random_state=0).fit(x)
+    sk = SKBisecting(n_clusters=4, random_state=0).fit(x)
+    # Both find the four blobs; inertia agrees tightly.
+    np.testing.assert_allclose(est.inertia_, sk.inertia_, rtol=1e-3)
+    assert est.cluster_centers_.shape == (4, 2)
+
+
+def test_recovers_blob_centers(four_blobs):
+    x, centers = four_blobs
+    res = bisecting_kmeans_fit(x, 4)
+    got = np.asarray(res.centroids)
+    d = np.linalg.norm(got[:, None] - centers[None], axis=-1)
+    assert d.min(axis=1).max() < 0.5  # every center near a true blob
+    assert int(res.n_iter) == 3  # K-1 splits
+    assert bool(res.converged)
+
+
+def test_largest_cluster_strategy(four_blobs):
+    x, centers = four_blobs
+    res = bisecting_kmeans_fit(x, 4, bisecting_strategy="largest_cluster")
+    got = np.asarray(res.centroids)
+    d = np.linalg.norm(got[:, None] - centers[None], axis=-1)
+    assert d.min(axis=1).max() < 0.5
+
+
+def test_sse_decreases_with_k(four_blobs):
+    x, _ = four_blobs
+    sses = [float(bisecting_kmeans_fit(x, k).sse) for k in (1, 2, 4, 8)]
+    assert all(b <= a + 1e-4 for a, b in zip(sses, sses[1:])), sses
+
+
+def test_k1_is_global_mean(four_blobs):
+    x, _ = four_blobs
+    res = bisecting_kmeans_fit(x, 1)
+    np.testing.assert_allclose(np.asarray(res.centroids)[0],
+                               x.mean(axis=0), rtol=1e-5)
+
+
+def test_labels_cover_all_clusters(four_blobs):
+    x, _ = four_blobs
+    res = bisecting_kmeans_fit(x, 4)
+    labels = np.asarray(kmeans_predict(x, res.centroids))
+    assert set(labels.tolist()) == {0, 1, 2, 3}
+
+
+def test_unsplittable_raises():
+    x = np.zeros((16, 3), np.float32)  # all-identical points
+    with pytest.raises(ValueError, match="splittable|distinct"):
+        bisecting_kmeans_fit(x, 4)
+
+
+def test_bad_strategy_rejected(four_blobs):
+    x, _ = four_blobs
+    with pytest.raises(ValueError, match="bisecting_strategy"):
+        bisecting_kmeans_fit(x, 2, bisecting_strategy="bogus")
+
+
+def test_estimator_unfitted_raises():
+    with pytest.raises(AttributeError, match="not fitted"):
+        BisectingKMeans(n_clusters=2).predict(np.zeros((4, 2), np.float32))
+
+
+def test_estimator_fit_predict(four_blobs):
+    x, _ = four_blobs
+    labels = BisectingKMeans(n_clusters=4, random_state=1).fit_predict(x)
+    assert labels.shape == (2000,)
+    assert len(set(labels.tolist())) == 4
+
+
+def test_labels_inertia_consistent(four_blobs):
+    """sklearn semantics: inertia_ is computed over labels_ (the
+    hierarchical assignment), so the two must agree exactly."""
+    x, _ = four_blobs
+    est = BisectingKMeans(n_clusters=4, random_state=0).fit(x)
+    recomputed = float(
+        ((x - est.cluster_centers_[est.labels_]) ** 2).sum()
+    )
+    np.testing.assert_allclose(est.inertia_, recomputed, rtol=1e-5)
+
+
+def test_sample_weight_repeated_rows_equivalence(four_blobs):
+    """Integer weights == repeating rows (the standard sample_weight
+    contract), up to split tie-breaks on well-separated blobs."""
+    x, _ = four_blobs
+    x = x[:400]
+    w = np.ones(len(x), np.float32)
+    w[:100] = 3.0
+    res_w = bisecting_kmeans_fit(x, 4, sample_weight=w)
+    x_rep = np.concatenate([x, x[:100], x[:100]])
+    res_r = bisecting_kmeans_fit(x_rep, 4)
+    a = np.sort(np.asarray(res_w.centroids), axis=0)
+    b = np.sort(np.asarray(res_r.centroids), axis=0)
+    np.testing.assert_allclose(a, b, atol=0.2)
+
+
+def test_estimator_accepts_sample_weight(four_blobs):
+    x, _ = four_blobs
+    w = np.ones(len(x), np.float32)
+    est = BisectingKMeans(n_clusters=4, random_state=0).fit(
+        x, sample_weight=w
+    )
+    assert est.labels_.shape == (len(x),)
