@@ -22,7 +22,7 @@ import time
 import numpy as np
 
 METHOD_NAMES = ("distributedKMeans", "distributedFuzzyCMeans",
-                "gaussianMixture")
+                "gaussianMixture", "bisectingKMeans")
 
 
 def _valid_int(parser, name, value, minimum=1):
@@ -237,6 +237,30 @@ def validate_args(parser, args):
         parser.error("--init=kmeans is a gaussianMixture seeding mode")
     elif args.covariance_type != "diag":
         parser.error("--covariance_type applies to gaussianMixture only")
+    if args.method_name == "bisectingKMeans":
+        # In-memory, single-device: every split is a full-array weighted
+        # 2-means, which has no streamed/sharded form yet.
+        for flag in ("minibatch", "mean_combine", "spherical", "streamed"):
+            if getattr(args, flag):
+                parser.error(f"--{flag} is not supported with "
+                             "bisectingKMeans")
+        if args.num_batches > 1 or args.shard_k > 1:
+            parser.error("bisectingKMeans is in-memory only "
+                         "(no --num_batches/--shard_k)")
+        if args.n_devices and args.n_devices > 1:
+            parser.error("bisectingKMeans is single-device")
+        if args.kernel is not None:
+            parser.error("bisectingKMeans has no --kernel selection (each "
+                         "split is a weighted XLA-path 2-means)")
+        if args.ckpt_dir or args.ckpt_every_batches:
+            parser.error("bisectingKMeans does not checkpoint")
+        # Reject rather than silently ignore (same rule as the pallas gate).
+        if args.init != "kmeans++":
+            parser.error("bisectingKMeans seeds every split with kmeans++; "
+                         f"--init={args.init} would be silently ignored")
+        if args.history_file:
+            parser.error("bisectingKMeans produces no per-iteration "
+                         "history (--history_file is kmeans/fuzzy)")
     if args.metrics_sample < 0:
         parser.error("--metrics_sample must be >= 0")
     if args.weight_file:
@@ -539,6 +563,21 @@ def run_experiment(args) -> dict:
                 covariance_type=args.covariance_type,
                 sample_weight=weights,
                 kernel=args.kernel or "xla",
+            )
+        if args.method_name == "bisectingKMeans":
+            from tdc_tpu.models.bisecting import bisecting_kmeans_fit
+
+            if streamed or n_devices > 1:
+                # validate_args rejects the explicit flags; this catches the
+                # implicit every-local-device default and OOM fallbacks.
+                raise ValueError(
+                    "bisectingKMeans is in-memory single-device only "
+                    f"(resolved n_devices={n_devices}, "
+                    f"num_batches={num_batches}); pass --n_GPUs=1"
+                )
+            return bisecting_kmeans_fit(
+                xx, args.K, key=key, max_iters=args.n_max_iters,
+                tol=args.tol, sample_weight=weights,
             )
         if args.method_name == "distributedFuzzyCMeans":
             if streamed:

@@ -118,7 +118,7 @@ class BisectingKMeans:
         self,
         n_clusters: int = 8,
         *,
-        max_iter: int = 20,
+        max_iter: int = 300,  # sklearn.cluster.BisectingKMeans default
         tol: float = 1e-4,
         random_state: int = 0,
         n_init: int = 1,

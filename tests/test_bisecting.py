@@ -33,7 +33,7 @@ def test_recovers_blob_centers(four_blobs):
     got = np.asarray(res.centroids)
     d = np.linalg.norm(got[:, None] - centers[None], axis=-1)
     assert d.min(axis=1).max() < 0.5  # every center near a true blob
-    assert int(res.n_iter) == 3  # K-1 splits
+    assert int(res.n_iter) >= 3  # total Lloyd iters over K-1 splits
     assert bool(res.converged)
 
 

@@ -559,3 +559,29 @@ def test_streamed_gmm_rejects_pallas_vmem_infeasible(rng):
     with pytest.raises(ValueError, match="VMEM"):
         streamed_gmm_fit(lambda: iter(batches), 1024, 768, kernel="pallas",
                          key=jax.random.PRNGKey(0))
+
+
+def test_cli_bisecting_kmeans(tmp_path):
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--method_name=bisectingKMeans --n_obs=2000 --n_dim=4 --K=5 "
+        f"--n_max_iters=20 --seed=0 --n_GPUs=1 --log_file={log}".split()
+    )
+    assert rc == 0
+    rows = list(csv.DictReader(open(log)))
+    assert rows[0]["status"] == "ok"
+    assert rows[0]["method_name"] == "bisectingKMeans"
+    assert int(rows[0]["n_iter"]) >= 4  # total Lloyd iters over K-1 splits
+
+
+def test_cli_bisecting_rejects_streamed_and_shard(tmp_path):
+    p = build_parser()
+    for extra in ("--num_batches=4", "--shard_k=2 --n_GPUs=4",
+                  "--kernel=pallas", "--spherical", "--init=random",
+                  "--history_file=h.csv"):
+        args = p.parse_args(
+            f"--method_name=bisectingKMeans --n_obs=1000 --n_dim=4 --K=3 "
+            f"--seed=0 --log_file={tmp_path}/l.csv {extra}".split()
+        )
+        with pytest.raises(SystemExit):
+            validate_args(p, args)
