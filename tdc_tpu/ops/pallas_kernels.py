@@ -71,21 +71,38 @@ def fused_block_n(
     return int(min(cap, avail // per_row // 128 * 128))
 
 
-def _distance_argmin_kernel(x_ref, c_ref, c2_ref, mind_ref, arg_ref, *, block_k: int):
+def _distance_argmin_kernel(
+    x_ref, c_ref, c2_ref, mind_ref, arg_ref, *, block_k: int, halves: int
+):
+    """`halves` > 1 splits the x-block into sub-blocks whose cross matmuls
+    are all issued before any VPU work, so Mosaic can overlap one sub-block's
+    min/argmin chain with the next's MXU matmul (the same interleave as
+    _fused_lloyd_kernel; identical math at any value)."""
     j = pl.program_id(1)
-    cross = jax.lax.dot_general(
-        x_ref[...],
-        c_ref[...],
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (BN, BK)
-    d2 = c2_ref[...] - 2.0 * cross  # (1, BK) + (BN, BK); ‖x‖² row-constant, omitted
-    tile_min = jnp.min(d2, axis=1, keepdims=True)  # (BN, 1)
-    # Manual argmin: first column index achieving the min, all-i32 (neither
-    # jnp.argmin nor f32<->i32 vector casts legalize in Mosaic).
-    col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1) + j * block_k
-    masked = jnp.where(d2 <= tile_min, col, _ARG_SENTINEL)
-    tile_arg = jnp.min(masked, axis=1, keepdims=True)  # (BN, 1) i32 index
+    sub = x_ref.shape[0] // halves
+    xs = [x_ref[h * sub:(h + 1) * sub, :] for h in range(halves)]
+    crosses = [
+        jax.lax.dot_general(
+            xh,
+            c_ref[...],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BN/halves, BK)
+        for xh in xs
+    ]
+    tile_mins = []
+    tile_args = []
+    for cross in crosses:
+        d2 = c2_ref[...] - 2.0 * cross  # ‖x‖² row-constant, omitted
+        tile_min = jnp.min(d2, axis=1, keepdims=True)  # (sub, 1)
+        # Manual argmin: first column index achieving the min, all-i32
+        # (neither jnp.argmin nor f32<->i32 vector casts legalize in Mosaic).
+        col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1) + j * block_k
+        masked = jnp.where(d2 <= tile_min, col, _ARG_SENTINEL)
+        tile_args.append(jnp.min(masked, axis=1, keepdims=True))  # (sub, 1)
+        tile_mins.append(tile_min)
+    tile_min = jnp.concatenate(tile_mins, axis=0)  # (BN, 1)
+    tile_arg = jnp.concatenate(tile_args, axis=0)
 
     @pl.when(j == 0)
     def _():
@@ -111,7 +128,7 @@ def _pad_axis(a, axis: int, multiple: int, value):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_n", "block_k", "return_dist", "interpret"),
+    static_argnames=("block_n", "block_k", "return_dist", "halves", "interpret"),
 )
 def distance_argmin(
     x: jax.Array,
@@ -120,6 +137,7 @@ def distance_argmin(
     block_n: int = 1024,
     block_k: int = 512,
     return_dist: bool = False,
+    halves: int | None = None,
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(argmin (N,) int32, min squared distance (N,) f32) without materializing N×K.
@@ -130,11 +148,28 @@ def distance_argmin(
       block_n / block_k: VMEM tile sizes (points / centroids per grid step).
       return_dist: also return true min ‖x−c‖² (adds the ‖x‖² term back);
         otherwise the distance output is the shifted value (still argmin-valid).
+      halves: MXU/VPU-overlap sub-block split (see _distance_argmin_kernel);
+        None auto-picks (identical math at any value).
       interpret: run in interpreter mode (auto-True off-TPU so tests exercise
         the same kernel on the CPU mesh).
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    if halves is None:
+        # Auto-enable only at hardware-swept configs (v5e, K=16384·d=768):
+        # (1024,1024)+h4 80.3 ms vs h1 85.4; (1024,512)+h2 84.8 vs h1 90.5.
+        # Other blocks keep the sequential kernel (same policy as
+        # lloyd_stats_fused — no untested scheduling configs by default).
+        if (block_n, block_k) == (1024, 1024):
+            halves = 4
+        elif (block_n, block_k) == (1024, 512):
+            halves = 2
+        else:
+            halves = 1
+    elif block_n % halves:
+        raise ValueError(
+            f"distance_argmin: halves={halves} must divide block_n={block_n}"
+        )
     n, d = x.shape
     k = centroids.shape[0]
     # Lane-align d (zero columns change nothing), tile-align N and K.
@@ -147,7 +182,9 @@ def distance_argmin(
 
     grid = (n_pad // block_n, k_pad // block_k)
     mind, argf = pl.pallas_call(
-        functools.partial(_distance_argmin_kernel, block_k=block_k),
+        functools.partial(
+            _distance_argmin_kernel, block_k=block_k, halves=halves
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -496,14 +533,18 @@ def fuzzy_stats_fused(
 def lloyd_stats_auto(x: jax.Array, centroids: jax.Array, **kw):
     """Pallas Lloyd stats routed by VMEM feasibility (decided at trace time
     from the static shapes): the fully-fused single-pass kernel when the
-    (K, d) accumulator + block tiles fit the scope, else the two-pass
-    blockwise path (online-argmin kernel + one-hot-matmul stats) that works
-    at any K·d — so kernel='pallas' is safe at every shape, including the
-    K=4096·d=256 and K=16,384·d=768 regimes where the fused kernel cannot
-    compile."""
+    (K, d) accumulator + block tiles fit the scope, else the sorted-stats
+    path (online-argmin kernel + sort-based segment sum, ops/sorted_stats)
+    that works at any K·d — so kernel='pallas' is safe at every shape,
+    including the K=4096·d=256 and K=16,384·d=768 regimes where the fused
+    kernel cannot compile. Beyond the fused regime the dense one-hot stats
+    contraction costs a full second distance pass; the sorted path replaces
+    it with 2·B·d FLOPs/point (benchmarks/ROOFLINE_SHARDED.md)."""
+    from tdc_tpu.ops.sorted_stats import lloyd_stats_sorted
+
     if fused_block_n(centroids.shape[0], x.shape[1], x.dtype.itemsize) > 0:
         return lloyd_stats_fused(x, centroids, **kw)
-    return lloyd_stats_pallas(x, centroids, **kw)
+    return lloyd_stats_sorted(x, centroids, **kw)
 
 
 def fuzzy_stats_auto(x: jax.Array, centroids: jax.Array, m: float = 2.0, **kw):

@@ -1,0 +1,166 @@
+"""Sort-based cluster sufficient statistics — O(N·B·d) instead of O(N·K·d).
+
+The one-hot-matmul stats contraction (ops/assign.cluster_stats) is the right
+tool at small K: its 2·N·K·d MXU FLOPs ride along with the distance pass and
+the (N, K) one-hot fuses away inside the fused Pallas kernel. At K = 16,384 it
+becomes the bottleneck: the stats matmul costs exactly as much MXU time as the
+distance pass itself (2·K·d FLOPs per point to multiply 16,383 zeros per row),
+so the iteration can never exceed 50% of the distance-only roofline, and the
+(N, K) one-hot materializes in HBM (64 KB/point) on the unfused path.
+
+This module exploits the sparsity instead: sort the points by assignment, and
+per B-row block of the *sorted* order the distinct labels form a contiguous
+range of at most B "dense ranks" — so a (B, B) block-local one-hot matmul plus
+a windowed accumulate produces the exact per-cluster sums with 2·B·d FLOPs per
+point (B = 512 ⇒ 3% of the K = 16,384 distance work) and O(N·d) HBM traffic.
+Counts come from K+1 binary searches over the sorted labels — no scatter, no
+(N, K) anything, anywhere.
+
+This is the TPU-native realization of the reference's better update variant —
+`tf.unsorted_segment_sum` of X and of ones (visualization.ipynb#cell5) — for
+the sharded-centroid regime (BASELINE config 5) where the dense contraction
+stops being free. Pure XLA (sort / cumsum / scan / dynamic_update_slice), so
+it runs identically on the CPU test mesh and inside shard_map towers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sorted_counts(sorted_labels: jax.Array, k: int) -> jax.Array:
+    """(k,) f32 occurrence counts of 0..k-1 in an ascending label array,
+    via k+1 vectorized binary searches (no scatter, no one-hot)."""
+    lo = jnp.searchsorted(sorted_labels, jnp.arange(k + 1, dtype=jnp.int32))
+    return (lo[1:] - lo[:-1]).astype(jnp.float32)
+
+
+def sorted_cluster_stats(
+    x: jax.Array,
+    labels: jax.Array,
+    k: int,
+    *,
+    block: int = 512,
+) -> tuple[jax.Array, jax.Array]:
+    """(Σx per cluster (k, d) f32, counts (k,) f32) from per-point labels.
+
+    Exact (f32 accumulation; bf16 inputs contribute their exact bf16 values,
+    matching ops/assign.cluster_stats' precision contract). Labels outside
+    [0, k) are ignored — the K-sharded tower uses label k as the
+    "assigned to another shard" sentinel.
+
+    Algorithm: stable argsort of labels → gather rows → dense ranks via a
+    cumsum over label-change flags → per B-block local one-hot matmul into a
+    compact accumulator window at the block's base rank (ranks are contiguous,
+    so any B rows span < B ranks) → one final gather maps compact rows back to
+    label space. Counts are read off the sorted labels with searchsorted.
+    """
+    n, d = x.shape
+    labels = labels.astype(jnp.int32)
+    # Clamp strays + pad to a block multiple with the sentinel label k (sorts
+    # last; dropped by the final [:k] gather).
+    labels = jnp.where((labels >= 0) & (labels < k), labels, k)
+    pad = (-n) % block
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=k)
+    n_pad = x.shape[0]
+    nb = n_pad // block
+
+    # One stable sort carries the permutation along with the keys (an extra
+    # keys = labels[order] scalar gather measured 3.7 ms at N=524k). The row
+    # gather uses index syntax, not jnp.take — jnp.take's clip-mode gather
+    # lowers ~50x slower for this shape on v5e (287 ms vs 5.2 ms, round 4).
+    keys, order = jax.lax.sort(
+        (labels, jnp.arange(n_pad, dtype=jnp.int32)), num_keys=1,
+        is_stable=True,
+    )
+    xs = x[order]
+
+    lo = jnp.searchsorted(keys, jnp.arange(k + 1, dtype=jnp.int32))
+    counts = (lo[1:] - lo[:-1]).astype(jnp.float32)
+
+    # Dense ranks: 0 for the first run, +1 at every label change. Contiguous
+    # by construction, so block-local ids (rank − block-base rank) ∈ [0, B).
+    newseg = (keys[1:] != keys[:-1]).astype(jnp.int32)
+    ranks = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(newseg)]
+    )
+    rb = ranks.reshape(nb, block)
+    base = rb[:, 0]
+    local = rb - base[:, None]
+
+    if x.dtype == jnp.bfloat16:
+        oh_dtype, precision = jnp.bfloat16, jax.lax.Precision.DEFAULT
+        xmm = xs
+    else:
+        oh_dtype, precision = jnp.float32, jax.lax.Precision.HIGHEST
+        xmm = xs.astype(jnp.float32)
+    xb = xmm.reshape(nb, block, d)
+
+    # Compact accumulator: ≤ min(k+1, n_pad) distinct labels exist, and the
+    # last window starts at most at rank U−1, so U + block rows always hold
+    # every window write.
+    cap = min(k + 1, n_pad) + block
+
+    def body(acc, inp):
+        xblk, lblk, b = inp
+        col = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        oh = (lblk[:, None] == col).astype(oh_dtype)  # (B, B) block-local
+        part = jax.lax.dot_general(
+            oh,
+            xblk,
+            (((0,), (0,)), ((), ())),
+            precision=precision,
+            preferred_element_type=jnp.float32,
+        )  # (B, d) per-local-rank sums
+        win = jax.lax.dynamic_slice(acc, (b, 0), (block, d))
+        return jax.lax.dynamic_update_slice(acc, win + part, (b, 0)), None
+
+    compact, _ = jax.lax.scan(
+        body, jnp.zeros((cap, d), jnp.float32), (xb, local, base)
+    )
+
+    # Map label j → its dense rank (first occurrence is at lo[j]); absent
+    # labels point at the never-written top row and are zeroed explicitly.
+    pos = jnp.clip(lo[:k], 0, n_pad - 1)
+    present = keys[pos] == jnp.arange(k, dtype=jnp.int32)
+    r_of_key = jnp.where(present, ranks[pos], cap - 1)
+    sums = jnp.where(present[:, None], compact[r_of_key], 0.0)
+    return sums, counts
+
+
+def lloyd_stats_sorted(
+    x: jax.Array,
+    centroids: jax.Array,
+    *,
+    block_n: int = 1024,
+    block_k: int = 512,
+    sort_block: int = 512,
+    interpret: bool | None = None,
+):
+    """Lloyd sufficient stats for the large-K regime: Pallas blockwise
+    online-argmin (no N×K anywhere) + sort-based stats (no dense one-hot
+    contraction). The large-K drop-in for ops/assign.lloyd_stats: at
+    K = 16,384·d = 768 the dense stats matmul costs a full second distance
+    pass (2·K·d FLOPs/point); this path replaces it with 2·B·d (~3%).
+
+    Returns ops.assign.SufficientStats (sums (K, d) f32, counts (K,) f32,
+    sse () f32).
+    """
+    from tdc_tpu.ops.assign import SufficientStats
+    from tdc_tpu.ops.pallas_kernels import distance_argmin
+
+    arg, mind = distance_argmin(
+        x,
+        centroids,
+        block_n=block_n,
+        block_k=block_k,
+        return_dist=True,
+        interpret=interpret,
+    )
+    sums, counts = sorted_cluster_stats(
+        x, arg, centroids.shape[0], block=sort_block
+    )
+    return SufficientStats(sums=sums, counts=counts, sse=jnp.sum(mind))

@@ -62,7 +62,12 @@ def _block_champions(x_blk, c_loc, kernel: str):
     if kernel == "pallas":
         from tdc_tpu.ops.pallas_kernels import distance_argmin
 
-        arg, lmin = distance_argmin(x_blk, c_loc, return_dist=True)
+        # 1024-wide K-tiles measured 7% faster than the 512 default at the
+        # K=16,384·d=768 regime (80% vs 74% MFU) and stay within VMEM.
+        blk_k = 1024 if k_per >= 1024 else 512
+        arg, lmin = distance_argmin(
+            x_blk, c_loc, block_k=blk_k, return_dist=True
+        )
     else:
         d2 = pairwise_sq_dist(x_blk, c_loc)  # (block, K/Pm)
         lmin = jnp.min(d2, axis=1)
@@ -70,29 +75,33 @@ def _block_champions(x_blk, c_loc, kernel: str):
     larg = arg + m_idx * k_per
     mins = jax.lax.all_gather(lmin, MODEL_AXIS)  # (Pm, block)
     args = jax.lax.all_gather(larg, MODEL_AXIS)  # (Pm, block)
-    w = jnp.argmin(mins, axis=0)  # (block,) winning shard per point
-    gmin = jnp.take_along_axis(mins, w[None, :], 0)[0]
-    garg = jnp.take_along_axis(args, w[None, :], 0)[0]
+    # Champion selection as pure reductions: per-column take_along_axis
+    # gathers on (Pm, N) measured 3.75 ms each at N=524k (scalar-gather
+    # layout); min + masked-min is VPU-cheap and deterministic (distance
+    # ties across shards resolve to the lowest centroid index).
+    gmin = jnp.min(mins, axis=0)
+    garg = jnp.min(jnp.where(mins == gmin[None, :], args, 2**30), axis=0)
     return gmin, garg
 
 
 def _block_stats(x_blk, c_loc, kernel: str):
     """(sums (K/Pm, d), counts (K/Pm,), sse ()) for one N-block — local to
-    this (data, model) shard pair; data-psum'd by the caller."""
+    this (data, model) shard pair; data-psum'd by the caller.
+
+    Stats for MY K-shard only, via the sort-based segment sum
+    (ops/sorted_stats): out-of-shard assignments map to the sentinel label
+    K/Pm and drop out. The round-3 dense one-hot contraction here cost a
+    full second distance pass (2·K·d MXU FLOPs per point at HIGHEST
+    precision) plus an HBM-materialized (block, K/Pm) one-hot — it capped
+    the K=16,384 regime at ~40% of the distance-only roofline
+    (benchmarks/ROOFLINE_SHARDED.md)."""
+    from tdc_tpu.ops.sorted_stats import sorted_cluster_stats
+
     k_per = c_loc.shape[0]
     m_idx = jax.lax.axis_index(MODEL_AXIS)
     gmin, garg = _block_champions(x_blk, c_loc, kernel)
-    # Stats for MY K-shard only: one_hot maps out-of-shard assignments to 0.
     rel = garg - m_idx * k_per
-    one_hot = jax.nn.one_hot(rel, k_per, dtype=jnp.float32)  # (block, K/Pm)
-    sums = jax.lax.dot_general(
-        one_hot,
-        x_blk.astype(jnp.float32),
-        (((0,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32,
-    )
-    counts = jnp.sum(one_hot, axis=0)
+    sums, counts = sorted_cluster_stats(x_blk, rel, k_per)
     return sums, counts, jnp.sum(gmin)
 
 
@@ -116,7 +125,12 @@ def make_sharded_stats(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
     def stats(x_loc, c_loc):
         n_loc, d = x_loc.shape
         k_per = c_loc.shape[0]
-        if block_rows and n_loc > block_rows:
+        # The N-block scan exists to bound the XLA path's (block, K/Pm)
+        # distance intermediates. The pallas path has none — its only
+        # N-sized arrays are the (N,) champion columns — and profiling showed
+        # the per-block sorts inside the scan cost ~25 ms/step at N=524k
+        # (8 sorts of 64k vs one of 512k): one-shot is strictly better.
+        if block_rows and n_loc > block_rows and kernel != "pallas":
             if n_loc % block_rows != 0:
                 raise ValueError(
                     f"local shard rows {n_loc} not divisible by "
@@ -209,7 +223,7 @@ def sharded_assign(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
     )
     def assign(x_loc, c_loc):
         n_loc, d = x_loc.shape
-        if block_rows and n_loc > block_rows:
+        if block_rows and n_loc > block_rows and kernel != "pallas":
             if n_loc % block_rows != 0:
                 raise ValueError(
                     f"local shard rows {n_loc} not divisible by "

@@ -1,0 +1,128 @@
+"""Sort-based cluster stats (ops/sorted_stats) vs the dense one-hot oracle.
+
+The sorted path must be numerically interchangeable with
+ops/assign.cluster_stats / lloyd_stats: exact counts, f32-accumulated sums
+(order-of-summation fp noise only), and the same sentinel semantics the
+K-sharded tower relies on (out-of-range labels drop out).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tdc_tpu.ops.assign import cluster_stats, lloyd_stats
+from tdc_tpu.ops.sorted_stats import (
+    lloyd_stats_sorted,
+    sorted_cluster_stats,
+    sorted_counts,
+)
+
+
+@pytest.mark.parametrize(
+    "n,d,k",
+    [(1000, 7, 13), (2048, 16, 5), (300, 3, 400), (512, 4, 512), (17, 2, 3)],
+)
+def test_matches_dense_oracle(n, d, k):
+    rng = np.random.default_rng(n + k)
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    lab = jnp.asarray(rng.integers(0, k, size=n).astype(np.int32))
+    s1, c1 = sorted_cluster_stats(x, lab, k)
+    s2, c2 = cluster_stats(x, lab, k)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-5, atol=1e-4
+    )
+
+
+def test_bfloat16_inputs_exact():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(1537, 8)).astype(np.float32)).astype(
+        jnp.bfloat16
+    )
+    lab = jnp.asarray(rng.integers(0, 64, size=1537).astype(np.int32))
+    s1, c1 = sorted_cluster_stats(x, lab, 64)
+    s2, c2 = cluster_stats(x, lab, 64)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    # both paths sum the exact bf16 values in f32 — tiny order-dependent noise
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-5, atol=1e-4
+    )
+
+
+def test_out_of_range_labels_drop_out():
+    """The K-sharded tower labels out-of-shard points with values outside
+    [0, k); they must contribute to nothing (sentinel semantics)."""
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(500, 4)).astype(np.float32))
+    lab_np = rng.integers(-2, 12, size=500).astype(np.int32)  # k=8 + strays
+    s1, c1 = sorted_cluster_stats(x, jnp.asarray(lab_np), 8)
+    mask = (lab_np >= 0) & (lab_np < 8)
+    s2, c2 = cluster_stats(x[mask], jnp.asarray(lab_np[mask]), 8)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-5, atol=1e-4
+    )
+
+
+def test_empty_clusters_zero():
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(100, 4)).astype(np.float32))
+    lab = jnp.full((100,), 3, jnp.int32)
+    s, c = sorted_cluster_stats(x, lab, 8)
+    assert float(c[3]) == 100 and float(c.sum()) == 100
+    np.testing.assert_allclose(
+        np.asarray(s)[3], np.asarray(x.sum(0)), rtol=1e-5, atol=1e-4
+    )
+    others = np.asarray(s)[[0, 1, 2, 4, 5, 6, 7]]
+    assert np.abs(others).max() == 0
+
+
+def test_single_run_spanning_blocks():
+    """One cluster with more points than the sort block: the windowed
+    accumulate must merge the run across block boundaries."""
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.normal(size=(2000, 3)).astype(np.float32))
+    lab = jnp.zeros((2000,), jnp.int32)
+    s, c = sorted_cluster_stats(x, lab, 4, block=256)
+    assert float(c[0]) == 2000
+    np.testing.assert_allclose(
+        np.asarray(s)[0], np.asarray(x.sum(0)), rtol=1e-5, atol=1e-3
+    )
+
+
+def test_sorted_counts():
+    rng = np.random.default_rng(17)
+    lab = np.sort(rng.integers(0, 31, size=997)).astype(np.int32)
+    c = sorted_counts(jnp.asarray(lab), 31)
+    np.testing.assert_array_equal(
+        np.asarray(c), np.bincount(lab, minlength=31).astype(np.float32)
+    )
+
+
+def test_lloyd_stats_sorted_matches_oracle():
+    rng = np.random.default_rng(19)
+    x = jnp.asarray(rng.normal(size=(777, 6)).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=(37, 6)).astype(np.float32))
+    a = lloyd_stats_sorted(x, c)
+    b = lloyd_stats(x, c)
+    np.testing.assert_array_equal(np.asarray(a.counts), np.asarray(b.counts))
+    np.testing.assert_allclose(
+        np.asarray(a.sums), np.asarray(b.sums), rtol=1e-5, atol=1e-3
+    )
+    # SSE via the shifted-distance kernel: cancellation-level fp noise only
+    assert abs(float(a.sse) - float(b.sse)) / float(b.sse) < 1e-3
+
+
+def test_auto_routes_to_sorted_beyond_fused_regime():
+    from tdc_tpu.ops.pallas_kernels import fused_block_n, lloyd_stats_auto
+
+    k, d = 4096, 256  # fused infeasible at f32
+    assert fused_block_n(k, d, 4) == 0
+    rng = np.random.default_rng(23)
+    x = jnp.asarray(rng.normal(size=(300, d)).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+    a, b = lloyd_stats_auto(x, c), lloyd_stats(x, c)
+    np.testing.assert_array_equal(np.asarray(a.counts), np.asarray(b.counts))
+    np.testing.assert_allclose(
+        np.asarray(a.sums), np.asarray(b.sums), rtol=1e-5, atol=1e-3
+    )
