@@ -188,6 +188,11 @@ def validate_args(parser, args):
             parser.error("--minibatch and --shard_k are mutually exclusive")
     if args.minibatch and args.method_name != "distributedKMeans":
         parser.error("--minibatch supports distributedKMeans only")
+    if args.minibatch and args.kernel is not None:
+        # minibatch_kmeans_fit has no kernel parameter; accepting the flag
+        # would record XLA numbers under an explicit kernel label.
+        parser.error("--kernel is not supported with --minibatch "
+                     "(the mini-batch update is the XLA path)")
     if args.method_name == "gaussianMixture":
         for flag in ("minibatch", "mean_combine", "spherical"):
             if getattr(args, flag):
@@ -269,6 +274,12 @@ def validate_args(parser, args):
         if args.minibatch or args.mean_combine or args.shard_k > 1:
             parser.error("--weight_file is not supported with "
                          "--minibatch/--mean_combine/--shard_k")
+        if args.kernel == "pallas":
+            # Weighted stats run in f32 XLA for mass exactness; reject rather
+            # than record XLA numbers as Pallas (the GMM gate's rule, applied
+            # to every method — kmeans/fuzzy, in-memory and streamed).
+            parser.error("--kernel=pallas does not support --weight_file "
+                         "(weighted stats are the f32 XLA path)")
     if args.mean_combine:
         if args.method_name != "distributedKMeans":
             parser.error("--mean_combine supports distributedKMeans only")
@@ -592,6 +603,7 @@ def run_experiment(args) -> dict:
                     sample_weight_batches=(
                         weight_stream(rows) if weights is not None else None
                     ),
+                    kernel=args.kernel or "xla",
                 )
             return fuzzy_cmeans_fit(
                 xx, args.K, m=args.fuzzifier, init=args.init, key=key,
@@ -611,6 +623,7 @@ def run_experiment(args) -> dict:
                     key=key, max_iters=args.n_max_iters, tol=args.tol,
                     spherical=args.spherical, mesh=mesh,
                     prefetch=args.prefetch,
+                    kernel=args.kernel or "xla",
                 )
             return streamed_kmeans_fit(
                 make_stream(rows), args.K, n_dim,
@@ -622,6 +635,7 @@ def run_experiment(args) -> dict:
                 sample_weight_batches=(
                     weight_stream(rows) if weights is not None else None
                 ),
+                kernel=args.kernel or "xla",
             )
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,
@@ -724,6 +738,10 @@ def run_experiment(args) -> dict:
         "converged": bool(result.converged),
         "num_batches": num_batches,
         "tol": args.tol,
+        # What was requested ('' = method default): with the explicit-kernel
+        # fail-fast gates (validate_args + the model-level rejections), a
+        # recorded 'pallas' row now really means the Pallas path ran.
+        "kernel": ("tall" if use_features else (args.kernel or "")),
         "status": "ok",
         "_metrics": metrics,
     }

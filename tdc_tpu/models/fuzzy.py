@@ -186,6 +186,13 @@ def fuzzy_cmeans_fit(
         return res
     w = None
     if sample_weight is not None:
+        if kernel == "pallas":
+            # Same rule as kmeans_fit/the streamed drivers: an explicit
+            # kernel request must not silently run the f32 XLA weighted path.
+            raise ValueError(
+                "kernel='pallas' does not support sample_weight; drop the "
+                "explicit kernel"
+            )
         from tdc_tpu.models._common import validate_sample_weight
 
         w = validate_sample_weight(sample_weight, int(x.shape[0]), k)

@@ -314,6 +314,14 @@ def kmeans_fit(
             )
         return res
 
+    if sample_weight is not None and kernel == "pallas":
+        # The weighted stats run in f32 XLA for mass exactness; an explicit
+        # kernel request must not silently record XLA numbers as Pallas
+        # (same rule as the streamed drivers and the GMM CLI gate).
+        raise ValueError(
+            "kernel='pallas' does not support sample_weight; drop the "
+            "explicit kernel"
+        )
     block_rows = 0
     if mesh is None and (kernel == "xla" or sample_weight is not None):
         block_rows = auto_block_rows(int(np.asarray(x.shape[0])), k)

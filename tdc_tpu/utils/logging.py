@@ -34,6 +34,7 @@ EXTENDED_COLUMNS = REFERENCE_COLUMNS + [
     "converged",
     "num_batches",
     "tol",  # convergence tolerance; negative = fixed-iteration parity mode
+    "kernel",  # compute path actually requested: xla/pallas/tall ('' = default)
     "status",
 ]
 

