@@ -164,7 +164,12 @@ def crosscheck_cv2(pixels: np.ndarray, k: int, seed: int = 0):
     """cv2.kmeans-oracle comparison — the reference's exact oracle
     (Testing Images.ipynb#cell5-6,#cell13: TERM_CRITERIA_EPS+MAX_ITER,
     10 iterations, eps 1.0, 10 attempts, random centers). Same return shape
-    as crosscheck_sklearn."""
+    as crosscheck_sklearn.
+
+    Side effect: reseeds OpenCV's PROCESS-GLOBAL RNG via cv2.setRNGSeed
+    (KMEANS_RANDOM_CENTERS offers no scoped alternative, and the public API
+    has no way to save/restore the previous state) — caller code relying on
+    cv2 randomness after this call is silently reseeded."""
     import cv2
 
     ours, t_ours = _our_centers_timed(pixels, k, seed)

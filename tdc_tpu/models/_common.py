@@ -18,6 +18,10 @@ def validate_sample_weight(sample_weight, n: int, k: int) -> jnp.ndarray:
     w = jnp.asarray(host, jnp.float32)
     if w.shape != (n,):
         raise ValueError(f"sample_weight shape {w.shape} != ({n},)")
+    if not np.isfinite(host).all():
+        # NaN slips through both comparisons below (NaN < 0 and NaN > 0 are
+        # False) and would silently poison every centroid (round-3 advisor).
+        raise ValueError("sample_weight entries must be finite")
     if (host < 0).any():
         raise ValueError("sample_weight entries must be nonnegative")
     n_pos = int((host > 0).sum())
