@@ -568,7 +568,53 @@ def test_mesh_spherical_matches_single_device(aniso_blobs):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_mesh_tied_still_rejected(aniso_blobs):
+def test_mesh_tied_matches_single_device(aniso_blobs):
+    """Tied whitens once through the replicated (d, d) Cholesky — a per-point
+    column solve that shards over N — then runs the diag matmul expansion in
+    whitened space, so mesh parity must hold (round-3 VERDICT weak #6)."""
     x, _, _ = aniso_blobs
-    with pytest.raises(ValueError, match="spherical"):
-        gmm_fit(x[:992], 3, covariance_type="tied", mesh=make_mesh(8))
+    x = x[:992]
+    means_init = x[:3]
+    single = gmm_fit(x, 3, init=means_init, max_iters=40, tol=-1.0,
+                     covariance_type="tied")
+    sharded = gmm_fit(x, 3, init=means_init, max_iters=40, tol=-1.0,
+                      covariance_type="tied", mesh=make_mesh(8))
+    np.testing.assert_allclose(np.asarray(single.means),
+                               np.asarray(sharded.means),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.variances),
+                               np.asarray(sharded.variances),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(single.log_likelihood),
+                               float(sharded.log_likelihood), rtol=1e-5)
+
+
+def test_mesh_streamed_tied_matches_single_device(aniso_blobs):
+    """Streamed tied over a mesh: padded batches (997 rows, 8 devices) with
+    the generic zero-row correction must match the unsharded stream."""
+    from tdc_tpu.models.gmm import streamed_gmm_fit
+
+    x, _, _ = aniso_blobs
+    x = x[:997]  # deliberately NOT divisible by 8: exercises padding
+    means_init = x[:3]
+
+    def batches():
+        return iter([x[:400], x[400:800], x[800:]])
+
+    single = streamed_gmm_fit(batches, 3, 2, init=means_init, max_iters=20,
+                              tol=-1.0, covariance_type="tied")
+    sharded = streamed_gmm_fit(batches, 3, 2, init=means_init, max_iters=20,
+                               tol=-1.0, covariance_type="tied",
+                               mesh=make_mesh(8))
+    np.testing.assert_allclose(np.asarray(single.means),
+                               np.asarray(sharded.means),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.variances),
+                               np.asarray(sharded.variances),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_mesh_full_still_rejected(aniso_blobs):
+    x, _, _ = aniso_blobs
+    with pytest.raises(ValueError, match="full"):
+        gmm_fit(x[:992], 3, covariance_type="full", mesh=make_mesh(8))
