@@ -575,8 +575,10 @@ def test_cli_bisecting_kmeans(tmp_path):
 
 
 def test_cli_bisecting_rejects_streamed_and_shard(tmp_path):
+    # --num_batches is now the streamed bisecting path (round-4); only the
+    # genuinely unsupported combinations must still fail fast.
     p = build_parser()
-    for extra in ("--num_batches=4", "--shard_k=2 --n_GPUs=4",
+    for extra in ("--shard_k=2 --n_GPUs=4",
                   "--kernel=pallas", "--spherical", "--init=random",
                   "--history_file=h.csv"):
         args = p.parse_args(
@@ -631,3 +633,19 @@ def test_cli_rejects_pallas_with_weight_file(tmp_path):
     )
     with pytest.raises(SystemExit):
         validate_args(p, args)
+
+
+def test_cli_streamed_bisecting(tmp_path):
+    """--num_batches with bisectingKMeans runs the streamed splits
+    (round-3 VERDICT weak #5: the gate used to reject it)."""
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=1200 --n_dim=2 --K=4 --n_max_iters=10 --seed=5 "
+        f"--log_file={log} --n_GPUs=1 --num_batches=3 "
+        f"--method_name=bisectingKMeans".split()
+    )
+    assert rc == 0
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["status"] == "ok"
+    assert int(row["num_batches"]) == 3
+    assert float(row["sse"]) > 0
