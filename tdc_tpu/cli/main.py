@@ -185,10 +185,39 @@ def validate_args(parser, args):
     if args.shard_k > 1:
         if args.K % args.shard_k != 0:
             parser.error(f"--K={args.K} not divisible by --shard_k={args.shard_k}")
-        if args.method_name != "distributedKMeans":
-            parser.error("--shard_k supports distributedKMeans only")
+        if args.method_name not in ("distributedKMeans",
+                                    "distributedFuzzyCMeans",
+                                    "gaussianMixture"):
+            parser.error("--shard_k supports distributedKMeans, "
+                         "distributedFuzzyCMeans, and gaussianMixture")
         if args.minibatch:
             parser.error("--minibatch and --shard_k are mutually exclusive")
+        if args.method_name != "distributedKMeans":
+            # The K-sharded fuzzy/GMM towers are in-memory f32 XLA steps;
+            # only the Lloyd tower has streamed / Pallas / bf16 / ckpt /
+            # history sharded paths so far. Reject rather than silently
+            # ignore, per the CLI's standing rule.
+            if args.streamed or args.num_batches > 1:
+                parser.error("--shard_k streaming is distributedKMeans only")
+            if args.kernel == "pallas":
+                parser.error("--shard_k --kernel=pallas is "
+                             "distributedKMeans only (the fuzzy/GMM shard "
+                             "towers are XLA matmul steps)")
+            if args.ckpt_dir or args.ckpt_every_batches:
+                parser.error("--shard_k checkpointing is distributedKMeans "
+                             "only")
+            if args.history_file:
+                parser.error("--shard_k --history_file is distributedKMeans "
+                             "only (the fuzzy/GMM shard towers record no "
+                             "per-iteration history)")
+            if args.dtype == "bfloat16":
+                parser.error("--shard_k --dtype=bfloat16 is "
+                             "distributedKMeans only (the fuzzy/GMM shard "
+                             "towers run f32)")
+            if args.method_name == "gaussianMixture" and args.init == "kmeans":
+                parser.error("--shard_k gaussianMixture seeds from a host "
+                             "subsample; --init=kmeans (a full K-Means "
+                             "pre-fit) is the unsharded mode")
     if args.minibatch and args.method_name != "distributedKMeans":
         parser.error("--minibatch supports distributedKMeans only")
     if args.minibatch and args.kernel is not None:
@@ -201,8 +230,8 @@ def validate_args(parser, args):
             if getattr(args, flag):
                 parser.error(f"--{flag} is not supported with gaussianMixture")
 
-        if args.shard_k > 1:
-            parser.error("gaussianMixture has no sharded-K mode")
+        if args.shard_k > 1 and args.covariance_type != "diag":
+            parser.error("--shard_k gaussianMixture is diag-covariance only")
         if args.ckpt_every_batches:
             parser.error("gaussianMixture checkpoints per iteration only "
                          "(--ckpt_every_batches is kmeans/fuzzy)")
@@ -536,20 +565,42 @@ def run_experiment(args) -> dict:
                 reassignment_ratio=args.reassignment_ratio,
                 ckpt_dir=args.ckpt_dir,
             )
+        def shard_block(rows_per_pass: int) -> int:
+            """N-block for the K-sharded towers: --block_rows, or the
+            auto size bounding the per-(data-shard, K-shard) intermediates
+            (the towers pad ragged shards to the block multiple exactly)."""
+            from tdc_tpu.models.kmeans import auto_block_rows
+
+            if args.block_rows >= 0:
+                return args.block_rows
+            n_data_ax = n_devices // args.shard_k
+            return auto_block_rows(
+                -(-rows_per_pass // n_data_ax), args.K // args.shard_k
+            )
+
+        if mesh2d is not None and args.method_name == "distributedFuzzyCMeans":
+            from tdc_tpu.parallel.sharded_k import fuzzy_fit_sharded
+
+            return fuzzy_fit_sharded(
+                host_points(), args.K, mesh2d, m=args.fuzzifier,
+                init=args.init, key=key, max_iters=args.n_max_iters,
+                tol=args.tol, block_rows=shard_block(n_obs),
+            )
+        if mesh2d is not None and args.method_name == "gaussianMixture":
+            from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
+
+            return gmm_fit_sharded(
+                host_points(), args.K, mesh2d, init=args.init, key=key,
+                max_iters=args.n_max_iters, tol=args.tol,
+                block_rows=shard_block(n_obs),
+            )
         if mesh2d is not None:
             # K-sharded 2-D layout: always the streamed driver — it subsumes
             # the in-memory case (one batch) and pads ragged batches exactly.
-            from tdc_tpu.models.kmeans import auto_block_rows
             from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
 
             rows = -(-n_obs // num_batches)
-            n_data_ax = n_devices // args.shard_k
-            if args.block_rows < 0:
-                block = auto_block_rows(
-                    -(-rows // n_data_ax), args.K // args.shard_k
-                )
-            else:
-                block = args.block_rows
+            block = shard_block(rows)
             return streamed_kmeans_fit_sharded(
                 make_stream(rows), args.K, n_dim, mesh2d,
                 init=args.init, key=key, max_iters=args.n_max_iters,

@@ -323,6 +323,363 @@ def kmeans_fit_sharded(
     )
 
 
+def _pad_rows_sharded(x, n_data: int, block_rows: int):
+    """(padded x, n_pad): zero-pad rows to the n_data x block multiple the
+    sharded towers require (they hard-raise on ragged shards); callers
+    remove the padding's exact contribution."""
+    multiple = n_data * max(block_rows, 1)
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, 0
+    if isinstance(x, np.ndarray):
+        return np.pad(x, ((0, rem), (0, 0))), rem
+    return jnp.pad(x, ((0, rem), (0, 0))), rem
+
+
+def make_sharded_fuzzy_stats(
+    mesh: Mesh, m: float = 2.0, eps: float = 1e-9, block_rows: int = 0
+):
+    """K-sharded fuzzy c-means sufficient stats (round-3 VERDICT item 5):
+    jit-able fn(x, c) → (weighted_sums, weights, objective) with x sharded
+    (data,), c sharded (model,); wsums/weights stay K-sharded, objective
+    replicated.
+
+    The only cross-shard quantity is the per-point membership normalizer
+    Σ_K (d²+eps)^(-1/(m-1)) — a (block, 1) psum over the model axis (the
+    fuzzy analog of the Lloyd tower's champion all_gather); every other
+    term is local to its K-shard. The reference's fuzzy tower
+    (scripts/distribuitedClustering.py:117-148) materialized the full
+    (N, K) membership matrix per GPU — here no shard ever holds more than
+    (block, K/Pm)."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None)),
+        out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P()),
+        check_vma=False,
+    )
+    def stats(x_loc, c_loc):
+        n_loc, d = x_loc.shape
+        k_per = c_loc.shape[0]
+
+        def block(x_blk):
+            d2 = pairwise_sq_dist(x_blk, c_loc)  # (b, K/Pm)
+            inv = (d2 + eps) ** (-1.0 / (m - 1.0))
+            s = jax.lax.psum(
+                jnp.sum(inv, axis=1, keepdims=True), MODEL_AXIS
+            )  # (b, 1) — global normalizer
+            u = inv / s
+            mu = u**m
+            wsums = jax.lax.dot_general(
+                mu,
+                x_blk.astype(jnp.float32),
+                (((0,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )  # (K/Pm, d)
+            return wsums, jnp.sum(mu, axis=0), jnp.sum(mu * d2)
+
+        if block_rows and n_loc > block_rows:
+            if n_loc % block_rows != 0:
+                raise ValueError(
+                    f"local shard rows {n_loc} not divisible by "
+                    f"block_rows={block_rows}"
+                )
+            xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
+
+            def body(acc, blk):
+                ws, w, o = block(blk)
+                return (acc[0] + ws, acc[1] + w, acc[2] + o), None
+
+            zero = (
+                jnp.zeros((k_per, d), jnp.float32),
+                jnp.zeros((k_per,), jnp.float32),
+                jnp.zeros((), jnp.float32),
+            )
+            (wsums, weights, obj), _ = jax.lax.scan(body, zero, xb)
+        else:
+            wsums, weights, obj = block(x_loc)
+        wsums = jax.lax.psum(wsums, DATA_AXIS)
+        weights = jax.lax.psum(weights, DATA_AXIS)
+        # The objective sums over K too: reduce over BOTH axes.
+        obj = jax.lax.psum(jax.lax.psum(obj, DATA_AXIS), MODEL_AXIS)
+        return wsums, weights, obj
+
+    return stats
+
+
+def fuzzy_fit_sharded(
+    x,
+    k: int,
+    mesh: Mesh,
+    *,
+    m: float = 2.0,
+    init,
+    key=None,
+    max_iters: int = 20,
+    tol: float = 1e-4,
+    block_rows: int = 0,
+):
+    """Fuzzy C-Means with points sharded over 'data' and centroids over
+    'model' — the large-K regime for the reference's fastest algorithm.
+    Same layout/init contract as kmeans_fit_sharded."""
+    from tdc_tpu.models.fuzzy import FuzzyCMeansResult
+
+    n_data = mesh.devices.shape[0]
+    n_model = mesh.devices.shape[1]
+    if not isinstance(x, np.ndarray):
+        x = jnp.asarray(x)
+    if k % n_model != 0:
+        raise ValueError(f"K={k} not divisible by model axis {n_model}")
+    if m <= 1.0:
+        raise ValueError(f"fuzzifier m must be > 1, got {m}")
+    eps = 1e-9
+    c = _resolve_init_sharded(x, k, init, key)
+    x, n_pad = _pad_rows_sharded(x, n_data, block_rows)
+    x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
+    c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    stats_fn = make_sharded_fuzzy_stats(mesh, m, eps, block_rows=block_rows)
+
+    @jax.jit
+    def step(x, c):
+        wsums, weights, obj = stats_fn(x, c)
+        if n_pad:
+            # Exact zero-row correction (the soft analog of
+            # padding_correction): a zero row's memberships depend only on
+            # the centroid norms — u0 ∝ (‖c‖²+eps)^(-1/(m-1)) — adding u0^m
+            # to the weights and u0^m·‖c‖² to the objective, nothing to Σx.
+            # Computable from the K-sharded (K,) norm vector directly.
+            c2 = jnp.sum(c**2, axis=-1)
+            inv0 = (c2 + eps) ** (-1.0 / (m - 1.0))
+            u0 = inv0 / jnp.sum(inv0)
+            mu0 = u0**m
+            weights = weights - n_pad * mu0
+            obj = obj - n_pad * jnp.sum(mu0 * c2)
+        new_c = wsums / jnp.maximum(weights[:, None], 1e-12)
+        shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
+        return new_c, shift, obj
+
+    shift = float("inf")
+    n_iter = 0
+    converged = False
+    for n_iter in range(1, max_iters + 1):
+        c, shift_dev, _ = step(x, c)
+        shift = float(shift_dev)
+        if tol >= 0 and shift <= tol:
+            converged = True
+            break
+    _, _, obj = step(x, c)  # objective of the RETURNED centroids
+    return FuzzyCMeansResult(
+        centroids=c,
+        n_iter=jnp.asarray(n_iter, jnp.int32),
+        objective=jnp.asarray(float(obj), jnp.float32),
+        shift=jnp.asarray(shift, jnp.float32),
+        converged=jnp.asarray(converged),
+    )
+
+
+def make_sharded_gmm_stats(mesh: Mesh, block_rows: int = 0):
+    """K-sharded diag-GMM E-step sufficient stats (round-3 VERDICT item 5):
+    jit-able fn(x, means, variances, weights) → (ll_sum, nk, sx, sxx) with
+    x sharded (data,) and all component parameters sharded (model,);
+    nk/sx/sxx stay K-sharded, ll_sum replicated.
+
+    The cross-shard quantity is the per-point log-normalizer: a pmax over
+    the model axis for the stable max, then a psum of Σ exp(logp − max) —
+    a distributed logsumexp, the soft analog of the Lloyd champion
+    reduction. Responsibilities and moments stay local per K-shard."""
+    from tdc_tpu.models.gmm import _LOG_2PI
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None), P(MODEL_AXIS, None), P(MODEL_AXIS, None),
+            P(MODEL_AXIS),
+        ),
+        out_specs=(
+            P(), P(MODEL_AXIS), P(MODEL_AXIS, None), P(MODEL_AXIS, None)
+        ),
+        check_vma=False,
+    )
+    def stats(x_loc, means_loc, var_loc, w_loc):
+        n_loc, d = x_loc.shape
+        k_per = means_loc.shape[0]
+        inv = 1.0 / var_loc
+        log_det = jnp.sum(jnp.log(var_loc), axis=1)  # (K/Pm,)
+        log_w = jnp.log(w_loc)
+
+        def block(x_blk):
+            xf = x_blk.astype(jnp.float32)
+            xsq = xf * xf
+            maha = (
+                xsq @ inv.T
+                - 2.0 * (xf @ (means_loc * inv).T)
+                + jnp.sum(means_loc**2 * inv, axis=1)[None, :]
+            )  # (b, K/Pm)
+            logp = (
+                -0.5 * (maha + log_det[None, :] + d * _LOG_2PI)
+                + log_w[None, :]
+            )
+            mx = jax.lax.pmax(
+                jnp.max(logp, axis=1, keepdims=True), MODEL_AXIS
+            )  # (b, 1) — global max
+            se = jax.lax.psum(
+                jnp.sum(jnp.exp(logp - mx), axis=1, keepdims=True),
+                MODEL_AXIS,
+            )
+            norm = mx + jnp.log(se)  # (b, 1) — global logsumexp
+            r = jnp.exp(logp - norm)  # (b, K/Pm) — local responsibilities
+            nk = jnp.sum(r, axis=0)
+            sx = jax.lax.dot_general(
+                r, xf, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            sxx = jax.lax.dot_general(
+                r, xsq, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return jnp.sum(norm), nk, sx, sxx
+
+        if block_rows and n_loc > block_rows:
+            if n_loc % block_rows != 0:
+                raise ValueError(
+                    f"local shard rows {n_loc} not divisible by "
+                    f"block_rows={block_rows}"
+                )
+            xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
+
+            def body(acc, blk):
+                ll, nk, sx, sxx = block(blk)
+                return (acc[0] + ll, acc[1] + nk, acc[2] + sx,
+                        acc[3] + sxx), None
+
+            zero = (
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((k_per,), jnp.float32),
+                jnp.zeros((k_per, d), jnp.float32),
+                jnp.zeros((k_per, d), jnp.float32),
+            )
+            (ll, nk, sx, sxx), _ = jax.lax.scan(body, zero, xb)
+        else:
+            ll, nk, sx, sxx = block(x_loc)
+        # norm is identical on every model shard (already globally reduced),
+        # so ll only reduces over the data axis.
+        ll = jax.lax.psum(ll, DATA_AXIS)
+        nk = jax.lax.psum(nk, DATA_AXIS)
+        sx = jax.lax.psum(sx, DATA_AXIS)
+        sxx = jax.lax.psum(sxx, DATA_AXIS)
+        return ll, nk, sx, sxx
+
+    return stats
+
+
+def gmm_fit_sharded(
+    x,
+    k: int,
+    mesh: Mesh,
+    *,
+    init="kmeans++",
+    key=None,
+    max_iters: int = 100,
+    tol: float = 1e-3,
+    reg_covar: float = 1e-6,
+    block_rows: int = 0,
+):
+    """Diag-covariance GMM EM with points sharded over 'data' and components
+    sharded over 'model'. Seeding mirrors _resolve_init_sharded (host
+    subsample); variances start at the subsample's per-dimension variance,
+    weights uniform. Convergence: mean per-point log-likelihood gain ≤ tol
+    (sklearn's lower_bound_ criterion)."""
+    from tdc_tpu.models.gmm import GMMResult
+
+    from tdc_tpu.models.gmm import _LOG_2PI
+
+    n_data = mesh.devices.shape[0]
+    n_model = mesh.devices.shape[1]
+    if not isinstance(x, np.ndarray):
+        x = jnp.asarray(x)
+    n = x.shape[0]
+    if k % n_model != 0:
+        raise ValueError(f"K={k} not divisible by model axis {n_model}")
+    if isinstance(init, str) and init == "kmeans":
+        raise ValueError(
+            "gmm_fit_sharded seeds from a host subsample "
+            "(_resolve_init_sharded); init='kmeans' (a full K-Means pre-fit) "
+            "is the unsharded gmm_fit's mode — pass 'kmeans++' or an array"
+        )
+    means = _resolve_init_sharded(x, k, init, key)
+    # Initial variances/weights from the hard assignment to the initial
+    # means (gmm_fit's _moments_from_hard_assign — a loose global-variance
+    # init lets early E-steps merge separated components), computed on the
+    # same deterministic host subsample the seeding uses: the init moments
+    # are a starting heuristic, and a full-data pass here would need the
+    # very (N, K) work the sharded layout exists to avoid.
+    from tdc_tpu.models.gmm import _moments_from_hard_assign
+
+    sample = jnp.asarray(np.asarray(x[: min(n, 65536)], np.float32))
+    variances, weights = _moments_from_hard_assign(sample, means, reg_covar)
+    x, n_pad = _pad_rows_sharded(x, n_data, block_rows)
+    x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
+    put_k = lambda a: jax.device_put(
+        a, NamedSharding(mesh, P(MODEL_AXIS) if a.ndim == 1
+                         else P(MODEL_AXIS, None))
+    )
+    means, variances, weights = map(put_k, (means, variances, weights))
+    stats_fn = make_sharded_gmm_stats(mesh, block_rows=block_rows)
+
+    @jax.jit
+    def step(x, means, variances, weights):
+        ll, nk, sx, sxx = stats_fn(x, means, variances, weights)
+        if n_pad:
+            # Exact zero-row correction: a zero row's log-prob is the
+            # x-independent bias term per component; it contributes its
+            # responsibilities to nk and its log-normalizer to ll, nothing
+            # to sx/sxx. Computed from the K-sharded parameter vectors.
+            d = x.shape[1]
+            logp0 = (
+                -0.5 * (
+                    jnp.sum(means**2 / variances, axis=1)
+                    + jnp.sum(jnp.log(variances), axis=1)
+                    + d * _LOG_2PI
+                )
+                + jnp.log(weights)
+            )
+            mx0 = jnp.max(logp0)
+            norm0 = mx0 + jnp.log(jnp.sum(jnp.exp(logp0 - mx0)))
+            nk = nk - n_pad * jnp.exp(logp0 - norm0)
+            ll = ll - n_pad * norm0
+        safe = jnp.maximum(nk, 1e-12)[:, None]
+        new_means = sx / safe
+        new_vars = jnp.maximum(sxx / safe - new_means**2, 0.0) + reg_covar
+        new_w = jnp.maximum(nk / n, 1e-12)
+        new_w = new_w / jnp.sum(new_w)
+        return ll / n, new_means, new_vars, new_w
+
+    prev_ll = -float("inf")
+    ll = prev_ll
+    n_iter = 0
+    converged = False
+    for n_iter in range(1, max_iters + 1):
+        ll_dev, means, variances, weights = step(x, means, variances, weights)
+        ll = float(ll_dev)
+        if n_iter > 1 and ll - prev_ll <= tol:
+            converged = True
+            break
+        prev_ll = ll
+    return GMMResult(
+        means=means,
+        variances=variances,
+        weights=weights,
+        log_likelihood=jnp.asarray(ll, jnp.float32),
+        n_iter=jnp.asarray(n_iter, jnp.int32),
+        converged=jnp.asarray(converged),
+        covariance_type="diag",
+    )
+
+
 class _ShardedAcc(NamedTuple):
     sums: jax.Array  # (K, d) — K-sharded
     counts: jax.Array  # (K,) — K-sharded

@@ -267,3 +267,126 @@ def test_sharded_resume_nothing_left_reports_faithfully(data, tmp_path):
     np.testing.assert_array_equal(
         np.asarray(again.centroids), np.asarray(first.centroids)
     )
+
+
+class TestShardedFuzzyGMM:
+    """K-sharded fuzzy / GMM towers (round-3 VERDICT item 5): the 2-D
+    (data x model) layout must match the unsharded fits — the cross-shard
+    collectives are a psum'd membership normalizer (fuzzy) and a
+    distributed logsumexp (GMM)."""
+
+    def test_fuzzy_sharded_matches_unsharded(self, data):
+        from tdc_tpu.models import fuzzy_cmeans_fit
+        from tdc_tpu.parallel.sharded_k import fuzzy_fit_sharded
+
+        init = data[:8]
+        full = fuzzy_cmeans_fit(data, 8, m=2.0, init=init, max_iters=15,
+                                tol=-1.0)
+        sh = fuzzy_fit_sharded(data, 8, make_mesh_2d(2, 4), m=2.0,
+                               init=init, max_iters=15, tol=-1.0)
+        np.testing.assert_allclose(
+            np.asarray(sh.centroids), np.asarray(full.centroids),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            float(sh.objective), float(full.objective), rtol=1e-4
+        )
+
+    def test_fuzzy_sharded_blocked_matches(self, data):
+        from tdc_tpu.parallel.sharded_k import fuzzy_fit_sharded
+
+        init = data[:8]
+        a = fuzzy_fit_sharded(data, 8, make_mesh_2d(2, 4), init=init,
+                              max_iters=8, tol=-1.0)
+        b = fuzzy_fit_sharded(data, 8, make_mesh_2d(2, 4), init=init,
+                              max_iters=8, tol=-1.0, block_rows=100)
+        np.testing.assert_allclose(
+            np.asarray(a.centroids), np.asarray(b.centroids),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_gmm_sharded_matches_unsharded(self, data):
+        from tdc_tpu.models.gmm import gmm_fit
+        from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
+
+        init = data[:8]
+        full = gmm_fit(data, 8, init=init, max_iters=12, tol=-1.0)
+        sh = gmm_fit_sharded(data, 8, make_mesh_2d(2, 4), init=init,
+                             max_iters=12, tol=-1.0)
+        np.testing.assert_allclose(
+            np.asarray(sh.means), np.asarray(full.means),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sh.variances), np.asarray(full.variances),
+            rtol=1e-3, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            float(sh.log_likelihood), float(full.log_likelihood), rtol=1e-4
+        )
+
+    def test_gmm_sharded_blocked_matches(self, data):
+        from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
+
+        init = data[:8]
+        a = gmm_fit_sharded(data, 8, make_mesh_2d(2, 4), init=init,
+                            max_iters=6, tol=-1.0)
+        b = gmm_fit_sharded(data, 8, make_mesh_2d(2, 4), init=init,
+                            max_iters=6, tol=-1.0, block_rows=100)
+        np.testing.assert_allclose(
+            np.asarray(a.means), np.asarray(b.means), rtol=1e-5, atol=1e-5
+        )
+
+    def test_k_not_divisible_raises(self, data):
+        from tdc_tpu.parallel.sharded_k import (
+            fuzzy_fit_sharded,
+            gmm_fit_sharded,
+        )
+
+        with pytest.raises(ValueError, match="divisible"):
+            fuzzy_fit_sharded(data, 9, make_mesh_2d(2, 4), init="first_k")
+        with pytest.raises(ValueError, match="divisible"):
+            gmm_fit_sharded(data, 9, make_mesh_2d(2, 4), init="first_k")
+
+    def test_fuzzy_sharded_ragged_n_pads_exactly(self, data):
+        """N not divisible by the data axis: zero-pad + the soft zero-row
+        correction must reproduce the unsharded fit on the same rows."""
+        from tdc_tpu.models import fuzzy_cmeans_fit
+        from tdc_tpu.parallel.sharded_k import fuzzy_fit_sharded
+
+        x = data[:1597]  # prime-ish: 1597 % 2 != 0
+        init = x[:8]
+        full = fuzzy_cmeans_fit(x, 8, m=2.0, init=init, max_iters=10,
+                                tol=-1.0)
+        sh = fuzzy_fit_sharded(x, 8, make_mesh_2d(2, 4), m=2.0, init=init,
+                               max_iters=10, tol=-1.0, block_rows=100)
+        np.testing.assert_allclose(
+            np.asarray(sh.centroids), np.asarray(full.centroids),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            float(sh.objective), float(full.objective), rtol=1e-4
+        )
+
+    def test_gmm_sharded_ragged_n_pads_exactly(self, data):
+        from tdc_tpu.models.gmm import gmm_fit
+        from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
+
+        x = data[:1597]
+        init = x[:8]
+        full = gmm_fit(x, 8, init=init, max_iters=8, tol=-1.0)
+        sh = gmm_fit_sharded(x, 8, make_mesh_2d(2, 4), init=init,
+                             max_iters=8, tol=-1.0, block_rows=100)
+        np.testing.assert_allclose(
+            np.asarray(sh.means), np.asarray(full.means),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            float(sh.log_likelihood), float(full.log_likelihood), rtol=1e-4
+        )
+
+    def test_gmm_sharded_rejects_kmeans_init(self, data):
+        from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
+
+        with pytest.raises(ValueError, match="kmeans"):
+            gmm_fit_sharded(data, 8, make_mesh_2d(2, 4), init="kmeans")
