@@ -42,7 +42,7 @@ def main():
     centers_key, key = jax.random.split(key)
     n_batches = args.n_total // args.batch_rows
 
-    key, k0 = jax.random.split(key)
+    key, k0, k_step = jax.random.split(key, 3)
     first = make_batch(k0, centers_key, args.batch_rows, args.d)
     c0 = init_kmeans_pp(key, first, args.K)
     state = MiniBatchState(
@@ -50,13 +50,14 @@ def main():
         counts=jnp.zeros((args.K,), jnp.float32),
         step=jnp.asarray(0, jnp.int32),
         last_sse=jnp.asarray(jnp.inf, jnp.float32),
+        key=k_step,  # drives the sklearn-style low-count reassignment
     )
 
     t0 = time.perf_counter()
     for i in range(n_batches):
         key, kb = jax.random.split(key)
         batch = make_batch(kb, centers_key, args.batch_rows, args.d)
-        state = minibatch_step(state, batch)
+        state = minibatch_step(state, batch, reassignment_ratio=0.01)
     np.asarray(state.centroids)  # true sync (tunnel-safe)
     dt = time.perf_counter() - t0
     seen = n_batches * args.batch_rows
