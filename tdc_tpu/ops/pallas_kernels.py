@@ -471,7 +471,13 @@ def fuzzy_stats_fused(
                 "fuzzy_stats_auto / ops.assign.fuzzy_stats_padded_blocked"
             )
     if halves is None:
-        halves = 4 if block_n % 512 == 0 else (2 if block_n % 256 == 0 else 1)
+        # Same policy as lloyd_stats_fused (round-3 advisor): auto-enable
+        # the sub-block interleave only at the hardware-validated block —
+        # 1024 is what fused_block_n picks at the K=1024·d=128 bench shape,
+        # where halves=4 was measured on v5e (142.5 M pt·iter/s, RESULTS.md).
+        # Other blocks keep the strictly sequential kernel rather than
+        # turning on scheduling configs no sweep has exercised.
+        halves = 4 if block_n == 1024 else 1
     elif block_n % halves:
         raise ValueError(
             f"fuzzy_stats_fused: halves={halves} must divide "
