@@ -136,7 +136,7 @@ def lloyd_stats_sorted(
     centroids: jax.Array,
     *,
     block_n: int = 1024,
-    block_k: int = 512,
+    block_k: int | None = None,
     sort_block: int = 512,
     interpret: bool | None = None,
 ):
@@ -152,6 +152,16 @@ def lloyd_stats_sorted(
     from tdc_tpu.ops.assign import SufficientStats
     from tdc_tpu.ops.pallas_kernels import distance_argmin
 
+    if block_k is None:
+        # 1024-wide K-tiles measured 7% faster than 512 in the large-K
+        # regime this path serves — VMEM-gated so large-d shapes that only
+        # compiled at 512 keep compiling (same chooser as the sharded tower).
+        from tdc_tpu.ops.pallas_kernels import argmin_block_k
+
+        block_k = argmin_block_k(
+            centroids.shape[0], x.shape[1], x.dtype.itemsize,
+            block_n=block_n,
+        )
     arg, mind = distance_argmin(
         x,
         centroids,

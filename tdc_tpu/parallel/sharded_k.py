@@ -60,11 +60,11 @@ def _block_champions(x_blk, c_loc, kernel: str):
     k_per = c_loc.shape[0]
     m_idx = jax.lax.axis_index(MODEL_AXIS)
     if kernel == "pallas":
-        from tdc_tpu.ops.pallas_kernels import distance_argmin
+        from tdc_tpu.ops.pallas_kernels import argmin_block_k, distance_argmin
 
         # 1024-wide K-tiles measured 7% faster than the 512 default at the
-        # K=16,384·d=768 regime (80% vs 74% MFU) and stay within VMEM.
-        blk_k = 1024 if k_per >= 1024 else 512
+        # K=16,384·d=768 regime (80% vs 74% MFU); VMEM-gated per dtype/d.
+        blk_k = argmin_block_k(k_per, x_blk.shape[1], x_blk.dtype.itemsize)
         arg, lmin = distance_argmin(
             x_blk, c_loc, block_k=blk_k, return_dist=True
         )
@@ -724,6 +724,7 @@ def streamed_kmeans_fit_sharded(
     """
     from tdc_tpu.models.streaming import (
         _StreamCheckpointer,
+        _history_array,
         _mesh_layout,
         _run_pass,
     )
@@ -862,15 +863,21 @@ def streamed_kmeans_fit_sharded(
                         rows0=resume_rows)
         resume_cursor, resume_acc, resume_rows = 0, None, 0
         c, shift_dev = update(acc, c)
-        shift = float(shift_dev)
-        history.append((float(acc.sse), shift))
-        done = tol >= 0 and shift <= tol
+        # Same deferred-sync rule as streamed_kmeans_fit: only the
+        # convergence test / checkpoint metadata justify a per-iteration
+        # device fetch (a round trip costs ~10x the iteration's dispatch on
+        # remote links).
+        sync = tol >= 0 or ckpt_dir is not None
+        shift = float(shift_dev) if sync else shift_dev
+        history.append((float(acc.sse) if sync else acc.sse, shift))
+        done = sync and tol >= 0 and shift <= tol
         if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
                                      or n_iter == max_iters):
             ckpt.save(n_iter, c, shift, history)
         if done:
             converged = True
             break
+    shift = float(shift)  # one deferred fetch on the async path
     # Extra stats pass: report the SSE of the returned centroids, not the
     # pre-update ones (parity with streamed_kmeans_fit).
     sse = float(full_pass(c).sse)
@@ -880,6 +887,6 @@ def streamed_kmeans_fit_sharded(
         sse=jnp.asarray(sse, jnp.float32),
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(converged),
-        history=np.asarray(history, np.float32),
+        history=_history_array(history),
         n_iter_run=n_iter - start_iter,
     )

@@ -60,3 +60,21 @@ def test_seed_isolation(blobs_small):
     r1 = kmeans_fit(x, 4, init="kmeans++", key=jax.random.PRNGKey(0), max_iters=1, tol=-1.0)
     r2 = kmeans_fit(x, 4, init="kmeans++", key=jax.random.PRNGKey(1), max_iters=1, tol=-1.0)
     assert not np.allclose(np.asarray(r1.centroids), np.asarray(r2.centroids))
+
+
+def test_sorted_stats_bitwise_deterministic():
+    """The sort-based segment-sum (round 4) must be bitwise-reproducible
+    run to run: the stable sort fixes the accumulation order, so repeated
+    evaluation on identical inputs yields identical f32 sums (the property
+    the dense one-hot contraction had by construction)."""
+    import jax.numpy as jnp
+
+    from tdc_tpu.ops.sorted_stats import sorted_cluster_stats
+
+    rng = np.random.default_rng(42)
+    x = jnp.asarray(rng.normal(size=(4096, 24)).astype(np.float32))
+    lab = jnp.asarray(rng.integers(0, 257, size=4096).astype(np.int32))
+    s1, c1 = sorted_cluster_stats(x, lab, 257)
+    s2, c2 = sorted_cluster_stats(x, lab, 257)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
