@@ -26,12 +26,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tdc_tpu.parallel.sharded_k import make_mesh_2d, make_sharded_lloyd_step
+from tdc_tpu.parallel.sharded_k import (
+    make_mesh_2d,
+    make_sharded_lloyd_step,
+    sum_sq,
+)
 
 BASE_RATE = 22.2e6 * (3 * 5)  # reference best per-GPU rate x (K*d) it ran at
 
 
-def measure(step, x, c, iters_short=13, iters_long=43, repeats=3):
+def measure(step, x, c, x2sum, iters_short=13, iters_long=43, repeats=3):
     """Per-iteration seconds from the slope between per-length MIN times
     (constant dispatch/fetch overhead cancels; see bench.py timing notes).
     Tunnel hiccups only ever ADD time, so min-per-length is the robust
@@ -48,7 +52,7 @@ def measure(step, x, c, iters_short=13, iters_long=43, repeats=3):
         ci = c
         t0 = time.perf_counter()
         for _ in range(iters):
-            ci, _, _ = step(x, ci, x.shape[0])
+            ci, _, _ = step(x, ci, x.shape[0], x2sum)
         np.asarray(ci)  # true sync: D2H fetch
         return time.perf_counter() - t0
 
@@ -67,8 +71,9 @@ def run(tag, mesh, n, k, d, kernel, block_rows):
     x = jax.device_put(x, NamedSharding(mesh, P("data", None)))
     c = jax.device_put(c, NamedSharding(mesh, P("model", None)))
     step = make_sharded_lloyd_step(mesh, kernel=kernel, block_rows=block_rows)
-    np.asarray(step(x, c, x.shape[0])[0])  # compile + warm
-    per_iter = measure(step, x, c)
+    x2sum = sum_sq(x)  # once per fit, exactly as kmeans_fit_sharded does
+    np.asarray(step(x, c, x.shape[0], x2sum)[0])  # compile + warm
+    per_iter = measure(step, x, c, x2sum)
     value = n / per_iter
     base = BASE_RATE / (k * d)
     print(

@@ -358,6 +358,19 @@ def run_experiment(args) -> dict:
     if args.backend:
         import jax
         jax.config.update("jax_platforms", args.backend)
+    else:
+        # A machine sitecustomize may pre-import jax and pin jax_platforms
+        # before the environment is consulted, silently ignoring an explicit
+        # JAX_PLATFORMS (e.g. the CPU-mesh drive recipe). Re-assert it —
+        # the same dance as bench.py and __graft_entry__.dryrun_multichip.
+        env_platforms = os.environ.get("JAX_PLATFORMS")
+        if env_platforms:
+            import jax
+            try:
+                if jax.config.jax_platforms != env_platforms:
+                    jax.config.update("jax_platforms", env_platforms)
+            except Exception:
+                pass
     import jax
 
     if args.cache_dir:

@@ -23,6 +23,7 @@ def pairwise_sq_dist(
     *,
     precision: jax.lax.Precision | None = None,
     center: bool = False,
+    shifted: bool = False,
 ) -> jax.Array:
     """Squared Euclidean distance between every point and every centroid.
 
@@ -42,10 +43,16 @@ def pairwise_sq_dist(
       center: subtract the centroid mean from both operands before expanding
         (O((N+K)·d) extra work vs the O(N·K·d) matmul; worth it when
         ‖x‖ ≫ inter-cluster distances).
+      shifted: drop the row-constant ‖x‖² term (and the 0-clamp, which needs
+        it): returns ‖c‖² − 2x·c, whose per-row argmin is the same cluster
+        assignment without re-reading x for its norms. Used by the K-sharded
+        tower, which adds the iteration-invariant Σ‖x‖² back to the SSE once
+        per fit; matches the Pallas `distance_argmin` kernel's internal form.
 
     Returns:
       (N, K) squared distances, clamped at 0 (the expansion can go slightly
-      negative in floating point).
+      negative in floating point); with shifted=True, the unclamped shifted
+      values (which can be negative by construction).
     """
     x = jnp.asarray(x)
     centroids = jnp.asarray(centroids)
@@ -62,7 +69,6 @@ def pairwise_sq_dist(
             jax.lax.Precision.DEFAULT if bf16 else jax.lax.Precision.HIGHEST
         )
     # Norms in f32 regardless of input dtype (cheap: O(N*d), no K factor).
-    x_sq = jnp.sum(x.astype(jnp.float32) ** 2, axis=-1, keepdims=True)  # (N, 1)
     c_sq = jnp.sum(centroids.astype(jnp.float32) ** 2, axis=-1)  # (K,)
     # The MXU matmul. preferred_element_type keeps accumulation in f32 even if
     # inputs are bf16.
@@ -73,6 +79,9 @@ def pairwise_sq_dist(
         precision=precision,
         preferred_element_type=jnp.float32,
     )  # (N, K)
+    if shifted:
+        return c_sq - 2.0 * cross
+    x_sq = jnp.sum(x.astype(jnp.float32) ** 2, axis=-1, keepdims=True)  # (N, 1)
     d2 = x_sq - 2.0 * cross + c_sq
     return jnp.maximum(d2, 0.0)
 

@@ -79,7 +79,10 @@ def argmin_block_k(k: int, d: int, itemsize: int = 2, *, block_n: int = 1024,
     all `halves` cross buffers (block_n × bk f32, issued before any VPU
     work) + two live per-sub-block f32 temps. Otherwise keep the 512
     default, which is exactly the pre-upgrade behavior at every shape."""
-    if k < 1024:
+    if k < 1024 or block_n != 1024:
+        # The 1024-wide upgrade is only swept (and its halves=4 VMEM model
+        # only valid) at block_n=1024; other block_n values run halves=1,
+        # whose live temps the model below would under-count by 2×.
         return 512
     d_pad = -(-d // 128) * 128
     bk = 1024

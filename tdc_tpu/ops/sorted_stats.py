@@ -25,8 +25,12 @@ it runs identically on the CPU test mesh and inside shard_map towers.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def sorted_counts(sorted_labels: jax.Array, k: int) -> jax.Array:
@@ -36,12 +40,125 @@ def sorted_counts(sorted_labels: jax.Array, k: int) -> jax.Array:
     return (lo[1:] - lo[:-1]).astype(jnp.float32)
 
 
+def windowed_sort_block(
+    d: int, itemsize: int = 2, *, budget: int = 13 << 20
+) -> int:
+    """Largest sort block (512/256/128) whose windowed-kernel VMEM footprint
+    fits the derated scoped-vmem budget, or 0 when none does (route to the
+    lax.scan path). Model: double-buffered x tile + (B, 2B) one-hot +
+    (2B, d) f32 partial + two double-buffered (B, d) f32 accumulator tiles."""
+    d_pad = -(-d // 128) * 128
+    for b in (512, 256, 128):
+        vmem = (
+            2 * b * d_pad * itemsize  # x tile, double-buffered
+            + 2 * b * b * itemsize  # one-hot
+            + 2 * b * d_pad * 4  # (2B, d) partial
+            + 4 * b * d_pad * 4  # out0/out1 tiles, double-buffered
+        )
+        if vmem <= budget:
+            return b
+    return 0
+
+
+def _windowed_stats_kernel(wi_ref, x_ref, loc_ref, out0_ref, out1_ref, *, window, precision):
+    """One sorted B-row block → a (2W, d) one-hot matmul split across the two
+    W-row accumulator tiles its rank span can touch (wi[i] and wi[i]+1).
+
+    The window index sequence is nondecreasing and steps by at most 1 (a block
+    spans < B ≤ W ranks), so each output tile is visited in one contiguous run
+    of grid steps — exactly the revisiting pattern Pallas keeps resident in
+    VMEM between consecutive steps. Zero on first visit, accumulate after; the
+    wrapper masks the never-visited tiles (their HBM contents are undefined).
+    """
+    i = pl.program_id(0)
+    fresh = (i == 0) | (wi_ref[i] != wi_ref[jnp.maximum(i - 1, 0)])
+
+    @pl.when(fresh)
+    def _():
+        out0_ref[...] = jnp.zeros(out0_ref.shape, out0_ref.dtype)
+        out1_ref[...] = jnp.zeros(out1_ref.shape, out1_ref.dtype)
+
+    col = jax.lax.broadcasted_iota(jnp.int32, (x_ref.shape[0], 2 * window), 1)
+    oh = (loc_ref[...] == col).astype(x_ref.dtype)  # (B, 2W) block-local
+    part = jax.lax.dot_general(
+        oh,
+        x_ref[...],
+        (((0,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    )  # (2W, d): per-rank sums relative to tile wi[i]
+    out0_ref[...] += part[:window, :]
+    out1_ref[...] += part[window:, :]
+
+
+def _windowed_stats_pallas(
+    xs: jax.Array,
+    local: jax.Array,
+    wi: jax.Array,
+    cap: int,
+    *,
+    block: int,
+    interpret: bool,
+    precision,
+) -> jax.Array:
+    """(cap, d) f32 compact per-rank sums from block-sorted rows.
+
+    xs: (n_pad, d) rows in sorted-label order (n_pad a `block` multiple);
+    local: (n_pad, 1) int32 rank − wi[block]·W (∈ [0, 2W) by construction);
+    wi: (nb,) int32 accumulator tile index per block (nondecreasing, +≤1).
+
+    Replaces the lax.scan dynamic-slice window (17.6 ms DUS + 9 ms overhead
+    per step at N=2M·d=768 on v5e — benchmarks/ROOFLINE_SHARDED.md): each
+    tile is flushed to HBM once instead of read-modify-written per block.
+    """
+    n_pad, d = xs.shape
+    nb = n_pad // block
+    d_pad = -(-d // 128) * 128
+    if d_pad != d:
+        xs = jnp.pad(xs, ((0, 0), (0, d_pad - d)))
+    t_cover = -(-cap // block) + 2
+    out_shape = jax.ShapeDtypeStruct((t_cover * block, d_pad), jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block, d_pad), lambda i, wi_ref: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i, wi_ref: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, d_pad), lambda i, wi_ref: (wi_ref[i], 0)),
+            pl.BlockSpec((block, d_pad), lambda i, wi_ref: (wi_ref[i] + 1, 0)),
+        ],
+    )
+    out0, out1 = pl.pallas_call(
+        functools.partial(
+            _windowed_stats_kernel, window=block, precision=precision
+        ),
+        grid_spec=grid_spec,
+        out_shape=[out_shape, out_shape],
+        interpret=interpret,
+    )(wi, xs, local)
+
+    # Visited tiles: out0 covers [0, wi_last], out1 covers [1, wi_last+1]
+    # (wi starts at 0 and steps by ≤1, so no interior tile is skipped).
+    # Everything else is uninitialized HBM — mask before summing the halves.
+    row = jax.lax.broadcasted_iota(jnp.int32, (t_cover * block, 1), 0)
+    wi_last = wi[-1]
+    lo_valid = row < (wi_last + 1) * block
+    hi_valid = (row >= block) & (row < (wi_last + 2) * block)
+    compact = jnp.where(lo_valid, out0, 0.0) + jnp.where(hi_valid, out1, 0.0)
+    return compact[:cap, :d]
+
+
 def sorted_cluster_stats(
     x: jax.Array,
     labels: jax.Array,
     k: int,
     *,
     block: int = 512,
+    pallas: bool = False,
+    interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(Σx per cluster (k, d) f32, counts (k,) f32) from per-point labels.
 
@@ -55,8 +172,19 @@ def sorted_cluster_stats(
     compact accumulator window at the block's base rank (ranks are contiguous,
     so any B rows span < B ranks) → one final gather maps compact rows back to
     label space. Counts are read off the sorted labels with searchsorted.
+
+    pallas=True replaces the windowed-accumulate lax.scan with the Pallas
+    kernel (_windowed_stats_pallas): same math, but the accumulator tiles stay
+    resident in VMEM across the blocks that touch them instead of being
+    dynamic-slice read-modify-written per block (interpret auto-True off-TPU).
     """
     n, d = x.shape
+    if pallas:
+        fit = windowed_sort_block(d, x.dtype.itemsize)
+        if fit == 0:
+            pallas = False  # footprint infeasible at this d — scan path
+        else:
+            block = min(block, fit)
     labels = labels.astype(jnp.int32)
     # Clamp strays + pad to a block multiple with the sentinel label k (sorts
     # last; dropped by the final [:k] gather).
@@ -104,23 +232,33 @@ def sorted_cluster_stats(
     # every window write.
     cap = min(k + 1, n_pad) + block
 
-    def body(acc, inp):
-        xblk, lblk, b = inp
-        col = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
-        oh = (lblk[:, None] == col).astype(oh_dtype)  # (B, B) block-local
-        part = jax.lax.dot_general(
-            oh,
-            xblk,
-            (((0,), (0,)), ((), ())),
-            precision=precision,
-            preferred_element_type=jnp.float32,
-        )  # (B, d) per-local-rank sums
-        win = jax.lax.dynamic_slice(acc, (b, 0), (block, d))
-        return jax.lax.dynamic_update_slice(acc, win + part, (b, 0)), None
+    if pallas:
+        if interpret is None:
+            interpret = jax.devices()[0].platform != "tpu"
+        wi = (base // block).astype(jnp.int32)  # (nb,) tile index, +≤1 steps
+        loc_w = (rb - (wi * block)[:, None]).reshape(n_pad, 1)  # ∈ [0, 2B)
+        compact = _windowed_stats_pallas(
+            xmm, loc_w, wi, cap,
+            block=block, interpret=interpret, precision=precision,
+        )
+    else:
+        def body(acc, inp):
+            xblk, lblk, b = inp
+            col = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+            oh = (lblk[:, None] == col).astype(oh_dtype)  # (B, B) block-local
+            part = jax.lax.dot_general(
+                oh,
+                xblk,
+                (((0,), (0,)), ((), ())),
+                precision=precision,
+                preferred_element_type=jnp.float32,
+            )  # (B, d) per-local-rank sums
+            win = jax.lax.dynamic_slice(acc, (b, 0), (block, d))
+            return jax.lax.dynamic_update_slice(acc, win + part, (b, 0)), None
 
-    compact, _ = jax.lax.scan(
-        body, jnp.zeros((cap, d), jnp.float32), (xb, local, base)
-    )
+        compact, _ = jax.lax.scan(
+            body, jnp.zeros((cap, d), jnp.float32), (xb, local, base)
+        )
 
     # Map label j → its dense rank (first occurrence is at lo[j]); absent
     # labels point at the never-written top row and are zeroed explicitly.
@@ -170,7 +308,10 @@ def lloyd_stats_sorted(
         return_dist=True,
         interpret=interpret,
     )
+    # This function only serves the kernel='pallas' route, so the stats use
+    # the windowed Pallas accumulator too (VMEM-gated; scan fallback inside).
     sums, counts = sorted_cluster_stats(
-        x, arg, centroids.shape[0], block=sort_block
+        x, arg, centroids.shape[0], block=sort_block,
+        pallas=True, interpret=interpret,
     )
     return SufficientStats(sums=sums, counts=counts, sse=jnp.sum(mind))

@@ -50,12 +50,24 @@ def make_mesh_2d(n_data: int, n_model: int) -> Mesh:
     )
 
 
-def _block_champions(x_blk, c_loc, kernel: str):
+def _block_champions(x_blk, c_loc, kernel: str, shifted: bool = False):
     """Per-block global (min d², argmin) across all K shards.
 
     Each model shard scores the block against its local centroids, then the
     per-shard champions — two (Pm, block) arrays, not distances — cross ICI
     via all_gather for the global argmin.
+
+    shifted=True drops the row-constant ‖x‖² term from the reported min
+    distances — every shard shifts a given point by the same amount, so
+    cross-shard champion comparisons are unchanged. The caller adds the
+    iteration-invariant Σ‖x‖² back to the summed SSE once per fit instead of
+    re-reading all of x every iteration (4.3 ms/step at N=2M·d=768 on v5e).
+    Tie-break caveat: the exact XLA path clamps distances at 0, which can
+    collapse near-duplicate centroids' fp-noise-level distances into an
+    index-order tie; the shifted form compares the unclamped values instead
+    — the same semantics the Pallas `distance_argmin` kernel always had —
+    so assignments may differ on such degenerate pairs (either index is a
+    valid argmin).
     """
     k_per = c_loc.shape[0]
     m_idx = jax.lax.axis_index(MODEL_AXIS)
@@ -66,10 +78,12 @@ def _block_champions(x_blk, c_loc, kernel: str):
         # K=16,384·d=768 regime (80% vs 74% MFU); VMEM-gated per dtype/d.
         blk_k = argmin_block_k(k_per, x_blk.shape[1], x_blk.dtype.itemsize)
         arg, lmin = distance_argmin(
-            x_blk, c_loc, block_k=blk_k, return_dist=True
+            x_blk, c_loc, block_k=blk_k, return_dist=not shifted
         )
     else:
-        d2 = pairwise_sq_dist(x_blk, c_loc)  # (block, K/Pm)
+        # shifted=True drops the ‖x‖² term and the 0-clamp inside the shared
+        # helper (same dtype/precision policy either way).
+        d2 = pairwise_sq_dist(x_blk, c_loc, shifted=shifted)  # (block, K/Pm)
         lmin = jnp.min(d2, axis=1)
         arg = jnp.argmin(d2, axis=1).astype(jnp.int32)
     larg = arg + m_idx * k_per
@@ -84,7 +98,7 @@ def _block_champions(x_blk, c_loc, kernel: str):
     return gmin, garg
 
 
-def _block_stats(x_blk, c_loc, kernel: str):
+def _block_stats(x_blk, c_loc, kernel: str, shifted: bool = False):
     """(sums (K/Pm, d), counts (K/Pm,), sse ()) for one N-block — local to
     this (data, model) shard pair; data-psum'd by the caller.
 
@@ -99,13 +113,21 @@ def _block_stats(x_blk, c_loc, kernel: str):
 
     k_per = c_loc.shape[0]
     m_idx = jax.lax.axis_index(MODEL_AXIS)
-    gmin, garg = _block_champions(x_blk, c_loc, kernel)
+    gmin, garg = _block_champions(x_blk, c_loc, kernel, shifted)
     rel = garg - m_idx * k_per
-    sums, counts = sorted_cluster_stats(x_blk, rel, k_per)
+    # On the pallas route the windowed-accumulate runs as a Pallas kernel
+    # too (accumulator tiles stay VMEM-resident instead of DUS round-trips
+    # — benchmarks/ROOFLINE_SHARDED.md round-4 update).
+    sums, counts = sorted_cluster_stats(
+        x_blk, rel, k_per, pallas=(kernel == "pallas")
+    )
     return sums, counts, jnp.sum(gmin)
 
 
-def make_sharded_stats(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
+def make_sharded_stats(
+    mesh: Mesh, kernel: str = "xla", block_rows: int = 0,
+    shifted: bool = False,
+):
     """Returns a jit-able fn(x, c) → (sums, counts, sse): x sharded (data,),
     c sharded (model,); sums/counts stay K-sharded, sse replicated.
 
@@ -113,6 +135,9 @@ def make_sharded_stats(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
     per-shard intermediates never exceed O(block_rows · K/Pm) regardless of N
     (requires the local shard size to be a block_rows multiple — pad upstream
     with zero rows and correct via `padding_correction`).
+
+    shifted=True returns sse WITHOUT the Σ‖x‖² term (see _block_champions);
+    the caller must add it back.
     """
 
     @partial(
@@ -139,7 +164,7 @@ def make_sharded_stats(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
             xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
 
             def body(acc, blk):
-                s, ct, e = _block_stats(blk, c_loc, kernel)
+                s, ct, e = _block_stats(blk, c_loc, kernel, shifted)
                 return (acc[0] + s, acc[1] + ct, acc[2] + e), None
 
             zero = (
@@ -149,7 +174,7 @@ def make_sharded_stats(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
             )
             (sums, counts, sse), _ = jax.lax.scan(body, zero, xb)
         else:
-            sums, counts, sse = _block_stats(x_loc, c_loc, kernel)
+            sums, counts, sse = _block_stats(x_loc, c_loc, kernel, shifted)
         # Reduce over the data axis only; K stays sharded. The champions are
         # identical on every model shard, so sse comes out replicated.
         sums = jax.lax.psum(sums, DATA_AXIS)
@@ -158,6 +183,14 @@ def make_sharded_stats(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
         return sums, counts, sse
 
     return stats
+
+
+@jax.jit
+def sum_sq(x) -> jax.Array:
+    """Σ‖x‖² as an f32 scalar — the iteration-invariant SSE term, computed
+    once per fit and passed to the sharded step as `x2sum` (auto-sharded
+    reduce; zero-padding rows contribute zero)."""
+    return jnp.sum(jnp.square(x.astype(jnp.float32)))
 
 
 def padding_correction(counts, sse, centroids, n_pad):
@@ -178,12 +211,23 @@ def make_sharded_lloyd_step(
 ):
     """Returns a jit'd step: (x (data,)-sharded, c (model,)-sharded, n_valid)
     → (new_c (model,)-sharded, shift, sse). Zero-padding rows beyond n_valid
-    are corrected exactly."""
+    are corrected exactly.
+
+    Pass x2sum = Σ‖x‖² (a scalar, computed once per fit — `sum_sq`) to skip
+    the per-iteration ‖x‖² re-read: the distance pass then reports shifted
+    minima (identical argmin/ties) and the scalar is added back to the SSE.
+    Zero-padding rows contribute zero to x2sum, so the same value is valid
+    for any n_valid."""
     stats_fn = make_sharded_stats(mesh, kernel, block_rows)
+    stats_shifted = make_sharded_stats(mesh, kernel, block_rows, shifted=True)
 
     @jax.jit
-    def step(x, c, n_valid):
-        sums, counts, sse = stats_fn(x, c)
+    def step(x, c, n_valid, x2sum=None):
+        if x2sum is None:
+            sums, counts, sse = stats_fn(x, c)
+        else:
+            sums, counts, sse = stats_shifted(x, c)
+            sse = jnp.maximum(sse + x2sum, 0.0)
         n_pad = x.shape[0] - n_valid
         counts, sse = padding_correction(counts, sse, c, n_pad)
         cf = c.astype(jnp.float32)
@@ -230,13 +274,17 @@ def sharded_assign(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
                     f"block_rows={block_rows}"
                 )
             xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
+            # shifted=True: labels only — argmin is invariant to the
+            # row-constant ‖x‖² term, so skip its (N, d) re-read entirely.
             _, garg = jax.lax.scan(
-                lambda _, blk: (None, _block_champions(blk, c_loc, kernel)[1]),
+                lambda _, blk: (
+                    None, _block_champions(blk, c_loc, kernel, True)[1],
+                ),
                 None,
                 xb,
             )
             return garg.reshape(-1)
-        return _block_champions(x_loc, c_loc, kernel)[1]
+        return _block_champions(x_loc, c_loc, kernel, True)[1]
 
     return assign
 
@@ -298,12 +346,13 @@ def kmeans_fit_sharded(
     x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
     c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
     step = make_sharded_lloyd_step(mesh, kernel, block_rows, spherical)
+    x2sum = sum_sq(x)  # once per fit; the step then skips the ‖x‖² re-read
 
     shift = float("inf")
     n_iter = 0
     converged = False
     for n_iter in range(1, max_iters + 1):
-        c, shift_dev, _ = step(x, c, x.shape[0])
+        c, shift_dev, _ = step(x, c, x.shape[0], x2sum)
         shift = float(shift_dev)
         if tol >= 0 and shift <= tol:
             converged = True
@@ -313,7 +362,7 @@ def kmeans_fit_sharded(
     # against the pre-update centroids). step's SSE is computed against its
     # INPUT centroids, so re-invoking the already-compiled step and
     # discarding its update gives exactly that with no extra compile.
-    _, _, sse = step(x, c, x.shape[0])
+    _, _, sse = step(x, c, x.shape[0], x2sum)
     return KMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),

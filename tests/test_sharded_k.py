@@ -46,6 +46,27 @@ def test_sharded_fit_4x2(data):
     )
 
 
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_step_with_cached_sum_sq_matches_exact(data, kernel):
+    """step(..., x2sum) runs the shifted distance pass (no per-iteration
+    ‖x‖² re-read) and must return the same centroids, shift, and SSE as the
+    exact path — argmin and cross-shard ties are invariant to the shift."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tdc_tpu.parallel.sharded_k import make_sharded_lloyd_step, sum_sq
+
+    mesh = make_mesh_2d(2, 4)
+    x = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("data", None)))
+    c = jax.device_put(jnp.asarray(data[:8]), NamedSharding(mesh, P("model", None)))
+    step = make_sharded_lloyd_step(mesh, kernel=kernel)
+    c1, shift1, sse1 = step(x, c, x.shape[0])
+    c2, shift2, sse2 = step(x, c, x.shape[0], sum_sq(x))
+    np.testing.assert_allclose(
+        np.asarray(c1), np.asarray(c2), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(float(shift1), float(shift2), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(sse1), float(sse2), rtol=1e-4)
+
+
 def test_sharded_assign_matches_global(data):
     from tdc_tpu.ops.assign import assign_clusters
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -90,6 +90,75 @@ def test_single_run_spanning_blocks():
     )
 
 
+@pytest.mark.parametrize(
+    "n,d,k,blk",
+    [(1000, 7, 13, 512), (300, 3, 400, 512), (512, 4, 512, 256), (17, 2, 3, 512)],
+)
+def test_pallas_windowed_matches_scan(n, d, k, blk):
+    """The Pallas windowed-accumulate kernel (interpret mode off-TPU) must be
+    numerically interchangeable with the lax.scan window — including sentinel
+    (out-of-range) labels, which the K-sharded tower relies on."""
+    rng = np.random.default_rng(n + k)
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    lab = jnp.asarray(rng.integers(-1, k + 1, size=n).astype(np.int32))
+    s1, c1 = sorted_cluster_stats(x, lab, k, block=blk)
+    s2, c2 = sorted_cluster_stats(x, lab, k, block=blk, pallas=True)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-5, atol=1e-4
+    )
+
+
+def test_pallas_windowed_bf16():
+    rng = np.random.default_rng(29)
+    x = jnp.asarray(rng.normal(size=(1537, 8)).astype(np.float32)).astype(
+        jnp.bfloat16
+    )
+    lab = jnp.asarray(rng.integers(0, 64, size=1537).astype(np.int32))
+    s1, c1 = sorted_cluster_stats(x, lab, 64)
+    s2, c2 = sorted_cluster_stats(x, lab, 64, pallas=True)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    np.testing.assert_allclose(
+        np.asarray(s1, dtype=np.float32),
+        np.asarray(s2, dtype=np.float32),
+        rtol=1e-5,
+        atol=1e-4,
+    )
+
+
+def test_pallas_windowed_single_run_spanning_blocks():
+    """One cluster spanning many sorted blocks: the same accumulator tile is
+    revisited across consecutive grid steps and must keep accumulating."""
+    rng = np.random.default_rng(31)
+    x = jnp.asarray(rng.normal(size=(2000, 3)).astype(np.float32))
+    lab = jnp.zeros((2000,), jnp.int32)
+    s, c = sorted_cluster_stats(x, lab, 4, block=256, pallas=True)
+    assert float(c[0]) == 2000
+    np.testing.assert_allclose(
+        np.asarray(s)[0], np.asarray(x.sum(0)), rtol=1e-5, atol=1e-3
+    )
+
+
+def test_pallas_windowed_vmem_gate_falls_back():
+    """Shapes whose windowed-kernel footprint can't fit scoped VMEM must
+    silently take the scan path (pallas=True is a routing hint, not a
+    commitment to compile an infeasible kernel)."""
+    from tdc_tpu.ops.sorted_stats import windowed_sort_block
+
+    assert windowed_sort_block(768, 2) == 512  # flagship shape: full block
+    assert windowed_sort_block(768, 4) == 256  # f32 shrinks
+    assert windowed_sort_block(4096, 4) == 0  # infeasible → scan
+    rng = np.random.default_rng(37)
+    x = jnp.asarray(rng.normal(size=(64, 4096)).astype(np.float32))
+    lab = jnp.asarray(rng.integers(0, 5, size=64).astype(np.int32))
+    s1, c1 = sorted_cluster_stats(x, lab, 5)
+    s2, c2 = sorted_cluster_stats(x, lab, 5, pallas=True)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-5, atol=1e-4
+    )
+
+
 def test_sorted_counts():
     rng = np.random.default_rng(17)
     lab = np.sort(rng.integers(0, 31, size=997)).astype(np.int32)
