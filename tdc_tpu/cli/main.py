@@ -192,29 +192,28 @@ def validate_args(parser, args):
                          "distributedFuzzyCMeans, and gaussianMixture")
         if args.minibatch:
             parser.error("--minibatch and --shard_k are mutually exclusive")
-        if args.method_name != "distributedKMeans":
-            # The K-sharded fuzzy/GMM towers are in-memory f32 XLA steps;
-            # only the Lloyd tower has streamed / Pallas / bf16 / ckpt /
-            # history sharded paths so far. Reject rather than silently
-            # ignore, per the CLI's standing rule.
+        if args.method_name == "gaussianMixture":
+            # The K-sharded GMM tower is an in-memory f32 XLA step; the
+            # Lloyd and fuzzy towers are first-class (streamed / Pallas /
+            # bf16 / ckpt / history). Reject rather than silently ignore,
+            # per the CLI's standing rule.
             if args.streamed or args.num_batches > 1:
-                parser.error("--shard_k streaming is distributedKMeans only")
+                parser.error("--shard_k streaming is kmeans/fuzzy only "
+                             "(the GMM shard tower is in-memory)")
             if args.kernel == "pallas":
-                parser.error("--shard_k --kernel=pallas is "
-                             "distributedKMeans only (the fuzzy/GMM shard "
-                             "towers are XLA matmul steps)")
+                parser.error("--shard_k --kernel=pallas is kmeans/fuzzy "
+                             "only (the GMM shard tower is an XLA matmul "
+                             "step)")
             if args.ckpt_dir or args.ckpt_every_batches:
-                parser.error("--shard_k checkpointing is distributedKMeans "
-                             "only")
+                parser.error("--shard_k checkpointing is kmeans/fuzzy only")
             if args.history_file:
-                parser.error("--shard_k --history_file is distributedKMeans "
-                             "only (the fuzzy/GMM shard towers record no "
+                parser.error("--shard_k --history_file is kmeans/fuzzy "
+                             "only (the GMM shard tower records no "
                              "per-iteration history)")
             if args.dtype == "bfloat16":
-                parser.error("--shard_k --dtype=bfloat16 is "
-                             "distributedKMeans only (the fuzzy/GMM shard "
-                             "towers run f32)")
-            if args.method_name == "gaussianMixture" and args.init == "kmeans":
+                parser.error("--shard_k --dtype=bfloat16 is kmeans/fuzzy "
+                             "only (the GMM shard tower runs f32)")
+            if args.init == "kmeans":
                 parser.error("--shard_k gaussianMixture seeds from a host "
                              "subsample; --init=kmeans (a full K-Means "
                              "pre-fit) is the unsharded mode")
@@ -592,12 +591,34 @@ def run_experiment(args) -> dict:
             )
 
         if mesh2d is not None and args.method_name == "distributedFuzzyCMeans":
+            # Checkpointing lives in the streamed driver (one batch subsumes
+            # the in-memory case — the kmeans tower's rule); the plain
+            # in-memory fit below keeps x device-resident across iterations.
+            if streamed or args.ckpt_dir or args.ckpt_every_batches:
+                from tdc_tpu.parallel.sharded_k import (
+                    streamed_fuzzy_fit_sharded,
+                )
+
+                rows = -(-n_obs // num_batches)
+                return streamed_fuzzy_fit_sharded(
+                    make_stream(rows), args.K, n_dim, mesh2d,
+                    m=args.fuzzifier, init=args.init, key=key,
+                    max_iters=args.n_max_iters, tol=args.tol,
+                    kernel=args.kernel or "xla",
+                    block_rows=shard_block(rows),
+                    dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+                    prefetch=args.prefetch,
+                    ckpt_dir=args.ckpt_dir,
+                    ckpt_every_batches=args.ckpt_every_batches,
+                )
             from tdc_tpu.parallel.sharded_k import fuzzy_fit_sharded
 
             return fuzzy_fit_sharded(
                 host_points(), args.K, mesh2d, m=args.fuzzifier,
                 init=args.init, key=key, max_iters=args.n_max_iters,
                 tol=args.tol, block_rows=shard_block(n_obs),
+                kernel=args.kernel or "xla",
+                dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
             )
         if mesh2d is not None and args.method_name == "gaussianMixture":
             from tdc_tpu.parallel.sharded_k import gmm_fit_sharded

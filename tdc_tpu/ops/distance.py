@@ -56,6 +56,16 @@ def pairwise_sq_dist(
     """
     x = jnp.asarray(x)
     centroids = jnp.asarray(centroids)
+    if center and shifted:
+        # The dropped row-constant would silently become the CENTERED norm
+        # sum: a caller following the "add Σ‖x‖² back to the SSE" recipe
+        # would reconstruct a wrong total. No caller needs both — centering
+        # exists for accuracy, shifting for skipping the ‖x‖² re-read.
+        raise ValueError(
+            "center=True and shifted=True cannot combine: the shifted "
+            "form's dropped constant would be the centered Σ‖x−μ‖², not "
+            "Σ‖x‖² — the add-back recipe breaks"
+        )
     if center:
         mu = jnp.mean(centroids.astype(jnp.float32), axis=0)
         x = x.astype(jnp.float32) - mu

@@ -33,6 +33,9 @@ from jax.experimental.pallas import tpu as pltpu
 # Pad value for centroid rows added to reach a BLOCK_K multiple: ‖c‖² ≈ 1e30
 # dominates any real -2xᵀc term, so padded rows are never the argmin.
 _PAD_CENTROID = 1e15
+# ‖c‖² threshold that identifies _PAD_CENTROID rows (their c² is ≥ 1e30 per
+# dimension; no sane real centroid reaches 1e29).
+_PAD_C2_THRESHOLD = 1e29
 _ARG_SENTINEL = 2**30  # masked-out i32 index value; > any real K
 _NP_LOG_2PI = 1.8378770664093453  # log(2π)
 
@@ -594,7 +597,8 @@ def fuzzy_stats_auto(x: jax.Array, centroids: jax.Array, m: float = 2.0, **kw):
     return fuzzy_stats(x, centroids, m=m)
 
 
-def _fuzzy_norm_kernel(x_ref, c_ref, c2_ref, x2_ref, s_ref, *, m, eps):
+def _fuzzy_norm_kernel(x_ref, c_ref, c2_ref, x2_ref, s_ref, *, m, eps,
+                       precision):
     """Pass 1 of the two-pass fuzzy kernel: the per-point membership
     normalizer Σ_k (d²+eps)^(-1/(m-1)), accumulated online over K-tiles —
     the same streaming trick as the online argmin, applied to a sum.
@@ -609,10 +613,17 @@ def _fuzzy_norm_kernel(x_ref, c_ref, c2_ref, x2_ref, s_ref, *, m, eps):
         x_ref[...],
         c_ref[...],
         (((1,), (1,)), ((), ())),
+        precision=precision,
         preferred_element_type=jnp.float32,
     )  # (BN, BK)
     d2 = jnp.maximum(x2_ref[...] - 2.0 * cross + c2_ref[...], 0.0)
-    tile = jnp.sum((d2 + eps) ** (-1.0 / (m - 1.0)), axis=1, keepdims=True)
+    inv = (d2 + eps) ** (-1.0 / (m - 1.0))
+    # Zero the BLOCK_K-padding centroids exactly (‖c‖² ≈ 1e30 ⇒ inv is tiny
+    # but nonzero; at large m the 511-row worst case reached ~1e-5 absolute).
+    # Exactness matters for the K-sharded tower, where each shard pads its
+    # own K/Pm tile and the psum'd normalizer must match the unsharded one.
+    inv = jnp.where(c2_ref[...] > _PAD_C2_THRESHOLD, 0.0, inv)
+    tile = jnp.sum(inv, axis=1, keepdims=True)
 
     @pl.when(j == 0)
     def _():
@@ -625,7 +636,7 @@ def _fuzzy_norm_kernel(x_ref, c_ref, c2_ref, x2_ref, s_ref, *, m, eps):
 
 def _fuzzy_accum_kernel(
     x_ref, c_ref, c2_ref, x2_ref, s_ref, wsums_ref, weights_ref, obj_ref,
-    acc_ws, acc_w, acc_obj, *, m, eps,
+    acc_ws, acc_w, acc_obj, *, m, eps, precision,
 ):
     """Pass 2: memberships u = inv/normalizer recomputed per (K-tile,
     N-block) pair and folded into K-tile accumulators — the (N, K)
@@ -649,16 +660,21 @@ def _fuzzy_accum_kernel(
         x_ref[...],
         c_ref[...],
         (((1,), (1,)), ((), ())),
+        precision=precision,
         preferred_element_type=jnp.float32,
     )  # (BN, BK)
     d2 = jnp.maximum(x2_ref[...] - 2.0 * cross + c2_ref[...], 0.0)
     inv = (d2 + eps) ** (-1.0 / (m - 1.0))
+    # Same pad-centroid masking as the norm pass; BLOCK_N-padding rows carry
+    # s = +inf (set by the wrapper) so u = inv/inf = 0 zeroes them exactly.
+    inv = jnp.where(c2_ref[...] > _PAD_C2_THRESHOLD, 0.0, inv)
     u = inv / s_ref[...]  # (BN, BK) / (BN, 1)
     mu = u**m
     acc_ws[...] += jax.lax.dot_general(
         mu,
         x_ref[...].astype(jnp.float32),
         (((0,), (0,)), ((), ())),
+        precision=precision,
         preferred_element_type=jnp.float32,
     )  # (BK, d)
     acc_w[...] += jnp.sum(mu, axis=0, keepdims=True)
@@ -701,43 +717,33 @@ def twopass_blocks(
     return 0, 0
 
 
-@functools.partial(
-    jax.jit, static_argnames=("m", "eps", "block_n", "block_k", "interpret")
-)
-def fuzzy_stats_twopass(
-    x: jax.Array,
-    centroids: jax.Array,
-    m: float = 2.0,
-    eps: float = 1e-9,
-    *,
-    block_n: int | None = None,
-    block_k: int | None = None,
-    interpret: bool | None = None,
-):
-    """Fuzzy c-means sufficient stats at large K·d where the fused kernel's
-    (K, d) VMEM accumulator cannot fit (K=16,384·d=768 regime): pass 1
-    streams K-tiles to build the per-point normalizer (an (N, 1) f32
-    column — the only N-sized intermediate anywhere); pass 2 recomputes
-    each distance tile and accumulates the u^m-weighted moments per K-tile.
-    2× the distance FLOPs of the fused kernel, O(N) instead of O(N·K) HBM
-    traffic versus the XLA blocked path that materializes (block, K)
-    membership tiles (round-2 VERDICT weak #1).
+def _twopass_precision(dtype):
+    """Matmul precision for the two-pass fuzzy kernels: HIGHEST for f32
+    inputs so the Pallas path tracks the XLA path's trajectory (a DEFAULT
+    single-bf16-pass distance loses ~1% per iteration, compounding to
+    visibly divergent centroids over a fit — measured on v5e, round 5);
+    DEFAULT for bf16 inputs (the MXU fast path — the operands carry no
+    extra precision to preserve)."""
+    return (
+        jax.lax.Precision.DEFAULT
+        if dtype == jnp.bfloat16
+        else jax.lax.Precision.HIGHEST
+    )
 
-    Matches ops.assign.fuzzy_stats to f32-accumulation tolerance.
-    Reference counterpart: the fuzzy tower,
-    scripts/distribuitedClustering.py:117-148.
-    """
-    from tdc_tpu.ops.assign import FuzzyStats
 
+def _twopass_prep(x, centroids, block_n, block_k, interpret):
+    """Shared padding/derived-operand prep for the two-pass fuzzy kernels:
+    (xp, cp, c2, x2, block_n, block_k, interpret). Centroid padding rows use
+    _PAD_CENTROID and are masked to exactly zero membership inside both
+    kernels (c² threshold)."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    n, d = x.shape
-    k = centroids.shape[0]
+    k, d = centroids.shape
     if block_n is None or block_k is None:
         bn, bk = twopass_blocks(k, d, x.dtype.itemsize)
         if bn == 0:
             raise ValueError(
-                f"fuzzy_stats_twopass: d={d} too large for any K-tile; use "
+                f"two-pass fuzzy kernel: d={d} too large for any K-tile; use "
                 "ops.assign.fuzzy_stats_padded_blocked"
             )
         block_n = block_n or bn
@@ -749,13 +755,40 @@ def fuzzy_stats_twopass(
     )
     c2 = jnp.sum(cp.astype(jnp.float32) ** 2, axis=1)[None, :]  # (1, K_pad)
     x2 = jnp.sum(xp.astype(jnp.float32) ** 2, axis=1, keepdims=True)
-    n_pad, k_pad = xp.shape[0], cp.shape[0]
-    d_pad = xp.shape[1]
-    grid_n, grid_k = n_pad // block_n, k_pad // block_k
+    return xp, cp, c2, x2, block_n, block_k, interpret
 
+
+@functools.partial(
+    jax.jit, static_argnames=("m", "eps", "block_n", "block_k", "interpret")
+)
+def fuzzy_normalizer(
+    x: jax.Array,
+    centroids: jax.Array,
+    m: float = 2.0,
+    eps: float = 1e-9,
+    *,
+    block_n: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Pass 1 of the two-pass fuzzy machinery as a standalone: the (N, 1) f32
+    per-point membership normalizer Σ_K (d²+eps)^(-1/(m-1)) over THESE
+    centroids, streamed over K-tiles (no (N, K) anywhere).
+
+    Exposed separately so the K-sharded fuzzy tower can psum the per-shard
+    normalizers over the model axis before the accumulate pass — the fuzzy
+    analog of the Lloyd tower's champion all_gather. Padding centroids
+    contribute exactly zero (masked in-kernel), so Σ over shards of this
+    function equals the unsharded normalizer exactly."""
+    xp, cp, c2, x2, block_n, block_k, interpret = _twopass_prep(
+        x, centroids, block_n, block_k, interpret
+    )
+    n_pad, d_pad = xp.shape
+    grid = (n_pad // block_n, cp.shape[0] // block_k)
     s = pl.pallas_call(
-        functools.partial(_fuzzy_norm_kernel, m=float(m), eps=float(eps)),
-        grid=(grid_n, grid_k),
+        functools.partial(_fuzzy_norm_kernel, m=float(m), eps=float(eps),
+                          precision=_twopass_precision(x.dtype)),
+        grid=grid,
         in_specs=[
             pl.BlockSpec((block_n, d_pad), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -771,10 +804,46 @@ def fuzzy_stats_twopass(
         out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
         interpret=interpret,
     )(xp, cp, c2, x2)
+    return s[: x.shape[0]]
 
+
+@functools.partial(
+    jax.jit, static_argnames=("m", "eps", "block_n", "block_k", "interpret")
+)
+def fuzzy_accumulate(
+    x: jax.Array,
+    centroids: jax.Array,
+    s: jax.Array,
+    m: float = 2.0,
+    eps: float = 1e-9,
+    *,
+    block_n: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Pass 2 of the two-pass fuzzy machinery as a standalone: given the
+    per-point normalizer `s` ((N, 1) f32 — local from `fuzzy_normalizer`, or
+    the psum over model shards), recompute each distance tile and fold the
+    u^m-weighted moments into K-tile accumulators. Returns
+    ops.assign.FuzzyStats restricted to THESE centroids.
+
+    Exact at any N: internal BLOCK_N-padding rows get s = +inf, so their
+    memberships vanish identically (no zero-row correction term)."""
+    from tdc_tpu.ops.assign import FuzzyStats
+
+    n, d = x.shape
+    k = centroids.shape[0]
+    xp, cp, c2, x2, block_n, block_k, interpret = _twopass_prep(
+        x, centroids, block_n, block_k, interpret
+    )
+    n_pad, d_pad = xp.shape
+    k_pad = cp.shape[0]
+    sp = _pad_axis(s.astype(jnp.float32), 0, block_n, jnp.inf)
+    grid = (k_pad // block_k, n_pad // block_n)
     wsums, weights, obj = pl.pallas_call(
-        functools.partial(_fuzzy_accum_kernel, m=float(m), eps=float(eps)),
-        grid=(grid_k, grid_n),
+        functools.partial(_fuzzy_accum_kernel, m=float(m), eps=float(eps),
+                          precision=_twopass_precision(x.dtype)),
+        grid=grid,
         in_specs=[
             pl.BlockSpec((block_n, d_pad), lambda j, i: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -806,22 +875,47 @@ def fuzzy_stats_twopass(
             pltpu.VMEM((1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(xp, cp, c2, x2, s)
-
-    n_fake = n_pad - n
-    weights = weights[0, :k]
-    obj = obj[0, 0]
-    if n_fake:
-        from tdc_tpu.ops.assign import fuzzy_stats
-
-        zs = fuzzy_stats(jnp.zeros((1, d), jnp.float32), centroids, m=m,
-                         eps=eps)
-        weights = weights - n_fake * zs.weights
-        obj = obj - n_fake * zs.objective
+    )(xp, cp, c2, x2, sp)
     return FuzzyStats(
         weighted_sums=wsums[:k, :d],
-        weights=weights,
-        objective=jnp.maximum(obj, 0.0),
+        weights=weights[0, :k],
+        objective=jnp.maximum(obj[0, 0], 0.0),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("m", "eps", "block_n", "block_k", "interpret")
+)
+def fuzzy_stats_twopass(
+    x: jax.Array,
+    centroids: jax.Array,
+    m: float = 2.0,
+    eps: float = 1e-9,
+    *,
+    block_n: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Fuzzy c-means sufficient stats at large K·d where the fused kernel's
+    (K, d) VMEM accumulator cannot fit (K=16,384·d=768 regime): pass 1
+    (`fuzzy_normalizer`) streams K-tiles to build the per-point normalizer
+    (an (N, 1) f32 column — the only N-sized intermediate anywhere); pass 2
+    (`fuzzy_accumulate`) recomputes each distance tile and accumulates the
+    u^m-weighted moments per K-tile. 2× the distance FLOPs of the fused
+    kernel, O(N) instead of O(N·K) HBM traffic versus the XLA blocked path
+    that materializes (block, K) membership tiles (round-2 VERDICT weak #1).
+
+    Matches ops.assign.fuzzy_stats to f32-accumulation tolerance.
+    Reference counterpart: the fuzzy tower,
+    scripts/distribuitedClustering.py:117-148.
+    """
+    s = fuzzy_normalizer(
+        x, centroids, m, eps,
+        block_n=block_n, block_k=block_k, interpret=interpret,
+    )
+    return fuzzy_accumulate(
+        x, centroids, s, m, eps,
+        block_n=block_n, block_k=block_k, interpret=interpret,
     )
 
 

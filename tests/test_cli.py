@@ -116,17 +116,21 @@ def test_cli_shard_k_validation():
             "--n_obs=100 --n_dim=2 --K=7 --shard_k=2".split()
         )
         validate_args(parser, args)
-    # fuzzy + shard_k is now supported (round-4); its unsupported combos
-    # must still fail fast.
-    with pytest.raises(SystemExit):
+    # fuzzy + shard_k is first-class since round 5 (streamed / pallas /
+    # bf16 / ckpt all valid); the GMM shard tower's unsupported combos must
+    # still fail fast.
+    for combo in ("--num_batches=4", "--kernel=pallas", "--ckpt_dir=/tmp/x",
+                  "--dtype=bfloat16"):
+        with pytest.raises(SystemExit):
+            args = parser.parse_args(
+                f"--n_obs=100 --n_dim=2 --K=8 --shard_k=2 {combo} "
+                "--method_name=gaussianMixture".split()
+            )
+            validate_args(parser, args)
+    # ...while the same combos parse clean for fuzzy.
+    for combo in ("--num_batches=4", "--kernel=pallas", "--dtype=bfloat16"):
         args = parser.parse_args(
-            "--n_obs=100 --n_dim=2 --K=8 --shard_k=2 --num_batches=4 "
-            "--method_name=distributedFuzzyCMeans".split()
-        )
-        validate_args(parser, args)
-    with pytest.raises(SystemExit):
-        args = parser.parse_args(
-            "--n_obs=100 --n_dim=2 --K=8 --shard_k=2 --kernel=pallas "
+            f"--n_obs=100 --n_dim=2 --K=8 --shard_k=2 {combo} "
             "--method_name=distributedFuzzyCMeans".split()
         )
         validate_args(parser, args)
@@ -688,3 +692,24 @@ def test_cli_shard_k_gmm_tied_rejected(tmp_path):
     )
     with pytest.raises(SystemExit):
         validate_args(p, args)
+
+
+def test_cli_shard_k_fuzzy_ckpt_routes_to_streamed(tmp_path):
+    """In-memory fuzzy --shard_k with --ckpt_dir must actually checkpoint
+    (round-5 review finding: the in-memory tower has no ckpt parameters, so
+    the CLI routes such runs through the streamed driver — one batch
+    subsumes the in-memory case)."""
+    log = str(tmp_path / "log.csv")
+    ck = str(tmp_path / "ck")
+    rc = cli_main(
+        f"--n_obs=4000 --n_dim=4 --K=4 --n_max_iters=5 --seed=1 --tol=-1.0 "
+        f"--method_name=distributedFuzzyCMeans --shard_k=2 --n_GPUs=4 "
+        f"--log_file={log} --ckpt_dir={ck} --backend=cpu".split()
+    )
+    assert rc == 0
+    import os
+
+    assert os.path.isdir(ck) and os.listdir(ck)  # a checkpoint was written
+    row = list(csv.DictReader(open(log)))[-1]
+    assert row["status"] == "ok"
+    assert int(row["n_iter"]) == 5
