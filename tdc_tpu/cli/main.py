@@ -83,6 +83,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "AND streamed (--num_batches/--streamed)")
     p.add_argument("--spherical", action="store_true",
                    help="cosine K-Means (normalize points and centroids)")
+    p.add_argument("--empty_policy", type=str, default="keep",
+                   choices=("keep", "relocate"),
+                   help="empty-cluster policy for in-memory kmeans: 'keep' "
+                        "(stale centroid survives — every other driver's "
+                        "deterministic choice) or 'relocate' (sklearn "
+                        "parity: reseed from highest-cost points each "
+                        "iteration; closes the large-K SSE gap vs sklearn, "
+                        "benchmarks/iters_to_converge.csv)")
     p.add_argument("--num_batches", type=int, default=1,
                    help="initial serial batch count; doubled on OOM "
                         "(reference :357-360 semantics)")
@@ -102,14 +110,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "iters-to-converge comparisons; kmeans only)")
     p.add_argument("--class_sep", type=float, default=1.5)
     p.add_argument("--kernel", type=str, default=None,
-                   choices=("xla", "pallas"),
+                   choices=("xla", "pallas", "refined"),
                    help="sufficient-stats kernel for K-Means: 'pallas' = "
                         "fused single-pass VMEM kernel (single-device and "
                         "mesh; with --shard_k, the blockwise online-argmin "
-                        "kernel runs inside each shard). Default: 'xla', "
-                        "except --layout=auto may route narrow-d in-memory "
-                        "fits to the feature-major tall kernel; passing "
-                        "--kernel explicitly pins the sample-major layout")
+                        "kernel runs inside each shard); 'refined' = exact-"
+                        "distance champion refinement (in-memory kmeans "
+                        "only — the iters-to-converge parity path: matmul-"
+                        "form cancellation can flip assignments near "
+                        "convergence). Default: 'xla', except --layout=auto "
+                        "may route narrow-d in-memory fits to the feature-"
+                        "major tall kernel; passing --kernel explicitly "
+                        "pins the sample-major layout")
     p.add_argument("--shard_k", type=int, default=1,
                    help="model-axis size: shard the K centroids this many "
                         "ways over a 2-D (data x model) mesh (the K=16,384 "
@@ -219,11 +231,6 @@ def validate_args(parser, args):
                              "pre-fit) is the unsharded mode")
     if args.minibatch and args.method_name != "distributedKMeans":
         parser.error("--minibatch supports distributedKMeans only")
-    if args.minibatch and args.kernel is not None:
-        # minibatch_kmeans_fit has no kernel parameter; accepting the flag
-        # would record XLA numbers under an explicit kernel label.
-        parser.error("--kernel is not supported with --minibatch "
-                     "(the mini-batch update is the XLA path)")
     if args.method_name == "gaussianMixture":
         for flag in ("minibatch", "mean_combine", "spherical"):
             if getattr(args, flag):
@@ -297,6 +304,34 @@ def validate_args(parser, args):
         if args.history_file:
             parser.error("bisectingKMeans produces no per-iteration "
                          "history (--history_file is kmeans/fuzzy)")
+    if args.empty_policy != "keep":
+        # Only the in-memory Lloyd loop implements relocation; reject every
+        # other route rather than silently keeping stale centroids.
+        if args.method_name != "distributedKMeans":
+            parser.error("--empty_policy=relocate is distributedKMeans only")
+        for flag in ("minibatch", "streamed", "mean_combine"):
+            if getattr(args, flag):
+                parser.error(f"--empty_policy=relocate is in-memory only; "
+                             f"--{flag} is not supported (mini-batch has "
+                             "its own --reassignment_ratio policy)")
+        if args.num_batches > 1 or args.shard_k > 1:
+            parser.error("--empty_policy=relocate is in-memory single-shard")
+        if args.layout == "features":
+            parser.error("--empty_policy=relocate needs the sample-major "
+                         "layout (--layout=samples)")
+    if args.kernel == "refined":
+        # The exact-champion path exists for tol-driven trajectory parity;
+        # only the in-memory Lloyd fit implements it. Reject every other
+        # route rather than silently recording xla numbers as 'refined'.
+        if args.method_name != "distributedKMeans":
+            parser.error("--kernel=refined is distributedKMeans only")
+        for flag in ("minibatch", "streamed", "mean_combine"):
+            if getattr(args, flag):
+                parser.error(f"--kernel=refined is the in-memory exact-"
+                             f"champion path; --{flag} is not supported")
+        if args.num_batches > 1 or args.shard_k > 1:
+            parser.error("--kernel=refined is in-memory single-shard "
+                         "(use it for iters-to-converge parity runs)")
     if args.metrics_sample < 0:
         parser.error("--metrics_sample must be >= 0")
     if args.weight_file:
@@ -305,12 +340,23 @@ def validate_args(parser, args):
         if args.minibatch or args.mean_combine or args.shard_k > 1:
             parser.error("--weight_file is not supported with "
                          "--minibatch/--mean_combine/--shard_k")
+        if args.kernel == "refined":
+            parser.error("--kernel=refined does not support --weight_file")
         if args.kernel == "pallas":
-            # Weighted stats run in f32 XLA for mass exactness; reject rather
-            # than record XLA numbers as Pallas (the GMM gate's rule, applied
-            # to every method — kmeans/fuzzy, in-memory and streamed).
-            parser.error("--kernel=pallas does not support --weight_file "
-                         "(weighted stats are the f32 XLA path)")
+            # Weighted Pallas stats exist for kmeans only (fused/sorted
+            # weighted kernels, single-device — round-5); fuzzy/GMM
+            # weighted stats stay f32 XLA. Reject rather than record XLA
+            # numbers as Pallas (the standing rule). The implicit
+            # every-device default is caught by the model-level
+            # single-device check at runtime.
+            if args.method_name != "distributedKMeans":
+                parser.error("--kernel=pallas --weight_file is "
+                             "distributedKMeans only (fuzzy/GMM weighted "
+                             "stats are the f32 XLA path)")
+            if args.n_devices and args.n_devices > 1:
+                parser.error("--kernel=pallas --weight_file is "
+                             "single-device (the weighted kernels have no "
+                             "shard_map tower); pass --n_GPUs=1")
     if args.mean_combine:
         if args.method_name != "distributedKMeans":
             parser.error("--mean_combine supports distributedKMeans only")
@@ -437,6 +483,7 @@ def run_experiment(args) -> dict:
                 # An explicit --kernel (even 'xla') pins the sample-major
                 # layout so benchmark runs stay comparable across flags.
                 and args.kernel is None
+                and args.empty_policy == "keep"  # relocation gathers rows
             )
             if args.layout == "features":
                 if not feat_ok:
@@ -576,6 +623,7 @@ def run_experiment(args) -> dict:
                 prefetch=args.prefetch,
                 reassignment_ratio=args.reassignment_ratio,
                 ckpt_dir=args.ckpt_dir,
+                kernel=args.kernel or "xla",
             )
         def shard_block(rows_per_pass: int) -> int:
             """N-block for the K-sharded towers: --block_rows, or the
@@ -752,6 +800,7 @@ def run_experiment(args) -> dict:
             sample_weight=weights,
             layout="features" if use_features else "samples",
             history=args.history_file is not None,
+            empty_policy=args.empty_policy,
         )
 
     if args.profile_dir:

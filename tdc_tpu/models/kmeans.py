@@ -57,6 +57,21 @@ def _stats_fn(kernel: str, block_rows: int, mesh=None):
         # Mesh path: ops on globally-sharded arrays; XLA inserts the
         # all-reduce at the stats contraction itself.
         return lloyd_stats
+    if kernel == "refined":
+        # Exact-distance champion refinement (ops/assign.assign_refined):
+        # the iters-to-converge parity path — fixes matmul-form cancellation
+        # flipping assignments near convergence. Works on sharded inputs the
+        # same way the xla path does (auto-sharded gathers/contraction).
+        from tdc_tpu.ops.assign import (
+            lloyd_stats_padded_blocked,
+            lloyd_stats_refined,
+        )
+
+        if block_rows:
+            return lambda x, c: lloyd_stats_padded_blocked(
+                x, c, block_rows, lloyd_stats_refined
+            )
+        return lloyd_stats_refined
     if kernel == "pallas":
         if mesh is not None:
             # Fused VMEM kernel per shard + psum of the (K,d)+(K) stats over
@@ -91,10 +106,65 @@ def auto_block_rows(n: int, k: int, *, budget_bytes: int | None = None) -> int:
     return max(1 << max(block.bit_length() - 1, 10), 1024)  # pow2, ≥1024
 
 
+def _blocked_min_dist(x: jax.Array, c: jax.Array, block_rows: int):
+    """(N,) f32 squared distance of every point to its nearest centroid,
+    N-blocked so the (block, K) distance tile stays bounded (same guard as
+    lloyd_stats_blocked). Serves the empty-cluster relocation pass."""
+    from tdc_tpu.ops.distance import pairwise_sq_dist
+
+    n = x.shape[0]
+    if not block_rows or n <= block_rows:
+        return jnp.min(pairwise_sq_dist(x, c), axis=1)
+    pad = (-n) % block_rows
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    xb = xp.reshape(-1, block_rows, x.shape[1])
+    _, mind = jax.lax.scan(
+        lambda _, blk: (None, jnp.min(pairwise_sq_dist(blk, c), axis=1)),
+        None, xb,
+    )
+    return mind.reshape(-1)[:n]
+
+
+def _relocate_empty(x, new_c, counts, block_rows: int):
+    """sklearn-style empty-cluster relocation: every zero-count centroid is
+    replaced by a distinct highest-cost point (largest squared distance to
+    its nearest centroid) — the policy sklearn's Lloyd applies every
+    iteration, vs our default of keeping the stale centroid. The cost pass
+    runs only when an empty cluster exists (lax.cond), measured against the
+    UPDATED centroids (sklearn uses the pre-update assignment's inertia;
+    same fixed point — no empty clusters survive convergence either way).
+
+    The measured motivation (benchmarks/iters_to_converge.csv, round 5):
+    at K=1024 two k-means++ seeded clusters go empty mid-fit and the keep
+    policy strands them, landing 0.25% above sklearn's final SSE — a
+    policy difference, not a precision one.
+    """
+    k = new_c.shape[0]
+    empty = counts <= 0.0
+    if not block_rows:
+        # The pallas kernels never set block_rows (their tiles live in
+        # VMEM), but THIS pass is plain XLA — without blocking it would
+        # materialize the full (N, K) matrix the kernel path exists to
+        # avoid (8 GB at N=2M·K=1024).
+        block_rows = auto_block_rows(int(x.shape[0]), k)
+
+    def reloc(c):
+        mind = _blocked_min_dist(x, c, block_rows)
+        # Top-K costs cover the worst case of every cluster empty; the
+        # i-th empty slot takes the i-th costliest point (distinct rows).
+        _, top = jax.lax.top_k(mind, min(k, x.shape[0]))
+        rank = jnp.clip(jnp.cumsum(empty) - 1, 0, top.shape[0] - 1)
+        cand = x[top].astype(jnp.float32)
+        return jnp.where(empty[:, None], cand[rank], c)
+
+    return jax.lax.cond(jnp.any(empty), reloc, lambda c: c, new_c)
+
+
 @partial(
     jax.jit,
     static_argnames=(
-        "max_iters", "spherical", "kernel", "block_rows", "mesh", "history"
+        "max_iters", "spherical", "kernel", "block_rows", "mesh", "history",
+        "empty_policy",
     ),
 )
 def _lloyd_loop(
@@ -108,6 +178,7 @@ def _lloyd_loop(
     mesh: jax.sharding.Mesh | None = None,
     w: jax.Array | None = None,
     history: bool = False,
+    empty_policy: str = "keep",
 ) -> KMeansResult:
     """One traced Lloyd loop. tol < 0 disables the convergence test (reference
     fixed-iteration parity mode). `mesh` is only consulted by the pallas
@@ -119,17 +190,25 @@ def _lloyd_loop(
     semantics as the streamed fit: row i = cost at the iteration's *input*
     centroids + that iteration's shift."""
     if w is not None:
-        from tdc_tpu.ops.assign import (
-            lloyd_stats_weighted,
-            lloyd_stats_weighted_blocked,
-        )
+        if kernel == "pallas":
+            # Weighted Pallas stats (round-4 VERDICT weak #9): fused kernel
+            # with the f32 weight column, sorted-stats beyond its VMEM
+            # regime. Single-device (mesh runs keep the XLA weighted path).
+            from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto_weighted
 
-        if block_rows:
-            stats_fn = lambda xx, c: lloyd_stats_weighted_blocked(
-                xx, c, w, block_rows
-            )
+            stats_fn = lambda xx, c: lloyd_stats_auto_weighted(xx, c, w)
         else:
-            stats_fn = lambda xx, c: lloyd_stats_weighted(xx, c, w)
+            from tdc_tpu.ops.assign import (
+                lloyd_stats_weighted,
+                lloyd_stats_weighted_blocked,
+            )
+
+            if block_rows:
+                stats_fn = lambda xx, c: lloyd_stats_weighted_blocked(
+                    xx, c, w, block_rows
+                )
+            else:
+                stats_fn = lambda xx, c: lloyd_stats_weighted(xx, c, w)
     else:
         stats_fn = _stats_fn(kernel, block_rows, mesh)
 
@@ -139,6 +218,10 @@ def _lloyd_loop(
         new_c = apply_centroid_update(stats, c)
         if spherical:
             new_c = _normalize(new_c)
+        if empty_policy == "relocate":
+            new_c = _relocate_empty(x, new_c, stats.counts, block_rows)
+            if spherical:
+                new_c = _normalize(new_c)
         shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
         if history:
             hist = jax.lax.dynamic_update_slice(
@@ -220,6 +303,7 @@ def kmeans_fit(
     layout: str = "samples",
     history: bool = False,
     init_sample: int = 1 << 18,
+    empty_policy: str = "keep",
 ) -> KMeansResult:
     """Fit K-Means.
 
@@ -263,10 +347,23 @@ def kmeans_fit(
         first `init_sample` points (transposed to a small sample-major
         block); full-data init would need the sample-major buffer the layout
         exists to avoid.
+      empty_policy: 'keep' (default — an empty cluster keeps its stale
+        centroid, the deterministic choice every other driver shares) or
+        'relocate' (sklearn parity: empty clusters are reseeded each
+        iteration from the current highest-cost points — see
+        _relocate_empty; required for SSE parity with sklearn at large K,
+        where k-means++ seeds can go empty mid-fit). 'samples' layout only.
     """
     x = jnp.asarray(x)  # before the restart loop: one host→device transfer
     if layout not in ("samples", "features"):
         raise ValueError(f"unknown layout {layout!r}")
+    if empty_policy not in ("keep", "relocate"):
+        raise ValueError(f"unknown empty_policy {empty_policy!r}")
+    if empty_policy == "relocate" and layout == "features":
+        raise ValueError(
+            "empty_policy='relocate' needs the sample-major layout (the "
+            "relocation pass gathers point rows)"
+        )
     features = layout == "features"
     if features:
         if mesh is not None or sample_weight is not None:
@@ -293,6 +390,7 @@ def kmeans_fit(
                 spherical=spherical, mesh=mesh, kernel=kernel,
                 sample_weight=sample_weight, n_init=1, layout=layout,
                 history=history, init_sample=init_sample,
+                empty_policy=empty_policy,
             )
             if best is None or float(res.sse) < float(best.sse):
                 best = res
@@ -314,16 +412,22 @@ def kmeans_fit(
             )
         return res
 
-    if sample_weight is not None and kernel == "pallas":
-        # The weighted stats run in f32 XLA for mass exactness; an explicit
-        # kernel request must not silently record XLA numbers as Pallas
-        # (same rule as the streamed drivers and the GMM CLI gate).
+    if sample_weight is not None and kernel == "refined":
+        # The exact-champion path has no weighted variant; an explicit
+        # kernel request must not silently record xla numbers as refined.
         raise ValueError(
-            "kernel='pallas' does not support sample_weight; drop the "
+            "kernel='refined' does not support sample_weight; drop the "
+            "explicit kernel"
+        )
+    if sample_weight is not None and kernel == "pallas" and mesh is not None:
+        raise ValueError(
+            "kernel='pallas' with sample_weight is single-device (the "
+            "weighted kernels have no shard_map tower); drop mesh or the "
             "explicit kernel"
         )
     block_rows = 0
-    if mesh is None and (kernel == "xla" or sample_weight is not None):
+    if mesh is None and (kernel in ("xla", "refined")
+                         or sample_weight is not None):
         block_rows = auto_block_rows(int(np.asarray(x.shape[0])), k)
     w = None
     if sample_weight is not None:
@@ -351,7 +455,7 @@ def kmeans_fit(
     res = _lloyd_loop(
         x, c_init, int(max_iters), float(tol), bool(spherical), kernel,
         block_rows, mesh if (kernel == "pallas" and w is None) else None,
-        w, bool(history),
+        w, bool(history), empty_policy,
     )
     if history:
         res = res._replace(history=np.asarray(res.history)[: int(res.n_iter)])

@@ -330,8 +330,6 @@ def test_gmm_covariance_validations(aniso_blobs):
     x, _, _ = aniso_blobs
     with pytest.raises(ValueError, match="covariance_type"):
         gmm_fit(x, 3, covariance_type="banana")
-    with pytest.raises(ValueError, match="diag"):
-        gmm_fit(x[:512], 2, covariance_type="full", mesh=make_mesh(2))
     with pytest.raises(ValueError, match="nonnegative"):
         gmm_fit(x, 3, sample_weight=-np.ones(len(x)))
 
@@ -456,14 +454,6 @@ class TestStreamedGMMCovarianceTypes:
         np.testing.assert_allclose(np.asarray(a.variances),
                                    np.asarray(b.variances),
                                    rtol=1e-4, atol=1e-5)
-
-    def test_mesh_non_diag_rejected(self, aniso_blobs):
-        from tdc_tpu.models.gmm import streamed_gmm_fit
-
-        x, _, centers = aniso_blobs
-        with pytest.raises(ValueError, match="diag"):
-            streamed_gmm_fit(lambda: iter([x]), 3, 2, init=centers,
-                             covariance_type="full", mesh=make_mesh(8))
 
     def test_ckpt_covariance_type_mismatch_rejected(self, aniso_blobs,
                                                     tmp_path):
@@ -614,7 +604,49 @@ def test_mesh_streamed_tied_matches_single_device(aniso_blobs):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_mesh_full_still_rejected(aniso_blobs):
+def test_mesh_full_covariance_matches_single_device(aniso_blobs):
+    """Round-5 (VERDICT #8): full covariance under the data mesh — the
+    per-component Cholesky factorizations are replicated tiny work and each
+    triangular solve's (d, N) RHS shards over the data axis, so the E-step
+    needs no special-casing. Oracle: the single-device fit."""
     x, _, _ = aniso_blobs
-    with pytest.raises(ValueError, match="full"):
-        gmm_fit(x[:992], 3, covariance_type="full", mesh=make_mesh(8))
+    x = x[:992]
+    means_init = x[:3]
+    single = gmm_fit(x, 3, init=means_init, max_iters=25, tol=-1.0,
+                     covariance_type="full")
+    sharded = gmm_fit(x, 3, init=means_init, max_iters=25, tol=-1.0,
+                      covariance_type="full", mesh=make_mesh(8))
+    np.testing.assert_allclose(np.asarray(single.means),
+                               np.asarray(sharded.means),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.variances),
+                               np.asarray(sharded.variances),
+                               rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(float(single.log_likelihood),
+                               float(sharded.log_likelihood), rtol=1e-5)
+
+
+def test_streamed_mesh_full_covariance_matches(aniso_blobs):
+    """Streamed + mesh + full covariance (ragged batches): the (K, d, d)
+    second-moment accumulator psums over the data axis exactly."""
+    from tdc_tpu.models.gmm import streamed_gmm_fit
+
+    x, _, _ = aniso_blobs
+    x = x[:997]  # every batch ragged on the 8-mesh
+    centers = x[:3]
+
+    def batches():
+        for i in range(0, len(x), 250):
+            yield x[i:i + 250]
+
+    single = streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=12,
+                              tol=-1.0, covariance_type="full")
+    meshed = streamed_gmm_fit(batches, 3, 2, init=centers, max_iters=12,
+                              tol=-1.0, covariance_type="full",
+                              mesh=make_mesh(8))
+    np.testing.assert_allclose(np.asarray(single.means),
+                               np.asarray(meshed.means),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.variances),
+                               np.asarray(meshed.variances),
+                               rtol=1e-3, atol=1e-5)

@@ -120,3 +120,69 @@ def test_n_init_ignored_for_deterministic_init(blobs_small):
     b = kmeans_fit(x, 3, init=centers, max_iters=10, tol=-1.0)
     np.testing.assert_array_equal(np.asarray(a.centroids),
                                   np.asarray(b.centroids))
+
+
+class TestEmptyClusterRelocation:
+    """sklearn-parity empty-cluster policy (round-5: the K=1024
+    iters-to-converge SSE gap traced to stranded empty clusters, not
+    precision — benchmarks/iters_to_converge.csv)."""
+
+    def _data_with_doomed_seed(self):
+        # Two tight blobs + an init centroid parked far away: it captures
+        # nothing on iteration 1 and goes permanently empty under 'keep'.
+        rng = np.random.default_rng(3)
+        a = rng.normal([0, 0], 0.2, (500, 2)).astype(np.float32)
+        b = rng.normal([8, 0], 0.2, (500, 2)).astype(np.float32)
+        x = np.concatenate([a, b])
+        init = np.array([[0.1, 0.0], [7.9, 0.0], [500.0, 500.0]], np.float32)
+        return x, init
+
+    def test_keep_strands_relocate_revives(self):
+        from tdc_tpu.models import kmeans_fit, kmeans_predict
+
+        x, init = self._data_with_doomed_seed()
+        keep = kmeans_fit(x, 3, init=init, max_iters=50, tol=0.0)
+        reloc = kmeans_fit(x, 3, init=init, max_iters=50, tol=0.0,
+                           empty_policy="relocate")
+        keep_hist = np.bincount(
+            np.asarray(kmeans_predict(x, keep.centroids)), minlength=3)
+        reloc_hist = np.bincount(
+            np.asarray(kmeans_predict(x, reloc.centroids)), minlength=3)
+        assert (keep_hist == 0).sum() == 1  # the doomed seed stays dead
+        assert (reloc_hist == 0).sum() == 0  # relocation revived it
+        assert float(reloc.sse) < float(keep.sse) * 0.9
+
+    def test_relocate_noop_when_no_empties(self):
+        from tdc_tpu.models import kmeans_fit
+
+        rng = np.random.default_rng(0)
+        centers = rng.normal(scale=8, size=(4, 3)).astype(np.float32)
+        x = (centers[rng.integers(0, 4, 2000)]
+             + rng.normal(size=(2000, 3)).astype(np.float32))
+        init = jnp.asarray(centers)
+        a = kmeans_fit(x, 4, init=init, max_iters=30, tol=0.0)
+        b = kmeans_fit(x, 4, init=init, max_iters=30, tol=0.0,
+                       empty_policy="relocate")
+        np.testing.assert_array_equal(np.asarray(a.centroids),
+                                      np.asarray(b.centroids))
+        assert int(a.n_iter) == int(b.n_iter)
+
+    def test_relocate_composes_with_refined_and_blocked(self):
+        from tdc_tpu.models import kmeans_fit, kmeans_predict
+
+        x, init = self._data_with_doomed_seed()
+        r = kmeans_fit(x, 3, init=init, max_iters=50, tol=0.0,
+                       kernel="refined", empty_policy="relocate")
+        hist = np.bincount(
+            np.asarray(kmeans_predict(x, r.centroids)), minlength=3)
+        assert (hist == 0).sum() == 0
+        assert bool(r.converged)
+
+    def test_relocate_rejects_features_layout(self):
+        import pytest
+
+        from tdc_tpu.models import kmeans_fit
+
+        x = np.ones((64, 4), np.float32)
+        with pytest.raises(ValueError, match="sample-major"):
+            kmeans_fit(x.T, 2, layout="features", empty_policy="relocate")

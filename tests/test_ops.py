@@ -164,3 +164,98 @@ def test_point_on_centroid_full_membership():
     c = np.array([[5.0, 5.0], [0.0, 0.0]], np.float32)
     u = np.asarray(fuzzy_memberships(x, c, m=2.0))
     assert u[0, 0] > 0.999
+
+
+class TestRefinedAssignment:
+    """Exact-distance champion refinement (round-4 VERDICT weak #3: matmul-
+    form cancellation flips assignments near convergence, breaking
+    iters-to-converge parity with sklearn's exact Lloyd)."""
+
+    def _offset_data(self):
+        # Clusters offset 3e3 from the origin: the matmul form's
+        # cancellation error (~‖x‖²·2⁻²⁴ ≈ 4) sits between typical
+        # champion/runner-up gaps (flips ~1% of assignments) and the gap to
+        # the 3rd-best centroid (so the true champion stays in the top-2 —
+        # the refinement's working regime; far larger offsets break the
+        # top-2 nomination itself, documented in assign_refined).
+        rng = np.random.default_rng(11)
+        centers = 3e3 + rng.normal(scale=2.0, size=(6, 8)).astype(np.float32)
+        x = (centers[rng.integers(0, 6, 4000)]
+             + rng.normal(scale=0.5, size=(4000, 8))).astype(np.float32)
+        return x, centers
+
+    def test_assign_refined_matches_exact(self):
+        from tdc_tpu.ops.assign import assign_refined
+        from tdc_tpu.ops.distance import pairwise_sq_dist_direct
+
+        x, centers = self._offset_data()
+        labels, mind = assign_refined(jnp.asarray(x), jnp.asarray(centers))
+        d2 = pairwise_sq_dist_direct(jnp.asarray(x), jnp.asarray(centers))
+        want = np.asarray(jnp.argmin(d2, axis=-1))
+        np.testing.assert_array_equal(np.asarray(labels), want)
+        np.testing.assert_allclose(
+            np.asarray(mind), np.asarray(jnp.min(d2, axis=-1)),
+            rtol=1e-5, atol=1e-7,
+        )
+
+    def test_plain_argmin_actually_flips_here(self):
+        """The regime is real: without refinement the matmul form
+        mis-assigns a nontrivial fraction of these points (if this ever
+        stops failing, the refined path has become redundant)."""
+        from tdc_tpu.ops.assign import assign_clusters
+        from tdc_tpu.ops.distance import pairwise_sq_dist_direct
+
+        x, centers = self._offset_data()
+        plain = np.asarray(assign_clusters(jnp.asarray(x), jnp.asarray(centers)))
+        d2 = pairwise_sq_dist_direct(jnp.asarray(x), jnp.asarray(centers))
+        want = np.asarray(jnp.argmin(d2, axis=-1))
+        assert (plain != want).mean() > 0.01
+
+    def test_refined_stats_blocked_matches_plain(self):
+        from tdc_tpu.ops.assign import (
+            lloyd_stats_padded_blocked,
+            lloyd_stats_refined,
+        )
+
+        x, centers = self._offset_data()
+        a = lloyd_stats_refined(jnp.asarray(x), jnp.asarray(centers))
+        b = lloyd_stats_padded_blocked(
+            jnp.asarray(x), jnp.asarray(centers), 512, lloyd_stats_refined
+        )
+        np.testing.assert_allclose(np.asarray(a.sums), np.asarray(b.sums),
+                                   rtol=1e-6, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(a.counts),
+                                      np.asarray(b.counts))
+        # 96 pad rows each contribute ‖c_j‖² ≈ 7.2e7 to the blocked SSE
+        # before the correction subtracts them back out; at this deliberate
+        # 3e3 offset the add-then-subtract cancels ~6.9e9-magnitude f32
+        # values, so the residual is bounded by that magnitude's ulp — not
+        # by the (tiny) true SSE. Real data near the origin doesn't pay
+        # this; the offset exists here to provoke assignment flips.
+        pad_mag = 96 * float(np.square(centers).sum(axis=1).min())
+        np.testing.assert_allclose(float(a.sse), float(b.sse),
+                                   atol=pad_mag * 2e-7)
+
+    def test_kmeans_fit_refined_kernel(self):
+        from tdc_tpu.models import kmeans_fit
+
+        x, centers = self._offset_data()
+        res = kmeans_fit(x, 6, init=jnp.asarray(centers), max_iters=30,
+                         tol=0.0, kernel="refined")
+        exact = kmeans_fit(x, 6, init=jnp.asarray(centers), max_iters=30,
+                           tol=0.0)
+        # The refined fit reaches a fixed point of the EXACT assignment;
+        # its SSE can only be <= the cancellation-afflicted one.
+        assert float(res.sse) <= float(exact.sse) * (1 + 1e-6)
+        assert bool(res.converged)
+
+    def test_assign_refined_single_centroid(self):
+        from tdc_tpu.ops.assign import assign_refined
+
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        c = np.ones((1, 3), np.float32)
+        labels, mind = assign_refined(jnp.asarray(x), jnp.asarray(c))
+        np.testing.assert_array_equal(np.asarray(labels), np.zeros(4))
+        np.testing.assert_allclose(
+            np.asarray(mind), ((x - 1.0) ** 2).sum(axis=1), rtol=1e-6
+        )

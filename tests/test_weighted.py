@@ -411,3 +411,95 @@ def test_streamed_all_zero_weights_rejected(blobs_small):
     with pytest.raises(ValueError, match="no mass"):
         streamed_kmeans_fit(xs, 3, 2, init=centers, max_iters=3, tol=-1.0,
                             sample_weight_batches=ws)
+
+
+class TestWeightedPallas:
+    """Weighted Pallas stats (round-4 VERDICT weak #9): the fused kernel
+    carries the f32 weight column; the sorted path augments the row matrix
+    with [w·x | w]; both must satisfy the duplication contract."""
+
+    def test_fused_weighted_matches_xla(self, rng):
+        from tdc_tpu.ops.assign import lloyd_stats_weighted
+        from tdc_tpu.ops.pallas_kernels import lloyd_stats_fused_weighted
+
+        x = rng.normal(size=(700, 6)).astype(np.float32) * 4
+        c = rng.normal(size=(5, 6)).astype(np.float32) * 4
+        w = rng.uniform(0, 3, size=700).astype(np.float32)
+        w[:50] = 0.0  # zero-weight rows contribute nothing
+        want = lloyd_stats_weighted(jnp.asarray(x), jnp.asarray(c),
+                                    jnp.asarray(w))
+        got = lloyd_stats_fused_weighted(jnp.asarray(x), jnp.asarray(c),
+                                         jnp.asarray(w), block_n=256)
+        np.testing.assert_allclose(np.asarray(got.sums),
+                                   np.asarray(want.sums),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(got.counts),
+                                   np.asarray(want.counts),
+                                   rtol=1e-6, atol=1e-5)
+        np.testing.assert_allclose(float(got.sse), float(want.sse),
+                                   rtol=1e-5)
+
+    def test_sorted_weighted_matches_xla(self, rng):
+        from tdc_tpu.ops.assign import lloyd_stats_weighted
+        from tdc_tpu.ops.sorted_stats import lloyd_stats_sorted_weighted
+
+        x = rng.normal(size=(900, 7)).astype(np.float32) * 4
+        c = rng.normal(size=(6, 7)).astype(np.float32) * 4
+        w = rng.uniform(0, 2, size=900).astype(np.float32)
+        want = lloyd_stats_weighted(jnp.asarray(x), jnp.asarray(c),
+                                    jnp.asarray(w))
+        got = lloyd_stats_sorted_weighted(
+            jnp.asarray(x), jnp.asarray(c), jnp.asarray(w), sort_block=128
+        )
+        np.testing.assert_allclose(np.asarray(got.sums),
+                                   np.asarray(want.sums),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(got.counts),
+                                   np.asarray(want.counts),
+                                   rtol=1e-6, atol=1e-5)
+        np.testing.assert_allclose(float(got.sse), float(want.sse),
+                                   rtol=1e-5)
+
+    def test_integer_weights_equal_duplication_pallas(self, blobs_small):
+        """The duplication contract through kernel='pallas' end to end."""
+        x, _, centers = blobs_small
+        w = np.ones(len(x), np.float32)
+        w[: len(x) // 3] = 2.0
+        dup = np.concatenate([x, x[: len(x) // 3]])
+        a = kmeans_fit(x, 3, init=centers, max_iters=15, tol=-1.0,
+                       sample_weight=w, kernel="pallas")
+        b = kmeans_fit(dup, 3, init=centers, max_iters=15, tol=-1.0,
+                       kernel="pallas")
+        np.testing.assert_allclose(
+            np.asarray(a.centroids), np.asarray(b.centroids),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(float(a.sse), float(b.sse), rtol=1e-4)
+
+    def test_streamed_weighted_pallas_matches_in_memory(self, blobs_small):
+        from tdc_tpu.data.loader import NpzStream
+        from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+        x, _, centers = blobs_small
+        w = np.linspace(0.1, 2.0, len(x)).astype(np.float32)
+        streamed = streamed_kmeans_fit(
+            NpzStream(x, 250), 3, 2, init=centers, max_iters=12, tol=-1.0,
+            sample_weight_batches=NpzStream(w, 250), kernel="pallas",
+        )
+        in_mem = kmeans_fit(x, 3, init=centers, max_iters=12, tol=-1.0,
+                            sample_weight=w, kernel="pallas")
+        np.testing.assert_allclose(
+            np.asarray(streamed.centroids), np.asarray(in_mem.centroids),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_weighted_pallas_mesh_rejected(self, blobs_small):
+        import pytest
+
+        from tdc_tpu.parallel import make_mesh
+
+        x, _, centers = blobs_small
+        w = np.ones(len(x), np.float32)
+        with pytest.raises(ValueError, match="single-device"):
+            kmeans_fit(x[:1192], 3, init=centers, sample_weight=w[:1192],
+                       kernel="pallas", mesh=make_mesh(8))
