@@ -1,29 +1,39 @@
-"""CPU-mesh scaling sanity table (round-3 VERDICT missing #2).
+"""Collective-overhead isolation on the virtual CPU mesh (round-4 VERDICT
+weak #5 — the round-3 strong-scaling table measured nothing: its 1/2/4/8
+numbers were non-monotonic because the virtual devices share one CPU's
+cores, so wall-clock confounded collective cost with thread-pool
+contention).
 
-Real multi-chip hardware is not reachable from this environment, so this
-script documents the collective-efficiency story on the virtual CPU mesh
-instead: a FIXED problem (strong scaling) run on 1/2/4/8 forced-host
-devices, data-parallel via the same mesh/psum machinery the TPU pod path
-uses. What this measures is the *overhead structure* of the sharded step —
-partition + per-shard compute + XLA all-reduce — not silicon speedup: the
-virtual devices share one CPU's cores, so wall-clock per step reflects how
-the work partitions across the shared thread pool (it can even DROP vs
-1-device, where XLA's single-device CPU executor underuses the cores), and
-the signal to read is that no mesh size blows up: 8-way sharding with the
-psum reduce completes within ~0.9x of the 1-device wall-clock on the same
-fixed problem. Contrast the reference's empirical product — the 1-8 GPU
-grid in scripts/executions_log.csv:2-321, whose K=15 rows went FLAT from
-5->8 GPUs because every partial crossed PCIe to a host-side add_n reduce
-(SURVEY.md §2.4): its collective cost grew with device count; psum's does
-not.
+Real multi-chip hardware is not reachable from this environment, so the
+question this script CAN answer honestly is: **what does the psum add to a
+sharded Lloyd step, and does that cost grow with device count?** Protocol:
 
-Run (takes ~1 min):
+- WEAK SCALING: fixed rows per device (N = n_dev x N_PER_DEV), so each
+  shard's compute is identical at every mesh size.
+- MATCHED CONTROL: every mesh size is measured twice with the SAME
+  shard_map tower — once with the psum of the (K, d)+(K)+() sufficient
+  stats over the data axis, once with the reduction deleted (stats stay
+  shard-local). Both variants contend for the same shared cores in the
+  same pattern, so their DIFFERENCE is the all-reduce cost alone — the
+  contention that invalidated the strong-scaling table cancels out.
+
+The claim being evidenced (SURVEY.md §2.4): the reference's reduce was a
+host-side tf.add_n over PCIe whose cost grew with device count (its K=15
+rows went FLAT from 5->8 GPUs, scripts/executions_log.csv:250-256); XLA's
+all-reduce of the tiny (K, d) stats is a constant-ish, sub-millisecond
+term. The committed CSV shows psum overhead well under 10% of the step at
+every mesh size, with no growth trend — on ICI-connected TPU chips the
+same reduction is faster still (the stats are KB-scale vs MB/s-scale
+links; see benchmarks/ROOFLINE_SHARDED.md for on-chip collective numbers).
+
+Run (takes ~2 min):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python benchmarks/cpu_mesh_scaling.py
 Writes benchmarks/cpu_mesh_scaling.csv and prints one JSON line per mesh.
 """
 
 import csv
+import functools
 import json
 import os
 import sys
@@ -37,53 +47,93 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
 
 if jax.config.jax_platforms != "cpu":  # sitecustomize may pin 'axon'
     jax.config.update("jax_platforms", "cpu")
 
-from tdc_tpu.models.kmeans import _lloyd_loop  # noqa: E402
+from tdc_tpu.ops.assign import lloyd_stats  # noqa: E402
 from tdc_tpu.parallel import make_mesh  # noqa: E402
-from tdc_tpu.parallel.mesh import shard_points  # noqa: E402
+from tdc_tpu.parallel.mesh import DATA_AXIS, shard_points  # noqa: E402
 
-N, D, K, ITERS = 1 << 20, 16, 64, 8
+N_PER_DEV, D, K, ITERS, REPS = 1 << 17, 16, 64, 8, 5
 
 
-def measure(n_dev: int, x_host, c0) -> float:
-    """Seconds per Lloyd iteration on an n_dev-device mesh (fixed problem).
-    min-of-reps; CPU timing needs no tunnel-safe slope machinery."""
-    mesh = make_mesh(n_dev) if n_dev > 1 else None
-    x = jnp.asarray(x_host)
-    if mesh is not None:
-        x = shard_points(x, mesh)
+def make_step(mesh, reduce_stats: bool):
+    """One Lloyd stats pass over the mesh; reduce_stats=False deletes the
+    psum (stats stay shard-local) — the matched contention control."""
 
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P()),
+        out_specs=(
+            (P(None, None), P(None), P()) if reduce_stats
+            else (P(DATA_AXIS, None), P(DATA_AXIS), P())
+        ),
+        check_vma=False,
+    )
+    def stats(x_loc, c):
+        s = lloyd_stats(x_loc, c)
+        if reduce_stats:
+            return (
+                jax.lax.psum(s.sums, DATA_AXIS),
+                jax.lax.psum(s.counts, DATA_AXIS),
+                jax.lax.psum(s.sse, DATA_AXIS),
+            )
+        # Shard-local: same compute, zero collectives. Counts/sums stay
+        # sharded along the data axis (stacked per shard).
+        return s.sums, s.counts[None, :] * 1.0, s.sse
+
+    @jax.jit
+    def chain(x, c):
+        # ITERS dependent stats passes (the sums feed a dummy centroid
+        # update so XLA cannot collapse the chain).
+        def body(c, _):
+            sums, counts, sse = stats(x, c)
+            cnew = c + 1e-12 * jnp.sum(sums) + 0.0 * sse
+            return cnew, None
+
+        c, _ = jax.lax.scan(body, c, None, length=ITERS)
+        return c
+
+    return chain
+
+
+def measure(chain, x, c0) -> float:
     def run():
         t0 = time.perf_counter()
-        res = _lloyd_loop(x, c0, ITERS, -1.0, False, "xla", 0, None, None,
-                          False)
-        np.asarray(res.centroids)
+        np.asarray(chain(x, c0))
         return time.perf_counter() - t0
 
     run()  # compile + warm
-    return min(run() for _ in range(3)) / ITERS
+    return min(run() for _ in range(REPS)) / ITERS
 
 
 def main():
     if len(jax.devices()) < 8:
         sys.exit("need 8 forced-host devices (see module docstring)")
     rng = np.random.default_rng(0)
-    x = rng.normal(size=(N, D)).astype(np.float32)
-    c0 = jnp.asarray(x[:K])
     out = os.path.join(os.path.dirname(__file__), "cpu_mesh_scaling.csv")
     rows = []
-    base = None
     for n_dev in (1, 2, 4, 8):
-        per = measure(n_dev, x, c0)
-        base = base or per
+        n = n_dev * N_PER_DEV
+        x_host = rng.normal(size=(n, D)).astype(np.float32)
+        c0 = jnp.asarray(x_host[:K])
+        mesh = make_mesh(n_dev)
+        x = shard_points(jnp.asarray(x_host), mesh)
+        with_ms = measure(make_step(mesh, True), x, c0) * 1e3
+        without_ms = measure(make_step(mesh, False), x, c0) * 1e3
         rows.append({
             "n_devices": n_dev,
-            "ms_per_iter": round(per * 1e3, 2),
-            "pt_iter_per_s": round(N / per, 1),
-            "rel_wallclock_vs_1dev": round(per / base, 3),
+            "rows_per_device": N_PER_DEV,
+            "step_ms_with_psum": round(with_ms, 3),
+            "step_ms_no_psum": round(without_ms, 3),
+            "psum_overhead_ms": round(with_ms - without_ms, 3),
+            "psum_overhead_pct": round(
+                100.0 * (with_ms - without_ms) / with_ms, 2
+            ),
         })
         print(json.dumps(rows[-1]))
     with open(out, "w", newline="") as f:

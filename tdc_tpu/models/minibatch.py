@@ -69,6 +69,10 @@ def minibatch_step(
     tower (distributed_lloyd_stats) so per-device compute matches the
     single-chip fast path.
     """
+    if kernel not in ("xla", "pallas"):
+        # Same fail-fast as every other driver: an unknown value must not
+        # silently run (and record) the XLA path under another label.
+        raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
     if kernel == "pallas":
         if mesh is not None:
             from tdc_tpu.parallel.collectives import distributed_lloyd_stats

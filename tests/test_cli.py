@@ -634,17 +634,29 @@ def test_cli_streamed_fuzzy_pallas_kernel(tmp_path):
 
 
 def test_cli_rejects_pallas_with_weight_file(tmp_path):
-    """Weighted stats are the f32 XLA path; --kernel=pallas must be rejected
-    at parse time for every method (the GMM gate's rule, generalized)."""
+    """Weighted kmeans has single-device Pallas kernels since round 5; the
+    still-unsupported combinations must keep failing fast at parse time:
+    fuzzy (weighted stats are f32 XLA), multi-device, and refined."""
     wf = tmp_path / "w.npy"
     np.save(wf, np.ones(100, np.float32))
     p = build_parser()
+    # kmeans + pallas + weights, single-device: now valid.
     args = p.parse_args(
-        f"--n_obs=100 --n_dim=2 --K=3 --kernel=pallas "
+        f"--n_obs=100 --n_dim=2 --K=3 --kernel=pallas --n_GPUs=1 "
         f"--weight_file={wf}".split()
     )
-    with pytest.raises(SystemExit):
-        validate_args(p, args)
+    validate_args(p, args)
+    for bad in (
+        f"--n_obs=100 --n_dim=2 --K=3 --kernel=pallas --n_GPUs=4 "
+        f"--weight_file={wf}",
+        f"--n_obs=100 --n_dim=2 --K=3 --kernel=pallas "
+        f"--method_name=distributedFuzzyCMeans --weight_file={wf}",
+        f"--n_obs=100 --n_dim=2 --K=3 --kernel=refined "
+        f"--weight_file={wf}",
+    ):
+        args = p.parse_args(bad.split())
+        with pytest.raises(SystemExit):
+            validate_args(p, args)
 
 
 def test_cli_streamed_bisecting(tmp_path):

@@ -125,10 +125,11 @@ def test_uneven_shard_raises(blobs_small):
 
 
 def test_cpu_mesh_scaling_artifact_integrity():
-    """The committed scaling table (benchmarks/cpu_mesh_scaling.csv, round-3
-    VERDICT missing #2) stays parseable and shaped: 1/2/4/8 devices, positive
-    throughputs, relative wall-clock within a sane band (no collective
-    blow-up — the property the table documents)."""
+    """The committed collective-overhead table (round-5 weak-scaling
+    protocol with matched no-psum controls) stays parseable and shaped:
+    1/2/4/8 devices, positive step times, and the property the table
+    documents — psum overhead bounded (<10% of the step) with no blow-up
+    at larger meshes."""
     import csv
     import os
 
@@ -138,5 +139,6 @@ def test_cpu_mesh_scaling_artifact_integrity():
     rows = list(csv.DictReader(open(path)))
     assert [int(r["n_devices"]) for r in rows] == [1, 2, 4, 8]
     for r in rows:
-        assert float(r["pt_iter_per_s"]) > 0
-        assert 0 < float(r["rel_wallclock_vs_1dev"]) < 3.0
+        assert float(r["step_ms_with_psum"]) > 0
+        assert float(r["step_ms_no_psum"]) > 0
+        assert float(r["psum_overhead_pct"]) < 10.0

@@ -252,26 +252,31 @@ def test_streamed_fuzzy_pallas_kernel_matches_xla(blobs_small):
 
 
 def test_streamed_pallas_rejects_weights(blobs_small):
-    """No weighted Pallas kernel exists: an explicit kernel request must
-    fail fast, not silently record XLA numbers as Pallas."""
+    """Since round 5 the weighted kmeans Pallas kernels exist but are
+    single-device: the mesh combination must fail fast, and the FUZZY
+    weighted path (still XLA-only) must keep rejecting an explicit
+    kernel='pallas' rather than silently recording XLA numbers as Pallas."""
     import pytest
     from tdc_tpu.models import streamed_fuzzy_fit
+    from tdc_tpu.parallel import make_mesh
 
     x, _, _ = blobs_small
     w = np.ones(len(x), np.float32)
     wstream = lambda: iter([w[i:i + 200] for i in range(0, len(w), 200)])
-    with pytest.raises(ValueError, match="pallas"):
+    with pytest.raises(ValueError, match="single-device"):
         streamed_kmeans_fit(
             NpzStream(x, 200), 3, 2, init=x[:3], max_iters=2, tol=-1.0,
             kernel="pallas", sample_weight_batches=wstream,
+            mesh=make_mesh(8),
         )
     with pytest.raises(ValueError, match="pallas"):
         streamed_fuzzy_fit(
             NpzStream(x, 200), 3, 2, init=x[:3], max_iters=2, tol=-1.0,
             kernel="pallas", sample_weight_batches=wstream,
         )
-    with pytest.raises(ValueError, match="pallas"):
-        kmeans_fit(x, 3, init=x[:3], kernel="pallas", sample_weight=w)
+    with pytest.raises(ValueError, match="single-device"):
+        kmeans_fit(x[:1192], 3, init=x[:3], kernel="pallas",
+                   sample_weight=w[:1192], mesh=make_mesh(8))
 
 
 def test_minibatch_reassignment_revives_dead_centers():
