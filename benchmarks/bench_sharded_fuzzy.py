@@ -1,0 +1,118 @@
+"""K=16,384 · d=768 sharded FUZZY C-Means benchmark (round-4 VERDICT #1:
+fuzzy — the reference's fastest algorithm, 326 M pt·iter/s at K=3 in its
+log — deserved the Lloyd tower's large-K treatment).
+
+Measures one fuzzy step of the K-sharded tower (parallel/sharded_k.
+make_sharded_fuzzy_stats + the M-step ratio) with the two-pass Pallas
+kernels inside the shard: pass 1 streams K-tiles to build the per-point
+membership normalizer, a psum over the model axis globalizes it, pass 2
+re-streams the K-tiles accumulating the u^m-weighted moments. No (N, K)
+or (N, K/Pm) tile exists anywhere; the only N-sized arrays are the (N, 1)
+normalizer columns.
+
+Roofline note (distance-only convention, 2·K·d = 25.17 MFLOP/pt·iter,
+v5e bf16 peak 197 TFLOP/s ⇒ 7.83 M pt·iter/s): the two-pass design pays
+the distance FLOPs TWICE (the normalizer pass and the accumulate pass
+recompute the same d² tiles — the price of never materializing (N, K)),
+plus the accumulate pass's second MXU contraction (u^m @ x, another
+2·K·d). So the fuzzy step's hard ceiling is 197/(6·K·d) = **2.61 M
+pt·iter/s** — committed numbers should be read against that, not the
+Lloyd tower's 7.83 M. The reference's own fuzzy/Lloyd ratio at K=15 was
+similar (59 M vs 31 M — its fuzzy did ~2× the work per point too, with
+the full membership matrix materialized per GPU).
+
+Run:  python benchmarks/bench_sharded_fuzzy.py
+Prints one JSON line per configuration (bench.py conventions: robust
+slope timing, min-of-repeats, D2H sync).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tdc_tpu.parallel.sharded_k import make_mesh_2d, make_sharded_fuzzy_stats
+
+BASE_RATE = 40.7e6 * (3 * 5)  # reference best fuzzy per-GPU rate x (K*d)
+
+
+def measure(step_fn, x, c, iters_short=7, iters_long=21, repeats=3):
+    """Per-iteration seconds from the slope between per-length MIN times
+    (bench.py timing notes: constant dispatch/fetch overhead cancels;
+    min-per-length is robust against tunnel hiccups, which only ADD)."""
+
+    def chain(iters):
+        ci = c
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            wsums, weights, _ = step_fn(x, ci)
+            ci = wsums / jnp.maximum(weights[:, None], 1e-12)
+        np.asarray(ci)  # true sync: D2H fetch
+        return time.perf_counter() - t0
+
+    t_short = min(chain(iters_short) for _ in range(repeats))
+    t_long = min(chain(iters_long) for _ in range(repeats))
+    return max((t_long - t_short) / (iters_long - iters_short), 1e-9)
+
+
+def run(tag, mesh, n, k, d, kernel, block_rows):
+    rng = np.random.default_rng(0)
+    dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+    x = jnp.asarray(rng.normal(size=(n, d)), dtype)
+    c = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+    c = jax.device_put(c, NamedSharding(mesh, P("model", None)))
+    stats = jax.jit(
+        make_sharded_fuzzy_stats(mesh, 2.0, 1e-9, block_rows=block_rows,
+                                 kernel=kernel)
+    )
+    np.asarray(stats(x, c)[0])  # compile + warm
+    per_iter = measure(stats, x, c)
+    value = n / per_iter
+    base = BASE_RATE / (k * d)
+    # Fuzzy two-pass ceiling: 6*K*d FLOPs/pt (see module docstring).
+    ceiling = 197e12 / (6.0 * k * d)
+    print(
+        json.dumps(
+            {
+                "metric": f"sharded_fuzzy_pt_iter_per_s_{tag}_K{k}_d{d}",
+                "value": round(value, 1),
+                "unit": "pt*iter/s",
+                "vs_baseline": round(value / base, 2),
+                "pct_of_twopass_ceiling": round(100.0 * value / ceiling, 1),
+            }
+        )
+    )
+
+
+def main():
+    import os
+
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        try:
+            jax.config.update("jax_platforms", env_platforms)
+        except Exception:
+            pass
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        # The real regime, single chip (one model shard holds all of K):
+        # N = 1M bf16 (1.5 GB) — the two-pass kernel re-reads x per K-tile
+        # pair, so N is HBM-bound lower than the Lloyd bench's 2M.
+        run("1chip", make_mesh_2d(1, 1), n=1 << 20, k=16384, d=768,
+            kernel="pallas", block_rows=0)
+    else:
+        run("1dev_cpu", make_mesh_2d(1, 1), n=1 << 14, k=2048, d=128,
+            kernel="xla", block_rows=1 << 12)
+        if len(jax.devices()) >= 8:
+            run("2x4_cpu", make_mesh_2d(2, 4), n=1 << 14, k=2048, d=128,
+                kernel="xla", block_rows=1 << 12)
+
+
+if __name__ == "__main__":
+    main()

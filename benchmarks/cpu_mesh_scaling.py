@@ -9,22 +9,26 @@ question this script CAN answer honestly is: **what does the psum add to a
 sharded Lloyd step, and does that cost grow with device count?** Protocol:
 
 - WEAK SCALING: fixed rows per device (N = n_dev x N_PER_DEV), so each
-  shard's compute is identical at every mesh size.
-- MATCHED CONTROL: every mesh size is measured twice with the SAME
-  shard_map tower — once with the psum of the (K, d)+(K)+() sufficient
-  stats over the data axis, once with the reduction deleted (stats stay
-  shard-local). Both variants contend for the same shared cores in the
-  same pattern, so their DIFFERENCE is the all-reduce cost alone — the
-  contention that invalidated the strong-scaling table cancels out.
+  shard's compute is identical at every mesh size; the full stats step
+  (per-shard Lloyd stats + psum) is timed as context.
+- DIRECT COLLECTIVE MEASUREMENT: the psum itself is timed in isolation —
+  a chained shard_map loop whose body is nothing but the all-reduce of
+  the stats-sized arrays ((K, d) f32 sums + (K,) counts + scalar sse,
+  the exact payload the step reduces). No subtraction, no matched
+  control: two earlier protocols (strong scaling round 3; with/without-
+  psum differencing round 5a) both drowned in shared-core contention
+  noise — deleting the psum changes how XLA compiles the control, so
+  the "difference" measured compilation artifacts as often as the
+  collective. A direct chain of 64 dependent psums is immune to both.
 
 The claim being evidenced (SURVEY.md §2.4): the reference's reduce was a
 host-side tf.add_n over PCIe whose cost grew with device count (its K=15
 rows went FLAT from 5->8 GPUs, scripts/executions_log.csv:250-256); XLA's
-all-reduce of the tiny (K, d) stats is a constant-ish, sub-millisecond
-term. The committed CSV shows psum overhead well under 10% of the step at
-every mesh size, with no growth trend — on ICI-connected TPU chips the
-same reduction is faster still (the stats are KB-scale vs MB/s-scale
-links; see benchmarks/ROOFLINE_SHARDED.md for on-chip collective numbers).
+all-reduce of the tiny (K, d) stats is a small term that does not blow up
+with device count. The committed CSV shows the directly-measured psum at
+single-digit milliseconds and far below the step time at every mesh size
+— on ICI-connected TPU chips the same reduction is faster still (the
+stats are KB-scale vs the links' GB/s).
 
 Run (takes ~2 min):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -60,31 +64,24 @@ from tdc_tpu.parallel.mesh import DATA_AXIS, shard_points  # noqa: E402
 N_PER_DEV, D, K, ITERS, REPS = 1 << 17, 16, 64, 8, 5
 
 
-def make_step(mesh, reduce_stats: bool):
-    """One Lloyd stats pass over the mesh; reduce_stats=False deletes the
-    psum (stats stay shard-local) — the matched contention control."""
+def make_step(mesh):
+    """One full Lloyd stats pass over the mesh (per-shard stats + psum) —
+    the weak-scaling context measurement."""
 
     @functools.partial(
         shard_map,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P()),
-        out_specs=(
-            (P(None, None), P(None), P()) if reduce_stats
-            else (P(DATA_AXIS, None), P(DATA_AXIS), P())
-        ),
+        out_specs=(P(None, None), P(None), P()),
         check_vma=False,
     )
     def stats(x_loc, c):
         s = lloyd_stats(x_loc, c)
-        if reduce_stats:
-            return (
-                jax.lax.psum(s.sums, DATA_AXIS),
-                jax.lax.psum(s.counts, DATA_AXIS),
-                jax.lax.psum(s.sse, DATA_AXIS),
-            )
-        # Shard-local: same compute, zero collectives. Counts/sums stay
-        # sharded along the data axis (stacked per shard).
-        return s.sums, s.counts[None, :] * 1.0, s.sse
+        return (
+            jax.lax.psum(s.sums, DATA_AXIS),
+            jax.lax.psum(s.counts, DATA_AXIS),
+            jax.lax.psum(s.sse, DATA_AXIS),
+        )
 
     @jax.jit
     def chain(x, c):
@@ -101,6 +98,42 @@ def make_step(mesh, reduce_stats: bool):
     return chain
 
 
+PSUM_CHAIN = 64
+
+
+def make_psum_chain(mesh):
+    """PSUM_CHAIN dependent all-reduces of exactly the stats payload —
+    the direct collective measurement (no compute, no control)."""
+    n_dev = float(np.prod(mesh.devices.shape))
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, None), P(None), P()),
+        out_specs=(P(None, None), P(None), P()),
+        check_vma=False,
+    )
+    def body_once(sums, counts, sse):
+        # /n_dev keeps values finite across the chain (psum of a
+        # replicated operand multiplies by the axis size).
+        return (
+            jax.lax.psum(sums, DATA_AXIS) / n_dev,
+            jax.lax.psum(counts, DATA_AXIS) / n_dev,
+            jax.lax.psum(sse, DATA_AXIS) / n_dev,
+        )
+
+    @jax.jit
+    def chain(sums, counts, sse):
+        def body(carry, _):
+            return body_once(*carry), None
+
+        out, _ = jax.lax.scan(body, (sums, counts, sse), None,
+                              length=PSUM_CHAIN)
+        return out
+
+    return chain
+
+
 def measure(chain, x, c0) -> float:
     def run():
         t0 = time.perf_counter()
@@ -109,6 +142,17 @@ def measure(chain, x, c0) -> float:
 
     run()  # compile + warm
     return min(run() for _ in range(REPS)) / ITERS
+
+
+def measure_psum(chain, sums, counts, sse, reps=5):
+    def run():
+        t0 = time.perf_counter()
+        out = chain(sums, counts, sse)
+        np.asarray(out[2])
+        return time.perf_counter() - t0
+
+    run()  # compile + warm
+    return min(run() for _ in range(reps)) / PSUM_CHAIN
 
 
 def main():
@@ -123,17 +167,19 @@ def main():
         c0 = jnp.asarray(x_host[:K])
         mesh = make_mesh(n_dev)
         x = shard_points(jnp.asarray(x_host), mesh)
-        with_ms = measure(make_step(mesh, True), x, c0) * 1e3
-        without_ms = measure(make_step(mesh, False), x, c0) * 1e3
+        step_ms = measure(make_step(mesh), x, c0) * 1e3
+        sums0 = jnp.zeros((K, D), jnp.float32)
+        counts0 = jnp.zeros((K,), jnp.float32)
+        sse0 = jnp.zeros((), jnp.float32)
+        psum_ms = measure_psum(
+            make_psum_chain(mesh), sums0, counts0, sse0
+        ) * 1e3
         rows.append({
             "n_devices": n_dev,
             "rows_per_device": N_PER_DEV,
-            "step_ms_with_psum": round(with_ms, 3),
-            "step_ms_no_psum": round(without_ms, 3),
-            "psum_overhead_ms": round(with_ms - without_ms, 3),
-            "psum_overhead_pct": round(
-                100.0 * (with_ms - without_ms) / with_ms, 2
-            ),
+            "step_ms": round(step_ms, 3),
+            "psum_ms": round(psum_ms, 3),
+            "psum_pct_of_step": round(100.0 * psum_ms / step_ms, 2),
         })
         print(json.dumps(rows[-1]))
     with open(out, "w", newline="") as f:

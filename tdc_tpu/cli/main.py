@@ -244,9 +244,12 @@ def validate_args(parser, args):
         if args.kernel == "pallas":
             # Reject rather than silently downgrade to the XLA E-step — an
             # explicit kernel request must not record XLA numbers as Pallas.
-            if args.covariance_type != "diag" or args.weight_file:
+            if (args.covariance_type not in ("diag", "spherical")
+                    or args.weight_file):
                 parser.error("--kernel=pallas gaussianMixture supports the "
-                             "diag, unweighted E-step only")
+                             "diag/spherical, unweighted E-step only "
+                             "(spherical runs the diag kernel with the "
+                             "scalar variance broadcast)")
             # Only the EXPLICIT flag is checkable here: resolving the
             # implicit every-local-device default needs jax.device_count(),
             # which would initialize the backend before run_experiment's
@@ -531,10 +534,16 @@ def run_experiment(args) -> dict:
                 except Exception:
                     hbm = 16 << 30
                 needs_host = n_obs * per_pt > 0.4 * hbm
-                if not needs_host and args.dtype == "bfloat16":
-                    import jax.numpy as jnp
+            if args.dtype == "bfloat16":
+                # In-memory: one bf16 device copy instead of f32+cast.
+                # Host/streamed: bf16 host generation halves RAM AND the
+                # per-pass H2D transfer — the "batched bf16" configuration
+                # for the 100M×256 regime (a 100M×256 f32 host array would
+                # need ~205 GB at the generation concat peak; bf16 fits).
+                # Stats accumulate f32 either way.
+                import jax.numpy as jnp
 
-                    gen_dtype = jnp.bfloat16
+                gen_dtype = jnp.bfloat16
             if needs_host and use_features:
                 if args.layout == "features":
                     raise ValueError(

@@ -41,8 +41,13 @@ def make_blobs(
     if not features and layout != "samples":
         raise ValueError(f"unknown layout {layout!r}")
     # Feature-major chunks cost pad8(d)·n bytes instead of pad128(d)·n, so
-    # they can be much longer.
-    chunk = min(n_obs, (1 << 26) if features else (1 << 24))
+    # they can be much longer. Chunk rows are ALSO bounded by bytes, not
+    # rows alone: generation keeps ~3 live f32 buffers per chunk, so at
+    # d=256 a 2²⁴-row chunk was a 17 GB device allocation — past a v5e's
+    # entire HBM (round-5 config-4 OOM). ~0.5 GB per buffer keeps any d
+    # comfortably inside HBM with generation throughput unaffected.
+    by_bytes = max(1 << 18, (1 << 29) // (4 * max(n_dim, 1)))
+    chunk = min(n_obs, (1 << 26) if features else (1 << 24), by_bytes)
     key = jax.random.PRNGKey(seed)
     xs, ys = [], []
     remaining = n_obs

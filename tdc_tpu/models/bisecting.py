@@ -181,15 +181,22 @@ def bisecting_kmeans_fit(
             total_iters += int(res.n_iter)
             side = np.asarray(kmeans_predict(x, res.centroids))
             mask = labels == target
-            left = mask & (side == 0)
-            right = mask & (side == 1)
+            # Validity demands a POSITIVE-WEIGHT member on each side: a
+            # zero-weight row (mesh padding, or a zero base weight) landing
+            # alone on one side would otherwise validate a split whose new
+            # cluster has zero real mass (round-5 review finding).
+            pos = mask if base_w is None else (mask & (np.asarray(w) > 0))
+            left = pos & (side == 0)
+            right = pos & (side == 1)
             if not left.any() or not right.any():
                 # Degenerate split (duplicate points): this cluster cannot
                 # be divided — mark it and pick another candidate.
                 splittable[target] = False
                 continue
             break
-        labels[right] = next_label
+        # Relabel EVERY member row by its side (zero-weight rows carry no
+        # mass but still belong to one side of the hierarchy).
+        labels[mask & (side == 1)] = next_label
         new_centers = np.asarray(res.centroids, np.float32)
         centers[target] = new_centers[0]
         centers = np.concatenate([centers, new_centers[1:2]], axis=0)
@@ -426,8 +433,12 @@ def streamed_bisecting_kmeans_fit(
                 )
                 mask = labels_chunks[i] == target
                 sides.append((mask, side))
-                any_left = any_left or bool((mask & (side == 0)).any())
-                any_right = any_right or bool((mask & (side == 1)).any())
+                # Positive-weight members only (the in-memory fit's rule):
+                # a zero-weight row alone on one side must not validate
+                # the split.
+                pos = mask if not weighted else (mask & (w_chunks[i] > 0))
+                any_left = any_left or bool((pos & (side == 0)).any())
+                any_right = any_right or bool((pos & (side == 1)).any())
             if not any_left or not any_right:
                 splittable[target] = False
                 continue

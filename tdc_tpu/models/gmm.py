@@ -228,9 +228,17 @@ def _em_loop(x, means0, cov0, weights0, max_iters: int, tol: float,
 
     def e_and_stats(means, cov, log_weights):
         if kernel == "pallas":
-            # Fused Pallas E-step (diag, unweighted — validated upstream).
+            # Fused Pallas E-step (diag/spherical, unweighted — validated
+            # upstream). Spherical is the diag kernel with the per-component
+            # scalar variance broadcast across d: identical log-density, and
+            # the (K, d) second moment is exactly what the spherical M-step
+            # consumes (it averages over d).
+            var_d = (
+                cov if cov_type == "diag"
+                else jnp.broadcast_to(cov[:, None], (cov.shape[0], d))
+            )
             ll_sum, nk, sx, s2 = gmm_stats_auto(
-                x, means, cov, jnp.exp(log_weights)
+                x, means, var_d, jnp.exp(log_weights)
             )
             return ll_sum / n, nk, sx, s2
         logp = _log_prob_t(x, means, cov, log_weights, cov_type)  # (N, K)
@@ -326,9 +334,11 @@ def gmm_fit(
         each point's responsibilities (equivalent to repeating rows; an API
         sklearn.mixture itself lacks).
       kernel: 'xla' (default) or 'pallas' — the fused single-pass E-step
-        kernel (ops/pallas_kernels.gmm_stats_fused); diag, unweighted,
-        single-device only, and raises beyond the VMEM-feasible K·d (an
-        explicit 'pallas' request must not silently record XLA numbers).
+        kernel (ops/pallas_kernels.gmm_stats_fused); diag or spherical
+        (the scalar variance broadcasts through the diag kernel —
+        identical log-density), unweighted, single-device only, and
+        raises beyond the VMEM-feasible K·d (an explicit 'pallas' request
+        must not silently record XLA numbers).
     """
     x = jnp.asarray(x)
     n, d = x.shape
@@ -346,12 +356,13 @@ def gmm_fit(
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
     if kernel == "pallas" and (
-        covariance_type != "diag" or sample_weight is not None
+        covariance_type not in ("diag", "spherical")
+        or sample_weight is not None
         or mesh is not None
     ):
         raise ValueError(
-            "kernel='pallas' supports the diag, unweighted, single-device "
-            "E-step only"
+            "kernel='pallas' supports the diag/spherical, unweighted, "
+            "single-device E-step only"
         )
     if kernel == "pallas":
         # Reject infeasible K·d up front: gmm_stats_auto would otherwise
@@ -566,8 +577,14 @@ def _accumulate_gmm(acc, batch, means, variances, weights, n_valid,
     (single-device diag streams only)."""
     log_w = jnp.log(weights)
     if kernel == "pallas":
+        var_d = (
+            variances if cov_type == "diag"
+            else jnp.broadcast_to(
+                variances[:, None], (variances.shape[0], batch.shape[1])
+            )
+        )
         ll_b, nk_b, sx_b, sxx_b = gmm_stats_auto(
-            batch, means, variances, weights
+            batch, means, var_d, weights
         )
     else:
         logp = _log_prob_t(batch, means, variances, log_w, cov_type)
@@ -697,9 +714,11 @@ def streamed_gmm_fit(
         raise ValueError(
             "streamed kernel='pallas' supports single-device streams only"
         )
-    if kernel == "pallas" and covariance_type != "diag":
+    if kernel == "pallas" and covariance_type not in ("diag", "spherical"):
         raise ValueError(
-            "streamed kernel='pallas' supports covariance_type='diag' only"
+            "streamed kernel='pallas' supports covariance_type "
+            "'diag'/'spherical' only (spherical runs the diag kernel with "
+            "the scalar variance broadcast)"
         )
     weighted = sample_weight_batches is not None
     if kernel == "pallas" and weighted:

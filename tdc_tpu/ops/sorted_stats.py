@@ -151,6 +151,161 @@ def _windowed_stats_pallas(
     return compact[:cap, :d]
 
 
+def _gathered_windowed_kernel(
+    wi_ref, ord_cur, ord_nxt, loc_ref, x_any, out0_ref, out1_ref,
+    buf, sems, *, window, precision,
+):
+    """_windowed_stats_kernel with the x[order] row gather fused in: per
+    grid step, block i+1's rows are issued as per-row HBM→VMEM async
+    copies (row indices from the SMEM-tiled `order`) while block i's
+    one-hot matmul runs — the gather's DMA-descriptor cost (the round-4b
+    "honest remaining gap": 35.9 ms/step at N=2M, ~18 ns/row, issue-bound
+    not bandwidth-bound) hides behind the stats MXU work instead of
+    serializing before it.
+
+    Double-buffered: buf[(i+1) % 2] fills while buf[i % 2] computes; step 0
+    issues and waits its own rows first. Waits are per-row against the
+    same-shaped destination slice (the byte-count the DMA semaphore
+    tracks), matching the per-row issues exactly — the last block issues
+    nothing, so no copy is left in flight at kernel end.
+
+    **MEASURED DEAD END (round 5, v5e, jax 0.9 Mosaic)** — interpret-mode
+    correct (tested), but every hardware layout for the per-row DMA fails
+    Mosaic's tiling rules:
+    - 2-D src/dst row slices: "Slice shape along dimension 0 must be
+      aligned to tiling (8), but is 1" (both HBM src and VMEM dst).
+    - flat 1-D src (row stride padded to the 1-D tile, 1024 el for bf16)
+      → flat 1-D dst: DMAs compile, but the compute-side
+      (block·d,)→(block, d) view is an "unsupported shape cast".
+    - flat 1-D src → 2-D row dst: the dst slice hits the first rule.
+    And even compiled, the fusion cannot reach the 6 M sharded-step
+    target: the gather data-depends on the argmin pass (labels → sort →
+    order), so its ~36 ms descriptor floor can only overlap the ~17 ms
+    one-hot stats matmul — best case ≈ 345 ms/step ≈ 5.8 M
+    (benchmarks/ROOFLINE_SHARDED.md, round-5 section)."""
+    i = pl.program_id(0)
+    nb = pl.num_programs(0)
+    block = buf.shape[1]
+    slot = jax.lax.rem(i, 2)
+    nxt = jax.lax.rem(i + 1, 2)
+
+    def issue(ord_smem, slot_idx):
+        def body(r, _):
+            row = ord_smem[r, 0]
+            pltpu.make_async_copy(
+                x_any.at[pl.ds(row, 1), :],
+                buf.at[slot_idx, pl.ds(r, 1), :],
+                sems.at[slot_idx],
+            ).start()
+            return 0
+
+        jax.lax.fori_loop(0, block, body, 0)
+
+    def drain(slot_idx):
+        def body(r, _):
+            pltpu.make_async_copy(
+                x_any.at[pl.ds(0, 1), :],
+                buf.at[slot_idx, pl.ds(r, 1), :],
+                sems.at[slot_idx],
+            ).wait()
+            return 0
+
+        jax.lax.fori_loop(0, block, body, 0)
+
+    @pl.when(i == 0)
+    def _():
+        issue(ord_cur, slot)
+
+    @pl.when(i + 1 < nb)
+    def _():
+        issue(ord_nxt, nxt)
+
+    drain(slot)
+
+    fresh = (i == 0) | (wi_ref[i] != wi_ref[jnp.maximum(i - 1, 0)])
+
+    @pl.when(fresh)
+    def _():
+        out0_ref[...] = jnp.zeros(out0_ref.shape, out0_ref.dtype)
+        out1_ref[...] = jnp.zeros(out1_ref.shape, out1_ref.dtype)
+
+    xs = buf[slot]
+    col = jax.lax.broadcasted_iota(jnp.int32, (block, 2 * window), 1)
+    oh = (loc_ref[...] == col).astype(xs.dtype)  # (B, 2W) block-local
+    part = jax.lax.dot_general(
+        oh,
+        xs,
+        (((0,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    )  # (2W, d)
+    out0_ref[...] += part[:window, :]
+    out1_ref[...] += part[window:, :]
+
+
+def _gathered_windowed_stats_pallas(
+    x: jax.Array,
+    order: jax.Array,
+    local: jax.Array,
+    wi: jax.Array,
+    cap: int,
+    *,
+    block: int,
+    interpret: bool,
+    precision,
+) -> jax.Array:
+    """(cap, d) f32 compact per-rank sums — _windowed_stats_pallas with the
+    row gather fused into the kernel (x arrives UNSORTED; `order` is the
+    sort permutation, consumed as SMEM tiles). Same contract otherwise."""
+    n_pad, d = x.shape
+    nb = n_pad // block
+    d_pad = -(-d // 128) * 128
+    if d_pad != d:
+        x = jnp.pad(x, ((0, 0), (0, d_pad - d)))
+    t_cover = -(-cap // block) + 2
+    out_shape = jax.ShapeDtypeStruct((t_cover * block, d_pad), jnp.float32)
+    order2 = order.reshape(n_pad, 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block, 1), lambda i, wi_ref: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (block, 1),
+                lambda i, wi_ref: (jnp.minimum(i + 1, pl.num_programs(0) - 1), 0),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec((block, 1), lambda i, wi_ref: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, d_pad), lambda i, wi_ref: (wi_ref[i], 0)),
+            pl.BlockSpec((block, d_pad), lambda i, wi_ref: (wi_ref[i] + 1, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block, d_pad), x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out0, out1 = pl.pallas_call(
+        functools.partial(
+            _gathered_windowed_kernel, window=block, precision=precision
+        ),
+        grid_spec=grid_spec,
+        out_shape=[out_shape, out_shape],
+        interpret=interpret,
+    )(wi, order2, order2, local, x)
+
+    row = jax.lax.broadcasted_iota(jnp.int32, (t_cover * block, 1), 0)
+    wi_last = wi[-1]
+    lo_valid = row < (wi_last + 1) * block
+    hi_valid = (row >= block) & (row < (wi_last + 2) * block)
+    compact = jnp.where(lo_valid, out0, 0.0) + jnp.where(hi_valid, out1, 0.0)
+    return compact[:cap, :d]
+
+
 def sorted_cluster_stats(
     x: jax.Array,
     labels: jax.Array,
@@ -159,6 +314,7 @@ def sorted_cluster_stats(
     block: int = 512,
     pallas: bool = False,
     interpret: bool | None = None,
+    fuse_gather: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """(Σx per cluster (k, d) f32, counts (k,) f32) from per-point labels.
 
@@ -177,6 +333,15 @@ def sorted_cluster_stats(
     kernel (_windowed_stats_pallas): same math, but the accumulator tiles stay
     resident in VMEM across the blocks that touch them instead of being
     dynamic-slice read-modify-written per block (interpret auto-True off-TPU).
+    fuse_gather=True additionally folds the x[order] row gather into that
+    kernel as per-row async DMAs issued one block ahead
+    (_gathered_windowed_stats_pallas). MEASURED DEAD END on current
+    Mosaic/v5e — default False; interpret-mode only. See the gathered
+    kernel's docstring for the three compile-blocked layouts and
+    benchmarks/ROOFLINE_SHARDED.md round-5 for why even a working fusion
+    cannot reach the 6 M target (the gather's ~36 ms descriptor floor can
+    only overlap the ~17 ms stats matmul, never the argmin pass it
+    data-depends on).
     """
     n, d = x.shape
     if pallas:
@@ -197,14 +362,11 @@ def sorted_cluster_stats(
     nb = n_pad // block
 
     # One stable sort carries the permutation along with the keys (an extra
-    # keys = labels[order] scalar gather measured 3.7 ms at N=524k). The row
-    # gather uses index syntax, not jnp.take — jnp.take's clip-mode gather
-    # lowers ~50x slower for this shape on v5e (287 ms vs 5.2 ms, round 4).
+    # keys = labels[order] scalar gather measured 3.7 ms at N=524k).
     keys, order = jax.lax.sort(
         (labels, jnp.arange(n_pad, dtype=jnp.int32)), num_keys=1,
         is_stable=True,
     )
-    xs = x[order]
 
     lo = jnp.searchsorted(keys, jnp.arange(k + 1, dtype=jnp.int32))
     counts = (lo[1:] - lo[:-1]).astype(jnp.float32)
@@ -221,11 +383,8 @@ def sorted_cluster_stats(
 
     if x.dtype == jnp.bfloat16:
         oh_dtype, precision = jnp.bfloat16, jax.lax.Precision.DEFAULT
-        xmm = xs
     else:
         oh_dtype, precision = jnp.float32, jax.lax.Precision.HIGHEST
-        xmm = xs.astype(jnp.float32)
-    xb = xmm.reshape(nb, block, d)
 
     # Compact accumulator: ≤ min(k+1, n_pad) distinct labels exist, and the
     # last window starts at most at rank U−1, so U + block rows always hold
@@ -237,11 +396,31 @@ def sorted_cluster_stats(
             interpret = jax.devices()[0].platform != "tpu"
         wi = (base // block).astype(jnp.int32)  # (nb,) tile index, +≤1 steps
         loc_w = (rb - (wi * block)[:, None]).reshape(n_pad, 1)  # ∈ [0, 2B)
-        compact = _windowed_stats_pallas(
-            xmm, loc_w, wi, cap,
-            block=block, interpret=interpret, precision=precision,
-        )
+        if fuse_gather:
+            # Rows gathered INSIDE the kernel (round-5): x stays unsorted;
+            # the permutation streams through SMEM tiles and the per-row
+            # DMAs overlap the previous block's one-hot matmul.
+            xg = x if x.dtype == jnp.bfloat16 else x.astype(jnp.float32)
+            compact = _gathered_windowed_stats_pallas(
+                xg, order, loc_w, wi, cap,
+                block=block, interpret=interpret, precision=precision,
+            )
+        else:
+            # Pre-gathered variant (index syntax, not jnp.take — the
+            # clip-mode gather lowers ~50x slower on v5e: 287 vs 5.2 ms).
+            xmm = x[order]
+            if x.dtype != jnp.bfloat16:
+                xmm = xmm.astype(jnp.float32)
+            compact = _windowed_stats_pallas(
+                xmm, loc_w, wi, cap,
+                block=block, interpret=interpret, precision=precision,
+            )
     else:
+        xmm = x[order]
+        if x.dtype != jnp.bfloat16:
+            xmm = xmm.astype(jnp.float32)
+        xb = xmm.reshape(nb, block, d)
+
         def body(acc, inp):
             xblk, lblk, b = inp
             col = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)

@@ -650,3 +650,36 @@ def test_streamed_mesh_full_covariance_matches(aniso_blobs):
     np.testing.assert_allclose(np.asarray(single.variances),
                                np.asarray(meshed.variances),
                                rtol=1e-3, atol=1e-5)
+
+
+def test_pallas_spherical_matches_xla(aniso_blobs):
+    """Round-5: the spherical covariance type rides the diag Pallas E-step
+    (scalar variance broadcast across d — identical log-density); the fit
+    must match the XLA E-step, in-memory and streamed."""
+    from tdc_tpu.models.gmm import streamed_gmm_fit
+
+    x, _, _ = aniso_blobs
+    init = x[:3]
+    a = gmm_fit(x, 3, init=init, max_iters=12, tol=-1.0,
+                covariance_type="spherical", kernel="xla")
+    b = gmm_fit(x, 3, init=init, max_iters=12, tol=-1.0,
+                covariance_type="spherical", kernel="pallas")
+    np.testing.assert_allclose(np.asarray(a.means), np.asarray(b.means),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(a.variances),
+                               np.asarray(b.variances), rtol=1e-3)
+    np.testing.assert_allclose(float(a.log_likelihood),
+                               float(b.log_likelihood), rtol=1e-4)
+
+    def batches():
+        for i in range(0, len(x), 250):
+            yield x[i:i + 250]
+
+    sa = streamed_gmm_fit(batches, 3, 2, init=init, max_iters=12, tol=-1.0,
+                          covariance_type="spherical", kernel="xla")
+    sb = streamed_gmm_fit(batches, 3, 2, init=init, max_iters=12, tol=-1.0,
+                          covariance_type="spherical", kernel="pallas")
+    np.testing.assert_allclose(np.asarray(sa.means), np.asarray(sb.means),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(float(sa.log_likelihood),
+                               float(sb.log_likelihood), rtol=1e-4)

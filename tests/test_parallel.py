@@ -125,11 +125,12 @@ def test_uneven_shard_raises(blobs_small):
 
 
 def test_cpu_mesh_scaling_artifact_integrity():
-    """The committed collective-overhead table (round-5 weak-scaling
-    protocol with matched no-psum controls) stays parseable and shaped:
-    1/2/4/8 devices, positive step times, and the property the table
-    documents — psum overhead bounded (<10% of the step) with no blow-up
-    at larger meshes."""
+    """The committed collective-overhead table (round-5 direct-psum
+    protocol: the all-reduce of the exact stats payload timed in
+    isolation, weak-scaling step times as context) stays parseable and
+    shaped: 1/2/4/8 devices, positive step times, and the property the
+    table documents — the directly-measured psum is a tiny fraction of
+    the step (<5%) with no blow-up at larger meshes."""
     import csv
     import os
 
@@ -139,6 +140,6 @@ def test_cpu_mesh_scaling_artifact_integrity():
     rows = list(csv.DictReader(open(path)))
     assert [int(r["n_devices"]) for r in rows] == [1, 2, 4, 8]
     for r in rows:
-        assert float(r["step_ms_with_psum"]) > 0
-        assert float(r["step_ms_no_psum"]) > 0
-        assert float(r["psum_overhead_pct"]) < 10.0
+        assert float(r["step_ms"]) > 0
+        assert float(r["psum_ms"]) >= 0
+        assert float(r["psum_pct_of_step"]) < 5.0
