@@ -1,0 +1,57 @@
+"""Generate the config-4 dataset to disk: 100M × 256 bf16 blobs → /tmp/c4.npy
+(51.2 GB), consumed by benchmarks/config4_100m.json via --data_file.
+
+Why a disk file instead of the CLI's in-process synthetic path (round 5,
+measured the hard way): a 100M×256 run needs the dataset OUT of anonymous
+host memory. The tunneled device client pins a host-side staging copy per
+uploaded batch for longer than the batch's Python lifetime, so a streamed
+pass leaks ~dataset-size of anon RSS per pass; with the dataset ALSO
+resident (in-process generation), the second pass OOM-killed the run at
+130 GB RSS on a 125 GB host — twice. A memory-mapped npy moves the dataset
+into reclaimable page cache, which the kernel evicts under that pressure,
+and lets a checkpoint-resumed retry skip the ~50-minute regeneration
+(device→host through the tunnel runs at ~1 GB/min — the generation, not
+the fit, is the expensive part).
+
+bf16 on disk halves both the file and every pass's H2D (the npy format
+stores it as unstructured |V2; data/loader.load_points reinterprets).
+
+Run:  python benchmarks/gen_config4_data.py   (~50 min through the tunnel)
+Then: python -m tdc_tpu.cli.sweep benchmarks/config4_100m.json
+      (re-run the sweep to resume from /tmp/ckpt_c4 if an attempt dies).
+"""
+
+import time
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from tdc_tpu.data.synthetic import make_blobs
+
+N, D, K, SEED = 100_000_000, 256, 4096, 123128
+CHUNK = 4_000_000
+
+
+def main():
+    out = np.lib.format.open_memmap(
+        "/tmp/c4.npy", mode="w+", dtype=ml_dtypes.bfloat16, shape=(N, D)
+    )
+    t0 = time.time()
+    done = 0
+    while done < N:
+        n = min(CHUNK, N - done)
+        # Per-chunk seeds keep chunks independent draws of the same blob
+        # family (this is a data FILE, not seed-parity data — the fit's
+        # own seed governs everything downstream).
+        x, _ = make_blobs(SEED + 1 + done, n, D, K, to_host=True,
+                          dtype=jnp.bfloat16)
+        out[done:done + n] = x
+        done += n
+        print(f"{done / 1e6:.0f}M rows, {time.time() - t0:.0f}s", flush=True)
+    out.flush()
+    print("done", round(time.time() - t0, 1))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,12 +24,25 @@ def load_points(data_file: str, *, mmap: bool = True):
     """
     if data_file.endswith(".npz"):
         with np.load(data_file, allow_pickle=False) as z:
-            x = z["X"]
+            x = _restore_bf16(z["X"])
             y = z["Y"] if "Y" in z.files else None
         return x, y
     mode = "r" if mmap else None
     x = np.load(data_file, mmap_mode=mode)
-    return x, None
+    return _restore_bf16(x), None
+
+
+def _restore_bf16(x):
+    """The npy/npz formats cannot express bfloat16: ml_dtypes arrays
+    round-trip as unstructured '|V2'. Nothing else in this ecosystem
+    produces such files, so reinterpret — bf16 datasets halve the disk
+    footprint AND the per-pass H2D transfer for streamed runs (the
+    100M×256 regime)."""
+    if x.dtype.kind == "V" and x.dtype.itemsize == 2 and x.dtype.names is None:
+        import ml_dtypes
+
+        return x.view(ml_dtypes.bfloat16)
+    return x
 
 
 def batch_iterator(

@@ -22,7 +22,7 @@ and its N×K work could not exceed one device's memory (the root cause of its
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable, Iterable, NamedTuple
 
 import jax
@@ -395,18 +395,12 @@ def kmeans_fit_sharded(
         c = _normalize(c)
     x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
     c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
-    step = make_sharded_lloyd_step(mesh, kernel, block_rows, spherical)
-    x2sum = sum_sq(x)  # once per fit; the step then skips the ‖x‖² re-read
-
     # Whole fit loop device-side (round-4 VERDICT weak #2: the Python
     # iterate-and-float() loop here cost one device round trip per
     # iteration). Host syncs per fit: the loop-result fetch + the final SSE.
-    @jax.jit
-    def run(x, c0, x2sum):
-        return _device_loop(
-            lambda ci: step(x, ci, x.shape[0], x2sum), c0, max_iters, tol
-        )
-
+    run, step = _lloyd_fit_fns(mesh, kernel, block_rows, spherical,
+                               int(max_iters), float(tol))
+    x2sum = sum_sq(x)  # once per fit; the step then skips the ‖x‖² re-read
     c, shift_dev, i_dev, hist = run(x, c, x2sum)
     n_iter = int(i_dev)
     shift = float(shift_dev)
@@ -425,6 +419,112 @@ def kmeans_fit_sharded(
         converged=jnp.asarray(converged),
         history=np.asarray(hist)[:n_iter],
     )
+
+
+@lru_cache(maxsize=64)
+def _lloyd_fit_fns(mesh, kernel, block_rows, spherical, max_iters, tol):
+    """Per-configuration jitted (loop, step) pair for kmeans_fit_sharded,
+    cached module-wide: a fit call otherwise builds FRESH jit closures and
+    re-traces + re-compiles the whole while_loop every invocation —
+    measured ~6 s per fit through the remote-compile tunnel even with the
+    persistent XLA cache warm (round-5; repeated fits are the sweep
+    harness's bread and butter). Keyed by everything the trace closes over."""
+    step = make_sharded_lloyd_step(mesh, kernel, block_rows, spherical)
+
+    @jax.jit
+    def run(x, c0, x2sum):
+        return _device_loop(
+            lambda ci: step(x, ci, x.shape[0], x2sum), c0, max_iters, tol
+        )
+
+    return run, step
+
+
+@lru_cache(maxsize=64)
+def _gmm_fit_fns(mesh, block_rows, n, n_pad, reg_covar, max_iters, tol):
+    """gmm_fit_sharded's cached jitted EM loop — see _lloyd_fit_fns. The
+    device-side while_loop carries the last two mean log-likelihoods so
+    the sklearn lower_bound_ convergence test (gain ≤ tol after iteration
+    2) runs on-device — one host sync per fit, not per iteration."""
+    from tdc_tpu.models.gmm import _LOG_2PI
+
+    stats_fn = make_sharded_gmm_stats(mesh, block_rows=block_rows)
+
+    def step(x, means, variances, weights):
+        ll, nk, sx, sxx = stats_fn(x, means, variances, weights)
+        if n_pad:
+            # Exact zero-row correction: a zero row's log-prob is the
+            # x-independent bias term per component; it contributes its
+            # responsibilities to nk and its log-normalizer to ll, nothing
+            # to sx/sxx. Computed from the K-sharded parameter vectors.
+            d = x.shape[1]
+            logp0 = (
+                -0.5 * (
+                    jnp.sum(means**2 / variances, axis=1)
+                    + jnp.sum(jnp.log(variances), axis=1)
+                    + d * _LOG_2PI
+                )
+                + jnp.log(weights)
+            )
+            mx0 = jnp.max(logp0)
+            norm0 = mx0 + jnp.log(jnp.sum(jnp.exp(logp0 - mx0)))
+            nk = nk - n_pad * jnp.exp(logp0 - norm0)
+            ll = ll - n_pad * norm0
+        safe = jnp.maximum(nk, 1e-12)[:, None]
+        new_means = sx / safe
+        new_vars = jnp.maximum(sxx / safe - new_means**2, 0.0) + reg_covar
+        new_w = jnp.maximum(nk / n, 1e-12)
+        new_w = new_w / jnp.sum(new_w)
+        return ll / n, new_means, new_vars, new_w
+
+    @jax.jit
+    def run(x, means0, var0, w0):
+        def cond(carry):
+            _, _, _, ll, prev_ll, i = carry
+            return jnp.logical_and(
+                i < max_iters,
+                jnp.logical_or(i < 2, ll - prev_ll > tol),
+            )
+
+        def body(carry):
+            means, var, w, ll_old, _, i = carry
+            ll, nm, nv, nw = step(x, means, var, w)
+            return nm, nv, nw, ll, ll_old, i + 1
+
+        neg_inf = jnp.asarray(-jnp.inf, jnp.float32)
+        return jax.lax.while_loop(
+            cond, body,
+            (means0, var0, w0, neg_inf, neg_inf, jnp.asarray(0, jnp.int32)),
+        )
+
+    return run
+
+
+@lru_cache(maxsize=64)
+def _fuzzy_fit_fns(mesh, m, block_rows, kernel, n_pad, max_iters, tol):
+    """fuzzy_fit_sharded's cached (loop, step) pair — see _lloyd_fit_fns."""
+    eps = 1e-9
+    stats_fn = make_sharded_fuzzy_stats(
+        mesh, m, eps, block_rows=block_rows, kernel=kernel
+    )
+
+    @jax.jit
+    def step(x, c):
+        wsums, weights, obj = stats_fn(x, c)
+        if n_pad:
+            weights, obj = _fuzzy_pad_correction(
+                weights, obj, c, n_pad, m, eps,
+                cast_dtype=x.dtype if kernel == "pallas" else None,
+            )
+        new_c = wsums / jnp.maximum(weights[:, None], 1e-12)
+        shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
+        return new_c, shift, obj
+
+    @jax.jit
+    def run(x, c0):
+        return _device_loop(lambda ci: step(x, ci), c0, max_iters, tol)
+
+    return run, step
 
 
 def _pad_rows_sharded(x, n_data: int, block_rows: int):
@@ -590,7 +690,6 @@ def fuzzy_fit_sharded(
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
-    eps = 1e-9
     c = _resolve_init_sharded(x, k, init, key)
     x, n_pad = _pad_rows_sharded(x, n_data, block_rows)
     if dtype is not None:
@@ -599,26 +698,8 @@ def fuzzy_fit_sharded(
         )
     x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
     c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
-    stats_fn = make_sharded_fuzzy_stats(
-        mesh, m, eps, block_rows=block_rows, kernel=kernel
-    )
-
-    @jax.jit
-    def step(x, c):
-        wsums, weights, obj = stats_fn(x, c)
-        if n_pad:
-            weights, obj = _fuzzy_pad_correction(
-                weights, obj, c, n_pad, m, eps,
-                cast_dtype=x.dtype if kernel == "pallas" else None,
-            )
-        new_c = wsums / jnp.maximum(weights[:, None], 1e-12)
-        shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
-        return new_c, shift, obj
-
-    @jax.jit
-    def run(x, c0):
-        return _device_loop(lambda ci: step(x, ci), c0, max_iters, tol)
-
+    run, step = _fuzzy_fit_fns(mesh, float(m), block_rows, kernel,
+                               int(n_pad), int(max_iters), float(tol))
     c, shift_dev, i_dev, hist = run(x, c)
     n_iter = int(i_dev)
     shift = float(shift_dev)
@@ -749,8 +830,6 @@ def gmm_fit_sharded(
     (sklearn's lower_bound_ criterion)."""
     from tdc_tpu.models.gmm import GMMResult
 
-    from tdc_tpu.models.gmm import _LOG_2PI
-
     n_data = mesh.devices.shape[0]
     n_model = mesh.devices.shape[1]
     if not isinstance(x, np.ndarray):
@@ -782,60 +861,8 @@ def gmm_fit_sharded(
                          else P(MODEL_AXIS, None))
     )
     means, variances, weights = map(put_k, (means, variances, weights))
-    stats_fn = make_sharded_gmm_stats(mesh, block_rows=block_rows)
-
-    @jax.jit
-    def step(x, means, variances, weights):
-        ll, nk, sx, sxx = stats_fn(x, means, variances, weights)
-        if n_pad:
-            # Exact zero-row correction: a zero row's log-prob is the
-            # x-independent bias term per component; it contributes its
-            # responsibilities to nk and its log-normalizer to ll, nothing
-            # to sx/sxx. Computed from the K-sharded parameter vectors.
-            d = x.shape[1]
-            logp0 = (
-                -0.5 * (
-                    jnp.sum(means**2 / variances, axis=1)
-                    + jnp.sum(jnp.log(variances), axis=1)
-                    + d * _LOG_2PI
-                )
-                + jnp.log(weights)
-            )
-            mx0 = jnp.max(logp0)
-            norm0 = mx0 + jnp.log(jnp.sum(jnp.exp(logp0 - mx0)))
-            nk = nk - n_pad * jnp.exp(logp0 - norm0)
-            ll = ll - n_pad * norm0
-        safe = jnp.maximum(nk, 1e-12)[:, None]
-        new_means = sx / safe
-        new_vars = jnp.maximum(sxx / safe - new_means**2, 0.0) + reg_covar
-        new_w = jnp.maximum(nk / n, 1e-12)
-        new_w = new_w / jnp.sum(new_w)
-        return ll / n, new_means, new_vars, new_w
-
-    # Device-side EM loop: carry the last two mean log-likelihoods so the
-    # sklearn lower_bound_ convergence test (gain ≤ tol after iteration 2)
-    # runs inside the while_loop — one host sync per fit, not per iteration
-    # (round-4 VERDICT weak #2).
-    @jax.jit
-    def run(x, means0, var0, w0):
-        def cond(carry):
-            _, _, _, ll, prev_ll, i = carry
-            return jnp.logical_and(
-                i < max_iters,
-                jnp.logical_or(i < 2, ll - prev_ll > tol),
-            )
-
-        def body(carry):
-            means, var, w, ll_old, _, i = carry
-            ll, nm, nv, nw = step(x, means, var, w)
-            return nm, nv, nw, ll, ll_old, i + 1
-
-        neg_inf = jnp.asarray(-jnp.inf, jnp.float32)
-        return jax.lax.while_loop(
-            cond, body,
-            (means0, var0, w0, neg_inf, neg_inf, jnp.asarray(0, jnp.int32)),
-        )
-
+    run = _gmm_fit_fns(mesh, block_rows, int(n), int(n_pad),
+                       float(reg_covar), int(max_iters), float(tol))
     means, variances, weights, ll_dev, prev_ll_dev, i_dev = run(
         x, means, variances, weights
     )

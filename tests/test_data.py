@@ -99,3 +99,25 @@ def test_oom_adaptive_reraises_other_errors():
 
     with pytest.raises(ValueError):
         oom_adaptive(run)
+
+
+def test_load_points_bf16_npy_roundtrip(tmp_path):
+    """npy cannot express bfloat16 (saves as unstructured |V2);
+    load_points reinterprets such files back to bf16 — the disk format for
+    the 100M x 256 streamed regime (half the disk and H2D of f32)."""
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from tdc_tpu.data.loader import load_points
+
+    x = (np.arange(24, dtype=np.float32) / 3).reshape(6, 4)
+    p = str(tmp_path / "b.npy")
+    np.save(p, x.astype(ml_dtypes.bfloat16))
+    got, y = load_points(p)
+    assert y is None
+    assert got.dtype == ml_dtypes.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), x, rtol=1e-2, atol=1e-2
+    )
+    # jnp consumes it directly
+    assert jnp.asarray(got).dtype == jnp.bfloat16
