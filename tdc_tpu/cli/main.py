@@ -205,13 +205,10 @@ def validate_args(parser, args):
         if args.minibatch:
             parser.error("--minibatch and --shard_k are mutually exclusive")
         if args.method_name == "gaussianMixture":
-            # The K-sharded GMM tower is an in-memory f32 XLA step; the
-            # Lloyd and fuzzy towers are first-class (streamed / Pallas /
-            # bf16 / ckpt / history). Reject rather than silently ignore,
-            # per the CLI's standing rule.
-            if args.streamed or args.num_batches > 1:
-                parser.error("--shard_k streaming is kmeans/fuzzy only "
-                             "(the GMM shard tower is in-memory)")
+            # The K-sharded GMM tower runs in-memory AND streamed (round 5)
+            # but stays f32 XLA with no checkpoint/history; reject the
+            # unsupported combos rather than silently ignore, per the
+            # CLI's standing rule.
             if args.kernel == "pallas":
                 parser.error("--shard_k --kernel=pallas is kmeans/fuzzy "
                              "only (the GMM shard tower is an XLA matmul "
@@ -679,6 +676,18 @@ def run_experiment(args) -> dict:
                 dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
             )
         if mesh2d is not None and args.method_name == "gaussianMixture":
+            if streamed:
+                from tdc_tpu.parallel.sharded_k import (
+                    streamed_gmm_fit_sharded,
+                )
+
+                rows = -(-n_obs // num_batches)
+                return streamed_gmm_fit_sharded(
+                    make_stream(rows), args.K, n_dim, mesh2d,
+                    init=args.init, key=key, max_iters=args.n_max_iters,
+                    tol=args.tol, block_rows=shard_block(rows),
+                    prefetch=args.prefetch,
+                )
             from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
 
             return gmm_fit_sharded(

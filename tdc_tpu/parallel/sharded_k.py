@@ -440,36 +440,42 @@ def _lloyd_fit_fns(mesh, kernel, block_rows, spherical, max_iters, tol):
     return run, step
 
 
+def _gmm_pad_correction(nk, ll, means, variances, weights, n_pad, d):
+    """Exact zero-row correction for the K-sharded GMM stats: a zero row's
+    log-prob is the x-independent bias term per component; it contributes
+    its responsibilities to nk and its log-normalizer to ll, nothing to
+    sx/sxx. Computed from the K-sharded parameter vectors (the global max
+    and sum are auto-sharded reductions)."""
+    from tdc_tpu.models.gmm import _LOG_2PI
+
+    logp0 = (
+        -0.5 * (
+            jnp.sum(means**2 / variances, axis=1)
+            + jnp.sum(jnp.log(variances), axis=1)
+            + d * _LOG_2PI
+        )
+        + jnp.log(weights)
+    )
+    mx0 = jnp.max(logp0)
+    norm0 = mx0 + jnp.log(jnp.sum(jnp.exp(logp0 - mx0)))
+    n_pad = jnp.asarray(n_pad, jnp.float32)
+    return nk - n_pad * jnp.exp(logp0 - norm0), ll - n_pad * norm0
+
+
 @lru_cache(maxsize=64)
 def _gmm_fit_fns(mesh, block_rows, n, n_pad, reg_covar, max_iters, tol):
     """gmm_fit_sharded's cached jitted EM loop — see _lloyd_fit_fns. The
     device-side while_loop carries the last two mean log-likelihoods so
     the sklearn lower_bound_ convergence test (gain ≤ tol after iteration
     2) runs on-device — one host sync per fit, not per iteration."""
-    from tdc_tpu.models.gmm import _LOG_2PI
-
     stats_fn = make_sharded_gmm_stats(mesh, block_rows=block_rows)
 
     def step(x, means, variances, weights):
         ll, nk, sx, sxx = stats_fn(x, means, variances, weights)
         if n_pad:
-            # Exact zero-row correction: a zero row's log-prob is the
-            # x-independent bias term per component; it contributes its
-            # responsibilities to nk and its log-normalizer to ll, nothing
-            # to sx/sxx. Computed from the K-sharded parameter vectors.
-            d = x.shape[1]
-            logp0 = (
-                -0.5 * (
-                    jnp.sum(means**2 / variances, axis=1)
-                    + jnp.sum(jnp.log(variances), axis=1)
-                    + d * _LOG_2PI
-                )
-                + jnp.log(weights)
+            nk, ll = _gmm_pad_correction(
+                nk, ll, means, variances, weights, n_pad, x.shape[1]
             )
-            mx0 = jnp.max(logp0)
-            norm0 = mx0 + jnp.log(jnp.sum(jnp.exp(logp0 - mx0)))
-            nk = nk - n_pad * jnp.exp(logp0 - norm0)
-            ll = ll - n_pad * norm0
         safe = jnp.maximum(nk, 1e-12)[:, None]
         new_means = sx / safe
         new_vars = jnp.maximum(sxx / safe - new_means**2, 0.0) + reg_covar
@@ -1303,4 +1309,167 @@ def streamed_fuzzy_fit_sharded(
         converged=jnp.asarray(converged),
         history=_history_array(history),
         n_iter_run=n_iter - start_iter,
+    )
+
+
+class _ShardedGMMAcc(NamedTuple):
+    ll: jax.Array  # () — replicated
+    nk: jax.Array  # (K,) — K-sharded
+    sx: jax.Array  # (K, d) — K-sharded
+    sxx: jax.Array  # (K, d) — K-sharded
+
+
+def streamed_gmm_fit_sharded(
+    batches: Callable[[], Iterable],
+    k: int,
+    d: int,
+    mesh: Mesh,
+    *,
+    init="kmeans++",
+    key=None,
+    max_iters: int = 100,
+    tol: float = 1e-3,
+    reg_covar: float = 1e-6,
+    block_rows: int = 0,
+    prefetch: int = 0,
+):
+    """Exact out-of-core diag-covariance GMM EM under the 2-D (data ×
+    model) layout: each batch's K-sharded E-step sufficient statistics
+    (ll, nk, Σr·x, Σr·x²) accumulate on-device across the pass, the
+    M-step is a pure ratio of the totals, and the component state never
+    exists unsharded — the soft analog of streamed_kmeans_fit_sharded,
+    completing the --shard_k streaming story for all three methods.
+
+    Same batch contract as the other sharded streamed drivers. Seeding
+    mirrors gmm_fit_sharded (host subsample of the FIRST batch —
+    init='kmeans' is the unsharded mode and is rejected). Convergence is
+    the sklearn lower_bound_ criterion (mean log-likelihood gain ≤ tol
+    after iteration 2), which requires the per-iteration ll on host —
+    the GMM drivers are inherently sync-per-iteration, so there is no
+    deferred-fetch mode here. Checkpointing is not implemented (the gate
+    at cli/main.py documents it); an interrupted fit restarts.
+    """
+    from tdc_tpu.models.gmm import (
+        GMMResult,
+        _moments_from_hard_assign,
+    )
+    from tdc_tpu.models.streaming import _run_pass
+
+    n_data = int(mesh.devices.shape[0])
+    n_model = int(mesh.devices.shape[1])
+    if k % n_model != 0:
+        raise ValueError(f"K={k} not divisible by model axis {n_model}")
+    if isinstance(init, str) and init == "kmeans":
+        raise ValueError(
+            "streamed_gmm_fit_sharded seeds from a host subsample; "
+            "init='kmeans' (a full K-Means pre-fit) is the unsharded mode"
+        )
+    pad_multiple = n_data * max(block_rows, 1)
+
+    # Seed from the stream's first ≤65536 rows — the SAME prefix
+    # gmm_fit_sharded's host subsample sees on the equivalent in-memory
+    # array, so the two fits follow identical trajectories (a single-batch
+    # sample gave different init moments and measurably divergent EM).
+    chunks, got = [], 0
+    for b in batches():
+        b = np.asarray(b)
+        chunks.append(b)
+        got += b.shape[0]
+        if got >= 65536:
+            break
+    first = np.concatenate(chunks)[:65536]
+    means = _resolve_init_sharded(first, k, init, key)
+    variances, weights = _moments_from_hard_assign(
+        jnp.asarray(first, jnp.float32), means, reg_covar
+    )
+    put_k = lambda a: jax.device_put(
+        a, NamedSharding(mesh, P(MODEL_AXIS) if a.ndim == 1
+                         else P(MODEL_AXIS, None))
+    )
+    means, variances, weights = map(put_k, (means, variances, weights))
+
+    stats_fn = make_sharded_gmm_stats(mesh, block_rows=block_rows)
+
+    @jax.jit
+    def accumulate(acc, x, means, variances, weights, n_valid):
+        ll, nk, sx, sxx = stats_fn(x, means, variances, weights)
+        n_pad = x.shape[0] - n_valid
+        nk, ll = _gmm_pad_correction(
+            nk, ll, means, variances, weights, n_pad, d
+        )
+        return _ShardedGMMAcc(acc.ll + ll, acc.nk + nk, acc.sx + sx,
+                              acc.sxx + sxx)
+
+    @jax.jit
+    def m_step(acc, n_rows):
+        # The single shared M-step (models/gmm._m_step — its floors and
+        # variance clamp must never drift across drivers).
+        from tdc_tpu.models.gmm import _m_step
+
+        new_means, new_vars, new_w = _m_step(
+            acc.nk, acc.sx, acc.sxx, n_rows, reg_covar
+        )
+        return new_means, new_vars, new_w, acc.ll / n_rows
+
+    def zero_acc():
+        return _ShardedGMMAcc(
+            ll=jnp.zeros((), jnp.float32),
+            nk=jax.device_put(jnp.zeros((k,), jnp.float32),
+                              NamedSharding(mesh, P(MODEL_AXIS))),
+            sx=jax.device_put(jnp.zeros((k, d), jnp.float32),
+                              NamedSharding(mesh, P(MODEL_AXIS, None))),
+            sxx=jax.device_put(jnp.zeros((k, d), jnp.float32),
+                               NamedSharding(mesh, P(MODEL_AXIS, None))),
+        )
+
+    def put_batch(batch):
+        batch = np.asarray(batch)
+        n_valid = batch.shape[0]
+        rem = (-n_valid) % pad_multiple
+        if rem:
+            batch = np.pad(batch, ((0, rem), (0, 0)))
+        return (
+            jax.device_put(batch,
+                           NamedSharding(mesh, P(DATA_AXIS, None))),
+            n_valid,
+        )
+
+    rows_seen = [0]
+
+    def full_pass(means, variances, weights):
+        rows_seen[0] = 0
+
+        def pass_step(acc, batch):
+            maybe_beat()  # supervised-gang liveness
+            xb, n_valid = put_batch(batch)
+            rows_seen[0] += n_valid
+            return accumulate(acc, xb, means, variances, weights,
+                              n_valid), n_valid
+
+        return _run_pass(batches, prefetch, zero_acc, pass_step)
+
+    prev_ll = -float("inf")
+    ll = prev_ll
+    n_iter = 0
+    converged = False
+    for n_iter in range(1, max_iters + 1):
+        acc = full_pass(means, variances, weights)
+        means, variances, weights, ll_dev = m_step(acc, rows_seen[0])
+        ll = float(ll_dev)
+        if n_iter > 1 and ll - prev_ll <= tol:
+            converged = True
+            break
+        prev_ll = ll
+    # Final ll of the RETURNED parameters (the loop's ll is pre-update —
+    # parity with streamed_gmm_fit).
+    acc = full_pass(means, variances, weights)
+    final_ll = float(acc.ll) / max(rows_seen[0], 1)
+    return GMMResult(
+        means=means,
+        variances=variances,
+        weights=weights,
+        log_likelihood=jnp.asarray(final_ll, jnp.float32),
+        n_iter=jnp.asarray(n_iter, jnp.int32),
+        converged=jnp.asarray(converged),
+        covariance_type="diag",
     )

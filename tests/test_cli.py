@@ -117,9 +117,9 @@ def test_cli_shard_k_validation():
         )
         validate_args(parser, args)
     # fuzzy + shard_k is first-class since round 5 (streamed / pallas /
-    # bf16 / ckpt all valid); the GMM shard tower's unsupported combos must
-    # still fail fast.
-    for combo in ("--num_batches=4", "--kernel=pallas", "--ckpt_dir=/tmp/x",
+    # bf16 / ckpt all valid), GMM + shard_k streams too; the GMM shard
+    # tower's remaining unsupported combos must still fail fast.
+    for combo in ("--kernel=pallas", "--ckpt_dir=/tmp/x",
                   "--dtype=bfloat16"):
         with pytest.raises(SystemExit):
             args = parser.parse_args(
@@ -127,11 +127,18 @@ def test_cli_shard_k_validation():
                 "--method_name=gaussianMixture".split()
             )
             validate_args(parser, args)
-    # ...while the same combos parse clean for fuzzy.
-    for combo in ("--num_batches=4", "--kernel=pallas", "--dtype=bfloat16"):
+    # ...while streaming parses clean for every --shard_k method, and
+    # pallas/bf16 for fuzzy.
+    for method, combo in (
+        ("distributedKMeans", "--num_batches=4"),
+        ("distributedFuzzyCMeans", "--num_batches=4"),
+        ("gaussianMixture", "--num_batches=4"),
+        ("distributedFuzzyCMeans", "--kernel=pallas"),
+        ("distributedFuzzyCMeans", "--dtype=bfloat16"),
+    ):
         args = parser.parse_args(
             f"--n_obs=100 --n_dim=2 --K=8 --shard_k=2 {combo} "
-            "--method_name=distributedFuzzyCMeans".split()
+            f"--method_name={method}".split()
         )
         validate_args(parser, args)
 

@@ -621,3 +621,66 @@ def test_streamed_fuzzy_pallas_bf16_pad_correction_exact(data):
     np.testing.assert_allclose(
         float(streamed.objective), float(in_mem.objective), rtol=1e-4
     )
+
+
+class TestStreamedShardedGMM:
+    """Round-5: streamed K-sharded diag-GMM — the soft tower completes the
+    --shard_k streaming story for all three methods."""
+
+    def test_streamed_matches_in_memory(self, data):
+        from tdc_tpu.data.loader import NpzStream
+        from tdc_tpu.parallel.sharded_k import (
+            gmm_fit_sharded,
+            streamed_gmm_fit_sharded,
+        )
+
+        mesh = make_mesh_2d(2, 4)
+        init = data[:8]
+        # 1600/300 → 5 full + ragged 100-row batch; block_rows=64 makes
+        # pad_multiple 128, so the 300-row batches pad by 84 rows and the
+        # 100-row tail by 28 — the zero-row correction is genuinely
+        # exercised (with block_rows=0 the multiple is 2 and nothing pads).
+        streamed = streamed_gmm_fit_sharded(
+            NpzStream(data, 300), 8, 6, mesh, init=init, max_iters=10,
+            tol=-1.0, block_rows=64,
+        )
+        in_mem = gmm_fit_sharded(data, 8, mesh, init=init, max_iters=10,
+                                 tol=-1.0)
+        np.testing.assert_allclose(
+            np.asarray(streamed.means), np.asarray(in_mem.means),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(streamed.variances), np.asarray(in_mem.variances),
+            rtol=1e-3, atol=1e-5,
+        )
+
+    def test_streamed_converges_like_unsharded_streamed(self, data):
+        from tdc_tpu.data.loader import NpzStream
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+        from tdc_tpu.parallel.sharded_k import streamed_gmm_fit_sharded
+
+        mesh = make_mesh_2d(2, 4)
+        init = data[:8]
+        sh = streamed_gmm_fit_sharded(
+            NpzStream(data, 400), 8, 6, mesh, init=init, max_iters=30,
+            tol=1e-3,
+        )
+        un = streamed_gmm_fit(
+            NpzStream(data, 400), 8, 6, init=init, max_iters=30, tol=1e-3,
+        )
+        assert bool(sh.converged) == bool(un.converged)
+        np.testing.assert_allclose(
+            float(sh.log_likelihood), float(un.log_likelihood), rtol=1e-4
+        )
+        assert abs(int(sh.n_iter) - int(un.n_iter)) <= 1
+
+    def test_rejects_kmeans_init(self, data):
+        from tdc_tpu.data.loader import NpzStream
+        from tdc_tpu.parallel.sharded_k import streamed_gmm_fit_sharded
+
+        with pytest.raises(ValueError, match="kmeans"):
+            streamed_gmm_fit_sharded(
+                NpzStream(data, 400), 8, 6, make_mesh_2d(2, 4),
+                init="kmeans",
+            )
