@@ -219,9 +219,6 @@ def validate_args(parser, args):
                 parser.error("--shard_k --history_file is kmeans/fuzzy "
                              "only (the GMM shard tower records no "
                              "per-iteration history)")
-            if args.dtype == "bfloat16":
-                parser.error("--shard_k --dtype=bfloat16 is kmeans/fuzzy "
-                             "only (the GMM shard tower runs f32)")
             if args.init == "kmeans":
                 parser.error("--shard_k gaussianMixture seeds from a host "
                              "subsample; --init=kmeans (a full K-Means "
@@ -687,6 +684,7 @@ def run_experiment(args) -> dict:
                     init=args.init, key=key, max_iters=args.n_max_iters,
                     tol=args.tol, block_rows=shard_block(rows),
                     prefetch=args.prefetch,
+                    dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
                 )
             from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
 
@@ -694,6 +692,7 @@ def run_experiment(args) -> dict:
                 host_points(), args.K, mesh2d, init=args.init, key=key,
                 max_iters=args.n_max_iters, tol=args.tol,
                 block_rows=shard_block(n_obs),
+                dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
             )
         if mesh2d is not None:
             # K-sharded 2-D layout: always the streamed driver — it subsumes

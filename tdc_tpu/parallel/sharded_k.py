@@ -828,12 +828,15 @@ def gmm_fit_sharded(
     tol: float = 1e-3,
     reg_covar: float = 1e-6,
     block_rows: int = 0,
+    dtype=None,
 ):
     """Diag-covariance GMM EM with points sharded over 'data' and components
     sharded over 'model'. Seeding mirrors _resolve_init_sharded (host
     subsample); variances start at the subsample's per-dimension variance,
     weights uniform. Convergence: mean per-point log-likelihood gain ≤ tol
-    (sklearn's lower_bound_ criterion)."""
+    (sklearn's lower_bound_ criterion). dtype (e.g. jnp.bfloat16) converts
+    the points before the device_put — halves HBM/H2D; the E-step itself
+    computes in f32 regardless (the stats tower casts per block)."""
     from tdc_tpu.models.gmm import GMMResult
 
     n_data = mesh.devices.shape[0]
@@ -861,6 +864,10 @@ def gmm_fit_sharded(
     sample = jnp.asarray(np.asarray(x[: min(n, 65536)], np.float32))
     variances, weights = _moments_from_hard_assign(sample, means, reg_covar)
     x, n_pad = _pad_rows_sharded(x, n_data, block_rows)
+    if dtype is not None:
+        x = x.astype(dtype) if isinstance(x, np.ndarray) else jnp.asarray(
+            x, dtype
+        )
     x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
     put_k = lambda a: jax.device_put(
         a, NamedSharding(mesh, P(MODEL_AXIS) if a.ndim == 1
@@ -890,6 +897,39 @@ class _ShardedAcc(NamedTuple):
     sums: jax.Array  # (K, d) — K-sharded
     counts: jax.Array  # (K,) — K-sharded
     sse: jax.Array  # () — replicated
+
+
+@jax.jit
+def _spherical_rows(xb):
+    # Normalize real rows; zero padding rows stay zero (norm 0 guard).
+    norms = jnp.linalg.norm(xb, axis=-1, keepdims=True)
+    return jnp.where(norms > 0, xb / jnp.maximum(norms, 1e-12), xb)
+
+
+def _make_put_batch(mesh, pad_multiple: int, dtype, spherical: bool = False):
+    """The per-batch host→device staging closure shared by all three
+    streamed K-sharded drivers: zero-pad rows to the shard multiple,
+    optional host-side dtype cast (bf16 halves the transfer), device_put
+    data-sharded, optional row normalization (spherical — zero pad rows
+    stay zero). One copy so pad/cast/placement can never drift between
+    the towers (the fuzzy cast_dtype episode)."""
+
+    def put_batch(batch):
+        batch = np.asarray(batch)
+        n_valid = batch.shape[0]
+        rem = (-n_valid) % pad_multiple
+        if rem:
+            batch = np.pad(batch, ((0, rem), (0, 0)))
+        if dtype is not None:
+            import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
+
+            batch = batch.astype(np.dtype(dtype))  # host-side cast
+        xb = jax.device_put(batch, NamedSharding(mesh, P(DATA_AXIS, None)))
+        if spherical:
+            xb = _spherical_rows(xb)
+        return xb, n_valid
+
+    return put_batch
 
 
 def _sharded_stream_loop(
@@ -1100,26 +1140,7 @@ def streamed_kmeans_fit_sharded(
             sse=jnp.zeros((), jnp.float32),
         )
 
-    def put_batch(batch):
-        batch = np.asarray(batch)
-        n_valid = batch.shape[0]
-        rem = (-n_valid) % pad_multiple
-        if rem:
-            batch = np.pad(batch, ((0, rem), (0, 0)))
-        if dtype is not None:
-            import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
-
-            batch = batch.astype(np.dtype(dtype))  # host-side: halves transfer
-        xb = jax.device_put(batch, NamedSharding(mesh, P(DATA_AXIS, None)))
-        if spherical:
-            xb = _spherical_rows(xb)
-        return xb, n_valid
-
-    @jax.jit
-    def _spherical_rows(xb):
-        # Normalize real rows; zero padding rows stay zero (norm 0 guard).
-        norms = jnp.linalg.norm(xb, axis=-1, keepdims=True)
-        return jnp.where(norms > 0, xb / jnp.maximum(norms, 1e-12), xb)
+    put_batch = _make_put_batch(mesh, pad_multiple, dtype, spherical)
 
     def step_batch(acc, batch, c):
         xb, n_valid = put_batch(batch)
@@ -1273,18 +1294,7 @@ def streamed_fuzzy_fit_sharded(
             obj=jnp.zeros((), jnp.float32),
         )
 
-    def put_batch(batch):
-        batch = np.asarray(batch)
-        n_valid = batch.shape[0]
-        rem = (-n_valid) % pad_multiple
-        if rem:
-            batch = np.pad(batch, ((0, rem), (0, 0)))
-        if dtype is not None:
-            import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
-
-            batch = batch.astype(np.dtype(dtype))  # host-side: halves transfer
-        xb = jax.device_put(batch, NamedSharding(mesh, P(DATA_AXIS, None)))
-        return xb, n_valid
+    put_batch = _make_put_batch(mesh, pad_multiple, dtype)
 
     def step_batch(acc, batch, c):
         xb, n_valid = put_batch(batch)
@@ -1332,6 +1342,7 @@ def streamed_gmm_fit_sharded(
     reg_covar: float = 1e-6,
     block_rows: int = 0,
     prefetch: int = 0,
+    dtype=None,
 ):
     """Exact out-of-core diag-covariance GMM EM under the 2-D (data ×
     model) layout: each batch's K-sharded E-step sufficient statistics
@@ -1341,8 +1352,11 @@ def streamed_gmm_fit_sharded(
     completing the --shard_k streaming story for all three methods.
 
     Same batch contract as the other sharded streamed drivers. Seeding
-    mirrors gmm_fit_sharded (host subsample of the FIRST batch —
-    init='kmeans' is the unsharded mode and is rejected). Convergence is
+    mirrors gmm_fit_sharded: a host subsample of the stream's first
+    ≤65536 rows — read across as many leading batches as that takes, so
+    streamed and in-memory fits see the SAME prefix and follow identical
+    trajectories (init='kmeans' is the unsharded mode and is rejected).
+    Convergence is
     the sklearn lower_bound_ criterion (mean log-likelihood gain ≤ tol
     after iteration 2), which requires the per-iteration ll on host —
     the GMM drivers are inherently sync-per-iteration, so there is no
@@ -1379,6 +1393,11 @@ def streamed_gmm_fit_sharded(
             break
     first = np.concatenate(chunks)[:65536]
     means = _resolve_init_sharded(first, k, init, key)
+    if means.shape != (k, d):
+        raise ValueError(
+            f"init means shape {means.shape} != {(k, d)} — the stream's "
+            f"rows are {first.shape[1]}-wide; pass the matching d"
+        )
     variances, weights = _moments_from_hard_assign(
         jnp.asarray(first, jnp.float32), means, reg_covar
     )
@@ -1422,17 +1441,7 @@ def streamed_gmm_fit_sharded(
                                NamedSharding(mesh, P(MODEL_AXIS, None))),
         )
 
-    def put_batch(batch):
-        batch = np.asarray(batch)
-        n_valid = batch.shape[0]
-        rem = (-n_valid) % pad_multiple
-        if rem:
-            batch = np.pad(batch, ((0, rem), (0, 0)))
-        return (
-            jax.device_put(batch,
-                           NamedSharding(mesh, P(DATA_AXIS, None))),
-            n_valid,
-        )
+    put_batch = _make_put_batch(mesh, pad_multiple, dtype)
 
     rows_seen = [0]
 

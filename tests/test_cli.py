@@ -117,22 +117,23 @@ def test_cli_shard_k_validation():
         )
         validate_args(parser, args)
     # fuzzy + shard_k is first-class since round 5 (streamed / pallas /
-    # bf16 / ckpt all valid), GMM + shard_k streams too; the GMM shard
-    # tower's remaining unsupported combos must still fail fast.
+    # bf16 / ckpt all valid), GMM + shard_k streams and takes bf16 too;
+    # the GMM shard tower's remaining unsupported combos must fail fast.
     for combo in ("--kernel=pallas", "--ckpt_dir=/tmp/x",
-                  "--dtype=bfloat16"):
+                  "--history_file=/tmp/h.csv"):
         with pytest.raises(SystemExit):
             args = parser.parse_args(
                 f"--n_obs=100 --n_dim=2 --K=8 --shard_k=2 {combo} "
                 "--method_name=gaussianMixture".split()
             )
             validate_args(parser, args)
-    # ...while streaming parses clean for every --shard_k method, and
-    # pallas/bf16 for fuzzy.
+    # ...while streaming parses clean for every --shard_k method, bf16 for
+    # all three, and pallas for fuzzy.
     for method, combo in (
         ("distributedKMeans", "--num_batches=4"),
         ("distributedFuzzyCMeans", "--num_batches=4"),
         ("gaussianMixture", "--num_batches=4"),
+        ("gaussianMixture", "--dtype=bfloat16"),
         ("distributedFuzzyCMeans", "--kernel=pallas"),
         ("distributedFuzzyCMeans", "--dtype=bfloat16"),
     ):

@@ -684,3 +684,22 @@ class TestStreamedShardedGMM:
                 NpzStream(data, 400), 8, 6, make_mesh_2d(2, 4),
                 init="kmeans",
             )
+
+    def test_bf16_points(self, data):
+        """bf16 input through the sharded GMM tower: the E-step casts per
+        block to f32, so the fit matches the f32 one loosely (bf16 input
+        rounding only)."""
+        import jax.numpy as jnp
+
+        from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
+
+        mesh = make_mesh_2d(2, 4)
+        init = data[:8]
+        f32 = gmm_fit_sharded(data, 8, mesh, init=init, max_iters=8,
+                              tol=-1.0)
+        bf = gmm_fit_sharded(data, 8, mesh, init=init, max_iters=8,
+                             tol=-1.0, dtype=jnp.bfloat16)
+        np.testing.assert_allclose(
+            np.asarray(bf.means), np.asarray(f32.means), rtol=0.05,
+            atol=0.15,
+        )
