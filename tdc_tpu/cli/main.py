@@ -595,10 +595,15 @@ def run_experiment(args) -> dict:
             # aligned batch-for-batch with make_stream's row slicing
             return NpzStream(np.asarray(weights, np.float32), rows)
         # bf16 applies to the in-memory device paths; streamed batches keep
-        # their on-disk dtype (stats accumulate in f32 either way).
+        # their on-disk dtype (stats accumulate in f32 either way), and the
+        # shard_k drivers cast host-side per batch/fit (shard_dtype) — so
+        # the eager full-dataset device cast must not run for them (it
+        # would waste a full H2D + HBM copy the mesh2d branches never read).
+        shard_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else None
         xx = (
             jnp.asarray(x, jnp.bfloat16)
-            if (args.dtype == "bfloat16" and not streamed)
+            if (args.dtype == "bfloat16" and not streamed
+                and mesh2d is None)
             else x
         )
         def make_stream(rows):
@@ -658,7 +663,7 @@ def run_experiment(args) -> dict:
                     max_iters=args.n_max_iters, tol=args.tol,
                     kernel=args.kernel or "xla",
                     block_rows=shard_block(rows),
-                    dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+                    dtype=shard_dtype,
                     prefetch=args.prefetch,
                     ckpt_dir=args.ckpt_dir,
                     ckpt_every_batches=args.ckpt_every_batches,
@@ -670,7 +675,7 @@ def run_experiment(args) -> dict:
                 init=args.init, key=key, max_iters=args.n_max_iters,
                 tol=args.tol, block_rows=shard_block(n_obs),
                 kernel=args.kernel or "xla",
-                dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+                dtype=shard_dtype,
             )
         if mesh2d is not None and args.method_name == "gaussianMixture":
             if streamed:
@@ -684,7 +689,7 @@ def run_experiment(args) -> dict:
                     init=args.init, key=key, max_iters=args.n_max_iters,
                     tol=args.tol, block_rows=shard_block(rows),
                     prefetch=args.prefetch,
-                    dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+                    dtype=shard_dtype,
                 )
             from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
 
@@ -692,7 +697,7 @@ def run_experiment(args) -> dict:
                 host_points(), args.K, mesh2d, init=args.init, key=key,
                 max_iters=args.n_max_iters, tol=args.tol,
                 block_rows=shard_block(n_obs),
-                dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+                dtype=shard_dtype,
             )
         if mesh2d is not None:
             # K-sharded 2-D layout: always the streamed driver — it subsumes
@@ -707,7 +712,7 @@ def run_experiment(args) -> dict:
                 tol=args.tol, spherical=args.spherical,
                 kernel=args.kernel or "xla",
                 block_rows=block,
-                dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+                dtype=shard_dtype,
                 prefetch=args.prefetch,
                 ckpt_dir=args.ckpt_dir,
                 ckpt_every_batches=args.ckpt_every_batches,

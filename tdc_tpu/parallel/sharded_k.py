@@ -698,11 +698,8 @@ def fuzzy_fit_sharded(
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     c = _resolve_init_sharded(x, k, init, key)
     x, n_pad = _pad_rows_sharded(x, n_data, block_rows)
-    if dtype is not None:
-        x = x.astype(dtype) if isinstance(x, np.ndarray) else jnp.asarray(
-            x, dtype
-        )
-    x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
+    x = jax.device_put(_cast_points(x, dtype),
+                       NamedSharding(mesh, P(DATA_AXIS, None)))
     c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
     run, step = _fuzzy_fit_fns(mesh, float(m), block_rows, kernel,
                                int(n_pad), int(max_iters), float(tol))
@@ -864,11 +861,8 @@ def gmm_fit_sharded(
     sample = jnp.asarray(np.asarray(x[: min(n, 65536)], np.float32))
     variances, weights = _moments_from_hard_assign(sample, means, reg_covar)
     x, n_pad = _pad_rows_sharded(x, n_data, block_rows)
-    if dtype is not None:
-        x = x.astype(dtype) if isinstance(x, np.ndarray) else jnp.asarray(
-            x, dtype
-        )
-    x = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS, None)))
+    x = jax.device_put(_cast_points(x, dtype),
+                       NamedSharding(mesh, P(DATA_AXIS, None)))
     put_k = lambda a: jax.device_put(
         a, NamedSharding(mesh, P(MODEL_AXIS) if a.ndim == 1
                          else P(MODEL_AXIS, None))
@@ -904,6 +898,17 @@ def _spherical_rows(xb):
     # Normalize real rows; zero padding rows stay zero (norm 0 guard).
     norms = jnp.linalg.norm(xb, axis=-1, keepdims=True)
     return jnp.where(norms > 0, xb / jnp.maximum(norms, 1e-12), xb)
+
+
+def _cast_points(x, dtype):
+    """Host-or-device dtype cast for the in-memory sharded fits (bf16
+    halves the H2D/HBM cost; stats stay f32) — one copy shared by the
+    fuzzy and GMM towers, same rationale as _make_put_batch."""
+    if dtype is None:
+        return x
+    return x.astype(dtype) if isinstance(x, np.ndarray) else jnp.asarray(
+        x, dtype
+    )
 
 
 def _make_put_batch(mesh, pad_multiple: int, dtype, spherical: bool = False):
@@ -1395,8 +1400,9 @@ def streamed_gmm_fit_sharded(
     means = _resolve_init_sharded(first, k, init, key)
     if means.shape != (k, d):
         raise ValueError(
-            f"init means shape {means.shape} != {(k, d)} — the stream's "
-            f"rows are {first.shape[1]}-wide; pass the matching d"
+            f"init means shape {means.shape} != {(k, d)} — either the "
+            f"stream's rows ({first.shape[1]}-wide) don't match d={d}, or "
+            "an explicit init array has the wrong feature width"
         )
     variances, weights = _moments_from_hard_assign(
         jnp.asarray(first, jnp.float32), means, reg_covar

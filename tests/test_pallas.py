@@ -356,3 +356,58 @@ def test_fused_fuzzy_halves_matches_sequential(rng):
     with pytest.raises(ValueError, match="halves"):
         fuzzy_stats_fused(jnp.asarray(x), jnp.asarray(c), block_n=128,
                           halves=3)
+
+
+class TestTwoPassSplit:
+    """Round-5: fuzzy_stats_twopass split at its seam into fuzzy_normalizer
+    / fuzzy_accumulate so the K-sharded tower can psum the normalizer
+    between the passes. The split's contracts, tested directly:
+    shard-additivity of the normalizer (pad centroids contribute exactly
+    zero) and exactness of accumulate under a global normalizer."""
+
+    def test_normalizer_shard_additive(self, rng):
+        from tdc_tpu.ops.pallas_kernels import fuzzy_normalizer
+
+        x = (rng.normal(size=(700, 6)) * 3).astype(np.float32)
+        c = (rng.normal(size=(12, 6)) * 3).astype(np.float32)
+        for m in (2.0, 5.0):
+            full = fuzzy_normalizer(jnp.asarray(x), jnp.asarray(c), m=m,
+                                    block_n=256, block_k=128)
+            halves = sum(
+                fuzzy_normalizer(jnp.asarray(x), jnp.asarray(c[i:i + 4]),
+                                 m=m, block_n=256, block_k=128)
+                for i in range(0, 12, 4)
+            )
+            # Each 4-row shard pads to block_k=128 with sentinel
+            # centroids; exact zero masking is what makes the sum match.
+            np.testing.assert_allclose(np.asarray(halves), np.asarray(full),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_accumulate_with_global_normalizer_matches_xla(self, rng):
+        from tdc_tpu.ops.assign import fuzzy_stats
+        from tdc_tpu.ops.pallas_kernels import (
+            fuzzy_accumulate,
+            fuzzy_normalizer,
+        )
+
+        x = (rng.normal(size=(515, 7)) * 2).astype(np.float32)  # ragged N
+        c = (rng.normal(size=(10, 7)) * 2).astype(np.float32)
+        want = fuzzy_stats(jnp.asarray(x), jnp.asarray(c), m=2.0)
+        s = fuzzy_normalizer(jnp.asarray(x), jnp.asarray(c), m=2.0,
+                             block_n=256, block_k=128)
+        lo = fuzzy_accumulate(jnp.asarray(x), jnp.asarray(c[:5]), s,
+                              m=2.0, block_n=256, block_k=128)
+        hi = fuzzy_accumulate(jnp.asarray(x), jnp.asarray(c[5:]), s,
+                              m=2.0, block_n=256, block_k=128)
+        np.testing.assert_allclose(
+            np.concatenate([lo.weighted_sums, hi.weighted_sums]),
+            np.asarray(want.weighted_sums), rtol=2e-4, atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.concatenate([lo.weights, hi.weights]),
+            np.asarray(want.weights), rtol=2e-4, atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            float(lo.objective + hi.objective), float(want.objective),
+            rtol=2e-4,
+        )
