@@ -123,10 +123,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "major tall kernel; passing --kernel explicitly "
                         "pins the sample-major layout")
     p.add_argument("--shard_k", type=int, default=1,
-                   help="model-axis size: shard the K centroids this many "
-                        "ways over a 2-D (data x model) mesh (the K=16,384 "
-                        "regime; requires n_devices %% shard_k == 0 and "
-                        "K %% shard_k == 0; kmeans only)")
+                   help="model-axis size: shard the K centroids/components "
+                        "this many ways over a 2-D (data x model) mesh (the "
+                        "K=16,384 regime; requires n_devices %% shard_k == 0 "
+                        "and K %% shard_k == 0; kmeans, fuzzy, and "
+                        "gaussianMixture — all three stream)")
     p.add_argument("--block_rows", type=int, default=-1,
                    help="N-block rows inside each shard for --shard_k "
                         "(-1 = auto from device memory, 0 = no blocking)")
