@@ -214,8 +214,6 @@ def validate_args(parser, args):
                 parser.error("--shard_k --kernel=pallas is kmeans/fuzzy "
                              "only (the GMM shard tower is an XLA matmul "
                              "step)")
-            if args.ckpt_dir or args.ckpt_every_batches:
-                parser.error("--shard_k checkpointing is kmeans/fuzzy only")
             if args.history_file:
                 parser.error("--shard_k --history_file is kmeans/fuzzy "
                              "only (the GMM shard tower records no "
@@ -679,7 +677,9 @@ def run_experiment(args) -> dict:
                 dtype=shard_dtype,
             )
         if mesh2d is not None and args.method_name == "gaussianMixture":
-            if streamed:
+            # Checkpointing lives in the streamed driver (one batch
+            # subsumes the in-memory case — the kmeans/fuzzy rule).
+            if streamed or args.ckpt_dir:
                 from tdc_tpu.parallel.sharded_k import (
                     streamed_gmm_fit_sharded,
                 )
@@ -691,6 +691,7 @@ def run_experiment(args) -> dict:
                     tol=args.tol, block_rows=shard_block(rows),
                     prefetch=args.prefetch,
                     dtype=shard_dtype,
+                    ckpt_dir=args.ckpt_dir,
                 )
             from tdc_tpu.parallel.sharded_k import gmm_fit_sharded
 

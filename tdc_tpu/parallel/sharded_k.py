@@ -1348,6 +1348,8 @@ def streamed_gmm_fit_sharded(
     block_rows: int = 0,
     prefetch: int = 0,
     dtype=None,
+    ckpt_dir: str | None = None,
+    ckpt_every: int = 5,
 ):
     """Exact out-of-core diag-covariance GMM EM under the 2-D (data ×
     model) layout: each batch's K-sharded E-step sufficient statistics
@@ -1365,14 +1367,22 @@ def streamed_gmm_fit_sharded(
     the sklearn lower_bound_ criterion (mean log-likelihood gain ≤ tol
     after iteration 2), which requires the per-iteration ll on host —
     the GMM drivers are inherently sync-per-iteration, so there is no
-    deferred-fetch mode here. Checkpointing is not implemented (the gate
-    at cli/main.py documents it); an interrupted fit restarts.
+    deferred-fetch mode here.
+
+    ckpt_dir: per-iteration checkpoint/resume with streamed_gmm_fit's
+    contract (means + variances + weights + ll persisted every
+    `ckpt_every` iterations and at the end; restore validates
+    k/d/reg_covar and the shard layout; a finished checkpoint's no-op
+    resume reuses its stored final ll instead of re-streaming).
+    Iteration-granular only — an interrupted pass is re-run — and
+    single-process meshes only (the I/O gathers K-sharded state to this
+    host, the streamed_kmeans_fit_sharded rule).
     """
     from tdc_tpu.models.gmm import (
         GMMResult,
         _moments_from_hard_assign,
     )
-    from tdc_tpu.models.streaming import _run_pass
+    from tdc_tpu.models.streaming import _mesh_layout, _run_pass
 
     n_data = int(mesh.devices.shape[0])
     n_model = int(mesh.devices.shape[1])
@@ -1383,35 +1393,104 @@ def streamed_gmm_fit_sharded(
             "streamed_gmm_fit_sharded seeds from a host subsample; "
             "init='kmeans' (a full K-Means pre-fit) is the unsharded mode"
         )
+    if ckpt_dir is not None and _mesh_layout(mesh)[0] > 1:
+        raise ValueError(
+            "K-sharded checkpointing gathers state to one host and supports "
+            "single-process meshes only (multi-process gang checkpointing "
+            "of K-sharded state is not implemented)"
+        )
     pad_multiple = n_data * max(block_rows, 1)
 
-    # Seed from the stream's first ≤65536 rows — the SAME prefix
-    # gmm_fit_sharded's host subsample sees on the equivalent in-memory
-    # array, so the two fits follow identical trajectories (a single-batch
-    # sample gave different init moments and measurably divergent EM).
-    chunks, got = [], 0
-    for b in batches():
-        b = np.asarray(b)
-        chunks.append(b)
-        got += b.shape[0]
-        if got >= 65536:
-            break
-    first = np.concatenate(chunks)[:65536]
-    means = _resolve_init_sharded(first, k, init, key)
-    if means.shape != (k, d):
-        raise ValueError(
-            f"init means shape {means.shape} != {(k, d)} — either the "
-            f"stream's rows ({first.shape[1]}-wide) don't match d={d}, or "
-            "an explicit init array has the wrong feature width"
-        )
-    variances, weights = _moments_from_hard_assign(
-        jnp.asarray(first, jnp.float32), means, reg_covar
-    )
     put_k = lambda a: jax.device_put(
         a, NamedSharding(mesh, P(MODEL_AXIS) if a.ndim == 1
                          else P(MODEL_AXIS, None))
     )
+    start_iter = 0
+    prev_ll = -float("inf")
+    saved_final_ll = None
+    resume_converged = False
+    means = variances = weights = None
+    if ckpt_dir is not None:
+        from tdc_tpu.utils.checkpoint import restore_checkpoint
+
+        saved = restore_checkpoint(ckpt_dir)
+        if saved is not None:
+            if saved.meta.get("model") != "gmm_sharded":
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir} is not a K-sharded GMM "
+                    "checkpoint"
+                )
+            if (int(saved.meta.get("k")) != k
+                    or int(saved.meta.get("d")) != d
+                    or float(saved.meta.get("reg")) != float(reg_covar)
+                    or int(saved.meta.get("shard_model")) != n_model):
+                raise ValueError(
+                    f"checkpoint in {ckpt_dir} was written with "
+                    f"k={saved.meta.get('k')}, d={saved.meta.get('d')}, "
+                    f"reg_covar={saved.meta.get('reg')}, "
+                    f"shard_model={saved.meta.get('shard_model')} — "
+                    "refusing to mix state"
+                )
+            means = jnp.asarray(saved.centroids, jnp.float32)
+            variances = jnp.asarray(saved.meta["variances"], jnp.float32)
+            weights = jnp.asarray(saved.meta["weights"], jnp.float32)
+            start_iter = saved.n_iter
+            prev_ll = float(saved.meta.get("ll", -float("inf")))
+            saved_final_ll = saved.meta.get("final_ll")
+            resume_converged = bool(
+                np.asarray(saved.meta.get("converged", False))
+            )
+    if means is None:
+        # Seed from the stream's first ≤65536 rows — the SAME prefix
+        # gmm_fit_sharded's host subsample sees on the equivalent in-memory
+        # array, so the two fits follow identical trajectories (a
+        # single-batch sample gave different init moments and measurably
+        # divergent EM).
+        chunks, got = [], 0
+        for b in batches():
+            b = np.asarray(b)
+            chunks.append(b)
+            got += b.shape[0]
+            if got >= 65536:
+                break
+        first = np.concatenate(chunks)[:65536]
+        means = _resolve_init_sharded(first, k, init, key)
+        if means.shape != (k, d):
+            raise ValueError(
+                f"init means shape {means.shape} != {(k, d)} — either the "
+                f"stream's rows ({first.shape[1]}-wide) don't match d={d}, "
+                "or an explicit init array has the wrong feature width"
+            )
+        variances, weights = _moments_from_hard_assign(
+            jnp.asarray(first, jnp.float32), means, reg_covar
+        )
     means, variances, weights = map(put_k, (means, variances, weights))
+
+    def save_ckpt(n_iter, ll, done, final_ll=None):
+        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
+
+        save_checkpoint(
+            ckpt_dir,
+            ClusterState(
+                centroids=np.asarray(means), n_iter=n_iter, key=None,
+                batch_cursor=0,
+                meta={
+                    "model": "gmm_sharded", "k": k, "d": d,
+                    "reg": float(reg_covar), "shard_model": n_model,
+                    "variances": np.asarray(variances),
+                    "weights": np.asarray(weights),
+                    "ll": float(ll), "converged": bool(done),
+                    **({"final_ll": float(final_ll)}
+                       if final_ll is not None else {}),
+                },
+            ),
+            step=n_iter,
+            # The gate above restricts ckpt to single-process meshes, so
+            # this host is the sole writer even inside a jax.distributed
+            # runtime (gang=None would infer gang mode from
+            # jax.process_count() and deadlock on the barrier).
+            gang=False,
+        )
 
     stats_fn = make_sharded_gmm_stats(mesh, block_rows=block_rows)
 
@@ -1463,22 +1542,36 @@ def streamed_gmm_fit_sharded(
 
         return _run_pass(batches, prefetch, zero_acc, pass_step)
 
-    prev_ll = -float("inf")
     ll = prev_ll
-    n_iter = 0
-    converged = False
-    for n_iter in range(1, max_iters + 1):
+    n_iter = start_iter
+    converged = resume_converged
+    iters = (
+        () if resume_converged else range(start_iter + 1, max_iters + 1)
+    )
+    for n_iter in iters:
         acc = full_pass(means, variances, weights)
         means, variances, weights, ll_dev = m_step(acc, rows_seen[0])
         ll = float(ll_dev)
-        if n_iter > 1 and ll - prev_ll <= tol:
+        done = n_iter > 1 and ll - prev_ll <= tol
+        if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
+                                     or n_iter == max_iters):
+            save_ckpt(n_iter, ll, done)
+        if done:
             converged = True
             break
         prev_ll = ll
-    # Final ll of the RETURNED parameters (the loop's ll is pre-update —
-    # parity with streamed_gmm_fit).
-    acc = full_pass(means, variances, weights)
-    final_ll = float(acc.ll) / max(rows_seen[0], 1)
+    resume_done = resume_converged or start_iter >= max_iters
+    if resume_done and saved_final_ll is not None:
+        # No-op resume of a finished checkpoint: reuse its stored final ll
+        # instead of re-streaming the dataset (streamed_gmm_fit's rule).
+        final_ll = float(saved_final_ll)
+    else:
+        # Final ll of the RETURNED parameters (the loop's ll is pre-update
+        # — parity with streamed_gmm_fit).
+        acc = full_pass(means, variances, weights)
+        final_ll = float(acc.ll) / max(rows_seen[0], 1)
+        if ckpt_dir is not None and (converged or n_iter >= max_iters):
+            save_ckpt(n_iter, ll, converged, final_ll=final_ll)
     return GMMResult(
         means=means,
         variances=variances,
@@ -1487,4 +1580,5 @@ def streamed_gmm_fit_sharded(
         n_iter=jnp.asarray(n_iter, jnp.int32),
         converged=jnp.asarray(converged),
         covariance_type="diag",
+        n_iter_run=n_iter - start_iter,
     )

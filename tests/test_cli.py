@@ -117,10 +117,11 @@ def test_cli_shard_k_validation():
         )
         validate_args(parser, args)
     # fuzzy + shard_k is first-class since round 5 (streamed / pallas /
-    # bf16 / ckpt all valid), GMM + shard_k streams and takes bf16 too;
-    # the GMM shard tower's remaining unsupported combos must fail fast.
-    for combo in ("--kernel=pallas", "--ckpt_dir=/tmp/x",
-                  "--history_file=/tmp/h.csv"):
+    # bf16 / ckpt all valid), GMM + shard_k streams, takes bf16, and
+    # checkpoints per iteration too; the GMM shard tower's remaining
+    # unsupported combos must fail fast.
+    for combo in ("--kernel=pallas", "--history_file=/tmp/h.csv",
+                  "--ckpt_every_batches=4"):
         with pytest.raises(SystemExit):
             args = parser.parse_args(
                 f"--n_obs=100 --n_dim=2 --K=8 --shard_k=2 {combo} "
