@@ -1,0 +1,152 @@
+"""Serving CLI: load fitted models and answer predict traffic over HTTP.
+
+    python -m tdc_tpu.cli.serve \
+        --model km=/ckpts/kmeans_model --model gmm=/ckpts/gmm_model \
+        --port 8100 --log_file serve_log.jsonl
+
+Models are fitted-model dirs (models/persist.save_fitted) or raw
+utils/checkpoint.py checkpoint dirs; each is polled for hot-reload every
+--poll_interval seconds. With --shard_model > 1 the engine builds a 2-D
+(data × model) mesh and routes hard assignment for models with
+K ≥ --shard_k_threshold through parallel.sharded_k.sharded_assign.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tdc_tpu.serve",
+        description="Online inference serving for fitted clustering models",
+    )
+    p.add_argument("--model", action="append", default=[],
+                   metavar="ID=PATH",
+                   help="register model ID from a fitted-model or "
+                        "checkpoint dir (repeatable)")
+    p.add_argument("--model_root", type=str, default=None,
+                   help="register every immediate subdirectory of this "
+                        "dir as a model (id = subdir name)")
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--backend", type=str, default=None,
+                   help="jax platform override (tpu|cpu); default auto")
+    p.add_argument("--n_devices", type=int, default=None,
+                   help="devices for the serving mesh (default all)")
+    p.add_argument("--shard_model", type=int, default=1,
+                   help="model-axis size of the 2-D serving mesh; >1 "
+                        "enables the sharded_assign route for large-K "
+                        "models")
+    p.add_argument("--shard_k_threshold", type=int, default=8192,
+                   help="K at or above which hard assignment routes "
+                        "through sharded_assign (needs --shard_model>1)")
+    p.add_argument("--max_batch_rows", type=int, default=4096,
+                   help="device micro-batch row cap")
+    p.add_argument("--max_wait_ms", type=float, default=2.0,
+                   help="micro-batch coalescing deadline")
+    p.add_argument("--max_queue_rows", type=int, default=65536,
+                   help="queued-rows bound; beyond it requests are "
+                        "rejected as overloaded (HTTP 503)")
+    p.add_argument("--poll_interval", type=float, default=2.0,
+                   help="hot-reload manifest poll period in seconds "
+                        "(0 disables)")
+    p.add_argument("--warmup_buckets", type=str, default="8,64,512",
+                   help="comma-separated row buckets to pre-compile per "
+                        "model ('' skips warmup)")
+    p.add_argument("--log_file", type=str, default=None,
+                   help="request-level JSONL event log "
+                        "(utils/structlog.RunLog)")
+    return p
+
+
+def _parse_models(args, parser) -> list[tuple[str, str]]:
+    pairs = []
+    for spec in args.model:
+        mid, sep, path = spec.partition("=")
+        if not sep or not mid or not path:
+            parser.error(f"--model must be ID=PATH, got {spec!r}")
+        pairs.append((mid, path))
+    if args.model_root:
+        for name in sorted(os.listdir(args.model_root)):
+            path = os.path.join(args.model_root, name)
+            if os.path.isdir(path):
+                pairs.append((name, path))
+    if not pairs:
+        parser.error("no models: pass --model ID=PATH or --model_root DIR")
+    return pairs
+
+
+def make_app(args):
+    """Build a started ServeApp from parsed args (the testable seam)."""
+    if args.backend:
+        import jax
+
+        jax.config.update("jax_platforms", args.backend)
+    import jax
+
+    from tdc_tpu.serve import ModelRegistry, PredictEngine, ServeApp
+    from tdc_tpu.utils.structlog import RunLog
+
+    log = RunLog(args.log_file)
+    mesh = None
+    if args.shard_model > 1:
+        from tdc_tpu.parallel.sharded_k import make_mesh_2d
+
+        n = args.n_devices or len(jax.devices())
+        if n % args.shard_model != 0:
+            raise SystemExit(
+                f"--shard_model={args.shard_model} does not divide "
+                f"{n} devices"
+            )
+        mesh = make_mesh_2d(n // args.shard_model, args.shard_model)
+    registry = ModelRegistry()
+    engine = PredictEngine(
+        mesh, shard_k_threshold=args.shard_k_threshold, log=log
+    )
+    app = ServeApp(
+        registry,
+        engine,
+        log=log,
+        max_batch_rows=args.max_batch_rows,
+        max_wait_ms=args.max_wait_ms,
+        max_queue_rows=args.max_queue_rows,
+        poll_interval=args.poll_interval,
+    )
+    return app, log
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    pairs = _parse_models(args, parser)
+    app, log = make_app(args)
+    for mid, path in pairs:
+        entry = app.registry.add(mid, path, log=log)
+        print(f"loaded {mid}: {entry.fitted.model} K={entry.fitted.k} "
+              f"d={entry.fitted.d} version={entry.version}", flush=True)
+    buckets = [int(b) for b in args.warmup_buckets.split(",") if b]
+    if buckets:  # '' really does skip warmup (engine.warmup defaults [])
+        for mid, _ in pairs:
+            entry = app.registry.get(mid)
+            compiles = app.engine.warmup(entry, buckets=buckets)
+            print(
+                f"warmed {mid}: {compiles} compiles over buckets {buckets}",
+                flush=True,
+            )
+    app.start()
+    print(f"serving on http://{args.host}:{args.port} "
+          f"(models: {', '.join(app.registry.ids())})", flush=True)
+    try:
+        app.serve_http(args.host, args.port)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        app.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,13 @@
 """Data generation, loading, and HBM-aware batching."""
 
 from tdc_tpu.data.synthetic import make_blobs, make_classification_data, save_npz
-from tdc_tpu.data.loader import load_points, batch_iterator, NpzStream
+from tdc_tpu.data.loader import (
+    NpzStream,
+    batch_iterator,
+    load_points,
+    load_points_feature_major,
+    to_feature_major,
+)
 from tdc_tpu.data.batching import auto_batch_size, oom_adaptive
 
 __all__ = [
@@ -9,6 +15,8 @@ __all__ = [
     "make_classification_data",
     "save_npz",
     "load_points",
+    "load_points_feature_major",
+    "to_feature_major",
     "batch_iterator",
     "NpzStream",
     "auto_batch_size",

@@ -22,6 +22,14 @@ def load_points(data_file: str, *, mmap: bool = True):
     .npz members can't be memmapped directly; for large out-of-core runs prefer
     .npy (np.lib.format.open_memmap) or convert once with NpzStream.to_npy.
     """
+    if data_file.endswith(FEATURE_MAJOR_SUFFIX):
+        # A (d, N) feature-major file read as sample-major would silently
+        # cluster d "points" of dimension N — garbage with status ok.
+        raise ValueError(
+            f"{data_file} is a feature-major ({FEATURE_MAJOR_SUFFIX}) "
+            "file; load it with load_points_feature_major / "
+            "--layout=features, or re-save sample-major"
+        )
     if data_file.endswith(".npz"):
         with np.load(data_file, allow_pickle=False) as z:
             x = _restore_bf16(z["X"])
@@ -56,6 +64,70 @@ def batch_iterator(
         size = base + (1 if i < extra else 0)
         yield x[start : start + size]
         start += size
+
+
+FEATURE_MAJOR_SUFFIX = ".fm.npy"
+
+
+def load_points_feature_major(
+    data_file: str, *, mmap: bool = True, chunk_rows: int = 1 << 20
+):
+    """(d, N) feature-major points for the tall-kernel layout
+    (`--layout=features`, ops/tall.py).
+
+    Two source conventions:
+      * `*.fm.npy` — the file already stores (d, N); memmapped as-is, the
+        out-of-core-friendly path (use `to_feature_major` to convert once).
+      * any other .npy/.npz — the reference's sample-major (N, d) layout;
+        transposed host-side in row chunks. For mmapped .npy sources the
+        peak is one chunk plus the (d, N) result, not 2× the dataset;
+        .npz members cannot be memmapped, so that path materializes the
+        source first — convert big .npz datasets to .npy once.
+
+    Returns (x_feature_major, y_or_None). bf16 round-trips the same way
+    load_points does (_restore_bf16).
+    """
+    if data_file.endswith(FEATURE_MAJOR_SUFFIX):
+        x = np.load(data_file, mmap_mode="r" if mmap else None)
+        return _restore_bf16(x), None
+    x, y = load_points(data_file, mmap=mmap)
+    if x.ndim != 2:
+        raise ValueError(f"expected 2-D points, got shape {x.shape}")
+    n, d = x.shape
+    out = np.empty((d, n), x.dtype)
+    for s in range(0, n, chunk_rows):
+        out[:, s : s + chunk_rows] = x[s : s + chunk_rows].T
+    return out, y
+
+
+def to_feature_major(
+    src_path: str, dst_path: str, *, chunk_rows: int = 1 << 20,
+    key: str = "X",
+) -> str:
+    """One-time sample-major .npy/.npz → feature-major `*.fm.npy`
+    conversion, so later feature-major loads mmap directly instead of
+    transposing. .npy sources stream memmap-to-memmap (bounded host
+    memory); .npz members cannot be memmapped, so that branch holds the
+    full source array while writing."""
+    if not dst_path.endswith(FEATURE_MAJOR_SUFFIX):
+        raise ValueError(
+            f"feature-major files use the {FEATURE_MAJOR_SUFFIX!r} suffix "
+            f"(got {dst_path!r}) — the suffix is how "
+            "load_points_feature_major knows not to transpose again"
+        )
+    if src_path.endswith(".npz"):
+        with np.load(src_path, allow_pickle=False) as z:
+            src = z[key]
+    else:
+        src = np.load(src_path, mmap_mode="r")
+    n, d = src.shape
+    out = np.lib.format.open_memmap(
+        dst_path, mode="w+", dtype=src.dtype, shape=(d, n)
+    )
+    for s in range(0, n, chunk_rows):
+        out[:, s : s + chunk_rows] = np.asarray(src[s : s + chunk_rows]).T
+    out.flush()
+    return dst_path
 
 
 class NpzStream:

@@ -23,6 +23,11 @@ from tdc_tpu.models.kmeans import resolve_init
 from tdc_tpu.parallel import mesh as mesh_lib
 
 
+# Shared jitted membership kernel (m dynamic — one executable per shape,
+# any fuzzifier); both fuzzy_predict and the serve engine go through it.
+_memberships_jit = jax.jit(fuzzy_memberships)
+
+
 class FuzzyCMeansResult(NamedTuple):
     centroids: jax.Array  # (K, d) float32
     n_iter: jax.Array  # () int32 — cumulative iterations (incl. resumed-from)
@@ -254,7 +259,9 @@ def fuzzy_predict(x, centroids, *, m: float = 2.0, soft: bool = False,
             lambda blk: fuzzy_memberships(blk, centroids, m=m), xb
         )
         return u.reshape(-1, centroids.shape[0])[:n]
-    return fuzzy_memberships(x, centroids, m=m)
+    # jit-backed with m dynamic (one executable serves every fuzzifier);
+    # serve/engine.py calls this same path for bit-stable batched serving.
+    return _memberships_jit(x, centroids, m)
 
 
 def predict_proba(x, centroids, *, m: float = 2.0, block_rows: int = 0):

@@ -468,14 +468,20 @@ def _posteriors(x, means, cov, weights, cov_type: str = "diag"):
     return jnp.exp(logp - norm)
 
 
+@partial(jax.jit, static_argnames=("cov_type",))
+def _hard_assign_t(x, means, cov, weights, cov_type: str):
+    logp = _log_prob_t(x, means, cov, jnp.log(weights), cov_type)
+    return jnp.argmax(logp, axis=1).astype(jnp.int32)
+
+
 def gmm_predict(x, result: GMMResult) -> jax.Array:
-    """Hard component labels (argmax posterior)."""
-    x = jnp.asarray(x)
-    logp = _log_prob_t(
-        x, result.means, result.variances, jnp.log(result.weights),
+    """Hard component labels (argmax posterior). jit-backed: repeated
+    predict calls (and serve/engine.py batches) share one executable per
+    shape."""
+    return _hard_assign_t(
+        jnp.asarray(x), result.means, result.variances, result.weights,
         result.covariance_type,
     )
-    return jnp.argmax(logp, axis=1).astype(jnp.int32)
 
 
 def gmm_predict_proba(x, result: GMMResult) -> jax.Array:

@@ -483,4 +483,9 @@ def kmeans_predict(
         from tdc_tpu.ops.pallas_kernels import distance_argmin
 
         return distance_argmin(x, centroids)[0]
-    return assign_clusters(x, centroids)
+    from tdc_tpu.ops.assign import assign_clusters_jit
+
+    # jit-backed (not eager): repeated predict calls — the serving hot
+    # path — reuse one executable per shape, and serve/engine.py calls
+    # this same function so batched responses bit-match single calls.
+    return assign_clusters_jit(x, centroids)

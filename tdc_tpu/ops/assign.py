@@ -37,6 +37,12 @@ def assign_clusters(x: jax.Array, centroids: jax.Array) -> jax.Array:
     return jnp.argmin(pairwise_sq_dist(x, centroids), axis=-1).astype(jnp.int32)
 
 
+# The jitted single-call entry point shared by kmeans_predict and the serve
+# engine (serve/engine.py): both paths running the SAME executable is what
+# makes a batched serving response bit-identical to a single-request call.
+assign_clusters_jit = jax.jit(assign_clusters)
+
+
 def cluster_stats(x: jax.Array, assign: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     """(Σx per cluster, counts) from a precomputed assignment.
 

@@ -23,7 +23,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from tdc_tpu.parallel.compat import shard_map
 
 from tdc_tpu.ops.assign import SufficientStats, FuzzyStats, lloyd_stats, fuzzy_stats
 from tdc_tpu.parallel.mesh import DATA_AXIS

@@ -1,0 +1,32 @@
+"""jax version compatibility for the explicit-collective towers.
+
+`shard_map` has moved twice across the jax versions this repo meets in the
+wild: `jax.experimental.shard_map.shard_map(check_rep=...)` (≤0.4.x),
+`jax.shard_map(check_vma=...)` (≥0.6). The towers are written against the
+new surface (check_vma); this shim presents exactly that surface on every
+version, translating the replication-check kwarg when the installed jax
+still calls it check_rep.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax ≥ 0.6: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+except ImportError:  # jax ≤ 0.4/0.5: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+_HAS_VMA = "check_vma" in _PARAMS
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True, **kw):
+    """`jax.shard_map` with the modern kwarg surface on any jax version."""
+    if not _HAS_VMA:
+        kw["check_rep"] = check_vma
+    else:
+        kw["check_vma"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )

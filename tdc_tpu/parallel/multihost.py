@@ -35,14 +35,37 @@ def initialize_distributed(
     pass explicitly for manual clusters. Returns (process_index, num_processes).
     """
     if num_processes is not None and num_processes > 1:
+        _enable_cpu_collectives()
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
     elif coordinator_address is not None:
+        _enable_cpu_collectives()
         jax.distributed.initialize(coordinator_address=coordinator_address)
     return jax.process_index(), jax.process_count()
+
+
+def _enable_cpu_collectives() -> None:
+    """Multi-process collectives on the CPU backend need gloo; some jax
+    versions default it off ('Multiprocess computations aren't implemented
+    on the CPU backend'). Flip it before the backend initializes; harmless
+    for TPU runs (the option only affects the CPU client) and absent on
+    versions where gloo is the default."""
+    name = "jax_cpu_collectives_implementation"
+    try:  # attribute read works on some versions, _read on others
+        current = getattr(jax.config, name)
+    except AttributeError:
+        try:
+            current = jax.config._read(name)
+        except Exception:
+            current = None
+    if current in (None, "none", ""):
+        try:
+            jax.config.update(name, "gloo")
+        except Exception:
+            pass  # option absent: gloo is this version's default
 
 
 def initialize_from_env() -> tuple[int, int]:

@@ -28,8 +28,9 @@ from typing import Callable, Iterable, NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tdc_tpu.parallel.compat import shard_map
 
 from tdc_tpu.ops.distance import pairwise_sq_dist
 from tdc_tpu.models.kmeans import KMeansResult, _normalize, resolve_init
@@ -893,6 +894,69 @@ class _ShardedAcc(NamedTuple):
     sse: jax.Array  # () — replicated
 
 
+def _host_full(arr) -> np.ndarray:
+    """Assemble a global (possibly K-sharded) array on THIS host from its
+    addressable shards. Valid whenever every model shard has a replica on
+    every process — the (data × model) layout with the data axis spanning
+    the processes, where each process's local devices cover every K-shard
+    column. The gang checkpoint writer needs the full array host-side;
+    np.asarray alone refuses non-fully-addressable global arrays."""
+    if isinstance(arr, np.ndarray):
+        return arr
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    out = np.empty(arr.shape, arr.dtype)
+    covered = np.zeros(arr.shape, bool)
+    for s in arr.addressable_shards:
+        out[s.index] = np.asarray(s.data)
+        covered[s.index] = True
+    if not covered.all():
+        raise ValueError(
+            "checkpoint gather: this process does not hold every K-shard "
+            "(model axis spans processes); put the data axis across "
+            "processes so centroid shards are process-local"
+        )
+    return out
+
+
+class _GatheringCheckpointer:
+    """_StreamCheckpointer adapter for multi-process K-sharded gangs:
+    gathers the sharded centroids/accumulator to host before the write
+    (the inner checkpointer then runs the gang single-writer protocol —
+    process 0 writes, everyone barriers; utils/checkpoint.py)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    @property
+    def dir(self):  # _run_pass consults ckpt.dir for mid-pass saves
+        return self._inner.dir
+
+    def restore(self, acc_cls, mesh):
+        return self._inner.restore(acc_cls, mesh)
+
+    def save(self, n_iter, c, shift, history, *, batch_cursor=0, acc=None,
+             rows_seen=0):
+        if jax.process_index() == 0:
+            if acc is not None:
+                acc = type(acc)(*[_host_full(t) for t in acc])
+            c = _host_full(c)
+        else:
+            # Non-writers only rendezvous in save_checkpoint's barrier;
+            # skip their D2H gather (tens of MB per mid-pass save at the
+            # K=16384·d=768 target) and hand the inner checkpointer cheap
+            # placeholders it never reads.
+            c = np.zeros((0, 0), np.float32)
+            if acc is not None:
+                acc = type(acc)(
+                    *[np.zeros(0, np.float32) for _ in acc]
+                )
+        return self._inner.save(
+            n_iter, c, shift, history,
+            batch_cursor=batch_cursor, acc=acc, rows_seen=rows_seen,
+        )
+
+
 @jax.jit
 def _spherical_rows(xb):
     # Normalize real rows; zero padding rows stay zero (norm 0 guard).
@@ -1050,9 +1114,16 @@ def streamed_kmeans_fit_sharded(
     ckpt_dir enables checkpoint/resume with the models/streaming contract
     (per-iteration saves every `ckpt_every` iterations; mid-pass accumulator
     + batch-cursor saves every `ckpt_every_batches` batches; resume is
-    bit-identical to the uninterrupted fit). Checkpoint I/O gathers the
-    (K, d) centroids/accumulator to THIS host, so it is single-process-mesh
-    only — the multi-hour 1B-row single-host regime this driver targets.
+    bit-identical to the uninterrupted fit).
+
+    Multi-process gangs: pass a mesh whose DATA axis spans the processes
+    (model columns process-local, the pod deployment shape) and have every
+    process stream IDENTICAL global batches (the kmeans_fit_sharded
+    contract: device_put places only this host's addressable rows).
+    Checkpointing then runs the gang single-writer protocol — every
+    process assembles the K-sharded state from its local shard replicas
+    (_host_full), process 0 writes, all rendezvous — so a supervised gang
+    (parallel/supervisor.py) can kill-and-resume mid-fit.
     """
     from tdc_tpu.models.streaming import (
         _StreamCheckpointer,
@@ -1064,12 +1135,23 @@ def streamed_kmeans_fit_sharded(
     n_model = int(mesh.devices.shape[1])
     if k % n_model != 0:
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
-    if ckpt_dir is not None and _mesh_layout(mesh)[0] > 1:
-        raise ValueError(
-            "K-sharded checkpointing gathers state to one host and supports "
-            "single-process meshes only (multi-process gang checkpointing "
-            "of K-sharded state is not implemented)"
-        )
+    gang = _mesh_layout(mesh)[0] > 1
+    if ckpt_dir is not None and gang:
+        # Gang checkpointing needs every K-shard process-local so process 0
+        # can assemble the full (K, d) state host-side (_host_full): every
+        # process must own a device in every model column. The data-axis-
+        # across-processes layout (the pod deployment shape) satisfies
+        # this; a model axis spanning processes does not.
+        nproc = _mesh_layout(mesh)[0]
+        for j in range(n_model):
+            col_procs = {dev.process_index for dev in mesh.devices[:, j]}
+            if len(col_procs) != nproc:
+                raise ValueError(
+                    "K-sharded gang checkpointing requires the data axis "
+                    "to span the processes (every process holding every "
+                    f"K-shard); model column {j} is only on processes "
+                    f"{sorted(col_procs)}"
+                )
     pad_multiple = n_data * max(block_rows, 1)
 
     ckpt = _StreamCheckpointer(
@@ -1078,7 +1160,10 @@ def streamed_kmeans_fit_sharded(
         acc_map={"acc_sums": "sums", "acc_counts": "counts",
                  "acc_sse": "sse"},
         key=key,
+        gang=gang,
     )
+    if gang:
+        ckpt = _GatheringCheckpointer(ckpt)
     # Restore FIRST (models/streaming convention): a resume must not re-pay
     # init resolution, and must report the checkpointed state faithfully.
     state = ckpt.restore(_ShardedAcc, None)

@@ -1,0 +1,23 @@
+"""Online inference serving for fitted clustering models.
+
+The offline twin of the streamed fit drivers: `registry` loads fitted
+models (models/persist.py manifests or raw checkpoint dirs) and keeps
+their parameters device-resident across requests, `engine` owns the
+compiled predict-function cache (bucketed padding, sharded_assign routing
+for large K), `batcher` coalesces concurrent requests into one device
+batch, and `server` exposes the stdlib HTTP JSON API.
+"""
+
+from tdc_tpu.serve.batcher import MicroBatcher, Overloaded
+from tdc_tpu.serve.engine import PredictEngine
+from tdc_tpu.serve.registry import ModelEntry, ModelRegistry
+from tdc_tpu.serve.server import ServeApp
+
+__all__ = [
+    "MicroBatcher",
+    "ModelEntry",
+    "ModelRegistry",
+    "Overloaded",
+    "PredictEngine",
+    "ServeApp",
+]

@@ -1,0 +1,247 @@
+"""Asyncio micro-batching: coalesce concurrent predict requests into one
+padded device batch.
+
+The device prefers few large batches; clients send many small ones. Each
+submitted request lands in a per-(model, method, generation) queue; a
+single dispatcher task repeatedly picks the queue whose HEAD request has
+waited longest (so no model's traffic can starve another's), holds the
+batch open until that head's max-wait deadline, then runs the engine once
+over the concatenated rows and slices each requester's rows back out of
+the shared result. Interleaved traffic for different models coalesces
+per model instead of fragmenting into singleton batches.
+
+Backpressure is EXPLICIT: when the queues already hold max_queue_rows of
+pending work, `submit` raises Overloaded immediately — the caller gets a
+clear 'overloaded' rejection (HTTP 503 upstream) instead of unbounded
+queue growth and collapsing tail latency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tdc_tpu.serve.engine import PredictEngine
+from tdc_tpu.serve.registry import ModelRegistry
+
+
+class Overloaded(Exception):
+    """The pending-request queue is full; retry later (HTTP 503)."""
+
+
+@dataclass
+class _Request:
+    model_id: str
+    method: str
+    entry: object  # the ModelEntry resolved at submit time: a hot reload
+    # mid-flight must not retarget an admitted request to different params
+    x: np.ndarray
+    future: asyncio.Future
+    enqueued_at: float = field(default_factory=time.perf_counter)
+
+
+class MicroBatcher:
+    """One dispatcher task per batcher; submit() from any asyncio task.
+
+    max_batch_rows: device-batch row cap — a batch stops draining its
+      queue when the next request would exceed it. Must not exceed the
+      engine's max_bucket.
+    max_wait_ms: how long the head request of a batch waits for company
+      before the batch is dispatched anyway (the latency the throughput
+      is bought with).
+    max_queue_rows: bounded-queue backpressure threshold over ALL queues.
+    """
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        engine: PredictEngine,
+        *,
+        max_batch_rows: int = 4096,
+        max_wait_ms: float = 2.0,
+        max_queue_rows: int = 65536,
+        log=None,
+    ):
+        if max_batch_rows > engine.max_bucket:
+            raise ValueError(
+                f"max_batch_rows={max_batch_rows} exceeds the engine's "
+                f"max_bucket={engine.max_bucket}"
+            )
+        self.registry = registry
+        self.engine = engine
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue_rows = int(max_queue_rows)
+        self.log = log
+        # key = (model_id, method, generation) -> FIFO of requests
+        self._pending: dict[tuple, collections.deque[_Request]] = {}
+        self._arrival = asyncio.Event()
+        self._queued_rows = 0
+        self._dispatcher: asyncio.Task | None = None
+        self.stats = {
+            "requests": 0,
+            "rejected": 0,
+            "batches": 0,
+            "queue_wait_ms_total": 0.0,
+        }
+
+    # ---------------- client side ----------------
+
+    async def submit(self, model_id: str, method: str, x) -> np.ndarray:
+        """Coalesce this request into a device batch; returns its rows of
+        the shared result. Raises Overloaded / KeyError / ValueError."""
+        out, _ = await self.submit_full(model_id, method, x)
+        return out
+
+    async def submit_full(
+        self, model_id: str, method: str, x
+    ) -> tuple[np.ndarray, object]:
+        """submit() plus the ModelEntry the request resolved — the version
+        the caller should report alongside the result."""
+        x = np.asarray(x, np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        entry = self.registry.get(model_id)  # KeyError -> 404 upstream
+        if method not in self.engine.methods(entry):
+            raise ValueError(
+                f"model {model_id!r} ({entry.fitted.model}) has no method "
+                f"{method!r}; valid: {self.engine.methods(entry)}"
+            )
+        if x.shape[0] > self.max_batch_rows:
+            raise ValueError(
+                f"request of {x.shape[0]} rows exceeds max_batch_rows="
+                f"{self.max_batch_rows}; split client-side"
+            )
+        if self._queued_rows + x.shape[0] > self.max_queue_rows:
+            self.stats["rejected"] += 1
+            if self.log is not None:
+                self.log.event("overloaded", model=model_id, method=method,
+                               rows=int(x.shape[0]),
+                               queued_rows=self._queued_rows)
+            raise Overloaded(
+                f"queue holds {self._queued_rows} rows "
+                f"(max_queue_rows={self.max_queue_rows}); retry later"
+            )
+        self._ensure_dispatcher()
+        fut = asyncio.get_running_loop().create_future()
+        req = _Request(model_id, method, entry, x, fut)
+        key = (model_id, method, entry.generation)
+        self._pending.setdefault(key, collections.deque()).append(req)
+        self._queued_rows += x.shape[0]
+        self.stats["requests"] += 1
+        self._arrival.set()
+        return await fut, entry
+
+    # ---------------- dispatcher ----------------
+
+    def _ensure_dispatcher(self) -> None:
+        if self._dispatcher is None or self._dispatcher.done():
+            self._dispatcher = asyncio.get_running_loop().create_task(
+                self._run(), name="tdc-serve-dispatcher"
+            )
+
+    async def close(self) -> None:
+        if self._dispatcher is not None:
+            self._dispatcher.cancel()
+            try:
+                await self._dispatcher
+            except asyncio.CancelledError:
+                pass
+            self._dispatcher = None
+        # Shutdown must not strand submitters: fail whatever is queued.
+        for dq in self._pending.values():
+            for req in dq:
+                if not req.future.done():
+                    req.future.set_exception(
+                        Overloaded("server shutting down")
+                    )
+        self._pending.clear()
+        self._queued_rows = 0
+
+    def _oldest_key(self) -> tuple:
+        return min(
+            self._pending, key=lambda k: self._pending[k][0].enqueued_at
+        )
+
+    def _key_rows(self, key: tuple) -> int:
+        return sum(r.x.shape[0] for r in self._pending[key])
+
+    async def _collect_batch(self) -> list[_Request]:
+        """One batch: the longest-waiting queue's head plus everything that
+        joins that queue before the head's deadline, up to max_batch_rows."""
+        while not self._pending:
+            self._arrival.clear()
+            await self._arrival.wait()
+        key = self._oldest_key()
+        head = self._pending[key][0]
+        deadline = head.enqueued_at + self.max_wait_ms / 1e3
+        while (
+            time.perf_counter() < deadline
+            and self._key_rows(key) < self.max_batch_rows
+        ):
+            timeout = deadline - time.perf_counter()
+            self._arrival.clear()
+            try:
+                await asyncio.wait_for(self._arrival.wait(), timeout)
+            except asyncio.TimeoutError:
+                break
+        dq = self._pending[key]
+        batch, rows = [], 0
+        while dq and rows + dq[0].x.shape[0] <= self.max_batch_rows:
+            req = dq.popleft()
+            batch.append(req)
+            rows += req.x.shape[0]
+        if not dq:
+            del self._pending[key]
+        return batch
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            batch = await self._collect_batch()
+            now = time.perf_counter()
+            rows = sum(r.x.shape[0] for r in batch)
+            self._queued_rows -= rows
+            head = batch[0]
+            try:
+                entry = head.entry
+                x = (
+                    head.x if len(batch) == 1
+                    else np.concatenate([r.x for r in batch])
+                )
+                # The device call blocks; run it off-loop so new submits
+                # keep queueing (they form the next batch) while the
+                # current batch computes.
+                out, meta = await loop.run_in_executor(
+                    None, self.engine.run, entry, head.method, x
+                )
+            except Exception as e:
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                continue
+            self.stats["batches"] += 1
+            offset = 0
+            for r in batch:
+                n = r.x.shape[0]
+                if not r.future.done():
+                    r.future.set_result(out[offset:offset + n])
+                offset += n
+                wait_ms = (now - r.enqueued_at) * 1e3
+                self.stats["queue_wait_ms_total"] += wait_ms
+                if self.log is not None:
+                    self.log.event(
+                        "request", model=r.model_id, method=r.method,
+                        rows=n, batch_rows=rows,
+                        coalesced=len(batch),
+                        queue_wait_ms=round(wait_ms, 3),
+                        device_ms=meta["device_ms"],
+                        bucket=meta["bucket"],
+                        e2e_ms=round(
+                            (time.perf_counter() - r.enqueued_at) * 1e3, 3
+                        ),
+                    )

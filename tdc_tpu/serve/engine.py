@@ -1,0 +1,336 @@
+"""Compiled predict-function cache: bucketed padding so concurrent
+odd-sized requests never trigger recompiles.
+
+jax recompiles per input shape; an online server sees every batch size.
+The engine rounds each device batch up to a power-of-two row bucket, so
+the executable cache converges to O(log max_batch) entries per
+(model, method, kernel) and stays warm forever after.
+
+Bit-exactness contract: predict / predict_proba execute THE SAME jitted
+callables as the public single-request API (models.kmeans.kmeans_predict,
+models.gmm.gmm_predict{,_proba}, models.fuzzy.predict_proba) — not a
+re-jitted copy, whose different fusion context measurably flips low-order
+bits. A batched response row is therefore bit-identical to the
+single-request call (padding rows are row-locally inert and sliced off).
+
+Recompile accounting is two-level: `stats["compiles"]` counts fills of
+the (model-id, generation, method, bucket, kernel) key cache, and
+`jit_cache_size()` reads the executable-cache sizes of every underlying
+jitted callable — the test-grade "zero recompiles after warmup" signal.
+
+Large-K models route hard assignment through
+`parallel.sharded_k.sharded_assign` on the session mesh: the K-sharded
+centroid placement is cached on the registry entry (Mesh-TensorFlow's
+keep-the-layout-live-across-requests argument), so per-request work is
+one data-sharded device_put + the assign tower.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tdc_tpu.serve.registry import ModelEntry
+
+_METHODS = {
+    "kmeans": ("predict", "transform"),
+    "fuzzy": ("predict", "predict_proba", "transform"),
+    "gmm": ("predict", "predict_proba"),
+}
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(int(n - 1).bit_length(), 0)
+
+
+@jax.jit
+def _transform_jit(x, c):
+    """sklearn KMeans.transform parity: (N, K) Euclidean distances."""
+    from tdc_tpu.ops.distance import pairwise_sq_dist
+
+    return jnp.sqrt(jnp.maximum(pairwise_sq_dist(x, c), 0.0))
+
+
+@jax.jit
+def _transform_spherical_jit(x, c):
+    x = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+    from tdc_tpu.ops.distance import pairwise_sq_dist
+
+    return jnp.sqrt(jnp.maximum(pairwise_sq_dist(x, c), 0.0))
+
+
+class PredictEngine:
+    """Bucketed, cached predict execution over registry entries.
+
+    mesh: optional 2-D (data × model) jax.sharding.Mesh
+      (parallel.sharded_k.make_mesh_2d). Models with
+      k >= shard_k_threshold run hard assignment through sharded_assign
+      on it; everything else runs the single-logical-device path.
+    """
+
+    def __init__(
+        self,
+        mesh=None,
+        *,
+        shard_k_threshold: int = 8192,
+        min_bucket: int = 8,
+        max_bucket: int = 1 << 15,
+        log=None,
+    ):
+        self.mesh = mesh
+        self.shard_k_threshold = int(shard_k_threshold)
+        self.min_bucket = int(min_bucket)
+        self.max_bucket = int(max_bucket)
+        self.log = log
+        self._fns: dict[tuple, Callable] = {}
+        self.compiled_keys: set[tuple] = set()  # (id, gen, method, bucket, kernel)
+        self.stats = {
+            "batches": 0,
+            "rows": 0,
+            "padded_rows": 0,
+            "compiles": 0,
+            "device_ms_total": 0.0,
+        }
+        if mesh is not None and len(mesh.devices.shape) != 2:
+            raise ValueError(
+                "PredictEngine mesh must be 2-D (data × model); use "
+                "parallel.sharded_k.make_mesh_2d"
+            )
+
+    # ---------------- buckets ----------------
+
+    def bucket(self, rows: int) -> int:
+        """Power-of-two row bucket for a device batch (≥ min_bucket). With
+        a mesh, additionally a multiple of the data-axis size — shard_map
+        requires even divisibility, and a non-power-of-two axis (e.g. 3 of
+        6 devices) divides no power of two, so the lcm keeps the bucket
+        set small AND evenly shardable."""
+        if rows > self.max_bucket:
+            raise ValueError(
+                f"batch of {rows} rows exceeds max_bucket={self.max_bucket}; "
+                "split upstream (the batcher caps batches below this)"
+            )
+        b = max(_next_pow2(rows), self.min_bucket)
+        if self.mesh is not None:
+            import math
+
+            b = math.lcm(b, int(self.mesh.devices.shape[0]))
+        return b
+
+    def methods(self, entry: ModelEntry) -> tuple[str, ...]:
+        return _METHODS[entry.fitted.model]
+
+    # ---------------- compiled-fn construction ----------------
+
+    def _resolve_kernel(self, entry: ModelEntry, method: str) -> str:
+        if (
+            self.mesh is not None
+            and method == "predict"
+            and entry.fitted.model in ("kmeans", "fuzzy")
+            and entry.fitted.k >= self.shard_k_threshold
+        ):
+            return "sharded"
+        k = entry.fitted.kernel
+        return "xla" if k in ("auto", "") else k
+
+    def _evict_stale(self, entry: ModelEntry) -> None:
+        """Drop compiled state for generations OLDER than this entry's.
+        Strictly older, never newer: a late batch for an already-reloaded
+        entry must not evict the new generation's warm fns. Sharded keys
+        carry their generation at index 2 (('__sharded__', id, gen))."""
+        def stale(key) -> bool:
+            if key[0] == "__sharded__":
+                return key[1] == entry.model_id and key[2] < entry.generation
+            return key[0] == entry.model_id and key[1] < entry.generation
+
+        dead = [k for k in self._fns if stale(k)]
+        for k in dead:
+            del self._fns[k]
+        if dead:
+            self.compiled_keys = {
+                k for k in self.compiled_keys if not stale(k)
+            }
+
+    def _build_fn(self, entry: ModelEntry, method: str, kernel: str):
+        """One closure over the entry's device-resident parameters. The
+        predict-family closures delegate to the SAME jitted callables the
+        public API uses — see the module docstring's bit-exactness
+        contract."""
+        fitted = entry.fitted
+        model = fitted.model
+        if method not in _METHODS[model]:
+            raise ValueError(
+                f"model {entry.model_id!r} ({model}) does not support "
+                f"{method!r}; valid: {_METHODS[model]}"
+            )
+        spherical = bool(fitted.params.get("spherical", False))
+
+        if kernel == "sharded":
+            return self._build_sharded_predict(entry, spherical)
+
+        if model == "gmm":
+            from tdc_tpu.models.gmm import (
+                GMMResult,
+                gmm_predict,
+                gmm_predict_proba,
+            )
+
+            result = GMMResult(
+                means=entry.device["means"],
+                variances=entry.device["variances"],
+                weights=entry.device["weights"],
+                n_iter=jnp.asarray(0, jnp.int32),
+                log_likelihood=jnp.asarray(0.0, jnp.float32),
+                converged=jnp.asarray(True),
+                covariance_type=fitted.params.get("covariance_type", "diag"),
+            )
+            impl = gmm_predict if method == "predict" else gmm_predict_proba
+            return lambda x, _impl=impl, _res=result: _impl(x, _res)
+
+        c = entry.device["centroids"]
+        if model == "fuzzy" and method == "predict_proba":
+            from tdc_tpu.models.fuzzy import predict_proba
+
+            m = float(fitted.params.get("m", 2.0))
+            return lambda x, _c=c, _m=m: predict_proba(x, _c, m=_m)
+
+        if method == "transform":
+            impl = _transform_spherical_jit if spherical else _transform_jit
+            return lambda x, _c=c, _impl=impl: _impl(x, _c)
+
+        # hard assignment (kmeans predict / fuzzy predict — argmax u ==
+        # argmin d², see models/fuzzy.fuzzy_predict)
+        from tdc_tpu.models.kmeans import kmeans_predict
+
+        return lambda x, _c=c: kmeans_predict(
+            x, _c, spherical=spherical, kernel=kernel
+        )
+
+    def _build_sharded_predict(self, entry: ModelEntry, spherical: bool):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tdc_tpu.parallel.sharded_k import (
+            DATA_AXIS,
+            MODEL_AXIS,
+            sharded_assign,
+        )
+
+        key = "sharded_centroids"
+        if key not in entry.placements:
+            n_model = int(self.mesh.devices.shape[1])
+            if entry.fitted.k % n_model != 0:
+                raise ValueError(
+                    f"model {entry.model_id!r}: K={entry.fitted.k} not "
+                    f"divisible by mesh model axis {n_model}"
+                )
+            entry.placements[key] = jax.device_put(
+                entry.device["centroids"],
+                NamedSharding(self.mesh, P(MODEL_AXIS, None)),
+            )
+        c_sharded = entry.placements[key]
+        assign = jax.jit(sharded_assign(self.mesh))
+        data_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        self._fns[("__sharded__", entry.model_id, entry.generation)] = assign
+
+        def run(x, _c=c_sharded, _assign=assign, _sh=data_sharding):
+            if spherical:
+                x = np.asarray(x)
+                x = x / np.maximum(
+                    np.linalg.norm(x, axis=-1, keepdims=True), 1e-12
+                )
+            return _assign(jax.device_put(np.asarray(x), _sh), _c)
+
+        return run
+
+    # ---------------- execution ----------------
+
+    def run(
+        self, entry: ModelEntry, method: str, x: np.ndarray
+    ) -> tuple[np.ndarray, dict]:
+        """Execute one device batch: pad rows to the bucket, run the cached
+        fn, slice the real rows back out. Returns (result, meta) where meta
+        carries bucket/device-ms for the request log."""
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[1] != entry.fitted.d:
+            raise ValueError(
+                f"expected (rows, {entry.fitted.d}) points for model "
+                f"{entry.model_id!r}, got {x.shape}"
+            )
+        n = x.shape[0]
+        bucket = self.bucket(n)
+        kernel = self._resolve_kernel(entry, method)
+        self._evict_stale(entry)
+        fkey = (entry.model_id, entry.generation, method, kernel)
+        fn = self._fns.get(fkey)
+        if fn is None:
+            fn = self._fns[fkey] = self._build_fn(entry, method, kernel)
+        if n < bucket:
+            x = np.pad(x, ((0, bucket - n), (0, 0)))
+
+        ckey = (entry.model_id, entry.generation, method, bucket, kernel)
+        warm = ckey in self.compiled_keys
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(x))
+        device_ms = (time.perf_counter() - t0) * 1e3
+
+        if not warm:
+            self.compiled_keys.add(ckey)
+            self.stats["compiles"] += 1
+        self.stats["batches"] += 1
+        self.stats["rows"] += n
+        self.stats["padded_rows"] += bucket - n
+        self.stats["device_ms_total"] += device_ms
+        meta = {
+            "bucket": bucket,
+            "kernel": kernel,
+            "device_ms": round(device_ms, 3),
+            "warm": warm,
+        }
+        if self.log is not None:
+            self.log.event(
+                "engine_batch", model=entry.model_id, method=method,
+                rows=n, **meta,
+            )
+        return np.asarray(out)[:n], meta
+
+    def warmup(self, entry: ModelEntry, methods=None, buckets=None) -> int:
+        """Pre-compile the (method × bucket) grid; returns new cache keys.
+        buckets=None warms min_bucket; an explicit empty list is a no-op
+        (the CLI's --warmup_buckets='' skip)."""
+        before = self.stats["compiles"]
+        methods = methods or self.methods(entry)
+        if buckets is None:
+            buckets = [self.min_bucket]
+        d = entry.fitted.d
+        for method in methods:
+            for b in buckets:
+                self.run(entry, method, np.zeros((int(b), d), np.float32))
+        return self.stats["compiles"] - before
+
+    def jit_cache_size(self) -> int:
+        """Total executable-cache entries across every jitted callable the
+        engine can reach — the ground-truth recompile detector: if this is
+        unchanged across a traffic burst, jax traced nothing new."""
+        import tdc_tpu.models.fuzzy as fuzzy_mod
+        import tdc_tpu.models.gmm as gmm_mod
+        import tdc_tpu.ops.assign as assign_mod
+
+        fns = [
+            _transform_jit,
+            _transform_spherical_jit,
+            getattr(assign_mod, "assign_clusters_jit", None),
+            getattr(gmm_mod, "_posteriors", None),
+            getattr(gmm_mod, "_hard_assign_t", None),
+            getattr(fuzzy_mod, "_memberships_jit", None),
+        ]
+        fns += [f for k, f in self._fns.items() if k[0] == "__sharded__"]
+        total = 0
+        for f in fns:
+            size = getattr(f, "_cache_size", None)
+            if callable(size):
+                total += int(size())
+        return total

@@ -1,0 +1,163 @@
+"""Model registry: fitted models loaded once, parameters device-resident,
+hot-reloadable without dropping in-flight requests.
+
+Mesh-TensorFlow's lesson (PAPERS.md) applied to serving: the reference
+re-staged its centroids through a feed_dict on every call; here a model's
+parameters are `jax.device_put` once at load and every request reuses the
+same device buffers. Reload is an ATOMIC SWAP of the registry entry — a
+request that already resolved the old entry keeps computing against the
+old (still-alive) device arrays; the next request sees the new ones. No
+lock is held across device work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+
+from tdc_tpu.models.persist import (
+    FittedModel,
+    load_fitted,
+    manifest_fingerprint,
+)
+
+
+@dataclass
+class ModelEntry:
+    """One loaded model version. Immutable after construction — hot reload
+    builds a NEW entry and swaps the registry pointer."""
+
+    model_id: str
+    fitted: FittedModel
+    device: dict[str, jax.Array]  # parameter arrays, device-resident
+    generation: int  # bumps on every (re)load of this model_id
+    loaded_at: float
+    # Engine-owned cache of alternative placements (e.g. the K-sharded
+    # layout for sharded_assign). Lives on the entry so a hot reload
+    # naturally invalidates it, and in-flight users of the old entry keep
+    # their old placements.
+    placements: dict[Any, Any] = field(default_factory=dict)
+
+    @property
+    def version(self) -> str:
+        return self.fitted.version
+
+    def info(self) -> dict:
+        f = self.fitted
+        return {
+            "id": self.model_id,
+            "model": f.model,
+            "k": f.k,
+            "d": f.d,
+            "dtype": f.dtype,
+            "kernel": f.kernel,
+            "params": f.params,
+            "version": f.version,
+            "generation": self.generation,
+            "path": f.path,
+            "loaded_at": round(self.loaded_at, 3),
+        }
+
+
+class ModelRegistry:
+    """model_id -> ModelEntry with poll-based versioned hot-reload.
+
+    `add` loads and registers a model; `poll_once` re-stats every tracked
+    manifest (mtime/size/content-hash fingerprint) and reloads the entries
+    whose fingerprint moved. Reads (`get`, `list_models`) never block on a
+    reload in progress: loading happens outside the lock and only the final
+    pointer swap is locked.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, ModelEntry] = {}
+        self._paths: dict[str, str] = {}
+        self._model_hint: dict[str, str | None] = {}
+        self._fingerprints: dict[str, tuple | None] = {}
+        self._generations: dict[str, int] = {}
+
+    def add(self, model_id: str, path: str, *, model: str | None = None,
+            log=None) -> ModelEntry:
+        """Load the model at `path` and register (or replace) `model_id`."""
+        fitted = load_fitted(path, model=model)
+        entry = self._build_entry(model_id, fitted)
+        with self._lock:
+            self._paths[model_id] = path
+            self._model_hint[model_id] = model
+            self._fingerprints[model_id] = manifest_fingerprint(path)
+            self._entries[model_id] = entry
+        if log is not None:
+            log.event("model_loaded", model=model_id,
+                      version=entry.version, generation=entry.generation,
+                      k=fitted.k, d=fitted.d, type=fitted.model)
+        return entry
+
+    def _build_entry(self, model_id: str, fitted: FittedModel) -> ModelEntry:
+        device = {
+            name: jax.device_put(np.asarray(arr, np.float32))
+            for name, arr in fitted.arrays.items()
+        }
+        for buf in device.values():
+            buf.block_until_ready()  # pay the H2D cost at load, not request
+        with self._lock:
+            gen = self._generations.get(model_id, 0) + 1
+            self._generations[model_id] = gen
+        return ModelEntry(
+            model_id=model_id,
+            fitted=fitted,
+            device=device,
+            generation=gen,
+            loaded_at=time.time(),
+        )
+
+    def get(self, model_id: str) -> ModelEntry:
+        try:
+            return self._entries[model_id]
+        except KeyError:
+            raise KeyError(
+                f"unknown model {model_id!r}; have {sorted(self._entries)}"
+            ) from None
+
+    def ids(self) -> list[str]:
+        return sorted(self._entries)
+
+    def list_models(self) -> list[dict]:
+        return [self._entries[mid].info() for mid in sorted(self._entries)]
+
+    def poll_once(self, log=None) -> list[str]:
+        """Reload every tracked model whose manifest fingerprint changed;
+        returns the reloaded ids. A manifest mid-swap (fingerprint None)
+        is skipped until the next poll — the publisher's os.replace makes
+        that window tiny."""
+        with self._lock:
+            tracked = list(self._paths.items())
+        reloaded = []
+        for model_id, path in tracked:
+            fp = manifest_fingerprint(path)
+            if fp is None or fp == self._fingerprints.get(model_id):
+                continue
+            try:
+                fitted = load_fitted(
+                    path, model=self._model_hint.get(model_id)
+                )
+            except Exception as e:  # half-published dir: keep serving old
+                if log is not None:
+                    log.event("model_reload_failed", model=model_id,
+                              error=f"{type(e).__name__}: {e}")
+                continue
+            entry = self._build_entry(model_id, fitted)
+            with self._lock:
+                self._fingerprints[model_id] = fp
+                self._entries[model_id] = entry  # the atomic swap
+            reloaded.append(model_id)
+            if log is not None:
+                log.event("model_reloaded", model=model_id,
+                          version=entry.version,
+                          generation=entry.generation)
+        return reloaded

@@ -1,0 +1,337 @@
+"""Stdlib-only HTTP JSON serving front end + the app object that owns the
+event loop, hot-reload polling, and request-level observability.
+
+Endpoints:
+  POST /predict        {"model": id, "points": [[...], ...]} -> labels
+  POST /predict_proba  soft responsibilities / fuzzy memberships
+  POST /transform      point-to-centroid distance matrix (kmeans/fuzzy)
+  GET  /models         registry listing (id, type, k, d, version, ...)
+  GET  /healthz        liveness + device inventory
+  GET  /metrics        Prometheus text format
+
+Every served request emits one utils/structlog JSONL event (queue wait,
+coalesced batch size, device ms, e2e ms) — the repo's first request-level
+observability layer; EQuARX (PAPERS.md) motivates tracking per-request
+compute cost as a first-class metric rather than an offline afterthought.
+
+The HTTP layer is threads (http.server.ThreadingHTTPServer: one thread
+per connection, all blocking in `future.result()`), the batching layer is
+a single asyncio loop in a daemon thread — requests cross via
+`asyncio.run_coroutine_threadsafe`. Keeping the loop private to the app
+means an embedding test can also drive the batcher directly with its own
+loop and never touch HTTP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import concurrent.futures
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from tdc_tpu.serve.batcher import MicroBatcher, Overloaded
+from tdc_tpu.serve.engine import PredictEngine
+from tdc_tpu.serve.registry import ModelRegistry
+
+_PREDICT_ENDPOINTS = ("predict", "predict_proba", "transform")
+_RESULT_FIELD = {
+    "predict": "labels",
+    "predict_proba": "proba",
+    "transform": "distances",
+}
+
+
+class ServeApp:
+    """Registry + engine + batcher + loop thread, one object.
+
+    Construct, `start()`, then either `serve_http(...)` (blocking) or use
+    `request(...)` / `handle_get(...)` in-process.
+    """
+
+    def __init__(
+        self,
+        registry: ModelRegistry | None = None,
+        engine: PredictEngine | None = None,
+        *,
+        mesh=None,
+        log=None,
+        max_batch_rows: int = 4096,
+        max_wait_ms: float = 2.0,
+        max_queue_rows: int = 65536,
+        poll_interval: float = 2.0,
+        request_timeout: float = 30.0,
+    ):
+        self.log = log
+        self.registry = registry or ModelRegistry()
+        self.engine = engine or PredictEngine(mesh, log=log)
+        self.batcher = MicroBatcher(
+            self.registry,
+            self.engine,
+            max_batch_rows=max_batch_rows,
+            max_wait_ms=max_wait_ms,
+            max_queue_rows=max_queue_rows,
+            log=log,
+        )
+        self.poll_interval = float(poll_interval)
+        self.request_timeout = float(request_timeout)
+        self.started_at = time.time()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread: threading.Thread | None = None
+        self._poll_task = None
+        self._httpd: ThreadingHTTPServer | None = None
+        self._counters: collections.Counter = collections.Counter()
+        self._latencies: dict[str, collections.deque] = {
+            ep: collections.deque(maxlen=2048) for ep in _PREDICT_ENDPOINTS
+        }
+
+    # ---------------- lifecycle ----------------
+
+    def start(self) -> None:
+        """Start the batching loop thread and the hot-reload poller."""
+        if self._loop is not None:
+            return
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        self._loop_thread = threading.Thread(
+            target=loop.run_forever, name="tdc-serve-loop", daemon=True
+        )
+        self._loop_thread.start()
+        if self.poll_interval > 0:
+            self._poll_task = asyncio.run_coroutine_threadsafe(
+                self._poll_models(), loop
+            )
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        loop, self._loop = self._loop, None
+        if loop is None:
+            return
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            self._poll_task = None
+        asyncio.run_coroutine_threadsafe(
+            self.batcher.close(), loop
+        ).result(timeout=5)
+        loop.call_soon_threadsafe(loop.stop)
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+            self._loop_thread = None
+        loop.close()
+
+    async def _poll_models(self) -> None:
+        while True:
+            await asyncio.sleep(self.poll_interval)
+            try:
+                self.registry.poll_once(log=self.log)
+            except Exception as e:  # polling must never kill the loop
+                if self.log is not None:
+                    self.log.event(
+                        "poll_error", error=f"{type(e).__name__}: {e}"
+                    )
+
+    # ---------------- request handling (transport-agnostic) ----------------
+
+    def request(self, endpoint: str, payload: dict) -> tuple[int, dict]:
+        """One predict-family request from any thread; returns
+        (http_status, response_dict)."""
+        t0 = time.perf_counter()
+        status, body = self._request_inner(endpoint, payload)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._counters[(endpoint, status)] += 1
+        if status == 200:
+            self._latencies[endpoint].append(ms)
+        return status, body
+
+    def _request_inner(self, endpoint: str, payload: dict) -> tuple[int, dict]:
+        if self._loop is None:
+            return 503, {"error": "server not started"}
+        if endpoint not in _PREDICT_ENDPOINTS:
+            return 404, {"error": f"unknown endpoint /{endpoint}"}
+        model_id = payload.get("model")
+        points = payload.get("points")
+        if not isinstance(model_id, str) or points is None:
+            return 400, {"error": "body must be {'model': id, 'points': [[...]]}"}
+        try:
+            x = np.asarray(points, np.float32)
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"points not numeric: {e}"}
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.ndim != 2 or x.shape[0] == 0 or not np.isfinite(x).all():
+            return 400, {"error": "points must be a non-empty finite 2-D array"}
+        fut = asyncio.run_coroutine_threadsafe(
+            self.batcher.submit_full(model_id, endpoint, x), self._loop
+        )
+        try:
+            # The version in the response comes from the SAME entry the
+            # batcher resolved at submit time — a hot reload between two
+            # separate registry reads would otherwise pair one version's
+            # predictions with the other's hash.
+            out, entry = fut.result(timeout=self.request_timeout)
+        except Overloaded as e:
+            return 503, {"error": "overloaded", "detail": str(e)}
+        except KeyError as e:
+            return 404, {"error": str(e)}
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        except concurrent.futures.TimeoutError:
+            # NOT builtin TimeoutError: on 3.10 futures.TimeoutError is a
+            # distinct class (they merge in 3.11), and the builtin name
+            # would let timeouts escape as 500s.
+            fut.cancel()
+            return 504, {"error": "request timed out"}
+        field = _RESULT_FIELD[endpoint]
+        return 200, {
+            "model": model_id,
+            "version": entry.version,
+            "rows": int(out.shape[0]),
+            field: out.tolist(),
+        }
+
+    def handle_get(self, path: str) -> tuple[int, str, str]:
+        """GET dispatch; returns (status, content_type, body_text)."""
+        if path == "/models":
+            self._counters[("models", 200)] += 1
+            return 200, "application/json", json.dumps(
+                {"models": self.registry.list_models()}
+            )
+        if path == "/healthz":
+            import jax
+
+            self._counters[("healthz", 200)] += 1
+            return 200, "application/json", json.dumps({
+                "status": "ok",
+                "models": self.registry.ids(),
+                "devices": len(jax.devices()),
+                "uptime_s": round(time.time() - self.started_at, 1),
+            })
+        if path == "/metrics":
+            return 200, "text/plain; version=0.0.4", self.metrics_text()
+        return 404, "application/json", json.dumps(
+            {"error": f"unknown path {path}"}
+        )
+
+    # ---------------- metrics ----------------
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the request/batch/engine stats."""
+        e, b = self.engine.stats, self.batcher.stats
+        lines = [
+            "# HELP tdc_serve_requests_total Requests by endpoint and status.",
+            "# TYPE tdc_serve_requests_total counter",
+        ]
+        for (endpoint, status), n in sorted(self._counters.items()):
+            lines.append(
+                f'tdc_serve_requests_total{{endpoint="{endpoint}",'
+                f'status="{status}"}} {n}'
+            )
+        scalar = [
+            ("tdc_serve_batches_total", "counter",
+             "Coalesced device batches executed.", b["batches"]),
+            ("tdc_serve_batched_requests_total", "counter",
+             "Requests that went through the batcher.", b["requests"]),
+            ("tdc_serve_rejected_total", "counter",
+             "Requests rejected with overloaded backpressure.",
+             b["rejected"]),
+            ("tdc_serve_engine_rows_total", "counter",
+             "Real data rows computed on device.", e["rows"]),
+            ("tdc_serve_engine_padded_rows_total", "counter",
+             "Bucket-padding rows computed on device.", e["padded_rows"]),
+            ("tdc_serve_engine_compiles_total", "counter",
+             "jit traces paid (bucket warmup).", e["compiles"]),
+            ("tdc_serve_engine_device_ms_total", "counter",
+             "Device compute milliseconds.",
+             round(e["device_ms_total"], 3)),
+            ("tdc_serve_queue_wait_ms_total", "counter",
+             "Milliseconds requests spent queued before dispatch.",
+             round(b["queue_wait_ms_total"], 3)),
+            ("tdc_serve_models", "gauge",
+             "Models currently registered.", len(self.registry.ids())),
+        ]
+        for name, typ, help_, val in scalar:
+            lines += [f"# HELP {name} {help_}", f"# TYPE {name} {typ}",
+                      f"{name} {val}"]
+        lines += [
+            "# HELP tdc_serve_latency_ms Recent end-to-end latency "
+            "quantiles per endpoint.",
+            "# TYPE tdc_serve_latency_ms summary",
+        ]
+        for endpoint, window in sorted(self._latencies.items()):
+            if not window:
+                continue
+            arr = np.asarray(window)
+            for q in (0.5, 0.9, 0.99):
+                lines.append(
+                    f'tdc_serve_latency_ms{{endpoint="{endpoint}",'
+                    f'quantile="{q}"}} '
+                    f"{round(float(np.quantile(arr, q)), 3)}"
+                )
+        return "\n".join(lines) + "\n"
+
+    # ---------------- HTTP transport ----------------
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 8100):
+        """Blocking HTTP serve loop; returns the bound (host, port) via the
+        server object on another thread if needed."""
+        self._httpd = _make_httpd(self, host, port)
+        try:
+            self._httpd.serve_forever()
+        finally:
+            httpd, self._httpd = self._httpd, None
+            if httpd is not None:
+                httpd.server_close()
+
+    def start_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Non-blocking HTTP serving on a daemon thread; returns the bound
+        port (port=0 picks a free one — the test path)."""
+        self._httpd = _make_httpd(self, host, port)
+        bound = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, name="tdc-serve-http",
+            daemon=True,
+        ).start()
+        return bound
+
+
+def _make_httpd(app: ServeApp, host: str, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # structlog, not stderr noise
+            if app.log is not None:
+                app.log.event("http", line=fmt % args)
+
+        def _reply(self, status: int, content_type: str, body: str) -> None:
+            data = body.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            status, ctype, body = app.handle_get(self.path)
+            self._reply(status, ctype, body)
+
+        def do_POST(self):
+            endpoint = self.path.lstrip("/")
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, TypeError) as e:
+                self._reply(400, "application/json",
+                            json.dumps({"error": f"bad JSON body: {e}"}))
+                return
+            status, body = app.request(endpoint, payload)
+            self._reply(status, "application/json", json.dumps(body))
+
+    return ThreadingHTTPServer((host, port), Handler)

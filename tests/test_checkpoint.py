@@ -432,7 +432,8 @@ def test_independent_per_host_checkpoints_no_deadlock(tmp_path):
         import jax
         jax.config.update("jax_platforms", "cpu")
         port, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
-        jax.distributed.initialize(f"127.0.0.1:{port}", 2, pid)
+        from tdc_tpu.parallel.multihost import initialize_distributed
+        initialize_distributed(f"127.0.0.1:{port}", 2, pid)
         import numpy as np
         from tdc_tpu.models.streaming import streamed_kmeans_fit
         rng = np.random.default_rng(pid)

@@ -82,8 +82,11 @@ def test_cli_shard_k(tmp_path):
     """--shard_k: K-sharded 2-D (data x model) mesh end-to-end through the
     CLI (round-1 VERDICT item 1 — this regime was library-only)."""
     log = str(tmp_path / "log.csv")
+    # 80-iteration headroom: iterations-to-converge at tol=1e-6 varies
+    # with the backend's reduction order (50 on jaxlib 0.4.37 CPU, <30 on
+    # the authoring version); the assertion is convergence, not the count.
     rc = cli_main(
-        f"--n_obs=4000 --n_dim=4 --K=8 --n_max_iters=30 --seed=1 "
+        f"--n_obs=4000 --n_dim=4 --K=8 --n_max_iters=80 --seed=1 "
         f"--log_file={log} --n_GPUs=8 --shard_k=4 --tol=1e-6".split()
     )
     assert rc == 0
@@ -734,3 +737,70 @@ def test_cli_shard_k_fuzzy_ckpt_routes_to_streamed(tmp_path):
     row = list(csv.DictReader(open(log)))[-1]
     assert row["status"] == "ok"
     assert int(row["n_iter"]) == 5
+
+
+def test_cli_features_layout_reads_data_file(tmp_path):
+    """--layout=features x --data_file (round-5 VERDICT weak #5): the tall
+    layout runs on a real dataset loaded from disk and lands the same SSE
+    as the sample-major fit of the same file."""
+    rng = np.random.default_rng(3)
+    centers = np.array([[0, 0, 0, 0], [8, 8, 8, 8], [-8, 8, -8, 8]],
+                       np.float32)
+    x = np.concatenate([
+        (c + rng.normal(scale=0.5, size=(400, 4))).astype(np.float32)
+        for c in centers
+    ])
+    data = str(tmp_path / "pts.npy")
+    np.save(data, x)
+
+    log_f = str(tmp_path / "feat.csv")
+    rc = cli_main(
+        f"--data_file={data} --K=3 --n_max_iters=25 --seed=5 "
+        f"--log_file={log_f} --n_GPUs=1 --layout=features".split()
+    )
+    assert rc == 0
+    feat = list(csv.DictReader(open(log_f)))[0]
+    assert feat["status"] == "ok"
+
+    log_s = str(tmp_path / "samp.csv")
+    rc = cli_main(
+        f"--data_file={data} --K=3 --n_max_iters=25 --seed=5 "
+        f"--log_file={log_s} --n_GPUs=1 --layout=samples".split()
+    )
+    assert rc == 0
+    samp = list(csv.DictReader(open(log_s)))[0]
+    # same data, same seed: both layouts find the 3 well-separated blobs
+    assert abs(float(feat["sse"]) - float(samp["sse"])) <= (
+        1e-3 * max(float(samp["sse"]), 1.0)
+    )
+
+
+def test_cli_features_layout_fm_npy_passthrough(tmp_path):
+    """A pre-converted *.fm.npy feature-major file serves the tall layout
+    via mmap pass-through."""
+    from tdc_tpu.data.loader import to_feature_major
+
+    rng = np.random.default_rng(4)
+    x = (rng.normal(size=(900, 3)) * 2).astype(np.float32)
+    src = str(tmp_path / "pts.npy")
+    np.save(src, x)
+    fm = to_feature_major(src, str(tmp_path / "pts.fm.npy"))
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--data_file={fm} --K=4 --n_max_iters=15 --seed=2 "
+        f"--log_file={log} --n_GPUs=1 --layout=features".split()
+    )
+    assert rc == 0
+    assert list(csv.DictReader(open(log)))[0]["status"] == "ok"
+
+
+def test_cli_features_layout_data_file_still_rejects_streamed(tmp_path):
+    # lifting the data_file gate must not loosen the in-memory contract
+    data = str(tmp_path / "pts.npy")
+    np.save(data, np.zeros((16, 3), np.float32))
+    parser = build_parser()
+    with pytest.raises(SystemExit):
+        args = parser.parse_args(
+            f"--data_file={data} --K=3 --layout=features --streamed".split()
+        )
+        validate_args(parser, args)

