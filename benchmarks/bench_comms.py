@@ -1,0 +1,179 @@
+"""Communication-strategy sweep for the streamed distributed fits
+(parallel/reduce): strategy × K on the 8-device mesh.
+
+What this measures, per (mesh, strategy, K):
+
+- **reduces_per_pass / bytes_per_pass** — the comms accounting from the
+  fit result's `CommsReport` (parallel/reduce.py): cross-device
+  sufficient-stat reduces issued and the logical payload bytes they moved.
+  The acceptance invariant under test: per-pass reduction issues EXACTLY
+  one cross-device reduce per Lloyd iteration, vs num_batches for the
+  per-batch default; the quantized encodings shrink bytes_per_pass by
+  ~2x (bf16) / ~3.7x (int8 + scales) at K=1024, d=64.
+- **max_centroid_delta / rel_inertia_delta** — numerics vs the per-batch
+  f32 baseline on the same mesh: per-pass reorders f32 summation
+  (tolerance-level, ~1e-6 on this data), and the quantized modes carry
+  error feedback (documented bound: inertia within 1e-3 relative).
+- **wall_s** — whole-fit wall clock. CAVEAT (the cpu_mesh_scaling.py
+  lesson): the 8 virtual CPU devices share one CPU's cores, so wall-clock
+  differences here mostly measure dispatch/thread contention, NOT link
+  time — on real multi-chip hardware the collective count and DCN bytes
+  are the quantities that dominate, which is exactly what the counters
+  report. Treat wall_s as context, the counters as the result.
+
+Mesh column: `flat8` = 1-D 8-device data mesh; `hier2x4` = hierarchical
+(dcn=2, ici=4) mesh (mesh.make_hierarchical_mesh) — two staged reduces
+whose DCN stage moves the payload once per host group.
+
+Run:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python benchmarks/bench_comms.py            # full sweep -> CSV
+  python benchmarks/bench_comms.py --smoke        # CI one-liner (~20 s)
+
+Writes benchmarks/comms_8dev_cpu.csv; analysis note in
+benchmarks/COMMS.md. One JSON line per configuration on stdout.
+"""
+
+import csv
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tdc_tpu.models.streaming import streamed_kmeans_fit  # noqa: E402
+from tdc_tpu.parallel.mesh import (  # noqa: E402
+    make_hierarchical_mesh,
+    make_mesh,
+)
+
+OUT = os.path.join(os.path.dirname(__file__), "comms_8dev_cpu.csv")
+STRATEGIES = ("per_batch", "per_pass", "per_pass:bf16", "per_pass:int8")
+FIELDS = [
+    "mesh", "strategy", "K", "d", "n", "batch_rows", "n_batches", "iters",
+    "passes", "reduces_per_pass", "bytes_per_pass", "total_reduces",
+    "total_bytes", "max_centroid_delta", "rel_inertia_delta", "wall_s",
+]
+
+
+def _data(n, d, k, seed=123128):
+    """k well-separated gaussian blobs in d dims (the reference sweep's
+    seed); init = the true centers so every strategy follows the same
+    short, well-conditioned trajectory."""
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10.0, 10.0, size=(k, d)).astype(np.float32)
+    x = np.repeat(centers, n // k, axis=0) + rng.normal(
+        0, 0.5, size=(n // k * k, d)
+    ).astype(np.float32)
+    rng.shuffle(x)
+    return x, centers
+
+
+def run_one(mesh_name, mesh, strategy, k, d, n, batch_rows, iters):
+    x, centers = _data(n, d, k)
+    batches = lambda: (
+        x[i: i + batch_rows] for i in range(0, len(x), batch_rows)
+    )
+    t0 = time.perf_counter()
+    res = streamed_kmeans_fit(
+        batches, k, d, init=centers, max_iters=iters, tol=-1.0, mesh=mesh,
+        reduce=strategy,
+    )
+    jax.block_until_ready(res.centroids)
+    wall = time.perf_counter() - t0
+    c = res.comms
+    row = {
+        "mesh": mesh_name, "strategy": strategy, "K": k, "d": d,
+        "n": len(x), "batch_rows": batch_rows,
+        "n_batches": -(-len(x) // batch_rows), "iters": iters,
+        "passes": c.passes,
+        "reduces_per_pass": round(c.reduces / c.passes, 3),
+        "bytes_per_pass": c.logical_bytes // c.passes,
+        "total_reduces": c.reduces, "total_bytes": c.logical_bytes,
+        "wall_s": round(wall, 3),
+    }
+    return row, res
+
+
+def sweep(ks, d, n, batch_rows, iters, meshes):
+    rows = []
+    for mesh_name, mesh, strategies in meshes:
+        for k in ks:
+            baseline = None
+            for strategy in strategies:
+                row, res = run_one(
+                    mesh_name, mesh, strategy, k, d, n, batch_rows, iters
+                )
+                if baseline is None:  # per_batch runs first
+                    baseline = res
+                bc = np.asarray(baseline.centroids)
+                row["max_centroid_delta"] = float(
+                    np.max(np.abs(np.asarray(res.centroids) - bc))
+                )
+                row["rel_inertia_delta"] = float(
+                    abs(float(res.sse) - float(baseline.sse))
+                    / max(float(baseline.sse), 1e-12)
+                )
+                rows.append(row)
+                print(json.dumps(row))
+    return rows
+
+
+def main(argv):
+    smoke = "--smoke" in argv
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        print(f"note: only {n_dev} devices visible; expected the 8-device "
+              "mesh", file=sys.stderr)
+    flat = make_mesh(min(8, n_dev))
+    meshes = [("flat8", flat, STRATEGIES)]
+    if min(8, n_dev) % 2 == 0:
+        meshes.append(
+            ("hier2x4", make_hierarchical_mesh(2, n_devices=min(8, n_dev)),
+             ("per_batch", "per_pass"))
+        )
+
+    if smoke:
+        rows = sweep([16], d=16, n=2048, batch_rows=256, iters=2,
+                     meshes=meshes[:1])
+        by = {r["strategy"]: r for r in rows}
+        ok = (
+            by["per_pass"]["reduces_per_pass"] == 1.0
+            and by["per_batch"]["reduces_per_pass"]
+            == by["per_batch"]["n_batches"]
+            and all(r["rel_inertia_delta"] < 1e-3 for r in rows)
+        )
+        print(
+            "COMMS-SMOKE "
+            + ("PASS" if ok else "FAIL")
+            + f": per_pass={by['per_pass']['reduces_per_pass']}/pass, "
+            f"per_batch={by['per_batch']['reduces_per_pass']}/pass "
+            f"(n_batches={by['per_batch']['n_batches']}), "
+            f"worst rel_inertia_delta="
+            f"{max(r['rel_inertia_delta'] for r in rows):.2e}"
+        )
+        return 0 if ok else 1
+
+    rows = sweep([16, 256, 1024], d=64, n=8192, batch_rows=1024, iters=5,
+                 meshes=meshes)
+    with open(OUT, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=FIELDS)
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {OUT} ({len(rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

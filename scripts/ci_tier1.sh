@@ -15,12 +15,26 @@ cd "$(dirname "$0")/.."
 log="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$log"
 
+# --strict-markers: an unregistered @pytest.mark.* (e.g. a typo'd
+# `multiproc` or `slow`) silently de-selects nothing and rots; make it a
+# collection error instead.
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
+    --strict-markers \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$log"
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$log" | tr -cd . | wc -c)"
+
+# Comms-strategy smoke (parallel/reduce): proves per-pass reduction issues
+# exactly 1 cross-device reduce per iteration on the 8-device mesh and the
+# strategies stay within numeric tolerance. ~20 s; prints one PASS/FAIL line.
+comms_rc=0
+if [ -z "$SKIP_COMMS_SMOKE" ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python benchmarks/bench_comms.py --smoke \
+        | tail -n 1 || comms_rc=$?
+fi
 
 lint_rc=0
 if [ -z "$SKIP_LINT" ]; then
@@ -36,4 +50,5 @@ if [ -z "$SKIP_LINT" ]; then
 fi
 
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+if [ "$comms_rc" -ne 0 ]; then exit "$comms_rc"; fi
 exit "$lint_rc"

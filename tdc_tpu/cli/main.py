@@ -35,6 +35,18 @@ def _valid_int(parser, name, value, minimum=1):
     return v
 
 
+def _sharded_reduce(args) -> str:
+    """--reduce for the K-sharded drivers: quantized encodings are wired
+    for the 1-D streamed fits only — fail in the CLI's vocabulary instead
+    of a deep driver ValueError."""
+    if args.reduce.startswith("per_pass:"):
+        raise SystemExit(
+            "--reduce=per_pass:bf16|int8 applies to the 1-D streamed fits; "
+            "--shard_k supports --reduce=per_batch|per_pass"
+        )
+    return args.reduce
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tdc_tpu",
@@ -131,6 +143,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--block_rows", type=int, default=-1,
                    help="N-block rows inside each shard for --shard_k "
                         "(-1 = auto from device memory, 0 = no blocking)")
+    p.add_argument("--reduce", type=str, default="per_batch",
+                   choices=("per_batch", "per_pass", "per_pass:bf16",
+                            "per_pass:int8"),
+                   help="cross-device stats reduction strategy for the "
+                        "streamed fits (parallel/reduce.py): 'per_pass' "
+                        "defers to ONE reduce per iteration instead of one "
+                        "per batch (f32 summation reorder — tolerance-level "
+                        "parity); ':bf16'/':int8' additionally quantize the "
+                        "(K, d) sums on the wire with error feedback "
+                        "(1-D meshes only)")
     p.add_argument("--native_loader", action="store_true",
                    help="stream batches through the C++ prefetch loader "
                         "(requires --data_file pointing at an .npy)")
@@ -605,6 +627,25 @@ def run_experiment(args) -> dict:
         import jax.numpy as jnp
 
         streamed = args.streamed or num_batches > 1
+        if args.reduce != "per_batch":
+            # Fail fast instead of silently ignoring the knob: only the
+            # streamed drivers take reduce= (in-memory fits are already
+            # one reduce per iteration by construction; mean_combine /
+            # minibatch / the K-sharded GMM driver have no knob).
+            unsupported = (
+                not streamed or args.mean_combine or args.minibatch
+                or args.method_name == "bisectingKMeans"
+                or (mesh2d is not None
+                    and args.method_name == "gaussianMixture")
+            )
+            if unsupported:
+                raise SystemExit(
+                    f"--reduce={args.reduce} applies to the streamed "
+                    "kmeans/fuzzy/gaussianMixture drivers (add "
+                    "--streamed/--num_batches); in-memory fits already "
+                    "reduce once per iteration, and mean_combine/minibatch/"
+                    "bisecting/--shard_k gaussianMixture take no strategy"
+                )
 
         def weight_stream(rows):
             # aligned batch-for-batch with make_stream's row slicing
@@ -640,7 +681,9 @@ def run_experiment(args) -> dict:
                 rows = -(-n_obs // num_batches)
             else:
                 rows = min(auto_batch_size(n_dim, args.K,
-                                           n_devices=n_devices), n_obs)
+                                           n_devices=n_devices,
+                                           kernel=args.kernel or "xla"),
+                           n_obs)
             return minibatch_kmeans_fit(
                 make_stream(rows), args.K, n_dim, init=args.init, key=key,
                 epochs=args.n_max_iters, tol=args.tol, mesh=mesh,
@@ -682,6 +725,7 @@ def run_experiment(args) -> dict:
                     prefetch=args.prefetch,
                     ckpt_dir=args.ckpt_dir,
                     ckpt_every_batches=args.ckpt_every_batches,
+                    reduce=_sharded_reduce(args),
                 )
             from tdc_tpu.parallel.sharded_k import fuzzy_fit_sharded
 
@@ -734,6 +778,7 @@ def run_experiment(args) -> dict:
                 prefetch=args.prefetch,
                 ckpt_dir=args.ckpt_dir,
                 ckpt_every_batches=args.ckpt_every_batches,
+                reduce=_sharded_reduce(args),
             )
         if args.method_name == "gaussianMixture":
             if streamed:
@@ -750,6 +795,7 @@ def run_experiment(args) -> dict:
                     sample_weight_batches=(
                         weight_stream(rows) if weights is not None else None
                     ),
+                    reduce=args.reduce,
                 )
             from tdc_tpu.models.gmm import gmm_fit
 
@@ -795,6 +841,7 @@ def run_experiment(args) -> dict:
                         weight_stream(rows) if weights is not None else None
                     ),
                     kernel=args.kernel or "xla",
+                    reduce=args.reduce,
                 )
             return fuzzy_cmeans_fit(
                 xx, args.K, m=args.fuzzifier, init=args.init, key=key,
@@ -827,6 +874,7 @@ def run_experiment(args) -> dict:
                     weight_stream(rows) if weights is not None else None
                 ),
                 kernel=args.kernel or "xla",
+                reduce=args.reduce,
             )
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,

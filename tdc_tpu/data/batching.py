@@ -36,14 +36,26 @@ def device_hbm_bytes(device=None) -> int:
 
 
 def auto_batch_size(
-    n_dim: int, k: int, *, n_devices: int = 1, itemsize: int = 4, device=None
+    n_dim: int, k: int, *, n_devices: int = 1, itemsize: int = 4,
+    device=None, kernel: str = "xla",
 ) -> int:
     """Max points per *global* batch that fit the per-device working set.
 
     Replaces the magic table keyed on GPU count (New-Distributed-KMeans.ipynb#cell13)
     with bytes_limit-derived sizing: rows_per_device = safety * HBM / bytes_per_row.
+
+    The working-set model is kernel-aware: the XLA matmul form budgets the
+    (N, K) distance row AND the materialized f32 one-hot row per point; the
+    fused Pallas kernels stream (block, K) tiles through VMEM and never
+    materialize either in HBM — their only N-sized arrays are the x rows
+    plus the (1,) label/min columns — so kernel='pallas' admits batches up
+    to ~(1 + 8k/(itemsize·d))× larger at the same HBM budget.
     """
-    bytes_per_row = itemsize * n_dim + 4 * k + 4 * k  # x + dists + one-hot, f32
+    if kernel == "pallas":
+        # x row + the per-point (label, min) columns; no HBM (N, K) buffers.
+        bytes_per_row = itemsize * n_dim + 8
+    else:
+        bytes_per_row = itemsize * n_dim + 4 * k + 4 * k  # x + dists + one-hot
     per_device = int(_SAFETY_FRACTION * device_hbm_bytes(device) / bytes_per_row)
     return max(per_device * n_devices, 1)
 

@@ -38,6 +38,9 @@ class FuzzyCMeansResult(NamedTuple):
     history: object = None
     # Iterations executed by THIS fit call (None = same as n_iter).
     n_iter_run: object = None
+    # parallel/reduce.CommsReport — cross-device stats-reduce accounting,
+    # filled by the streamed drivers (None for in-memory fits).
+    comms: object = None
 
 
 def _fuzzy_stats_fn(kernel: str, m: float, block_rows: int, mesh=None):

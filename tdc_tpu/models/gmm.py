@@ -36,7 +36,7 @@ beyond O(K·d) device state.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -63,6 +63,9 @@ class GMMResult(NamedTuple):
     # do reports 0, not an inflated rate from timing a bare scoring pass.
     n_iter_run: object = None
     covariance_type: str = "diag"
+    # parallel/reduce.CommsReport — cross-device stats-reduce accounting,
+    # filled by the streamed drivers (None for in-memory fits).
+    comms: object = None
 
 
 COVARIANCE_TYPES = ("diag", "spherical", "tied", "full")
@@ -572,15 +575,11 @@ class GMMStats(NamedTuple):
     sxx: jax.Array  # second moment, shape per covariance type (see above)
 
 
-@partial(jax.jit, static_argnames=("kernel", "cov_type"))
-def _accumulate_gmm(acc, batch, means, variances, weights, n_valid,
-                    kernel: str = "xla", cov_type: str = "diag"):
-    """Add one (possibly zero-padded) batch's EM stats; subtract the
-    padding's exact contribution (a zero row's responsibilities and
-    log-likelihood depend only on the parameters — same correction pattern
-    as the streamed fuzzy fit). Zero rows add exactly nothing to sx/sxx.
-    kernel='pallas' computes the batch stats with the fused E-step kernel
-    (single-device diag streams only)."""
+def _batch_gmm_stats(batch, means, variances, weights,
+                     kernel: str = "xla", cov_type: str = "diag") -> GMMStats:
+    """One batch's raw E-step stats — no accumulator, no pad correction —
+    shared by the per-batch accumulate and the deferred per-pass tower.
+    kernel='pallas' computes them with the fused E-step kernel."""
     log_w = jnp.log(weights)
     if kernel == "pallas":
         var_d = (
@@ -607,27 +606,13 @@ def _accumulate_gmm(acc, batch, means, variances, weights, n_valid,
             sxx_b = jax.lax.map(lambda rk: (xf * rk[:, None]).T @ xf, r.T)
         else:  # tied: Σ xxᵀ, responsibility-free (Σ_k r = 1 per point)
             sxx_b = xf.T @ xf  # (d, d)
-    n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(
-        jnp.float32
-    )
-    zlogp = _log_prob_t(jnp.zeros((1, batch.shape[1]), batch.dtype), means,
-                        variances, log_w, cov_type)
-    znorm = jax.scipy.special.logsumexp(zlogp, axis=1)
-    zr = jnp.exp(zlogp - znorm[:, None])[0]
-    return GMMStats(
-        ll_sum=acc.ll_sum + ll_b - n_pad * znorm[0],
-        nk=acc.nk + nk_b - n_pad * zr,
-        sx=acc.sx + sx_b,
-        sxx=acc.sxx + sxx_b,
-    )
+    return GMMStats(ll_sum=ll_b, nk=nk_b, sx=sx_b, sxx=sxx_b)
 
 
-@partial(jax.jit, static_argnames=("cov_type",))
-def _accumulate_gmm_weighted(acc, batch, w, means, variances, weights,
-                             cov_type: str = "diag"):
-    """Weighted batch EM stats. No padding correction needed: pad rows
-    carry ZERO WEIGHT, so they contribute exactly nothing to
-    ll/nk/sx/sxx (same pattern as the streamed weighted K-Means)."""
+def _batch_gmm_stats_weighted(batch, w, means, variances, weights,
+                              cov_type: str = "diag") -> GMMStats:
+    """Weighted one-batch raw E-step stats (responsibilities scaled by w;
+    zero-weight rows contribute exactly nothing)."""
     log_w = jnp.log(weights)
     logp = _log_prob_t(batch, means, variances, log_w, cov_type)
     norm = jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
@@ -642,12 +627,147 @@ def _accumulate_gmm_weighted(acc, batch, w, means, variances, weights,
         sxx_b = jax.lax.map(lambda rk: (xf * rk[:, None]).T @ xf, r.T)
     else:  # tied: Σ w·xxᵀ (responsibility-free)
         sxx_b = (xf * w[:, None]).T @ xf
-    return GMMStats(
-        ll_sum=acc.ll_sum + ll_b,
-        nk=acc.nk + nk_b,
-        sx=acc.sx + sx_b,
-        sxx=acc.sxx + sxx_b,
+    return GMMStats(ll_sum=ll_b, nk=nk_b, sx=sx_b, sxx=sxx_b)
+
+
+def _gmm_zero_row_correction(means, variances, weights, n_pad, d, dtype,
+                             cov_type: str):
+    """(Δll, Δnk) a batch of `n_pad` zero rows contributed: their
+    responsibilities and log-likelihood depend only on the parameters (zero
+    rows add exactly nothing to sx/sxx)."""
+    log_w = jnp.log(weights)
+    zlogp = _log_prob_t(jnp.zeros((1, d), dtype), means,
+                        variances, log_w, cov_type)
+    znorm = jax.scipy.special.logsumexp(zlogp, axis=1)
+    zr = jnp.exp(zlogp - znorm[:, None])[0]
+    return n_pad * znorm[0], n_pad * zr
+
+
+@partial(jax.jit, static_argnames=("kernel", "cov_type", "mesh"))
+def _accumulate_gmm(acc, batch, means, variances, weights, n_valid,
+                    kernel: str = "xla", cov_type: str = "diag", mesh=None):
+    """Add one (possibly zero-padded) batch's EM stats; subtract the
+    padding's exact contribution (a zero row's responsibilities and
+    log-likelihood depend only on the parameters — same correction pattern
+    as the streamed fuzzy fit). Zero rows add exactly nothing to sx/sxx.
+    kernel='pallas' computes the batch stats with the fused E-step kernel
+    (single-device diag streams only). A hierarchical (dcn, ici) mesh
+    reduces through the explicit two-stage ICI-then-DCN tower."""
+    from tdc_tpu.parallel import mesh as mesh_lib
+
+    if mesh is not None and mesh_lib.is_hierarchical(mesh):
+        from tdc_tpu.parallel.reduce import reduced_tree_stats
+
+        s = reduced_tree_stats(
+            mesh,
+            lambda x, mu, v, w: _batch_gmm_stats(x, mu, v, w, kernel,
+                                                 cov_type),
+            1, 4,
+        )(batch, means, variances, weights)
+    else:
+        s = _batch_gmm_stats(batch, means, variances, weights, kernel,
+                             cov_type)
+    n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(
+        jnp.float32
     )
+    dll, dnk = _gmm_zero_row_correction(
+        means, variances, weights, n_pad, batch.shape[1], batch.dtype,
+        cov_type,
+    )
+    return GMMStats(
+        ll_sum=acc.ll_sum + s.ll_sum - dll,
+        nk=acc.nk + s.nk - dnk,
+        sx=acc.sx + s.sx,
+        sxx=acc.sxx + s.sxx,
+    )
+
+
+@partial(jax.jit, static_argnames=("cov_type", "mesh"))
+def _accumulate_gmm_weighted(acc, batch, w, means, variances, weights,
+                             cov_type: str = "diag", mesh=None):
+    """Weighted batch EM stats. No padding correction needed: pad rows
+    carry ZERO WEIGHT, so they contribute exactly nothing to
+    ll/nk/sx/sxx (same pattern as the streamed weighted K-Means)."""
+    from tdc_tpu.parallel import mesh as mesh_lib
+
+    if mesh is not None and mesh_lib.is_hierarchical(mesh):
+        from tdc_tpu.parallel.reduce import reduced_tree_stats
+
+        s = reduced_tree_stats(
+            mesh,
+            lambda x, wt, mu, v, wgt: _batch_gmm_stats_weighted(
+                x, wt, mu, v, wgt, cov_type
+            ),
+            2, 5,
+        )(batch, w, means, variances, weights)
+    else:
+        s = _batch_gmm_stats_weighted(batch, w, means, variances, weights,
+                                      cov_type)
+    return GMMStats(
+        ll_sum=acc.ll_sum + s.ll_sum,
+        nk=acc.nk + s.nk,
+        sx=acc.sx + s.sx,
+        sxx=acc.sxx + s.sxx,
+    )
+
+
+def _gmm_sxx_shape(k: int, d: int, cov_type: str) -> tuple:
+    return {
+        "diag": (k, d), "spherical": (k, d),
+        "tied": (d, d), "full": (k, d, d),
+    }[cov_type]
+
+
+def _gmm_example(k: int, d: int, cov_type: str) -> GMMStats:
+    return GMMStats(
+        ll_sum=jax.ShapeDtypeStruct((), jnp.float32),
+        nk=jax.ShapeDtypeStruct((k,), jnp.float32),
+        sx=jax.ShapeDtypeStruct((k, d), jnp.float32),
+        sxx=jax.ShapeDtypeStruct(_gmm_sxx_shape(k, d, cov_type), jnp.float32),
+    )
+
+
+@lru_cache(maxsize=64)
+def _deferred_gmm_fns(mesh, k, d, kernel, cov_type, quantize, weighted):
+    """streamed_gmm_fit's per-pass (zero_acc, acc_add, reduce) — the EM
+    analog of streaming._deferred_lloyd_fns: shard-local GMMStats
+    accumulation with a leading device axis, ONE cross-device reduce per EM
+    iteration (optionally quantized with error feedback)."""
+    from tdc_tpu.parallel import reduce as reduce_lib
+
+    if weighted:
+        tower = reduce_lib.local_tree_stats(
+            mesh,
+            lambda x, w, mu, v, wgt: _batch_gmm_stats_weighted(
+                x, w, mu, v, wgt, cov_type
+            ),
+            2, 5,
+        )
+    else:
+        tower = reduce_lib.local_tree_stats(
+            mesh,
+            lambda x, mu, v, wgt: _batch_gmm_stats(x, mu, v, wgt, kernel,
+                                                   cov_type),
+            1, 4,
+        )
+    return reduce_lib.make_deferred_fns(
+        mesh, _gmm_example(k, d, cov_type), tower, quantize
+    )
+
+
+@partial(jax.jit, static_argnames=("cov_type", "cast"))
+def _gmm_pass_correction(red, means, variances, weights, n_pad,
+                         cov_type: str, cast: str = "float32"):
+    """Whole-pass zero-row padding correction on the REDUCED GMM stats —
+    parameters are pass-constant, so the per-batch correction sums to one
+    evaluation scaled by the total pad-row count. `cast` is the batch dtype
+    the zero rows were scored in (per-batch parity with _accumulate_gmm)."""
+    dll, dnk = _gmm_zero_row_correction(
+        means, variances, weights, n_pad, means.shape[1], jnp.dtype(cast),
+        cov_type,
+    )
+    return GMMStats(ll_sum=red.ll_sum - dll, nk=red.nk - dnk,
+                    sx=red.sx, sxx=red.sxx)
 
 
 def streamed_gmm_fit(
@@ -667,11 +787,16 @@ def streamed_gmm_fit(
     kernel: str = "xla",
     covariance_type: str = "diag",
     sample_weight_batches=None,
+    reduce="per_batch",
 ) -> GMMResult:
     """Exact streamed EM over a re-iterable stream of (B, d) batches — the
     same contract as streamed_kmeans_fit (one full pass per EM iteration,
     bit-exact sufficient statistics, mesh batches padded with corrected
-    contributions; multi-process hosts stream their own slices).
+    contributions; multi-process hosts stream their own slices), including
+    the `reduce=` strategy knob ("per_batch" / "per_pass" /
+    "per_pass:bf16|int8" — parallel/reduce.py): per-pass mode accumulates
+    the E-step sufficient statistics device-locally and cross-device-reduces
+    ONCE per EM iteration instead of once per batch.
 
     Initialization (means via `init`, variances/weights via hard-assignment
     moments) uses the FIRST batch only — document-sized seeding, matching
@@ -703,9 +828,11 @@ def streamed_gmm_fit(
         _check_equal_local_rows,
         _prepare_batch,
         _prepare_weighted_batch,
+        _reduce_plan,
         _run_pass,
         _weighted_stream,
     )
+    from tdc_tpu.parallel import reduce as reduce_lib
 
     if covariance_type not in COVARIANCE_TYPES:
         raise ValueError(
@@ -840,6 +967,22 @@ def streamed_gmm_fit(
         {dev.process_index for dev in mesh.devices.ravel()}
     ) > 1
 
+    strategy = reduce_lib.resolve_reduce(reduce)
+    deferred, n_mesh_dev = _reduce_plan(strategy, mesh, ckpt_dir, None)
+    counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
+    passes = [0]
+    axes = mesh_lib.data_axes(mesh) if mesh is not None else ()
+    example = _gmm_example(k, d, covariance_type)
+    cost_pb = (
+        reduce_lib.tree_reduce_cost(example, axes)
+        if n_mesh_dev > 1 else (0, 0)
+    )
+    if deferred:
+        d_zero, d_add, d_reduce = _deferred_gmm_fns(
+            mesh, k, d, kernel, covariance_type, strategy.quantize, weighted
+        )
+        err_state = [d_zero() if strategy.quantize else None]
+
     def save(n_iter, ll, done, final_ll=None):
         from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
 
@@ -881,6 +1024,9 @@ def streamed_gmm_fit(
 
     def full_pass(means, variances, weights):
         rows_total = [0]
+        passes[0] += 1
+        pad = [0.0]
+        bdt = ["float32"]
 
         def step(acc, batch):
             if weighted:
@@ -888,17 +1034,29 @@ def streamed_gmm_fit(
                     batch[0], batch[1], mesh
                 )
                 rows_total[0] += n_local
+                if deferred:
+                    bdt[0] = str(xb.dtype)
+                    return (
+                        d_add(acc, xb, wb, means, variances, weights),
+                        n_local,
+                    )
+                counter.add(*cost_pb)
                 return (
                     _accumulate_gmm_weighted(acc, xb, wb, means, variances,
-                                             weights, covariance_type),
+                                             weights, covariance_type, mesh),
                     n_local,
                 )
             xb, n_valid, n_local = _prepare_batch(batch, mesh)
             rows_total[0] += n_valid
+            if deferred:
+                pad[0] += xb.shape[0] - n_valid
+                bdt[0] = str(xb.dtype)
+                return d_add(acc, xb, means, variances, weights), n_local
+            counter.add(*cost_pb)
             return (
                 _accumulate_gmm(acc, xb, means, variances, weights,
                                 jnp.asarray(n_valid), kernel,
-                                covariance_type),
+                                covariance_type, mesh),
                 n_local,
             )
 
@@ -906,8 +1064,22 @@ def streamed_gmm_fit(
         # (same protection as the streamed kmeans/fuzzy drivers).
         cm = None if crosschecked[0] else mesh
         crosschecked[0] = True
-        acc = _run_pass(stream, prefetch, zero_stats, step,
+        acc = _run_pass(stream, prefetch,
+                        d_zero if deferred else zero_stats, step,
                         crosscheck_mesh=cm)
+        if deferred:
+            if strategy.quantize is not None:
+                acc, err_state[0] = d_reduce(acc, err_state[0])
+            else:
+                acc = d_reduce(acc)
+            counter.add(*reduce_lib.tree_reduce_cost(
+                example, axes, strategy.quantize
+            ))
+            acc = _gmm_pass_correction(
+                acc, means, variances, weights,
+                jnp.asarray(0.0 if weighted else pad[0], jnp.float32),
+                covariance_type, cast=bdt[0],
+            )
         # Weighted normalizer: Σw == Σ_k nk exactly (Σ_k r = 1 per unit
         # weight), so no separate weight-sum accumulator is needed. Floor
         # only against division by zero — clamping to 1 would mis-scale
@@ -960,6 +1132,10 @@ def streamed_gmm_fit(
         converged=jnp.asarray(converged),
         n_iter_run=n_iter - start_iter,
         covariance_type=covariance_type,
+        comms=reduce_lib.CommsReport(
+            strategy=strategy.label(), reduces=counter.reduces,
+            logical_bytes=counter.logical_bytes, passes=passes[0],
+        ),
     )
 
 

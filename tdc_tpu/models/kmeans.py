@@ -38,6 +38,9 @@ class KMeansResult(NamedTuple):
     # Iterations executed by THIS fit call (None = same as n_iter). Differs on
     # checkpoint resume; throughput must be computed from this, not n_iter.
     n_iter_run: object = None
+    # parallel/reduce.CommsReport — cross-device stats-reduce accounting,
+    # filled by the streamed drivers (None for in-memory fits).
+    comms: object = None
 
 
 def _normalize(c: jax.Array) -> jax.Array:

@@ -35,6 +35,7 @@ from tdc_tpu.ops.assign import (
 from tdc_tpu.models.kmeans import KMeansResult, resolve_init, _normalize
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult
 from tdc_tpu.parallel import mesh as mesh_lib
+from tdc_tpu.parallel import reduce as reduce_lib
 from tdc_tpu.utils.heartbeat import maybe_beat
 
 
@@ -68,18 +69,25 @@ def _accumulate(
             from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto
 
             s = lloyd_stats_auto(batch, centroids)
+    elif mesh is not None and mesh_lib.is_hierarchical(mesh):
+        # Hierarchical (dcn, ici) mesh: the explicit two-stage tower — an
+        # intra-host ICI psum, then one inter-host psum of the combined
+        # per-host payload — instead of XLA's flat auto-inserted reduce.
+        from tdc_tpu.parallel.collectives import distributed_lloyd_stats
+
+        s = distributed_lloyd_stats(batch, centroids, mesh, kernel="xla")
     else:
         s = lloyd_stats(batch, centroids)
+    from tdc_tpu.parallel.sharded_k import padding_correction
+
     n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(jnp.float32)
     # The correction's argmin must mirror where the kernel actually PUT the
     # zero pad rows: the pallas kernels score them against centroids cast to
     # the batch dtype (bf16 norm ties can pick a different winner than f32),
-    # the XLA path in f32.
+    # the XLA path in f32. One shared correction (padding_correction) so the
+    # per-batch and per-pass paths can never drift.
     cd = centroids.astype(batch.dtype) if kernel == "pallas" else centroids
-    c2 = jnp.sum(cd.astype(jnp.float32) ** 2, axis=-1)
-    j = jnp.argmin(c2)
-    counts = s.counts.at[j].add(-n_pad)
-    sse = s.sse - n_pad * c2[j]
+    counts, sse = padding_correction(s.counts, s.sse, cd, n_pad)
     return SufficientStats(
         sums=acc.sums + s.sums, counts=acc.counts + counts, sse=acc.sse + sse
     )
@@ -118,7 +126,12 @@ def _prefetched(it, depth: int):
     (cold spinning-disk/network reads), or use the C++ loader.
 
     depth <= 0 yields `it` unchanged. Producer exceptions re-raise in the
-    consumer; the producer dies with the queue on early exit (daemon)."""
+    consumer. Early consumer exit (break / .close() / GC of the generator)
+    sets a stop event and drains the queue, so a producer blocked on
+    `q.put` into the full bounded queue wakes and terminates instead of
+    parking forever on a daemon thread that pins every produced batch in
+    memory (each abandoned pass leaked `depth`+1 batches until process
+    exit)."""
     if depth <= 0:
         yield from it
         return
@@ -127,24 +140,47 @@ def _prefetched(it, depth: int):
 
     q = _queue.Queue(maxsize=depth)
     _END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
 
     def produce():
         try:
             for item in it:
-                q.put(item)
-            q.put(_END)
+                if not _put(item):
+                    return
+            _put(_END)
         except BaseException as e:  # propagate (incl. injected test crashes)
-            q.put(e)
+            _put(e)
 
-    t = threading.Thread(target=produce, daemon=True)
+    t = threading.Thread(target=produce, name="tdc-prefetch", daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        # Drain so a producer mid-put frees its slot immediately (it would
+        # otherwise wake only on the 0.1 s poll) and queued batches drop
+        # their references.
+        try:
+            while True:
+                q.get_nowait()
+        except _queue.Empty:
+            pass
 
 
 # Ready-wait cadence for the streamed pass loop (see _run_pass docstring):
@@ -346,7 +382,7 @@ def _check_equal_local_rows(batches, first, mesh):
         )
 
 
-@partial(jax.jit, static_argnames=("spherical", "kernel"))
+@partial(jax.jit, static_argnames=("spherical", "kernel", "mesh"))
 def _accumulate_weighted(
     acc: SufficientStats,
     batch: jax.Array,
@@ -354,11 +390,13 @@ def _accumulate_weighted(
     centroids: jax.Array,
     spherical: bool,
     kernel: str = "xla",
+    mesh=None,
 ) -> SufficientStats:
     """Weighted batch stats. No padding correction needed: pad rows carry
     ZERO WEIGHT, so they contribute exactly nothing to sums/mass/sse.
     kernel='pallas' routes to the weighted fused/sorted kernels (f32 mass
-    accumulation — round-4 VERDICT weak #9)."""
+    accumulation — round-4 VERDICT weak #9). A hierarchical mesh reduces
+    through the explicit two-stage (ICI-then-DCN) tower."""
     if spherical:
         norms = jnp.linalg.norm(batch, axis=-1, keepdims=True)
         batch = jnp.where(norms > 0, batch / jnp.maximum(norms, 1e-12), batch)
@@ -369,18 +407,28 @@ def _accumulate_weighted(
     else:
         from tdc_tpu.ops.assign import lloyd_stats_weighted
 
-        s = lloyd_stats_weighted(batch, centroids, w)
+        if mesh is not None and mesh_lib.is_hierarchical(mesh):
+            s = reduce_lib.reduced_tree_stats(
+                mesh, lambda x, wt, c: lloyd_stats_weighted(x, c, wt), 2, 3
+            )(batch, w, centroids)
+        else:
+            s = lloyd_stats_weighted(batch, centroids, w)
     return SufficientStats(
         sums=acc.sums + s.sums, counts=acc.counts + s.counts,
         sse=acc.sse + s.sse,
     )
 
 
-@jax.jit
-def _accumulate_fuzzy_weighted(acc, batch, w, centroids, m: float):
+@partial(jax.jit, static_argnames=("m", "mesh"))
+def _accumulate_fuzzy_weighted(acc, batch, w, centroids, m: float, mesh=None):
     from tdc_tpu.ops.assign import fuzzy_stats_weighted
 
-    s = fuzzy_stats_weighted(batch, centroids, w, m=m)
+    if mesh is not None and mesh_lib.is_hierarchical(mesh):
+        s = reduce_lib.reduced_tree_stats(
+            mesh, lambda x, wt, c: fuzzy_stats_weighted(x, c, wt, m=m), 2, 3
+        )(batch, w, centroids)
+    else:
+        s = fuzzy_stats_weighted(batch, centroids, w, m=m)
     return FuzzyStats(
         weighted_sums=acc.weighted_sums + s.weighted_sums,
         weights=acc.weights + s.weights,
@@ -433,6 +481,170 @@ def _prepare_weighted_batch(batch, w, mesh):
     pw, _ = mesh_lib.pad_to_multiple(w, n_dev, 0.0)
     return (mesh_lib.shard_points(pb, mesh),
             mesh_lib.shard_points(pw, mesh), n_local)
+
+
+# ---------------------------------------------------------------------------
+# Deferred (per-pass) reduction — parallel/reduce strategies wired into the
+# 1-D streamed drivers. The accumulator grows a leading device axis (one
+# slot per data shard), every per-batch add stays shard-local, and the
+# cross-device reduce runs ONCE per pass: O(1) collectives per Lloyd
+# iteration instead of O(num_batches). The zero-row padding correction —
+# per batch in the per-batch drivers — is applied once per pass against the
+# pass-constant centroids (exactly equivalent: the correction depends only
+# on the centroids and the total pad-row count).
+# ---------------------------------------------------------------------------
+
+
+def _lloyd_example(k: int, d: int) -> SufficientStats:
+    return SufficientStats(
+        sums=jax.ShapeDtypeStruct((k, d), jnp.float32),
+        counts=jax.ShapeDtypeStruct((k,), jnp.float32),
+        sse=jax.ShapeDtypeStruct((), jnp.float32),
+    )
+
+
+def _fuzzy_example(k: int, d: int) -> FuzzyStats:
+    return FuzzyStats(
+        weighted_sums=jax.ShapeDtypeStruct((k, d), jnp.float32),
+        weights=jax.ShapeDtypeStruct((k,), jnp.float32),
+        objective=jax.ShapeDtypeStruct((), jnp.float32),
+    )
+
+
+@lru_cache(maxsize=64)
+def _deferred_lloyd_fns(mesh, k, d, spherical, kernel, quantize, weighted):
+    """(zero_acc, acc_add, reduce) for streamed_kmeans_fit's per-pass mode
+    (reduce_lib.make_deferred_fns over the Lloyd stats tower).
+    acc_add(acc, batch[, w], c) adds one batch's shard-local stats (zero
+    collectives); reduce(acc[, err]) is the ONE cross-device reduce of the
+    pass, quantized with error feedback when `quantize` is set. Cached per
+    configuration (the sharded drivers' _lloyd_fit_fns rationale: fresh jit
+    closures per fit re-trace every invocation)."""
+
+    def norm(b):
+        if not spherical:
+            return b
+        norms = jnp.linalg.norm(b, axis=-1, keepdims=True)
+        return jnp.where(norms > 0, b / jnp.maximum(norms, 1e-12), b)
+
+    if weighted:
+        from tdc_tpu.ops.assign import lloyd_stats_weighted
+
+        tower = reduce_lib.local_tree_stats(
+            mesh, lambda x, w, c: lloyd_stats_weighted(norm(x), c, w), 2, 3
+        )
+    elif kernel == "pallas":
+        from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto
+
+        tower = reduce_lib.local_tree_stats(
+            mesh, lambda x, c: lloyd_stats_auto(norm(x), c), 1, 2
+        )
+    else:
+        tower = reduce_lib.local_tree_stats(
+            mesh, lambda x, c: lloyd_stats(norm(x), c), 1, 2
+        )
+    return reduce_lib.make_deferred_fns(
+        mesh, _lloyd_example(k, d), tower, quantize
+    )
+
+
+@lru_cache(maxsize=64)
+def _deferred_fuzzy_fns(mesh, k, d, m, kernel, quantize, weighted):
+    """streamed_fuzzy_fit's per-pass (zero_acc, acc_add, reduce) — see
+    _deferred_lloyd_fns."""
+    if weighted:
+        from tdc_tpu.ops.assign import fuzzy_stats_weighted
+
+        tower = reduce_lib.local_tree_stats(
+            mesh, lambda x, w, c: fuzzy_stats_weighted(x, c, w, m=m), 2, 3
+        )
+    elif kernel == "pallas":
+        from tdc_tpu.ops.pallas_kernels import fuzzy_stats_auto
+
+        tower = reduce_lib.local_tree_stats(
+            mesh, lambda x, c: fuzzy_stats_auto(x, c, m=m), 1, 2
+        )
+    else:
+        tower = reduce_lib.local_tree_stats(
+            mesh, lambda x, c: fuzzy_stats(x, c, m=m), 1, 2
+        )
+    return reduce_lib.make_deferred_fns(
+        mesh, _fuzzy_example(k, d), tower, quantize
+    )
+
+
+@partial(jax.jit, static_argnames=("cast",))
+def _lloyd_pass_correction(red, c, n_pad, cast: str | None = None):
+    """Whole-pass zero-row padding correction on the REDUCED Lloyd stats:
+    all n_pad pad rows landed on the argmin-‖c‖² cluster (centroids are
+    pass-constant). `cast` mirrors where the kernel scored the zero rows —
+    the pallas kernels cast centroids to the batch dtype (see _accumulate),
+    the XLA path stays f32. The math is the single shared
+    padding_correction (sharded_k), same as the per-batch path."""
+    from tdc_tpu.parallel.sharded_k import padding_correction
+
+    cd = c.astype(jnp.dtype(cast)) if cast else c
+    counts, sse = padding_correction(red.counts, red.sse, cd, n_pad)
+    return SufficientStats(sums=red.sums, counts=counts, sse=sse)
+
+
+@partial(jax.jit, static_argnames=("m", "cast"))
+def _fuzzy_pass_correction(red, c, n_pad, m: float, cast: str | None = None):
+    """Whole-pass zero-row correction on the REDUCED fuzzy stats (the soft
+    analog of _lloyd_pass_correction): a zero row's memberships depend only
+    on the pass-constant centroids. `cast` is the batch dtype the zero rows
+    were scored in (per-batch parity with _accumulate_fuzzy)."""
+    zero_row = jnp.zeros((1, c.shape[1]), jnp.dtype(cast) if cast else c.dtype)
+    zs = fuzzy_stats(zero_row, c, m=m)
+    return FuzzyStats(
+        weighted_sums=red.weighted_sums,
+        weights=red.weights - n_pad * zs.weights,
+        objective=red.objective - n_pad * zs.objective,
+    )
+
+
+def _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=0,
+                 allow_quantize=True):
+    """Shared validation for the streamed drivers' `reduce=` knob — the ONE
+    copy of the per_pass/quantize checkpoint-compatibility rules (1-D and
+    K-sharded drivers both call it); returns (deferred, n_mesh_devices).
+    per_pass degrades to per_batch on a single-device (or absent) mesh —
+    there is no cross-device reduce to defer — but quantize is rejected
+    there rather than silently ignored. allow_quantize=False is the
+    K-sharded drivers' gate (quantized encodings are 1-D-only)."""
+    n_mesh_dev = 0 if mesh is None else int(np.prod(mesh.devices.shape))
+    deferred = strategy.deferred and n_mesh_dev > 1
+    if strategy.quantize is not None:
+        if not allow_quantize:
+            raise ValueError(
+                "quantized stats reduce is wired for the 1-D streamed "
+                "fits; the K-sharded drivers support "
+                "reduce='per_batch'|'per_pass'"
+            )
+        if n_mesh_dev <= 1:
+            raise ValueError(
+                "quantized stats reduce requires a multi-device mesh "
+                "(there is no cross-device reduce to quantize)"
+            )
+        if ckpt_dir is not None:
+            raise ValueError(
+                "quantized reduce does not support ckpt_dir: a resume would "
+                "restart the error-feedback residual, breaking the "
+                "bit-identical-resume contract"
+            )
+    if deferred and ckpt_every_batches:
+        raise ValueError(
+            "reduce='per_pass' does not support mid-pass checkpointing "
+            "(the deferred accumulator is device-layout state); use "
+            "per-iteration checkpoints (ckpt_every)"
+        )
+    if deferred and cursor:
+        raise ValueError(
+            "cannot resume a mid-pass (per-batch) checkpoint with "
+            "reduce='per_pass' — finish the interrupted pass in per-batch "
+            "mode or resume from a per-iteration checkpoint"
+        )
+    return deferred, n_mesh_dev
 
 
 def _broadcast_init(init, mesh):
@@ -592,6 +804,7 @@ def streamed_kmeans_fit(
     prefetch: int = 0,
     sample_weight_batches: Callable[[], Iterable] | None = None,
     kernel: str = "xla",
+    reduce="per_batch",
 ) -> KMeansResult:
     """Exact Lloyd over a re-iterable stream of (B, d) batches.
 
@@ -624,9 +837,21 @@ def streamed_kmeans_fit(
         (f32 mass accumulation; single-device — the weighted kernels have
         no shard_map tower, so kernel='pallas' + sample_weight_batches +
         mesh raises rather than silently recording XLA numbers as Pallas).
+      reduce: cross-device stats reduction strategy — "per_batch" (default,
+        exact: one reduce per streamed batch), "per_pass" (device-local
+        accumulation, ONE reduce per Lloyd iteration — O(1) vs
+        O(num_batches) collectives; reorders f32 summation so results
+        match per_batch to accumulation tolerance, not bitwise), or
+        "per_pass:bf16" / "per_pass:int8" (additionally quantize the
+        (K, d) sums on the wire with persistent error feedback). A
+        hierarchical (dcn, ici) mesh (mesh.make_hierarchical_mesh) makes
+        any strategy reduce in two stages, ICI first. See
+        parallel/reduce.py; the fit result's `comms` field reports reduces
+        issued and logical bytes moved.
     """
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
+    strategy = reduce_lib.resolve_reduce(reduce)
     weighted = sample_weight_batches is not None
     if weighted and kernel == "pallas" and mesh is not None:
         raise ValueError(
@@ -683,28 +908,74 @@ def streamed_kmeans_fit(
     resume_cursor, resume_acc = state.cursor, state.acc
     ckpt.key = state.key
 
+    deferred, n_mesh_dev = _reduce_plan(
+        strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=state.cursor
+    )
+    counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
+    passes = [0]
+    axes = mesh_lib.data_axes(mesh) if mesh is not None else ()
+    example = _lloyd_example(k, d)
+    cost_pb = (
+        reduce_lib.tree_reduce_cost(example, axes)
+        if n_mesh_dev > 1 else (0, 0)
+    )
+    if deferred:
+        d_zero, d_add, d_reduce = _deferred_lloyd_fns(
+            mesh, k, d, bool(spherical), kernel, strategy.quantize, weighted
+        )
+        err_state = [d_zero() if strategy.quantize else None]
+
     def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
+        passes[0] += 1
+        pad = [0.0]
+        bdt = ["float32"]
+
         def step(acc, batch):
             if weighted:
                 xb, wb, n_local = _prepare_weighted_batch(
                     batch[0], batch[1], mesh
                 )
+                if deferred:
+                    bdt[0] = str(xb.dtype)
+                    return d_add(acc, xb, wb, c), n_local
+                counter.add(*cost_pb)
                 return (
-                    _accumulate_weighted(acc, xb, wb, c, spherical, kernel),
+                    _accumulate_weighted(acc, xb, wb, c, spherical, kernel,
+                                         mesh),
                     n_local,
                 )
             xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            if deferred:
+                pad[0] += xb.shape[0] - n_valid
+                bdt[0] = str(xb.dtype)
+                return d_add(acc, xb, c), n_local
+            counter.add(*cost_pb)
             return (
                 _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical,
                             kernel, mesh),
                 n_local,
             )
 
-        return _run_pass(
-            stream, prefetch, zero_stats, step,
+        acc = _run_pass(
+            stream, prefetch, d_zero if deferred else zero_stats, step,
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
+        )
+        if not deferred:
+            return acc
+        # The ONE cross-device reduce of this pass (+ error feedback), then
+        # the whole-pass padding correction against the pass-constant c.
+        if strategy.quantize is not None:
+            acc, err_state[0] = d_reduce(acc, err_state[0])
+        else:
+            acc = d_reduce(acc)
+        counter.add(
+            *reduce_lib.tree_reduce_cost(example, axes, strategy.quantize)
+        )
+        return _lloyd_pass_correction(
+            acc, c, jnp.asarray(0.0 if weighted else pad[0], jnp.float32),
+            cast=bdt[0] if kernel == "pallas" else None,
         )
 
     n_iter = start_iter
@@ -750,6 +1021,10 @@ def streamed_kmeans_fit(
         converged=jnp.asarray(tol >= 0 and shift <= tol),
         history=_history_array(history),
         n_iter_run=n_iter - start_iter,
+        comms=reduce_lib.CommsReport(
+            strategy=strategy.label(), reduces=counter.reduces,
+            logical_bytes=counter.logical_bytes, passes=passes[0],
+        ),
     )
 
 
@@ -866,6 +1141,10 @@ def _accumulate_fuzzy(
             from tdc_tpu.ops.pallas_kernels import fuzzy_stats_auto
 
             s = fuzzy_stats_auto(batch, centroids, m=m)
+    elif mesh is not None and mesh_lib.is_hierarchical(mesh):
+        from tdc_tpu.parallel.collectives import distributed_fuzzy_stats
+
+        s = distributed_fuzzy_stats(batch, centroids, mesh, m=m, kernel="xla")
     else:
         s = fuzzy_stats(batch, centroids, m=m)
     n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(jnp.float32)
@@ -895,16 +1174,21 @@ def streamed_fuzzy_fit(
     prefetch: int = 0,
     sample_weight_batches: Callable[[], Iterable] | None = None,
     kernel: str = "xla",
+    reduce="per_batch",
 ) -> FuzzyCMeansResult:
     """Exact streamed Fuzzy C-Means — same contract as streamed_kmeans_fit,
     including checkpoint/resume (per-iteration and mid-pass), streamed
     sample weights, the per-iteration (objective, shift) history the
-    reference never computed, and kernel='pallas' per-batch stats (raises
-    with sample_weight_batches — no weighted Pallas kernel)."""
+    reference never computed, kernel='pallas' per-batch stats (raises
+    with sample_weight_batches — no weighted Pallas kernel), and the
+    `reduce=` strategy knob ("per_batch" / "per_pass" /
+    "per_pass:bf16|int8" — see streamed_kmeans_fit and
+    parallel/reduce.py)."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
+    strategy = reduce_lib.resolve_reduce(reduce)
     weighted = sample_weight_batches is not None
     if weighted and kernel == "pallas":
         raise ValueError(
@@ -960,25 +1244,71 @@ def streamed_fuzzy_fit(
     resume_cursor, resume_acc = state.cursor, state.acc
     ckpt.key = state.key
 
+    deferred, n_mesh_dev = _reduce_plan(
+        strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=state.cursor
+    )
+    counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
+    passes = [0]
+    axes = mesh_lib.data_axes(mesh) if mesh is not None else ()
+    example = _fuzzy_example(k, d)
+    cost_pb = (
+        reduce_lib.tree_reduce_cost(example, axes)
+        if n_mesh_dev > 1 else (0, 0)
+    )
+    if deferred:
+        d_zero, d_add, d_reduce = _deferred_fuzzy_fns(
+            mesh, k, d, float(m), kernel, strategy.quantize, weighted
+        )
+        err_state = [d_zero() if strategy.quantize else None]
+
     def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
+        passes[0] += 1
+        pad = [0.0]
+        bdt = ["float32"]
+
         def step(acc, batch):
             if weighted:
                 xb, wb, n_local = _prepare_weighted_batch(
                     batch[0], batch[1], mesh
                 )
-                return _accumulate_fuzzy_weighted(acc, xb, wb, c, m), n_local
+                if deferred:
+                    bdt[0] = str(xb.dtype)
+                    return d_add(acc, xb, wb, c), n_local
+                counter.add(*cost_pb)
+                return (
+                    _accumulate_fuzzy_weighted(acc, xb, wb, c, m, mesh),
+                    n_local,
+                )
             xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            if deferred:
+                pad[0] += xb.shape[0] - n_valid
+                bdt[0] = str(xb.dtype)
+                return d_add(acc, xb, c), n_local
+            counter.add(*cost_pb)
             return (
                 _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m,
                                   kernel, mesh),
                 n_local,
             )
 
-        return _run_pass(
-            stream, prefetch, zero_stats, step,
+        acc = _run_pass(
+            stream, prefetch, d_zero if deferred else zero_stats, step,
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
+        )
+        if not deferred:
+            return acc
+        if strategy.quantize is not None:
+            acc, err_state[0] = d_reduce(acc, err_state[0])
+        else:
+            acc = d_reduce(acc)
+        counter.add(
+            *reduce_lib.tree_reduce_cost(example, axes, strategy.quantize)
+        )
+        return _fuzzy_pass_correction(
+            acc, c, jnp.asarray(0.0 if weighted else pad[0], jnp.float32),
+            m=float(m), cast=bdt[0] if kernel == "pallas" else None,
         )
 
     n_iter = start_iter
@@ -1017,4 +1347,8 @@ def streamed_fuzzy_fit(
         converged=jnp.asarray(tol >= 0 and shift <= tol),
         history=_history_array(history),
         n_iter_run=n_iter - start_iter,
+        comms=reduce_lib.CommsReport(
+            strategy=strategy.label(), reduces=counter.reduces,
+            logical_bytes=counter.logical_bytes, passes=passes[0],
+        ),
     )

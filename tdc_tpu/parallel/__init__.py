@@ -1,13 +1,21 @@
-"""Mesh construction, sharding helpers, and explicit-collective steps."""
+"""Mesh construction, sharding helpers, explicit-collective steps, and
+cross-device reduction strategies."""
 
 from tdc_tpu.parallel.mesh import (
     make_mesh,
+    make_hierarchical_mesh,
     shard_points,
     replicate,
     data_sharding,
     replicated_sharding,
 )
 from tdc_tpu.parallel.collectives import distributed_lloyd_stats, distributed_fuzzy_stats
+from tdc_tpu.parallel.reduce import (
+    GLOBAL_COMMS,
+    CommsReport,
+    ReduceStrategy,
+    resolve_reduce,
+)
 from tdc_tpu.parallel.supervisor import (
     GangFailed,
     GangResult,
@@ -17,12 +25,17 @@ from tdc_tpu.parallel.supervisor import (
 
 __all__ = [
     "make_mesh",
+    "make_hierarchical_mesh",
     "shard_points",
     "replicate",
     "data_sharding",
     "replicated_sharding",
     "distributed_lloyd_stats",
     "distributed_fuzzy_stats",
+    "GLOBAL_COMMS",
+    "CommsReport",
+    "ReduceStrategy",
+    "resolve_reduce",
     "GangFailed",
     "GangResult",
     "align_checkpoints",

@@ -13,33 +13,41 @@ Two equivalent distributed paths exist in tdc_tpu:
 Both produce bitwise-identical centroid updates in f32 (psum and XLA's
 all-reduce use the same deterministic reduction order on TPU); the explicit path
 exists for clarity, for tests of the collective math, and as the template for
-multi-host DCN meshes.
+multi-host DCN meshes. That template is now concrete: pass a hierarchical
+(dcn, ici) mesh (parallel/mesh.make_hierarchical_mesh) and the reduce runs
+in two stages — intra-host ICI psum first, then one inter-host psum of the
+already-combined per-host payload (parallel/reduce.tree_psum).
 """
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
-
-from tdc_tpu.parallel.compat import shard_map
+from jax.sharding import Mesh
 
 from tdc_tpu.ops.assign import SufficientStats, FuzzyStats, lloyd_stats, fuzzy_stats
-from tdc_tpu.parallel.mesh import DATA_AXIS
+
+
+def _reduced_tower(mesh: Mesh, local_fn, axis_name: str | None):
+    """Shared shard_map wrapper: per-shard `local_fn(x_shard, c)` tower +
+    staged psum over the mesh's data axes (ICI-first on a hierarchical
+    mesh — parallel/reduce.tree_psum)."""
+    from tdc_tpu.parallel.reduce import reduced_tree_stats
+
+    return reduced_tree_stats(mesh, local_fn, 1, 2, axis_name=axis_name)
 
 
 def distributed_lloyd_stats(
     x: jax.Array,
     centroids: jax.Array,
     mesh: Mesh,
-    axis_name: str = DATA_AXIS,
+    axis_name: str | None = None,
     kernel: str = "xla",
 ) -> SufficientStats:
     """Globally-reduced Lloyd stats: per-shard tower + psum.
 
-    x must be sharded (axis_name) on its leading axis; centroids replicated.
+    x must be sharded on its leading axis over the mesh's data axes
+    (axis_name overrides; None derives them, including the hierarchical
+    (dcn, ici) two-stage reduce); centroids replicated.
     kernel='pallas' runs the fused single-pass VMEM kernel *inside* each
     shard_map body — per-device compute identical to the single-chip fast
     path, with only the (K, d) stats crossing ICI.
@@ -51,18 +59,7 @@ def distributed_lloyd_stats(
     else:
         local_fn = lloyd_stats
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P(axis_name), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-    def step(x_shard, c):
-        local = local_fn(x_shard, c)
-        return jax.tree.map(lambda t: jax.lax.psum(t, axis_name), local)
-
-    return step(x, centroids)
+    return _reduced_tower(mesh, local_fn, axis_name)(x, centroids)
 
 
 def distributed_fuzzy_stats(
@@ -70,12 +67,13 @@ def distributed_fuzzy_stats(
     centroids: jax.Array,
     mesh: Mesh,
     m: float = 2.0,
-    axis_name: str = DATA_AXIS,
+    axis_name: str | None = None,
     kernel: str = "xla",
 ) -> FuzzyStats:
-    """Globally-reduced fuzzy c-means stats: per-shard tower + psum.
-    kernel='pallas' runs the fused single-pass VMEM fuzzy kernel per shard
-    (no (N, K) membership matrix anywhere)."""
+    """Globally-reduced fuzzy c-means stats: per-shard tower + psum (staged
+    ICI-then-DCN on a hierarchical mesh). kernel='pallas' runs the fused
+    single-pass VMEM fuzzy kernel per shard (no (N, K) membership matrix
+    anywhere)."""
     if kernel == "pallas":
         from tdc_tpu.ops.pallas_kernels import fuzzy_stats_auto
 
@@ -83,15 +81,4 @@ def distributed_fuzzy_stats(
     else:
         local_fn = lambda x, c: fuzzy_stats(x, c, m=m)
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P(axis_name), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-    def step(x_shard, c):
-        local = local_fn(x_shard, c)
-        return jax.tree.map(lambda t: jax.lax.psum(t, axis_name), local)
-
-    return step(x, centroids)
+    return _reduced_tower(mesh, local_fn, axis_name)(x, centroids)

@@ -16,6 +16,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 
+# Two-level hierarchical data-parallel mesh axes (parallel/reduce.py): the
+# outer axis crosses hosts (DCN — data-center network), the inner axis stays
+# within a host's chips (ICI — inter-chip interconnect). A reduce over
+# ("dcn", "ici") done ICI-first sends each host's payload over DCN once,
+# instead of letting a flat ring drag every device's partial across the
+# slow link (Mesh-TensorFlow's hierarchy argument, PAPERS.md).
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
 
 def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
     """1-D data-parallel mesh over the first `n_devices` devices.
@@ -32,9 +41,74 @@ def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
     return Mesh(np.asarray(devs[:n_devices]), (axis_name,))
 
 
-def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
-    """Shard leading (points) axis across the mesh."""
-    return NamedSharding(mesh, P(axis_name))
+def make_hierarchical_mesh(
+    n_hosts: int | None = None, n_devices: int | None = None
+) -> Mesh:
+    """2-level (dcn, ici) data-parallel mesh: host axis × local-device axis,
+    derived from the process structure of `jax.devices()` (devices grouped
+    by process_index). On a single-process runtime (the CPU 8-device sim,
+    or one host's chips) pass `n_hosts` to emulate the host grouping — the
+    reduce structure is identical, only the link speeds differ.
+
+    The streamed fits detect this mesh shape (`data_axes`) and reduce
+    sufficient stats ICI-first: one intra-host psum, then one inter-host
+    psum of the already-combined per-host payload.
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if n_hosts is None:
+        n_hosts = len({d.process_index for d in devs})
+    if n_hosts <= 0 or len(devs) % n_hosts != 0:
+        raise ValueError(
+            f"{len(devs)} devices not divisible into {n_hosts} host groups"
+        )
+    # Group by process so the inner axis is genuinely intra-host when the
+    # runtime is multi-process; a plain reshape would interleave hosts.
+    ordered = sorted(devs, key=lambda d: (d.process_index, d.id))
+    grid = np.asarray(ordered).reshape(n_hosts, len(devs) // n_hosts)
+    if len({d.process_index for d in devs}) > 1:
+        # The whole point of the mesh is that the ICI axis stays inside a
+        # host; a row straddling processes (uneven per-host device counts,
+        # or n_devices truncating mid-host) would silently run every
+        # "intra-host" psum over DCN — and quantize the wrong stage.
+        for i, row in enumerate(grid):
+            procs = {d.process_index for d in row}
+            if len(procs) != 1:
+                raise ValueError(
+                    f"hierarchical mesh row {i} spans processes "
+                    f"{sorted(procs)}; the ici axis must be intra-host — "
+                    "use one host group per process (or per same-host "
+                    "process set) and per-host device counts divisible by "
+                    "the group size"
+                )
+    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Mesh axis names the points' leading dim shards over: ("dcn", "ici")
+    for a hierarchical mesh, else the data axis. Reduction order is
+    innermost-first (reversed), so hierarchical reduces run ICI before DCN.
+    """
+    names = tuple(mesh.axis_names)
+    if DCN_AXIS in names and ICI_AXIS in names:
+        return (DCN_AXIS, ICI_AXIS)
+    if DATA_AXIS in names:
+        return (DATA_AXIS,)
+    return (names[0],)
+
+
+def is_hierarchical(mesh: Mesh) -> bool:
+    return len(data_axes(mesh)) > 1
+
+
+def data_sharding(mesh: Mesh, axis_name: str | None = None) -> NamedSharding:
+    """Shard leading (points) axis across the mesh (both host/device axes of
+    a hierarchical mesh)."""
+    if axis_name is not None:
+        return NamedSharding(mesh, P(axis_name))
+    axes = data_axes(mesh)
+    return NamedSharding(mesh, P(axes if len(axes) > 1 else axes[0]))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -53,8 +127,9 @@ def pad_to_multiple(x, multiple: int, fill_value=np.nan):
     return np.pad(np.asarray(x), pad_width, constant_values=fill_value), n
 
 
-def shard_points(x, mesh: Mesh, axis_name: str = DATA_AXIS) -> jax.Array:
-    """Place points on the mesh sharded along the data axis.
+def shard_points(x, mesh: Mesh, axis_name: str | None = None) -> jax.Array:
+    """Place points on the mesh sharded along the data axis (or axes, for a
+    hierarchical (dcn, ici) mesh).
 
     Replaces the reference's tf.split-on-CPU + per-tower Variables staged
     through a full-dataset feed_dict (scripts/distribuitedClustering.py:197,217,273).

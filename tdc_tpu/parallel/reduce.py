@@ -1,0 +1,425 @@
+"""Communication-efficient cross-device reduction of sufficient statistics.
+
+Every streamed fit's inner loop ends in the same collective: a tree of
+per-shard sufficient statistics — (K, d) sums, (K,) counts, a scalar cost —
+all-reduced across the data-parallel mesh. At the flagship shape
+(K=16,384, d=128) that payload is ~8.5 MB of f32, and the per-batch drivers
+pay it once per streamed batch: a pass over B batches issues B cross-device
+reduces where one would do. This module provides the three composable
+levers that keep that reduction off the critical path (Mesh-TensorFlow's
+hierarchy argument and EQuARX's quantized-allreduce argument, PAPERS.md):
+
+1. **Deferred per-pass reduction** (`local_tree_stats` + `deferred_reduce`):
+   accumulate stats device-locally across the whole pass — the accumulator
+   grows a leading device axis and every per-batch add is shard-local —
+   and cross-device-reduce ONCE per Lloyd/EM iteration. O(1) collectives
+   per pass instead of O(num_batches). Off by default: it reorders f32
+   summation (per-device-then-across-devices instead of
+   per-batch-across-devices), so results match the per-batch path only to
+   accumulation tolerance, not bitwise.
+
+2. **Hierarchical reduction** (`tree_psum` over a (dcn, ici) mesh from
+   `mesh.make_hierarchical_mesh`): psum the inner ICI axis first, then the
+   outer DCN axis — each host's payload crosses the slow inter-host link
+   once, already combined, instead of a flat ring dragging every device's
+   partial across DCN. Numerically this is just a fixed two-level
+   summation order; it composes with both per-batch and per-pass modes.
+
+3. **Quantized reduce with error feedback** (`quantize="bf16"|"int8"`):
+   encode the large rank-≥2 leaves (the (K, d) sums) on the wire — bf16,
+   or int8 with a shared-per-row scale agreed via a pmax — and carry the
+   per-device quantization residual in a persistent error-feedback
+   accumulator that is re-injected into the NEXT pass's reduce, so the
+   error is deferred, not lost (EF-SGD's trick applied to stats). Rank ≤1
+   leaves (counts, scalars) always ride f32: they are tiny and the M-step
+   divides by them. Per-pass mode only — the residual is defined per
+   reduce, and one reduce per pass is what makes it cheap. On a
+   hierarchical mesh only the DCN stage is quantized (ICI bandwidth is not
+   the bottleneck; EQuARX makes the same split).
+
+Instrumentation: `CommsCounter` tallies reduces issued and logical payload
+bytes (the byte size of the reduced buffer per stage — a wire-format
+model, not a link-level measurement). Drivers attach a `CommsReport` to
+fit results and bump the process-wide `GLOBAL_COMMS`, which the serve
+`/metrics` endpoint exposes.
+
+The champion all_gather of the K-sharded towers (parallel/sharded_k) is a
+different category — N-proportional assignment traffic, not stats — and is
+deliberately not counted here.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tdc_tpu.parallel.compat import shard_map
+from tdc_tpu.parallel.mesh import data_axes
+
+_QUANT_MODES = (None, "bf16", "int8")
+_MODES = ("per_batch", "per_pass")
+
+
+@dataclass(frozen=True)
+class ReduceStrategy:
+    """How a streamed fit reduces its sufficient statistics across devices.
+
+    mode: "per_batch" (the exact default — one reduce per streamed batch)
+      or "per_pass" (device-local accumulation, one reduce per iteration).
+    quantize: None | "bf16" | "int8" — wire encoding of the rank-≥2 stats
+      leaves, per-pass mode only, with persistent error feedback.
+
+    Hierarchical (ICI-then-DCN) reduction is not a flag here: it is derived
+    from the mesh layout — pass a mesh from `make_hierarchical_mesh` and
+    every strategy reduces in two stages.
+    """
+
+    mode: str = "per_batch"
+    quantize: str | None = None
+
+    def __post_init__(self):
+        if self.mode not in _MODES:
+            raise ValueError(
+                f"reduce mode must be one of {_MODES}, got {self.mode!r}"
+            )
+        if self.quantize not in _QUANT_MODES:
+            raise ValueError(
+                f"quantize must be one of {_QUANT_MODES}, "
+                f"got {self.quantize!r}"
+            )
+        if self.quantize is not None and self.mode != "per_pass":
+            raise ValueError(
+                "quantized stats reduce requires mode='per_pass' (the "
+                "error-feedback residual is carried across passes; a "
+                "per-batch residual would be meaningless)"
+            )
+
+    @property
+    def deferred(self) -> bool:
+        return self.mode == "per_pass"
+
+    def label(self) -> str:
+        return (
+            self.mode if self.quantize is None
+            else f"{self.mode}:{self.quantize}"
+        )
+
+
+def resolve_reduce(reduce) -> ReduceStrategy:
+    """Accepts a ReduceStrategy, or the string shorthands "per_batch",
+    "per_pass", "per_pass:bf16", "per_pass:int8"."""
+    if isinstance(reduce, ReduceStrategy):
+        return reduce
+    if not isinstance(reduce, str):
+        raise TypeError(
+            f"reduce must be a str or ReduceStrategy, got {type(reduce)}"
+        )
+    mode, _, quant = reduce.partition(":")
+    return ReduceStrategy(mode=mode, quantize=quant or None)
+
+
+# ---------------------------------------------------------------------------
+# Comms accounting
+# ---------------------------------------------------------------------------
+
+
+class CommsCounter:
+    """Host-side tally of cross-device stats reduces issued and the logical
+    payload bytes they moved (buffer size per reduce stage — each staged
+    psum of a hierarchical reduce counts separately). Thread-safe: fits and
+    the serve metrics scrape run on different threads."""
+
+    def __init__(self, _mirror=None):
+        self._lock = threading.Lock()
+        self._mirror = _mirror
+        self.reduces = 0
+        self.logical_bytes = 0
+
+    def add(self, reduces: int, nbytes: int) -> None:
+        with self._lock:
+            self.reduces += int(reduces)
+            self.logical_bytes += int(nbytes)
+        if self._mirror is not None:
+            self._mirror.add(reduces, nbytes)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "reduces": self.reduces,
+                "logical_bytes": self.logical_bytes,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.reduces = 0
+            self.logical_bytes = 0
+
+
+# Process-wide counter (mirrored into by every per-fit counter); surfaced
+# by the serve /metrics endpoint as tdc_comms_stats_*.
+GLOBAL_COMMS = CommsCounter()
+
+
+class CommsReport(NamedTuple):
+    """Per-fit communication summary attached to fit results."""
+
+    strategy: str  # ReduceStrategy.label()
+    reduces: int  # cross-device stats reduces issued by this fit
+    logical_bytes: int  # total logical payload bytes across those reduces
+    passes: int  # full passes over the stream (iterations + final scoring)
+
+    @property
+    def reduces_per_pass(self) -> float:
+        return self.reduces / max(self.passes, 1)
+
+
+def _quantized_leaf(t) -> bool:
+    """Leaves that ride the quantized wire: the rank-≥2 float stats (the
+    (K, d) sums and GMM second moments). Counts and scalars stay f32."""
+    return t.ndim >= 2 and jnp.issubdtype(t.dtype, jnp.floating)
+
+
+def tree_reduce_cost(tree, axes, quantize: str | None = None) -> tuple[int, int]:
+    """(reduces, logical_bytes) for ONE reduce of a stats `tree` (LOGICAL
+    reduced shapes, e.g. sums (K, d)) over mesh `axes`. Each staged psum
+    counts as one reduce; int8 adds the per-row scale-agreement pmax."""
+    leaves = jax.tree.leaves(tree)
+    shapes = [t.shape for t in leaves]
+    f32_payload = sum(4 * math.prod(s) for s in shapes)
+    n_stages = len(axes)
+    if quantize is None:
+        return n_stages, n_stages * f32_payload
+    # Hierarchical: only the LAST (DCN) stage is quantized.
+    q_elem = 1 if quantize == "int8" else 2
+    q_payload = 0
+    for shp, t in zip(shapes, leaves):
+        if len(shp) >= 2 and jnp.issubdtype(t.dtype, jnp.floating):
+            rows = math.prod(shp[:-1])
+            q_payload += q_elem * math.prod(shp)
+            if quantize == "int8":
+                q_payload += 4 * rows  # shared per-row f32 scales
+        else:
+            q_payload += 4 * math.prod(shp)
+    reduces = n_stages
+    nbytes = (n_stages - 1) * f32_payload + q_payload
+    if quantize == "int8":
+        # One scale-agreement pmax PER quantized leaf (tree_psum calls
+        # _q_psum_leaf per leaf), each moving that leaf's f32 row maxes.
+        q_leaves = [
+            s for s, t in zip(shapes, leaves)
+            if len(s) >= 2 and jnp.issubdtype(t.dtype, jnp.floating)
+        ]
+        reduces += len(q_leaves)
+        nbytes += sum(4 * math.prod(s[:-1]) for s in q_leaves)
+    return reduces, nbytes
+
+
+# ---------------------------------------------------------------------------
+# The reduction kernels (inside shard_map bodies)
+# ---------------------------------------------------------------------------
+
+
+def _q_psum_leaf(y, axis, quantize: str):
+    """Quantized psum of one leaf over one mesh axis; returns (reduced f32,
+    local residual) — the residual is this device's y − decode(encode(y)),
+    the quantity error feedback carries to the next pass."""
+    if quantize == "bf16":
+        q = y.astype(jnp.bfloat16)
+        out = jax.lax.psum(q, axis).astype(jnp.float32)
+        return out, y - q.astype(jnp.float32)
+    # int8: shared per-row scale agreed via pmax so every device's codes
+    # decode identically; the sum itself is carried exactly (the codes are
+    # small integers — f32 holds them losslessly; the wire format is int8).
+    amax = jax.lax.pmax(jnp.max(jnp.abs(y), axis=-1, keepdims=True), axis)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(y / scale), -127.0, 127.0)
+    return jax.lax.psum(q, axis) * scale, y - q * scale
+
+
+def tree_psum(tree, axes, *, quantize: str | None = None, err=None):
+    """Reduce a stats pytree over mesh `axes` inside a shard_map body.
+
+    axes are reduced innermost-first (reversed), so a hierarchical
+    (dcn, ici) mesh psums ICI then DCN. quantize encodes the rank-≥2 float
+    leaves on the LAST (outermost / DCN) stage; `err` is the same-structure
+    error-feedback tree added to those leaves before encoding. Returns
+    (reduced_tree, new_err_tree) — new_err is None when quantize is None.
+
+    Hierarchical + quantize ordering matters: the per-device residual is
+    folded in BEFORE the ICI stage, so the DCN-stage encoder sees a value
+    (and, for int8, agrees a scale) that is identical at every ICI
+    position — otherwise each ICI position would quantize a different y
+    and the "replicated" output would silently differ across the group.
+    The new residual is then identical within each ICI group; it is stored
+    scaled by 1/group_size so the NEXT pass's ICI psum reconstitutes
+    exactly one copy of it.
+    """
+    order = tuple(reversed(axes))
+    early, last = order[:-1], order[-1]
+    if quantize is None:
+        for ax in early:
+            tree = jax.tree.map(lambda t: jax.lax.psum(t, ax), tree)
+        return jax.tree.map(lambda t: jax.lax.psum(t, last), tree), None
+    if err is None:
+        err = jax.tree.map(jnp.zeros_like, tree)
+    group = 1.0
+    for ax in early:
+        group = group * jax.lax.psum(1.0, ax)
+    flat, treedef = jax.tree.flatten(tree)
+    eflat = jax.tree.leaves(err)
+    outs, resids = [], []
+    for t, e in zip(flat, eflat):
+        if _quantized_leaf(t):
+            y = t + e
+            for ax in early:
+                y = jax.lax.psum(y, ax)
+            out, resid = _q_psum_leaf(y, last, quantize)
+            if early:
+                resid = resid / group
+        else:
+            for ax in early:
+                t = jax.lax.psum(t, ax)
+            out, resid = jax.lax.psum(t, last), jnp.zeros_like(e)
+        outs.append(out)
+        resids.append(resid)
+    return treedef.unflatten(outs), treedef.unflatten(resids)
+
+
+def _data_spec(axes) -> P:
+    return P(axes if len(axes) > 1 else axes[0])
+
+
+def reduced_tree_stats(
+    mesh, local_fn, n_data_args: int, n_args: int, axis_name=None
+):
+    """Per-batch reduced tower: the first `n_data_args` of `n_args` args are
+    sharded on their leading axis over the mesh's data axes, the rest
+    replicated; `local_fn(*args)`'s stats tree is psum'd over those axes
+    (staged ICI-then-DCN on a hierarchical mesh) and returned replicated."""
+    axes = (axis_name,) if axis_name is not None else data_axes(mesh)
+    spec = _data_spec(axes)
+    in_specs = tuple(
+        spec if i < n_data_args else P() for i in range(n_args)
+    )
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        check_vma=False,
+    )
+    def run(*args):
+        return tree_psum(local_fn(*args), axes)[0]
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Deferred (per-pass) accumulation
+# ---------------------------------------------------------------------------
+
+
+def local_tree_stats(mesh, local_fn, n_data_args: int, n_args: int):
+    """shard_map wrapper for deferred accumulation: the first `n_data_args`
+    of `n_args` arguments are sharded on their leading axis over the mesh's
+    data axes, the rest replicated. Runs `local_fn(*args)` per shard and
+    returns its stats tree with a LEADING DEVICE AXIS (one slot per data
+    shard) — no cross-device reduce anywhere; the per-batch accumulator add
+    stays shard-local."""
+    axes = data_axes(mesh)
+    spec = _data_spec(axes)
+    in_specs = tuple(
+        spec if i < n_data_args else P() for i in range(n_args)
+    )
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=spec,
+        check_vma=False,
+    )
+    def run(*args):
+        local = local_fn(*args)
+        return jax.tree.map(lambda t: t[None], local)
+
+    return run
+
+
+def deferred_reduce(mesh, quantize: str | None = None):
+    """The ONE cross-device reduce of a deferred stats tree: returns a
+    jit-able fn. Without quantize: fn(acc) → reduced tree (replicated).
+    With quantize: fn(acc, err) → (reduced tree, new_err), err being the
+    deferred-layout error-feedback tree (leading device axis)."""
+    axes = data_axes(mesh)
+    spec = _data_spec(axes)
+
+    if quantize is None:
+
+        @partial(
+            shard_map, mesh=mesh, in_specs=(spec,), out_specs=P(),
+            check_vma=False,
+        )
+        def run(acc):
+            local = jax.tree.map(lambda t: t[0], acc)
+            red, _ = tree_psum(local, axes)
+            return red
+
+        return run
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec), out_specs=(P(), spec),
+        check_vma=False,
+    )
+    def run_q(acc, err):
+        local = jax.tree.map(lambda t: t[0], acc)
+        e = jax.tree.map(lambda t: t[0], err)
+        red, new_err = tree_psum(local, axes, quantize=quantize, err=e)
+        return red, jax.tree.map(lambda t: t[None], new_err)
+
+    return run_q
+
+
+def make_deferred_fns(mesh, example_tree, tower, quantize: str | None):
+    """The (zero_acc, acc_add, reduce) triple every deferred streamed
+    driver shares — built from its stats `tower` (a local_tree_stats
+    wrapper) and LOGICAL-shape `example_tree`: acc_add(acc, *tower_args)
+    adds one batch's shard-local stats (zero collectives), reduce is the
+    jitted once-per-pass cross-device reduce (with error feedback when
+    quantized). Callers lru_cache per configuration (fresh jit closures
+    per fit would re-trace every invocation)."""
+    reducer = deferred_reduce(mesh, quantize)
+
+    # Donate the accumulator: without it XLA keeps the old n_dev-times-
+    # larger accumulator live while allocating the new one on EVERY batch
+    # step — the same transient spike zero_deferred's sharding-first
+    # allocation exists to avoid. (No caller reads an acc after passing it
+    # back in; CPU backends ignore donation with a benign warning.)
+    @partial(jax.jit, donate_argnums=(0,))
+    def acc_add(acc, *args):
+        return jax.tree.map(jnp.add, acc, tower(*args))
+
+    zero_acc = lambda: zero_deferred(mesh, example_tree)
+    return zero_acc, acc_add, jax.jit(reducer)
+
+
+def zero_deferred(mesh, example_tree):
+    """Deferred-layout zeros for `example_tree` (a stats tree of LOGICAL
+    shapes, e.g. sums (K, d)): each leaf gains a leading device axis and is
+    sharded over the mesh's data axes — the per-pass accumulator (and the
+    quantized modes' error-feedback state) start here.
+
+    Allocated sharding-first (jnp.zeros(device=sharding)) — this runs once
+    per pass, and materializing the n_dev-times-larger accumulator on one
+    device before resharding would cost n_dev× the steady-state per-device
+    budget at exactly the large-K shapes per-pass mode targets."""
+    axes = data_axes(mesh)
+    n_dev = int(math.prod(mesh.devices.shape))
+    sharding = NamedSharding(mesh, _data_spec(axes))
+
+    def zero(t):
+        return jnp.zeros((n_dev,) + tuple(t.shape), t.dtype, device=sharding)
+
+    return jax.tree.map(zero, example_tree)

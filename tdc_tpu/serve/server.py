@@ -222,7 +222,10 @@ class ServeApp:
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the request/batch/engine stats."""
+        from tdc_tpu.parallel.reduce import GLOBAL_COMMS
+
         e, b = self.engine.stats, self.batcher.stats
+        comms = GLOBAL_COMMS.snapshot()
         lines = [
             "# HELP tdc_serve_requests_total Requests by endpoint and status.",
             "# TYPE tdc_serve_requests_total counter",
@@ -254,6 +257,15 @@ class ServeApp:
              round(b["queue_wait_ms_total"], 3)),
             ("tdc_serve_models", "gauge",
              "Models currently registered.", len(self.registry.ids())),
+            # Process-wide stats-reduce accounting (parallel/reduce.py):
+            # cross-device sufficient-stat reduces issued by fits running
+            # in this process, and the logical payload bytes they moved.
+            ("tdc_comms_stats_reduces_total", "counter",
+             "Cross-device stats reduces issued (parallel/reduce).",
+             comms["reduces"]),
+            ("tdc_comms_stats_logical_bytes_total", "counter",
+             "Logical payload bytes moved by stats reduces.",
+             comms["logical_bytes"]),
         ]
         for name, typ, help_, val in scalar:
             lines += [f"# HELP {name} {help_}", f"# TYPE {name} {typ}",

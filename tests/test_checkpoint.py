@@ -414,6 +414,7 @@ def test_manual_save_overwrite_is_atomic_per_file(tmp_path):
     assert leftovers == []
 
 
+@pytest.mark.multiproc
 def test_independent_per_host_checkpoints_no_deadlock(tmp_path):
     """Two jax.distributed processes each running their OWN host-local
     streamed fit (mesh=None) with different iteration counts must both

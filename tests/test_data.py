@@ -101,6 +101,62 @@ def test_oom_adaptive_reraises_other_errors():
         oom_adaptive(run)
 
 
+def test_auto_batch_size_pallas_kernel_larger():
+    """The fused Pallas kernels never materialize the (N, K) one-hot or
+    distance rows in HBM, so their working-set model must admit larger
+    batches than the XLA matmul form at the same K."""
+    xla = auto_batch_size(128, 16384, kernel="xla")
+    pallas = auto_batch_size(128, 16384, kernel="pallas")
+    assert pallas > xla
+    # At K=16384, d=128 the XLA model budgets 8*K bytes/row of (N, K)
+    # buffers vs the pallas model's 8-byte label/min columns — two orders
+    # of magnitude, not a rounding artifact.
+    assert pallas > 50 * xla
+    # Small K: the x row dominates both models and they converge.
+    assert auto_batch_size(4096, 3, kernel="pallas") <= 2 * auto_batch_size(
+        4096, 3, kernel="xla"
+    )
+
+
+class TestOOMAxonInternalError:
+    """The tunneled-TPU (axon) backend reports compile-time HBM exhaustion
+    as an INTERNAL error with a 'would exceed memory' message instead of
+    RESOURCE_EXHAUSTED — previously only exercised implicitly."""
+
+    AXON_MSG = (
+        "INTERNAL: Attempting to reserve 12.60G at the bottom of memory. "
+        "That was not possible. There are 9.33G free, 0B reserved, and "
+        "9.33G reservable. Allocating 13528335360 bytes would exceed "
+        "memory capacity."
+    )
+
+    def test_is_oom_error_matches_axon_string(self):
+        from tdc_tpu.data.batching import is_oom_error
+
+        assert is_oom_error(RuntimeError(self.AXON_MSG))
+        assert not is_oom_error(RuntimeError("INTERNAL: something else"))
+
+    def test_oom_adaptive_doubles_on_axon_internal_error(self):
+        calls = []
+
+        def run(num_batches):
+            calls.append(num_batches)
+            if num_batches < 8:
+                raise RuntimeError(self.AXON_MSG)
+            return "fit"
+
+        result, nb = oom_adaptive(run, initial_num_batches=2)
+        assert result == "fit" and nb == 8
+        assert calls == [2, 4, 8]
+
+    def test_oom_adaptive_exhausts_doublings(self):
+        def run(num_batches):
+            raise RuntimeError(self.AXON_MSG)
+
+        with pytest.raises(MemoryError):
+            oom_adaptive(run, initial_num_batches=1, max_doublings=3)
+
+
 def test_load_points_bf16_npy_roundtrip(tmp_path):
     """npy cannot express bfloat16 (saves as unstructured |V2);
     load_points reinterprets such files back to bf16 — the disk format for

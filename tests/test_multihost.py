@@ -10,6 +10,7 @@ import textwrap
 
 import numpy as np
 import jax
+import pytest
 
 from tdc_tpu.models import kmeans_fit
 from tdc_tpu.parallel.multihost import (
@@ -98,6 +99,7 @@ _WORKER = textwrap.dedent(
 )
 
 
+@pytest.mark.multiproc
 def test_two_process_distributed_fit_matches_single(tmp_path):
     """Spawn 2 OS processes with a local jax.distributed coordinator (2 CPU
     devices each -> a 4-device global mesh); each contributes only its
@@ -166,6 +168,7 @@ _WORKER_SHARDED_K = textwrap.dedent(
 )
 
 
+@pytest.mark.multiproc
 def test_two_process_k_sharded_fit_matches_single(tmp_path):
     """SURVEY §7 step 7 composed: a 2-process jax.distributed run whose 2-D
     mesh is (data=2 hosts x model=2 local devices), running
@@ -216,6 +219,7 @@ _GMM_WORKER = textwrap.dedent(
 )
 
 
+@pytest.mark.multiproc
 def test_two_process_streamed_gmm_matches_single(tmp_path):
     """2-process streamed GMM EM over a global mesh (each host streams its
     own slice) must match the single-process streamed fit — same init
@@ -271,6 +275,7 @@ _WORKER_SHARDED_FUZZY = textwrap.dedent(
 )
 
 
+@pytest.mark.multiproc
 def test_two_process_k_sharded_fuzzy_matches_single(tmp_path):
     """The K-sharded fuzzy tower's cross-shard collective (the psum'd
     membership normalizer) over a REAL 2-process jax.distributed mesh:

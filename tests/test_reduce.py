@@ -1,0 +1,499 @@
+"""parallel/reduce: deferred per-pass reduction, hierarchical (dcn, ici)
+meshes, quantized stats reduces with error feedback, and comms accounting —
+on the 8-virtual-device CPU mesh (tests/conftest.py).
+
+Tolerance contract under test: per_pass reorders f32 summation
+(per-device-then-across-devices), so parity with per_batch is
+accumulation-tolerance, not bitwise; the quantized modes must keep the
+final inertia within 1e-3 RELATIVE of the f32 path on the blobs config
+(ISSUE 2 acceptance criterion)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.models.gmm import streamed_gmm_fit
+from tdc_tpu.models.streaming import (
+    _deferred_lloyd_fns,
+    streamed_fuzzy_fit,
+    streamed_kmeans_fit,
+)
+from tdc_tpu.parallel import reduce as reduce_lib
+from tdc_tpu.parallel.mesh import (
+    DATA_AXIS,
+    DCN_AXIS,
+    ICI_AXIS,
+    data_axes,
+    is_hierarchical,
+    make_hierarchical_mesh,
+    make_mesh,
+)
+from tdc_tpu.parallel.reduce import ReduceStrategy, resolve_reduce
+from tdc_tpu.parallel.sharded_k import (
+    make_mesh_2d,
+    streamed_fuzzy_fit_sharded,
+    streamed_kmeans_fit_sharded,
+)
+
+N_BATCH = 5
+
+
+def _batches(x, rows=250):
+    # 1200 rows / 250 → 5 batches with a ragged 200-row tail: exercises the
+    # zero-padding correction on every strategy.
+    return lambda: (x[i: i + rows] for i in range(0, len(x), rows))
+
+
+# ---------------------------------------------------------------------------
+# Strategy resolution and mesh layout
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_reduce_shorthands():
+    assert resolve_reduce("per_batch") == ReduceStrategy("per_batch")
+    assert resolve_reduce("per_pass") == ReduceStrategy("per_pass")
+    assert resolve_reduce("per_pass:int8") == ReduceStrategy(
+        "per_pass", "int8"
+    )
+    assert resolve_reduce("per_pass:bf16").quantize == "bf16"
+    s = ReduceStrategy("per_pass", "int8")
+    assert resolve_reduce(s) is s
+    assert s.label() == "per_pass:int8"
+    with pytest.raises(ValueError, match="mode"):
+        resolve_reduce("per_epoch")
+    with pytest.raises(ValueError, match="quantize"):
+        resolve_reduce("per_pass:fp4")
+    with pytest.raises(ValueError, match="per_pass"):
+        ReduceStrategy("per_batch", "int8")
+
+
+def test_hierarchical_mesh_layout():
+    flat = make_mesh(8)
+    assert data_axes(flat) == (DATA_AXIS,)
+    assert not is_hierarchical(flat)
+    hm = make_hierarchical_mesh(2)
+    assert hm.devices.shape == (2, 4)
+    assert hm.axis_names == (DCN_AXIS, ICI_AXIS)
+    assert data_axes(hm) == (DCN_AXIS, ICI_AXIS)
+    assert is_hierarchical(hm)
+    with pytest.raises(ValueError, match="divisible"):
+        make_hierarchical_mesh(3)
+
+
+def test_tree_reduce_cost_model():
+    example = reduce_lib.zero_deferred  # noqa: F841 (shape-only below)
+    tree = {
+        "sums": jax.ShapeDtypeStruct((16, 8), jnp.float32),
+        "counts": jax.ShapeDtypeStruct((16,), jnp.float32),
+        "sse": jax.ShapeDtypeStruct((), jnp.float32),
+    }
+    payload = 4 * (16 * 8 + 16 + 1)
+    assert reduce_lib.tree_reduce_cost(tree, ("data",)) == (1, payload)
+    # Hierarchical: two staged reduces, each moving the full payload.
+    assert reduce_lib.tree_reduce_cost(tree, ("dcn", "ici")) == (
+        2, 2 * payload,
+    )
+    # int8: 1 B/elem for the rank-2 leaf + f32 per-row scales, f32 for the
+    # rank-≤1 leaves, plus the scale-agreement pmax (its own reduce).
+    r, b = reduce_lib.tree_reduce_cost(tree, ("data",), quantize="int8")
+    assert r == 2
+    assert b == (16 * 8 + 4 * 16) + 4 * (16 + 1) + 4 * 16
+    # bf16: 2 B/elem for the rank-2 leaf, one reduce.
+    r, b = reduce_lib.tree_reduce_cost(tree, ("data",), quantize="bf16")
+    assert r == 1
+    assert b == 2 * 16 * 8 + 4 * (16 + 1)
+    # int8 with TWO rank-≥2 leaves (the GMM shape): one pmax per quantized
+    # leaf — tree_psum agrees scales leaf by leaf.
+    gmm_tree = {
+        "sx": jax.ShapeDtypeStruct((16, 8), jnp.float32),
+        "sxx": jax.ShapeDtypeStruct((16, 8), jnp.float32),
+        "nk": jax.ShapeDtypeStruct((16,), jnp.float32),
+    }
+    r, _ = reduce_lib.tree_reduce_cost(gmm_tree, ("data",), quantize="int8")
+    assert r == 3  # payload psum + 2 scale pmaxes
+
+
+# ---------------------------------------------------------------------------
+# Deferred per-pass reduction — O(1) collectives per pass
+# ---------------------------------------------------------------------------
+
+
+def test_per_pass_matches_per_batch_kmeans(blobs_small):
+    x, _, centers = blobs_small
+    mesh = make_mesh(8)
+    kw = dict(init=x[:3], max_iters=5, tol=-1.0, mesh=mesh)
+    pb = streamed_kmeans_fit(_batches(x), 3, 2, **kw)
+    pp = streamed_kmeans_fit(_batches(x), 3, 2, reduce="per_pass", **kw)
+    np.testing.assert_allclose(
+        np.asarray(pb.centroids), np.asarray(pp.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert abs(float(pb.sse) - float(pp.sse)) <= 1e-4 * float(pb.sse)
+    # The acceptance accounting: per-pass issues EXACTLY one cross-device
+    # reduce per pass (5 Lloyd iterations + the final scoring pass), the
+    # per-batch path one per streamed batch.
+    assert pp.comms.passes == 6
+    assert pp.comms.reduces == pp.comms.passes
+    assert pp.comms.reduces_per_pass == 1.0
+    assert pb.comms.reduces == N_BATCH * pb.comms.passes
+    assert pb.comms.logical_bytes == N_BATCH * pp.comms.logical_bytes
+
+
+def test_per_pass_accumulate_compiles_with_no_collectives():
+    """The deferred accumulate must be collective-free (the whole point:
+    per-batch work stays shard-local) and the deferred reduce must carry
+    the pass's all-reduce — checked on the compiled HLO, not trust in the
+    host-side counter."""
+    mesh = make_mesh(8)
+    k, d = 4, 8
+    zero_acc, acc_add, reducer = _deferred_lloyd_fns(
+        mesh, k, d, False, "xla", None, False
+    )
+    acc = zero_acc()
+    xb = jax.device_put(
+        np.zeros((16, d), np.float32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data")),
+    )
+    c = jnp.zeros((k, d), jnp.float32)
+    add_hlo = jax.jit(acc_add).lower(acc, xb, c).compile().as_text()
+    assert "all-reduce" not in add_hlo
+    red_hlo = jax.jit(reducer).lower(acc).compile().as_text()
+    assert "all-reduce" in red_hlo
+
+
+def test_per_pass_matches_per_batch_fuzzy(blobs_small):
+    x, _, _ = blobs_small
+    mesh = make_mesh(8)
+    kw = dict(init=x[:3], max_iters=4, tol=-1.0, mesh=mesh)
+    pb = streamed_fuzzy_fit(_batches(x), 3, 2, **kw)
+    pp = streamed_fuzzy_fit(_batches(x), 3, 2, reduce="per_pass", **kw)
+    np.testing.assert_allclose(
+        np.asarray(pb.centroids), np.asarray(pp.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert pp.comms.reduces == pp.comms.passes == 5
+    assert pb.comms.reduces == N_BATCH * pb.comms.passes
+
+
+def test_per_pass_matches_per_batch_gmm(blobs_small):
+    x, _, _ = blobs_small
+    mesh = make_mesh(8)
+    kw = dict(init=x[:3], max_iters=4, mesh=mesh)
+    pb = streamed_gmm_fit(_batches(x), 3, 2, **kw)
+    pp = streamed_gmm_fit(_batches(x), 3, 2, reduce="per_pass", **kw)
+    assert abs(float(pb.log_likelihood) - float(pp.log_likelihood)) < 1e-4
+    np.testing.assert_allclose(
+        np.asarray(pb.means), np.asarray(pp.means), rtol=1e-4, atol=1e-4
+    )
+    assert pp.comms.reduces == pp.comms.passes
+    assert pb.comms.reduces == N_BATCH * pb.comms.passes
+
+
+def test_per_pass_weighted_kmeans(blobs_small):
+    """Weighted streams defer too — pad rows carry zero weight, so the
+    per-pass path needs (and applies) no padding correction."""
+    x, _, _ = blobs_small
+    mesh = make_mesh(8)
+    w = np.ones(len(x), np.float32)
+    w[: len(x) // 2] = 2.0
+    wb = lambda: (w[i: i + 250] for i in range(0, len(x), 250))
+    kw = dict(init=x[:3], max_iters=4, tol=-1.0, mesh=mesh,
+              sample_weight_batches=wb)
+    pb = streamed_kmeans_fit(_batches(x), 3, 2, **kw)
+    pp = streamed_kmeans_fit(_batches(x), 3, 2, reduce="per_pass", **kw)
+    np.testing.assert_allclose(
+        np.asarray(pb.centroids), np.asarray(pp.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert pp.comms.reduces == pp.comms.passes
+
+
+def test_per_pass_single_device_degrades_gracefully(blobs_small):
+    """per_pass without a multi-device mesh is a no-op (nothing to defer):
+    same math, zero reduces reported."""
+    x, _, _ = blobs_small
+    res = streamed_kmeans_fit(
+        _batches(x), 3, 2, init=x[:3], max_iters=3, tol=-1.0,
+        reduce="per_pass",
+    )
+    base = streamed_kmeans_fit(
+        _batches(x), 3, 2, init=x[:3], max_iters=3, tol=-1.0,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.centroids), np.asarray(base.centroids)
+    )
+    assert res.comms.reduces == 0
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical ICI/DCN reduction
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchical_per_batch_matches_flat(blobs_small):
+    x, _, _ = blobs_small
+    flat = make_mesh(8)
+    hm = make_hierarchical_mesh(2)
+    kw = dict(init=x[:3], max_iters=5, tol=-1.0)
+    a = streamed_kmeans_fit(_batches(x), 3, 2, mesh=flat, **kw)
+    b = streamed_kmeans_fit(_batches(x), 3, 2, mesh=hm, **kw)
+    np.testing.assert_allclose(
+        np.asarray(a.centroids), np.asarray(b.centroids),
+        rtol=1e-5, atol=1e-5,
+    )
+    # Two staged reduces (ICI then DCN) per batch instead of one flat.
+    assert b.comms.reduces == 2 * a.comms.reduces
+    assert b.comms.strategy == "per_batch"
+
+
+def test_hierarchical_per_pass(blobs_small):
+    x, _, _ = blobs_small
+    hm = make_hierarchical_mesh(2)
+    flat = make_mesh(8)
+    kw = dict(init=x[:3], max_iters=5, tol=-1.0)
+    a = streamed_kmeans_fit(_batches(x), 3, 2, mesh=flat, **kw)
+    b = streamed_kmeans_fit(_batches(x), 3, 2, mesh=hm,
+                            reduce="per_pass", **kw)
+    np.testing.assert_allclose(
+        np.asarray(a.centroids), np.asarray(b.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    # 2 staged reduces per PASS — still O(1) in the batch count.
+    assert b.comms.reduces == 2 * b.comms.passes
+
+
+def test_distributed_stats_hierarchical_tower(blobs_small):
+    """collectives.distributed_lloyd_stats on a hierarchical mesh (the
+    two-stage psum) equals the local stats computed directly."""
+    from tdc_tpu.ops.assign import lloyd_stats
+    from tdc_tpu.parallel.collectives import distributed_lloyd_stats
+    from tdc_tpu.parallel.mesh import data_sharding
+
+    x, _, centers = blobs_small
+    x = x[:1024]
+    hm = make_hierarchical_mesh(2)
+    c = jnp.asarray(centers)
+    xs = jax.device_put(x, data_sharding(hm))
+    got = distributed_lloyd_stats(xs, c, hm)
+    want = lloyd_stats(jnp.asarray(x), c)
+    np.testing.assert_allclose(got.sums, want.sums, rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(got.counts, want.counts, rtol=0, atol=0)
+    np.testing.assert_allclose(got.sse, want.sse, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Quantized reduce + error feedback
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("quant", ["bf16", "int8"])
+def test_quantized_inertia_within_tolerance(blobs_small, quant):
+    """ISSUE 2 acceptance: quantized error-feedback inertia within 1e-3
+    RELATIVE of the f32 path on the blobs config."""
+    x, y, centers = blobs_small
+    mesh = make_mesh(8)
+    kw = dict(init=centers, max_iters=8, tol=-1.0, mesh=mesh)
+    f32 = streamed_kmeans_fit(_batches(x), 3, 2, **kw)
+    q = streamed_kmeans_fit(
+        _batches(x), 3, 2, reduce=f"per_pass:{quant}", **kw
+    )
+    rel = abs(float(q.sse) - float(f32.sse)) / float(f32.sse)
+    assert rel < 1e-3, f"{quant} inertia off by {rel:.2e} relative"
+    # The quantized trajectory lands on the same solution as the f32 path.
+    d = np.linalg.norm(
+        np.asarray(q.centroids) - np.asarray(f32.centroids), axis=-1
+    )
+    assert d.max() < 0.05
+    # And that solution identifies the true blob centers.
+    dc = np.linalg.norm(
+        np.asarray(q.centroids)[:, None, :] - centers[None], axis=-1
+    )
+    assert (dc.min(axis=1) < 0.5).all()
+    assert q.comms.strategy == f"per_pass:{quant}"
+    assert q.comms.logical_bytes < f32.comms.logical_bytes
+
+
+def test_error_feedback_reinjects_residual():
+    """EF property, directly on deferred_reduce: reducing the same
+    accumulator twice with the carried residual makes the TWO-reduce
+    average strictly more accurate than a single quantized reduce — the
+    error is deferred into the next pass, not lost."""
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(7)
+    sums = rng.normal(size=(8, 16, 8)).astype(np.float32) * np.logspace(
+        0, 3, 16
+    ).astype(np.float32)[None, :, None]
+    tree = {
+        "sums": jax.device_put(
+            sums,
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("data")
+            ),
+        )
+    }
+    truth = sums.sum(axis=0)
+    reducer = reduce_lib.deferred_reduce(mesh, "int8")
+    err0 = jax.tree.map(jnp.zeros_like, tree)
+    r1, e1 = reducer(tree, err0)
+    r2, _ = reducer(tree, e1)
+    err_single = np.abs(np.asarray(r1["sums"]) - truth).max()
+    err_ef = np.abs(
+        (np.asarray(r1["sums"]) + np.asarray(r2["sums"])) / 2 - truth
+    ).max()
+    assert err_single > 0  # int8 genuinely quantizes this data
+    assert err_ef < 0.6 * err_single
+
+
+@pytest.mark.parametrize("quant", ["bf16", "int8"])
+def test_quantized_on_hierarchical_mesh(blobs_small, quant):
+    """Regression: the DCN-stage encoder must see a value identical at
+    every ICI position (the residual folds in BEFORE the ICI psum, and the
+    new residual is stored scaled by 1/group so the next ICI psum
+    reconstitutes one copy) — otherwise each ICI position quantizes a
+    different y and the 'replicated' output silently diverges across the
+    group."""
+    x, _, centers = blobs_small
+    hm = make_hierarchical_mesh(2)
+    flat = make_mesh(8)
+    kw = dict(init=centers, max_iters=8, tol=-1.0)
+    f32 = streamed_kmeans_fit(_batches(x), 3, 2, mesh=flat, **kw)
+    q = streamed_kmeans_fit(_batches(x), 3, 2, mesh=hm,
+                            reduce=f"per_pass:{quant}", **kw)
+    rel = abs(float(q.sse) - float(f32.sse)) / float(f32.sse)
+    assert rel < 1e-3, f"hier {quant} inertia off by {rel:.2e} relative"
+    np.testing.assert_allclose(
+        np.asarray(q.centroids), np.asarray(f32.centroids), atol=0.05
+    )
+
+
+def test_quantized_hierarchical_output_physically_replicated():
+    """Direct detector for the above: with distinct per-device residuals,
+    every device's shard of the 'replicated' reduced output must hold
+    byte-identical values, and the EF bookkeeping invariant
+    out + Σ_devices(new_err) == Σ(acc) + Σ(err) must hold across the
+    hierarchy."""
+    hm = make_hierarchical_mesh(2)
+    spec = jax.sharding.NamedSharding(
+        hm, jax.sharding.PartitionSpec((DCN_AXIS, ICI_AXIS))
+    )
+    rng = np.random.default_rng(11)
+    acc = {"sums": jax.device_put(
+        rng.normal(size=(8, 16, 8)).astype(np.float32), spec
+    )}
+    err = {"sums": jax.device_put(
+        rng.normal(size=(8, 16, 8)).astype(np.float32) * 0.1, spec
+    )}
+    reducer = reduce_lib.deferred_reduce(hm, "int8")
+    out, new_err = reducer(acc, err)
+    shards = [np.asarray(s.data) for s in out["sums"].addressable_shards]
+    for v in shards[1:]:
+        np.testing.assert_array_equal(v, shards[0])
+    total_in = np.asarray(acc["sums"]).sum(0) + np.asarray(err["sums"]).sum(0)
+    total_out = np.asarray(out["sums"]) + np.asarray(new_err["sums"]).sum(0)
+    np.testing.assert_allclose(total_out, total_in, rtol=1e-5, atol=1e-4)
+
+
+def test_quantized_validation():
+    mesh = make_mesh(8)
+    x = np.zeros((64, 2), np.float32)
+    b = lambda: iter([x])
+    with pytest.raises(ValueError, match="multi-device"):
+        streamed_kmeans_fit(b, 2, 2, init=x[:2], max_iters=1,
+                            reduce="per_pass:int8")
+    with pytest.raises(ValueError, match="error-feedback"):
+        streamed_kmeans_fit(b, 2, 2, init=x[:2], max_iters=1, mesh=mesh,
+                            reduce="per_pass:int8", ckpt_dir="/tmp/nope")
+    with pytest.raises(ValueError, match="mid-pass"):
+        streamed_kmeans_fit(b, 2, 2, init=x[:2], max_iters=1, mesh=mesh,
+                            reduce="per_pass", ckpt_dir="/tmp/nope",
+                            ckpt_every_batches=1)
+
+
+# ---------------------------------------------------------------------------
+# K-sharded (2-D mesh) per-pass mode
+# ---------------------------------------------------------------------------
+
+
+def _blobs8(n=1600):
+    rng = np.random.default_rng(3)
+    centers = np.pad(
+        np.array(
+            [[0.0, 0.0], [10.0, 0.0], [0.0, 10.0], [10.0, 10.0]], np.float32
+        ),
+        ((0, 0), (0, 6)),
+    )
+    x = np.concatenate(
+        [
+            rng.normal(c, 1.0, size=(n // 4, 8)).astype(np.float32)
+            for c in centers
+        ]
+    )
+    rng.shuffle(x)
+    return x
+
+
+def test_sharded_per_pass_matches_per_batch():
+    x = _blobs8()
+    mesh = make_mesh_2d(4, 2)
+    batches = lambda: (x[i: i + 300] for i in range(0, len(x), 300))
+    kw = dict(init=x[:4], max_iters=4, tol=-1.0)
+    pb = streamed_kmeans_fit_sharded(batches, 4, 8, mesh, **kw)
+    pp = streamed_kmeans_fit_sharded(batches, 4, 8, mesh,
+                                     reduce="per_pass", **kw)
+    np.testing.assert_allclose(
+        np.asarray(pb.centroids), np.asarray(pp.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert pp.comms.reduces == pp.comms.passes == 5
+    assert pb.comms.reduces == 6 * pb.comms.passes  # ceil(1600/300) batches
+
+
+def test_sharded_fuzzy_per_pass_matches_per_batch():
+    x = _blobs8()
+    mesh = make_mesh_2d(4, 2)
+    batches = lambda: (x[i: i + 400] for i in range(0, len(x), 400))
+    kw = dict(init=x[:4], max_iters=3, tol=-1.0)
+    pb = streamed_fuzzy_fit_sharded(batches, 4, 8, mesh, **kw)
+    pp = streamed_fuzzy_fit_sharded(batches, 4, 8, mesh,
+                                    reduce="per_pass", **kw)
+    np.testing.assert_allclose(
+        np.asarray(pb.centroids), np.asarray(pp.centroids),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert abs(float(pb.objective) - float(pp.objective)) <= 1e-4 * abs(
+        float(pb.objective)
+    )
+    assert pp.comms.reduces == pp.comms.passes == 4
+
+
+def test_sharded_quantize_rejected():
+    x = _blobs8()
+    mesh = make_mesh_2d(4, 2)
+    with pytest.raises(ValueError, match="1-D streamed"):
+        streamed_kmeans_fit_sharded(
+            lambda: iter([x]), 4, 8, mesh, init=x[:4], max_iters=1,
+            reduce="per_pass:int8",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Comms accounting plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_global_counter_mirrors_fit_counters(blobs_small):
+    x, _, _ = blobs_small
+    mesh = make_mesh(8)
+    before = reduce_lib.GLOBAL_COMMS.snapshot()
+    res = streamed_kmeans_fit(
+        _batches(x), 3, 2, init=x[:3], max_iters=2, tol=-1.0, mesh=mesh,
+        reduce="per_pass",
+    )
+    after = reduce_lib.GLOBAL_COMMS.snapshot()
+    assert after["reduces"] - before["reduces"] >= res.comms.reduces
+    assert (
+        after["logical_bytes"] - before["logical_bytes"]
+        >= res.comms.logical_bytes
+    )

@@ -466,6 +466,11 @@ class TestHTTP:
             assert 'endpoint="predict",status="200"' in metrics
             assert "tdc_serve_batches_total" in metrics
             assert "tdc_serve_latency_ms" in metrics
+            # Cross-device stats-reduce accounting (parallel/reduce):
+            # surfaced process-wide so operators can watch fit comms from
+            # the same scrape.
+            assert "tdc_comms_stats_reduces_total" in metrics
+            assert "tdc_comms_stats_logical_bytes_total" in metrics
         finally:
             app.stop()
 

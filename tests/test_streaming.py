@@ -103,6 +103,71 @@ def test_prefetched_preserves_order_and_propagates_errors():
         next(it)
 
 
+def _prefetch_threads():
+    import threading
+
+    return [
+        t for t in threading.enumerate()
+        if t.name == "tdc-prefetch" and t.is_alive()
+    ]
+
+
+def _assert_prefetch_threads_die(baseline, timeout=5.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(_prefetch_threads()) <= baseline:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"prefetch producer threads still alive after {timeout}s: "
+        f"{_prefetch_threads()}"
+    )
+
+
+def test_prefetched_producer_terminates_on_consumer_close():
+    """Early consumer exit used to leave the producer parked forever on
+    q.put into the full bounded queue (the old docstring claimed it 'dies
+    with the queue' — it didn't; each abandoned pass pinned depth+1
+    batches until process exit). The stop signal + drain must kill it."""
+    import itertools
+
+    from tdc_tpu.models.streaming import _prefetched
+
+    baseline = len(_prefetch_threads())
+
+    def endless():
+        for i in itertools.count():
+            yield np.full((4, 2), i, np.float32)
+
+    gen = _prefetched(endless(), depth=2)
+    assert int(next(gen)[0, 0]) == 0
+    assert int(next(gen)[0, 0]) == 1
+    # The producer is now blocked putting into the full queue; closing the
+    # generator must wake and terminate it.
+    gen.close()
+    _assert_prefetch_threads_die(baseline)
+
+
+def test_prefetched_producer_terminates_on_midstream_break():
+    """The for-loop-break shape every driver hits on early convergence or
+    an exception mid-pass."""
+    from tdc_tpu.models.streaming import _prefetched
+
+    baseline = len(_prefetch_threads())
+    items = [np.full((2, 2), i) for i in range(64)]
+    for i, b in enumerate(_prefetched(iter(items), depth=2)):
+        if i == 3:
+            break
+    # The loop's generator goes out of scope here; CPython refcounting
+    # closes it immediately (GeneratorExit in the consumer frame).
+    import gc
+
+    gc.collect()
+    _assert_prefetch_threads_die(baseline)
+
+
 def test_streamed_prefetch_matches_no_prefetch(blobs_small):
     x, _, _ = blobs_small
     a = streamed_kmeans_fit(NpzStream(x, 200), 3, 2, init=x[:3], max_iters=6,

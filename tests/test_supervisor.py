@@ -212,6 +212,7 @@ _ELASTIC_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.multiproc
 def test_gang_kill_and_resume_matches_uninterrupted(tmp_path):
     """The full elastic story: a 2-process jax.distributed gang runs a
     mesh-sharded streamed fit with per-iteration checkpoints; worker 1 is
@@ -393,6 +394,7 @@ _SHARDED_GANG_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.multiproc
 def test_sharded_gang_kill_and_resume_matches_uninterrupted(tmp_path):
     """The elastic story for the 2-D K-SHARDED gang (round-5 VERDICT weak
     #6 — worker loss with model-sharded centroid state, the harder
