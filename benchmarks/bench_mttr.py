@@ -1,0 +1,195 @@
+"""MTTR micro-benchmark: kill -> resumed progress, through the real
+supervisor recovery path.
+
+Measures the wall-clock gap between the moment the gang loses a worker to
+an injected kill -9 (TDC_FAULTS, tdc_tpu.testing.faults) and the moment
+the relaunched gang writes its first NEW checkpoint step — i.e. the full
+recovery pipeline: loss detection, survivor kill, checkpoint alignment,
+backoff, respawn, jax re-import, restore, and the remainder of the
+interrupted pass. That end-to-end number (not just process respawn) is
+what a preempted production fit actually pays per interruption.
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_mttr.py [--runs 3] [--smoke]
+
+Writes benchmarks/mttr_cpu.md (committed results for the CI box) unless
+--no_write. Single process, CPU backend: the measured costs are dominated
+by worker startup (python + jax import) and the replayed pass, both of
+which scale the same way on real hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+    from tdc_tpu.utils.preempt import install_preemption_handler
+
+    install_preemption_handler()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4096, 16)).astype(np.float32)
+    x[:1024] += 4.0
+
+    def batches():
+        for i in range(0, 4096, 512):
+            yield x[i:i + 512]
+
+    res = streamed_kmeans_fit(
+        batches, 8, 16, init=x[:8], max_iters=6, tol=-1.0,
+        ckpt_dir=os.environ["TDC_CKPT_DIR"], ckpt_every=1,
+        ckpt_keep_last_n=4,
+    )
+    print("FIT_DONE", int(res.n_iter), flush=True)
+""")
+
+
+def _steps(ckpt_dir: str) -> set[int]:
+    from tdc_tpu.utils.checkpoint import _all_steps  # the one step parser
+
+    return set(_all_steps(ckpt_dir))
+
+
+def one_run(tmp: str, kill_hit: int) -> dict:
+    """One supervised run with a kill injected at stream.batch hit
+    `kill_hit`; returns the MTTR decomposition."""
+    import shutil
+    import threading
+
+    from tdc_tpu.parallel.supervisor import run_gang
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    ckpt = os.path.join(tmp, "ckpt")
+    os.makedirs(ckpt)
+    worker_py = os.path.join(tmp, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TDC_FAULTS"] = f"stream.batch=kill@{kill_hit}&attempt=0"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    marks = {}
+    steps_at_kill = [set()]
+    stop = threading.Event()
+
+    def watch():
+        # Poll the checkpoint dir: t_loss = supervisor echoes the failure;
+        # t_progress = first step that did not exist at the loss.
+        while not stop.is_set():
+            if "loss" in marks and "progress" not in marks:
+                if _steps(ckpt) - steps_at_kill[0]:
+                    marks["progress"] = time.perf_counter()
+            time.sleep(0.005)
+
+    def echo(msg):
+        if "failed" in msg and "loss" not in marks:
+            marks["loss"] = time.perf_counter()
+            steps_at_kill[0] = _steps(ckpt)
+        if "resuming from" in msg:
+            marks["relaunch"] = time.perf_counter()
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    t0 = time.perf_counter()
+    res = run_gang(
+        [sys.executable, worker_py], 1, max_restarts=2,
+        ckpt_dirs=[ckpt], log_dir=os.path.join(tmp, "logs"),
+        env=env, echo=echo, backoff_base=0.0,  # measure the pipeline, not
+        # the (configurable) backoff sleep
+    )
+    stop.set()
+    t.join(timeout=1)
+    total = time.perf_counter() - t0
+    return {
+        "attempts": res.attempts,
+        "total_s": round(total, 3),
+        "detect_to_relaunch_s": round(
+            marks.get("relaunch", float("nan")) - marks["loss"], 3
+        ),
+        "mttr_s": round(
+            marks.get("progress", float("nan")) - marks["loss"], 3
+        ),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--smoke", action="store_true",
+                    help="1 run, assert recovery happened, no file write")
+    ap.add_argument("--no_write", action="store_true")
+    args = ap.parse_args(argv)
+    runs = 1 if args.smoke else args.runs
+
+    results = []
+    for i in range(runs):
+        # kill in pass 3 (8 batches/pass): steps 1-2 are on disk
+        r = one_run(f"/tmp/tdc_mttr_{i}", kill_hit=19)
+        print(json.dumps(r), flush=True)
+        assert r["attempts"] == 2, r
+        results.append(r)
+
+    mttrs = [r["mttr_s"] for r in results]
+    summary = {
+        "runs": runs,
+        "mttr_median_s": round(statistics.median(mttrs), 3),
+        "mttr_min_s": min(mttrs),
+        "mttr_max_s": max(mttrs),
+    }
+    print("MTTR_SUMMARY", json.dumps(summary))
+    if args.smoke or args.no_write:
+        print("PASS: kill -> resumed progress measured through the "
+              "supervisor recovery path")
+        return 0
+
+    out = os.path.join(REPO, "benchmarks", "mttr_cpu.md")
+    with open(out, "w") as f:
+        f.write(textwrap.dedent(f"""\
+            # MTTR micro-benchmark (kill -> resumed progress)
+
+            `benchmarks/bench_mttr.py` on the CI container (CPU backend,
+            {os.cpu_count()} cores): a supervised 1-process gang runs a
+            checkpointed streamed fit; TDC_FAULTS kills the worker
+            (SIGKILL) at a pass-3 batch boundary; MTTR is measured from
+            the supervisor observing the loss to the relaunched worker
+            writing its first NEW checkpoint step — detection, alignment,
+            respawn, jax import, restore, and the recovered pass all
+            included. Backoff is set to 0 (its contribution is exactly
+            the configured knob).
+
+            | metric | seconds |
+            |---|---|
+            | MTTR median ({runs} runs) | {summary['mttr_median_s']} |
+            | MTTR min | {summary['mttr_min_s']} |
+            | MTTR max | {summary['mttr_max_s']} |
+            | detect -> relaunch (median) | {
+                round(statistics.median(
+                    [r['detect_to_relaunch_s'] for r in results]), 3)} |
+
+            Per-run data: {json.dumps(results)}
+
+            Reading: the floor is worker startup (python + jax import,
+            ~2-4 s on this box) plus the replay of the interrupted pass;
+            loss detection itself is bounded by the supervisor's 0.25 s
+            poll. On TPU the import cost is amortized identically, so the
+            lever for production MTTR is checkpoint cadence (`ckpt_every`
+            / `ckpt_every_batches`), not supervisor overhead.
+            """))
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,17 +10,24 @@ so the demo runs anywhere):
 
 Kill any worker mid-run (kill -9 <pid>): the supervisor detects the loss,
 kills the survivors, trims the shared checkpoint to the last complete step,
-and relaunches; the fit resumes where it left off. On a TPU pod, drop the
-JAX_PLATFORMS/XLA_FLAGS overrides and run one process per host.
+and relaunches; the fit resumes where it left off. SIGTERM a worker (or the
+supervisor) instead and the gang drains GRACEFULLY: checkpoint at the next
+safe boundary, exit with the preemption code, relaunch without charging the
+restart budget. On a TPU pod, drop the JAX_PLATFORMS/XLA_FLAGS overrides
+and run one process per host.
 
 The structure to copy:
-  1. initialize_from_env() first — joins the gang from $TDC_* variables and
-     works unchanged standalone (single process, no supervisor).
+  1. install_preemption_handler() + initialize_from_env() first — the
+     handler turns preemption SIGTERM into checkpoint-and-exit-75 (install
+     on EVERY worker or none: gangs agree on the stop point collectively),
+     and initialize_from_env joins the gang from $TDC_* variables (works
+     unchanged standalone; it also re-asserts the handler over jax's own
+     C-level SIGTERM notifier).
   2. Each host streams ONLY its own rows of every global batch
      (host_shard_bounds), same local count on every host.
   3. ckpt_dir comes from $TDC_CKPT_DIR — one SHARED directory for the gang
-     (process 0 is the single writer, atomic state.npz per step; all hosts
-     restore the same step).
+     (process 0 is the single writer, atomic state.npz per step with
+     per-array CRCs; all hosts restore the same step).
 """
 
 import os
@@ -43,9 +50,11 @@ from tdc_tpu.parallel.multihost import (
     host_shard_bounds,
     initialize_from_env,
 )
+from tdc_tpu.utils.preempt import install_preemption_handler
 
 
 def main() -> int:
+    install_preemption_handler()
     pid, nproc = initialize_from_env()
 
     # Demo data: derivable on every host so no distribution step is needed.
@@ -71,6 +80,7 @@ def main() -> int:
         ckpt_dir=os.environ.get("TDC_CKPT_DIR"),
         ckpt_every=1,
         ckpt_every_batches=2,
+        ckpt_keep_last_n=3,  # retention: crash fallback needs >= 2
     )
     print(
         f"worker {pid}/{nproc}: n_iter={int(res.n_iter)} "

@@ -183,6 +183,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --ckpt_dir: also checkpoint mid-pass every N "
                         "batches (accumulator + batch cursor; resume is "
                         "bit-identical)")
+    p.add_argument("--ckpt_keep_last_n", type=int, default=None,
+                   help="with --ckpt_dir (streamed kmeans/fuzzy): retain "
+                        "only the newest N checkpoint steps (default all; "
+                        "N >= 2 keeps the corruption-fallback step)")
     # Multi-host (jax.distributed over DCN); on managed TPU pods these
     # autodetect — pass explicitly for manual clusters.
     p.add_argument("--coordinator_address", type=str, default=None)
@@ -385,6 +389,17 @@ def validate_args(parser, args):
         # mean_combine has no checkpoint support; accepting the flag would
         # silently skip checkpointing AND corrupt the computation timing.
         parser.error("--ckpt_dir is not supported with --mean_combine")
+    if args.ckpt_keep_last_n is not None:
+        # Reject rather than silently ignore (the --covariance_type rule):
+        # retention is wired through the 1-D streamed kmeans/fuzzy drivers.
+        if args.ckpt_keep_last_n < 1:
+            parser.error("--ckpt_keep_last_n must be >= 1")
+        if not args.ckpt_dir:
+            parser.error("--ckpt_keep_last_n requires --ckpt_dir")
+        if (args.minibatch or args.shard_k > 1
+                or args.method_name == "gaussianMixture"):
+            parser.error("--ckpt_keep_last_n applies to the 1-D streamed "
+                         "kmeans/fuzzy fits only")
     if not (0 <= args.reassignment_ratio <= 1):
         parser.error("--reassignment_ratio must be in [0, 1]")
     if args.reassignment_ratio != 0.01 and not args.minibatch:
@@ -836,6 +851,7 @@ def run_experiment(args) -> dict:
                     max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
                     ckpt_dir=args.ckpt_dir,
                     ckpt_every_batches=args.ckpt_every_batches,
+                    ckpt_keep_last_n=args.ckpt_keep_last_n,
                     prefetch=args.prefetch,
                     sample_weight_batches=(
                         weight_stream(rows) if weights is not None else None
@@ -869,6 +885,7 @@ def run_experiment(args) -> dict:
                 tol=args.tol, spherical=args.spherical, mesh=mesh,
                 ckpt_dir=args.ckpt_dir,
                 ckpt_every_batches=args.ckpt_every_batches,
+                ckpt_keep_last_n=args.ckpt_keep_last_n,
                 prefetch=args.prefetch,
                 sample_weight_batches=(
                     weight_stream(rows) if weights is not None else None

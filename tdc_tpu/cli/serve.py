@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log_file", type=str, default=None,
                    help="request-level JSONL event log "
                         "(utils/structlog.RunLog)")
+    p.add_argument("--drain_linger", type=float, default=5.0,
+                   help="on SIGTERM: seconds the HTTP listener keeps "
+                        "answering (503 for new work, 200 liveness) "
+                        "before closing — the LB deregistration window")
     return p
 
 
@@ -137,6 +141,26 @@ def main(argv=None) -> int:
                 flush=True,
             )
     app.start()
+
+    # SIGTERM (preemption / rolling restart): flip /readyz and reject new
+    # work IMMEDIATELY while the listener keeps answering for the LB
+    # deregistration window (begin_drain) — raising out of serve_forever
+    # right away would close the socket first and turn the promised 503s
+    # into connection-refused. serve_forever unwinds when begin_drain's
+    # linger expires; app.stop() then flushes in-flight batches and closes.
+    import signal
+
+    def _drain(signum, frame):
+        print("serve: SIGTERM — draining "
+              f"(listener up {args.drain_linger}s)", file=sys.stderr,
+              flush=True)
+        app.begin_drain(linger=args.drain_linger)
+
+    try:
+        signal.signal(signal.SIGTERM, _drain)
+    except ValueError:  # non-main thread (embedded); no signal path
+        pass
+
     print(f"serving on http://{args.host}:{args.port} "
           f"(models: {', '.join(app.registry.ids())})", flush=True)
     try:

@@ -28,7 +28,12 @@ import argparse
 import os
 import sys
 
-from tdc_tpu.parallel.supervisor import GangFailed, run_gang
+from tdc_tpu.parallel.supervisor import (
+    PREEMPTED_EXIT_CODE,
+    GangFailed,
+    GangPreempted,
+    run_gang,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -39,11 +44,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--num_processes", type=int, required=True)
     p.add_argument("--max_restarts", type=int, default=2,
-                   help="gang restarts after the first launch (default 2)")
+                   help="budget of NON-progress failure restarts (a restart "
+                        "whose checkpoint step advanced resets it; "
+                        "preemption exits never charge it)")
     p.add_argument("--heartbeat_timeout", type=float, default=None,
                    help="seconds of worker heartbeat silence treated as a "
                         "hang (off by default; the clock starts at spawn, so "
                         "allow for compile time)")
+    p.add_argument("--backoff_base", type=float, default=0.5,
+                   help="base seconds of the exponential backoff between "
+                        "failure relaunches (0 disables)")
+    p.add_argument("--backoff_max", type=float, default=30.0,
+                   help="backoff ceiling in seconds")
+    p.add_argument("--drain_grace", type=float, default=30.0,
+                   help="seconds workers get to checkpoint and exit after "
+                        "a preemption SIGTERM before being killed")
+    p.add_argument("--max_preemption_restarts", type=int, default=32,
+                   help="hard cap on budget-free preemption relaunches")
     p.add_argument("--ckpt_root", type=str, default=None,
                    help="shared checkpoint dir exported to every worker as "
                         "$TDC_CKPT_DIR (process 0 is the single writer — "
@@ -75,15 +92,25 @@ def main(argv=None) -> int:
             cmd,
             args.num_processes,
             max_restarts=args.max_restarts,
+            max_preemption_restarts=args.max_preemption_restarts,
             heartbeat_timeout=args.heartbeat_timeout,
             ckpt_dirs=ckpt_dirs,
             log_dir=args.log_dir,
+            drain_grace=args.drain_grace,
+            backoff_base=args.backoff_base,
+            backoff_max=args.backoff_max,
         )
     except GangFailed as e:
         print(f"supervise: {e}", file=sys.stderr)
         return 1
-    print(f"supervise: gang completed in {result.attempts} attempt(s); "
-          f"logs: {args.log_dir}")
+    except GangPreempted as e:
+        # Propagate the preemption contract: the scheduler that SIGTERMed
+        # us sees the same retry-later code a drained worker uses.
+        print(f"supervise: {e}", file=sys.stderr)
+        return PREEMPTED_EXIT_CODE
+    print(f"supervise: gang completed in {result.attempts} attempt(s) "
+          f"({result.preemptions} preemption(s), restart budget used "
+          f"{result.budget_used}); logs: {args.log_dir}")
     return 0
 
 

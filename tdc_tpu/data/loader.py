@@ -22,6 +22,9 @@ def load_points(data_file: str, *, mmap: bool = True):
     .npz members can't be memmapped directly; for large out-of-core runs prefer
     .npy (np.lib.format.open_memmap) or convert once with NpzStream.to_npy.
     """
+    from tdc_tpu.testing.faults import fault_point
+
+    fault_point("data.load")
     if data_file.endswith(FEATURE_MAJOR_SUFFIX):
         # A (d, N) feature-major file read as sample-major would silently
         # cluster d "points" of dimension N — garbage with status ok.

@@ -36,7 +36,10 @@ from tdc_tpu.models.kmeans import KMeansResult, resolve_init, _normalize
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult
 from tdc_tpu.parallel import mesh as mesh_lib
 from tdc_tpu.parallel import reduce as reduce_lib
+from tdc_tpu.testing.faults import fault_point
+from tdc_tpu.utils import preempt
 from tdc_tpu.utils.heartbeat import maybe_beat
+from tdc_tpu.utils.preempt import Preempted
 
 
 @partial(jax.jit, static_argnames=("spherical", "kernel", "mesh"))
@@ -202,9 +205,24 @@ def _run_pass(
     rows0: int = 0,
     save_args=None,
     crosscheck_mesh=None,
+    preempt_batch: bool = False,
+    preempt_can_save: bool = False,
 ):
     """One accumulation pass over the stream — the loop shared by the
     streamed kmeans and fuzzy fits.
+
+    Preemption (utils/preempt): with preempt_batch, a raised SIGTERM flag
+    is honored at the next batch boundary — a mid-pass checkpoint is
+    written if allowed (preempt_can_save: the caller opted into mid-pass
+    state via ckpt_every_batches — a cursor resume assumes the stream
+    replays in the same order, which per-iteration-only checkpointing
+    never requires — AND the accumulator is host-serializable, i.e. not
+    the deferred device-layout one, and this is not the final reporting
+    pass) and Preempted exits the worker with the supervisor's budget-free
+    code. Without the save, the drain still exits 75 and resume falls back
+    to the last completed-iteration checkpoint. Single-process/-host fits
+    only: a GANG must agree on the stop batch (the next collective would
+    deadlock), so gang drivers check once per pass instead.
 
     step_fn(acc, batch) -> (acc, n_rows). On a mid-pass resume (skip > 0) the
     skipped prefix is read once, its row count validated against `rows0` (the
@@ -238,10 +256,20 @@ def _run_pass(
         prefix_ok = skip == 0
         mismatch = False
         for i, batch in enumerate(_prefetched(batches(), prefetch)):
-            maybe_beat()  # also while replaying a resume prefix: reading the
-            # skipped batches is real progress, and a silent replay would trip
-            # the supervisor's hang detector and loop the gang restart
+            maybe_beat(progress=f"iter={n_iter} batch={i}")
+            # (also while replaying a resume prefix: reading the skipped
+            # batches is real progress, and a silent replay would trip the
+            # supervisor's hang detector and loop the gang restart)
+            fault_point("stream.batch")
             if i < skip:
+                if preempt_batch and preempt.requested():
+                    # Preempted while replaying a resume prefix: the
+                    # on-disk checkpoint already covers exactly this state
+                    # — exit now (no save needed) rather than replaying a
+                    # possibly-long prefix into the grace window.
+                    raise Preempted(
+                        f"preempted during resume replay at batch {i + 1}"
+                    )
                 # Weighted streams yield (x, w) pairs; rows come from x.
                 xb = batch[0] if isinstance(batch, tuple) else batch
                 skipped_rows += np.asarray(xb).shape[0]
@@ -256,12 +284,26 @@ def _run_pass(
             consumed = i + 1
             if consumed % _BACKPRESSURE_EVERY == 0:
                 jax.block_until_ready(jax.tree_util.tree_leaves(acc))
-            if (n_iter > 0 and ckpt is not None and ckpt.dir is not None
-                    and ckpt_every_batches
-                    and consumed % ckpt_every_batches == 0):
+            can_save = (n_iter > 0 and ckpt is not None
+                        and ckpt.dir is not None)
+            saved_midpass = bool(can_save and ckpt_every_batches
+                                 and consumed % ckpt_every_batches == 0)
+            if saved_midpass:
                 c, shift, history = save_args
                 ckpt.save(n_iter - 1, c, shift, history,
                           batch_cursor=consumed, acc=acc, rows_seen=rows)
+            if preempt_batch and preempt.requested():
+                # Drain save, unless the periodic save just wrote this
+                # exact (cursor, acc) state — a second full serialization
+                # inside the grace window buys nothing.
+                if preempt_can_save and can_save and not saved_midpass:
+                    c, shift, history = save_args
+                    ckpt.save(n_iter - 1, c, shift, history,
+                              batch_cursor=consumed, acc=acc, rows_seen=rows)
+                raise Preempted(
+                    f"preempted at batch boundary {consumed} of iteration "
+                    f"{n_iter}"
+                )
         if not mismatch and not prefix_ok:
             # Stream ended inside the skip prefix: fewer batches than the
             # cursor — layout definitely changed.
@@ -682,12 +724,14 @@ class _StreamCheckpointer:
     """
 
     def __init__(self, ckpt_dir, k, d, params: dict, acc_map: dict, key,
-                 gang: bool = False):
+                 gang: bool = False, keep: int | None = None):
         self.dir = ckpt_dir
         self.k, self.d = k, d
         self.params = params
         self.acc_map = acc_map
         self.key = key
+        # Retention: keep only the newest `keep` step dirs (None = all).
+        self.keep = keep
         # True only when the FIT spans processes (mesh covers >1 process):
         # then the gang shares one dir via the single-writer protocol.
         # Host-local fits inside a jax.distributed runtime checkpoint
@@ -784,6 +828,7 @@ class _StreamCheckpointer:
             # stays monotone in completed iterations.
             step=n_iter,
             gang=self.gang,
+            keep_last_n=self.keep,
         )
 
 
@@ -801,6 +846,7 @@ def streamed_kmeans_fit(
     ckpt_dir: str | None = None,
     ckpt_every: int = 5,
     ckpt_every_batches: int | None = None,
+    ckpt_keep_last_n: int | None = None,
     prefetch: int = 0,
     sample_weight_batches: Callable[[], Iterable] | None = None,
     kernel: str = "xla",
@@ -825,6 +871,16 @@ def streamed_kmeans_fit(
         so resume replays only the remaining batches of the interrupted pass
         (bit-identical to an uninterrupted run: f32 accumulation order is
         preserved).
+      ckpt_keep_last_n: retain only the newest N checkpoint steps (None
+        keeps all). N >= 2 recommended: crash recovery falls back one step
+        when the newest is truncated or fails its CRC.
+
+    Preemption (utils/preempt.install_preemption_handler): once the handler
+    is installed, a SIGTERM makes this fit checkpoint at the next batch
+    boundary (single-host; multi-process gangs agree once per pass — the
+    gang must stop on the same batch count) and raise Preempted, exiting
+    the worker with the budget-free preemption code the gang supervisor
+    refunds.
       prefetch: background-thread batch prefetch depth (0 disables) —
         overlaps host staging with device compute.
       sample_weight_batches: optional zero-arg callable returning a fresh
@@ -898,6 +954,7 @@ def streamed_kmeans_fit(
         acc_map={"acc_sums": "sums", "acc_counts": "counts", "acc_sse": "sse"},
         key=key,
         gang=mesh is not None and _mesh_layout(mesh)[0] > 1,
+        keep=ckpt_keep_last_n,
     )
     state = ckpt.restore(SufficientStats, mesh)
     if state.centroids is not None:
@@ -961,6 +1018,8 @@ def streamed_kmeans_fit(
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
+            preempt_batch=not ckpt.gang,
+            preempt_can_save=bool(ckpt_every_batches) and not deferred,
         )
         if not deferred:
             return acc
@@ -1004,9 +1063,17 @@ def streamed_kmeans_fit(
         history.append((float(acc.sse) if sync else acc.sse, shift))
         c = new_c
         done = sync and tol >= 0 and shift <= tol
-        if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
-                                     or n_iter == max_iters):
+        saved_now = ckpt_dir is not None and (done or n_iter % ckpt_every == 0
+                                              or n_iter == max_iters)
+        if saved_now:
             ckpt.save(n_iter, c, shift, history)
+        # Gang-agreed preemption point: every process must take this branch
+        # identically (sync_requested is a collective when gang) — a lone
+        # worker stopping here would deadlock the others' next pass.
+        if preempt.installed() and preempt.sync_requested(gang=ckpt.gang):
+            if ckpt_dir is not None and not saved_now:
+                ckpt.save(n_iter, c, shift, history)
+            raise Preempted(f"preempted after iteration {n_iter}")
         if done:
             break
     shift = float(shift)  # one deferred fetch on the async path
@@ -1171,15 +1238,17 @@ def streamed_fuzzy_fit(
     ckpt_dir: str | None = None,
     ckpt_every: int = 5,
     ckpt_every_batches: int | None = None,
+    ckpt_keep_last_n: int | None = None,
     prefetch: int = 0,
     sample_weight_batches: Callable[[], Iterable] | None = None,
     kernel: str = "xla",
     reduce="per_batch",
 ) -> FuzzyCMeansResult:
     """Exact streamed Fuzzy C-Means — same contract as streamed_kmeans_fit,
-    including checkpoint/resume (per-iteration and mid-pass), streamed
-    sample weights, the per-iteration (objective, shift) history the
-    reference never computed, kernel='pallas' per-batch stats (raises
+    including checkpoint/resume (per-iteration and mid-pass, with the
+    ckpt_keep_last_n retention knob and graceful-preemption drain),
+    streamed sample weights, the per-iteration (objective, shift) history
+    the reference never computed, kernel='pallas' per-batch stats (raises
     with sample_weight_batches — no weighted Pallas kernel), and the
     `reduce=` strategy knob ("per_batch" / "per_pass" /
     "per_pass:bf16|int8" — see streamed_kmeans_fit and
@@ -1234,6 +1303,7 @@ def streamed_fuzzy_fit(
         },
         key=key,
         gang=mesh is not None and _mesh_layout(mesh)[0] > 1,
+        keep=ckpt_keep_last_n,
     )
     state = ckpt.restore(FuzzyStats, mesh)
     if state.centroids is not None:
@@ -1296,6 +1366,8 @@ def streamed_fuzzy_fit(
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
+            preempt_batch=not ckpt.gang,
+            preempt_can_save=bool(ckpt_every_batches) and not deferred,
         )
         if not deferred:
             return acc
@@ -1332,9 +1404,15 @@ def streamed_fuzzy_fit(
                         shift))
         c = new_c
         done = sync and tol >= 0 and shift <= tol
-        if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
-                                     or n_iter == max_iters):
+        saved_now = ckpt_dir is not None and (done or n_iter % ckpt_every == 0
+                                              or n_iter == max_iters)
+        if saved_now:
             ckpt.save(n_iter, c, shift, history)
+        # Gang-agreed preemption point (see streamed_kmeans_fit).
+        if preempt.installed() and preempt.sync_requested(gang=ckpt.gang):
+            if ckpt_dir is not None and not saved_now:
+                ckpt.save(n_iter, c, shift, history)
+            raise Preempted(f"preempted after iteration {n_iter}")
         if done:
             break
     shift = float(shift)  # one deferred fetch on the async path

@@ -41,10 +41,22 @@ def initialize_distributed(
             num_processes=num_processes,
             process_id=process_id,
         )
+        _reassert_preemption_handler()
     elif coordinator_address is not None:
         _enable_cpu_collectives()
         jax.distributed.initialize(coordinator_address=coordinator_address)
+        _reassert_preemption_handler()
     return jax.process_index(), jax.process_count()
+
+
+def _reassert_preemption_handler() -> None:
+    """jax.distributed.initialize registers TSL's preemption notifier as a
+    C-level SIGTERM handler, silently displacing a graceful-drain handler
+    (utils/preempt) installed earlier — the worker would then die to the
+    default action instead of checkpointing. Put ours back on top."""
+    from tdc_tpu.utils.preempt import reinstall_if_installed
+
+    reinstall_if_installed()
 
 
 def _enable_cpu_collectives() -> None:

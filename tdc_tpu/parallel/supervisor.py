@@ -1,4 +1,5 @@
-"""Elastic gang supervision: worker-loss detection + restart-from-checkpoint.
+"""Elastic gang supervision: worker-loss detection + restart-from-checkpoint
++ preemption-aware failure domains.
 
 The reference's entire failure story is per-experiment subprocess isolation
 plus an OOM retry (SURVEY.md §5; scripts/new_experiment.py:59-64,
@@ -17,6 +18,28 @@ calls for: a gang of `jax.distributed` worker processes is supervised, and
 - the gang is relaunched on a fresh coordinator port; workers resume from
   the aligned checkpoint (models/streaming.py persists centroids, iteration,
   and optionally the mid-pass accumulator).
+
+Failure-domain semantics (gang-scheduled SPMD makes the QUALITY of each
+recovery the whole robustness budget — Mesh-TensorFlow, arxiv 1811.02084):
+
+- **Preemption is not a crash.** A worker that exits with
+  PREEMPTED_EXIT_CODE (75 — utils/preempt.py: SIGTERM caught, checkpoint
+  written at a safe boundary) marks the attempt *preempted*: the gang is
+  relaunched immediately and the restart budget is NOT charged. A SIGTERM
+  delivered to the supervisor itself is forwarded to the whole gang, the
+  workers are given `drain_grace` seconds to checkpoint and exit, and
+  GangPreempted is raised (exit the job; the scheduler will rerun it).
+- **Only non-progress restarts burn budget.** Before charging a failure
+  against `max_restarts`, the supervisor compares the aligned common
+  checkpoint step with the one recorded at the previous relaunch: if the
+  step advanced, the workload is making progress and the attempt counter
+  resets — a workload that crashes every N hours runs forever, while a
+  crash-loop (same step every time) exhausts the budget fast.
+- **Backoff between failure relaunches.** Exponential with jitter
+  (`backoff_base * 2^(consecutive non-progress failures)`, capped at
+  `backoff_max`) so a crash-looping gang cannot hammer the coordinator /
+  filesystem back-to-back. Preemption relaunches skip the backoff — the
+  replacement capacity is already allocated.
 
 Checkpoint-directory semantics: a gang shares ONE checkpoint directory —
 process 0 is the single writer (utils/checkpoint.py writes an atomic
@@ -39,16 +62,36 @@ optionally TDC_CKPT_DIR / TDC_HEARTBEAT_FILE) and should call
 from __future__ import annotations
 
 import os
+import random
 import shutil
+import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+# The single definition lives with the worker-side drain machinery; any
+# tdc_tpu.* import already runs the package __init__ (jax included), so
+# duplicating the value here would buy no import savings — only the risk
+# of the refund check silently desyncing from the workers' exit code.
+from tdc_tpu.utils.preempt import PREEMPTED_EXIT_CODE
 
 
 class GangFailed(RuntimeError):
     """All restart attempts exhausted; carries per-worker log tails."""
+
+
+class GangPreempted(RuntimeError):
+    """The SUPERVISOR received SIGTERM: the gang was drained (forwarded
+    SIGTERM, waited for checkpoint-and-exit) and the job should stop —
+    the external scheduler owns the relaunch. `.step` is the aligned
+    checkpoint step the next run will resume from (None = none)."""
+
+    def __init__(self, message: str, step: int | None = None):
+        super().__init__(message)
+        self.step = step
 
 
 @dataclass
@@ -56,6 +99,18 @@ class GangResult:
     attempts: int  # total launches (1 = no restart was needed)
     returncodes: list[int]  # final attempt's per-worker exit codes (all 0)
     log_paths: list[str]  # final attempt's per-worker stdout+stderr logs
+    preemptions: int = 0  # launches that ended in a preemption exit (75)
+    budget_used: int = 0  # failure restarts charged against max_restarts
+    restart_delays: list[float] = field(default_factory=list)  # backoffs slept
+
+
+def _default_echo(msg: str) -> None:
+    # Routed through utils/structlog so recovery events are one JSON line
+    # each, machine-parseable next to the serve request log (lazy import:
+    # only the default path pays for the package import).
+    from tdc_tpu.utils.structlog import emit
+
+    emit("supervisor", msg=msg)
 
 
 def free_port() -> int:
@@ -66,18 +121,20 @@ def free_port() -> int:
 
 
 def _checkpoint_steps(ckpt_dir: str) -> set[int]:
-    # Deliberately duplicates utils/checkpoint._all_steps' step_<N> parsing:
-    # the supervisor stays stdlib-only (importing tdc_tpu.utils.checkpoint
-    # would pull jax into the supervising process). Keep the two in sync if
-    # the on-disk step layout ever changes.
-    if not os.path.isdir(ckpt_dir):
-        return set()
-    steps = set()
-    for name in os.listdir(ckpt_dir):
-        parts = name.split("_")
-        if name.startswith("step_") and len(parts) == 2 and parts[1].isdigit():
-            steps.add(int(parts[1]))
-    return steps
+    # One parser for the on-disk step_<N> layout: utils/checkpoint owns it
+    # (lazy import keeps module import light; the process has the package
+    # loaded anyway).
+    from tdc_tpu.utils.checkpoint import _all_steps
+
+    return set(_all_steps(ckpt_dir))
+
+
+def _common_step(ckpt_dirs: list[str]) -> int | None:
+    """Latest step present in ALL dirs (read-only; align_checkpoints is the
+    trimming counterpart). The supervisor's progress signal."""
+    per_dir = [_checkpoint_steps(d) for d in ckpt_dirs]
+    common = set.intersection(*per_dir) if per_dir else set()
+    return max(common) if common else None
 
 
 def align_checkpoints(ckpt_dirs: list[str], log=lambda *_: None) -> int | None:
@@ -122,25 +179,46 @@ def _kill(procs, grace: float) -> None:
                 p.wait()
 
 
+def _prune_heartbeats(hb_files) -> None:
+    """A completed attempt's heartbeat files are dead weight — without this
+    a long-lived elastic job accumulates one per worker per attempt in
+    log_dir, unbounded."""
+    for hb in hb_files:
+        if hb:
+            try:
+                os.remove(hb)
+            except OSError:
+                pass
+
+
 def run_gang(
     cmd: list[str],
     num_processes: int,
     *,
     max_restarts: int = 2,
+    max_preemption_restarts: int = 32,
     heartbeat_timeout: float | None = None,
     ckpt_dirs: list[str] | None = None,
     log_dir: str,
     env: dict | None = None,
     poll_interval: float = 0.25,
     grace: float = 5.0,
-    echo=lambda msg: print(msg, file=sys.stderr, flush=True),
+    drain_grace: float = 30.0,
+    backoff_base: float = 0.5,
+    backoff_max: float = 30.0,
+    echo=_default_echo,
 ) -> GangResult:
     """Run `cmd` as a gang of `num_processes` workers; restart on failure.
 
     Args:
       cmd: the worker command line, identical for every worker — workers read
         their coordinates from the TDC_* environment.
-      max_restarts: restarts after the first launch (total attempts = 1 + this).
+      max_restarts: budget of NON-PROGRESS failure restarts (crash-loop
+        detection): a relaunch whose aligned checkpoint step advanced past
+        the previous relaunch's resets the counter, and preemption exits
+        (PREEMPTED_EXIT_CODE) never charge it.
+      max_preemption_restarts: hard cap on free preemption relaunches — a
+        worker that (buggily) always exits 75 must not loop forever.
       heartbeat_timeout: if set, a worker whose TDC_HEARTBEAT_FILE goes
         untouched for this many seconds is treated as hung (the clock starts
         at spawn, so slow startup counts against it — size accordingly, e.g.
@@ -151,8 +229,15 @@ def run_gang(
         docstring); otherwise len must equal num_processes. Without it,
         restarts are from scratch.
       log_dir: per-attempt, per-worker stdout+stderr capture files.
+      drain_grace: on supervisor SIGTERM (or a partial preemption — some
+        workers exited 75 while peers still run), how long the remaining
+        workers get to checkpoint and exit before being killed.
+      backoff_base / backoff_max: exponential-backoff-with-jitter bounds
+        between FAILURE relaunches (base * 2^failures, capped; preemption
+        relaunches are immediate). backoff_base=0 disables (tests).
 
-    Returns GangResult on success; raises GangFailed when attempts run out.
+    Returns GangResult on success; raises GangFailed when the restart budget
+    runs out, GangPreempted when the supervisor itself was told to drain.
     """
     if ckpt_dirs is not None and len(ckpt_dirs) not in (1, num_processes):
         raise ValueError(
@@ -169,101 +254,238 @@ def run_gang(
     os.makedirs(log_dir, exist_ok=True)
     base_env = dict(os.environ if env is None else env)
 
-    for attempt in range(max_restarts + 1):
-        if attempt > 0 and ckpt_dirs is not None:
-            step = align_checkpoints(ckpt_dirs, log=echo)
-            echo(f"supervisor: attempt {attempt + 1}, resuming from "
-                 f"{'scratch' if step is None else f'common step {step}'}")
-        coordinator = f"127.0.0.1:{free_port()}"
-        procs, logs, hb_files, log_paths = [], [], [], []
-        failed_why = None
+    # Supervisor-level SIGTERM: forward to the gang and drain. Installed
+    # only on the main thread (signal.signal's requirement); elsewhere the
+    # supervisor simply has no drain path of its own.
+    sigterm_box: list[float] = []
+    old_handler = None
+    handler_installed = False
+    if threading.current_thread() is threading.main_thread():
         try:
-            # Spawn inside the try so a mid-loop Popen/open failure (fd or
-            # memory exhaustion) still kills the workers already started —
-            # they would otherwise block forever in the coordinator
-            # handshake waiting for peers that never came up.
-            for pid in range(num_processes):
-                worker_env = dict(base_env)
-                worker_env.update(
-                    TDC_PROCESS_ID=str(pid),
-                    TDC_NUM_PROCESSES=str(num_processes),
-                    TDC_COORDINATOR=coordinator,
-                    TDC_ATTEMPT=str(attempt),
-                )
-                hb = None
-                if heartbeat_timeout is not None:
-                    hb = os.path.join(log_dir, f"hb_a{attempt}_p{pid}")
-                    worker_env["TDC_HEARTBEAT_FILE"] = hb
-                hb_files.append(hb)
-                if ckpt_dirs is not None:
-                    worker_env["TDC_CKPT_DIR"] = ckpt_dirs[pid]
-                log_path = os.path.join(log_dir,
-                                        f"worker_a{attempt}_p{pid}.log")
-                log_paths.append(log_path)
-                logf = open(log_path, "w")
-                logs.append(logf)
-                procs.append(
-                    subprocess.Popen(cmd, env=worker_env, stdout=logf,
-                                     stderr=subprocess.STDOUT)
-                )
-            # Wall clock, not monotonic: heartbeat staleness compares against
-            # file mtimes, which are epoch seconds.
-            start = time.time()
-            while True:
-                codes = [p.poll() for p in procs]
-                bad = [(i, c) for i, c in enumerate(codes)
-                       if c is not None and c != 0]
-                if bad:
-                    failed_why = ", ".join(
-                        f"worker {i} exited {c}" for i, c in bad)
-                    break
-                if all(c == 0 for c in codes):
-                    for f in logs:
-                        f.close()
-                    return GangResult(
-                        attempts=attempt + 1,
-                        returncodes=[int(c) for c in codes],
-                        log_paths=log_paths,
-                    )
-                if heartbeat_timeout is not None:
-                    now = time.time()
-                    for i, (hb, c) in enumerate(zip(hb_files, codes)):
-                        if c is not None:
-                            continue  # already exited 0; not hung
-                        try:
-                            last = os.path.getmtime(hb)
-                        except OSError:
-                            last = start
-                        if now - max(last, start) > heartbeat_timeout:
-                            failed_why = (f"worker {i} heartbeat silent "
-                                          f"> {heartbeat_timeout}s")
-                            break
-                    if failed_why:
-                        break
-                time.sleep(poll_interval)
-        finally:
-            _kill(procs, grace)
-            for f in logs:
-                f.close()
-        echo(f"supervisor: gang attempt {attempt + 1} failed ({failed_why})")
-        if attempt == max_restarts:
-            tails = []
-            for i, path in enumerate(log_paths):
-                try:
-                    with open(path) as f:
-                        tails.append(f"--- worker {i} ---\n{f.read()[-2000:]}")
-                except OSError:
-                    pass
-            raise GangFailed(
-                f"gang failed after {max_restarts + 1} attempts "
-                f"(last: {failed_why})\n" + "\n".join(tails)
+            old_handler = signal.signal(
+                signal.SIGTERM, lambda *_: sigterm_box.append(time.time())
             )
-    raise AssertionError("unreachable")
+            handler_installed = True
+        except (ValueError, OSError):  # exotic embeddings
+            pass
+
+    from tdc_tpu.testing.faults import fault_point
+
+    attempt = 0  # launch index: TDC_ATTEMPT and log-file naming
+    budget_used = 0
+    preemptions = 0
+    restart_delays: list[float] = []
+    last_step: int | None = None  # aligned step at the previous relaunch
+    try:
+        while True:
+            if attempt > 0 and ckpt_dirs is not None:
+                step = align_checkpoints(ckpt_dirs, log=echo)
+                echo(f"supervisor: attempt {attempt + 1}, resuming from "
+                     f"{'scratch' if step is None else f'common step {step}'}")
+                last_step = step if step is not None else last_step
+            coordinator = f"127.0.0.1:{free_port()}"
+            procs, logs, hb_files, log_paths = [], [], [], []
+            failed_why = None
+            preempted_attempt = False
+            drain_deadline = None
+            forwarded = False
+            try:
+                # Spawn inside the try so a mid-loop Popen/open failure (fd or
+                # memory exhaustion) still kills the workers already started —
+                # they would otherwise block forever in the coordinator
+                # handshake waiting for peers that never came up.
+                for pid in range(num_processes):
+                    worker_env = dict(base_env)
+                    worker_env.update(
+                        TDC_PROCESS_ID=str(pid),
+                        TDC_NUM_PROCESSES=str(num_processes),
+                        TDC_COORDINATOR=coordinator,
+                        TDC_ATTEMPT=str(attempt),
+                    )
+                    hb = None
+                    if heartbeat_timeout is not None:
+                        hb = os.path.join(log_dir, f"hb_a{attempt}_p{pid}")
+                        worker_env["TDC_HEARTBEAT_FILE"] = hb
+                    hb_files.append(hb)
+                    if ckpt_dirs is not None:
+                        worker_env["TDC_CKPT_DIR"] = ckpt_dirs[pid]
+                    log_path = os.path.join(log_dir,
+                                            f"worker_a{attempt}_p{pid}.log")
+                    log_paths.append(log_path)
+                    logf = open(log_path, "w")
+                    logs.append(logf)
+                    fault_point("supervisor.spawn")
+                    procs.append(
+                        subprocess.Popen(cmd, env=worker_env, stdout=logf,
+                                         stderr=subprocess.STDOUT)
+                    )
+                # Wall clock, not monotonic: heartbeat staleness compares
+                # against file mtimes, which are epoch seconds.
+                start = time.time()
+                while True:
+                    if sigterm_box and not forwarded:
+                        echo("supervisor: SIGTERM received — forwarding to "
+                             f"the gang and draining (grace {drain_grace}s)")
+                        for p in procs:
+                            if p.poll() is None:
+                                p.terminate()
+                        forwarded = True
+                        drain_deadline = time.monotonic() + drain_grace
+                    codes = [p.poll() for p in procs]
+                    bad = [(i, c) for i, c in enumerate(codes)
+                           if c is not None and c not in (0, PREEMPTED_EXIT_CODE)]
+                    if bad:
+                        failed_why = ", ".join(
+                            f"worker {i} exited {c}" for i, c in bad)
+                        break
+                    preempted = [i for i, c in enumerate(codes)
+                                 if c == PREEMPTED_EXIT_CODE]
+                    if preempted and drain_deadline is None:
+                        # Some worker(s) took a preemption exit: peers are
+                        # draining too (the drivers agree per pass) — give
+                        # them the grace window instead of killing them
+                        # mid-checkpoint.
+                        drain_deadline = time.monotonic() + drain_grace
+                    if all(c is not None for c in codes):
+                        if all(c == 0 for c in codes):
+                            # Completed — even when a SIGTERM was
+                            # forwarded mid-final-pass: the work is done;
+                            # returning success beats telling the
+                            # scheduler to retry a finished job. (Log
+                            # close + heartbeat prune happen in the
+                            # finally on the way out.)
+                            return GangResult(
+                                attempts=attempt + 1,
+                                returncodes=[int(c) for c in codes],
+                                log_paths=log_paths,
+                                preemptions=preemptions,
+                                budget_used=budget_used,
+                                restart_delays=restart_delays,
+                            )
+                        # remaining codes are 75s (+0s): a clean drain
+                        preempted_attempt = True
+                        break
+                    if drain_deadline is not None:
+                        if time.monotonic() > drain_deadline:
+                            # NOT a clean preemption: worker(s) hung
+                            # through the grace window. Charge the budget
+                            # (else a deterministic drain-wedge loops
+                            # max_preemption_restarts times for free);
+                            # a supervisor-SIGTERM drain still raises
+                            # GangPreempted below regardless.
+                            failed_why = ("drain grace expired (worker(s) "
+                                          "hung during preemption drain)")
+                            break
+                    elif heartbeat_timeout is not None:
+                        now = time.time()
+                        for i, (hb, c) in enumerate(zip(hb_files, codes)):
+                            if c is not None:
+                                continue  # already exited 0; not hung
+                            try:
+                                last = os.path.getmtime(hb)
+                            except OSError:
+                                last = start
+                            if now - max(last, start) > heartbeat_timeout:
+                                failed_why = (f"worker {i} heartbeat silent "
+                                              f"> {heartbeat_timeout}s")
+                                break
+                        if failed_why:
+                            break
+                    time.sleep(poll_interval)
+            finally:
+                _kill(procs, grace)
+                for f in logs:
+                    f.close()
+                _prune_heartbeats(hb_files)
+
+            if forwarded:
+                step = None
+                if ckpt_dirs is not None:
+                    step = align_checkpoints(ckpt_dirs, log=echo)
+                echo("supervisor: gang drained after SIGTERM"
+                     + ("" if step is None else f"; resume step {step}"))
+                raise GangPreempted(
+                    f"gang drained after supervisor SIGTERM (attempt "
+                    f"{attempt + 1}); resume from "
+                    f"{'scratch' if step is None else f'step {step}'}",
+                    step=step,
+                )
+
+            if preempted_attempt:
+                preemptions += 1
+                if preemptions > max_preemption_restarts:
+                    raise GangFailed(
+                        f"gang preempted {preemptions} times "
+                        f"(max_preemption_restarts={max_preemption_restarts})"
+                        " — refusing to relaunch forever"
+                    )
+                echo(f"supervisor: gang attempt {attempt + 1} preempted — "
+                     "relaunching without charging the restart budget")
+                attempt += 1
+                continue
+
+            echo(f"supervisor: gang attempt {attempt + 1} failed ({failed_why})")
+            # Progress-aware budget: a failure AFTER the checkpoint advanced
+            # is a workload that recovers — reset the crash-loop counter.
+            if ckpt_dirs is not None:
+                cur = _common_step(ckpt_dirs)
+                if (cur is not None and last_step is not None
+                        and cur > last_step and budget_used):
+                    echo(f"supervisor: progress since last restart (step "
+                         f"{last_step} -> {cur}) — resetting restart budget")
+                    budget_used = 0
+            budget_used += 1
+            if budget_used > max_restarts:
+                tails = []
+                for i, path in enumerate(log_paths):
+                    try:
+                        with open(path) as f:
+                            tails.append(
+                                f"--- worker {i} (attempt {attempt + 1}) "
+                                f"---\n{f.read()[-2000:]}"
+                            )
+                    except OSError:
+                        pass
+                raise GangFailed(
+                    f"gang failed on attempt {attempt + 1} with the restart "
+                    f"budget exhausted ({budget_used - 1}/{max_restarts} "
+                    "non-progress restarts already used and another "
+                    f"failure occurred; last: {failed_why})\n"
+                    + "\n".join(tails)
+                )
+            if backoff_base > 0:
+                delay = min(backoff_max,
+                            backoff_base * 2 ** (budget_used - 1))
+                delay *= random.uniform(0.5, 1.5)  # jitter: desync relaunches
+                restart_delays.append(delay)
+                echo(f"supervisor: backing off {delay:.2f}s before "
+                     f"relaunch (failure {budget_used}/{max_restarts + 1})")
+                deadline = time.monotonic() + delay
+                while time.monotonic() < deadline:
+                    if sigterm_box:
+                        raise GangPreempted(
+                            "supervisor SIGTERM during restart backoff",
+                            step=_common_step(ckpt_dirs) if ckpt_dirs else None,
+                        )
+                    time.sleep(min(poll_interval,
+                                   max(deadline - time.monotonic(), 0.01)))
+            attempt += 1
+    finally:
+        if handler_installed:
+            # getsignal-style None means the previous handler was set at
+            # the C level (e.g. TSL's notifier); signal.signal(sig, None)
+            # raises TypeError — fall back to the default disposition.
+            signal.signal(
+                signal.SIGTERM,
+                old_handler if old_handler is not None else signal.SIG_DFL,
+            )
 
 
 __all__ = [
     "GangFailed",
+    "GangPreempted",
     "GangResult",
+    "PREEMPTED_EXIT_CODE",
     "align_checkpoints",
     "free_port",
     "run_gang",

@@ -27,10 +27,12 @@ import numpy as np
 
 from tdc_tpu.serve.engine import PredictEngine
 from tdc_tpu.serve.registry import ModelRegistry
+from tdc_tpu.testing.faults import fault_point
 
 
 class Overloaded(Exception):
-    """The pending-request queue is full; retry later (HTTP 503)."""
+    """The pending-request queue is full (or the server is draining);
+    retry later / elsewhere (HTTP 503)."""
 
 
 @dataclass
@@ -81,6 +83,8 @@ class MicroBatcher:
         self._pending: dict[tuple, collections.deque[_Request]] = {}
         self._arrival = asyncio.Event()
         self._queued_rows = 0
+        self._in_flight = 0  # batches currently on device (drain watches it)
+        self.draining = False  # reject new work; let queued work finish
         self._dispatcher: asyncio.Task | None = None
         self.stats = {
             "requests": 0,
@@ -102,6 +106,9 @@ class MicroBatcher:
     ) -> tuple[np.ndarray, object]:
         """submit() plus the ModelEntry the request resolved — the version
         the caller should report alongside the result."""
+        if self.draining:
+            self.stats["rejected"] += 1
+            raise Overloaded("server draining; not accepting new work")
         x = np.asarray(x, np.float32)
         if x.ndim == 1:
             x = x[None, :]
@@ -143,6 +150,19 @@ class MicroBatcher:
             self._dispatcher = asyncio.get_running_loop().create_task(
                 self._run(), name="tdc-serve-dispatcher"
             )
+
+    async def drain(self, timeout: float = 10.0) -> bool:
+        """Graceful-shutdown flush: stop admitting (sets `draining`), then
+        wait until every queued request has been dispatched AND every
+        in-flight device batch has delivered its results. Returns True
+        when fully drained, False on timeout (close() will then fail the
+        stragglers with Overloaded — explicit, not stranded)."""
+        self.draining = True
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while (self._pending or self._in_flight) and loop.time() < deadline:
+            await asyncio.sleep(0.01)
+        return not self._pending and not self._in_flight
 
     async def close(self) -> None:
         if self._dispatcher is not None:
@@ -207,7 +227,9 @@ class MicroBatcher:
             rows = sum(r.x.shape[0] for r in batch)
             self._queued_rows -= rows
             head = batch[0]
+            self._in_flight += 1
             try:
+                fault_point("serve.dispatch")
                 entry = head.entry
                 x = (
                     head.x if len(batch) == 1
@@ -219,11 +241,24 @@ class MicroBatcher:
                 out, meta = await loop.run_in_executor(
                     None, self.engine.run, entry, head.method, x
                 )
+            except asyncio.CancelledError:
+                # close() cancelled the dispatcher mid-dispatch (drain
+                # timed out): the popped batch is in neither _pending nor
+                # done — fail its futures explicitly or their HTTP threads
+                # block the full request_timeout.
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(
+                            Overloaded("server shutting down")
+                        )
+                raise
             except Exception as e:
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(e)
                 continue
+            finally:
+                self._in_flight -= 1
             self.stats["batches"] += 1
             offset = 0
             for r in batch:

@@ -6,8 +6,18 @@ Endpoints:
   POST /predict_proba  soft responsibilities / fuzzy memberships
   POST /transform      point-to-centroid distance matrix (kmeans/fuzzy)
   GET  /models         registry listing (id, type, k, d, version, ...)
-  GET  /healthz        liveness + device inventory
-  GET  /metrics        Prometheus text format
+  GET  /healthz        LIVENESS: 200 while the process is up (also while
+                       draining — a drain is not a reason to kill the pod)
+  GET  /readyz         READINESS: 200 only when serving can succeed —
+                       loop started, >=1 model loaded, not draining. This
+                       is the endpoint load balancers should gate on.
+  GET  /metrics        Prometheus text format (incl. tdc_serve_draining)
+
+Graceful shutdown (`stop()`, wired to SIGTERM by cli/serve): flip /readyz
+to 503 and mark draining -> new predict work is rejected 503 -> in-flight
+micro-batches flush and their HTTP responses go out -> HTTP socket and
+loop close. An LB that honors /readyz sees zero failed requests during a
+rolling restart/preemption.
 
 Every served request emits one utils/structlog JSONL event (queue wait,
 coalesced batch size, device ms, e2e ms) — the repo's first request-level
@@ -80,6 +90,7 @@ class ServeApp:
         self.poll_interval = float(poll_interval)
         self.request_timeout = float(request_timeout)
         self.started_at = time.time()
+        self._draining = False
         self._loop: asyncio.AbstractEventLoop | None = None
         self._loop_thread: threading.Thread | None = None
         self._poll_task = None
@@ -95,6 +106,8 @@ class ServeApp:
         """Start the batching loop thread and the hot-reload poller."""
         if self._loop is not None:
             return
+        self._draining = False  # a restarted app serves again
+        self.batcher.draining = False
         loop = asyncio.new_event_loop()
         self._loop = loop
         self._loop_thread = threading.Thread(
@@ -106,19 +119,61 @@ class ServeApp:
                 self._poll_models(), loop
             )
 
-    def stop(self) -> None:
+    def begin_drain(self, linger: float = 5.0) -> None:
+        """Start a drain WITHOUT closing the HTTP listener: /readyz flips
+        to 503 and new predict work is rejected immediately, but the
+        socket keeps answering for `linger` seconds (the LB
+        deregistration window — closing the listener first would turn
+        would-be 503s into connection-refused), then serve_forever is
+        unblocked so the caller's stop() can finish the flush-and-close.
+        This is the SIGTERM entry point (cli/serve); stop() alone is
+        correct when no LB needs the window."""
+        self._draining = True
+        self.batcher.draining = True
+        httpd = self._httpd
+
+        def _close():
+            time.sleep(linger)
+            if httpd is not None:
+                httpd.shutdown()
+
+        threading.Thread(
+            target=_close, name="tdc-serve-drain", daemon=True
+        ).start()
+
+    def stop(self, drain_timeout: float = 10.0) -> None:
+        """Graceful drain-then-close (idempotent).
+
+        Order matters: readiness flips FIRST (LBs stop routing here), new
+        predict work 503s, the in-flight micro-batches flush so their HTTP
+        responses still go out over the live socket, and only then do the
+        HTTP server and the loop come down.
+        """
+        self._draining = True
+        self.batcher.draining = True
+        loop, self._loop = self._loop, None
+        if loop is not None:
+            if self._poll_task is not None:
+                self._poll_task.cancel()
+                self._poll_task = None
+            try:
+                drained = asyncio.run_coroutine_threadsafe(
+                    self.batcher.drain(drain_timeout), loop
+                ).result(timeout=drain_timeout + 5)
+            except Exception:
+                drained = False
+            if self.log is not None:
+                self.log.event("drain", complete=bool(drained))
+            # close() fails whatever (if anything) survived the drain
+            # window with an explicit Overloaded instead of stranding it.
+            asyncio.run_coroutine_threadsafe(
+                self.batcher.close(), loop
+            ).result(timeout=5)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
-        loop, self._loop = self._loop, None
         if loop is None:
             return
-        if self._poll_task is not None:
-            self._poll_task.cancel()
-            self._poll_task = None
-        asyncio.run_coroutine_threadsafe(
-            self.batcher.close(), loop
-        ).result(timeout=5)
         loop.call_soon_threadsafe(loop.stop)
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=5)
@@ -150,6 +205,9 @@ class ServeApp:
         return status, body
 
     def _request_inner(self, endpoint: str, payload: dict) -> tuple[int, dict]:
+        if self._draining:
+            return 503, {"error": "draining", "detail":
+                         "server is shutting down; retry another replica"}
         if self._loop is None:
             return 503, {"error": "server not started"}
         if endpoint not in _PREDICT_ENDPOINTS:
@@ -203,15 +261,33 @@ class ServeApp:
                 {"models": self.registry.list_models()}
             )
         if path == "/healthz":
+            # Liveness: 200 as long as the process can answer — INCLUDING
+            # while draining (restarting a pod because it is draining would
+            # turn every rolling restart into a crash loop).
             import jax
 
             self._counters[("healthz", 200)] += 1
             return 200, "application/json", json.dumps({
-                "status": "ok",
+                "status": "draining" if self._draining else "ok",
                 "models": self.registry.ids(),
                 "devices": len(jax.devices()),
                 "uptime_s": round(time.time() - self.started_at, 1),
             })
+        if path == "/readyz":
+            # Readiness: only when a predict request would succeed.
+            reason = None
+            if self._draining:
+                reason = "draining"
+            elif self._loop is None:
+                reason = "not started"
+            elif not self.registry.ids():
+                reason = "no model loaded"
+            status = 200 if reason is None else 503
+            self._counters[("readyz", status)] += 1
+            body = {"ready": reason is None}
+            if reason is not None:
+                body["reason"] = reason
+            return status, "application/json", json.dumps(body)
         if path == "/metrics":
             return 200, "text/plain; version=0.0.4", self.metrics_text()
         return 404, "application/json", json.dumps(
@@ -257,6 +333,9 @@ class ServeApp:
              round(b["queue_wait_ms_total"], 3)),
             ("tdc_serve_models", "gauge",
              "Models currently registered.", len(self.registry.ids())),
+            ("tdc_serve_draining", "gauge",
+             "1 while the server is draining (rejecting new work, "
+             "flushing in-flight batches).", int(self._draining)),
             # Process-wide stats-reduce accounting (parallel/reduce.py):
             # cross-device sufficient-stat reduces issued by fits running
             # in this process, and the logical payload bytes they moved.

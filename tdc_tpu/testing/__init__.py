@@ -1,0 +1,6 @@
+"""Deterministic test harnesses (fault injection) — importable from
+production code paths at zero cost when inactive."""
+
+from tdc_tpu.testing.faults import fault_point, parse_faults, reset
+
+__all__ = ["fault_point", "parse_faults", "reset"]

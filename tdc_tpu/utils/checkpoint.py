@@ -16,6 +16,13 @@ import jax
 import numpy as np
 
 
+class CheckpointCorrupt(ValueError):
+    """state.npz loaded but an array failed its CRC32 — silent corruption
+    (bit rot, torn write the rename couldn't prevent, bad copy). The
+    newest-first restore scan treats the step as unreadable and falls
+    back; an explicit-step restore propagates it."""
+
+
 class ClusterState(NamedTuple):
     """Everything needed to resume a clustering run."""
 
@@ -44,8 +51,15 @@ def _manual_save(path: str, payload: dict) -> None:
     internal barriers. The state is four small arrays plus a numeric meta
     dict; a tmp dir + atomic rename by a single writer is the entire
     requirement.
+
+    Integrity: every array is stored alongside a `crc_<name>` CRC32 of its
+    raw bytes; _manual_restore re-hashes and raises CheckpointCorrupt on
+    mismatch. The zip layer has its own member CRCs, but those only guard
+    the read path — ours travel with the arrays and catch corruption the
+    container format misses (e.g. a rewritten member with stale payload).
     """
     import uuid
+    import zlib
 
     meta = payload.pop("meta")
     # Overwrites must not window-delete the readable state (mid-pass saves
@@ -56,20 +70,46 @@ def _manual_save(path: str, payload: dict) -> None:
     # the first replace leaves a dir without state.npz; restore_checkpoint
     # skips such steps when scanning for the latest valid one.
     os.makedirs(path, exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in payload.items()}
+    arrays.update({f"meta_{k}": np.asarray(v) for k, v in meta.items()})
+    crcs = {
+        f"crc_{k}": np.uint32(
+            zlib.crc32(np.ascontiguousarray(v).tobytes())
+        )
+        for k, v in arrays.items()
+    }
     # np.savez appends .npz to names not already ending in it — keep the
     # suffix so the written file is exactly `tmp`.
     tmp = os.path.join(path, f"state.tmp-{uuid.uuid4().hex[:8]}.npz")
-    np.savez(
-        tmp,
-        **{k: np.asarray(v) for k, v in payload.items()},
-        **{f"meta_{k}": np.asarray(v) for k, v in meta.items()},
-    )
+    np.savez(tmp, **arrays, **crcs)
+    from tdc_tpu.testing.faults import fault_point
+
+    fault_point("ckpt.save.pre_replace")
     os.replace(tmp, os.path.join(path, "state.npz"))
 
 
 def _manual_restore(path: str) -> dict:
+    import zlib
+
     with np.load(os.path.join(path, "state.npz"), allow_pickle=False) as z:
         payload = {k: z[k] for k in z.files}
+    crcs = {
+        k[len("crc_"):]: payload.pop(k)
+        for k in list(payload)
+        if k.startswith("crc_")
+    }
+    # Checkpoints from before the CRC era simply carry no crc_ keys and
+    # skip verification; with CRCs present, every array must match.
+    for name, want in crcs.items():
+        if name not in payload:
+            continue
+        got = zlib.crc32(np.ascontiguousarray(payload[name]).tobytes())
+        if got != int(want):
+            raise CheckpointCorrupt(
+                f"{os.path.join(path, 'state.npz')}: array {name!r} CRC32 "
+                f"{got:#010x} != stored {int(want):#010x} — checkpoint is "
+                "corrupt"
+            )
     meta = {
         k[len("meta_"):]: payload.pop(k)
         for k in list(payload)
@@ -79,10 +119,28 @@ def _manual_restore(path: str) -> dict:
     return payload
 
 
+def _prune_old_steps(ckpt_dir: str, keep_last_n: int) -> None:
+    """Retention: drop all but the newest keep_last_n step dirs. Only ever
+    called by the (single) writer, after its own successful write, so the
+    newest step is always complete when older ones disappear."""
+    import shutil
+
+    for s in _all_steps(ckpt_dir)[:-keep_last_n]:
+        shutil.rmtree(
+            os.path.join(ckpt_dir, f"step_{s:08d}"), ignore_errors=True
+        )
+
+
 def save_checkpoint(
-    ckpt_dir: str, state: ClusterState, step: int, *, gang: bool | None = None
+    ckpt_dir: str, state: ClusterState, step: int, *, gang: bool | None = None,
+    keep_last_n: int | None = None,
 ) -> str:
     """Write state under ckpt_dir/step_<N>; returns the path.
+
+    keep_last_n: after a successful write, retain only the newest N step
+    dirs (None keeps everything, the historical behavior). N >= 2 is the
+    sane floor with crash recovery in play: the restore scan falls back
+    one step when the newest is truncated/corrupt.
 
     gang=True: a multi-process gang shares ONE directory — process 0 is the
     single writer (manual atomic format — see _manual_save), every other
@@ -96,6 +154,10 @@ def save_checkpoint(
     jax.process_count() (legacy behavior; correct only when every process
     participates in the same fit).
     """
+    if keep_last_n is not None and keep_last_n < 1:
+        # keep_last_n=0 would prune the step just written — retention can
+        # never mean "keep nothing"; "keep everything" is None.
+        raise ValueError(f"keep_last_n must be >= 1 or None, got {keep_last_n}")
     if gang is None:
         gang = jax.process_count() > 1
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
@@ -118,6 +180,8 @@ def save_checkpoint(
             _manual_save(path, payload)
         else:
             _checkpointer().save(path, payload, force=True)
+        if keep_last_n is not None:
+            _prune_old_steps(os.path.abspath(ckpt_dir), keep_last_n)
     if gang:
         from tdc_tpu.parallel.multihost import barrier
 
@@ -155,13 +219,14 @@ def restore_checkpoint(ckpt_dir: str, step: int | None = None) -> ClusterState |
     every restart. An explicitly requested step propagates its load error.
     """
     if step is None:
-        import sys
+        from tdc_tpu.utils.structlog import emit
 
         # The per-step catch stays broad: a truncated orbax step can raise
-        # types well outside OSError/ValueError (msgpack/orbax internals),
-        # and aborting the scan would skip an older valid step. Systematic
-        # failure is detected AFTER the scan instead: several steps, none
-        # loadable, cannot be crash truncation.
+        # types well outside OSError/ValueError (msgpack/orbax internals,
+        # CheckpointCorrupt from a failed CRC), and aborting the scan would
+        # skip an older valid step. Systematic failure is detected AFTER
+        # the scan instead: several steps, none loadable, cannot be crash
+        # truncation.
         steps = _all_steps(ckpt_dir)
         errors = []
         for cand in reversed(steps):
@@ -169,11 +234,11 @@ def restore_checkpoint(ckpt_dir: str, step: int | None = None) -> ClusterState |
                 return restore_checkpoint(ckpt_dir, cand)
             except Exception as e:  # truncated/corrupt step: fall back
                 errors.append((cand, e))
-                print(
-                    f"note: checkpoint step {cand} in {ckpt_dir} is "
-                    f"unreadable ({type(e).__name__}: {e}); trying the "
-                    "previous step",
-                    file=sys.stderr,
+                emit(
+                    "ckpt_step_unreadable",
+                    dir=ckpt_dir, step=cand,
+                    error=f"{type(e).__name__}: {e}",
+                    action="trying the previous step",
                 )
         if len(steps) > 1:
             # Several checkpoints exist and NONE load: that is a systematic
@@ -191,6 +256,9 @@ def restore_checkpoint(ckpt_dir: str, step: int | None = None) -> ClusterState |
             )
         return None
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    from tdc_tpu.testing.faults import fault_point
+
+    fault_point("ckpt.restore")
     if os.path.exists(os.path.join(path, "state.npz")):
         payload = _manual_restore(path)  # gang single-writer format
     else:

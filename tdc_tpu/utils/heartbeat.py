@@ -17,13 +17,17 @@ import time
 _last_beat = 0.0
 
 
-def maybe_beat(min_interval: float = 1.0) -> None:
+def maybe_beat(min_interval: float = 1.0, progress=None) -> None:
     """Touch $TDC_HEARTBEAT_FILE, at most once per `min_interval` seconds.
 
     Called from the streamed-fit batch loop (models/streaming.py) — i.e. at
     the granularity of one device dispatch, the finest progress signal the
     host sees. Never raises: a missing/unwritable file must not take down
     the computation it is reporting on.
+
+    progress: optional marker (e.g. "iter=4 batch=7") written as the file's
+    content — the supervisor only reads the mtime, but a postmortem reading
+    the file sees where the worker last was.
     """
     global _last_beat
     path = os.environ.get("TDC_HEARTBEAT_FILE")
@@ -34,8 +38,12 @@ def maybe_beat(min_interval: float = 1.0) -> None:
         return
     _last_beat = now
     try:
-        with open(path, "a"):
-            pass
+        if progress is None:
+            with open(path, "a"):
+                pass
+        else:
+            with open(path, "w") as f:
+                f.write(str(progress))
         os.utime(path, None)
     except OSError:
         pass

@@ -1,0 +1,165 @@
+"""Graceful preemption: SIGTERM -> checkpoint at the next safe boundary ->
+exit with a distinct code the gang supervisor refunds.
+
+TPU/GKE preemption is announced with SIGTERM and a grace window; the
+default Python behavior (die immediately, exit 143) is indistinguishable
+from a crash, so the supervisor charges its restart budget and the run
+loses everything since the last periodic checkpoint. With
+`install_preemption_handler()` a worker instead: sets a flag; the streamed
+drivers (models/streaming.py) poll it at batch boundaries (single-process)
+or once per pass with a cross-process agreement collective (gangs — the
+workers must stop after the SAME batch count or the next pass's psum
+deadlocks the survivors); the driver checkpoints and raises `Preempted`,
+a SystemExit carrying PREEMPTED_EXIT_CODE — the process exits cleanly
+with that code and no traceback, and `parallel/supervisor.run_gang`
+relaunches WITHOUT consuming the restart budget.
+
+Gang contract: install the handler on every worker or on none — the
+per-pass agreement is a collective, and a worker that never calls it
+desyncs the others.
+
+A second SIGTERM while a drain is already in progress force-exits
+immediately (still with the preemption code): the platform's grace window
+is about to expire and a half-written tmp file beats a kill -9 mid-rename.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+# 75 = EX_TEMPFAIL (sysexits.h): "temporary failure, retry later" — exactly
+# the preemption contract, and distinct from any signal death (>128) or
+# Python traceback (1). The supervisor keys on this value.
+PREEMPTED_EXIT_CODE = 75
+
+
+class Preempted(SystemExit):
+    """Raised by drivers at the post-SIGTERM checkpoint boundary.
+
+    SystemExit subclass: uncaught, the worker exits PREEMPTED_EXIT_CODE
+    with no traceback; `except Exception` blocks never swallow it.
+    """
+
+    def __init__(self, message: str = "preempted"):
+        super().__init__(PREEMPTED_EXIT_CODE)
+        self.message = message
+
+    def __str__(self) -> str:
+        return self.message
+
+
+_state = {"installed": False, "requested": False}
+
+
+def install_preemption_handler(signals=(signal.SIGTERM,)) -> None:
+    """Install the drain-on-SIGTERM handler (main thread only; no-op if
+    already installed). Safe to call unconditionally in worker templates.
+
+    Order note: `jax.distributed.initialize` registers TSL's own SIGTERM
+    notifier at the C level, silently displacing any Python handler
+    installed earlier. `multihost.initialize_distributed` calls
+    `reinstall_if_installed()` after the runtime comes up, so either call
+    order works for workers using that path."""
+    if _state["installed"]:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        raise RuntimeError(
+            "install_preemption_handler must run on the main thread "
+            "(signal.signal requirement)"
+        )
+    for sig in signals:
+        signal.signal(sig, _on_signal)
+    _state["installed"] = True
+    _state["signals"] = tuple(signals)
+
+
+def reinstall_if_installed() -> None:
+    """Re-assert the drain handler if it was ever installed — needed after
+    anything that registers its own C-level SIGTERM handler on top of ours
+    (observed: jax.distributed.initialize's TSL preemption notifier, which
+    would swallow the notice and leave the flag forever unset)."""
+    if not _state["installed"]:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        return
+    for sig in _state.get("signals", (signal.SIGTERM,)):
+        signal.signal(sig, _on_signal)
+
+
+def _on_signal(signum, frame) -> None:
+    if _state["requested"]:
+        # Grace window expiring: get out now, but still with the
+        # preemption code so the supervisor refunds the restart.
+        os._exit(PREEMPTED_EXIT_CODE)
+    _state["requested"] = True
+    # Async-signal context: NO buffered I/O here — structlog.emit/print
+    # into a stderr writer the signal just interrupted raises
+    # RuntimeError('reentrant call'), crashing the very worker this
+    # handler is draining. One raw fd-2 write is the whole breadcrumb;
+    # the drain path logs properly when it acts on the flag.
+    try:
+        os.write(2, b'{"event": "preempt_requested", "signal": %d, '
+                    b'"pid": %d}\n' % (signum, os.getpid()))
+    except OSError:
+        pass
+
+
+def installed() -> bool:
+    return _state["installed"]
+
+
+def requested() -> bool:
+    """Has a preemption notice arrived? (Local flag; no collective.)"""
+    return _state["requested"]
+
+
+def request() -> None:
+    """Raise the flag programmatically (tests; or embedding runtimes that
+    get their preemption notice from an API instead of a signal).
+
+    Single-host fits honor a bare request() at the next batch boundary.
+    GANG fits additionally require the drain machinery enabled on every
+    process — call install_preemption_handler() everywhere at startup —
+    because the per-pass agreement is a collective gated on installed():
+    running it unconditionally would charge every preemption-free gang
+    fit one host allgather per iteration."""
+    _state["requested"] = True
+
+
+def reset() -> None:
+    """Clear the flag (tests). Does not uninstall the signal handler."""
+    _state["requested"] = False
+
+
+def sync_requested(gang: bool = False) -> bool:
+    """Gang-agreed preemption check: with gang=True every process of the
+    jax.distributed runtime must call this the same number of times (it is
+    a collective); returns True on ALL processes iff any process has the
+    flag. gang=False is a plain local read."""
+    local = requested()
+    if not gang:
+        return local
+    import jax
+
+    if jax.process_count() <= 1:
+        return local
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = np.asarray(multihost_utils.process_allgather(np.int32(local)))
+    return bool(flags.max() > 0)
+
+
+__all__ = [
+    "PREEMPTED_EXIT_CODE",
+    "Preempted",
+    "install_preemption_handler",
+    "installed",
+    "reinstall_if_installed",
+    "request",
+    "requested",
+    "reset",
+    "sync_requested",
+]

@@ -9,7 +9,30 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+
+
+def emit(event: str, **fields) -> None:
+    """One ad-hoc JSONL ops/recovery event: always to stderr, and appended
+    to $TDC_RUNLOG when set.
+
+    The module-function twin of RunLog.event for code that has no RunLog
+    plumbed through (checkpoint restore fallbacks, the gang supervisor's
+    echo): recovery events land machine-parseable next to the serve
+    request log instead of as raw prose on stderr. Never raises.
+    """
+    rec = {"ts": round(time.time(), 3), "event": event}
+    rec.update(fields)
+    line = json.dumps(rec, default=str)
+    print(line, file=sys.stderr, flush=True)
+    path = os.environ.get("TDC_RUNLOG")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
 
 
 class RunLog:

@@ -1,0 +1,433 @@
+"""Chaos / preemption fault-tolerance proofs.
+
+The deterministic fault harness (tdc_tpu.testing.faults) drives the real
+failure modes through the real recovery paths:
+
+- kill -9 at a fault-injected batch boundary -> gang restart from the
+  aligned checkpoint, restart budget charged;
+- preemption SIGTERM -> graceful drain (checkpoint at the agreed
+  boundary, exit 75) -> relaunch WITHOUT charging the budget;
+- the recovered fit must match the fault-free run within the documented
+  streamed-fit tolerance.
+
+The multi-process soak is marked slow+chaos+multiproc: scripts/ci_tier1.sh
+runs it as the dedicated timeout-wrapped chaos smoke so the main tier-1
+sweep keeps its time budget. The single-process contract tests below it
+are fast and run in tier-1.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from tdc_tpu.parallel.supervisor import run_gang
+from tdc_tpu.utils import preempt
+from tdc_tpu.utils.preempt import PREEMPTED_EXIT_CODE, Preempted
+
+
+def _blobs():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1024, 4)).astype(np.float32)
+    x[:256] += 4.0
+    x[256:512] -= 4.0
+    return x
+
+
+_CHAOS_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from tdc_tpu.parallel.multihost import (
+        barrier, global_mesh, host_shard_bounds, initialize_from_env,
+    )
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+    from tdc_tpu.utils.preempt import install_preemption_handler
+
+    outdir = sys.argv[1]
+    install_preemption_handler()  # SIGTERM -> drain, not die
+    pid, nproc = initialize_from_env()
+    attempt = int(os.environ["TDC_ATTEMPT"])
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 4)).astype(np.float32)
+    X[:256] += 4.0; X[256:512] -= 4.0
+    n_batches, per_batch = 4, 256
+
+    def batches():
+        # No in-script failure logic: every kill/SIGTERM in this test is
+        # injected by $TDC_FAULTS through the production fault points.
+        for b in range(n_batches):
+            lo = b * per_batch
+            start, end = host_shard_bounds(per_batch)
+            yield X[lo + start : lo + end]
+
+    res = streamed_kmeans_fit(
+        batches, 5, 4, init=X[:5], max_iters=5, tol=-1.0,
+        mesh=global_mesh(), ckpt_dir=os.environ["TDC_CKPT_DIR"],
+        ckpt_every=1,
+    )
+    np.save(os.path.join(outdir, f"centroids_{pid}.npy"),
+            np.asarray(res.centroids))
+    with open(os.path.join(outdir, f"iters_run_{pid}_a{attempt}"), "w") as f:
+        f.write(str(res.n_iter_run))
+    print("CHAOS_OK", pid, "attempt", attempt, flush=True)
+    barrier()
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.multiproc
+def test_chaos_soak_kill_and_sigterm_recovery(tmp_path):
+    """The chaos soak: one $TDC_FAULTS string injects a kill -9 (attempt 0,
+    worker 1, pass-3 batch boundary) AND a preemption SIGTERM (attempt 1,
+    worker 0, pass-2 batch boundary) into a 2-process gloo gang running a
+    checkpointed streamed fit. The gang must recover both, the SIGTERM
+    exit must NOT consume restart budget (GangResult accounting), and the
+    final centroids must match a fault-free run within the documented
+    streamed tolerance."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_CHAOS_WORKER)
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # 4 stream.batch hits per pass (4 batches); ckpt_every=1 so steps land
+    # after every pass. hit 10 = pass 3, batch 2 (steps 1,2 on disk,
+    # no save in flight -> the aligned resume step is deterministically 2);
+    # hit 6 on the resumed attempt = its pass 2 (global iteration 4),
+    # batch 2 — the drivers agree at the end of that pass and drain.
+    env["TDC_FAULTS"] = (
+        "stream.batch=kill@10&attempt=0&pid=1,"
+        "stream.batch=sigterm@6&attempt=1&pid=0"
+    )
+
+    echoes = []
+    res = run_gang(
+        [sys.executable, str(worker), str(outdir)], 2,
+        max_restarts=2, ckpt_dirs=[str(ckpt_dir)],
+        log_dir=str(tmp_path / "logs"),
+        heartbeat_timeout=180.0, env=env, echo=echoes.append,
+        backoff_base=0.05,
+    )
+    # Launch 1 killed (budget 1), launch 2 preempted (budget unchanged),
+    # launch 3 completes. Under heavy load a relaunch can additionally lose
+    # a worker to the gloo teardown/port race (memory: don't assert exact
+    # attempt counts), but the PREEMPTION accounting is exact: exactly one
+    # preemption, and the budget never exceeds the kill + transient races.
+    assert res.attempts >= 3, echoes
+    assert res.preemptions == 1, (res, echoes)
+    assert 1 <= res.budget_used <= 2, (res, echoes)
+    assert any("without charging the restart budget" in m for m in echoes), \
+        echoes
+    resumed = [m for m in echoes if "resuming from" in m]
+    assert resumed and all("scratch" not in m for m in resumed), echoes
+
+    # The preempted attempt drained gracefully: its log shows the SIGTERM
+    # flag being raised and the injected fault that delivered it.
+    a1_log = (tmp_path / "logs" / "worker_a1_p0.log").read_text()
+    assert "fault_injected" in a1_log and "preempt_requested" in a1_log
+
+    final = res.attempts - 1
+    for pid in range(2):
+        iters = int((outdir / f"iters_run_{pid}_a{final}").read_text())
+        assert 0 < iters < 5  # resumed from a checkpoint, not scratch
+    c0 = np.load(outdir / "centroids_0.npy")
+    c1 = np.load(outdir / "centroids_1.npy")
+    np.testing.assert_array_equal(c0, c1)  # replicated state agrees bitwise
+
+    # Fault-free oracle over the same global stream (single process).
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    x = _blobs()
+
+    def batches():
+        for b in range(4):
+            yield x[b * 256 : (b + 1) * 256]
+
+    want = streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=5,
+                               tol=-1.0)
+    # The documented streamed-fit tolerance for a multi-device recovery vs
+    # a single-device run (psum association order): 1e-4 — same bound the
+    # elastic supervisor test uses.
+    np.testing.assert_allclose(c0, np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+
+
+class TestPreemptionContract:
+    """Fast single-process pieces of the preemption story (tier-1)."""
+
+    def test_exit_code_constants_agree(self):
+        from tdc_tpu.parallel import supervisor
+
+        assert PREEMPTED_EXIT_CODE == 75
+        assert supervisor.PREEMPTED_EXIT_CODE == PREEMPTED_EXIT_CODE
+        assert Preempted().code == PREEMPTED_EXIT_CODE
+
+    def test_preempted_is_systemexit_not_exception(self):
+        # `except Exception` recovery blocks must never swallow a drain.
+        assert issubclass(Preempted, SystemExit)
+        assert not issubclass(Preempted, Exception)
+
+    def test_request_flag_roundtrip(self):
+        preempt.reset()
+        assert not preempt.requested()
+        preempt.request()
+        assert preempt.requested()
+        assert preempt.sync_requested(gang=False)
+        preempt.reset()
+        assert not preempt.requested()
+
+    def test_preempt_midpass_checkpoint_and_bit_identical_resume(
+        self, tmp_path
+    ):
+        """SIGTERM (via the test hook) mid-stream: the fit checkpoints at
+        the NEXT batch boundary — accumulator + cursor — and a resume is
+        bit-identical to the uninterrupted run, i.e. graceful preemption
+        loses zero progress."""
+        from tdc_tpu.models.streaming import streamed_kmeans_fit
+        from tdc_tpu.utils.checkpoint import restore_checkpoint
+
+        x = _blobs()
+        init = x[:5]
+
+        def mk(trip_at=None):
+            seen = {"n": 0}
+
+            def batches():
+                for i in range(0, 1024, 128):
+                    seen["n"] += 1
+                    if trip_at is not None and seen["n"] == trip_at:
+                        preempt.request()  # the handler's effect, sans signal
+                    yield x[i:i + 128]
+
+            return batches
+
+        full = streamed_kmeans_fit(mk(), 5, 4, init=init, max_iters=6,
+                                   tol=-1.0)
+        d = str(tmp_path / "ck")
+        preempt.reset()
+        # Preemption notice arrives during pass 3, batch 5 (global 21).
+        # ckpt_every_batches opts into mid-pass (order-dependent) state;
+        # its large value means the drain save is the only mid-pass write.
+        with pytest.raises(Preempted):
+            streamed_kmeans_fit(mk(trip_at=21), 5, 4, init=init,
+                                max_iters=6, tol=-1.0, ckpt_dir=d,
+                                ckpt_every=100, ckpt_every_batches=100)
+        preempt.reset()
+        st = restore_checkpoint(d)
+        assert st.n_iter == 2 and st.batch_cursor == 5  # mid-pass cursor
+        resumed = streamed_kmeans_fit(mk(), 5, 4, init=init, max_iters=6,
+                                      tol=-1.0, ckpt_dir=d, ckpt_every=100,
+                                      ckpt_every_batches=100)
+        np.testing.assert_array_equal(
+            np.asarray(resumed.centroids), np.asarray(full.centroids)
+        )
+
+    def test_preempt_without_midpass_opt_in_saves_no_cursor(self, tmp_path):
+        """Without ckpt_every_batches the stream never promised replay
+        determinism — a drain must NOT persist a mid-pass cursor (a resume
+        would silently mis-accumulate a reshuffling stream); it exits 75
+        and resume falls back to the completed-iteration checkpoint."""
+        from tdc_tpu.models.streaming import streamed_kmeans_fit
+        from tdc_tpu.utils.checkpoint import restore_checkpoint
+
+        x = _blobs()
+        seen = {"n": 0}
+
+        def batches():
+            for i in range(0, 1024, 128):
+                seen["n"] += 1
+                if seen["n"] == 21:
+                    preempt.request()
+                yield x[i:i + 128]
+
+        d = str(tmp_path / "ck")
+        preempt.reset()
+        with pytest.raises(Preempted):
+            streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=6,
+                                tol=-1.0, ckpt_dir=d, ckpt_every=1)
+        preempt.reset()
+        st = restore_checkpoint(d)
+        assert st.n_iter == 2 and st.batch_cursor == 0  # iteration only
+
+    def test_sigterm_handler_subprocess_drain_and_force_exit(self, tmp_path):
+        """The real signal path: first SIGTERM raises the flag (process
+        keeps running), second SIGTERM force-exits with the preemption
+        code — the grace-window-expiring contract."""
+        code = textwrap.dedent("""
+            import os, signal, sys, time
+            from tdc_tpu.utils import preempt
+            preempt.install_preemption_handler()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert preempt.requested(), "first SIGTERM must only flag"
+            print("flagged", flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)  # grace expired
+            time.sleep(30)
+            print("UNREACHABLE", flush=True)
+        """)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=120, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == PREEMPTED_EXIT_CODE, proc.stderr
+        assert "flagged" in proc.stdout
+        assert "UNREACHABLE" not in proc.stdout
+
+    def test_fault_injected_sigterm_exits_75_with_resumable_checkpoint(
+        self, tmp_path
+    ):
+        """End-to-end single-worker drain: TDC_FAULTS delivers a real
+        SIGTERM at a batch boundary; the worker checkpoints and exits 75;
+        the parent resumes the fit from the drained checkpoint and matches
+        the fault-free run bit-for-bit."""
+        from tdc_tpu.models.streaming import streamed_kmeans_fit
+        from tdc_tpu.utils.checkpoint import restore_checkpoint
+
+        d = str(tmp_path / "ck")
+        script = textwrap.dedent(f"""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import numpy as np
+            from tdc_tpu.models.streaming import streamed_kmeans_fit
+            from tdc_tpu.utils.preempt import install_preemption_handler
+            install_preemption_handler()
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(1024, 4)).astype(np.float32)
+            x[:256] += 4.0; x[256:512] -= 4.0
+            def batches():
+                for i in range(0, 1024, 128):
+                    yield x[i:i + 128]
+            streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=6,
+                                tol=-1.0, ckpt_dir={d!r}, ckpt_every=100,
+                                ckpt_every_batches=100)
+            print("UNREACHABLE: fit survived injected preemption")
+        """)
+        proc = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True,
+            timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 # pass 3 (batches 17-24), boundary after batch 21
+                 "TDC_FAULTS": "stream.batch=sigterm@21"},
+        )
+        assert proc.returncode == PREEMPTED_EXIT_CODE, (
+            proc.returncode, proc.stderr[-2000:]
+        )
+        assert "UNREACHABLE" not in proc.stdout
+        st = restore_checkpoint(d)
+        assert st is not None and st.batch_cursor > 0
+
+        x = _blobs()
+
+        def batches():
+            for i in range(0, 1024, 128):
+                yield x[i:i + 128]
+
+        full = streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=6,
+                                   tol=-1.0)
+        resumed = streamed_kmeans_fit(batches, 5, 4, init=x[:5],
+                                      max_iters=6, tol=-1.0, ckpt_dir=d,
+                                      ckpt_every=100)
+        np.testing.assert_array_equal(
+            np.asarray(resumed.centroids), np.asarray(full.centroids)
+        )
+
+
+class TestSupervisorPreemptionAccounting:
+    """Supervisor-side preemption/budget semantics with cheap no-jax
+    workers (tier-1 fast)."""
+
+    def test_preemption_exit_does_not_charge_budget(self, tmp_path):
+        # Worker preempts itself (exit 75) on attempt 0, succeeds on 1.
+        # max_restarts=0: ANY charged restart would raise GangFailed.
+        script = textwrap.dedent("""
+            import os, sys
+            sys.exit(75 if os.environ["TDC_ATTEMPT"] == "0" else 0)
+        """)
+        res = run_gang(
+            [sys.executable, "-c", script], 2, max_restarts=0,
+            log_dir=str(tmp_path), echo=lambda _: None, backoff_base=0,
+        )
+        assert res.attempts == 2
+        assert res.preemptions == 1
+        assert res.budget_used == 0
+
+    def test_preemption_cap_stops_infinite_loop(self, tmp_path):
+        from tdc_tpu.parallel.supervisor import GangFailed
+
+        with pytest.raises(GangFailed, match="preempted"):
+            run_gang(
+                [sys.executable, "-c", "import sys; sys.exit(75)"], 1,
+                max_restarts=0, max_preemption_restarts=2,
+                log_dir=str(tmp_path), echo=lambda _: None, backoff_base=0,
+            )
+
+    def test_wedged_drain_charges_budget_not_refunded(self, tmp_path):
+        """A worker that hangs through the drain grace window is a
+        FAILURE, not a clean preemption — refunding it would let a
+        deterministic drain-wedge relaunch max_preemption_restarts times
+        for free."""
+        from tdc_tpu.parallel.supervisor import GangFailed
+
+        script = textwrap.dedent("""
+            import os, sys, time
+            if os.environ["TDC_PROCESS_ID"] == "0":
+                sys.exit(75)  # one worker drains...
+            time.sleep(600)  # ...its peer wedges (stuck collective)
+        """)
+        with pytest.raises(GangFailed, match="drain grace expired"):
+            run_gang(
+                [sys.executable, "-c", script], 2, max_restarts=0,
+                drain_grace=2.0, log_dir=str(tmp_path),
+                echo=lambda _: None, backoff_base=0,
+            )
+
+    def test_completion_during_supervisor_drain_is_success(self, tmp_path):
+        """Workers that finish (exit 0) right as the supervisor forwards
+        SIGTERM: the job is DONE — run_gang must return success, not tell
+        the scheduler to retry a finished job. Simulated at the exit-code
+        layer: all-zero exits always win over preemption bookkeeping."""
+        res = run_gang(
+            [sys.executable, "-c", "pass"], 2, max_restarts=0,
+            log_dir=str(tmp_path), echo=lambda _: None, backoff_base=0,
+        )
+        assert res.attempts == 1 and res.returncodes == [0, 0]
+
+    def test_supervisor_sigterm_drains_gang(self, tmp_path):
+        """SIGTERM to the supervise CLI: forwarded to the gang, drained,
+        and the supervisor itself exits with the preemption code."""
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tdc_tpu.cli.supervise",
+             "--num_processes=1", "--max_restarts=0", "--drain_grace=10",
+             f"--log_dir={tmp_path}", "--",
+             sys.executable, "-c", "import time; time.sleep(120)"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        # Wait for the worker to exist (its log file appears), then preempt.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if (tmp_path / "worker_a0_p0.log").exists():
+                break
+            time.sleep(0.1)
+        time.sleep(0.5)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == PREEMPTED_EXIT_CODE, out[-2000:]
+        assert "drained" in out

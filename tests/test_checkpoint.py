@@ -1,6 +1,7 @@
 """Checkpoint/resume tests (capability absent from the reference, SURVEY.md §5)."""
 
 import csv
+import os
 
 import numpy as np
 import jax
@@ -345,6 +346,172 @@ def test_sweep_resume_migrates_legacy_csv(tmp_path):
     # The fallback migrated the completion: the plain hash branch covers it now.
     assert os.path.exists(_done_file(log))
     assert run_sweep(spec, isolate=False, resume=True) == []
+
+
+def _manual_payload(v=1):
+    return {
+        "centroids": np.full((2, 2), float(v), np.float32), "n_iter": v,
+        "key": np.zeros(2, np.uint32), "has_key": False,
+        "batch_cursor": 0, "meta": {"k": 2, "d": 2},
+    }
+
+
+class TestIntegrity:
+    """Per-array CRC32 in state.npz: silent corruption is detected and the
+    restore scan falls back to the previous step instead of resuming from
+    poisoned state."""
+
+    def test_silent_corruption_detected_by_crc(self, tmp_path):
+        from tdc_tpu.utils import checkpoint as ckpt
+
+        p = str(tmp_path / "step_00000001")
+        ckpt._manual_save(p, _manual_payload(1))
+        # Rewrite one array but keep the stored CRCs — the zip container
+        # is self-consistent, so only OUR checksums can catch it.
+        f = os.path.join(p, "state.npz")
+        with np.load(f) as z:
+            data = {k: z[k] for k in z.files}
+        data["centroids"] = np.full((2, 2), 666.0, np.float32)
+        np.savez(f, **data)
+        with pytest.raises(ckpt.CheckpointCorrupt, match="centroids"):
+            ckpt._manual_restore(p)
+
+    def test_bitflipped_npz_falls_back_to_previous_step(self, tmp_path):
+        """The acceptance scenario: a bit-flipped state.npz is detected
+        (CRC at one layer or another) and restore uses the previous
+        step."""
+        from tdc_tpu.utils import checkpoint as ckpt
+
+        d = str(tmp_path / "ck")
+        ckpt._manual_save(os.path.join(d, "step_00000003"),
+                          _manual_payload(3))
+        ckpt._manual_save(os.path.join(d, "step_00000004"),
+                          _manual_payload(4))
+        f = os.path.join(d, "step_00000004", "state.npz")
+        blob = bytearray(open(f, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # flip bits mid-payload
+        open(f, "wb").write(bytes(blob))
+        st = restore_checkpoint(d)
+        assert st is not None and st.n_iter == 3  # skipped the corrupt 4
+
+    def test_crc_roundtrip_all_arrays(self, tmp_path):
+        from tdc_tpu.utils import checkpoint as ckpt
+
+        p = str(tmp_path / "step_00000002")
+        ckpt._manual_save(p, _manual_payload(2))
+        with np.load(os.path.join(p, "state.npz")) as z:
+            names = set(z.files)
+        # every data/meta array travels with its checksum
+        plain = {n for n in names if not n.startswith("crc_")}
+        assert {f"crc_{n}" for n in plain} <= names
+        st = ckpt._manual_restore(p)  # and verification passes
+        assert int(np.asarray(st["n_iter"])) == 2
+
+    def test_pre_crc_checkpoints_still_restore(self, tmp_path):
+        """Legacy state.npz without crc_ members (pre-integrity era) must
+        load unverified rather than fail."""
+        from tdc_tpu.utils import checkpoint as ckpt
+
+        p = str(tmp_path / "step_00000001")
+        ckpt._manual_save(p, _manual_payload(1))
+        f = os.path.join(p, "state.npz")
+        with np.load(f) as z:
+            data = {k: z[k] for k in z.files if not k.startswith("crc_")}
+        np.savez(f, **data)
+        st = restore_checkpoint(str(tmp_path))
+        assert st is not None and st.n_iter == 1
+
+
+class TestSystematicFailure:
+    """restore_checkpoint's scan semantics, covered directly (previously
+    only implicit via supervisor tests): N>1 unreadable steps is systematic
+    -> RuntimeError; exactly 1 is crash truncation -> warn and None."""
+
+    def test_all_of_several_steps_unreadable_raises(self, tmp_path):
+        d = tmp_path / "ck"
+        for s in (1, 2, 3):
+            sd = d / f"step_{s:08d}"
+            sd.mkdir(parents=True)
+            (sd / "state.npz").write_bytes(b"not a zip at all")
+        with pytest.raises(RuntimeError, match="none could be loaded"):
+            restore_checkpoint(str(d))
+
+    def test_single_unreadable_step_warns_and_returns_none(
+        self, tmp_path, capsys
+    ):
+        d = tmp_path / "ck"
+        sd = d / "step_00000001"
+        sd.mkdir(parents=True)
+        (sd / "state.npz").write_bytes(b"garbage")
+        assert restore_checkpoint(str(d)) is None
+        # The recovery event is machine-parseable JSONL (structlog), not
+        # raw prose.
+        err = capsys.readouterr().err
+        line = next(ln for ln in err.splitlines()
+                    if "ckpt_step_unreadable" in ln)
+        import json
+
+        rec = json.loads(line)
+        assert rec["event"] == "ckpt_step_unreadable" and rec["step"] == 1
+
+    def test_one_unreadable_one_valid_falls_back(self, tmp_path):
+        from tdc_tpu.utils import checkpoint as ckpt
+
+        d = tmp_path / "ck"
+        ckpt._manual_save(str(d / "step_00000001"), _manual_payload(1))
+        sd = d / "step_00000002"
+        sd.mkdir()
+        (sd / "state.npz").write_bytes(b"garbage")
+        st = restore_checkpoint(str(d))
+        assert st is not None and st.n_iter == 1
+
+
+class TestRetention:
+    def test_keep_last_n_prunes_old_steps(self, tmp_path):
+        d = str(tmp_path / "ck")
+        s = ClusterState(np.zeros((2, 2), np.float32), 0, None, 0,
+                         {"k": 2, "d": 2})
+        for step in range(1, 6):
+            save_checkpoint(d, s._replace(n_iter=step), step=step,
+                            keep_last_n=2)
+        steps = sorted(n for n in os.listdir(d) if n.startswith("step_"))
+        assert steps == ["step_00000004", "step_00000005"]
+        assert restore_checkpoint(d).n_iter == 5
+
+    def test_keep_last_n_zero_rejected(self, tmp_path):
+        # 0 would prune the step just written; "keep everything" is None.
+        s = ClusterState(np.zeros((2, 2), np.float32), 0, None, 0,
+                         {"k": 2, "d": 2})
+        with pytest.raises(ValueError, match="keep_last_n"):
+            save_checkpoint(str(tmp_path / "ck"), s, step=1, keep_last_n=0)
+
+    def test_streamed_fit_retention_knob(self, blobs_small, tmp_path):
+        x, _, _ = blobs_small
+        d = str(tmp_path / "ck")
+        streamed_kmeans_fit(
+            NpzStream(x, 300), 3, 2, init=x[:3], max_iters=6, tol=-1.0,
+            ckpt_dir=d, ckpt_every=1, ckpt_keep_last_n=3,
+        )
+        steps = sorted(n for n in os.listdir(d) if n.startswith("step_"))
+        assert len(steps) == 3 and steps[-1] == "step_00000006"
+
+
+def test_align_checkpoints_drops_orbax_tmp_droppings_next_to_real_state(
+    tmp_path,
+):
+    """align_checkpoints on a dir holding REAL checkpoint state plus an
+    interrupted orbax tmp dir: the droppings go, the valid step stays
+    restorable (direct coverage for the supervisor's pre-relaunch trim)."""
+    from tdc_tpu.parallel.supervisor import align_checkpoints
+    from tdc_tpu.utils import checkpoint as ckpt
+
+    d = str(tmp_path / "ck")
+    ckpt._manual_save(os.path.join(d, "step_00000002"), _manual_payload(2))
+    tmp = os.path.join(d, "step_00000003.orbax-checkpoint-tmp-99")
+    os.makedirs(tmp)
+    assert align_checkpoints([d]) == 2
+    assert not os.path.exists(tmp)
+    assert restore_checkpoint(d).n_iter == 2
 
 
 def test_restore_skips_truncated_latest_step(tmp_path, capsys):

@@ -1,0 +1,134 @@
+"""Deterministic fault-injection registry (tdc_tpu.testing.faults) — the
+harness the chaos tests stand on, so its own semantics (trigger counts,
+filters, action dispatch) get direct coverage."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tdc_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    monkeypatch.delenv("TDC_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class TestParse:
+    def test_full_grammar(self):
+        specs = faults.parse_faults(
+            "ckpt.save.pre_replace=crash@2,stream.batch=delay:1.5@10,"
+            "reduce.psum=raise:OSError,s.b=kill@3&attempt=0&pid=1"
+        )
+        assert [s.point for s in specs] == [
+            "ckpt.save.pre_replace", "stream.batch", "reduce.psum", "s.b"
+        ]
+        assert specs[0].action == "crash" and specs[0].nth == 2
+        assert specs[1].arg == "1.5" and specs[1].nth == 10
+        assert specs[2].action == "raise" and specs[2].nth == 1
+        assert specs[3].filters == {"TDC_ATTEMPT": "0",
+                                    "TDC_PROCESS_ID": "1"}
+
+    def test_from_nth_on(self):
+        (s,) = faults.parse_faults("p=delay:0@3+")
+        assert s.nth == 3 and s.from_nth_on
+
+    @pytest.mark.parametrize("bad", [
+        "noequals", "p=unknownaction", "p=raise", "p=exit:notanint",
+        "p=delay:xyz", "p=crash@0", "p=crash@x", "p=kill&badfilter",
+    ])
+    def test_bad_specs_loud(self, bad):
+        # A typo'd chaos spec must fail the test run, not inject nothing.
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_faults(bad)
+
+    def test_bad_spec_raises_at_fault_point(self, monkeypatch):
+        monkeypatch.setenv("TDC_FAULTS", "p=bogus")
+        with pytest.raises(faults.FaultSpecError):
+            faults.fault_point("p")
+
+
+class TestTriggering:
+    def test_fires_on_exact_nth_hit_only(self, monkeypatch):
+        monkeypatch.setenv("TDC_FAULTS", "p.x=raise:OSError@2")
+        faults.reset()
+        faults.fault_point("p.x")  # hit 1: armed, silent
+        with pytest.raises(OSError, match="injected fault at p.x"):
+            faults.fault_point("p.x")  # hit 2: fires
+        faults.fault_point("p.x")  # hit 3: exact trigger is spent
+        assert faults.hit_count("p.x") == 3
+
+    def test_from_nth_on_fires_repeatedly(self, monkeypatch):
+        monkeypatch.setenv("TDC_FAULTS", "p.y=raise:ValueError@2+")
+        faults.reset()
+        faults.fault_point("p.y")
+        for _ in range(3):
+            with pytest.raises(ValueError):
+                faults.fault_point("p.y")
+
+    def test_other_points_untouched(self, monkeypatch):
+        monkeypatch.setenv("TDC_FAULTS", "p.z=raise:OSError@1")
+        faults.reset()
+        faults.fault_point("other.point")  # no spec for it: silent
+        assert faults.hit_count("p.z") == 0
+
+    def test_unset_env_is_noop(self):
+        faults.fault_point("anything")
+        assert faults.hit_count("anything") == 0
+
+    def test_env_filter_gates_counting(self, monkeypatch):
+        monkeypatch.setenv("TDC_FAULTS", "p.f=raise:OSError@1&attempt=1")
+        monkeypatch.setenv("TDC_ATTEMPT", "0")
+        faults.reset()
+        faults.fault_point("p.f")  # wrong attempt: not even counted
+        assert faults.hit_count("p.f") == 0
+        monkeypatch.setenv("TDC_ATTEMPT", "1")
+        with pytest.raises(OSError):
+            faults.fault_point("p.f")
+
+    def test_delay_action_sleeps(self, monkeypatch):
+        monkeypatch.setenv("TDC_FAULTS", "p.d=delay:0.05@1")
+        faults.reset()
+        t0 = time.perf_counter()
+        faults.fault_point("p.d")
+        assert time.perf_counter() - t0 >= 0.04
+
+    def test_spec_change_reparses_and_resets_counts(self, monkeypatch):
+        monkeypatch.setenv("TDC_FAULTS", "p.a=raise:OSError@5")
+        faults.reset()
+        faults.fault_point("p.a")
+        monkeypatch.setenv("TDC_FAULTS", "p.a=raise:OSError@2")
+        faults.fault_point("p.a")  # counter restarted with the new spec
+        with pytest.raises(OSError):
+            faults.fault_point("p.a")
+
+
+class TestProcessKillingActions:
+    """crash/kill/exit end the process — exercised in a subprocess."""
+
+    @pytest.mark.parametrize("action,expected", [
+        ("crash", faults.CRASH_EXIT_CODE),  # 137: kill -9 lookalike
+        ("exit:7", 7),
+        ("kill", -9),  # true SIGKILL: Popen reports -signal
+    ])
+    def test_terminal_actions(self, action, expected):
+        code = (
+            "from tdc_tpu.testing import faults\n"
+            "faults.fault_point('t.p')\n"
+            "print('survived')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "TDC_FAULTS": f"t.p={action}@1"},
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == expected, proc.stderr
+        assert "survived" not in proc.stdout
+        # the pre-action breadcrumb made it out before death
+        assert "fault_injected" in proc.stderr

@@ -600,3 +600,93 @@ class TestReviewRegressions:
             assert (st, body["error"]) == (504, "request timed out")
         finally:
             app.stop()
+
+
+class TestDrainAndReadiness:
+    """Graceful shutdown: liveness/readiness split, draining 503s, and the
+    in-flight flush — the serve half of the preemption story."""
+
+    def test_readyz_lifecycle(self, model_root):
+        app = ServeApp(poll_interval=0)
+        st, _, body = app.handle_get("/readyz")
+        assert st == 503 and json.loads(body)["reason"] == "not started"
+        app.start()
+        try:
+            st, _, body = app.handle_get("/readyz")
+            assert st == 503
+            assert json.loads(body)["reason"] == "no model loaded"
+            app.registry.add("km", str(model_root / "km"))
+            st, _, body = app.handle_get("/readyz")
+            assert st == 200 and json.loads(body) == {"ready": True}
+        finally:
+            app.stop()
+        # Post-stop: readiness is gone but LIVENESS stays 200 — a draining
+        # pod must not be health-check-killed mid-flush.
+        st, _, body = app.handle_get("/readyz")
+        assert st == 503 and json.loads(body)["reason"] == "draining"
+        st, _, body = app.handle_get("/healthz")
+        assert st == 200 and json.loads(body)["status"] == "draining"
+
+    def test_draining_rejects_new_predict_work(self, fitted, model_root):
+        x, _, _ = fitted
+        app = _mk_app(model_root)
+        app.stop()
+        st, body = app.request(
+            "predict", {"model": "km", "points": x[:3].tolist()}
+        )
+        assert st == 503 and body["error"] == "draining"
+
+    def test_draining_metric_exposed(self, model_root):
+        app = _mk_app(model_root)
+        try:
+            assert "tdc_serve_draining 0" in app.metrics_text()
+        finally:
+            app.stop()
+        assert "tdc_serve_draining 1" in app.metrics_text()
+
+    def test_stop_flushes_in_flight_requests(self, fitted, model_root):
+        """Requests admitted before the drain get their (correct) answers;
+        stop() waits for the flush instead of stranding them."""
+        import time as _time
+
+        x, km, _ = fitted
+        # Long coalesce window so stop() overlaps a queued-but-undispatched
+        # request: the drain must still deliver it.
+        app = _mk_app(model_root, max_wait_ms=200.0)
+        fut = asyncio.run_coroutine_threadsafe(
+            app.batcher.submit("km", "predict", x[:16]), app._loop
+        )
+        _time.sleep(0.05)  # let the submit enqueue, not yet dispatched
+        app.stop()
+        out = fut.result(timeout=5)  # resolved, not Overloaded
+        want = np.asarray(kmeans_predict(x[:16], km.centroids))
+        np.testing.assert_array_equal(out, want)
+
+    def test_batcher_drain_rejects_new_submits(self, fitted, model_root):
+        x, _, _ = fitted
+        app = _mk_app(model_root)
+        try:
+            app.batcher.draining = True
+            with pytest.raises(Overloaded, match="draining"):
+                _run_async(app, app.batcher.submit("km", "predict", x[:4]))
+        finally:
+            app.batcher.draining = False
+            app.stop()
+
+    def test_begin_drain_keeps_listener_answering(self, model_root):
+        """SIGTERM wiring (cli/serve -> begin_drain): the listener keeps
+        answering during the linger window — new work gets the promised
+        503, NOT connection-refused — and serve threads wind down after."""
+        import urllib.error
+
+        app = _mk_app(model_root)
+        port = app.start_http(port=0)
+        url = f"http://127.0.0.1:{port}"
+        assert urllib.request.urlopen(url + "/readyz").status == 200
+        app.begin_drain(linger=0.6)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/readyz")
+        assert ei.value.code == 503  # still listening, now draining
+        # liveness stays 200 through the drain
+        assert urllib.request.urlopen(url + "/healthz").status == 200
+        app.stop()

@@ -162,6 +162,101 @@ class TestRunGangSmall:
             assert f"dir {tmp_path / 'shared'}" in open(path).read()
 
 
+class TestRestartPolicy:
+    """Progress-aware budget, backoff, and log/heartbeat hygiene — cheap
+    no-jax workers."""
+
+    def test_progress_resets_restart_budget(self, tmp_path):
+        # Each attempt writes a NEW checkpoint step then crashes; attempt 3
+        # succeeds. With max_restarts=1 a naive counter would fail on the
+        # second crash — progress between crashes must reset it.
+        ck = tmp_path / "ck"
+        ck.mkdir()
+        script = textwrap.dedent("""
+            import os, sys
+            a = int(os.environ["TDC_ATTEMPT"])
+            os.makedirs(os.path.join(os.environ["TDC_CKPT_DIR"],
+                                     f"step_{a:08d}"), exist_ok=True)
+            sys.exit(0 if a == 3 else 1)
+        """)
+        echoes = []
+        res = run_gang(
+            [sys.executable, "-c", script], 1, max_restarts=1,
+            ckpt_dirs=[str(ck)], log_dir=str(tmp_path / "logs"),
+            echo=echoes.append, backoff_base=0,
+        )
+        assert res.attempts == 4
+        assert res.budget_used == 1  # never accumulated past 1
+        assert any("resetting restart budget" in m for m in echoes), echoes
+
+    def test_no_progress_crash_loop_exhausts_budget(self, tmp_path):
+        # Same step every attempt: a genuine crash loop must still die
+        # after 1 + max_restarts launches despite checkpoints existing.
+        ck = tmp_path / "ck"
+        ck.mkdir()
+        os.makedirs(ck / "step_00000001")
+        script = "import sys; sys.exit(1)"
+        with pytest.raises(GangFailed, match="restart budget exhausted"):
+            run_gang(
+                [sys.executable, "-c", script], 1, max_restarts=1,
+                ckpt_dirs=[str(ck)], log_dir=str(tmp_path / "logs"),
+                echo=lambda _: None, backoff_base=0,
+            )
+        logs = [n for n in os.listdir(tmp_path / "logs")
+                if n.startswith("worker_a")]
+        assert len(logs) == 2  # exactly 1 + max_restarts launches
+
+    def test_backoff_between_failure_relaunches(self, tmp_path):
+        script = textwrap.dedent("""
+            import os, sys
+            sys.exit(0 if os.environ["TDC_ATTEMPT"] == "2" else 1)
+        """)
+        echoes = []
+        res = run_gang(
+            [sys.executable, "-c", script], 1, max_restarts=2,
+            log_dir=str(tmp_path), echo=echoes.append,
+            backoff_base=0.1, backoff_max=1.0,
+        )
+        assert res.attempts == 3
+        assert len(res.restart_delays) == 2
+        # exponential-with-jitter envelope: base*2^(n-1) * [0.5, 1.5]
+        assert 0.05 <= res.restart_delays[0] <= 0.15
+        assert 0.10 <= res.restart_delays[1] <= 0.30
+        assert sum("backing off" in m for m in echoes) == 2
+
+    def test_heartbeat_files_pruned_after_attempts(self, tmp_path):
+        script = textwrap.dedent("""
+            import os, sys
+            hb = os.environ["TDC_HEARTBEAT_FILE"]
+            open(hb, "a").close(); os.utime(hb, None)
+            sys.exit(0 if os.environ["TDC_ATTEMPT"] == "1" else 1)
+        """)
+        res = run_gang(
+            [sys.executable, "-c", script], 2, max_restarts=1,
+            heartbeat_timeout=60.0, log_dir=str(tmp_path),
+            echo=lambda _: None, backoff_base=0,
+        )
+        assert res.attempts == 2
+        # worker logs stay (postmortem material); heartbeat files don't
+        names = os.listdir(tmp_path)
+        assert not [n for n in names if n.startswith("hb_")], names
+        assert len([n for n in names if n.startswith("worker_a")]) == 4
+
+    def test_gangfailed_tails_name_the_failed_attempt(self, tmp_path):
+        with pytest.raises(GangFailed) as ei:
+            run_gang(
+                [sys.executable, "-c",
+                 "print('from the last attempt'); import sys; sys.exit(5)"],
+                1, max_restarts=1, log_dir=str(tmp_path),
+                echo=lambda _: None, backoff_base=0,
+            )
+        msg = str(ei.value)
+        # The tails header names the attempt the tail came from, so a
+        # postmortem doesn't misread attempt-0 output as the final state.
+        assert "--- worker 0 (attempt 2) ---" in msg
+        assert "from the last attempt" in msg
+
+
 _ELASTIC_WORKER = textwrap.dedent("""
     import os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -434,9 +529,12 @@ def test_sharded_gang_kill_and_resume_matches_uninterrupted(tmp_path):
     # The successful attempt resumed from the last aligned checkpoint:
     # the injected crash hits iteration 4 after checkpoints 1..3 (a kill
     # mid-overwrite of step 3 legitimately falls back to step 2, same as
-    # the 1-D test); a crashed RELAUNCH may have checkpointed further.
+    # the 1-D test); a crashed RELAUNCH may have checkpointed further —
+    # up to step 6 (max_iters), when it finished every iteration and then
+    # lost the gang to a teardown race in the final pass/exit barrier
+    # (observed under 2-core full-suite contention).
     step = int(resumed[-1].rsplit("common step", 1)[1])
-    assert 2 <= step <= 5, echoes
+    assert 2 <= step <= 6, echoes
     for pid in range(2):
         iters_run = int((outdir / f"iters_run_{pid}_a{final}").read_text())
         assert iters_run == 6 - step  # resumed, not restarted from scratch
