@@ -1,13 +1,16 @@
 #!/usr/bin/env bash
 # Tier-1 verify, one command (ROADMAP.md "Tier-1 verify"): the CPU-mesh
 # test suite (8 virtual devices via tests/conftest.py) minus slow-marked
-# tests, plus a lint pass. The suite-green invariant every PR must hold.
+# tests, the comms + chaos smokes, and the tdclint static-analysis gate.
+# The suite-green invariant every PR must hold.
 #
-#   scripts/ci_tier1.sh            # tests + lint
+#   scripts/ci_tier1.sh            # tests + smokes + lint
 #   SKIP_LINT=1 scripts/ci_tier1.sh
 #
-# Exit code: pytest's (lint failures print but only fail when ruff exists
-# and reports errors).
+# Exit code: the FIRST failing stage's code (pytest, then comms smoke,
+# then chaos smoke, then lint), with every failed stage named on stderr —
+# a run where pytest passes but both smokes fail must say so, not
+# silently collapse into one opaque code.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -23,7 +26,7 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
     --strict-markers \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$log"
-rc=${PIPESTATUS[0]}
+pytest_rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$log" | tr -cd . | wc -c)"
 
 # Comms-strategy smoke (parallel/reduce): proves per-pass reduction issues
@@ -48,23 +51,37 @@ if [ -z "$SKIP_CHAOS_SMOKE" ]; then
         --strict-markers -p no:cacheprovider || chaos_rc=$?
 fi
 
+# Lint gate — tdclint (tdc_tpu/lint, docs/LINTING.md) is stdlib-only and
+# therefore ALWAYS runs and ALWAYS gates: the pre-PR-4 fallback that
+# degraded to a warning when the image shipped no ruff is exactly how a
+# seeded gang-deadlock pattern would have sailed through CI. Findings
+# not in the committed baseline (scripts/tdclint_baseline.json) fail the
+# build; ruff remains an additive extra when present.
 lint_rc=0
+ruff_rc=0
 if [ -z "$SKIP_LINT" ]; then
+    timeout -k 10 120 python -m tdc_tpu.lint \
+        --baseline=scripts/tdclint_baseline.json tdc_tpu/ tests/ \
+        || lint_rc=$?
     if command -v ruff >/dev/null 2>&1; then
-        ruff check tdc_tpu/ tests/
-        lint_rc=$?
-    else
-        # The CI image bakes a fixed dependency set; a container without
-        # ruff degrades the lint gate to a WARNING (the compile-only check
-        # still prints what it finds, but cannot fail the script — tier-1
-        # must be runnable on images that never shipped the linter).
-        echo "ruff not installed; lint gate degraded to a warning"
-        python -m compileall -q tdc_tpu/ tests/ \
-            || echo "WARNING: compile-only check found errors (not gating)"
+        ruff check tdc_tpu/ tests/ || ruff_rc=$?
     fi
 fi
 
-if [ "$rc" -ne 0 ]; then exit "$rc"; fi
-if [ "$comms_rc" -ne 0 ]; then exit "$comms_rc"; fi
-if [ "$chaos_rc" -ne 0 ]; then exit "$chaos_rc"; fi
-exit "$lint_rc"
+# First-failure exit, every failure named: the old cascade exited with
+# whichever stage happened to be checked first and said nothing about
+# the rest — "exit 1" with pytest green left comms vs chaos ambiguous.
+overall=0
+for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
+             "chaos-smoke:$chaos_rc" "tdclint:$lint_rc" "ruff:$ruff_rc"; do
+    name=${stage%%:*}
+    rc=${stage##*:}
+    if [ "$rc" -ne 0 ]; then
+        echo "ci_tier1: stage '$name' FAILED (exit $rc)" >&2
+        if [ "$overall" -eq 0 ]; then overall=$rc; fi
+    fi
+done
+if [ "$overall" -eq 0 ]; then
+    echo "ci_tier1: all stages green (pytest, comms-smoke, chaos-smoke, lint)" >&2
+fi
+exit "$overall"

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# tdclint wrapper — the exact lint stage ci_tier1.sh runs, standalone
+# (docs/LINTING.md). No make, no third-party deps.
+#
+#   scripts/lint.sh                      # gate against the baseline
+#   scripts/lint.sh --format=github      # CI annotations
+#   scripts/lint.sh --write-baseline     # shrink the baseline after fixes
+#   scripts/lint.sh path/to/file.py      # spot-check specific paths
+#
+# Extra args pass through; paths default to the repo-wide tree.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+args=()
+paths=()
+for a in "$@"; do
+    case "$a" in
+        -*) args+=("$a") ;;
+        *) paths+=("$a") ;;
+    esac
+done
+if [ ${#paths[@]} -eq 0 ]; then
+    paths=(tdc_tpu/ tests/)
+fi
+
+exec python -m tdc_tpu.lint \
+    --baseline=scripts/tdclint_baseline.json \
+    "${args[@]+"${args[@]}"}" "${paths[@]}"

@@ -3,36 +3,56 @@
 Re-implements the capabilities of Jhonsonzhangxing/tensorflow-distributed-clustering
 (multi-GPU TF 1.x distributed K-Means / Fuzzy C-Means) as an idiomatic
 JAX / XLA / Pallas / pjit framework for TPU meshes.
+
+The public names below resolve lazily (PEP 562): `import tdc_tpu` is
+cheap and pulls in NO third-party packages. That is a hard requirement —
+`python -m tdc_tpu.lint` (the stdlib-only CI lint gate, docs/LINTING.md)
+imports this package as a side effect of `-m`, and must run on an image
+with no jax at all; it also shaves the jax import off every CLI startup
+that doesn't touch a model. `from tdc_tpu import KMeans` still works:
+the attribute access triggers the submodule import.
 """
 
 __version__ = "0.1.0"
 
-from tdc_tpu.models.kmeans import KMeansResult, kmeans_fit, kmeans_predict
-from tdc_tpu.models.fuzzy import FuzzyCMeansResult, fuzzy_cmeans_fit
-from tdc_tpu.models.gmm import GMMResult, gmm_fit, gmm_predict
-from tdc_tpu.models.estimators import FuzzyCMeans, GaussianMixture, KMeans
-from tdc_tpu.analysis.metrics import (
-    calinski_harabasz_score,
-    davies_bouldin_score,
-    silhouette_score,
-)
-from tdc_tpu.parallel.mesh import make_mesh
+# name -> (submodule, attribute) — the eager import surface this module
+# used to expose, now resolved on first attribute access.
+_LAZY = {
+    "KMeansResult": ("tdc_tpu.models.kmeans", "KMeansResult"),
+    "kmeans_fit": ("tdc_tpu.models.kmeans", "kmeans_fit"),
+    "kmeans_predict": ("tdc_tpu.models.kmeans", "kmeans_predict"),
+    "FuzzyCMeansResult": ("tdc_tpu.models.fuzzy", "FuzzyCMeansResult"),
+    "fuzzy_cmeans_fit": ("tdc_tpu.models.fuzzy", "fuzzy_cmeans_fit"),
+    "GMMResult": ("tdc_tpu.models.gmm", "GMMResult"),
+    "gmm_fit": ("tdc_tpu.models.gmm", "gmm_fit"),
+    "gmm_predict": ("tdc_tpu.models.gmm", "gmm_predict"),
+    "KMeans": ("tdc_tpu.models.estimators", "KMeans"),
+    "FuzzyCMeans": ("tdc_tpu.models.estimators", "FuzzyCMeans"),
+    "GaussianMixture": ("tdc_tpu.models.estimators", "GaussianMixture"),
+    "silhouette_score": ("tdc_tpu.analysis.metrics", "silhouette_score"),
+    "davies_bouldin_score": (
+        "tdc_tpu.analysis.metrics", "davies_bouldin_score"),
+    "calinski_harabasz_score": (
+        "tdc_tpu.analysis.metrics", "calinski_harabasz_score"),
+    "make_mesh": ("tdc_tpu.parallel.mesh", "make_mesh"),
+}
 
-__all__ = [
-    "KMeansResult",
-    "kmeans_fit",
-    "kmeans_predict",
-    "FuzzyCMeansResult",
-    "fuzzy_cmeans_fit",
-    "GMMResult",
-    "gmm_fit",
-    "gmm_predict",
-    "KMeans",
-    "FuzzyCMeans",
-    "GaussianMixture",
-    "silhouette_score",
-    "davies_bouldin_score",
-    "calinski_harabasz_score",
-    "make_mesh",
-    "__version__",
-]
+__all__ = [*_LAZY, "__version__"]
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted(__all__)

@@ -151,9 +151,16 @@ def main(argv=None) -> int:
     import signal
 
     def _drain(signum, frame):
-        print("serve: SIGTERM — draining "
-              f"(listener up {args.drain_linger}s)", file=sys.stderr,
-              flush=True)
+        # Async-signal context: print/emit into a buffered stderr the
+        # signal may have interrupted raises RuntimeError('reentrant
+        # call') inside the handler (the utils/preempt._on_signal rule,
+        # TDC004). One raw fd-2 write is the whole breadcrumb; the drain
+        # machinery logs properly once it runs outside the handler.
+        try:
+            os.write(2, b'{"event": "serve_drain_begin", '
+                        b'"linger_s": %d}\n' % int(args.drain_linger))
+        except OSError:
+            pass
         app.begin_drain(linger=args.drain_linger)
 
     try:

@@ -1,0 +1,28 @@
+"""tdclint — the repo's own SPMD static-analysis suite (docs/LINTING.md).
+
+Zero third-party imports by design: the CI image ships no ruff, and the
+lint gate must never again silently degrade to a warning because a linter
+is missing (scripts/ci_tier1.sh pre-PR-4). Everything here is stdlib
+`ast` + `tokenize`; `python -m tdc_tpu.lint tdc_tpu/ tests/` runs on a
+bare Python 3.10.
+
+The rules are not generic style checks — each codifies a bug CLASS this
+repo has already paid for once (see docs/LINTING.md for the ancestry):
+
+    TDC001  collective-divergence        gang deadlock (PR 3 mid-pass stop)
+    TDC002  host-sync-in-hot-loop        erased comms wins (PR 2)
+    TDC003  recompile-hazard             serve zero-recompile contract
+    TDC004  signal-unsafe-handler        reentrant print in SIGTERM (PR 3)
+    TDC005  fault-point-drift            vacuously-green chaos tests
+    TDC006  structlog-event-drift        ungreppable run logs
+    TDC007  nondeterministic-ckpt-path   bit-identical resume contract
+    TDC008  axis-name-mismatch           hierarchical-mesh psum axes (PR 2)
+
+`jaxpr_check` (the compile-time companion) lives in this package but is
+imported only by tests and explicit callers — it needs jax; the CLI and
+the engine never touch it.
+"""
+
+from tdc_tpu.lint.engine import Finding, LintResult, all_rules, run_paths
+
+__all__ = ["Finding", "LintResult", "all_rules", "run_paths"]

@@ -1,0 +1,5 @@
+import sys
+
+from tdc_tpu.lint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,126 @@
+"""Grandfathered-findings baseline: the ratchet that lets tdclint gate CI
+on a codebase that predates it.
+
+A baseline entry fingerprints a finding by (rule, path, source-line text)
+— deliberately NOT the line number, so unrelated edits above a
+grandfathered finding don't resurrect it. Multiplicity is kept: two
+identical `float(x)` lines in one file need a count of 2, and fixing one
+of them makes the run fail until the baseline is regenerated smaller —
+the count only goes down.
+
+Workflow (docs/LINTING.md):
+
+    python -m tdc_tpu.lint --baseline=scripts/tdclint_baseline.json tdc_tpu/ tests/
+    # fix findings, then shrink the baseline:
+    python -m tdc_tpu.lint --baseline=... --write-baseline tdc_tpu/ tests/
+
+Stale entries (fingerprints no longer matching any finding) are reported
+as a non-gating notice so the file gets regenerated rather than rotting.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+
+from tdc_tpu.lint.engine import Finding
+
+BASELINE_VERSION = 1
+
+
+def fingerprint(f: Finding) -> str:
+    path = f.path.replace(os.sep, "/")
+    key = f"{f.rule}|{path}|{f.snippet}"
+    return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+
+@dataclass
+class BaselineResult:
+    new: list[Finding]  # findings NOT covered by the baseline — these gate
+    grandfathered: int  # findings absorbed by the baseline
+    stale: list[str]  # baseline fingerprints with no matching finding
+
+
+def normalize_paths(paths: list[str]) -> list[str]:
+    return sorted(os.path.normpath(p).replace(os.sep, "/") for p in paths)
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r} "
+            f"(want {BASELINE_VERSION})"
+        )
+    return data
+
+
+def covers_run(baseline: dict, paths: list[str]) -> bool:
+    """Does this run lint the same path set the baseline was generated
+    from? On a partial run (spot-checking one file) most baseline entries
+    trivially match nothing — reporting them as stale, or letting
+    --write-baseline rewrite the file from the partial findings, would
+    wipe the ratchet."""
+    recorded = baseline.get("paths")
+    if recorded is None:  # pre-paths baseline: assume covered (legacy)
+        return True
+    return normalize_paths(paths) == list(recorded)
+
+
+def apply(findings: list[Finding], baseline: dict) -> BaselineResult:
+    budget = {
+        fp: int(meta.get("count", 1))
+        for fp, meta in baseline.get("fingerprints", {}).items()
+    }
+    used: dict[str, int] = {}
+    new: list[Finding] = []
+    grandfathered = 0
+    for f in findings:
+        fp = fingerprint(f)
+        if used.get(fp, 0) < budget.get(fp, 0):
+            used[fp] = used.get(fp, 0) + 1
+            grandfathered += 1
+        else:
+            new.append(f)
+    stale = sorted(fp for fp, n in budget.items() if used.get(fp, 0) < n)
+    return BaselineResult(new, grandfathered, stale)
+
+
+def write(path: str, findings: list[Finding],
+          paths: list[str] | None = None) -> dict:
+    """Serialize `findings` as the new baseline (human-reviewable: each
+    fingerprint carries rule/path/snippet so diffs of the committed file
+    read as a findings ledger, not hash soup). `paths` records the linted
+    path set so partial runs can be refused at the next regeneration."""
+    fps: dict[str, dict] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        fp = fingerprint(f)
+        if fp in fps:
+            fps[fp]["count"] += 1
+        else:
+            fps[fp] = {
+                "count": 1,
+                "rule": f.rule,
+                "path": f.path.replace(os.sep, "/"),
+                "snippet": f.snippet,
+                "message": f.message,
+            }
+    data = {
+        "version": BASELINE_VERSION,
+        "paths": normalize_paths(paths or []),
+        "note": (
+            "tdclint grandfathered findings — regenerate with "
+            "`python -m tdc_tpu.lint --baseline=<this file> "
+            "--write-baseline <paths>`; the total count must only go down."
+        ),
+        "fingerprints": fps,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data

@@ -1,0 +1,199 @@
+"""`python -m tdc_tpu.lint` — the CLI over engine + baseline.
+
+Exit codes: 0 clean (or fully grandfathered/suppressed), 1 findings,
+2 usage error. `--format=github` emits workflow-command annotations;
+`--format=json` is the machine interface (schema tested in
+tests/test_lint.py::test_json_schema).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from tdc_tpu.lint import baseline as baseline_mod
+from tdc_tpu.lint.engine import Finding, all_rules, run_paths
+
+
+def _fmt_text(findings: list[Finding]) -> str:
+    return "\n".join(
+        f"{f.location()}: {f.rule} {f.name}: {f.message}" for f in findings
+    )
+
+
+def _fmt_github(findings: list[Finding]) -> str:
+    out = []
+    for f in findings:
+        # Workflow-command escaping: %0A etc. per GitHub's spec.
+        msg = (f.message.replace("%", "%25").replace("\r", "%0D")
+               .replace("\n", "%0A"))
+        out.append(
+            f"::error file={f.path},line={f.line},col={f.col},"
+            f"title={f.rule} {f.name}::{msg}"
+        )
+    return "\n".join(out)
+
+
+def _fmt_json(findings, result, base_res, elapsed) -> str:
+    return json.dumps({
+        "version": 1,
+        "files": result.files,
+        "elapsed_seconds": round(elapsed, 3),
+        "counts": {
+            "new": len(findings),
+            "grandfathered": base_res.grandfathered if base_res else 0,
+            "suppressed": result.suppressed,
+            "stale_baseline": len(base_res.stale) if base_res else 0,
+        },
+        "findings": [
+            {
+                "rule": f.rule,
+                "name": f.name,
+                "path": f.path.replace("\\", "/"),
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+                "snippet": f.snippet,
+                "fingerprint": baseline_mod.fingerprint(f),
+            }
+            for f in findings
+        ],
+    }, indent=2)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tdc_tpu.lint",
+        description="tdclint: zero-dependency SPMD static analysis "
+                    "(docs/LINTING.md)",
+    )
+    p.add_argument("paths", nargs="*", help="files and/or directories")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text")
+    p.add_argument("--baseline", metavar="PATH",
+                   help="grandfathered-findings file (JSON)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite --baseline from the current findings "
+                        "(the ratchet: regenerate after fixing, never to "
+                        "admit new findings)")
+    p.add_argument("--select", metavar="CODES",
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.code}  {r.name}\n    {r.description}")
+        return 0
+    if not args.paths:
+        p.error("no paths given (try: python -m tdc_tpu.lint tdc_tpu/ tests/)")
+    if args.write_baseline and not args.baseline:
+        p.error("--write-baseline requires --baseline=PATH")
+    if args.write_baseline and args.select:
+        # A baseline written from a rule subset's findings drops every
+        # other rule's grandfathered entries — the rule-selection twin of
+        # the partial-path wipe refused below.
+        p.error("--write-baseline cannot be combined with --select "
+                "(it would drop every unselected rule's baseline entries)")
+
+    select = None
+    if args.select:
+        select = {c.strip().upper() for c in args.select.split(",")
+                  if c.strip()}
+        known = {r.code for r in all_rules()}
+        bad = select - known - {"TDC000"}
+        if bad:
+            p.error(f"unknown rule codes: {sorted(bad)}")
+
+    t0 = time.monotonic()
+    try:
+        result = run_paths(args.paths, select=select)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        # Partial-path guard: regenerating from a subset of the recorded
+        # paths would rewrite the baseline with only that subset's
+        # findings — silently wiping the ratchet for everything else.
+        try:
+            existing = baseline_mod.load(args.baseline)
+        except FileNotFoundError:
+            existing = None
+        if existing is not None and \
+                not baseline_mod.covers_run(existing, args.paths):
+            print(
+                f"tdclint: refusing --write-baseline: {args.baseline} was "
+                f"generated from paths {existing.get('paths')} but this "
+                f"run lints "
+                f"{baseline_mod.normalize_paths(args.paths)} — a partial "
+                "regeneration would drop every grandfathered finding "
+                "outside this run. Re-run with the recorded paths (or "
+                "delete the baseline file to rebase deliberately).",
+                file=sys.stderr,
+            )
+            return 2
+        baseline_mod.write(args.baseline, result.findings, args.paths)
+        print(
+            f"tdclint: baseline {args.baseline} written with "
+            f"{len(result.findings)} grandfathered finding(s) across "
+            f"{result.files} file(s)"
+        )
+        return 0
+
+    base_res = None
+    findings = result.findings
+    full_run = True
+    if args.baseline:
+        try:
+            base = baseline_mod.load(args.baseline)
+        except FileNotFoundError:
+            print(
+                f"tdclint: baseline {args.baseline} not found — treating "
+                "every finding as new (generate it with --write-baseline)",
+                file=sys.stderr,
+            )
+        else:
+            base_res = baseline_mod.apply(findings, base)
+            findings = base_res.new
+            full_run = (baseline_mod.covers_run(base, args.paths)
+                        and select is None)
+            if not full_run:
+                # Partial run (path subset OR rule subset): unmatched
+                # baseline entries are expected, not stale — reporting
+                # them (in any format) steers the user into a
+                # ratchet-wiping partial regeneration.
+                base_res.stale = []
+
+    if args.format == "json":
+        print(_fmt_json(findings, result, base_res, elapsed))
+    elif args.format == "github":
+        if findings:
+            print(_fmt_github(findings))
+    else:
+        if findings:
+            print(_fmt_text(findings))
+        gf = base_res.grandfathered if base_res else 0
+        stale = len(base_res.stale) if base_res else 0
+        summary = (
+            f"tdclint: {len(findings)} new finding(s) in {result.files} "
+            f"file(s) ({gf} grandfathered, {result.suppressed} suppressed"
+            f"{', ' + str(stale) + ' STALE baseline entries' if stale else ''}"
+            f") in {elapsed:.2f}s"
+        )
+        print(summary, file=sys.stderr)
+        if stale:
+            print(
+                "tdclint: stale baseline entries mean findings were fixed "
+                "— shrink the baseline with --write-baseline so the count "
+                "keeps ratcheting down",
+                file=sys.stderr,
+            )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,325 @@
+"""The tdclint engine: file walking, suppression comments, rule driving.
+
+Stdlib only (ast + tokenize) — see the package docstring for why that is
+a hard requirement, not a style choice.
+
+Rule protocol: a rule object carries `code`/`name`/`description`, a
+per-file `check(ctx)` yielding Findings, and an optional whole-program
+`finalize()` yielding Findings after every file was checked (the drift
+rules cross-reference call sites against a registry that lives in a
+different module, so they cannot judge file-by-file). Rule objects are
+instantiated fresh per run; accumulating state on `self` during check()
+is the supported idiom.
+
+Suppressions (tokenize-driven, so strings that merely *contain* the
+marker text never count):
+
+    x = float(dev_val)        # tdclint: disable=TDC002
+    # tdclint: disable-next-line=TDC001,TDC004
+    offending_line()
+    # tdclint: disable-file=TDC007     (anywhere in the file)
+
+`disable=all` works in every position. Suppressed findings are counted
+but never reported or gated on.
+
+Directory walking skips `__pycache__`, hidden dirs, and any directory
+containing a `.tdclint-exclude` marker file (the golden-fixture corpus
+under tests/lint_fixtures/ is deliberate rule violations — it must not
+fail the repo-wide run). Files passed explicitly on the command line are
+always linted, marker or not: that is how the fixture tests lint the
+fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+
+EXCLUDE_MARKER = ".tdclint-exclude"
+
+# Engine-level pseudo-rule: a file that does not parse cannot be analyzed
+# — and a syntax error reaching CI is exactly what the old degraded
+# `compileall` warning path let through. Gates like any other finding.
+SYNTAX_ERROR_CODE = "TDC000"
+
+# The codes group is anchored to CODE-shaped tokens (TDCnnn / all) so a
+# trailing justification — "disable=TDC002 host-only row count", the form
+# the rule messages tell users to write — is prose, not part of the list.
+_SUPPRESS_RE = re.compile(
+    r"#\s*tdclint:\s*(disable|disable-next-line|disable-file)\s*=\s*"
+    r"((?:[A-Za-z]+\d+|all)(?:\s*,\s*(?:[A-Za-z]+\d+|all))*)",
+    re.IGNORECASE,
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # "TDC001"
+    name: str  # "collective-divergence"
+    path: str  # as passed/walked (relative paths stay relative)
+    line: int  # 1-based
+    col: int  # 1-based (ast col_offset + 1)
+    message: str
+    snippet: str  # stripped source line — the baseline fingerprint input
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]  # reported (post-suppression, pre-baseline)
+    suppressed: int  # count silenced by tdclint: disable comments
+    files: int  # files analyzed
+
+    def by_rule(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+
+class FileContext:
+    """One parsed file handed to each rule's check()."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0) + 1
+        return Finding(rule.code, rule.name, self.path, line, col, message,
+                       self.snippet(line))
+
+
+# --------------------------------------------------------------------------
+# Shared AST helpers (used by every rule module)
+# --------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.lax.psum' for an Attribute chain, 'psum' for a Name, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def last_seg(name: str | None) -> str | None:
+    return None if name is None else name.rsplit(".", 1)[-1]
+
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_calls(root: ast.AST):
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+# --------------------------------------------------------------------------
+# Suppression comments
+# --------------------------------------------------------------------------
+
+
+class Suppressions:
+    def __init__(self, source: str):
+        self.file_codes: set[str] = set()  # 'ALL' sentinel or TDCnnn
+        self.line_codes: dict[int, set[str]] = {}
+        try:
+            # stmt_start tracks the first line of the current LOGICAL
+            # line: a trailing `# tdclint: disable=` on a black-wrapped
+            # multi-line statement must cover the whole statement, whose
+            # findings anchor to its first physical line.
+            stmt_start: int | None = None
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type == tokenize.NEWLINE:
+                    stmt_start = None
+                    continue
+                if tok.type != tokenize.COMMENT:
+                    if stmt_start is None and tok.type not in (
+                            tokenize.NL, tokenize.INDENT, tokenize.DEDENT,
+                            tokenize.ENCODING, tokenize.ENDMARKER):
+                        stmt_start = tok.start[0]
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                kind = m.group(1)
+                codes = {
+                    c.strip().upper() for c in m.group(2).split(",")
+                    if c.strip()
+                }
+                if "ALL" in codes:
+                    codes = {"ALL"}
+                if kind == "disable-file":
+                    self.file_codes |= codes
+                elif kind == "disable-next-line":
+                    self.line_codes.setdefault(
+                        tok.start[0] + 1, set()
+                    ).update(codes)
+                else:  # disable — every line of the logical statement
+                    for line in range(stmt_start or tok.start[0],
+                                      tok.start[0] + 1):
+                        self.line_codes.setdefault(
+                            line, set()
+                        ).update(codes)
+        except (tokenize.TokenError, IndentationError):
+            pass  # the parse error is reported separately
+
+    def suppressed(self, finding: Finding) -> bool:
+        if "ALL" in self.file_codes or finding.rule in self.file_codes:
+            return True
+        codes = self.line_codes.get(finding.line, ())
+        return "ALL" in codes or finding.rule in codes
+
+
+# --------------------------------------------------------------------------
+# File collection
+# --------------------------------------------------------------------------
+
+
+def collect_files(paths: list[str]) -> list[str]:
+    """Explicit files always included; directories walked recursively for
+    .py, skipping __pycache__/hidden/.tdclint-exclude-marked dirs."""
+    out: list[str] = []
+    seen: set[str] = set()
+
+    def add(p: str) -> None:
+        key = os.path.abspath(p)
+        if key not in seen:
+            seen.add(key)
+            out.append(p)
+
+    for p in paths:
+        if os.path.isfile(p):
+            add(p)
+            continue
+        if not os.path.isdir(p):
+            raise FileNotFoundError(f"tdclint: no such file or directory: {p}")
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".") and d != "__pycache__"
+                and not os.path.exists(os.path.join(root, d, EXCLUDE_MARKER))
+            )
+            if os.path.exists(os.path.join(root, EXCLUDE_MARKER)):
+                dirs[:] = []
+                continue
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    add(os.path.join(root, name))
+    return out
+
+
+# --------------------------------------------------------------------------
+# The run
+# --------------------------------------------------------------------------
+
+
+def all_rules() -> list:
+    """Fresh rule instances (rules keep per-run state on self)."""
+    from tdc_tpu.lint.rules_collective import (
+        CollectiveDivergence, AxisNameMismatch,
+    )
+    from tdc_tpu.lint.rules_hostsync import HostSyncInHotLoop, RecompileHazard
+    from tdc_tpu.lint.rules_signal import SignalUnsafeHandler
+    from tdc_tpu.lint.rules_drift import (
+        FaultPointDrift, StructlogEventDrift, NondeterministicCkptPath,
+    )
+
+    return [
+        CollectiveDivergence(),
+        HostSyncInHotLoop(),
+        RecompileHazard(),
+        SignalUnsafeHandler(),
+        FaultPointDrift(),
+        StructlogEventDrift(),
+        NondeterministicCkptPath(),
+        AxisNameMismatch(),
+    ]
+
+
+def run_paths(paths: list[str], select: set[str] | None = None) -> LintResult:
+    """Lint `paths` (files and/or directories) with every rule (or the
+    `select` subset of codes). Returns reported findings with suppression
+    comments already applied; baseline filtering is the caller's layer
+    (tdc_tpu.lint.baseline)."""
+    files = collect_files(paths)
+    rules = [r for r in all_rules()
+             if select is None or r.code in select]
+    reported: list[Finding] = []
+    suppressed = 0
+    sups: dict[str, Suppressions] = {}
+
+    for path in files:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                source = f.read()
+        except OSError as e:
+            reported.append(Finding(
+                SYNTAX_ERROR_CODE, "unreadable-file", path, 1, 1,
+                f"cannot read file: {e}", ""))
+            continue
+        sups[path] = Suppressions(source)
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            reported.append(Finding(
+                SYNTAX_ERROR_CODE, "syntax-error", path, e.lineno or 1,
+                (e.offset or 0) + 1, f"syntax error: {e.msg}",
+                (e.text or "").strip()))
+            continue
+        ctx = FileContext(path, source, tree)
+        for rule in rules:
+            for finding in rule.check(ctx):
+                if sups[path].suppressed(finding):
+                    suppressed += 1
+                else:
+                    reported.append(finding)
+
+    for rule in rules:
+        for finding in rule.finalize():
+            sup = sups.get(finding.path)
+            if sup is not None and sup.suppressed(finding):
+                suppressed += 1
+            else:
+                reported.append(finding)
+
+    reported.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    # Identical (rule, location) duplicates collapse — nested control flow
+    # can reach the same offending call twice (e.g. an if inside an if,
+    # both with host-local conditions).
+    deduped: list[Finding] = []
+    seen_keys: set[tuple] = set()
+    for f in reported:
+        key = (f.rule, f.path, f.line, f.col)
+        if key not in seen_keys:
+            seen_keys.add(key)
+            deduped.append(f)
+    return LintResult(deduped, suppressed, len(files))

@@ -1,0 +1,191 @@
+"""TDC001 collective-divergence and TDC008 axis-name-mismatch.
+
+SPMD correctness is a *sequence* property: every process must execute the
+same collectives in the same order (Mesh-TensorFlow, arXiv:1811.02084).
+The two rules here catch the lexical versions of breaking it; the
+compile-time version (trace the jaxpr, compare the emitted collective
+sequence) lives in tdc_tpu.lint.jaxpr_check.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tdc_tpu.lint.engine import (
+    FileContext, call_name, last_seg, str_const, walk_calls,
+)
+
+# Collective operations — reaching any of these on a subset of processes
+# deadlocks the rest (PR 3's mid-pass-stop bug: one worker stopped at a
+# batch boundary the others sailed past, and the next pass's psum hung
+# the gang). Matched on the final attribute segment so jax.lax.psum,
+# lax.psum and bare psum all count.
+COLLECTIVE_CALLS = frozenset({
+    "psum", "psum_scatter", "pmax", "pmin", "pmean",
+    "all_gather", "allgather", "ppermute", "all_to_all", "pshuffle",
+    "tree_psum", "process_allgather", "barrier", "sync_global_devices",
+})
+
+# Condition ingredients that differ per process. jax.process_count() is
+# deliberately absent: it is uniform across the gang, so branching on it
+# is SPMD-safe (every process takes the same arm).
+_HOST_LOCAL_CALLS = frozenset({"process_index", "gethostname", "getpid"})
+_HOST_LOCAL_NAMES = frozenset({
+    "process_index", "process_id", "proc_id", "host_id", "rank",
+})
+_HOST_LOCAL_ENV_HINTS = ("PROCESS", "RANK", "HOST", "WORKER")
+
+
+def _is_host_local_cond(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            seg = last_seg(call_name(node))
+            if seg in _HOST_LOCAL_CALLS:
+                return True
+            # os.environ.get("TDC_PROCESS_ID") and friends
+            name = call_name(node) or ""
+            if name.endswith("environ.get") or seg == "getenv":
+                arg = str_const(node.args[0]) if node.args else None
+                if arg and any(h in arg.upper()
+                               for h in _HOST_LOCAL_ENV_HINTS):
+                    return True
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            seg = node.id if isinstance(node, ast.Name) else node.attr
+            if seg in _HOST_LOCAL_NAMES:
+                return True
+    return False
+
+
+class CollectiveDivergence:
+    code = "TDC001"
+    name = "collective-divergence"
+    description = (
+        "a collective (psum/all_gather/barrier/...) is reached under a "
+        "branch whose condition derives from process_index or other "
+        "host-local state — only some processes arrive, the rest of the "
+        "gang deadlocks"
+    )
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.If, ast.IfExp)):
+                test, bodies = node.test, []
+                if isinstance(node, ast.If):
+                    bodies = node.body + node.orelse
+                else:
+                    bodies = [node.body, node.orelse]
+            elif isinstance(node, ast.While):
+                test, bodies = node.test, node.body
+            else:
+                continue
+            if not _is_host_local_cond(test):
+                continue
+            for sub in bodies:
+                for call in walk_calls(sub):
+                    seg = last_seg(call_name(call))
+                    if seg in COLLECTIVE_CALLS:
+                        yield ctx.finding(
+                            self, call,
+                            f"collective '{seg}' under a host-local branch "
+                            f"(condition at line {test.lineno} derives from "
+                            "process_index/host identity): processes that "
+                            "skip this arm never join the collective and "
+                            "the gang deadlocks; hoist the collective out "
+                            "of the branch or gate on gang-uniform state",
+                        )
+
+    def finalize(self):
+        return ()
+
+
+# Collectives that NAME their axis -> positional index of the axis arg
+# (axis_name= kwarg overrides either way).
+_AXIS_USING = {
+    "psum": 1, "psum_scatter": 1, "pmax": 1, "pmin": 1, "pmean": 1,
+    "all_gather": 1, "ppermute": 1, "all_to_all": 1, "pshuffle": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+# Calls whose axis_name/axis_names kwarg DECLARES axes for a mapped region.
+_AXIS_DECLARING = frozenset({
+    "pmap", "shard_map", "smap", "xmap", "Mesh", "make_mesh",
+    "AbstractMesh",
+})
+
+
+class AxisNameMismatch:
+    code = "TDC008"
+    name = "axis-name-mismatch"
+    description = (
+        "a collective names a mesh axis that no pmap/shard_map/Mesh/"
+        "PartitionSpec in the file declares — the classic copy-paste "
+        "between the flat and hierarchical (dcn, ici) towers"
+    )
+
+    def check(self, ctx: FileContext):
+        declared: set[str] = set()
+        bindings: dict[str, str] = {}  # NAME = "axis" constants
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                val = str_const(node.value)
+                if isinstance(tgt, ast.Name) and val is not None:
+                    bindings[tgt.id] = val
+        for call in walk_calls(ctx.tree):
+            seg = last_seg(call_name(call))
+            if seg in _AXIS_USING:
+                continue  # uses are checked in the second sweep
+            for kw in call.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    declared.update(self._axis_strings(kw.value, bindings))
+            if seg in ("Mesh", "AbstractMesh", "make_mesh") and \
+                    len(call.args) >= 2:
+                declared.update(self._axis_strings(call.args[1], bindings))
+            if seg in ("P", "PartitionSpec"):
+                for a in call.args:
+                    declared.update(self._axis_strings(a, bindings))
+
+        if not declared:
+            return  # no declarations in scope — callers own the axes
+
+        for call in walk_calls(ctx.tree):
+            seg = last_seg(call_name(call))
+            if seg not in _AXIS_USING:
+                continue
+            pos = _AXIS_USING[seg]
+            axis_arg = None
+            if len(call.args) > pos:
+                axis_arg = call.args[pos]
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    axis_arg = kw.value
+            if axis_arg is None:
+                continue
+            for axis in self._axis_strings(axis_arg, bindings):
+                if axis not in declared:
+                    yield ctx.finding(
+                        self, call,
+                        f"collective '{seg}' names axis {axis!r} but this "
+                        f"file only declares axes "
+                        f"{sorted(declared)} (pmap/shard_map/Mesh/"
+                        "PartitionSpec) — a mismatched axis name fails at "
+                        "trace time on the real mesh or, worse, binds to "
+                        "the wrong axis of a reshaped hierarchical mesh",
+                    )
+
+    @staticmethod
+    def _axis_strings(node: ast.AST, bindings: dict[str, str]):
+        """Axis-name strings in an expression; Name nodes resolve through
+        NAME = "axis" constants. An unresolvable Name contributes nothing
+        — we cannot judge an axis we cannot see."""
+        out = []
+        for sub in ast.walk(node):
+            s = str_const(sub)
+            if s is not None:
+                out.append(s)
+            elif isinstance(sub, ast.Name) and sub.id in bindings:
+                out.append(bindings[sub.id])
+        return out
+
+    def finalize(self):
+        return ()

@@ -1,0 +1,273 @@
+"""TDC005 fault-point-drift, TDC006 structlog-event-drift, TDC007
+nondeterministic-ckpt-path.
+
+All three are *registry* rules: the value of a fault-point name, a
+structlog event name, or a checkpoint path lies entirely in other code
+(and other people's greps) finding it later. Drift — a renamed point the
+chaos spec still targets, two spellings of one event, a timestamp in a
+path a resume must re-derive — never fails a unit test; it fails the 3 am
+postmortem. TDC005/TDC006 are whole-program checks (finalize()); TDC007
+is lexical.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tdc_tpu.lint.engine import (
+    FileContext, Finding, call_name, dotted_name, last_seg, str_const,
+    walk_calls,
+)
+
+
+class FaultPointDrift:
+    code = "TDC005"
+    name = "fault-point-drift"
+    description = (
+        "fault_point(...) call-site names must match the KNOWN_POINTS "
+        "registry in testing/faults.py exactly, in both directions — a "
+        "drifted name makes $TDC_FAULTS target nothing and the chaos "
+        "test passes vacuously"
+    )
+
+    def __init__(self):
+        self._calls: list[tuple[str, Finding]] = []  # (point, finding-at)
+        self._registry: dict[str, Finding] | None = None
+        self._registry_seen = False
+
+    def check(self, ctx: FileContext):
+        for call in walk_calls(ctx.tree):
+            if last_seg(call_name(call)) != "fault_point" or not call.args:
+                continue
+            point = str_const(call.args[0])
+            f = ctx.finding(self, call, "")
+            if point is None:
+                yield ctx.finding(
+                    self, call.args[0],
+                    "fault_point name must be a string literal — a "
+                    "computed name cannot be cross-checked against the "
+                    "registry (or grepped for in a chaos postmortem)",
+                )
+            else:
+                self._calls.append((point, f))
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == "KNOWN_POINTS":
+                self._registry_seen = True
+                self._registry = {}
+                for sub in ast.walk(node.value):
+                    s = str_const(sub)
+                    if s is not None:
+                        self._registry[s] = ctx.finding(self, sub, "")
+
+    def finalize(self):
+        if not self._registry_seen:
+            # Registry not in the linted file set (e.g. linting one file):
+            # the cross-check cannot run; literal-ness was still enforced.
+            return
+        known = set(self._registry)
+        called = {p for p, _ in self._calls}
+        for point, at in self._calls:
+            if point not in known:
+                yield Finding(
+                    self.code, self.name, at.path, at.line, at.col,
+                    f"fault point {point!r} is not in testing/faults."
+                    f"KNOWN_POINTS {sorted(known)} — add it to the "
+                    "registry (and the module docstring) or fix the typo; "
+                    "a $TDC_FAULTS spec targeting the registry name would "
+                    "inject nothing here",
+                    at.snippet,
+                )
+        # The uncalled-entry direction is only sound when the run
+        # plausibly covers the call sites. Spot-checking faults.py alone
+        # (scripts/lint.sh path/to/file.py) sees the registry but none of
+        # the instrumented modules — every entry would falsely read as
+        # uncalled. Heuristic: sweep only when call sites were seen in
+        # >= 2 files (a tree-wide run) or in the registry's own file (the
+        # self-contained single-file case).
+        registry_paths = {at.path for at in self._registry.values()}
+        call_paths = {at.path for _, at in self._calls}
+        if len(call_paths) < 2 and not (call_paths & registry_paths):
+            return
+        for point in sorted(known - called):
+            at = self._registry[point]
+            yield Finding(
+                self.code, self.name, at.path, at.line, at.col,
+                f"registry entry {point!r} has no fault_point() call site "
+                "anywhere in the linted tree — the instrumentation was "
+                "removed or renamed; chaos specs targeting it pass "
+                "vacuously",
+                at.snippet,
+            )
+
+
+_EVENT_OK = re.compile(r"^[a-z][a-z0-9_.]*$")
+_LOGGY_RECV = re.compile(r"log", re.IGNORECASE)
+
+
+class StructlogEventDrift:
+    code = "TDC006"
+    name = "structlog-event-drift"
+    description = (
+        "structlog emit()/RunLog.event() names must be lowercase_snake "
+        "string literals, with no near-duplicate spellings — the run log "
+        "is an interface for greps and dashboards, and 'ckpt-restore' "
+        "next to 'ckpt_restore' silently halves every query"
+    )
+
+    def __init__(self):
+        self._names: dict[str, list[Finding]] = {}
+
+    def check(self, ctx: FileContext):
+        for call in walk_calls(ctx.tree):
+            name = call_name(call)
+            seg = last_seg(name)
+            is_emit = seg == "emit" and (
+                isinstance(call.func, ast.Name) or
+                (name or "").startswith("structlog."))
+            is_event = False
+            if seg == "event" and isinstance(call.func, ast.Attribute):
+                recv = dotted_name(call.func.value)
+                is_event = bool(recv and _LOGGY_RECV.search(recv))
+            if not (is_emit or is_event) or not call.args:
+                continue
+            ev = str_const(call.args[0])
+            if ev is None:
+                yield ctx.finding(
+                    self, call.args[0],
+                    "structlog event name must be a string literal "
+                    "(f-strings/variables defeat grep and cardinality-"
+                    "bound dashboards); put variability in fields, not "
+                    "the event name",
+                )
+                continue
+            if not _EVENT_OK.match(ev):
+                yield ctx.finding(
+                    self, call.args[0],
+                    f"event name {ev!r} is not lowercase_snake "
+                    "([a-z][a-z0-9_.]*) — mixed case/hyphens/spaces "
+                    "fragment the run-log namespace",
+                )
+                continue
+            self._names.setdefault(ev, []).append(
+                ctx.finding(self, call.args[0], ""))
+
+    def finalize(self):
+        norm: dict[str, dict[str, list[Finding]]] = {}
+        for ev, sites in self._names.items():
+            norm.setdefault(
+                ev.replace(".", "_"), {}
+            )[ev] = sites
+        for variants in norm.values():
+            if len(variants) < 2:
+                continue
+            spellings = sorted(variants)
+            for ev in spellings:
+                for at in variants[ev]:
+                    yield Finding(
+                        self.code, self.name, at.path, at.line, at.col,
+                        f"event name {ev!r} collides with "
+                        f"{[s for s in spellings if s != ev]} after "
+                        "normalization — one event, one spelling",
+                        at.snippet,
+                    )
+
+
+_NONDET = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "uuid.uuid1", "uuid.uuid4", "os.urandom",
+    "random.random", "random.randint", "random.randrange", "random.choice",
+    "random.getrandbits", "secrets.token_hex", "secrets.token_urlsafe",
+}
+_CKPT_HINT = re.compile(r"ckpt|checkpoint|resume", re.IGNORECASE)
+
+
+class NondeterministicCkptPath:
+    code = "TDC007"
+    name = "nondeterministic-ckpt-path"
+    description = (
+        "time/random/uuid feeding checkpoint filenames or resume logic — "
+        "a path the writer derives from a clock is a path the resumer "
+        "can never re-derive, and retention/scan logic silently skips it"
+    )
+
+    def check(self, ctx: FileContext):
+        # Context = a checkpoint-named file, an enclosing function whose
+        # name smells of checkpointing, or a SIMPLE statement that also
+        # mentions a ckpt-ish string/identifier. For a compound statement
+        # (while/if/for...) only its header counts — `while
+        # time.monotonic() < deadline:` must not inherit checkpoint
+        # context from an unrelated statement in its body.
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def enclosing(node, types):
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, types):
+                    return cur
+                cur = parents.get(cur)
+            return None
+
+        file_ckptish = bool(
+            _CKPT_HINT.search(ctx.path.rsplit("/", 1)[-1].rsplit("\\", 1)[-1])
+        )
+
+        for call in walk_calls(ctx.tree):
+            name = call_name(call)
+            if name is None:
+                continue
+            tail = ".".join(name.split(".")[-2:])
+            if tail not in _NONDET and name not in _NONDET:
+                continue
+            func = enclosing(
+                call, (ast.FunctionDef, ast.AsyncFunctionDef))
+            in_ckpt_func = bool(func and _CKPT_HINT.search(func.name))
+            stmt = enclosing(call, (ast.stmt,))
+            scan_root: ast.AST | None = stmt
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                scan_root = stmt.iter
+            elif isinstance(stmt, (ast.While, ast.If)):
+                scan_root = stmt.test
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef, ast.Try, ast.With)):
+                scan_root = None  # header carries no expression of interest
+            stmt_ckptish = False
+            if scan_root is not None:
+                for sub in ast.walk(scan_root):
+                    s = str_const(sub)
+                    ident = (
+                        sub.id if isinstance(sub, ast.Name)
+                        else sub.attr if isinstance(sub, ast.Attribute)
+                        else None
+                    )
+                    if (s and _CKPT_HINT.search(s)) or \
+                            (ident and _CKPT_HINT.search(ident)):
+                        stmt_ckptish = True
+                        break
+            if in_ckpt_func or stmt_ckptish or \
+                    (file_ckptish and func is not None):
+                where = (
+                    f"function {func.name}" if in_ckpt_func
+                    else "statement touches checkpoint state"
+                    if stmt_ckptish else "checkpoint module"
+                )
+                yield ctx.finding(
+                    self, call,
+                    f"nondeterministic '{tail}' in checkpoint context "
+                    f"({where}): "
+                    "a clock/random value flowing into a checkpoint path "
+                    "or resume decision cannot be re-derived after a "
+                    "crash — derive names from the step number; if this "
+                    "value never reaches a persisted name (e.g. a tmp "
+                    "suffix replaced atomically), annotate with "
+                    "`# tdclint: disable=TDC007` and say why",
+                )
+
+    def finalize(self):
+        return ()

@@ -1,0 +1,344 @@
+"""TDC002 host-sync-in-hot-loop and TDC003 recompile-hazard.
+
+PR 2 bought one cross-device reduce per pass; a single stray `.item()`
+inside the streamed batch loop silently pays a device round-trip per
+batch and erases the win without failing any test. Recompiles are the
+same shape of silent loss: the serve engine's zero-recompile contract
+(jit_cache_size assertions) only covers serving — a `jax.jit` created
+inside a loop, or an f-string flowing into a static argument, retraces
+on every call anywhere else.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tdc_tpu.lint.engine import (
+    FileContext, call_name, dotted_name, last_seg, walk_calls,
+)
+
+# A loop is "hot" when it is recognizably the streamed batch loop: its
+# DIRECT body (nested loops excluded) contains a maybe_beat liveness
+# marker or a stream/data fault point — those are placed exactly in the
+# per-batch loops — or it iterates something batch-shaped. Nested-loop
+# exclusion encodes the issue's finalization allowlist: a `float(shift)`
+# after the inner batch loop is per-pass finalization (one sync per
+# iteration, the PR-2 contract), not a per-batch sync.
+_HOT_FAULT_PREFIXES = ("stream.", "data.")
+_HOT_ITER_HINT = re.compile(
+    r"batch|stream|loader|prefetch|minibatch", re.IGNORECASE
+)
+
+# Calls that force a device→host value sync (or a full D2H copy).
+_SYNC_ATTRS = frozenset({"item"})
+_SYNC_CALLS = frozenset({"device_get"})
+_NP_COPY = frozenset({"asarray", "array"})
+_NP_ROOTS = frozenset({"np", "numpy", "onp"})
+_BUILTIN_SYNCS = frozenset({"float", "int", "bool"})
+
+
+def _names_in(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _region_nodes(loop) -> list[ast.AST]:
+    """Nodes whose NEAREST enclosing loop is `loop`: the loop's body with
+    nested For/While subtrees cut out (a nested For's iter/target still
+    belong to this region — they are evaluated per outer iteration)."""
+    roots: list[ast.AST] = []
+    if isinstance(loop, ast.For):
+        roots = list(loop.body) + list(loop.orelse)
+    else:  # While: the test re-evaluates every iteration
+        roots = [loop.test] + list(loop.body) + list(loop.orelse)
+    out: list[ast.AST] = []
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        if isinstance(n, ast.For):
+            stack.extend([n.iter, n.target])
+            continue
+        if isinstance(n, ast.While):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _loop_is_hot(loop, region: list[ast.AST]) -> bool:
+    for n in region:
+        if not isinstance(n, ast.Call):
+            continue
+        seg = last_seg(call_name(n))
+        if seg == "maybe_beat":
+            return True
+        if seg == "fault_point" and n.args:
+            arg = n.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str) and \
+                    arg.value.startswith(_HOT_FAULT_PREFIXES):
+                return True
+    if isinstance(loop, ast.For):
+        for name in list(_names_in(loop.iter)) + list(_names_in(loop.target)):
+            if _HOT_ITER_HINT.search(name):
+                return True
+    return False
+
+
+def _shape_only(arg: ast.AST) -> bool:
+    """float()/int() of shapes, lengths and dtypes never syncs — shape
+    metadata is host-resident on jax arrays."""
+    for sub in ast.walk(arg):
+        if isinstance(sub, ast.Attribute) and \
+                sub.attr in ("shape", "ndim", "size", "itemsize"):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return True
+    return False
+
+
+class HostSyncInHotLoop:
+    code = "TDC002"
+    name = "host-sync-in-hot-loop"
+    description = (
+        ".item()/float()/int()/np.asarray/jax.device_get inside a streamed "
+        "batch loop — each is a blocking device round-trip per batch that "
+        "silently erases the deferred-reduce comms wins"
+    )
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            region = _region_nodes(node)
+            if not _loop_is_hot(node, region):
+                continue
+            yield from self._check_region(ctx, region)
+
+    def _check_region(self, ctx: FileContext, region):
+        for call in region:
+            if not isinstance(call, ast.Call):
+                continue
+            name = call_name(call)
+            seg = last_seg(name)
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in _SYNC_ATTRS and not call.args:
+                yield ctx.finding(
+                    self, call,
+                    ".item() in a hot loop blocks on the device value "
+                    "every batch; accumulate on device and fetch once "
+                    "after the loop",
+                )
+            elif seg in _SYNC_CALLS:
+                yield ctx.finding(
+                    self, call,
+                    f"{name or seg}() in a hot loop is a full D2H transfer "
+                    "per batch; keep the value device-resident until the "
+                    "pass ends",
+                )
+            elif seg in _NP_COPY and name and \
+                    name.rsplit(".", 1)[0] in _NP_ROOTS:
+                yield ctx.finding(
+                    self, call,
+                    f"{name}() in a hot loop copies the array to host "
+                    "every batch (and re-uploads it if used on device); "
+                    "operate on the jax.Array directly",
+                )
+            elif isinstance(call.func, ast.Name) and \
+                    call.func.id in _BUILTIN_SYNCS and len(call.args) == 1 \
+                    and not isinstance(call.args[0], ast.Constant) \
+                    and not _shape_only(call.args[0]):
+                yield ctx.finding(
+                    self, call,
+                    f"{call.func.id}(...) in a hot loop forces the value "
+                    "to host every batch if its argument is a traced/"
+                    "device value; if the argument is host-only, annotate "
+                    "with `# tdclint: disable=TDC002` and say why",
+                )
+
+    def finalize(self):
+        return ()
+
+
+class RecompileHazard:
+    code = "TDC003"
+    name = "recompile-hazard"
+    description = (
+        "jit closures created inside loops, malformed static_argnums/"
+        "static_argnames, and unhashable or per-call-fresh values flowing "
+        "into static positions — every one retraces/recompiles per call"
+    )
+
+    _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                   ast.SetComp, ast.GeneratorExp)
+
+    def check(self, ctx: FileContext):
+        yield from self._jit_in_loop(ctx)
+        jitted = {}
+        for node in ast.walk(ctx.tree):
+            yield from self._bad_static_spec(ctx, node)
+            self._collect_jitted(node, jitted)
+        yield from self._bad_static_args(ctx, jitted)
+
+    # -- sub-check (a): jax.jit(...) inside a loop ------------------------
+    def _jit_in_loop(self, ctx: FileContext):
+        # Lexical scan with a function boundary: a jit inside a nested
+        # function that happens to be *defined* in a loop traces once per
+        # fit (the factory idiom, e.g. make_deferred_fns) — only a jit
+        # CALL directly under a loop in the same function body retraces
+        # per iteration.
+        rule = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.loop_depth = 0
+                self.found = []
+
+            def visit_For(self, node):
+                self._loop(node)
+
+            def visit_While(self, node):
+                self._loop(node)
+
+            def _loop(self, node):
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+
+            def visit_FunctionDef(self, node):
+                saved, self.loop_depth = self.loop_depth, 0
+                self.generic_visit(node)
+                self.loop_depth = saved
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                saved, self.loop_depth = self.loop_depth, 0
+                self.generic_visit(node)
+                self.loop_depth = saved
+
+            def visit_Call(self, node):
+                if self.loop_depth > 0 and \
+                        last_seg(call_name(node)) == "jit":
+                    self.found.append(node)
+                self.generic_visit(node)
+
+        v = V()
+        v.visit(ctx.tree)
+        for call in v.found:
+            yield ctx.finding(
+                rule, call,
+                "jax.jit called inside a loop creates a fresh compiled "
+                "callable (and a fresh trace cache) every iteration; "
+                "hoist the jitted function out of the loop",
+            )
+
+    # -- sub-check (b): malformed static specs ----------------------------
+    def _bad_static_spec(self, ctx: FileContext, node: ast.AST):
+        if not (isinstance(node, ast.Call) and
+                last_seg(call_name(node)) == "jit"):
+            return
+        for kw in node.keywords:
+            if kw.arg == "static_argnums":
+                for sub in ast.walk(kw.value):
+                    if not isinstance(sub, ast.Constant):
+                        continue
+                    bad = isinstance(sub.value, bool) or \
+                        not isinstance(sub.value, (int, type(None)))
+                    if bad:
+                        yield ctx.finding(
+                            self, kw.value,
+                            f"static_argnums takes integer positions, got "
+                            f"{sub.value!r} — a string here silently "
+                            "matches nothing and the argument is traced "
+                            "(recompiling per shape) instead of static",
+                        )
+                        break
+            elif kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str) and "," in sub.value:
+                        yield ctx.finding(
+                            self, kw.value,
+                            f"static_argnames={sub.value!r} is ONE name "
+                            "containing a comma, not two names — pass a "
+                            "tuple of strings",
+                        )
+
+    # -- sub-check (c): unhashable/fresh values into static positions -----
+    def _collect_jitted(self, node: ast.AST, jitted: dict):
+        """Map local name -> (static positions, static names) for
+        `f = jax.jit(g, static_argnums=..., static_argnames=...)` and the
+        decorator forms."""
+        def spec_of(call: ast.Call):
+            nums, names = set(), set()
+            for kw in call.keywords:
+                if kw.arg == "static_argnums":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) and \
+                                isinstance(sub.value, int) and \
+                                not isinstance(sub.value, bool):
+                            nums.add(sub.value)
+                elif kw.arg == "static_argnames":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) and \
+                                isinstance(sub.value, str):
+                            names.add(sub.value)
+            return nums, names
+
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call) and \
+                last_seg(call_name(node.value)) == "jit":
+            jitted[node.targets[0].id] = spec_of(node.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    seg = last_seg(call_name(dec))
+                    if seg == "jit":
+                        jitted[node.name] = spec_of(dec)
+                    elif seg == "partial" and dec.args and \
+                            last_seg(dotted_name(dec.args[0])) == "jit":
+                        jitted[node.name] = spec_of(dec)
+                elif last_seg(dotted_name(dec)) == "jit":
+                    jitted[node.name] = (set(), set())
+
+    def _bad_static_args(self, ctx: FileContext, jitted: dict):
+        if not jitted:
+            return
+        for call in walk_calls(ctx.tree):
+            if not (isinstance(call.func, ast.Name) and
+                    call.func.id in jitted):
+                continue
+            nums, names = jitted[call.func.id]
+            static_args = [
+                (i, a) for i, a in enumerate(call.args) if i in nums
+            ] + [
+                (kw.arg, kw.value) for kw in call.keywords
+                if kw.arg in names
+            ]
+            for pos, arg in static_args:
+                if isinstance(arg, self._UNHASHABLE):
+                    yield ctx.finding(
+                        self, arg,
+                        f"unhashable value (list/dict/set) passed to "
+                        f"static position {pos!r} of jitted "
+                        f"'{call.func.id}' — jit raises TypeError on "
+                        "unhashable statics; pass a tuple or hashable "
+                        "dataclass",
+                    )
+                elif isinstance(arg, ast.JoinedStr):
+                    yield ctx.finding(
+                        self, arg,
+                        f"f-string passed to static position {pos!r} of "
+                        f"jitted '{call.func.id}' — a fresh string per "
+                        "call means a fresh compile per call",
+                    )
+
+    def finalize(self):
+        return ()

@@ -1,0 +1,124 @@
+"""TDC004 signal-unsafe-handler.
+
+PR 3's chaos soak found the exact crash this rule now catches statically:
+`structlog.emit`/`print` inside the SIGTERM handler writes to a buffered
+stderr stream — if the signal interrupted a write already in progress,
+Python raises RuntimeError('reentrant call inside <_io.BufferedWriter>')
+*inside the handler*, killing the very worker the handler was draining.
+The contract (utils/preempt._on_signal documents it): a signal handler
+may set flags and do ONE raw `os.write`; everything else waits until the
+drain path acts on the flag outside async-signal context.
+
+Scope: handlers are resolved per module (a function passed to
+`signal.signal` by name or as a lambda), and the call graph is followed
+transitively through same-module function definitions. Cross-module
+calls are not followed — a helper imported from another module that
+prints will be caught when that module's own handler registration is
+linted, or by review; the rule stays zero-false-positive on the common
+shape.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tdc_tpu.lint.engine import FileContext, call_name, last_seg, walk_calls
+
+# Buffered/allocating calls that are unsafe in async-signal context.
+_BANNED_NAMES = frozenset({"print", "open"})
+_BANNED_LAST = frozenset({"emit", "warn"})  # structlog.emit, warnings.warn
+# NB: a bare ".log" method is NOT here — math.log/np.log would false-
+# positive; loggers reached via .info/.warning/... already identify it.
+_BANNED_METHODS = frozenset({
+    "info", "warning", "error", "debug", "exception", "critical",
+    "event",  # RunLog.event — buffered file append
+})
+_BANNED_DOTTED_SUFFIX = ("stderr.write", "stdout.write")
+_LOGGING_ROOTS = ("logging.",)
+
+
+def _banned(call: ast.Call) -> str | None:
+    name = call_name(call)
+    if name is None:
+        return None
+    seg = last_seg(name)
+    if name == "os.write":
+        return None  # THE async-signal-safe way to leave a breadcrumb
+    if name in _BANNED_NAMES or seg in _BANNED_NAMES:
+        return f"'{name}' (buffered/allocating I/O)"
+    if seg in _BANNED_LAST:
+        return f"'{name}' (buffered logging)"
+    if name.startswith(_LOGGING_ROOTS):
+        return f"'{name}' (the logging module allocates and locks)"
+    if any(name.endswith(s) for s in _BANNED_DOTTED_SUFFIX):
+        return f"'{name}' (buffered stream write — reentrant-call hazard)"
+    if isinstance(call.func, ast.Attribute) and seg in _BANNED_METHODS:
+        return f"'{name}' (logger/file method — buffered I/O)"
+    return None
+
+
+class SignalUnsafeHandler:
+    code = "TDC004"
+    name = "signal-unsafe-handler"
+    description = (
+        "a function registered with signal.signal transitively calls "
+        "print/logging/structlog/buffered writes — reentrant-call "
+        "RuntimeError inside the handler kills the worker mid-drain; "
+        "use one raw os.write and act on a flag outside the handler"
+    )
+
+    def check(self, ctx: FileContext):
+        defs: dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+
+        handlers: list[tuple[str, ast.AST, int]] = []  # (label, body, reg line)
+        for call in walk_calls(ctx.tree):
+            if call_name(call) not in ("signal.signal", "signal") or \
+                    len(call.args) < 2:
+                continue
+            target = call.args[1]
+            if isinstance(target, ast.Name) and target.id in defs:
+                handlers.append((target.id, defs[target.id], call.lineno))
+            elif isinstance(target, ast.Lambda):
+                handlers.append(("<lambda>", target, call.lineno))
+            # anything else (restoring a saved handler, SIG_DFL/SIG_IGN,
+            # attributes) is unresolvable here — skip silently
+
+        reported: set[tuple[int, int]] = set()
+        for label, body, reg_line in handlers:
+            yield from self._scan(ctx, label, body, reg_line, defs,
+                                  visited={id(body)}, depth=0,
+                                  reported=reported)
+
+    def _scan(self, ctx, label, body, reg_line, defs, visited, depth,
+              reported):
+        if depth > 8:  # recursion guard; real handler chains are shallow
+            return
+        for call in walk_calls(body):
+            why = _banned(call)
+            if why is not None:
+                key = (call.lineno, call.col_offset)
+                if key not in reported:
+                    reported.add(key)
+                    yield ctx.finding(
+                        self, call,
+                        f"{why} reached from signal handler '{label}' "
+                        f"(registered at line {reg_line}): buffered I/O in "
+                        "async-signal context raises reentrant-call "
+                        "RuntimeError; write one raw os.write(2, ...) "
+                        "line and do the real logging from the drain path",
+                    )
+                continue
+            seg = last_seg(call_name(call))
+            callee = defs.get(seg) if isinstance(call.func, ast.Name) \
+                else None
+            if callee is not None and id(callee) not in visited:
+                visited.add(id(callee))
+                yield from self._scan(
+                    ctx, f"{label} -> {seg}", callee, reg_line, defs,
+                    visited, depth + 1, reported)
+
+    def finalize(self):
+        return ()

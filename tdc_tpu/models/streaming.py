@@ -272,7 +272,9 @@ def _run_pass(
                     )
                 # Weighted streams yield (x, w) pairs; rows come from x.
                 xb = batch[0] if isinstance(batch, tuple) else batch
-                skipped_rows += np.asarray(xb).shape[0]
+                # Replay prefix only; xb is the host-side stream batch
+                # (shape read, no device value involved).
+                skipped_rows += np.asarray(xb).shape[0]  # tdclint: disable=TDC002
                 if i == skip - 1:
                     if skipped_rows != rows0:
                         mismatch = True
@@ -280,13 +282,16 @@ def _run_pass(
                     prefix_ok = True
                 continue
             acc, n_rows = step_fn(acc, batch)
-            rows += int(n_rows)
+            # n_rows is the step's host-side local row count (from
+            # _prepare_batch), never a traced value — no device sync here.
+            rows += int(n_rows)  # tdclint: disable=TDC002
             consumed = i + 1
             if consumed % _BACKPRESSURE_EVERY == 0:
                 jax.block_until_ready(jax.tree_util.tree_leaves(acc))
             can_save = (n_iter > 0 and ckpt is not None
                         and ckpt.dir is not None)
-            saved_midpass = bool(can_save and ckpt_every_batches
+            # Host-side checkpoint bookkeeping (plain Python values).
+            saved_midpass = bool(can_save and ckpt_every_batches  # tdclint: disable=TDC002
                                  and consumed % ckpt_every_batches == 0)
             if saved_midpass:
                 c, shift, history = save_args
@@ -1139,12 +1144,16 @@ def mean_combine_fit(
 
     total = jnp.zeros((k, d), jnp.float32)
     n_batches = 0
-    n_iter = 0
-    shift = 0.0
-    converged = True
+    n_iter = jnp.zeros((), jnp.int32)
+    shift = jnp.zeros((), jnp.float32)
+    converged = jnp.asarray(True)
     for batch in _prefetched(batches(), prefetch):
         maybe_beat()  # supervised-gang liveness
-        batch = np.asarray(batch)
+        if not isinstance(batch, jax.Array):
+            # Device-resident batches pass through untouched (np.asarray
+            # would D2H-copy and re-upload them — the _prepare_batch
+            # rule); under the guard the copy is host-to-host only.
+            batch = np.asarray(batch)  # tdclint: disable=TDC002
         bmesh = mesh
         if mesh is not None:
             n_dev = int(np.prod(mesh.devices.shape))
@@ -1158,11 +1167,15 @@ def mean_combine_fit(
         )
         total = total + res.centroids
         n_batches += 1
-        n_iter = max(n_iter, int(res.n_iter))
-        shift = max(shift, float(res.shift))
-        converged = converged and bool(res.converged)
+        # Worst-per-batch trackers stay device-resident: int()/float()/
+        # bool() here would block on each batch's async fit dispatch
+        # (TDC002); one fetch after the loop reads the same maxima.
+        n_iter = jnp.maximum(n_iter, res.n_iter)
+        shift = jnp.maximum(shift, res.shift)
+        converged = jnp.logical_and(converged, res.converged)
     if n_batches == 0:
         raise ValueError("empty batch stream")
+    n_iter, shift, converged = int(n_iter), float(shift), bool(converged)
     c = total / n_batches  # the reference's unweighted np.mean (:310)
     if spherical:
         c = _normalize(c)

@@ -55,6 +55,22 @@ from dataclasses import dataclass
 
 ENV_VAR = "TDC_FAULTS"
 
+# The instrumented-points registry (mirrors the docstring list above).
+# tdclint rule TDC005 cross-checks every `fault_point("...")` call site in
+# the tree against this set IN BOTH DIRECTIONS: a call site the registry
+# doesn't know means a $TDC_FAULTS spec written from this list injects
+# nothing there; a registry entry with no call site means the
+# instrumentation was renamed/removed and existing chaos specs now pass
+# vacuously. Update both together.
+KNOWN_POINTS = frozenset({
+    "ckpt.save.pre_replace",
+    "ckpt.restore",
+    "stream.batch",
+    "supervisor.spawn",
+    "serve.dispatch",
+    "data.load",
+})
+
 # Exit code used by the 'crash' action: 128+9, what a shell reports for a
 # kill -9 — postmortems grepping for OOM-killer/preemption kills match it.
 CRASH_EXIT_CODE = 137
@@ -210,6 +226,7 @@ def fault_point(name: str) -> None:
 __all__ = [
     "CRASH_EXIT_CODE",
     "ENV_VAR",
+    "KNOWN_POINTS",
     "FaultSpec",
     "FaultSpecError",
     "fault_point",

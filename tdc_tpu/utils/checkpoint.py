@@ -79,8 +79,10 @@ def _manual_save(path: str, payload: dict) -> None:
         for k, v in arrays.items()
     }
     # np.savez appends .npz to names not already ending in it — keep the
-    # suffix so the written file is exactly `tmp`.
-    tmp = os.path.join(path, f"state.tmp-{uuid.uuid4().hex[:8]}.npz")
+    # suffix so the written file is exactly `tmp`. The uuid suffix never
+    # reaches a persisted name (os.replace swaps it to the stable
+    # state.npz below); restore/resume re-derive nothing from it.
+    tmp = os.path.join(path, f"state.tmp-{uuid.uuid4().hex[:8]}.npz")  # tdclint: disable=TDC007
     np.savez(tmp, **arrays, **crcs)
     from tdc_tpu.testing.faults import fault_point
 

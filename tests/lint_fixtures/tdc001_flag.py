@@ -1,0 +1,34 @@
+"""MUST-FLAG TDC001: collectives under host-local branches (each shape
+mirrors a way the PR-3 gang deadlock could re-enter the codebase)."""
+import jax
+
+
+def coordinator_only_reduce(stats):
+    # The canonical deadlock: only process 0 enters the psum; every other
+    # process waits forever at its next collective.
+    if jax.process_index() == 0:
+        stats = jax.lax.psum(stats, "data")
+    return stats
+
+
+def rank_guarded_gather(x, rank):
+    if rank == 0:
+        return jax.lax.all_gather(x, "model")
+    return x
+
+
+def barrier_in_else(step):
+    from tdc_tpu.parallel.multihost import barrier
+
+    if jax.process_index() != 0:
+        pass
+    else:
+        barrier(f"ckpt_{step}")
+
+
+def env_targeted(x):
+    import os
+
+    if os.environ.get("TDC_PROCESS_ID") == "0":
+        x = jax.lax.pmax(x, "data")
+    return x

@@ -1,0 +1,36 @@
+"""MUST-NOT-FLAG TDC001: collectives outside host-local branches, and
+host-local branches that do only per-process work."""
+import jax
+
+
+def uniform_reduce(stats):
+    # Every process reaches the psum unconditionally.
+    stats = jax.lax.psum(stats, "data")
+    return stats
+
+
+def count_guarded(x):
+    # process_count is gang-uniform: every process takes the same arm.
+    if jax.process_count() > 1:
+        x = jax.lax.psum(x, "data")
+    return x
+
+
+def writer_only_io(state, path):
+    # Host-local branch with NO collective inside: the single-writer
+    # checkpoint idiom (the barrier happens outside, on all processes).
+    import json
+
+    if jax.process_index() == 0:
+        with open(path, "w") as f:
+            json.dump(state, f)
+    from tdc_tpu.parallel.multihost import barrier
+
+    barrier("ckpt")
+
+
+def flag_guarded(stats, gang):
+    # Plain bool parameter — nothing host-local about it.
+    if gang:
+        stats = jax.lax.psum(stats, "data")
+    return stats

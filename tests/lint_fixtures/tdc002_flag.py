@@ -1,0 +1,31 @@
+"""MUST-FLAG TDC002: device syncs inside recognizable streamed batch
+loops (the PR-2 comms-win eraser)."""
+import jax
+import numpy as np
+
+from tdc_tpu.testing.faults import fault_point
+from tdc_tpu.utils.heartbeat import maybe_beat
+
+
+def marked_loop(stream, step, acc, loss):
+    for batch in stream:
+        fault_point("stream.batch")
+        acc = step(acc, batch)
+        v = float(loss)  # per-batch device round-trip
+        x = loss.item()  # ditto
+    return acc, v, x
+
+
+def beat_loop(items, dev):
+    for it in items:
+        maybe_beat()
+        host = np.asarray(dev)  # D2H copy per iteration
+        got = jax.device_get(dev)
+    return host, got
+
+
+def hinted_loop(batches, res):
+    done = True
+    for batch in batches:
+        done = done and bool(res.converged)  # sync per batch
+    return done

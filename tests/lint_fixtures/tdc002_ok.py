@@ -1,0 +1,56 @@
+"""MUST-NOT-FLAG TDC002: pass-boundary finalization, shape metadata,
+non-hot loops, and annotated host-only values."""
+import jax.numpy as jnp
+import numpy as np
+
+from tdc_tpu.utils.heartbeat import maybe_beat
+
+
+def finalize_after_loop(stream, step, acc, shift):
+    for batch in stream:
+        maybe_beat()
+        acc = step(acc, batch)
+    return float(shift)  # end-of-fit finalization: one sync total
+
+
+def per_epoch_finalization(epochs, batches, step, acc, shift_dev):
+    # The sync sits in the EPOCH loop (per-pass), not the batch loop —
+    # exactly one sync per iteration is the documented contract.
+    for _epoch in range(epochs):
+        for batch in batches:
+            maybe_beat()
+            acc = step(acc, batch)
+        shift = float(shift_dev)
+    return shift
+
+
+def shape_metadata(batches):
+    n = 0
+    for batch in batches:
+        n += int(batch.shape[0])  # shapes are host-resident: no sync
+        w = float(len(batch))
+    return n, w
+
+
+def cold_loop(rows, total):
+    # No marker, no batch-shaped iterable: host bookkeeping loop.
+    for r in rows:
+        total += float(r)
+    return total
+
+
+def annotated(stream, n_rows_host):
+    rows = 0
+    for batch in stream:
+        maybe_beat()
+        # n_rows_host is a plain Python int from the host-side loader.
+        rows += int(n_rows_host)  # tdclint: disable=TDC002
+    return rows
+
+
+def device_accumulate(stream, step, acc, worst):
+    for batch in stream:
+        maybe_beat()
+        acc, shift = step(acc, batch)
+        worst = jnp.maximum(worst, shift)  # stays on device
+    return acc, worst

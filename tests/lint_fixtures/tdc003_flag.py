@@ -1,0 +1,26 @@
+"""MUST-FLAG TDC003: recompile hazards — jit-in-loop, malformed static
+specs, unhashable/fresh statics."""
+import jax
+
+step = jax.jit(lambda c, x: c + x.sum(0))
+
+
+def jit_per_iteration(batches, fn, c):
+    for batch in batches:
+        compiled = jax.jit(fn)  # fresh trace cache every iteration
+        c = compiled(c, batch)
+    return c
+
+
+bad_nums = jax.jit(lambda x, k: x * k, static_argnums="k")
+
+bad_names = jax.jit(lambda x, a, b: x, static_argnames="a,b")
+
+keyed = jax.jit(lambda x, key: x, static_argnames=("key",))
+by_pos = jax.jit(lambda x, mode: x, static_argnums=(1,))
+
+
+def fresh_statics(x, i):
+    a = keyed(x, key=f"run-{i}")  # fresh string -> fresh compile
+    b = by_pos(x, [i, i + 1])  # unhashable static
+    return a, b

@@ -1,0 +1,33 @@
+"""MUST-NOT-FLAG TDC003: the hoisted/factory jit idioms and well-formed
+static specs."""
+from functools import partial
+
+import jax
+
+step = jax.jit(lambda c, x: c + x.sum(0))  # hoisted: traced once
+
+
+def loop_over_batches(batches, c):
+    for batch in batches:
+        c = step(c, batch)  # calling a jitted fn in a loop is the POINT
+    return c
+
+
+def make_tower(fn):
+    # Factory idiom (make_deferred_fns): the jit call happens once per
+    # factory invocation, not per loop iteration.
+    return jax.jit(fn)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def blocked(x, block_rows, kernel):
+    return x.reshape(block_rows, -1)
+
+
+keyed = jax.jit(lambda x, kernel: x, static_argnames=("kernel",))
+
+
+def good_statics(x):
+    a = keyed(x, kernel="pallas")  # interned literal: one compile
+    b = blocked(x, 128, "xla")
+    return a, b

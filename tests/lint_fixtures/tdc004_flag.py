@@ -1,0 +1,23 @@
+"""MUST-FLAG TDC004: buffered I/O reachable from signal handlers (the
+PR-3 reentrant-call crash, statically)."""
+import logging
+import signal
+import sys
+
+
+def _log_stop(reason):
+    # Transitive: the handler itself looks clean, the helper prints.
+    print(f"stopping: {reason}", file=sys.stderr, flush=True)
+    logging.getLogger("tdc").info("drain %s", reason)
+
+
+def on_sigterm(signum, frame):
+    _log_stop("preempted")
+
+
+def install():
+    signal.signal(signal.SIGTERM, on_sigterm)
+    signal.signal(
+        signal.SIGINT,
+        lambda s, f: sys.stderr.write("interrupted\n"),
+    )

@@ -1,0 +1,29 @@
+"""MUST-NOT-FLAG TDC004: the async-signal-safe handler idiom
+(utils/preempt._on_signal), and buffered I/O that is NOT handler-reachable."""
+import os
+import signal
+import time
+
+_flag = {"requested": False}
+_box = []
+
+
+def on_sigterm(signum, frame):
+    _flag["requested"] = True
+    try:
+        os.write(2, b'{"event": "preempt_requested"}\n')  # raw fd: safe
+    except OSError:
+        pass
+    os._exit(75)
+
+
+def install():
+    signal.signal(signal.SIGTERM, on_sigterm)
+    # Append-only lambda (the supervisor idiom): allocation-free enough,
+    # and crucially no buffered stream anywhere.
+    signal.signal(signal.SIGINT, lambda s, f: _box.append(time.time()))
+
+
+def drain_path():
+    # print OUTSIDE any handler is of course fine.
+    print("drained", flush=True)

@@ -1,0 +1,18 @@
+"""MUST-FLAG TDC005: both directions of fault-point drift against the
+registry, plus a computed point name."""
+
+KNOWN_POINTS = frozenset({
+    "ckpt.save",
+    "stream.batch",
+    "never.instrumented",  # registry entry with no call site
+})
+
+
+def fault_point(name):
+    pass
+
+
+def instrumented(step, dynamic):
+    fault_point("ckpt.save")  # fine: registered
+    fault_point("ckpt.sav")  # typo: not in the registry
+    fault_point(f"step.{dynamic}")  # computed: uncheckable

@@ -1,0 +1,13 @@
+"""MUST-NOT-FLAG TDC005: registry and call sites agree exactly, both
+directions."""
+
+KNOWN_POINTS = frozenset({"ckpt.save", "stream.batch"})
+
+
+def fault_point(name):
+    pass
+
+
+def instrumented():
+    fault_point("ckpt.save")
+    fault_point("stream.batch")

@@ -1,0 +1,10 @@
+"""MUST-FLAG TDC006: non-literal, non-snake, and near-duplicate
+structlog event names."""
+from tdc_tpu.utils.structlog import emit
+
+
+def bad_events(log, which, step):
+    emit(f"ckpt_{which}")  # computed name: ungreppable
+    emit("Ckpt-Restore")  # not lowercase_snake
+    log.event("ckpt_restore")  # collides with ckpt.restore below...
+    emit("ckpt.restore")  # ...after normalization: one event, two spellings

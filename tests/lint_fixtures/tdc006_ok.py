@@ -1,0 +1,16 @@
+"""MUST-NOT-FLAG TDC006: literal lowercase_snake event names, distinct
+after normalization; variability lives in fields."""
+from tdc_tpu.utils.structlog import emit
+
+
+def good_events(log, step, err):
+    emit("ckpt_step_unreadable", step=step, error=str(err))
+    emit("fault_injected", point="stream.batch")
+    log.event("run_start", step=step)
+    log.event("run_ok")
+
+
+def not_an_event_api(queue, loop):
+    # .event() on a non-log receiver is out of scope for the rule.
+    queue.event("WHATEVER-Shape")
+    loop.event(f"dynamic-{1}")

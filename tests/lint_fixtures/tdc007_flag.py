@@ -1,0 +1,26 @@
+"""MUST-FLAG TDC007: clocks/randomness feeding checkpoint names and
+resume decisions."""
+import os
+import random
+import time
+import uuid
+
+
+def save_checkpoint(state, root):
+    # A path the writer derives from the clock is a path the resumer can
+    # never re-derive.
+    path = os.path.join(root, f"ckpt-{int(time.time())}")
+    with open(path, "wb") as f:
+        f.write(state)
+    return path
+
+
+def pick_resume_step(steps):
+    # Random resume choice: two processes disagree and the gang desyncs.
+    ckpt_step = random.choice(steps)
+    return ckpt_step
+
+
+def unique_run_dir(root):
+    checkpoint_dir = os.path.join(root, uuid.uuid4().hex)
+    return checkpoint_dir

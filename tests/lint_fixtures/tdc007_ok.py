@@ -1,0 +1,30 @@
+"""MUST-NOT-FLAG TDC007: step-derived checkpoint names, clocks outside
+checkpoint context, and the annotated atomic-tmp idiom."""
+import os
+import time
+import uuid
+
+
+def save_checkpoint(state, root, step):
+    # Deterministic: the resumer re-derives the name from the step.
+    path = os.path.join(root, f"step_{step:08d}")
+    with open(path, "wb") as f:
+        f.write(state)
+    return path
+
+
+def save_checkpoint_atomic(state, root, step):
+    final = os.path.join(root, f"step_{step:08d}")
+    # The uuid never reaches a persisted name: os.replace swaps it onto
+    # the stable step-derived path.
+    tmp = os.path.join(root, f".tmp-{uuid.uuid4().hex}")  # tdclint: disable=TDC007
+    with open(tmp, "wb") as f:
+        f.write(state)
+    os.replace(tmp, final)
+    return final
+
+
+def throttle(last):
+    # A clock with no checkpoint anywhere near it.
+    now = time.time()
+    return now - last > 1.0

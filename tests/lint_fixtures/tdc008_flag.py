@@ -1,0 +1,21 @@
+"""MUST-FLAG TDC008: collectives naming axes the file never declares —
+the flat-vs-hierarchical tower copy-paste."""
+
+import jax
+
+DATA_AXIS = "data"
+
+def tower(x):
+    # The mesh declares (dcn, ici) but the psum still says "data": the
+    # flat-tower axis name pasted into the hierarchical tower.
+    return jax.lax.psum(x, "data2")
+
+def build(mesh_devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(mesh_devices, ("dcn", "ici"))
+    mapped = jax.pmap(tower, axis_name="devices")
+    return mesh, mapped
+
+def gathered(x):
+    return jax.lax.all_gather(x, axis_name="modle")  # typo'd "model"

@@ -1,0 +1,26 @@
+"""MUST-NOT-FLAG TDC008: collective axis names that match the file's
+declarations, including resolution through *_AXIS constants."""
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+def make(devices):
+    return Mesh(devices, (DATA_AXIS, MODEL_AXIS))
+
+def tower(x, c):
+    local = x @ c.T
+    sums = jax.lax.psum(local, DATA_AXIS)  # resolves via the constant
+    gathered = jax.lax.all_gather(local, MODEL_AXIS)
+    idx = jax.lax.axis_index("model")  # literal matching a declaration
+    return sums, gathered, idx
+
+def specs():
+    return P(DATA_AXIS, None), P("model")
+
+def variable_axes(tree, axes):
+    # Axis names flowing through variables are out of scope (reduce.py's
+    # tree_psum): unresolvable, so never flagged.
+    return [jax.lax.psum(t, ax) for t in tree for ax in axes]

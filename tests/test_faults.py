@@ -2,6 +2,11 @@
 harness the chaos tests stand on, so its own semantics (trigger counts,
 filters, action dispatch) get direct coverage."""
 
+# The synthetic point names ('p', 'p.x', ...) in this file test the
+# MACHINERY, not real instrumentation sites — the KNOWN_POINTS registry
+# cross-check does not apply here.
+# tdclint: disable-file=TDC005
+
 import os
 import subprocess
 import sys
