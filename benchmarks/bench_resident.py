@@ -1,0 +1,180 @@
+"""Residency benchmark: streamed vs HBM-resident per-iteration wall clock.
+
+What this measures, per (mesh, config):
+
+- **streamed_iter_s / resident_iter_s** — the marginal cost of ONE more
+  Lloyd iteration on each path, measured by DIFFERENCING two whole-fit
+  wall clocks at different iteration counts (tol=-1 pins the counts):
+  `(wall(I2) - wall(I1)) / (I2 - I1)`. Everything the iteration count
+  does not scale — compile (warmed first), init, the cache-fill pass,
+  the final reporting pass — cancels, so the quotient isolates exactly
+  what the residency subsystem claims to change: per-iteration dispatch +
+  H2D round trips (streamed: one Python dispatch and one host->device
+  upload per batch per iteration) vs the compiled on-device chunk loop
+  (resident: 1/chunk_iters of a dispatch and ZERO transfers per
+  iteration; the chunk-boundary fetch is included in its quotient, so
+  the comparison is honest about the boundary cost).
+- **speedup** — streamed_iter_s / resident_iter_s. The CI acceptance
+  floor is >= 1.5x on the smoke config, which is sized to be
+  dispatch/H2D-dominated (many small batches, tiny stats compute) — the
+  regime the measured ~10x round-trip penalty on remote links
+  (models/streaming.py) makes ubiquitous off-box.
+
+CAVEAT (the cpu_mesh_scaling.py lesson): on the 8 virtual CPU devices the
+"H2D" is a memcpy, so the streamed path is charged far LESS here than on
+real TPU links — the CPU speedup is a conservative floor for hardware,
+where per-iteration H2D of the whole dataset rides a ~GB/s PCIe/ICI path.
+The v5e methodology for the full-size measurement is documented in
+benchmarks/ROOFLINE.md (residency addendum).
+
+Run:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python benchmarks/bench_resident.py           # sweep -> CSV
+  python benchmarks/bench_resident.py --smoke       # CI one-liner (~60 s)
+
+Writes benchmarks/resident_cpu.csv; one JSON line per config on stdout.
+"""
+
+import csv
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tdc_tpu.data.device_cache import SizedBatches  # noqa: E402
+from tdc_tpu.models.streaming import streamed_kmeans_fit  # noqa: E402
+from tdc_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "resident_cpu.csv")
+FIELDS = [
+    "mesh", "K", "d", "n", "batch_rows", "n_batches", "i1", "i2",
+    "streamed_iter_s", "resident_iter_s", "speedup",
+    "dispatch_overhead_per_iter_s", "bitexact",
+]
+
+
+def _data(n, d, k, seed=123128):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10.0, 10.0, size=(k, d)).astype(np.float32)
+    x = np.repeat(centers, n // k, axis=0) + rng.normal(
+        0, 0.5, size=(n // k * k, d)
+    ).astype(np.float32)
+    rng.shuffle(x)
+    return x, centers
+
+
+def _fit(x, centers, k, d, batch_rows, iters, mesh, residency):
+    batches = SizedBatches(
+        lambda: (x[i: i + batch_rows] for i in range(0, len(x), batch_rows)),
+        len(x), batch_rows,
+    )
+    t0 = time.perf_counter()
+    res = streamed_kmeans_fit(
+        batches, k, d, init=centers, max_iters=iters, tol=-1.0, mesh=mesh,
+        residency=residency,
+    )
+    jax.block_until_ready(res.centroids)
+    return time.perf_counter() - t0, res
+
+
+def run_one(mesh_name, mesh, k, d, n, batch_rows, i1, i2, repeats=3):
+    x, centers = _data(n, d, k)
+
+    # Warm every compile cache (streamed accumulate, fill, chunk loop).
+    _fit(x, centers, k, d, batch_rows, i1, mesh, "stream")
+    _fit(x, centers, k, d, batch_rows, i1, mesh, "hbm")
+
+    def marginal(residency):
+        samples, r2 = [], None
+        for _ in range(repeats):
+            w1, _ = _fit(x, centers, k, d, batch_rows, i1, mesh, residency)
+            w2, r2 = _fit(x, centers, k, d, batch_rows, i2, mesh, residency)
+            samples.append((w2 - w1) / (i2 - i1))
+        # Median across repeats absorbs scheduler noise on a loaded box; a
+        # non-positive median means the marginal iteration cost is below
+        # the differencing noise floor — clamp to 1 µs instead of crashing
+        # (the smoke then reports the honest "unmeasurably small" side).
+        return max(float(np.median(samples)), 1e-6), r2
+
+    s_iter, rs = marginal("stream")
+    r_iter, rh = marginal("hbm")
+    row = {
+        "mesh": mesh_name, "K": k, "d": d, "n": n,
+        "batch_rows": batch_rows, "n_batches": -(-n // batch_rows),
+        "i1": i1, "i2": i2,
+        "streamed_iter_s": round(s_iter, 6),
+        "resident_iter_s": round(r_iter, 6),
+        "speedup": round(s_iter / r_iter, 3),
+        "dispatch_overhead_per_iter_s": round(s_iter - r_iter, 6),
+        "bitexact": bool(
+            np.array_equal(np.asarray(rs.centroids), np.asarray(rh.centroids))
+        ),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main(argv):
+    smoke = "--smoke" in argv
+    n_dev = len(jax.devices())
+    mesh = make_mesh(min(8, n_dev))
+
+    if smoke:
+        # Dispatch-dominated sizing: 128 small batches per pass, trivial
+        # stats compute — the marginal streamed iteration is almost pure
+        # per-batch dispatch + upload, which is the cost residency
+        # removes (measured here: ~60 ms/iter streamed vs <1 ms resident,
+        # ~100x; the 1.5x floor leaves wide margin for a loaded CI box).
+        # Single-device (mesh dispatch contention on the shared CPU cores
+        # is bench_comms territory, not this claim).
+        row = run_one("cpu1", None, k=16, d=16, n=16384, batch_rows=128,
+                      i1=3, i2=9)
+        ok = row["speedup"] >= 1.5 and row["bitexact"]
+        print(
+            "RESIDENT-SMOKE "
+            + ("PASS" if ok else "FAIL")
+            + f": streamed={row['streamed_iter_s'] * 1e3:.1f} ms/iter, "
+            f"resident={row['resident_iter_s'] * 1e3:.1f} ms/iter, "
+            f"speedup={row['speedup']}x (floor 1.5x), "
+            f"bitexact={row['bitexact']}"
+        )
+        return 0 if ok else 1
+
+    rows = [
+        # dispatch-dominated (many small batches) ...
+        run_one("cpu1", None, k=16, d=16, n=16384, batch_rows=128,
+                i1=3, i2=9),
+        # ... through compute-heavier (few large batches): the speedup
+        # shrinks toward 1x as per-batch compute amortizes the dispatch —
+        # the honest shape of the win.
+        run_one("cpu1", None, k=16, d=16, n=16384, batch_rows=2048,
+                i1=3, i2=9),
+        run_one("cpu1", None, k=64, d=64, n=32768, batch_rows=2048,
+                i1=3, i2=9),
+        run_one("flat8", mesh, k=16, d=16, n=16384, batch_rows=128,
+                i1=3, i2=9),
+        run_one("flat8", mesh, k=64, d=64, n=32768, batch_rows=2048,
+                i1=3, i2=9),
+    ]
+    with open(OUT, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=FIELDS)
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {OUT} ({len(rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,14 +1,15 @@
 #!/usr/bin/env bash
 # Tier-1 verify, one command (ROADMAP.md "Tier-1 verify"): the CPU-mesh
 # test suite (8 virtual devices via tests/conftest.py) minus slow-marked
-# tests, the comms + chaos smokes, and the tdclint static-analysis gate.
-# The suite-green invariant every PR must hold.
+# tests, the comms + resident + chaos smokes, and the tdclint
+# static-analysis gate. The suite-green invariant every PR must hold.
 #
 #   scripts/ci_tier1.sh            # tests + smokes + lint
 #   SKIP_LINT=1 scripts/ci_tier1.sh
 #
 # Exit code: the FIRST failing stage's code (pytest, then comms smoke,
-# then chaos smoke, then lint), with every failed stage named on stderr —
+# then resident smoke, then chaos smoke, then lint), with every failed
+# stage named on stderr —
 # a run where pytest passes but both smokes fail must say so, not
 # silently collapse into one opaque code.
 set -o pipefail
@@ -21,7 +22,10 @@ rm -f "$log"
 # --strict-markers: an unregistered @pytest.mark.* (e.g. a typo'd
 # `multiproc` or `slow`) silently de-selects nothing and rots; make it a
 # collection error instead.
-timeout -k 10 870 env JAX_PLATFORMS=cpu \
+# Budget: the round-7 residency suite grew the sweep to ~915 s on the
+# 2-core CI box (was ~780 s at round 6) — 1200 keeps headroom without
+# letting a genuine hang run unbounded.
+timeout -k 10 1200 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --strict-markers \
     --continue-on-collection-errors \
@@ -37,6 +41,16 @@ if [ -z "$SKIP_COMMS_SMOKE" ]; then
     timeout -k 10 300 env JAX_PLATFORMS=cpu \
         python benchmarks/bench_comms.py --smoke \
         | tail -n 1 || comms_rc=$?
+fi
+
+# Residency smoke (benchmarks/bench_resident.py): proves HBM-resident
+# iterations beat the streamed path by the documented >=1.5x floor on the
+# dispatch-dominated config AND stay bit-exact with it. ~60 s.
+resident_rc=0
+if [ -z "$SKIP_RESIDENT_SMOKE" ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python benchmarks/bench_resident.py --smoke \
+        | tail -n 1 || resident_rc=$?
 fi
 
 # Chaos smoke (tests/test_chaos.py soak): 1 kill -9 + 1 preemption SIGTERM
@@ -73,7 +87,8 @@ fi
 # the rest — "exit 1" with pytest green left comms vs chaos ambiguous.
 overall=0
 for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
-             "chaos-smoke:$chaos_rc" "tdclint:$lint_rc" "ruff:$ruff_rc"; do
+             "resident-smoke:$resident_rc" "chaos-smoke:$chaos_rc" \
+             "tdclint:$lint_rc" "ruff:$ruff_rc"; do
     name=${stage%%:*}
     rc=${stage##*:}
     if [ "$rc" -ne 0 ]; then
@@ -82,6 +97,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, chaos-smoke, lint)" >&2
 fi
 exit "$overall"

@@ -153,6 +153,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "parity); ':bf16'/':int8' additionally quantize the "
                         "(K, d) sums on the wire with error feedback "
                         "(1-D meshes only)")
+    p.add_argument("--residency", type=str, default="stream",
+                   choices=("stream", "auto", "hbm"),
+                   help="streamed kmeans/fuzzy dataset residency "
+                        "(data/device_cache.py): 'hbm' caches the padded "
+                        "batches in device HBM during iteration 1 and runs "
+                        "iterations 2..N as a compiled on-device loop with "
+                        "zero host transfers per iteration; 'auto' does the "
+                        "same when dataset + accumulators fit the HBM "
+                        "budget and falls back to streaming (loudly) when "
+                        "they don't")
     p.add_argument("--native_loader", action="store_true",
                    help="stream batches through the C++ prefetch loader "
                         "(requires --data_file pointing at an .npy)")
@@ -192,9 +202,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coordinator_address", type=str, default=None)
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
-    p.add_argument("--cache_dir", type=str,
-                   default=os.path.expanduser("~/.cache/tdc_tpu_xla"),
-                   help="persistent XLA compilation cache ('' disables)")
+    p.add_argument("--cache_dir", "--compile_cache_dir", dest="cache_dir",
+                   type=str,
+                   default=os.environ.get(
+                       "TDC_COMPILE_CACHE",
+                       os.path.expanduser("~/.cache/tdc_tpu_xla"),
+                   ),
+                   help="persistent XLA compilation cache ('' disables; "
+                        "default $TDC_COMPILE_CACHE — gang relaunches "
+                        "after preemption skip recompiles; thresholds via "
+                        "TDC_COMPILE_CACHE_MIN_COMPILE_SECS / "
+                        "TDC_COMPILE_CACHE_MIN_ENTRY_BYTES)")
     p.add_argument("--history_file", type=str, default=None,
                    help="write per-iteration (sse, shift) CSV (streamed mode)")
     p.add_argument("--weight_file", type=str, default=None,
@@ -449,13 +467,15 @@ def run_experiment(args) -> dict:
                 pass
     import jax
 
-    if args.cache_dir:
-        # Persistent XLA compilation cache: the reference's graph-build cost
-        # was per-run (setup 20-33 s, executions_log.csv); ours is per-shape
-        # and amortizes across runs with this cache.
-        os.makedirs(args.cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", args.cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # Persistent XLA compilation cache: the reference's graph-build cost
+    # was per-run (setup 20-33 s, executions_log.csv); ours is per-shape
+    # and amortizes across runs — and across gang relaunches after a
+    # preemption (utils/compile_cache). Called even for --cache_dir ''
+    # so the opt-out sticks: initialize_distributed's enable_from_env()
+    # must not re-enable from $TDC_COMPILE_CACHE over an explicit flag.
+    from tdc_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(args.cache_dir)
 
     if args.num_processes or args.coordinator_address:
         from tdc_tpu.parallel.multihost import initialize_distributed
@@ -661,6 +681,85 @@ def run_experiment(args) -> dict:
                     "reduce once per iteration, and mean_combine/minibatch/"
                     "bisecting/--shard_k gaussianMixture take no strategy"
                 )
+        if args.residency != "stream":
+            # Same standing rule: fail instead of silently ignoring the
+            # knob on a path with no resident loop.
+            unsupported = (
+                not streamed or args.mean_combine or args.minibatch
+                or args.method_name in ("bisectingKMeans", "gaussianMixture")
+            )
+            if unsupported:
+                raise SystemExit(
+                    f"--residency={args.residency} applies to the streamed "
+                    "kmeans/fuzzy drivers (add --streamed/--num_batches); "
+                    "in-memory fits are already device-resident, and "
+                    "gaussianMixture/bisecting/mean_combine/minibatch "
+                    "have no resident loop"
+                )
+            if args.residency == "hbm" and args.ckpt_every_batches:
+                raise SystemExit(
+                    "--residency=hbm is incompatible with "
+                    "--ckpt_every_batches: the compiled on-device loop has "
+                    "no mid-pass boundaries to checkpoint at — drop one, "
+                    "or use --residency=auto to prefer mid-pass durability"
+                )
+
+        def residency_rows(rows: int, itemsize: int = 4,
+                           n_cache_devices: int | None = None) -> int:
+            """With a resident cache pinned in HBM for the whole fit, the
+            per-batch working set must fit the REMAINDER of the budget —
+            cap the batch rows via auto_batch_size(resident_bytes=...).
+            Without this, an over-sized batch OOMs the fill pass and
+            oom_adaptive halves batches forever against a budget that can
+            never fit (the cache does not shrink when batches do).
+            `n_cache_devices` is how many ways the cache itself divides:
+            the K-sharded cache is sharded over the data axis only and
+            REPLICATED across the model axis (_plan_sharded_residency), so
+            those call sites pass n_devices // shard_k, not n_devices.
+
+            This pre-check approximates plan_residency (which sees the
+            stream's real padded-batch geometry this helper is still
+            choosing): cache bytes here are unpadded, an under-estimate
+            of at most (pad_multiple-1)/batch_rows. In the sliver where
+            they disagree the planner still decides — worst case a
+            slightly-too-large cap makes the fill abandon loudly and the
+            fit streams; never a silent OOM spiral."""
+            if args.residency == "stream":
+                return rows
+            from tdc_tpu.data.batching import (
+                auto_batch_size,
+                hbm_budget_bytes,
+            )
+            from tdc_tpu.data.device_cache import state_reserve_bytes
+            from tdc_tpu.utils.structlog import emit
+
+            # Pinned alongside every batch: the cache shard plus the
+            # O(K*d) model-state copies plan_residency reserves — both
+            # must come out of the budget before the batch working set,
+            # or the cap admits batches the planner's feasibility test
+            # then rejects.
+            pinned = (
+                -(-n_obs * n_dim * itemsize
+                  // max(n_cache_devices or n_devices, 1))
+                + state_reserve_bytes(args.K, n_dim)
+            )
+            if pinned >= hbm_budget_bytes():
+                # The cache + state cannot fit: plan_residency will fall
+                # back to streaming (auto) or fail loudly in the fit
+                # (hbm). Capping the stream against the exhausted
+                # post-cache remainder here would collapse it to 1-row
+                # batches for a fit that ends up streaming anyway.
+                return rows
+            cap = auto_batch_size(
+                n_dim, args.K, n_devices=n_devices, itemsize=itemsize,
+                kernel="pallas" if args.kernel == "pallas" else "xla",
+                resident_bytes=pinned,
+            )
+            if rows > cap:
+                emit("residency_batch_cap", rows=rows, cap=cap,
+                     resident_bytes=pinned)
+                return cap
+            return rows
 
         def weight_stream(rows):
             # aligned batch-for-batch with make_stream's row slicing
@@ -729,7 +828,11 @@ def run_experiment(args) -> dict:
                     streamed_fuzzy_fit_sharded,
                 )
 
-                rows = -(-n_obs // num_batches)
+                rows = residency_rows(
+                    -(-n_obs // num_batches),
+                    itemsize=2 if args.dtype == "bfloat16" else 4,
+                    n_cache_devices=n_devices // args.shard_k,
+                )
                 return streamed_fuzzy_fit_sharded(
                     make_stream(rows), args.K, n_dim, mesh2d,
                     m=args.fuzzifier, init=args.init, key=key,
@@ -741,6 +844,7 @@ def run_experiment(args) -> dict:
                     ckpt_dir=args.ckpt_dir,
                     ckpt_every_batches=args.ckpt_every_batches,
                     reduce=_sharded_reduce(args),
+                    residency=args.residency,
                 )
             from tdc_tpu.parallel.sharded_k import fuzzy_fit_sharded
 
@@ -781,7 +885,11 @@ def run_experiment(args) -> dict:
             # the in-memory case (one batch) and pads ragged batches exactly.
             from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
 
-            rows = -(-n_obs // num_batches)
+            rows = residency_rows(
+                -(-n_obs // num_batches),
+                itemsize=2 if args.dtype == "bfloat16" else 4,
+                n_cache_devices=n_devices // args.shard_k,
+            )
             block = shard_block(rows)
             return streamed_kmeans_fit_sharded(
                 make_stream(rows), args.K, n_dim, mesh2d,
@@ -794,6 +902,7 @@ def run_experiment(args) -> dict:
                 ckpt_dir=args.ckpt_dir,
                 ckpt_every_batches=args.ckpt_every_batches,
                 reduce=_sharded_reduce(args),
+                residency=args.residency,
             )
         if args.method_name == "gaussianMixture":
             if streamed:
@@ -844,7 +953,14 @@ def run_experiment(args) -> dict:
             )
         if args.method_name == "distributedFuzzyCMeans":
             if streamed:
-                rows = -(-n_obs // num_batches)
+                rows = residency_rows(
+                    -(-n_obs // num_batches),
+                    # The 1-D streamed drivers never cast: the cache holds
+                    # the stream's own dtype (bf16 only when generation or
+                    # the data file made it so), unlike the shard_k sites
+                    # where --dtype drives a host-side cast.
+                    itemsize=np.dtype(x.dtype).itemsize,
+                )
                 return streamed_fuzzy_fit(
                     NpzStream(host_points(), rows), args.K, n_dim,
                     m=args.fuzzifier, init=args.init, key=key,
@@ -858,6 +974,7 @@ def run_experiment(args) -> dict:
                     ),
                     kernel=args.kernel or "xla",
                     reduce=args.reduce,
+                    residency=args.residency,
                 )
             return fuzzy_cmeans_fit(
                 xx, args.K, m=args.fuzzifier, init=args.init, key=key,
@@ -868,7 +985,10 @@ def run_experiment(args) -> dict:
                 history=args.history_file is not None,
             )
         if streamed:
-            rows = -(-n_obs // num_batches)
+            rows = residency_rows(
+                -(-n_obs // num_batches),
+                itemsize=np.dtype(x.dtype).itemsize,
+            )
             if args.mean_combine:
                 from tdc_tpu.models import mean_combine_fit
 
@@ -892,6 +1012,7 @@ def run_experiment(args) -> dict:
                 ),
                 kernel=args.kernel or "xla",
                 reduce=args.reduce,
+                residency=args.residency,
             )
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,

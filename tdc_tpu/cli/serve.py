@@ -63,6 +63,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="on SIGTERM: seconds the HTTP listener keeps "
                         "answering (503 for new work, 200 liveness) "
                         "before closing — the LB deregistration window")
+    p.add_argument("--compile_cache_dir", type=str,
+                   default=os.environ.get("TDC_COMPILE_CACHE", ""),
+                   help="persistent XLA compilation cache ('' disables; "
+                        "default $TDC_COMPILE_CACHE) — a restarted server "
+                        "deserializes its warmup/predict executables "
+                        "instead of recompiling (utils/compile_cache)")
     return p
 
 
@@ -90,6 +96,14 @@ def make_app(args):
 
         jax.config.update("jax_platforms", args.backend)
     import jax
+
+    if hasattr(args, "compile_cache_dir"):
+        # '' (the no-env default and the explicit opt-out) still calls in:
+        # recording the choice keeps a later enable_from_env() from
+        # re-enabling over it (utils/compile_cache).
+        from tdc_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache_dir)
 
     from tdc_tpu.serve import ModelRegistry, PredictEngine, ServeApp
     from tdc_tpu.utils.structlog import RunLog

@@ -35,29 +35,53 @@ def device_hbm_bytes(device=None) -> int:
     return _DEFAULT_HBM_BYTES
 
 
+def working_set_row_bytes(
+    n_dim: int, k: int, *, itemsize: int = 4, kernel: str = "xla"
+) -> int:
+    """Per-point working-set bytes of one stats pass — the model shared by
+    auto_batch_size and the residency planner (data/device_cache.py): the
+    XLA matmul form budgets the (N, K) distance row AND the materialized
+    f32 one-hot row per point; the fused Pallas kernels stream (block, K)
+    tiles through VMEM and never materialize either in HBM — their only
+    N-sized arrays are the x rows plus the (1,) label/min columns."""
+    if kernel == "pallas":
+        # x row + the per-point (label, min) columns; no HBM (N, K) buffers.
+        return itemsize * n_dim + 8
+    return itemsize * n_dim + 4 * k + 4 * k  # x + dists + one-hot
+
+
 def auto_batch_size(
     n_dim: int, k: int, *, n_devices: int = 1, itemsize: int = 4,
-    device=None, kernel: str = "xla",
+    device=None, kernel: str = "xla", resident_bytes: int = 0,
 ) -> int:
     """Max points per *global* batch that fit the per-device working set.
 
     Replaces the magic table keyed on GPU count (New-Distributed-KMeans.ipynb#cell13)
     with bytes_limit-derived sizing: rows_per_device = safety * HBM / bytes_per_row.
 
-    The working-set model is kernel-aware: the XLA matmul form budgets the
-    (N, K) distance row AND the materialized f32 one-hot row per point; the
-    fused Pallas kernels stream (block, K) tiles through VMEM and never
-    materialize either in HBM — their only N-sized arrays are the x rows
-    plus the (1,) label/min columns — so kernel='pallas' admits batches up
-    to ~(1 + 8k/(itemsize·d))× larger at the same HBM budget.
+    The working-set model is kernel-aware (`working_set_row_bytes`):
+    kernel='pallas' admits batches up to ~(1 + 8k/(itemsize·d))× larger at
+    the same HBM budget than the XLA matmul form.
+
+    resident_bytes: per-device bytes already pinned by an HBM-resident
+    dataset cache (data/device_cache.ResidencyPlan.resident_bytes). With
+    residency != "stream" the cache owns that slice of HBM for the whole
+    fit, so batch sizing must come out of the remainder — otherwise the
+    fill pass OOMs and `oom_adaptive` halves batches forever without the
+    budget ever fitting.
     """
-    if kernel == "pallas":
-        # x row + the per-point (label, min) columns; no HBM (N, K) buffers.
-        bytes_per_row = itemsize * n_dim + 8
-    else:
-        bytes_per_row = itemsize * n_dim + 4 * k + 4 * k  # x + dists + one-hot
-    per_device = int(_SAFETY_FRACTION * device_hbm_bytes(device) / bytes_per_row)
+    bytes_per_row = working_set_row_bytes(
+        n_dim, k, itemsize=itemsize, kernel=kernel
+    )
+    budget = hbm_budget_bytes(device) - resident_bytes
+    per_device = int(max(budget, 0) / bytes_per_row)
     return max(per_device * n_devices, 1)
+
+
+def hbm_budget_bytes(device=None) -> int:
+    """Per-device byte budget batch sizing (and residency feasibility
+    pre-checks) work within: the safety fraction of HBM."""
+    return int(_SAFETY_FRACTION * device_hbm_bytes(device))
 
 
 def is_oom_error(e: BaseException) -> bool:

@@ -25,7 +25,15 @@ from tdc_tpu.lint.engine import (
 # exclusion encodes the issue's finalization allowlist: a `float(shift)`
 # after the inner batch loop is per-pass finalization (one sync per
 # iteration, the PR-2 contract), not a per-batch sync.
+#
+# A `fault_point("resident.*")` marker OVERRIDES all of that: it names a
+# chunk-boundary loop (models/resident.run_resident_loop), where each trip
+# dispatches R compiled on-device iterations and the boundary fetch of
+# (n_done, shift, history) is the design — one sync per R iterations, with
+# the zero-transfer interior enforced by jax.transfer_guard — not a
+# per-batch round trip.
 _HOT_FAULT_PREFIXES = ("stream.", "data.")
+_CHUNK_BOUNDARY_PREFIXES = ("resident.",)
 _HOT_ITER_HINT = re.compile(
     r"batch|stream|loader|prefetch|minibatch", re.IGNORECASE
 )
@@ -70,18 +78,23 @@ def _region_nodes(loop) -> list[ast.AST]:
 
 
 def _loop_is_hot(loop, region: list[ast.AST]) -> bool:
+    hot = False
     for n in region:
         if not isinstance(n, ast.Call):
             continue
         seg = last_seg(call_name(n))
         if seg == "maybe_beat":
-            return True
-        if seg == "fault_point" and n.args:
+            hot = True
+        elif seg == "fault_point" and n.args:
             arg = n.args[0]
             if isinstance(arg, ast.Constant) and \
-                    isinstance(arg.value, str) and \
-                    arg.value.startswith(_HOT_FAULT_PREFIXES):
-                return True
+                    isinstance(arg.value, str):
+                if arg.value.startswith(_CHUNK_BOUNDARY_PREFIXES):
+                    return False  # chunk-boundary loop: fetches are by design
+                if arg.value.startswith(_HOT_FAULT_PREFIXES):
+                    hot = True
+    if hot:
+        return True
     if isinstance(loop, ast.For):
         for name in list(_names_in(loop.iter)) + list(_names_in(loop.target)):
             if _HOT_ITER_HINT.search(name):

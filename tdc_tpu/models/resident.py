@@ -1,0 +1,215 @@
+"""On-device multi-iteration driver over an HBM-resident dataset cache.
+
+The streamed fits dispatch one Python step per batch per iteration and
+re-upload every batch from host memory each pass. With the dataset cached
+in HBM (data/device_cache.py), iterations 2..N instead run as a single
+jitted `lax.while_loop` executing R iterations per dispatch:
+
+- the centroid carry is DONATED (`donate_argnums`), so updates happen in
+  place in HBM;
+- the shift-vs-tol convergence test runs on-device in the loop cond;
+- the host fetches state only at chunk boundaries — R = the checkpoint
+  cadence, so `ckpt_every` saves, the PR-3 preemption sync points, and
+  gang agreement land between dispatches exactly as they did between
+  streamed iterations.
+
+Every chunk dispatch (and the final reporting pass) runs under
+`jax.transfer_guard("disallow")`: the zero-H2D/D2H-per-resident-iteration
+claim is enforced at runtime, not just pinned by a test — a stray host
+value sneaking into the compiled loop fails loudly instead of silently
+re-paying the round trip this subsystem exists to eliminate.
+
+`make_resident_chunk` builds the compiled loop from a driver's traced
+`pass_fn` (one full accumulation pass over the cache, including the
+per-pass reduce and padding corrections — the fp32 op order is identical
+to the streamed path, which is what makes resident results bit-exact) and
+`update_fn` (centroid update + shift + history cost). `run_resident_loop`
+is the host-side boundary loop shared by the 1-D and K-sharded drivers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tdc_tpu.testing.faults import fault_point
+from tdc_tpu.utils import preempt
+from tdc_tpu.utils.heartbeat import maybe_beat
+from tdc_tpu.utils.preempt import Preempted
+
+# Chunk size when no checkpoint cadence dictates one: enough iterations to
+# amortize a dispatch + boundary fetch, small enough that preemption drains
+# and supervisor heartbeats stay responsive.
+DEFAULT_CHUNK_ITERS = 8
+
+
+def chunk_iters_for(ckpt_dir, ckpt_every: int) -> int:
+    """Iterations per compiled dispatch: the checkpoint cadence when
+    checkpointing (saves must land exactly on chunk boundaries — the
+    compiled loop has no interior host sync), else DEFAULT_CHUNK_ITERS."""
+    return max(ckpt_every, 1) if ckpt_dir is not None else DEFAULT_CHUNK_ITERS
+
+
+def place_scalar(v, mesh, dtype=jnp.int32):
+    """Commit a host scalar to the device(s) BEFORE the transfer guard: an
+    uncommitted scalar argument would be an implicit H2D (or, on a mesh, a
+    device-to-device reshard) inside the guarded dispatch."""
+    if mesh is None:
+        return jnp.asarray(v, dtype)
+    from tdc_tpu.parallel import mesh as mesh_lib
+
+    return mesh_lib.replicate(np.asarray(v, np.dtype(dtype)), mesh)
+
+
+def make_resident_chunk(pass_fn, update_fn, tol: float, chunk_iters: int):
+    """The compiled multi-iteration loop: (c, aux, cap, cache) ->
+    (c, aux, shift, n_done, hist).
+
+    pass_fn(c, aux, cache) -> (acc, aux): one full accumulation pass over
+    the cache (aux threads driver state through iterations — the quantized
+    reduce's error-feedback tree; () when unused). update_fn(acc, c) ->
+    (new_c, shift, cost). `cap` (a device scalar <= chunk_iters) bounds the
+    iterations this dispatch may run — min(chunk cadence, iterations left)
+    — without retracing; tol is trace-time (tol < 0 = fixed-iteration, no
+    early exit). hist rows at index >= n_done are zero.
+
+    c and aux are donated: the carry updates in place in HBM.
+    """
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def chunk(c, aux, cap, cache):
+        def cond(carry):
+            _, _, shift, i, _ = carry
+            live = i < cap
+            if tol >= 0:
+                live = jnp.logical_and(live, shift > tol)
+            return live
+
+        def body(carry):
+            c, aux, _, i, hist = carry
+            acc, aux = pass_fn(c, aux, cache)
+            new_c, shift, cost = update_fn(acc, c)
+            hist = hist.at[i].set(
+                jnp.stack([jnp.asarray(cost, jnp.float32), shift])
+            )
+            return new_c, aux, shift, i + 1, hist
+
+        carry0 = (
+            c,
+            aux,
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.asarray(0, jnp.int32),
+            jnp.zeros((chunk_iters, 2), jnp.float32),
+        )
+        c, aux, shift, i, hist = jax.lax.while_loop(cond, body, carry0)
+        return c, aux, shift, i, hist
+
+    return chunk
+
+
+def run_resident_loop(
+    *,
+    chunk,
+    cache,
+    c,
+    aux,
+    n_iter: int,
+    max_iters: int,
+    tol: float,
+    shift: float,
+    history: list,
+    chunk_iters: int,
+    mesh,
+    gang: bool,
+    ckpt=None,
+    ckpt_dir=None,
+    ckpt_every: int = 1,
+    counter=None,
+    comms_per_iter=(0, 0),
+    passes=None,
+):
+    """Drive `chunk` from iteration `n_iter`+1 to convergence/max_iters.
+
+    One host sync per chunk boundary (the `int(n_done)` fetch); everything
+    the streamed per-iteration loop did between iterations — heartbeat,
+    fault point, checkpoint save on the ckpt_every cadence, gang-agreed
+    preemption drain (PR 3: a gang must stop on the same boundary or the
+    next collective deadlocks) — happens here between dispatches. Returns
+    (c, aux, n_iter, shift, converged, history).
+
+    Heartbeat contract: the beat lands once per chunk, not once per
+    batch — the host cannot observe anything mid-chunk (that silence IS
+    the zero-round-trip property). Supervised runs must size
+    heartbeat_timeout above chunk_iters x per-iteration wall time
+    (docs/OPERATIONS.md), or the supervisor kills healthy workers.
+    """
+    done = tol >= 0 and shift <= tol
+    while not done and n_iter < max_iters:
+        step = min(chunk_iters, max_iters - n_iter)
+        if ckpt_dir is not None:
+            # Land the boundary exactly on the save cadence: the streamed
+            # loop saves at n_iter % ckpt_every == 0, and a chunk that
+            # drifts off the multiple would never satisfy it.
+            step = min(step, ckpt_every - n_iter % ckpt_every)
+        cap = place_scalar(step, mesh)
+        with jax.transfer_guard("disallow"):
+            c, aux, shift_dev, did_dev, hist = chunk(c, aux, cap, cache)
+        did = int(did_dev)
+        rows = np.asarray(hist)[:did]
+        shift = float(shift_dev)
+        history.extend((float(a), float(b)) for a, b in rows)
+        n_iter += did
+        if counter is not None and did:
+            counter.add(comms_per_iter[0] * did, comms_per_iter[1] * did)
+        if passes is not None:
+            passes[0] += did
+        maybe_beat(progress=f"resident iter={n_iter}")
+        fault_point("resident.chunk")
+        done = tol >= 0 and shift <= tol
+        saved_now = ckpt_dir is not None and (
+            done or n_iter % ckpt_every == 0 or n_iter == max_iters
+        )
+        if saved_now:
+            ckpt.save(n_iter, c, shift, history)
+        # Gang-agreed preemption point (models/streaming contract): every
+        # process reaches the same chunk boundary with the same n_iter, so
+        # the agreement collective lines up across the gang.
+        if preempt.installed() and preempt.sync_requested(gang=gang):
+            if ckpt_dir is not None and not saved_now:
+                ckpt.save(n_iter, c, shift, history)
+            raise Preempted(
+                f"preempted at resident chunk boundary (iteration {n_iter})"
+            )
+        if did == 0:
+            # Unreachable by construction (cap >= 1 and the compiled cond
+            # seeds shift=inf, so every dispatch runs >= 1 iteration) —
+            # kept so a broken invariant stalls loudly instead of
+            # re-dispatching the same chunk forever.
+            break
+    return c, aux, n_iter, shift, done, history
+
+
+def final_pass(pass_only, c, aux, cache, *, counter=None,
+               comms_per_iter=(0, 0), passes=None):
+    """The end-of-fit reporting pass over the cache (SSE/objective at the
+    RETURNED centroids) — same zero-transfer contract as the chunk."""
+    with jax.transfer_guard("disallow"):
+        acc, aux = pass_only(c, aux, cache)
+    if counter is not None:
+        counter.add(*comms_per_iter)
+    if passes is not None:
+        passes[0] += 1
+    return acc, aux
+
+
+__all__ = [
+    "DEFAULT_CHUNK_ITERS",
+    "chunk_iters_for",
+    "final_pass",
+    "make_resident_chunk",
+    "place_scalar",
+    "run_resident_loop",
+]

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tdc_tpu.data import device_cache as device_cache_lib
+from tdc_tpu.models import resident as resident_lib
 from tdc_tpu.ops.assign import (
     FuzzyStats,
     SufficientStats,
@@ -694,6 +696,180 @@ def _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=0,
     return deferred, n_mesh_dev
 
 
+def _plan_1d_residency(residency, batches, k, d, mesh, *, weighted,
+                       kernel, cursor, label, mid_pass_ckpt=False):
+    """Residency planning for the 1-D streamed drivers: translate the
+    fit's mesh layout into the planner's padding geometry (multi-process
+    meshes stream per-host slices padded to the local device count;
+    single-process meshes pad the global batch to the mesh size) and
+    build the cache fill when the plan says resident. Returns
+    (plan, builder-or-None); residency='stream' validates and returns
+    (None, None) with zero overhead."""
+    if residency not in device_cache_lib.RESIDENCY_MODES:
+        raise ValueError(
+            f"residency={residency!r}: use 'stream', 'auto', or 'hbm'"
+        )
+    if residency == "stream":
+        return None, None
+    if mesh is None:
+        n_dev, pad_multiple, scale = 1, 1, 1
+    else:
+        nproc, local_dev = _mesh_layout(mesh)
+        n_dev = int(np.prod(mesh.devices.shape))
+        if nproc > 1:
+            pad_multiple, scale = max(local_dev, 1), nproc
+        else:
+            pad_multiple, scale = n_dev, 1
+    plan = device_cache_lib.plan_residency(
+        residency,
+        hints=device_cache_lib.stream_hints(batches),
+        d=d, k=k, n_devices=n_dev, pad_multiple=pad_multiple,
+        process_scale=scale,
+        itemsize=device_cache_lib.stream_itemsize(batches) or 4,
+        weighted=weighted, kernel=kernel,
+        cursor=cursor, mid_pass_ckpt=mid_pass_ckpt, label=label,
+    )
+    builder = None
+    if plan.resident:
+        builder = device_cache_lib.DeviceCacheBuilder(
+            plan.hints.n_batches, mesh=mesh, weighted=weighted, label=label
+        )
+    return plan, builder
+
+
+@lru_cache(maxsize=32)
+def _resident_lloyd_fns(mesh, k, d, spherical, kernel, quantize, weighted,
+                        deferred, tol, chunk_iters):
+    """(chunk, pass_only) for streamed_kmeans_fit's resident mode — the
+    compiled R-iteration loop over the DeviceCache plus the final
+    reporting pass. Cached per configuration (the _lloyd_fit_fns
+    rationale: fresh closures would re-trace every fit). The pass body is
+    the streamed pass's exact op sequence — per-batch _accumulate (or the
+    deferred d_add + ONE per-pass reduce + whole-pass padding correction)
+    in stream order."""
+    if deferred:
+        _, d_add, d_reduce = _deferred_lloyd_fns(
+            mesh, k, d, spherical, kernel, quantize, weighted
+        )
+        n_dev = int(np.prod(mesh.devices.shape))
+        axes = mesh_lib.data_axes(mesh)
+        dspec = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(
+                axes if len(axes) > 1 else axes[0]
+            )
+        )
+        example = _lloyd_example(k, d)
+
+    def pass_fn(c, aux, cache):
+        def one(a, xb, wb, nv):
+            if deferred:
+                return d_add(a, xb, wb, c) if weighted else d_add(a, xb, c)
+            if weighted:
+                return _accumulate_weighted(a, xb, wb, c, spherical,
+                                            kernel, mesh)
+            return _accumulate(a, xb, c, nv, spherical, kernel, mesh)
+
+        if deferred:
+            acc = jax.tree.map(
+                lambda t: jax.lax.with_sharding_constraint(
+                    jnp.zeros((n_dev,) + tuple(t.shape), t.dtype), dspec
+                ),
+                example,
+            )
+        else:
+            acc = SufficientStats(
+                sums=jnp.zeros((k, d), jnp.float32),
+                counts=jnp.zeros((k,), jnp.float32),
+                sse=jnp.zeros((), jnp.float32),
+            )
+        acc = device_cache_lib.scan_cache(acc, cache, one, weighted)
+        if not deferred:
+            return acc, aux
+        if quantize is not None:
+            acc, aux = d_reduce(acc, aux)
+        else:
+            acc = d_reduce(acc)
+        n_pad = (jnp.asarray(0.0, jnp.float32) if weighted
+                 else device_cache_lib.cache_pad_rows(cache))
+        return _lloyd_pass_correction(
+            acc, c, n_pad,
+            cast=str(cache.tail.dtype) if kernel == "pallas" else None,
+        ), aux
+
+    def update_fn(acc, c):
+        new_c = apply_centroid_update(acc, c)
+        if spherical:
+            new_c = _normalize(new_c)
+        shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
+        return new_c, shift, acc.sse
+
+    chunk = resident_lib.make_resident_chunk(pass_fn, update_fn, tol,
+                                             chunk_iters)
+    return chunk, jax.jit(pass_fn)
+
+
+@lru_cache(maxsize=32)
+def _resident_fuzzy_fns(mesh, k, d, m, kernel, quantize, weighted,
+                        deferred, tol, chunk_iters):
+    """streamed_fuzzy_fit's (chunk, pass_only) — see _resident_lloyd_fns."""
+    if deferred:
+        _, d_add, d_reduce = _deferred_fuzzy_fns(
+            mesh, k, d, m, kernel, quantize, weighted
+        )
+        n_dev = int(np.prod(mesh.devices.shape))
+        axes = mesh_lib.data_axes(mesh)
+        dspec = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(
+                axes if len(axes) > 1 else axes[0]
+            )
+        )
+        example = _fuzzy_example(k, d)
+
+    def pass_fn(c, aux, cache):
+        def one(a, xb, wb, nv):
+            if deferred:
+                return d_add(a, xb, wb, c) if weighted else d_add(a, xb, c)
+            if weighted:
+                return _accumulate_fuzzy_weighted(a, xb, wb, c, m, mesh)
+            return _accumulate_fuzzy(a, xb, c, nv, m, kernel, mesh)
+
+        if deferred:
+            acc = jax.tree.map(
+                lambda t: jax.lax.with_sharding_constraint(
+                    jnp.zeros((n_dev,) + tuple(t.shape), t.dtype), dspec
+                ),
+                example,
+            )
+        else:
+            acc = FuzzyStats(
+                weighted_sums=jnp.zeros((k, d), jnp.float32),
+                weights=jnp.zeros((k,), jnp.float32),
+                objective=jnp.zeros((), jnp.float32),
+            )
+        acc = device_cache_lib.scan_cache(acc, cache, one, weighted)
+        if not deferred:
+            return acc, aux
+        if quantize is not None:
+            acc, aux = d_reduce(acc, aux)
+        else:
+            acc = d_reduce(acc)
+        n_pad = (jnp.asarray(0.0, jnp.float32) if weighted
+                 else device_cache_lib.cache_pad_rows(cache))
+        return _fuzzy_pass_correction(
+            acc, c, n_pad, m=m,
+            cast=str(cache.tail.dtype) if kernel == "pallas" else None,
+        ), aux
+
+    def update_fn(acc, c):
+        new_c = acc.weighted_sums / jnp.maximum(acc.weights[:, None], 1e-12)
+        shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
+        return new_c, shift, acc.objective
+
+    chunk = resident_lib.make_resident_chunk(pass_fn, update_fn, tol,
+                                             chunk_iters)
+    return chunk, jax.jit(pass_fn)
+
+
 def _broadcast_init(init, mesh):
     """Name-resolved inits come from the FIRST LOCAL batch, which differs per
     host when the fit's mesh spans processes — broadcast process 0's so the
@@ -856,6 +1032,7 @@ def streamed_kmeans_fit(
     sample_weight_batches: Callable[[], Iterable] | None = None,
     kernel: str = "xla",
     reduce="per_batch",
+    residency: str = "stream",
 ) -> KMeansResult:
     """Exact Lloyd over a re-iterable stream of (B, d) batches.
 
@@ -909,6 +1086,22 @@ def streamed_kmeans_fit(
         any strategy reduce in two stages, ICI first. See
         parallel/reduce.py; the fit result's `comms` field reports reduces
         issued and logical bytes moved.
+      residency: "stream" (default — today's behavior), "hbm", or "auto"
+        (data/device_cache.py). Under "hbm"/"auto", iteration 1 streams AND
+        fills a per-device HBM cache of the (padded, mesh-laid-out)
+        dataset; iterations 2..N then run as a compiled on-device loop
+        (models/resident.py) with donated centroid carry, the convergence
+        test in the loop cond, and ZERO host transfers per iteration
+        (enforced by jax.transfer_guard) — host fetches, checkpoint saves,
+        and preemption sync points land only at chunk boundaries (R =
+        ckpt_every when checkpointing). Results are bit-exact (fp32) with
+        the streamed path: the cache replays the exact per-batch geometry
+        and accumulation order. "auto" requires the stream to advertise
+        its size (NpzStream does; see device_cache.stream_hints) and falls
+        back to streaming — loudly, via a structlog `residency_fallback`
+        event — when the dataset + accumulators exceed the HBM budget;
+        it never truncates. A mid-pass checkpoint resume also degrades to
+        streaming for that run (the fill cannot replay a partial pass).
     """
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
@@ -973,6 +1166,11 @@ def streamed_kmeans_fit(
     deferred, n_mesh_dev = _reduce_plan(
         strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=state.cursor
     )
+    _, builder = _plan_1d_residency(
+        residency, batches, k, d, mesh, weighted=weighted, kernel=kernel,
+        cursor=state.cursor, label="streamed_kmeans_fit",
+        mid_pass_ckpt=ckpt_every_batches is not None,
+    )
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
     passes = [0]
     axes = mesh_lib.data_axes(mesh) if mesh is not None else ()
@@ -987,7 +1185,7 @@ def streamed_kmeans_fit(
         )
         err_state = [d_zero() if strategy.quantize else None]
 
-    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
+    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0, fill=None):
         passes[0] += 1
         pad = [0.0]
         bdt = ["float32"]
@@ -997,6 +1195,8 @@ def streamed_kmeans_fit(
                 xb, wb, n_local = _prepare_weighted_batch(
                     batch[0], batch[1], mesh
                 )
+                if fill is not None:
+                    fill.add(xb, xb.shape[0], wb)
                 if deferred:
                     bdt[0] = str(xb.dtype)
                     return d_add(acc, xb, wb, c), n_local
@@ -1007,6 +1207,8 @@ def streamed_kmeans_fit(
                     n_local,
                 )
             xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            if fill is not None:
+                fill.add(xb, n_valid)
             if deferred:
                 pad[0] += xb.shape[0] - n_valid
                 bdt[0] = str(xb.dtype)
@@ -1046,10 +1248,19 @@ def streamed_kmeans_fit(
     # A restored checkpoint that had already converged leaves nothing to do —
     # don't run (and checkpoint) extra iterations past convergence.
     resume_converged = tol >= 0 and shift <= tol
+    cache = None
+    chunk_iters = resident_lib.chunk_iters_for(ckpt_dir, ckpt_every)
     for n_iter in range(start_iter + 1, max_iters + 1) if not resume_converged else ():
+        fill = (builder if n_iter == start_iter + 1 and not resume_cursor
+                else None)
         acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
-                        rows0=state.rows_seen if resume_cursor else 0)
+                        rows0=state.rows_seen if resume_cursor else 0,
+                        fill=fill)
         resume_cursor, resume_acc = 0, None
+        if fill is not None:
+            # Even a fit that converges on iteration 1 reuses the cache for
+            # the final reporting pass below.
+            cache = fill.finish()
         if weighted and n_iter == start_iter + 1 \
                 and float(jnp.sum(acc.counts)) <= 0.0:
             raise ValueError(
@@ -1081,10 +1292,46 @@ def streamed_kmeans_fit(
             raise Preempted(f"preempted after iteration {n_iter}")
         if done:
             break
+        if cache is not None:
+            break  # iterations 2..N run on-device over the cache
+    if cache is not None:
+        chunk, pass_only = _resident_lloyd_fns(
+            mesh, k, d, bool(spherical), kernel, strategy.quantize,
+            weighted, deferred, float(tol), chunk_iters,
+        )
+        aux = (err_state[0]
+               if deferred and strategy.quantize is not None else ())
+        if deferred:
+            cost_ri = reduce_lib.tree_reduce_cost(example, axes,
+                                                  strategy.quantize)
+        else:
+            cost_ri = (cost_pb[0] * cache.n_batches,
+                       cost_pb[1] * cache.n_batches)
+        if n_iter < max_iters and not (tol >= 0 and float(shift) <= tol):
+            shift = float(shift)
+            c, aux, n_iter, shift, _, history = (
+                resident_lib.run_resident_loop(
+                    chunk=chunk, cache=cache, c=c, aux=aux, n_iter=n_iter,
+                    max_iters=max_iters, tol=tol, shift=shift,
+                    history=history, chunk_iters=chunk_iters, mesh=mesh,
+                    gang=ckpt.gang, ckpt=ckpt, ckpt_dir=ckpt_dir,
+                    ckpt_every=ckpt_every, counter=counter,
+                    comms_per_iter=cost_ri, passes=passes,
+                )
+            )
     shift = float(shift)  # one deferred fetch on the async path
     # One extra stats pass so the reported SSE matches the *returned* centroids
     # (kmeans_fit does the same; the in-loop SSE is one update stale).
-    sse = full_pass(c).sse
+    if cache is not None:
+        facc, aux = resident_lib.final_pass(
+            pass_only, c, aux, cache, counter=counter,
+            comms_per_iter=cost_ri, passes=passes,
+        )
+        if deferred and strategy.quantize is not None:
+            err_state[0] = aux
+        sse = facc.sse
+    else:
+        sse = full_pass(c).sse
     return KMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),
@@ -1256,16 +1503,20 @@ def streamed_fuzzy_fit(
     sample_weight_batches: Callable[[], Iterable] | None = None,
     kernel: str = "xla",
     reduce="per_batch",
+    residency: str = "stream",
 ) -> FuzzyCMeansResult:
     """Exact streamed Fuzzy C-Means — same contract as streamed_kmeans_fit,
     including checkpoint/resume (per-iteration and mid-pass, with the
     ckpt_keep_last_n retention knob and graceful-preemption drain),
     streamed sample weights, the per-iteration (objective, shift) history
     the reference never computed, kernel='pallas' per-batch stats (raises
-    with sample_weight_batches — no weighted Pallas kernel), and the
+    with sample_weight_batches — no weighted Pallas kernel), the
     `reduce=` strategy knob ("per_batch" / "per_pass" /
     "per_pass:bf16|int8" — see streamed_kmeans_fit and
-    parallel/reduce.py)."""
+    parallel/reduce.py), and the `residency=` HBM-cache knob ("stream" /
+    "auto" / "hbm" — iteration 1 fills a per-device HBM cache, iterations
+    2..N run as a compiled on-device loop with zero host transfers per
+    iteration; see streamed_kmeans_fit and data/device_cache.py)."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     if kernel not in ("xla", "pallas"):
@@ -1330,6 +1581,11 @@ def streamed_fuzzy_fit(
     deferred, n_mesh_dev = _reduce_plan(
         strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=state.cursor
     )
+    _, builder = _plan_1d_residency(
+        residency, batches, k, d, mesh, weighted=weighted, kernel=kernel,
+        cursor=state.cursor, label="streamed_fuzzy_fit",
+        mid_pass_ckpt=ckpt_every_batches is not None,
+    )
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
     passes = [0]
     axes = mesh_lib.data_axes(mesh) if mesh is not None else ()
@@ -1344,7 +1600,7 @@ def streamed_fuzzy_fit(
         )
         err_state = [d_zero() if strategy.quantize else None]
 
-    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
+    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0, fill=None):
         passes[0] += 1
         pad = [0.0]
         bdt = ["float32"]
@@ -1354,6 +1610,8 @@ def streamed_fuzzy_fit(
                 xb, wb, n_local = _prepare_weighted_batch(
                     batch[0], batch[1], mesh
                 )
+                if fill is not None:
+                    fill.add(xb, xb.shape[0], wb)
                 if deferred:
                     bdt[0] = str(xb.dtype)
                     return d_add(acc, xb, wb, c), n_local
@@ -1363,6 +1621,8 @@ def streamed_fuzzy_fit(
                     n_local,
                 )
             xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            if fill is not None:
+                fill.add(xb, n_valid)
             if deferred:
                 pad[0] += xb.shape[0] - n_valid
                 bdt[0] = str(xb.dtype)
@@ -1398,10 +1658,17 @@ def streamed_fuzzy_fit(
 
     n_iter = start_iter
     resume_converged = tol >= 0 and shift <= tol
+    cache = None
+    chunk_iters = resident_lib.chunk_iters_for(ckpt_dir, ckpt_every)
     for n_iter in range(start_iter + 1, max_iters + 1) if not resume_converged else ():
+        fill = (builder if n_iter == start_iter + 1 and not resume_cursor
+                else None)
         acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
-                        rows0=state.rows_seen if resume_cursor else 0)
+                        rows0=state.rows_seen if resume_cursor else 0,
+                        fill=fill)
         resume_cursor, resume_acc = 0, None
+        if fill is not None:
+            cache = fill.finish()
         if weighted and n_iter == start_iter + 1 \
                 and float(jnp.sum(acc.weights)) <= 0.0:
             raise ValueError(
@@ -1428,8 +1695,44 @@ def streamed_fuzzy_fit(
             raise Preempted(f"preempted after iteration {n_iter}")
         if done:
             break
+        if cache is not None:
+            break  # iterations 2..N run on-device over the cache
+    if cache is not None:
+        chunk, pass_only = _resident_fuzzy_fns(
+            mesh, k, d, float(m), kernel, strategy.quantize,
+            weighted, deferred, float(tol), chunk_iters,
+        )
+        aux = (err_state[0]
+               if deferred and strategy.quantize is not None else ())
+        if deferred:
+            cost_ri = reduce_lib.tree_reduce_cost(example, axes,
+                                                  strategy.quantize)
+        else:
+            cost_ri = (cost_pb[0] * cache.n_batches,
+                       cost_pb[1] * cache.n_batches)
+        if n_iter < max_iters and not (tol >= 0 and float(shift) <= tol):
+            shift = float(shift)
+            c, aux, n_iter, shift, _, history = (
+                resident_lib.run_resident_loop(
+                    chunk=chunk, cache=cache, c=c, aux=aux, n_iter=n_iter,
+                    max_iters=max_iters, tol=tol, shift=shift,
+                    history=history, chunk_iters=chunk_iters, mesh=mesh,
+                    gang=ckpt.gang, ckpt=ckpt, ckpt_dir=ckpt_dir,
+                    ckpt_every=ckpt_every, counter=counter,
+                    comms_per_iter=cost_ri, passes=passes,
+                )
+            )
     shift = float(shift)  # one deferred fetch on the async path
-    objective = full_pass(c).objective
+    if cache is not None:
+        facc, aux = resident_lib.final_pass(
+            pass_only, c, aux, cache, counter=counter,
+            comms_per_iter=cost_ri, passes=passes,
+        )
+        if deferred and strategy.quantize is not None:
+            err_state[0] = aux
+        objective = facc.objective
+    else:
+        objective = full_pass(c).objective
     return FuzzyCMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),

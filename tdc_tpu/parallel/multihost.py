@@ -33,7 +33,15 @@ def initialize_distributed(
 
     Args come from the environment in managed deployments (TPU VMs autodetect);
     pass explicitly for manual clusters. Returns (process_index, num_processes).
+
+    Also the workers' persistent-compile-cache hook: a $TDC_COMPILE_CACHE
+    inherited from the supervisor (or the deployment env) is enabled here,
+    so a gang relaunched after preemption deserializes its fit executables
+    instead of recompiling (utils/compile_cache).
     """
+    from tdc_tpu.utils.compile_cache import enable_from_env
+
+    enable_from_env()
     if num_processes is not None and num_processes > 1:
         _enable_cpu_collectives()
         jax.distributed.initialize(

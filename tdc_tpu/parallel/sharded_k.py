@@ -34,6 +34,7 @@ from tdc_tpu.parallel.compat import shard_map
 
 from tdc_tpu.ops.distance import pairwise_sq_dist
 from tdc_tpu.models.kmeans import KMeansResult, _normalize, resolve_init
+from tdc_tpu.models.resident import chunk_iters_for as _chunk_iters_for
 from tdc_tpu.utils.heartbeat import maybe_beat
 
 DATA_AXIS = "data"
@@ -1083,6 +1084,41 @@ def _make_put_batch(mesh, pad_multiple: int, dtype, spherical: bool = False):
     return put_batch
 
 
+def _plan_sharded_residency(residency, batches, k, d, mesh, *, n_data,
+                            pad_multiple, kernel, dtype, cursor, label,
+                            mid_pass_ckpt=False):
+    """Residency planning for the K-sharded streamed drivers. Geometry:
+    every process streams IDENTICAL GLOBAL batches (the sharded contract),
+    padded to n_data*block_rows and sharded over the data axis only — the
+    cache is replicated across the model axis, so the per-device budget
+    divides by n_data, not n_data*n_model. `dtype` (the host-side bf16
+    cast) halves the cache itemsize; without the cast the stream's own
+    element width (stream_itemsize) budgets natively-bf16 streams."""
+    from tdc_tpu.data import device_cache as dc
+
+    if residency not in dc.RESIDENCY_MODES:
+        raise ValueError(
+            f"residency={residency!r}: use 'stream', 'auto', or 'hbm'"
+        )
+    if residency == "stream":
+        return None, None
+    itemsize = (
+        int(np.dtype(dtype).itemsize) if dtype is not None
+        else dc.stream_itemsize(batches) or 4
+    )
+    plan = dc.plan_residency(
+        residency, hints=dc.stream_hints(batches), d=d, k=k,
+        n_devices=n_data, pad_multiple=pad_multiple, process_scale=1,
+        itemsize=itemsize, weighted=False, kernel=kernel, cursor=cursor,
+        mid_pass_ckpt=mid_pass_ckpt, label=label,
+    )
+    builder = None
+    if plan.resident:
+        builder = dc.DeviceCacheBuilder(plan.hints.n_batches, mesh=mesh,
+                                        weighted=False, label=label)
+    return plan, builder
+
+
 def _sharded_stream_loop(
     *,
     batches,
@@ -1101,6 +1137,13 @@ def _sharded_stream_loop(
     update,
     acc_cost,
     finalize=None,
+    fill=None,
+    make_resident=None,
+    resident_cost=None,
+    chunk_iters: int = 0,
+    mesh=None,
+    gang: bool = False,
+    counter=None,
 ):
     """The deferred-sync iteration driver shared by the streamed K-sharded
     fits (Lloyd and fuzzy differ only in their accumulator algebra): resume
@@ -1118,10 +1161,19 @@ def _sharded_stream_loop(
     cross-device reduce and padding correction; update/acc_cost then see a
     standard reduced accumulator.
 
+    Residency (data/device_cache.py): with a `fill` builder, the first
+    executed pass streams AND fills the HBM cache — step_batch is then
+    called as step_batch(acc, batch, c, fill) — and iterations 2..N run as
+    make_resident(cache)'s compiled chunk loop (models/resident.py) with
+    host fetches, checkpoint saves, and gang-agreed preemption drains only
+    at chunk boundaries. resident_cost(cache) -> the per-resident-iteration
+    comms (reduces, bytes) the counter should book.
+
     Returns (c, n_iter, start_iter, shift, converged, history, final_acc)
     where final_acc is one extra pass at the RETURNED centroids (its cost
     is the fit's reported SSE/objective — parity with streamed_kmeans_fit).
     """
+    from tdc_tpu.models import resident as resident_lib
     from tdc_tpu.models.streaming import _run_pass
 
     shift = state.shift
@@ -1130,10 +1182,12 @@ def _sharded_stream_loop(
     resume_cursor, resume_rows = state.cursor, state.rows_seen
     resume_acc = None if state.acc is None else put_acc(state.acc)
 
-    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0):
+    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0, pass_fill=None):
         def pass_step(acc, batch):
             maybe_beat()  # supervised-gang liveness
-            return step_batch(acc, batch, c)
+            if pass_fill is None:
+                return step_batch(acc, batch, c)
+            return step_batch(acc, batch, c, pass_fill)
 
         return _run_pass(
             batches, prefetch, zero_acc, pass_step,
@@ -1145,13 +1199,20 @@ def _sharded_stream_loop(
     n_iter = start_iter
     resume_converged = tol >= 0 and shift <= tol
     converged = resume_converged
+    cache = None
     iters = (
         () if resume_converged else range(start_iter + 1, max_iters + 1)
     )
     for n_iter in iters:
+        use_fill = (fill if n_iter == start_iter + 1 and not resume_cursor
+                    else None)
         acc = full_pass(c, n_iter, skip=resume_cursor, acc0=resume_acc,
-                        rows0=resume_rows)
+                        rows0=resume_rows, pass_fill=use_fill)
         resume_cursor, resume_acc, resume_rows = 0, None, 0
+        if use_fill is not None:
+            # Even a fit that converges on iteration 1 reuses the cache
+            # for the final reporting pass below.
+            cache = use_fill.finish()
         if finalize is not None:
             acc = finalize(acc, c)
         c, shift_dev = update(acc, c)
@@ -1166,10 +1227,34 @@ def _sharded_stream_loop(
         if done:
             converged = True
             break
+        if cache is not None:
+            break  # iterations 2..N run on-device over the cache
+    chunk_fns = None
+    if cache is not None and make_resident is not None:
+        chunk_fns = make_resident(cache)
+        cost_ri = resident_cost(cache)
+        if n_iter < max_iters and not (tol >= 0 and float(shift) <= tol):
+            shift = float(shift)
+            c, _, n_iter, shift, converged, history = (
+                resident_lib.run_resident_loop(
+                    chunk=chunk_fns[0], cache=cache, c=c, aux=(),
+                    n_iter=n_iter, max_iters=max_iters, tol=tol,
+                    shift=shift, history=history, chunk_iters=chunk_iters,
+                    mesh=mesh, gang=gang, ckpt=ckpt, ckpt_dir=ckpt_dir,
+                    ckpt_every=ckpt_every, counter=counter,
+                    comms_per_iter=cost_ri,
+                )
+            )
     shift = float(shift)  # one deferred fetch on the async path
-    final_acc = full_pass(c)
-    if finalize is not None:
-        final_acc = finalize(final_acc, c)
+    if chunk_fns is not None:
+        final_acc, _ = resident_lib.final_pass(
+            chunk_fns[1], c, (), cache, counter=counter,
+            comms_per_iter=cost_ri,
+        )
+    else:
+        final_acc = full_pass(c)
+        if finalize is not None:
+            final_acc = finalize(final_acc, c)
     return c, n_iter, start_iter, shift, converged, history, final_acc
 
 
@@ -1192,6 +1277,7 @@ def streamed_kmeans_fit_sharded(
     ckpt_every: int = 1,
     ckpt_every_batches: int | None = None,
     reduce="per_batch",
+    residency: str = "stream",
 ) -> KMeansResult:
     """Exact out-of-core Lloyd under the 2-D (data × model) layout — the
     1B×768, K=16,384 configuration: batches stream host→device, each batch's
@@ -1206,6 +1292,15 @@ def streamed_kmeans_fit_sharded(
     (tolerance-level, not bitwise, parity) and no mid-pass checkpointing.
     The fit result's `comms` field reports reduces issued / logical bytes.
     Quantized encodings are wired for the 1-D streamed fits only.
+
+    residency: "stream" (default), "hbm", or "auto" — under "hbm"/"auto"
+    iteration 1 streams AND fills a per-device HBM cache of the padded,
+    data-axis-sharded batches (replicated over the model axis; the bf16
+    `dtype` cast halves the cache), and iterations 2..N run as a compiled
+    on-device chunk loop with zero host transfers per iteration
+    (models/resident.py; same contract as streamed_kmeans_fit). "auto"
+    falls back to streaming — loudly, via a structlog `residency_fallback`
+    event — when dataset + accumulators exceed the per-device HBM budget.
 
     `batches` follows the models/streaming contract: a zero-arg callable
     returning a fresh iterator of (rows, d) arrays per Lloyd iteration.
@@ -1310,6 +1405,13 @@ def streamed_kmeans_fit_sharded(
 
     stats_fn = make_sharded_stats(mesh, kernel, block_rows,
                                   reduce_data=not deferred)
+    _, r_builder = _plan_sharded_residency(
+        residency, batches, k, d, mesh, n_data=n_data,
+        pad_multiple=pad_multiple, kernel=kernel, dtype=dtype,
+        cursor=state.cursor, label="streamed_kmeans_fit_sharded",
+        mid_pass_ckpt=ckpt_every_batches is not None,
+    )
+    chunk_iters = _chunk_iters_for(ckpt_dir, ckpt_every)
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
     cost_reduce = (
         reduce_lib.tree_reduce_cost(_lloyd_example(k, d), (DATA_AXIS,))
@@ -1355,8 +1457,10 @@ def streamed_kmeans_fit_sharded(
             counter.add(*cost_reduce)
             return _finalize_jit(acc, c, jnp.asarray(n_pad, jnp.float32))
 
-        def step_batch(acc, batch, c):
+        def step_batch(acc, batch, c, fill=None):
             xb, n_valid = put_batch(batch)
+            if fill is not None:
+                fill.add(xb, n_valid)
             pad_cell[0] += xb.shape[0] - n_valid
             return accumulate(acc, xb, c), n_valid
 
@@ -1392,8 +1496,10 @@ def streamed_kmeans_fit_sharded(
                 acc.sums + sums, acc.counts + counts, acc.sse + sse
             )
 
-        def step_batch(acc, batch, c):
+        def step_batch(acc, batch, c, fill=None):
             xb, n_valid = put_batch(batch)
+            if fill is not None:
+                fill.add(xb, n_valid)
             counter.add(*cost_reduce)
             return accumulate(acc, xb, c, n_valid), n_valid
 
@@ -1410,6 +1516,82 @@ def streamed_kmeans_fit_sharded(
                 sse=jnp.zeros((), jnp.float32),
             )
 
+    def make_resident(cache):
+        """(chunk, pass_only) over the HBM cache — the pass body mirrors
+        the streamed accumulate/finalize ops EXACTLY (same per-batch stats
+        in stream order, same one-per-pass deferred reduce and padding
+        correction), which keeps resident results bit-exact."""
+        from tdc_tpu.data import device_cache as dc
+        from tdc_tpu.models import resident as resident_lib
+
+        def pass_fn(c, aux, cache_):
+            if deferred:
+                acc = _ShardedAcc(
+                    sums=jax.lax.with_sharding_constraint(
+                        jnp.zeros((n_data, k, d), jnp.float32),
+                        NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None)),
+                    ),
+                    counts=jax.lax.with_sharding_constraint(
+                        jnp.zeros((n_data, k), jnp.float32),
+                        NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)),
+                    ),
+                    sse=jax.lax.with_sharding_constraint(
+                        jnp.zeros((n_data,), jnp.float32),
+                        NamedSharding(mesh, P(DATA_AXIS)),
+                    ),
+                )
+
+                def one(a, xb, wb, nv):
+                    sums, counts, sse = stats_fn(xb, c)
+                    return _ShardedAcc(
+                        a.sums + sums, a.counts + counts, a.sse + sse
+                    )
+
+                acc = dc.scan_cache(acc, cache_, one, False)
+                sums, counts, sse = _dred(acc.sums, acc.counts, acc.sse)
+                counts, sse = padding_correction(
+                    counts, sse, c, dc.cache_pad_rows(cache_)
+                )
+                return _ShardedAcc(sums, counts, sse), aux
+
+            acc = _ShardedAcc(
+                sums=jax.lax.with_sharding_constraint(
+                    jnp.zeros((k, d), jnp.float32),
+                    NamedSharding(mesh, P(MODEL_AXIS, None)),
+                ),
+                counts=jax.lax.with_sharding_constraint(
+                    jnp.zeros((k,), jnp.float32),
+                    NamedSharding(mesh, P(MODEL_AXIS)),
+                ),
+                sse=jnp.zeros((), jnp.float32),
+            )
+
+            def one(a, xb, wb, nv):
+                sums, counts, sse = stats_fn(xb, c)
+                counts, sse = padding_correction(
+                    counts, sse, c, xb.shape[0] - nv
+                )
+                return _ShardedAcc(
+                    a.sums + sums, a.counts + counts, a.sse + sse
+                )
+
+            return dc.scan_cache(acc, cache_, one, False), aux
+
+        def update_fn(acc, c):
+            new_c, shift = update(acc, c)
+            return new_c, shift, acc.sse
+
+        chunk = resident_lib.make_resident_chunk(
+            pass_fn, update_fn, float(tol), chunk_iters
+        )
+        return chunk, jax.jit(pass_fn)
+
+    def resident_cost(cache):
+        if deferred:
+            return cost_reduce
+        return (cost_reduce[0] * cache.n_batches,
+                cost_reduce[1] * cache.n_batches)
+
     c, n_iter, start_iter, shift, converged, history, final_acc = (
         _sharded_stream_loop(
             batches=batches, prefetch=prefetch, ckpt=ckpt, ckpt_dir=ckpt_dir,
@@ -1417,6 +1599,9 @@ def streamed_kmeans_fit_sharded(
             max_iters=max_iters, tol=tol, c=c, state=state, put_acc=put_acc,
             zero_acc=zero_acc, step_batch=step_batch, update=update,
             acc_cost=lambda acc: acc.sse, finalize=finalize,
+            fill=r_builder, make_resident=make_resident,
+            resident_cost=resident_cost, chunk_iters=chunk_iters,
+            mesh=mesh, gang=gang, counter=counter,
         )
     )
     sse = float(final_acc.sse)
@@ -1461,6 +1646,7 @@ def streamed_fuzzy_fit_sharded(
     ckpt_every: int = 1,
     ckpt_every_batches: int | None = None,
     reduce="per_batch",
+    residency: str = "stream",
 ):
     """Exact out-of-core Fuzzy C-Means under the 2-D (data × model) layout —
     the large-K regime of the reference's fastest algorithm, streamed: each
@@ -1479,6 +1665,9 @@ def streamed_fuzzy_fit_sharded(
     reduce="per_pass" defers the data-axis stats reduce to once per
     iteration (streamed_kmeans_fit_sharded's contract; the per-point
     membership-normalizer psum still runs per batch).
+    residency="hbm"/"auto" caches the padded batches in HBM during
+    iteration 1 and runs iterations 2..N as a compiled on-device chunk
+    loop (streamed_kmeans_fit_sharded's contract).
     """
     from tdc_tpu.models.fuzzy import FuzzyCMeansResult
     from tdc_tpu.models.streaming import (
@@ -1499,7 +1688,8 @@ def streamed_fuzzy_fit_sharded(
     strategy = reduce_lib.resolve_reduce(reduce)
     deferred, _ = _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
                                allow_quantize=False)
-    if ckpt_dir is not None and _mesh_layout(mesh)[0] > 1:
+    gang = _mesh_layout(mesh)[0] > 1
+    if ckpt_dir is not None and gang:
         raise ValueError(
             "K-sharded checkpointing gathers state to one host and supports "
             "single-process meshes only (multi-process gang checkpointing "
@@ -1545,6 +1735,13 @@ def streamed_fuzzy_fit_sharded(
         mesh, m, eps, block_rows=block_rows, kernel=kernel,
         reduce_data=not deferred,
     )
+    _, r_builder = _plan_sharded_residency(
+        residency, batches, k, d, mesh, n_data=n_data,
+        pad_multiple=pad_multiple, kernel=kernel, dtype=dtype,
+        cursor=state.cursor, label="streamed_fuzzy_fit_sharded",
+        mid_pass_ckpt=ckpt_every_batches is not None,
+    )
+    chunk_iters = _chunk_iters_for(ckpt_dir, ckpt_every)
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
     cost_reduce = (
         reduce_lib.tree_reduce_cost(_fuzzy_example(k, d), (DATA_AXIS,))
@@ -1589,8 +1786,10 @@ def streamed_fuzzy_fit_sharded(
                 cast=cast_cell[0] if kernel == "pallas" else None,
             )
 
-        def step_batch(acc, batch, c):
+        def step_batch(acc, batch, c, fill=None):
             xb, n_valid = put_batch(batch)
+            if fill is not None:
+                fill.add(xb, n_valid)
             pad_cell[0] += xb.shape[0] - n_valid
             cast_cell[0] = str(xb.dtype)
             return accumulate(acc, xb, c), n_valid
@@ -1629,8 +1828,10 @@ def streamed_fuzzy_fit_sharded(
                 acc.wsums + wsums, acc.weights + weights, acc.obj + obj
             )
 
-        def step_batch(acc, batch, c):
+        def step_batch(acc, batch, c, fill=None):
             xb, n_valid = put_batch(batch)
+            if fill is not None:
+                fill.add(xb, n_valid)
             counter.add(*cost_reduce)
             return accumulate(acc, xb, c, n_valid), n_valid
 
@@ -1647,6 +1848,85 @@ def streamed_fuzzy_fit_sharded(
                 obj=jnp.zeros((), jnp.float32),
             )
 
+    def make_resident(cache):
+        """(chunk, pass_only) over the HBM cache — mirrors the streamed
+        accumulate/finalize op order exactly (bit-exact contract; see
+        streamed_kmeans_fit_sharded's make_resident)."""
+        from tdc_tpu.data import device_cache as dc
+        from tdc_tpu.models import resident as resident_lib
+
+        def pass_fn(c, aux, cache_):
+            cast = (jnp.dtype(str(cache_.tail.dtype))
+                    if kernel == "pallas" else None)
+            if deferred:
+                acc = _ShardedFuzzyAcc(
+                    wsums=jax.lax.with_sharding_constraint(
+                        jnp.zeros((n_data, k, d), jnp.float32),
+                        NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None)),
+                    ),
+                    weights=jax.lax.with_sharding_constraint(
+                        jnp.zeros((n_data, k), jnp.float32),
+                        NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)),
+                    ),
+                    obj=jax.lax.with_sharding_constraint(
+                        jnp.zeros((n_data * n_model,), jnp.float32),
+                        NamedSharding(mesh, P((DATA_AXIS, MODEL_AXIS))),
+                    ),
+                )
+
+                def one(a, xb, wb, nv):
+                    wsums, weights, obj = stats_fn(xb, c)
+                    return _ShardedFuzzyAcc(
+                        a.wsums + wsums, a.weights + weights, a.obj + obj
+                    )
+
+                acc = dc.scan_cache(acc, cache_, one, False)
+                wsums, weights, obj = _dred(acc.wsums, acc.weights, acc.obj)
+                weights, obj = _fuzzy_pad_correction(
+                    weights, obj, c, dc.cache_pad_rows(cache_), m, eps,
+                    cast_dtype=cast,
+                )
+                return _ShardedFuzzyAcc(wsums, weights, obj), aux
+
+            acc = _ShardedFuzzyAcc(
+                wsums=jax.lax.with_sharding_constraint(
+                    jnp.zeros((k, d), jnp.float32),
+                    NamedSharding(mesh, P(MODEL_AXIS, None)),
+                ),
+                weights=jax.lax.with_sharding_constraint(
+                    jnp.zeros((k,), jnp.float32),
+                    NamedSharding(mesh, P(MODEL_AXIS)),
+                ),
+                obj=jnp.zeros((), jnp.float32),
+            )
+
+            def one(a, xb, wb, nv):
+                wsums, weights, obj = stats_fn(xb, c)
+                weights, obj = _fuzzy_pad_correction(
+                    weights, obj, c, xb.shape[0] - nv, m, eps,
+                    cast_dtype=cast,
+                )
+                return _ShardedFuzzyAcc(
+                    a.wsums + wsums, a.weights + weights, a.obj + obj
+                )
+
+            return dc.scan_cache(acc, cache_, one, False), aux
+
+        def update_fn(acc, c):
+            new_c, shift = update(acc, c)
+            return new_c, shift, acc.obj
+
+        chunk = resident_lib.make_resident_chunk(
+            pass_fn, update_fn, float(tol), chunk_iters
+        )
+        return chunk, jax.jit(pass_fn)
+
+    def resident_cost(cache):
+        if deferred:
+            return cost_reduce
+        return (cost_reduce[0] * cache.n_batches,
+                cost_reduce[1] * cache.n_batches)
+
     c, n_iter, start_iter, shift, converged, history, final_acc = (
         _sharded_stream_loop(
             batches=batches, prefetch=prefetch, ckpt=ckpt, ckpt_dir=ckpt_dir,
@@ -1654,6 +1934,9 @@ def streamed_fuzzy_fit_sharded(
             max_iters=max_iters, tol=tol, c=c, state=state, put_acc=put_acc,
             zero_acc=zero_acc, step_batch=step_batch, update=update,
             acc_cost=lambda acc: acc.obj, finalize=finalize,
+            fill=r_builder, make_resident=make_resident,
+            resident_cost=resident_cost, chunk_iters=chunk_iters,
+            mesh=mesh, gang=gang, counter=counter,
         )
     )
     # The final pass's objective is measured at the RETURNED centroids.

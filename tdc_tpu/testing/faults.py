@@ -43,6 +43,7 @@ Instrumented points (grep fault_point for the live list):
     supervisor.spawn        before each worker Popen
     serve.dispatch          before each micro-batch engine run
     data.load               dataset open
+    resident.chunk          each HBM-resident compiled-chunk boundary
 """
 
 from __future__ import annotations
@@ -69,6 +70,7 @@ KNOWN_POINTS = frozenset({
     "supervisor.spawn",
     "serve.dispatch",
     "data.load",
+    "resident.chunk",
 })
 
 # Exit code used by the 'crash' action: 128+9, what a shell reports for a

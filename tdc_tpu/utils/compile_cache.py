@@ -1,0 +1,102 @@
+"""Persistent XLA compilation cache — one switch for every entry point.
+
+A preempted gang relaunches every worker process from scratch (PR 3's
+supervisor), and each relaunch re-pays the full trace+compile cost of the
+fit's jitted graph before the first resumed iteration runs. XLA's
+persistent compilation cache amortizes that across process lifetimes: the
+second cold process deserializes the compiled executable instead of
+recompiling. This module is the single place that turns it on, driven by
+
+    TDC_COMPILE_CACHE                     cache directory ('' = disabled)
+    TDC_COMPILE_CACHE_MIN_COMPILE_SECS    only persist compilations slower
+                                          than this (default 0.5 s — gang
+                                          fit graphs; raise to keep tiny
+                                          helper jits out of the cache)
+    TDC_COMPILE_CACHE_MIN_ENTRY_BYTES     size floor for persisted entries
+                                          (default jax's; -1 = everything)
+
+or the equivalent CLI flags (--compile_cache_dir on cli.main and
+cli.serve). `parallel.multihost.initialize_*` calls `enable_from_env()`,
+so supervised gang workers (which inherit the supervisor's environment)
+pick the cache up with no worker-script changes — exporting
+TDC_COMPILE_CACHE next to TDC_CKPT_DIR is all a deployment needs.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENV_DIR = "TDC_COMPILE_CACHE"
+_ENV_MIN_SECS = "TDC_COMPILE_CACHE_MIN_COMPILE_SECS"
+_ENV_MIN_BYTES = "TDC_COMPILE_CACHE_MIN_ENTRY_BYTES"
+
+# Idempotence guard: initialize_from_env + an explicit CLI call must not
+# emit two events or fight over thresholds within one process.
+_enabled_dir: str | None = None
+# An explicit cache_dir argument (a CLI flag, including '' = opt-out) is a
+# process-level decision; enable_from_env() must not override it later —
+# initialize_distributed runs AFTER the CLI has already chosen.
+_explicit_choice = False
+
+
+def enable_compile_cache(
+    cache_dir: str | None = None,
+    *,
+    min_compile_secs: float | None = None,
+    min_entry_bytes: int | None = None,
+) -> str | None:
+    """Point jax's persistent compilation cache at `cache_dir` (or
+    $TDC_COMPILE_CACHE when None). Empty/unset disables — returns None.
+    Threshold args default to their TDC_* env vars, then to (0.5 s, jax's
+    size floor). Returns the enabled directory; repeat calls with the same
+    resolution are no-ops. Passing cache_dir explicitly (even '') records
+    the choice — subsequent enable_from_env() calls become no-ops."""
+    global _enabled_dir, _explicit_choice
+    if cache_dir is None:
+        cache_dir = os.environ.get(_ENV_DIR, "")
+    else:
+        _explicit_choice = True
+    if not cache_dir:
+        return None
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    if _enabled_dir == cache_dir:
+        return cache_dir
+    if min_compile_secs is None:
+        min_compile_secs = float(os.environ.get(_ENV_MIN_SECS, 0.5))
+    if min_entry_bytes is None:
+        env_bytes = os.environ.get(_ENV_MIN_BYTES)
+        min_entry_bytes = None if env_bytes is None else int(env_bytes)
+
+    import jax
+
+    from tdc_tpu.utils.structlog import emit
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+    )
+    if min_entry_bytes is not None:
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", min_entry_bytes
+            )
+        except AttributeError:  # option name drifts across jax versions
+            pass
+    _enabled_dir = cache_dir
+    emit("compile_cache_enabled", dir=cache_dir,
+         min_compile_secs=min_compile_secs,
+         min_entry_bytes=min_entry_bytes)
+    return cache_dir
+
+
+def enable_from_env() -> str | None:
+    """The zero-config entry: enable iff $TDC_COMPILE_CACHE is set — unless
+    an explicit enable_compile_cache(dir) call (a CLI flag, including the
+    '' opt-out) already decided for this process."""
+    if _explicit_choice:
+        return _enabled_dir
+    return enable_compile_cache(None)
+
+
+__all__ = ["enable_compile_cache", "enable_from_env"]

@@ -54,3 +54,23 @@ def device_accumulate(stream, step, acc, worst):
         acc, shift = step(acc, batch)
         worst = jnp.maximum(worst, shift)  # stays on device
     return acc, worst
+
+
+def resident_chunk_boundary_loop(chunk, cache, c, aux, cap, history,
+                                 n_iter, max_iters):
+    from tdc_tpu.testing.faults import fault_point
+
+    # The resident driver's chunk loop (models/resident.run_resident_loop):
+    # each trip dispatches R compiled on-device iterations, so the boundary
+    # fetch of (n_done, shift, history) is one sync per R iterations — the
+    # design, not a hot-loop defect. The fault_point("resident.*") marker
+    # identifies it.
+    while n_iter < max_iters:
+        c, aux, shift_dev, did_dev, hist = chunk(c, aux, cap, cache)
+        did = int(did_dev)
+        shift = float(shift_dev)
+        history.extend(np.asarray(hist)[:did].tolist())
+        n_iter += did
+        maybe_beat()
+        fault_point("resident.chunk")
+    return c, shift, history

@@ -167,6 +167,120 @@ def test_chaos_soak_kill_and_sigterm_recovery(tmp_path):
                                rtol=1e-4, atol=1e-4)
 
 
+_RESIDENT_CHAOS_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from tdc_tpu.data.device_cache import SizedBatches
+    from tdc_tpu.parallel.multihost import (
+        barrier, global_mesh, host_shard_bounds, initialize_from_env,
+    )
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+    from tdc_tpu.utils.preempt import install_preemption_handler
+
+    outdir = sys.argv[1]
+    install_preemption_handler()  # SIGTERM -> drain, not die
+    pid, nproc = initialize_from_env()
+    attempt = int(os.environ["TDC_ATTEMPT"])
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 4)).astype(np.float32)
+    X[:256] += 4.0; X[256:512] -= 4.0
+    n_batches, per_batch = 4, 256
+
+    def gen():
+        for b in range(n_batches):
+            lo = b * per_batch
+            start, end = host_shard_bounds(per_batch)
+            yield X[lo + start : lo + end]
+
+    local = per_batch // nproc
+    batches = SizedBatches(gen, local * n_batches, local)
+    res = streamed_kmeans_fit(
+        batches, 5, 4, init=X[:5], max_iters=6, tol=-1.0,
+        mesh=global_mesh(), ckpt_dir=os.environ["TDC_CKPT_DIR"],
+        ckpt_every=1, residency="hbm",
+    )
+    np.save(os.path.join(outdir, f"centroids_{pid}.npy"),
+            np.asarray(res.centroids))
+    with open(os.path.join(outdir, f"iters_run_{pid}_a{attempt}"), "w") as f:
+        f.write(str(res.n_iter_run))
+    print("CHAOS_OK", pid, "attempt", attempt, flush=True)
+    barrier()
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.multiproc
+def test_chaos_preemption_mid_resident_fit(tmp_path):
+    """PR-5 acceptance: a preemption SIGTERM delivered MID-RESIDENT-FIT
+    (at a resident.chunk boundary of the compiled on-device loop) drains
+    gracefully — checkpoint at the boundary, exit 75, budget-free
+    relaunch — and the resumed gang (which re-fills the HBM cache on its
+    first pass) finishes with centroids matching the fault-free run
+    within the documented 1e-4."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_RESIDENT_CHAOS_WORKER)
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # ckpt_every=1 -> one resident chunk per iteration. Boundary hit 2 =
+    # after global iteration 3 (iteration 1 streams+fills, boundaries run
+    # after iterations 2 and 3): steps 1..3 are on disk when the drain
+    # lands, so the relaunch resumes at iteration 4 of 6.
+    env["TDC_FAULTS"] = "resident.chunk=sigterm@2&attempt=0&pid=0"
+
+    echoes = []
+    res = run_gang(
+        [sys.executable, str(worker), str(outdir)], 2,
+        max_restarts=1, ckpt_dirs=[str(ckpt_dir)],
+        log_dir=str(tmp_path / "logs"),
+        heartbeat_timeout=180.0, env=env, echo=echoes.append,
+        backoff_base=0.05,
+    )
+    assert res.preemptions == 1, (res, echoes)
+    assert res.budget_used == 0, (res, echoes)  # SIGTERM exit is free
+    assert any("without charging the restart budget" in m for m in echoes), \
+        echoes
+
+    # The preempted attempt drained FROM THE CHUNK BOUNDARY: the injected
+    # fault fired at the resident.chunk point (nowhere else), raising the
+    # drain flag the boundary check then honored with a clean exit 75.
+    a0_log = (tmp_path / "logs" / "worker_a0_p0.log").read_text()
+    assert '"point": "resident.chunk"' in a0_log
+    assert "preempt_requested" in a0_log
+
+    final = res.attempts - 1
+    for pid in range(2):
+        iters = int((outdir / f"iters_run_{pid}_a{final}").read_text())
+        assert 0 < iters < 6  # resumed from the boundary ckpt, not scratch
+    c0 = np.load(outdir / "centroids_0.npy")
+    c1 = np.load(outdir / "centroids_1.npy")
+    np.testing.assert_array_equal(c0, c1)
+
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    x = _blobs()
+
+    def batches():
+        for b in range(4):
+            yield x[b * 256 : (b + 1) * 256]
+
+    want = streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=6,
+                               tol=-1.0)
+    np.testing.assert_allclose(c0, np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+
+
 class TestPreemptionContract:
     """Fast single-process pieces of the preemption story (tier-1)."""
 

@@ -1,0 +1,774 @@
+"""HBM-resident dataset cache + on-device multi-iteration driver.
+
+The residency subsystem's contract (data/device_cache.py,
+models/resident.py): iteration 1 streams AND fills a per-device HBM cache,
+iterations 2..N run as ONE compiled lax.while_loop per chunk with ZERO
+host transfers per iteration — and the results are bit-exact (fp32) with
+the streamed path because the cache replays the exact per-batch geometry
+and accumulation order. Checkpoint saves, preemption drains, and gang
+agreement land only at chunk boundaries, preserving every PR-3 semantic.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.data import device_cache as dc
+from tdc_tpu.data.device_cache import (
+    DeviceCacheBuilder,
+    SizedBatches,
+    plan_residency,
+    stream_hints,
+)
+from tdc_tpu.models.streaming import (
+    _prepare_batch,
+    streamed_fuzzy_fit,
+    streamed_kmeans_fit,
+)
+from tdc_tpu.parallel.mesh import make_mesh
+
+
+def _data(n=1003, d=8, seed=0):
+    """Odd N: the last batch is ragged AND pad-corrected on the mesh."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=8, size=(8, d)).astype(np.float32)
+    x = centers[rng.integers(0, 8, n)] + rng.normal(
+        size=(n, d)
+    ).astype(np.float32)
+    return x.astype(np.float32)
+
+
+def _sized(x, rows):
+    def gen():
+        for i in range(0, x.shape[0], rows):
+            yield x[i : i + rows]
+
+    return SizedBatches(gen, x.shape[0], rows)
+
+
+def _events(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture
+def runlog(tmp_path, monkeypatch):
+    path = tmp_path / "runlog.jsonl"
+    monkeypatch.setenv("TDC_RUNLOG", str(path))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Budget planner
+# ---------------------------------------------------------------------------
+
+
+class TestPlanner:
+    HINTS = dc.StreamHints(n_rows=1000, batch_rows=256, n_batches=4)
+
+    def test_bad_mode_rejected_everywhere(self):
+        with pytest.raises(ValueError, match="residency="):
+            plan_residency("hmb", hints=self.HINTS, d=8, k=8)
+        x = _data(64)
+        with pytest.raises(ValueError, match="residency="):
+            streamed_kmeans_fit(_sized(x, 32), 4, 8, init=x[:4],
+                                max_iters=2, residency="hmb")
+
+    def test_stream_requested_is_zero_overhead(self):
+        plan = plan_residency("stream", hints=None, d=8, k=8)
+        assert plan.mode == "stream" and plan.reason == "requested"
+
+    def test_auto_without_hints_falls_back_loudly(self, runlog):
+        plan = plan_residency("auto", hints=None, d=8, k=8)
+        assert plan.mode == "stream" and plan.reason == "no_size_hints"
+        ev = [e for e in _events(runlog) if e["event"] == "residency_fallback"]
+        assert ev and ev[0]["reason"] == "no_size_hints"
+
+    def test_hbm_without_hints_raises(self):
+        with pytest.raises(ValueError, match="SizedBatches"):
+            plan_residency("hbm", hints=None, d=8, k=8)
+
+    def test_auto_over_budget_falls_back_loudly_never_truncates(
+        self, runlog, monkeypatch
+    ):
+        monkeypatch.setattr(dc, "hbm_budget_bytes", lambda device=None: 10_000)
+        plan = plan_residency("auto", hints=self.HINTS, d=8, k=8)
+        assert plan.mode == "stream" and plan.reason == "over_budget"
+        assert plan.resident_bytes > 0  # the model was computed, not skipped
+        ev = [e for e in _events(runlog) if e["event"] == "residency_fallback"]
+        assert ev and ev[0]["reason"] == "over_budget"
+        assert "no truncation" in ev[0]["detail"]
+
+    def test_hbm_forced_over_budget_warns_but_proceeds(
+        self, runlog, monkeypatch
+    ):
+        monkeypatch.setattr(dc, "hbm_budget_bytes", lambda device=None: 10_000)
+        plan = plan_residency("hbm", hints=self.HINTS, d=8, k=8)
+        assert plan.resident and plan.reason == "forced"
+        assert any(e["event"] == "residency_forced_over_budget"
+                   for e in _events(runlog))
+
+    def test_mid_pass_cursor_degrades_to_stream(self, runlog):
+        plan = plan_residency("hbm", hints=self.HINTS, d=8, k=8, cursor=2)
+        assert plan.mode == "stream" and plan.reason == "mid_pass_resume"
+
+    def test_mid_pass_ckpt_incompatible(self, runlog, tmp_path):
+        """ckpt_every_batches promises bounded-loss mid-pass saves; the
+        compiled chunk never reaches the host mid-pass — hbm rejects the
+        combination, auto keeps the durability contract by streaming."""
+        with pytest.raises(ValueError, match="ckpt_every_batches"):
+            plan_residency("hbm", hints=self.HINTS, d=8, k=8,
+                           mid_pass_ckpt=True)
+        plan = plan_residency("auto", hints=self.HINTS, d=8, k=8,
+                              mid_pass_ckpt=True)
+        assert plan.mode == "stream" and plan.reason == "mid_pass_ckpt"
+        # end-to-end: the driver threads the knob through
+        x = _data(600, d=4)
+        with pytest.raises(ValueError, match="ckpt_every_batches"):
+            streamed_kmeans_fit(_sized(x, 200), 4, 4, init=x[:4],
+                                max_iters=3, ckpt_dir=str(tmp_path),
+                                ckpt_every_batches=1, residency="hbm")
+        res = streamed_kmeans_fit(_sized(x, 200), 4, 4, init=x[:4],
+                                  max_iters=3, ckpt_dir=str(tmp_path),
+                                  ckpt_every_batches=1, residency="auto")
+        assert not np.isnan(np.asarray(res.centroids)).any()
+        ev = [e for e in _events(runlog)
+              if e["event"] == "residency_fallback"]
+        assert any(e["reason"] == "mid_pass_ckpt" for e in ev)
+
+    def test_budget_math_scales_with_geometry(self):
+        small = plan_residency("auto", hints=self.HINTS, d=8, k=8)
+        big = plan_residency(
+            "auto",
+            hints=dc.StreamHints(n_rows=10**6, batch_rows=10**5,
+                                 n_batches=10),
+            d=8, k=8,
+        )
+        assert big.resident_bytes > small.resident_bytes
+        # weights add 4 B/row on top of the points
+        weighted = plan_residency("auto", hints=self.HINTS, d=8, k=8,
+                                  weighted=True)
+        assert weighted.resident_bytes > small.resident_bytes
+
+    def test_stream_hints_protocols(self):
+        from tdc_tpu.data.loader import NpzStream
+
+        x = _data(1000)
+        h = stream_hints(NpzStream(x, 256))
+        assert h == dc.StreamHints(n_rows=1000, batch_rows=256, n_batches=4)
+        s = _sized(x, 256)
+        assert stream_hints(s) == h
+        assert stream_hints(lambda: iter([x])) is None  # bare callable
+
+    def test_stream_itemsize_protocols(self):
+        from tdc_tpu.data.loader import NpzStream
+
+        x = _data(1000)
+        assert dc.stream_itemsize(NpzStream(x, 256)) == 4
+        assert dc.stream_itemsize(NpzStream(x.astype(jnp.bfloat16), 256)) == 2
+        wrapped = SizedBatches(lambda: iter(()), 1000, 256, itemsize=2)
+        assert dc.stream_itemsize(wrapped) == 2
+        assert dc.stream_itemsize(lambda: iter([x])) is None  # bare callable
+
+    def test_plan_1d_budgets_bf16_stream_at_its_own_itemsize(self):
+        """The 1-D planner must budget a bf16 stream at 2 B/element (the
+        cache stores batches at their device dtype) — at the 4 B default
+        residency='auto' refused bf16 datasets that actually fit."""
+        from tdc_tpu.data.loader import NpzStream
+        from tdc_tpu.models.streaming import _plan_1d_residency
+
+        x = _data(1000)
+        kw = dict(weighted=False, kernel="xla", cursor=0, label="t")
+        f32_plan, _ = _plan_1d_residency(
+            "auto", NpzStream(x, 256), 8, 8, None, **kw
+        )
+        bf16_plan, _ = _plan_1d_residency(
+            "auto", NpzStream(x.astype(jnp.bfloat16), 256), 8, 8, None, **kw
+        )
+        assert f32_plan.resident_bytes == 1000 * 8 * 4
+        assert bf16_plan.resident_bytes == 1000 * 8 * 2
+
+    def test_hbm_budget_bytes_is_the_planner_budget(self):
+        """cli residency_rows pre-checks cache feasibility against this
+        helper to skip the batch cap when the plan will fall back to
+        streaming anyway — it must match plan_residency's budget."""
+        from tdc_tpu.data.batching import hbm_budget_bytes
+
+        plan = plan_residency("auto", hints=self.HINTS, d=8, k=8)
+        assert plan.budget_bytes == hbm_budget_bytes()
+
+    def test_auto_batch_size_subtracts_resident_bytes(self):
+        """Satellite: with a resident cache pinned in HBM, batch sizing
+        must come out of the remainder — otherwise the fill pass OOMs and
+        oom_adaptive halves batches forever without ever fitting."""
+        from tdc_tpu.data.batching import (
+            _SAFETY_FRACTION,
+            auto_batch_size,
+            device_hbm_bytes,
+        )
+
+        free = auto_batch_size(128, 1024)
+        budget = int(_SAFETY_FRACTION * device_hbm_bytes())
+        half = auto_batch_size(128, 1024, resident_bytes=budget // 2)
+        assert half < free
+        assert abs(half - free // 2) <= 1
+        # cache >= whole budget: degrade to the 1-row floor, never negative
+        assert auto_batch_size(128, 1024, resident_bytes=2 * budget) == 1
+
+
+# ---------------------------------------------------------------------------
+# Cache builder: geometry surprises abandon LOUDLY, the fit keeps streaming
+# ---------------------------------------------------------------------------
+
+
+class TestBuilder:
+    def _add(self, b, arr):
+        xb, nv, _ = _prepare_batch(arr, None)
+        b.add(xb, nv)
+
+    def test_fill_and_scan_replays_stream_order(self):
+        x = _data(700, d=4)
+        b = DeviceCacheBuilder(3)
+        for i in range(0, 700, 256):
+            self._add(b, x[i : i + 256])
+        cache = b.finish()
+        assert cache is not None and cache.n_batches == 3
+        assert cache.stacked.shape == (2, 256, 4)
+        assert cache.tail.shape == (188, 4)
+        got = dc.scan_cache(
+            jnp.zeros((), jnp.float32), cache,
+            lambda a, xb, wb, nv: a + xb.sum(), False,
+        )
+        np.testing.assert_allclose(float(got), x.sum(), rtol=1e-5)
+
+    def test_ragged_middle_batch_abandons(self, runlog):
+        x = _data(700, d=4)
+        b = DeviceCacheBuilder(4)
+        self._add(b, x[:256])
+        self._add(b, x[256:400])  # ragged middle: not the advertised 256
+        assert b.abandoned == "batch_geometry_mismatch"
+        assert b.finish() is None
+        assert any(e["event"] == "residency_cache_abandoned"
+                   for e in _events(runlog))
+
+    def test_more_batches_than_advertised_abandons(self):
+        x = _data(512, d=4)
+        b = DeviceCacheBuilder(2)
+        for i in range(0, 512, 128):  # 4 batches into 2 slots
+            self._add(b, x[i : i + 128])
+        assert b.abandoned == "more_batches_than_advertised"
+
+    def test_fewer_batches_than_advertised_abandons_at_finish(self):
+        x = _data(256, d=4)
+        b = DeviceCacheBuilder(3)
+        self._add(b, x[:128])
+        assert b.finish() is None
+        assert b.abandoned == "fewer_batches_than_advertised"
+
+    def test_abandoned_fit_still_streams_correctly(self, runlog):
+        """A stream lying about its geometry must not break the fit: the
+        cache is dropped mid-pass and every iteration streams."""
+        x = _data(600, d=4)
+
+        def lying():
+            # advertises 2 batches of 300 but yields 3 ragged ones
+            yield x[:300]
+            yield x[300:500]
+            yield x[500:]
+
+        batches = SizedBatches(lambda: lying(), 600, 300)
+        res = streamed_kmeans_fit(batches, 4, 4, init=x[:4], max_iters=5,
+                                  tol=1e-6, residency="hbm")
+        want = streamed_kmeans_fit(batches, 4, 4, init=x[:4], max_iters=5,
+                                   tol=1e-6, residency="stream")
+        np.testing.assert_array_equal(np.asarray(res.centroids),
+                                      np.asarray(want.centroids))
+        assert any(e["event"] == "residency_cache_abandoned"
+                   for e in _events(runlog))
+
+
+# ---------------------------------------------------------------------------
+# Bit-exact parity: resident vs streamed (the acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+def _assert_same_fit(rs, rh, cost_attr):
+    np.testing.assert_array_equal(np.asarray(rs.centroids),
+                                  np.asarray(rh.centroids))
+    assert int(rs.n_iter) == int(rh.n_iter)
+    assert float(getattr(rs, cost_attr)) == float(getattr(rh, cost_attr))
+    np.testing.assert_array_equal(np.asarray(rs.history),
+                                  np.asarray(rh.history))
+    assert bool(rs.converged) == bool(rh.converged)
+
+
+class TestParity:
+    """Same seed, odd N, padded tail — fp32 results must be IDENTICAL."""
+
+    X = _data(1003)
+
+    def test_kmeans_single_device(self):
+        kw = dict(init=self.X[:8], max_iters=6, tol=1e-6)
+        rs = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 residency="stream", **kw)
+        rh = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 residency="hbm", **kw)
+        _assert_same_fit(rs, rh, "sse")
+        assert rs.comms.passes == rh.comms.passes
+
+    def test_kmeans_mesh_per_pass_deferred(self):
+        mesh = make_mesh(4)
+        kw = dict(init=self.X[:8], max_iters=6, tol=1e-6, mesh=mesh,
+                  reduce="per_pass")
+        rs = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 residency="stream", **kw)
+        rh = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 residency="auto", **kw)
+        _assert_same_fit(rs, rh, "sse")
+        # per_pass's contract survives residency: ONE logical reduce per
+        # pass, streamed and resident alike.
+        assert rh.comms.reduces == rs.comms.reduces
+
+    def test_fuzzy_single_and_mesh(self):
+        kw = dict(init=self.X[:8], max_iters=5, tol=1e-6)
+        for mesh in (None, make_mesh(4)):
+            rs = streamed_fuzzy_fit(_sized(self.X, 256), 8, 8, mesh=mesh,
+                                    residency="stream", **kw)
+            rh = streamed_fuzzy_fit(_sized(self.X, 256), 8, 8, mesh=mesh,
+                                    residency="hbm", **kw)
+            _assert_same_fit(rs, rh, "objective")
+
+    def test_weighted_stream_parity(self):
+        rng = np.random.default_rng(5)
+        w = rng.uniform(0.5, 2.0, size=1003).astype(np.float32)
+        kw = dict(init=self.X[:8], max_iters=5, tol=1e-6, mesh=make_mesh(4))
+        rs = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 sample_weight_batches=_sized(w, 256),
+                                 residency="stream", **kw)
+        rh = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 sample_weight_batches=_sized(w, 256),
+                                 residency="hbm", **kw)
+        _assert_same_fit(rs, rh, "sse")
+
+    def test_quantized_int8_error_feedback_parity(self):
+        """The EF residual is aux state threaded through the resident
+        chunk — drift here would silently decay convergence."""
+        kw = dict(init=self.X[:8], max_iters=5, tol=1e-6, mesh=make_mesh(4),
+                  reduce="per_pass:int8")
+        rs = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 residency="stream", **kw)
+        rh = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 residency="hbm", **kw)
+        _assert_same_fit(rs, rh, "sse")
+
+    def test_early_convergence_identical_stop(self):
+        kw = dict(init=self.X[:8], max_iters=50, tol=2e-2)
+        rs = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 residency="stream", **kw)
+        rh = streamed_kmeans_fit(_sized(self.X, 256), 8, 8,
+                                 residency="hbm", **kw)
+        _assert_same_fit(rs, rh, "sse")
+        assert bool(rh.converged) and int(rh.n_iter) < 50
+
+    def test_single_batch_stream(self):
+        """One batch = no stacked array, tail only."""
+        kw = dict(init=self.X[:8], max_iters=4, tol=1e-6)
+        rs = streamed_kmeans_fit(_sized(self.X, 1003), 8, 8,
+                                 residency="stream", **kw)
+        rh = streamed_kmeans_fit(_sized(self.X, 1003), 8, 8,
+                                 residency="hbm", **kw)
+        _assert_same_fit(rs, rh, "sse")
+
+    def test_ckpt_cadence_and_resume(self, tmp_path):
+        """Chunk boundaries land exactly on ckpt_every; a later run
+        resumes from the saved step and finishes bit-identical to an
+        uninterrupted streamed run."""
+        kw = dict(init=self.X[:8], tol=-1.0, ckpt_every=2)
+        streamed_kmeans_fit(_sized(self.X, 256), 8, 8, max_iters=4,
+                            ckpt_dir=str(tmp_path), residency="hbm", **kw)
+        steps = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+        assert steps, "resident mode must keep checkpointing"
+        r2 = streamed_kmeans_fit(_sized(self.X, 256), 8, 8, max_iters=9,
+                                 ckpt_dir=str(tmp_path), residency="hbm",
+                                 **kw)
+        want = streamed_kmeans_fit(_sized(self.X, 256), 8, 8, max_iters=9,
+                                   init=self.X[:8], tol=-1.0,
+                                   residency="stream")
+        np.testing.assert_array_equal(np.asarray(r2.centroids),
+                                      np.asarray(want.centroids))
+        assert r2.n_iter_run < 9  # genuinely resumed
+
+    def test_resident_loop_actually_ran(self, runlog, monkeypatch):
+        """Guard against a silent fallback faking every parity test: the
+        resident.chunk fault point must fire (the chunk loop ran) and no
+        fallback/abandon event may appear."""
+        from tdc_tpu.testing import faults
+
+        monkeypatch.setenv("TDC_FAULTS", "resident.chunk=delay:0@1")
+        faults.reset()
+        try:
+            streamed_kmeans_fit(_sized(self.X, 256), 8, 8, init=self.X[:8],
+                                max_iters=5, tol=1e-6, residency="hbm")
+        finally:
+            faults.reset()
+        events = [e["event"] for e in _events(runlog)]
+        assert "fault_injected" in events
+        assert "residency_fallback" not in events
+        assert "residency_cache_abandoned" not in events
+
+
+# ---------------------------------------------------------------------------
+# Sharded (2-D data x model) drivers
+# ---------------------------------------------------------------------------
+
+
+class TestShardedParity:
+    X = _data(1003)
+
+    @pytest.fixture(scope="class")
+    def mesh2d(self):
+        from tdc_tpu.parallel.sharded_k import make_mesh_2d
+
+        return make_mesh_2d(2, 4)
+
+    def test_kmeans_sharded_both_strategies(self, mesh2d):
+        from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+        for reduce in ("per_batch", "per_pass"):
+            kw = dict(init=self.X[:8], max_iters=5, tol=1e-6, reduce=reduce)
+            rs = streamed_kmeans_fit_sharded(_sized(self.X, 256), 8, 8,
+                                             mesh2d, residency="stream",
+                                             **kw)
+            rh = streamed_kmeans_fit_sharded(_sized(self.X, 256), 8, 8,
+                                             mesh2d, residency="hbm", **kw)
+            _assert_same_fit(rs, rh, "sse")
+
+    def test_fuzzy_sharded(self, mesh2d):
+        from tdc_tpu.parallel.sharded_k import streamed_fuzzy_fit_sharded
+
+        kw = dict(init=self.X[:8], max_iters=5, tol=1e-6, reduce="per_pass")
+        rs = streamed_fuzzy_fit_sharded(_sized(self.X, 256), 8, 8, mesh2d,
+                                        residency="stream", **kw)
+        rh = streamed_fuzzy_fit_sharded(_sized(self.X, 256), 8, 8, mesh2d,
+                                        residency="hbm", **kw)
+        _assert_same_fit(rs, rh, "objective")
+
+    def test_kmeans_sharded_ckpt_resume(self, mesh2d, tmp_path):
+        from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+        kw = dict(init=self.X[:8], tol=-1.0, ckpt_every=2)
+        streamed_kmeans_fit_sharded(_sized(self.X, 256), 8, 8, mesh2d,
+                                    max_iters=4, ckpt_dir=str(tmp_path),
+                                    residency="hbm", **kw)
+        r2 = streamed_kmeans_fit_sharded(_sized(self.X, 256), 8, 8, mesh2d,
+                                         max_iters=8, ckpt_dir=str(tmp_path),
+                                         residency="hbm", **kw)
+        want = streamed_kmeans_fit_sharded(_sized(self.X, 256), 8, 8, mesh2d,
+                                           init=self.X[:8], tol=-1.0,
+                                           max_iters=8, residency="stream")
+        np.testing.assert_array_equal(np.asarray(r2.centroids),
+                                      np.asarray(want.centroids))
+        assert r2.n_iter_run < 8
+
+
+# ---------------------------------------------------------------------------
+# The headline claim: zero host transfers inside the compiled chunk
+# ---------------------------------------------------------------------------
+
+
+class TestTransferGuard:
+    def test_guard_is_live_on_this_jax(self):
+        """Negative control: transfer_guard('disallow') must actually
+        reject an implicit H2D on this jax version — otherwise the
+        runtime enforcement in models/resident.py proves nothing."""
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            with jax.transfer_guard("disallow"):
+                jnp.sin(np.ones((4,), np.float32)) + 1
+
+    def test_chunk_dispatch_moves_zero_bytes(self):
+        """Build the compiled chunk exactly as the driver does and run a
+        multi-iteration dispatch under transfer_guard('disallow'): every
+        iteration — pass, reduce, padding correction, centroid update,
+        convergence test — must execute without ONE host byte in either
+        direction. A host-resident centroid input must conversely fail."""
+        from tdc_tpu.models import resident as resident_lib
+        from tdc_tpu.models.streaming import _resident_lloyd_fns
+
+        mesh = make_mesh(4)
+        x = _data(1003)
+        b = DeviceCacheBuilder(4, mesh=mesh)
+        for i in range(0, 1003, 256):
+            xb, nv, _ = _prepare_batch(x[i : i + 256], mesh)
+            b.add(xb, nv)
+        cache = b.finish()
+        assert cache is not None
+        chunk, pass_only = _resident_lloyd_fns(
+            mesh, 8, 8, False, "xla", None, False, True, 1e-6, 4
+        )
+        c = jax.device_put(
+            jnp.asarray(x[:8]),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+        )
+        cap = resident_lib.place_scalar(4, mesh)
+        with jax.transfer_guard("disallow"):
+            c2, _, shift, did, hist = chunk(c, (), cap, cache)
+            acc, _ = pass_only(c2, (), cache)
+        assert int(did) == 4  # 4 iterations in ONE dispatch
+        assert np.isfinite(float(acc.sse))
+        # the donated carry really was consumed (in-place HBM update)
+        assert c.is_deleted()
+        # conversely: a host centroid array fails loudly under the guard
+        chunk2, _ = _resident_lloyd_fns(
+            mesh, 8, 8, False, "xla", None, False, True, 1e-6, 4
+        )
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            with jax.transfer_guard("disallow"):
+                jax.block_until_ready(
+                    chunk2(x[:8].copy(), (), cap, cache)
+                )
+
+    def test_resident_chunk_collectives_uniform(self):
+        """jaxpr pin (satellite): the resident chunk's while body carries
+        EXACTLY the one logical per-pass reduce — 3 data-axis psums (sums,
+        counts, sse), identical across traces, no divergent branches. The
+        loop predicate derives from the globally-reduced shift, so the
+        while-collective caveat is satisfied by construction."""
+        from tdc_tpu.lint.jaxpr_check import assert_uniform_collectives
+        from tdc_tpu.models import resident as resident_lib
+        from tdc_tpu.models.streaming import _resident_lloyd_fns
+
+        mesh = make_mesh(4)
+        x = _data(515, d=4)
+        b = DeviceCacheBuilder(3, mesh=mesh)
+        for i in range(0, 515, 200):
+            xb, nv, _ = _prepare_batch(x[i : i + 200], mesh)
+            b.add(xb, nv)
+        cache = b.finish()
+        chunk, pass_only = _resident_lloyd_fns(
+            mesh, 4, 4, False, "xla", None, False, True, 1e-6, 4
+        )
+        c = jax.device_put(
+            jnp.asarray(x[:4]),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+        )
+        cap = resident_lib.place_scalar(4, mesh)
+        rep = assert_uniform_collectives(chunk, c, (), cap, cache,
+                                         require_collectives=True)
+        assert rep.sequence == ["while:psum[axes=('data',)]"] * 3
+        rep2 = assert_uniform_collectives(pass_only, c, (), cache,
+                                          require_collectives=True)
+        assert rep2.sequence == ["psum[axes=('data',)]"] * 3
+
+
+# ---------------------------------------------------------------------------
+# Persistent XLA compilation cache (satellite)
+# ---------------------------------------------------------------------------
+
+
+_CACHE_PROBE = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from tdc_tpu.parallel.multihost import initialize_distributed
+    initialize_distributed()  # the gang-worker path enables the cache
+    import jax, jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return jnp.sin(x) @ jnp.cos(x).T + jnp.tanh(x).sum()
+
+    t0 = time.perf_counter()
+    f(jnp.ones((256, 256))).block_until_ready()
+    print("PROBE_OK", time.perf_counter() - t0, flush=True)
+""")
+
+
+@pytest.mark.multiproc
+def test_compile_cache_second_cold_process_hits(tmp_path):
+    """Satellite pin: with $TDC_COMPILE_CACHE set, the FIRST cold process
+    populates the persistent cache via initialize_distributed (the gang
+    relaunch path) and a SECOND cold process deserializes instead of
+    recompiling — it must add NO new cache entries (threshold 0 means any
+    miss would have written one)."""
+    cache = tmp_path / "xla_cache"
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["TDC_COMPILE_CACHE"] = str(cache)
+    env["TDC_COMPILE_CACHE_MIN_COMPILE_SECS"] = "0"
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, "-c", _CACHE_PROBE], env=env, timeout=120,
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert "PROBE_OK" in out.stdout
+        assert "compile_cache_enabled" in out.stderr
+        return out
+
+    run()
+    entries = {p.name for p in cache.iterdir() if p.name.endswith("-cache")}
+    assert entries, "first process must populate the cache"
+    run()
+    after = {p.name for p in cache.iterdir() if p.name.endswith("-cache")}
+    assert after == entries, (
+        f"second cold process recompiled: new entries {after - entries}"
+    )
+
+
+def test_enable_compile_cache_disabled_when_unset(monkeypatch):
+    from tdc_tpu.utils import compile_cache
+
+    monkeypatch.delenv("TDC_COMPILE_CACHE", raising=False)
+    # Isolate from an explicit enable made earlier in this test process
+    # (e.g. a CLI test): enable_from_env() truthfully reports that choice.
+    monkeypatch.setattr(compile_cache, "_explicit_choice", False)
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    assert compile_cache.enable_from_env() is None
+
+
+_EXPLICIT_PROBE = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from tdc_tpu.utils import compile_cache
+    mode, arg = sys.argv[1], sys.argv[2]
+    if mode == "flag":
+        assert compile_cache.enable_compile_cache(arg) == arg
+    else:
+        assert compile_cache.enable_compile_cache("") is None
+    got = compile_cache.enable_from_env()  # the initialize_* pickup
+    import jax
+    if mode == "flag":
+        assert got == arg, got
+        assert jax.config.jax_compilation_cache_dir == arg
+    else:
+        assert got is None, got
+        assert (jax.config.jax_compilation_cache_dir
+                != os.environ["TDC_COMPILE_CACHE"])
+    print("EXPLICIT_OK", flush=True)
+""")
+
+
+def test_compile_cache_explicit_choice_beats_env(tmp_path):
+    """An explicit enable_compile_cache(dir) call — a CLI --cache_dir flag,
+    including the '' opt-out — is a process-level decision: the later
+    enable_from_env() inside initialize_distributed must not repoint (or
+    re-enable) the cache from $TDC_COMPILE_CACHE over it."""
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["TDC_COMPILE_CACHE"] = str(tmp_path / "envcache")
+    for mode, arg in (("flag", str(tmp_path / "flagcache")), ("optout", "")):
+        out = subprocess.run(
+            [sys.executable, "-c", _EXPLICIT_PROBE, mode, arg], env=env,
+            timeout=120, capture_output=True, text=True,
+        )
+        assert out.returncode == 0, (mode, out.stderr[-3000:])
+        assert "EXPLICIT_OK" in out.stdout, mode
+
+
+# ---------------------------------------------------------------------------
+# 2-process gloo gang parity under residency="hbm"
+# ---------------------------------------------------------------------------
+
+
+_GANG_WORKER = textwrap.dedent("""
+    import os, sys
+    port, pid, nproc, outdir = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]), sys.argv[4])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tdc_tpu.parallel.multihost import (
+        global_mesh, host_shard_bounds, initialize_distributed,
+    )
+    initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
+
+    import numpy as np
+    from tdc_tpu.data.device_cache import SizedBatches
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 4)).astype(np.float32)
+    X[:256] += 4.0; X[256:512] -= 4.0
+    n_batches, per_batch = 4, 256
+
+    def gen():
+        for b in range(n_batches):
+            lo = b * per_batch
+            start, end = host_shard_bounds(per_batch)
+            yield X[lo + start : lo + end]
+
+    # hints are LOCAL to this process: 512 rows in 4 batches of 128
+    local = per_batch // nproc
+    batches = SizedBatches(gen, local * n_batches, local)
+    mesh = global_mesh()
+    kw = dict(init=X[:5], max_iters=6, tol=-1.0, mesh=mesh)
+    rs = streamed_kmeans_fit(batches, 5, 4, residency="stream", **kw)
+    rh = streamed_kmeans_fit(batches, 5, 4, residency="hbm", **kw)
+    cs, ch = np.asarray(rs.centroids), np.asarray(rh.centroids)
+    assert np.array_equal(cs, ch), np.max(np.abs(cs - ch))
+    assert int(rs.n_iter) == int(rh.n_iter)
+    np.save(os.path.join(outdir, f"gang_resident_{pid}.npy"), ch)
+    print("WORKER_OK", pid, flush=True)
+""")
+
+
+@pytest.mark.multiproc
+def test_two_process_gang_resident_parity(tmp_path):
+    """residency='hbm' across a 2-process gloo gang: each process caches
+    its own device shards; the resident loop's chunk boundaries stay
+    gang-uniform (same n_iter everywhere), and results are bit-exact with
+    the gang's own streamed run AND within the documented 1e-4 of the
+    single-process oracle."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = tmp_path / "worker.py"
+    worker.write_text(_GANG_WORKER)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(i), "2",
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {i}" in out
+    c0 = np.load(tmp_path / "gang_resident_0.npy")
+    c1 = np.load(tmp_path / "gang_resident_1.npy")
+    np.testing.assert_array_equal(c0, c1)  # replicated state agrees bitwise
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 4)).astype(np.float32)
+    X[:256] += 4.0
+    X[256:512] -= 4.0
+
+    def batches():
+        for b in range(4):
+            yield X[b * 256 : (b + 1) * 256]
+
+    want = streamed_kmeans_fit(batches, 5, 4, init=X[:5], max_iters=6,
+                               tol=-1.0)
+    np.testing.assert_allclose(c0, np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
