@@ -183,7 +183,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "d <= 32 and the fit is an in-memory kmeans/fuzzy")
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="checkpoint/resume directory (streamed mode): saves "
-                        "centroids+iteration via orbax and resumes if present")
+                        "centroids+iteration via orbax and resumes if "
+                        "present. Checkpoints are size-portable (layout "
+                        "manifest + full host arrays): a save taken at N "
+                        "devices/processes resumes at M after an elastic "
+                        "resize (docs/OPERATIONS.md)")
     p.add_argument("--prefetch", type=int, default=0,
                    help="streamed modes: background-thread batch prefetch "
                         "depth (0 = off, the measured-fastest default on "
@@ -492,6 +496,7 @@ def run_experiment(args) -> dict:
         streamed_kmeans_fit,
     )
     from tdc_tpu.parallel import make_mesh
+    from tdc_tpu.parallel.meshspec import MeshSpec
     from tdc_tpu.utils.timing import PhaseTimers
 
     timers = PhaseTimers()
@@ -831,7 +836,7 @@ def run_experiment(args) -> dict:
                 rows = residency_rows(
                     -(-n_obs // num_batches),
                     itemsize=2 if args.dtype == "bfloat16" else 4,
-                    n_cache_devices=n_devices // args.shard_k,
+                    n_cache_devices=MeshSpec.of(mesh2d).n_data,
                 )
                 return streamed_fuzzy_fit_sharded(
                     make_stream(rows), args.K, n_dim, mesh2d,
@@ -888,7 +893,10 @@ def run_experiment(args) -> dict:
             rows = residency_rows(
                 -(-n_obs // num_batches),
                 itemsize=2 if args.dtype == "bfloat16" else 4,
-                n_cache_devices=n_devices // args.shard_k,
+                # The K-sharded cache divides over the DATA axis only
+                # (replicated across model shards) — the MeshSpec is the
+                # one source of that geometry (parallel/meshspec.py).
+                n_cache_devices=MeshSpec.of(mesh2d).n_data,
             )
             block = shard_block(rows)
             return streamed_kmeans_fit_sharded(

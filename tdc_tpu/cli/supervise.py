@@ -14,6 +14,16 @@ Usage:
         --ckpt_root=/tmp/ckpts --log_dir=/tmp/gang_logs \\
         -- python my_worker.py --flags...
 
+Elastic resize (docs/OPERATIONS.md "Elastic resize"): write the desired
+gang size into the resize request file (`--resize_file`, default
+`<log_dir>/resize`) — the supervisor drains the gang at a checkpoint
+boundary and relaunches it at the new size from the latest aligned
+checkpoint, charging no restart budget; SIGHUP forces an immediate
+re-read, and `$TDC_RESIZE` on the supervisor's environment overrides the
+initial size. Requires --ckpt_root (a shared checkpoint dir): the
+checkpoints are layout-portable (parallel/reshard.py), per-worker dirs
+are not.
+
 The worker should call `tdc_tpu.parallel.multihost.initialize_from_env()`
 first, read its checkpoint directory from $TDC_CKPT_DIR, pass it as
 `ckpt_dir=` to a streamed fit (models/streaming.py) so resume works, and call
@@ -69,6 +79,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "before every restart")
     p.add_argument("--log_dir", type=str, required=True,
                    help="per-attempt per-worker stdout+stderr capture")
+    p.add_argument("--resize_file", type=str, default=None,
+                   help="elastic-resize request file (one integer: the "
+                        "desired gang size; default <log_dir>/resize). A "
+                        "write drains the gang and relaunches it at the "
+                        "new size from the latest checkpoint; SIGHUP "
+                        "forces an immediate re-read")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command (prefix with --)")
     return p
@@ -99,6 +115,7 @@ def main(argv=None) -> int:
             drain_grace=args.drain_grace,
             backoff_base=args.backoff_base,
             backoff_max=args.backoff_max,
+            resize_request_path=args.resize_file,
         )
     except GangFailed as e:
         print(f"supervise: {e}", file=sys.stderr)
@@ -108,9 +125,13 @@ def main(argv=None) -> int:
         # us sees the same retry-later code a drained worker uses.
         print(f"supervise: {e}", file=sys.stderr)
         return PREEMPTED_EXIT_CODE
+    sizes = ""
+    if result.resizes:
+        sizes = (f", {result.resizes} resize(s): sizes "
+                 + "->".join(str(s) for s in result.size_history))
     print(f"supervise: gang completed in {result.attempts} attempt(s) "
           f"({result.preemptions} preemption(s), restart budget used "
-          f"{result.budget_used}); logs: {args.log_dir}")
+          f"{result.budget_used}{sizes}); logs: {args.log_dir}")
     return 0
 
 

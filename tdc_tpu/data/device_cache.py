@@ -210,6 +210,13 @@ def plan_residency(
     resident iterations could not honor the bounded-loss durability the
     knob promises — `hbm` raises, `auto` falls back loudly rather than
     silently narrowing the PR-3 contract to chunk-boundary saves.
+
+    Elastic resize (parallel/reshard.py): the cache is derived state and
+    is never persisted — a gang relaunched at a different size replans
+    here with its NEW geometry (the drivers pass it off their MeshSpec),
+    so a shrink whose per-device budget no longer fits degrades to
+    streaming through the same loud `residency_fallback` path, and a
+    grow simply refills a smaller per-device cache on its first pass.
     """
     from tdc_tpu.utils.structlog import emit
 

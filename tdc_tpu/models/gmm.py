@@ -928,10 +928,28 @@ def streamed_gmm_fit(
                 np.asarray(saved.meta.get("converged", False))
             )
             restored = True
+            # Size-portable restore (parallel/reshard.py): the GMM state
+            # is full host-side arrays, so placement at ANY world size is
+            # a replicate — redistribute owns the resize observability
+            # (one reshard_redistribute event + fault point when the
+            # saved layout manifest differs from this run's).
+            from tdc_tpu.parallel import reshard as reshard_lib
+            from tdc_tpu.parallel.meshspec import MeshSpec
+
+            old_layout = reshard_lib.layout_from_meta(saved.meta)
             if mesh is not None:
-                means = mesh_lib.replicate(means, mesh)
-                variances = mesh_lib.replicate(variances, mesh)
-                weights = mesh_lib.replicate(weights, mesh)
+                means, variances, weights = reshard_lib.redistribute(
+                    (means, variances, weights), old_layout,
+                    MeshSpec.of(mesh),
+                    place=lambda tree: jax.tree.map(
+                        lambda t: mesh_lib.replicate(t, mesh), tree
+                    ),
+                )
+            else:
+                means, variances, weights = reshard_lib.redistribute(
+                    (means, variances, weights), old_layout,
+                    MeshSpec.of(None), place=lambda tree: tree,
+                )
 
     first = None
     if not restored:
@@ -963,9 +981,9 @@ def streamed_gmm_fit(
             variances = mesh_lib.replicate(variances, mesh)
             weights = mesh_lib.replicate(weights, mesh)
     _check_equal_local_rows(stream, first, mesh)
-    gang = mesh is not None and len(
-        {dev.process_index for dev in mesh.devices.ravel()}
-    ) > 1
+    from tdc_tpu.parallel.meshspec import MeshSpec
+
+    gang = MeshSpec.of(mesh).gang
 
     strategy = reduce_lib.resolve_reduce(reduce)
     deferred, n_mesh_dev = _reduce_plan(strategy, mesh, ckpt_dir, None)
@@ -984,6 +1002,7 @@ def streamed_gmm_fit(
         err_state = [d_zero() if strategy.quantize else None]
 
     def save(n_iter, ll, done, final_ll=None):
+        from tdc_tpu.parallel import reshard as reshard_lib
         from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
 
         save_checkpoint(
@@ -997,6 +1016,9 @@ def streamed_gmm_fit(
                     "variances": np.asarray(variances),
                     "weights": np.asarray(weights),
                     "ll": float(ll), "converged": bool(done),
+                    # Layout manifest: a resized relaunch recognizes the
+                    # save was taken at another world size (reshard.py).
+                    **reshard_lib.layout_meta(MeshSpec.of(mesh)),
                     **({"final_ll": float(final_ll)}
                        if final_ll is not None else {}),
                 },

@@ -56,7 +56,12 @@ def chunk_iters_for(ckpt_dir, ckpt_every: int) -> int:
 def place_scalar(v, mesh, dtype=jnp.int32):
     """Commit a host scalar to the device(s) BEFORE the transfer guard: an
     uncommitted scalar argument would be an implicit H2D (or, on a mesh, a
-    device-to-device reshard) inside the guarded dispatch."""
+    device-to-device reshard) inside the guarded dispatch. Accepts a raw
+    Mesh or a parallel/meshspec.MeshSpec (the drivers' layout object)."""
+    from tdc_tpu.parallel.meshspec import MeshSpec
+
+    if isinstance(mesh, MeshSpec):
+        mesh = mesh.mesh
     if mesh is None:
         return jnp.asarray(v, dtype)
     from tdc_tpu.parallel import mesh as mesh_lib
@@ -145,6 +150,12 @@ def run_resident_loop(
     the zero-round-trip property). Supervised runs must size
     heartbeat_timeout above chunk_iters x per-iteration wall time
     (docs/OPERATIONS.md), or the supervisor kills healthy workers.
+
+    Elastic resize: the chunk-boundary checkpoints written here carry the
+    layout manifest like every other save, and the HBM cache is DERIVED
+    state — a resized relaunch replans residency against its new
+    per-device budget and refills (or degrades to streaming, loudly)
+    during its first pass; nothing resident needs redistributing.
     """
     done = tol >= 0 and shift <= tol
     while not done and n_iter < max_iters:

@@ -38,6 +38,8 @@ from tdc_tpu.models.kmeans import KMeansResult, resolve_init, _normalize
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult
 from tdc_tpu.parallel import mesh as mesh_lib
 from tdc_tpu.parallel import reduce as reduce_lib
+from tdc_tpu.parallel import reshard as reshard_lib
+from tdc_tpu.parallel.meshspec import MeshSpec
 from tdc_tpu.testing.faults import fault_point
 from tdc_tpu.utils import preempt
 from tdc_tpu.utils.heartbeat import maybe_beat
@@ -330,17 +332,13 @@ def _run_pass(
         skip, acc0, rows0 = 0, None, 0
 
 
-@lru_cache(maxsize=64)
 def _mesh_layout(mesh) -> tuple[int, int]:
-    """(n_processes, n_local_devices) of `mesh`, cached per mesh — a mesh can
-    be host-local even inside a jax.distributed run, so the mesh (not
-    jax.process_count()) decides whether batches are per-host slices; cached
-    because _prepare_batch sits in the streaming hot loop and scanning
-    thousands of pod devices per batch would be real host-side overhead."""
-    devs = mesh.devices.ravel()
-    procs = {d.process_index for d in devs}
-    local = sum(d.process_index == jax.process_index() for d in devs)
-    return len(procs), local
+    """(n_processes, n_local_devices) of `mesh` — the legacy tuple view of
+    parallel/meshspec.MeshSpec, kept because the K-sharded drivers and the
+    staging helpers below still consume it. MeshSpec.of is cached per mesh
+    (this sits in the streaming hot loop)."""
+    spec = MeshSpec.of(mesh)
+    return spec.n_processes, spec.n_local
 
 
 def _prepare_batch(batch, mesh):
@@ -696,35 +694,27 @@ def _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=0,
     return deferred, n_mesh_dev
 
 
-def _plan_1d_residency(residency, batches, k, d, mesh, *, weighted,
-                       kernel, cursor, label, mid_pass_ckpt=False):
-    """Residency planning for the 1-D streamed drivers: translate the
-    fit's mesh layout into the planner's padding geometry (multi-process
-    meshes stream per-host slices padded to the local device count;
-    single-process meshes pad the global batch to the mesh size) and
-    build the cache fill when the plan says resident. Returns
-    (plan, builder-or-None); residency='stream' validates and returns
-    (None, None) with zero overhead."""
+def _plan_1d_residency(residency, batches, k, d, spec: MeshSpec, *,
+                       weighted, kernel, cursor, label, mid_pass_ckpt=False):
+    """Residency planning for the 1-D streamed drivers: the MeshSpec IS
+    the planner's padding geometry (multi-process meshes stream per-host
+    slices padded to the local device count; single-process meshes pad
+    the global batch to the mesh size — spec.pad_multiple/process_scale
+    encode exactly that), and the cache fill is built when the plan says
+    resident. Returns (plan, builder-or-None); residency='stream'
+    validates and returns (None, None) with zero overhead."""
     if residency not in device_cache_lib.RESIDENCY_MODES:
         raise ValueError(
             f"residency={residency!r}: use 'stream', 'auto', or 'hbm'"
         )
     if residency == "stream":
         return None, None
-    if mesh is None:
-        n_dev, pad_multiple, scale = 1, 1, 1
-    else:
-        nproc, local_dev = _mesh_layout(mesh)
-        n_dev = int(np.prod(mesh.devices.shape))
-        if nproc > 1:
-            pad_multiple, scale = max(local_dev, 1), nproc
-        else:
-            pad_multiple, scale = n_dev, 1
     plan = device_cache_lib.plan_residency(
         residency,
         hints=device_cache_lib.stream_hints(batches),
-        d=d, k=k, n_devices=n_dev, pad_multiple=pad_multiple,
-        process_scale=scale,
+        d=d, k=k, n_devices=spec.n_devices,
+        pad_multiple=spec.pad_multiple,
+        process_scale=spec.process_scale,
         itemsize=device_cache_lib.stream_itemsize(batches) or 4,
         weighted=weighted, kernel=kernel,
         cursor=cursor, mid_pass_ckpt=mid_pass_ckpt, label=label,
@@ -732,7 +722,8 @@ def _plan_1d_residency(residency, batches, k, d, mesh, *, weighted,
     builder = None
     if plan.resident:
         builder = device_cache_lib.DeviceCacheBuilder(
-            plan.hints.n_batches, mesh=mesh, weighted=weighted, label=label
+            plan.hints.n_batches, mesh=spec.mesh, weighted=weighted,
+            label=label,
         )
     return plan, builder
 
@@ -892,6 +883,7 @@ class _ResumeState(NamedTuple):
     rows_seen: int  # rows covered by `acc` (validates the batch layout)
     acc: object  # restored accumulator NamedTuple or None
     key: object
+    layout: object = None  # reshard.LayoutManifest the save was taken under
 
 
 class _StreamCheckpointer:
@@ -902,10 +894,18 @@ class _StreamCheckpointer:
     by hyperparameters (`params`) that are persisted and VALIDATED on restore
     (k, d, and spherical / fuzzifier m — resuming with different ones would
     silently mix incompatible state).
+
+    Size portability: when constructed with a `spec` (MeshSpec), every
+    save records the layout manifest (parallel/reshard.py) in the meta,
+    and restore reads the SAVED layout back — placement then routes
+    through reshard.redistribute, so a checkpoint taken at N devices
+    restores (fp32-bit-exact: the persisted arrays are full host-side
+    copies) onto whatever mesh the resumed run actually has.
     """
 
     def __init__(self, ckpt_dir, k, d, params: dict, acc_map: dict, key,
-                 gang: bool = False, keep: int | None = None):
+                 gang: bool = False, keep: int | None = None,
+                 spec: MeshSpec | None = None):
         self.dir = ckpt_dir
         self.k, self.d = k, d
         self.params = params
@@ -918,6 +918,9 @@ class _StreamCheckpointer:
         # Host-local fits inside a jax.distributed runtime checkpoint
         # independently (see utils/checkpoint.save_checkpoint).
         self.gang = gang
+        # The fit's mesh layout — persisted as the checkpoint's layout
+        # manifest so a restore at a different world size is recognized.
+        self.spec = spec
 
     def restore(self, acc_cls, mesh) -> _ResumeState:
         from tdc_tpu.utils.checkpoint import restore_checkpoint
@@ -928,6 +931,7 @@ class _StreamCheckpointer:
         saved = restore_checkpoint(self.dir)
         if saved is None:
             return none
+        old_layout = reshard_lib.layout_from_meta(saved.meta)
         if saved.meta.get("k") != self.k or saved.meta.get("d") != self.d:
             raise ValueError(
                 f"checkpoint in {self.dir} is for K={saved.meta.get('k')}, "
@@ -946,8 +950,6 @@ class _StreamCheckpointer:
                     f"this run uses {name}={want} — refusing to mix state"
                 )
         c = jnp.asarray(saved.centroids, jnp.float32)
-        if mesh is not None:
-            c = mesh_lib.replicate(c, mesh)
         start_iter = saved.n_iter
         # Restore run state so a resume that has no iterations left still
         # reports the checkpointed run faithfully (round-1 advisor finding:
@@ -974,11 +976,27 @@ class _StreamCheckpointer:
                     for name, field in self.acc_map.items()
                 }
             )
-            if mesh is not None:
-                acc = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), acc)
+        if mesh is not None:
+            # One redistribute for the whole restored tree: fires the
+            # resize observability (event + fault point) exactly once
+            # when the saved layout differs from this run's, then places
+            # replicated (the 1-D drivers' layout for c and acc alike).
+            c, acc = reshard_lib.redistribute(
+                (c, acc), old_layout, MeshSpec.of(mesh),
+                place=lambda tree: jax.tree.map(
+                    lambda t: mesh_lib.replicate(t, mesh), tree
+                ),
+            )
+        elif self.spec is not None and self.spec.mesh is None:
+            # Single-device 1-D fit restoring a (possibly multi-device)
+            # save: values are already host/global — placement is the
+            # identity, but the resize observability must still fire.
+            c, acc = reshard_lib.redistribute(
+                (c, acc), old_layout, self.spec, place=lambda tree: tree
+            )
         key = saved.key if saved.key is not None else self.key
         return _ResumeState(c, start_iter, shift, history, cursor, rows_seen,
-                            acc, key)
+                            acc, key, old_layout)
 
     def save(self, n_iter, c, shift, history, *, batch_cursor=0, acc=None,
              rows_seen=0):
@@ -986,6 +1004,10 @@ class _StreamCheckpointer:
 
         meta = {"k": self.k, "d": self.d, "shift": float(shift)}
         meta.update(self.params)
+        if self.spec is not None:
+            # The layout manifest: lets a restore at a different world
+            # size recognize the resize and redistribute (reshard.py).
+            meta.update(reshard_lib.layout_meta(self.spec))
         if history:  # orbax rejects zero-size arrays
             meta["history"] = np.asarray(history, np.float32).reshape(-1, 2)
         if acc is not None:
@@ -1146,13 +1168,15 @@ def streamed_kmeans_fit(
             z = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), z)
         return z
 
+    spec = MeshSpec.of(mesh)
     ckpt = _StreamCheckpointer(
         ckpt_dir, k, d,
         params={"spherical": bool(spherical), "weighted": weighted},
         acc_map={"acc_sums": "sums", "acc_counts": "counts", "acc_sse": "sse"},
         key=key,
-        gang=mesh is not None and _mesh_layout(mesh)[0] > 1,
+        gang=spec.gang,
         keep=ckpt_keep_last_n,
+        spec=spec,
     )
     state = ckpt.restore(SufficientStats, mesh)
     if state.centroids is not None:
@@ -1167,7 +1191,7 @@ def streamed_kmeans_fit(
         strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=state.cursor
     )
     _, builder = _plan_1d_residency(
-        residency, batches, k, d, mesh, weighted=weighted, kernel=kernel,
+        residency, batches, k, d, spec, weighted=weighted, kernel=kernel,
         cursor=state.cursor, label="streamed_kmeans_fit",
         mid_pass_ckpt=ckpt_every_batches is not None,
     )
@@ -1558,6 +1582,7 @@ def streamed_fuzzy_fit(
             acc = jax.tree.map(lambda t: mesh_lib.replicate(t, mesh), acc)
         return acc
 
+    spec = MeshSpec.of(mesh)
     ckpt = _StreamCheckpointer(
         ckpt_dir, k, d, params={"m": float(m), "weighted": weighted},
         acc_map={
@@ -1566,8 +1591,9 @@ def streamed_fuzzy_fit(
             "acc_obj": "objective",
         },
         key=key,
-        gang=mesh is not None and _mesh_layout(mesh)[0] > 1,
+        gang=spec.gang,
         keep=ckpt_keep_last_n,
+        spec=spec,
     )
     state = ckpt.restore(FuzzyStats, mesh)
     if state.centroids is not None:
@@ -1582,7 +1608,7 @@ def streamed_fuzzy_fit(
         strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=state.cursor
     )
     _, builder = _plan_1d_residency(
-        residency, batches, k, d, mesh, weighted=weighted, kernel=kernel,
+        residency, batches, k, d, spec, weighted=weighted, kernel=kernel,
         cursor=state.cursor, label="streamed_fuzzy_fit",
         mid_pass_ckpt=ckpt_every_batches is not None,
     )

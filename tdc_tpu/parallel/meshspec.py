@@ -1,0 +1,170 @@
+"""MeshSpec: the one sharding-spec object the streamed drivers consult.
+
+Before this module every driver re-derived its own mesh geometry — the
+1-D streamed fits carried a cached `_mesh_layout(mesh)` tuple, the
+K-sharded drivers read `mesh.devices.shape` directly, the residency
+planners re-computed padding multiples and process scales from scratch,
+and the CLI approximated all three. Size-portable state (checkpoint at N
+devices, restore at M — parallel/reshard.py) makes that duplication a
+correctness hazard: each copy is one more place a resize can disagree
+about what the layout *is*.
+
+MeshSpec generalizes the SNIPPETS.md sharding-utility pattern into the
+single source of truth: built once per mesh (`MeshSpec.of`, cached — a
+mesh is hashable and the lookup sits in streaming hot paths), it answers
+every layout question the host-side driver code asks:
+
+- **kind** — "single" (no mesh), "data1d" (1-D data-parallel), "hier"
+  (the (dcn, ici) hierarchical mesh), "data_model" (the 2-D K-sharded
+  layout);
+- **batch staging geometry** — `pad_multiple` (the row multiple batches
+  are zero-padded to before placement) and `process_scale` (how many
+  global rows one local row represents: multi-process 1-D meshes stream
+  per-host slices, the K-sharded drivers stream identical global
+  batches);
+- **placement** — `replicate` / `named(...)` shardings, mesh-aware so a
+  single-device fit and an 8-way pod take the same code path.
+
+The jit/lru-cached compute functions keep taking the raw `Mesh` (it is
+the natural hashable static argument); MeshSpec is the HOST-side layout
+algebra in the spirit of Mesh-TensorFlow's named-dimension layouts
+(arXiv 1811.02084).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tdc_tpu.parallel import mesh as mesh_lib
+from tdc_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS, ICI_AXIS
+
+MODEL_AXIS = "model"  # the K-sharded drivers' centroid axis (sharded_k)
+
+KIND_SINGLE = "single"
+KIND_DATA1D = "data1d"
+KIND_HIER = "hier"
+KIND_DATA_MODEL = "data_model"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Layout facts of one mesh (or of the no-mesh single-device path)."""
+
+    mesh: Mesh | None
+    kind: str
+    n_devices: int
+    n_processes: int
+    n_local: int  # this process's devices in the mesh
+    n_data: int  # data-axis extent (== n_devices off the 2-D layout)
+    n_model: int  # model-axis extent (1 off the 2-D layout)
+
+    # -- construction -----------------------------------------------------
+
+    @staticmethod
+    def of(mesh: Mesh | None) -> "MeshSpec":
+        """The spec for `mesh` (None = the single-device path). Cached per
+        mesh: this sits under the streamed per-batch staging loop, and
+        scanning thousands of pod devices per batch would be real
+        host-side overhead (the old _mesh_layout rationale)."""
+        if mesh is None:
+            return _SINGLE
+        return _spec_of(mesh)
+
+    # -- derived layout facts ---------------------------------------------
+
+    @property
+    def gang(self) -> bool:
+        """Does the FIT span processes? (Then checkpoints run the gang
+        single-writer protocol and preemption drains need gang
+        agreement.)"""
+        return self.n_processes > 1
+
+    @property
+    def pad_multiple(self) -> int:
+        """Row multiple one staged batch is zero-padded to. Multi-process
+        1-D meshes stage per-host slices (pad to the local device count);
+        single-process meshes pad the global batch to the data extent.
+        The K-sharded drivers additionally multiply by their block_rows."""
+        if self.mesh is None:
+            return 1
+        if self.kind == KIND_DATA_MODEL:
+            return self.n_data
+        return max(self.n_local, 1) if self.gang else self.n_devices
+
+    @property
+    def process_scale(self) -> int:
+        """Global rows one local batch row becomes: nproc when the 1-D
+        drivers stream per-host slices; 1 when batches are already global
+        (single process, or the K-sharded identical-global-batch
+        contract)."""
+        if self.gang and self.kind != KIND_DATA_MODEL:
+            return self.n_processes
+        return 1
+
+    @property
+    def data_axes(self) -> tuple[str, ...]:
+        if self.mesh is None:
+            return ()
+        return mesh_lib.data_axes(self.mesh)
+
+    # -- placement --------------------------------------------------------
+
+    def named(self, spec: P) -> NamedSharding:
+        """A NamedSharding on this mesh (mesh required)."""
+        if self.mesh is None:
+            raise ValueError("named sharding needs a mesh (kind='single')")
+        return NamedSharding(self.mesh, spec)
+
+    def replicate(self, x):
+        """Place `x` fully replicated (mesh-aware; plain device array on
+        the single-device path)."""
+        if self.mesh is None:
+            return jax.numpy.asarray(x)
+        return mesh_lib.replicate(x, self.mesh)
+
+
+def _local_count(mesh: Mesh) -> int:
+    pidx = jax.process_index()
+    return sum(d.process_index == pidx for d in mesh.devices.ravel())
+
+
+@lru_cache(maxsize=64)
+def _spec_of(mesh: Mesh) -> MeshSpec:
+    names = tuple(mesh.axis_names)
+    shape = tuple(mesh.devices.shape)
+    n_devices = int(np.prod(shape))
+    n_processes = len({d.process_index for d in mesh.devices.ravel()})
+    n_local = _local_count(mesh)
+    if MODEL_AXIS in names and DATA_AXIS in names:
+        kind = KIND_DATA_MODEL
+        n_data = int(shape[names.index(DATA_AXIS)])
+        n_model = int(shape[names.index(MODEL_AXIS)])
+    elif DCN_AXIS in names and ICI_AXIS in names:
+        kind, n_data, n_model = KIND_HIER, n_devices, 1
+    else:
+        kind, n_data, n_model = KIND_DATA1D, n_devices, 1
+    return MeshSpec(
+        mesh=mesh, kind=kind, n_devices=n_devices, n_processes=n_processes,
+        n_local=n_local, n_data=n_data, n_model=n_model,
+    )
+
+
+_SINGLE = MeshSpec(
+    mesh=None, kind=KIND_SINGLE, n_devices=1, n_processes=1, n_local=1,
+    n_data=1, n_model=1,
+)
+
+
+__all__ = [
+    "KIND_DATA1D",
+    "KIND_DATA_MODEL",
+    "KIND_HIER",
+    "KIND_SINGLE",
+    "MODEL_AXIS",
+    "MeshSpec",
+]

@@ -105,8 +105,19 @@ def initialize_from_env() -> tuple[int, int]:
         # A 1-process supervised gang needs no coordinator handshake (and
         # initialize(coordinator_address=...) alone would try to autodetect
         # a process count, which fails off managed TPU/SLURM machines).
-        return initialize_distributed()
-    return initialize_distributed(coord, int(nproc), int(pid))
+        out = initialize_distributed()
+    else:
+        out = initialize_distributed(coord, int(nproc), int(pid))
+    if nproc is not None:
+        # One line per launch naming the gang size this worker came up at:
+        # with elastic resize (parallel/supervisor.py) the size changes
+        # across attempts, and the worker logs are where an operator
+        # confirms the relaunch actually happened at the requested size.
+        from tdc_tpu.utils.structlog import emit
+
+        emit("gang_init", process_id=out[0], num_processes=out[1],
+             attempt=int(os.environ.get("TDC_ATTEMPT", -1)))
+    return out
 
 
 def global_mesh(axis_name: str = DATA_AXIS) -> Mesh:

@@ -1,0 +1,176 @@
+"""Size-portable state redistribution: restore N-device state at M devices.
+
+PR-3 fault tolerance is restart-shaped — a gang that dies at size N
+relaunches at size N. Production TPU capacity is preemptible AND elastic:
+a slice can disappear for good, or more capacity can be offered, and
+either must be a recoverable event rather than a cold restart. This
+module is the state half of that story (parallel/supervisor.py's resize
+outcome is the control half): everything a checkpoint persists is kept
+**layout-portable** (full host-side arrays), every checkpoint carries a
+**layout manifest** recording the mesh it was saved under, and restore
+routes placement through `redistribute`, which re-lays the state onto
+whatever mesh the relaunched world actually has.
+
+Redistribution strategy — per state kind:
+
+- **Centroids / replicated accumulators** (the 1-D streamed fits): the
+  checkpoint holds the full (K, d) fp32 array; placement at M devices is
+  a broadcast. Bit-exact at any M by construction.
+- **K-sharded state** (sharded_k's model-axis centroid and stats
+  towers): persisted gathered (the _GatheringCheckpointer already
+  assembles shards host-side); restore device_puts it under the NEW
+  mesh's model sharding — the all-gather-then-slice form of portable
+  collective redistribution (arXiv 2112.01075: any resharding is a
+  sequence of gather/slice collectives; at checkpoint scale the gather
+  already happened on the way to disk). Bit-exact: a slice of the same
+  fp32 bytes. Requires K divisible by the new model extent — checked by
+  the drivers with a clear error.
+- **Deferred / error-feedback residual trees** (parallel/reduce's
+  per-device partials, leading device axis): the semantic payload is the
+  SUM over slots, so `redistribute_deferred` folds the N partials and
+  re-expands onto M slots (total in slot 0, zeros elsewhere) — the
+  invariant Σ_slots is preserved. NOTE: folding reorders the f32
+  summation (exact in value-space only when the partials are exactly
+  representable); that matches the EF contract, which is approximation
+  state to begin with. This state is never checkpointed (quantized
+  reduce rejects ckpt_dir) — the API serves in-process mesh swaps and
+  the tests that pin the invariant.
+- **The PR-5 HBM cache** is never persisted: a resized relaunch replans
+  residency against the NEW per-device budget (device_cache.plan_residency
+  with the new MeshSpec geometry) and either refills the cache during its
+  first pass or degrades to streaming LOUDLY via the existing
+  `residency_fallback` structlog event. Nothing to redistribute — by
+  design the cache is derived state.
+
+Observability: a restore whose manifest disagrees with the current
+layout emits one `reshard_redistribute` structlog event (old → new) and
+passes the `reshard.redistribute` fault point, so chaos specs can strike
+exactly the resize-restore path; reading the manifest itself passes
+`ckpt.restore.layout`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+from tdc_tpu.parallel.meshspec import MeshSpec
+from tdc_tpu.testing.faults import fault_point
+
+# Checkpoint-meta key prefix of the layout manifest (utils/checkpoint.py
+# persists meta entries as plain npz arrays; the manifest is 5 ints).
+LAYOUT_META_PREFIX = "layout_"
+
+
+class LayoutManifest(NamedTuple):
+    """The mesh layout a checkpoint was written under — enough to decide
+    whether a restore is same-layout (plain placement) or a resize
+    (redistribute + observability), and to explain either in logs."""
+
+    n_devices: int
+    n_processes: int
+    n_data: int
+    n_model: int
+    hier: int  # 1 = hierarchical (dcn, ici) mesh, else 0
+
+    def describe(self) -> str:
+        return (f"{self.n_devices}dev/{self.n_processes}proc"
+                f"(data={self.n_data},model={self.n_model}"
+                f"{',hier' if self.hier else ''})")
+
+
+def manifest_of(spec: MeshSpec) -> LayoutManifest:
+    return LayoutManifest(
+        n_devices=spec.n_devices,
+        n_processes=spec.n_processes,
+        n_data=spec.n_data,
+        n_model=spec.n_model,
+        hier=int(spec.kind == "hier"),
+    )
+
+
+def layout_meta(spec: MeshSpec) -> dict:
+    """Checkpoint-meta entries for this layout (numeric, npz-safe)."""
+    m = manifest_of(spec)
+    return {LAYOUT_META_PREFIX + k: int(v) for k, v in m._asdict().items()}
+
+
+def layout_from_meta(meta: dict) -> LayoutManifest | None:
+    """Parse a checkpoint's layout manifest (None: pre-manifest
+    checkpoint — restore then behaves as before, placement only). The
+    `ckpt.restore.layout` fault point fires whenever a manifest is
+    present, i.e. exactly when a resize-aware restore is in play."""
+    key = LAYOUT_META_PREFIX + "n_devices"
+    if meta is None or key not in meta:
+        return None
+    fault_point("ckpt.restore.layout")
+    vals = {}
+    for field in LayoutManifest._fields:
+        v = meta.get(LAYOUT_META_PREFIX + field, 0)
+        vals[field] = int(np.asarray(v))
+    return LayoutManifest(**vals)
+
+
+def redistribute(tree, old: LayoutManifest | None, spec: MeshSpec, place):
+    """Place host-side checkpoint state onto `spec`'s mesh, redistributing
+    from the layout it was saved under.
+
+    `place(tree)` performs the actual mesh placement (driver-owned
+    shardings: replicate for the 1-D fits, model-axis device_put for the
+    K-sharded towers). This wrapper owns the resize semantics: when the
+    saved layout differs from the current one it emits the
+    `reshard_redistribute` event and passes the fault point, then places —
+    the state is layout-portable host data, so redistribution IS
+    placement under the new layout (see module docstring for why that is
+    bit-exact per state kind).
+    """
+    cur = manifest_of(spec)
+    if old is not None and old != cur:
+        from tdc_tpu.utils.structlog import emit
+
+        emit("reshard_redistribute",
+             saved_layout=old.describe(), new_layout=cur.describe())
+        fault_point("reshard.redistribute")
+    return place(tree)
+
+
+def redistribute_deferred(tree, n_slots: int, place=None):
+    """Re-lay a deferred accumulator / error-feedback residual tree (per-
+    device partials along a leading axis) from its current slot count to
+    `n_slots`: fold the partials (their sum is the semantic payload) into
+    slot 0 of a fresh zeros tree. `place(host_tree)` optionally puts the
+    result onto the new mesh's deferred shardings; without it the host
+    tree is returned (tests, or callers that place later).
+
+    Invariant: sum over the leading axis is preserved (up to f32
+    re-association of the fold — acceptable for EF state, whose contract
+    is approximate; see module docstring)."""
+    if n_slots < 1:
+        raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+
+    def one(leaf):
+        arr = np.asarray(leaf)
+        if arr.ndim < 1:
+            raise ValueError(
+                "deferred leaves carry a leading device axis; got a scalar"
+            )
+        total = arr.sum(axis=0, dtype=arr.dtype)
+        out = np.zeros((n_slots,) + arr.shape[1:], arr.dtype)
+        out[0] = total
+        return out
+
+    host = jax.tree_util.tree_map(one, tree)
+    return host if place is None else place(host)
+
+
+__all__ = [
+    "LAYOUT_META_PREFIX",
+    "LayoutManifest",
+    "layout_from_meta",
+    "layout_meta",
+    "manifest_of",
+    "redistribute",
+    "redistribute_deferred",
+]

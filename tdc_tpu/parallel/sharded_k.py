@@ -31,6 +31,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tdc_tpu.parallel.compat import shard_map
+from tdc_tpu.parallel.meshspec import MeshSpec
+from tdc_tpu.parallel import reshard as reshard_lib
 
 from tdc_tpu.ops.distance import pairwise_sq_dist
 from tdc_tpu.models.kmeans import KMeansResult, _normalize, resolve_init
@@ -1084,16 +1086,18 @@ def _make_put_batch(mesh, pad_multiple: int, dtype, spherical: bool = False):
     return put_batch
 
 
-def _plan_sharded_residency(residency, batches, k, d, mesh, *, n_data,
+def _plan_sharded_residency(residency, batches, k, d, spec: MeshSpec, *,
                             pad_multiple, kernel, dtype, cursor, label,
                             mid_pass_ckpt=False):
-    """Residency planning for the K-sharded streamed drivers. Geometry:
-    every process streams IDENTICAL GLOBAL batches (the sharded contract),
-    padded to n_data*block_rows and sharded over the data axis only — the
-    cache is replicated across the model axis, so the per-device budget
-    divides by n_data, not n_data*n_model. `dtype` (the host-side bf16
-    cast) halves the cache itemsize; without the cast the stream's own
-    element width (stream_itemsize) budgets natively-bf16 streams."""
+    """Residency planning for the K-sharded streamed drivers. Geometry
+    comes off the MeshSpec: every process streams IDENTICAL GLOBAL
+    batches (the sharded contract — spec.process_scale is 1 on the 2-D
+    layout), padded to n_data*block_rows and sharded over the data axis
+    only — the cache is replicated across the model axis, so the
+    per-device budget divides by spec.n_data, not n_data*n_model.
+    `dtype` (the host-side bf16 cast) halves the cache itemsize; without
+    the cast the stream's own element width (stream_itemsize) budgets
+    natively-bf16 streams."""
     from tdc_tpu.data import device_cache as dc
 
     if residency not in dc.RESIDENCY_MODES:
@@ -1108,13 +1112,15 @@ def _plan_sharded_residency(residency, batches, k, d, mesh, *, n_data,
     )
     plan = dc.plan_residency(
         residency, hints=dc.stream_hints(batches), d=d, k=k,
-        n_devices=n_data, pad_multiple=pad_multiple, process_scale=1,
+        n_devices=spec.n_data, pad_multiple=pad_multiple,
+        process_scale=spec.process_scale,
         itemsize=itemsize, weighted=False, kernel=kernel, cursor=cursor,
         mid_pass_ckpt=mid_pass_ckpt, label=label,
     )
     builder = None
     if plan.resident:
-        builder = dc.DeviceCacheBuilder(plan.hints.n_batches, mesh=mesh,
+        builder = dc.DeviceCacheBuilder(plan.hints.n_batches,
+                                        mesh=spec.mesh,
                                         weighted=False, label=label)
     return plan, builder
 
@@ -1330,21 +1336,21 @@ def streamed_kmeans_fit_sharded(
     )
     from tdc_tpu.parallel import reduce as reduce_lib
 
-    n_data = int(mesh.devices.shape[0])
-    n_model = int(mesh.devices.shape[1])
+    spec = MeshSpec.of(mesh)
+    n_data, n_model = spec.n_data, spec.n_model
     if k % n_model != 0:
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
     strategy = reduce_lib.resolve_reduce(reduce)
     deferred, _ = _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
                                allow_quantize=False)
-    gang = _mesh_layout(mesh)[0] > 1
+    gang = spec.gang
     if ckpt_dir is not None and gang:
         # Gang checkpointing needs every K-shard process-local so process 0
         # can assemble the full (K, d) state host-side (_host_full): every
         # process must own a device in every model column. The data-axis-
         # across-processes layout (the pod deployment shape) satisfies
         # this; a model axis spanning processes does not.
-        nproc = _mesh_layout(mesh)[0]
+        nproc = spec.n_processes
         for j in range(n_model):
             col_procs = {dev.process_index for dev in mesh.devices[:, j]}
             if len(col_procs) != nproc:
@@ -1356,13 +1362,18 @@ def streamed_kmeans_fit_sharded(
                 )
     pad_multiple = n_data * max(block_rows, 1)
 
+    # shard_model is NOT a validated hyperparameter: the checkpoint keeps
+    # the gathered full (K, d) state plus a layout manifest, so a save
+    # taken under one (data, model) split restores under any other
+    # (reshard.redistribute below) — that is the elastic-resize contract.
     ckpt = _StreamCheckpointer(
         ckpt_dir, k, d,
-        params={"spherical": bool(spherical), "shard_model": float(n_model)},
+        params={"spherical": bool(spherical)},
         acc_map={"acc_sums": "sums", "acc_counts": "counts",
                  "acc_sse": "sse"},
         key=key,
         gang=gang,
+        spec=spec,
     )
     if gang:
         ckpt = _GatheringCheckpointer(ckpt)
@@ -1376,7 +1387,9 @@ def streamed_kmeans_fit_sharded(
                      cursor=state.cursor, allow_quantize=False)
     if state.centroids is not None:
         c = jnp.asarray(state.centroids, jnp.float32)
+        restored = True
     else:
+        restored = False
         first = None
         if not hasattr(init, "shape"):
             first = np.asarray(next(iter(batches())))
@@ -1390,9 +1403,22 @@ def streamed_kmeans_fit_sharded(
             raise ValueError(f"init shape {c.shape} != {(k, d)}")
         if spherical:
             c = _normalize(c)
-    c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
+
+    def put_c(t):
+        return jax.device_put(t, NamedSharding(mesh, P(MODEL_AXIS, None)))
+
+    if restored:
+        # The gathered full-(K, d) save re-slices under THIS mesh's model
+        # split — K % n_model re-checked above, so a resize that changed
+        # the split lands bit-exactly on the new shards.
+        c = reshard_lib.redistribute(c, state.layout, spec, place=put_c)
+    else:
+        c = put_c(c)
 
     def put_acc(acc):
+        # Mid-pass accumulators are persisted gathered too; a resize
+        # restore re-slices them the same way as the centroids (the
+        # observability fired once at the centroid redistribute).
         return _ShardedAcc(
             sums=jax.device_put(
                 acc.sums, NamedSharding(mesh, P(MODEL_AXIS, None))
@@ -1406,7 +1432,7 @@ def streamed_kmeans_fit_sharded(
     stats_fn = make_sharded_stats(mesh, kernel, block_rows,
                                   reduce_data=not deferred)
     _, r_builder = _plan_sharded_residency(
-        residency, batches, k, d, mesh, n_data=n_data,
+        residency, batches, k, d, spec,
         pad_multiple=pad_multiple, kernel=kernel, dtype=dtype,
         cursor=state.cursor, label="streamed_kmeans_fit_sharded",
         mid_pass_ckpt=ckpt_every_batches is not None,
@@ -1679,8 +1705,8 @@ def streamed_fuzzy_fit_sharded(
     )
     from tdc_tpu.parallel import reduce as reduce_lib
 
-    n_data = int(mesh.devices.shape[0])
-    n_model = int(mesh.devices.shape[1])
+    spec = MeshSpec.of(mesh)
+    n_data, n_model = spec.n_data, spec.n_model
     if k % n_model != 0:
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
     if m <= 1.0:
@@ -1688,7 +1714,7 @@ def streamed_fuzzy_fit_sharded(
     strategy = reduce_lib.resolve_reduce(reduce)
     deferred, _ = _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
                                allow_quantize=False)
-    gang = _mesh_layout(mesh)[0] > 1
+    gang = spec.gang
     if ckpt_dir is not None and gang:
         raise ValueError(
             "K-sharded checkpointing gathers state to one host and supports "
@@ -1698,19 +1724,30 @@ def streamed_fuzzy_fit_sharded(
     eps = 1e-9
     pad_multiple = n_data * max(block_rows, 1)
 
+    # shard_model deliberately not validated: the save is gathered +
+    # layout-manifested, portable across (data, model) splits (see
+    # streamed_kmeans_fit_sharded).
     ckpt = _StreamCheckpointer(
         ckpt_dir, k, d,
-        params={"m": float(m), "shard_model": float(n_model)},
+        params={"m": float(m)},
         acc_map={"acc_wsums": "wsums", "acc_weights": "weights",
                  "acc_obj": "obj"},
         key=key,
+        spec=spec,
     )
     state = ckpt.restore(_ShardedFuzzyAcc, None)
     if state.cursor:
         _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
                      cursor=state.cursor, allow_quantize=False)
+
+    def put_c(t):
+        return jax.device_put(t, NamedSharding(mesh, P(MODEL_AXIS, None)))
+
     if state.centroids is not None:
-        c = jnp.asarray(state.centroids, jnp.float32)
+        c = reshard_lib.redistribute(
+            jnp.asarray(state.centroids, jnp.float32), state.layout, spec,
+            place=put_c,
+        )
     else:
         if not hasattr(init, "shape"):
             first = np.asarray(next(iter(batches())))
@@ -1718,7 +1755,7 @@ def streamed_fuzzy_fit_sharded(
         c = jnp.asarray(init, jnp.float32)
         if c.shape != (k, d):
             raise ValueError(f"init shape {c.shape} != {(k, d)}")
-    c = jax.device_put(c, NamedSharding(mesh, P(MODEL_AXIS, None)))
+        c = put_c(c)
 
     def put_acc(acc):
         return _ShardedFuzzyAcc(
@@ -1736,7 +1773,7 @@ def streamed_fuzzy_fit_sharded(
         reduce_data=not deferred,
     )
     _, r_builder = _plan_sharded_residency(
-        residency, batches, k, d, mesh, n_data=n_data,
+        residency, batches, k, d, spec,
         pad_multiple=pad_multiple, kernel=kernel, dtype=dtype,
         cursor=state.cursor, label="streamed_fuzzy_fit_sharded",
         mid_pass_ckpt=ckpt_every_batches is not None,

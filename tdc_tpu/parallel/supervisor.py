@@ -40,6 +40,24 @@ recovery the whole robustness budget — Mesh-TensorFlow, arxiv 1811.02084):
   `backoff_max`) so a crash-looping gang cannot hammer the coordinator /
   filesystem back-to-back. Preemption relaunches skip the backoff — the
   replacement capacity is already allocated.
+- **Resize is the third outcome** (besides relaunch and fail): elastic
+  capacity means the gang's size can change without a cold restart. An
+  operator (or autoscaler) writes the desired size into the resize
+  request file (`<log_dir>/resize` by default; `$TDC_RESIZE` on the
+  supervisor's environment sets the INITIAL size) — a request observed
+  mid-run drains the gang exactly like a preemption (SIGTERM, grace
+  window, workers exit 75 at a checkpoint boundary; SIGHUP to the
+  supervisor forces an immediate re-read), and the relaunch comes up at
+  the new size, resuming from the latest aligned checkpoint. The
+  checkpoints are layout-portable (parallel/reshard.py: full host-side
+  arrays plus a layout manifest), so the resized workers redistribute
+  the state onto their new mesh. Resize relaunches charge NEITHER the
+  failure budget nor the preemption cap, and a standing request is also
+  honored at preemption/failure relaunches — losing a slice for good
+  shrinks the gang instead of crash-looping at a size the capacity can
+  no longer satisfy. `GangResult.size_history` records the size of
+  every launch. Resize requires a SHARED checkpoint dir (or none):
+  per-worker dirs have no meaning at a different size.
 
 Checkpoint-directory semantics: a gang shares ONE checkpoint directory —
 process 0 is the single writer (utils/checkpoint.py writes an atomic
@@ -102,6 +120,8 @@ class GangResult:
     preemptions: int = 0  # launches that ended in a preemption exit (75)
     budget_used: int = 0  # failure restarts charged against max_restarts
     restart_delays: list[float] = field(default_factory=list)  # backoffs slept
+    resizes: int = 0  # relaunches that changed the gang size
+    size_history: list[int] = field(default_factory=list)  # size per launch
 
 
 def _default_echo(msg: str) -> None:
@@ -191,6 +211,73 @@ def _prune_heartbeats(hb_files) -> None:
                 pass
 
 
+def _prune_stale_heartbeats(log_dir: str) -> None:
+    """Drop hb_a<N>_p<M> files left by a PREVIOUS supervisor run in the
+    same log_dir. Attempt numbering restarts at 0 per run, so a stale
+    file can collide with a fresh attempt's path — and with resize in
+    play the old size's files would linger forever (a 4->2 shrink never
+    recreates hb_a*_p3). The in-run hang detector already guards against
+    stale mtimes (max(last, start)); this keeps the DIRECTORY honest."""
+    try:
+        names = os.listdir(log_dir)
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith("hb_a"):
+            continue
+        parts = name[3:].split("_")  # ["a<N>", "p<M>"]
+        if (len(parts) == 2 and parts[0][:1] == "a" and parts[1][:1] == "p"
+                and parts[0][1:].isdigit() and parts[1][1:].isdigit()):
+            try:
+                os.remove(os.path.join(log_dir, name))
+            except OSError:
+                pass
+
+
+def _parse_size(txt: str, src: str, echo) -> int | None:
+    """Parse one desired-gang-size integer (shared by the request file
+    and $TDC_RESIZE — ONE copy of the validation, so the two channels
+    cannot drift). Malformed content is ignored LOUDLY: a typo'd
+    autoscaler write must not kill the supervisor, but silence would
+    make the no-op undebuggable."""
+    try:
+        want = int(txt)
+    except ValueError:
+        echo(f"supervisor: ignoring resize request {txt!r} from {src}: "
+             "not an integer")
+        return None
+    if want < 1:
+        echo(f"supervisor: ignoring resize request {want} from {src}: "
+             "gang size must be >= 1")
+        return None
+    return want
+
+
+def _read_resize_request(path: str | None, echo) -> int | None:
+    """The resize-request file: one integer, the desired gang size.
+    Absent/empty file means no request."""
+    if path is None:
+        return None
+    try:
+        with open(path) as f:
+            txt = f.read().strip()
+    except OSError:
+        return None
+    if not txt:
+        return None
+    return _parse_size(txt, path, echo)
+
+
+def _read_env_resize(echo) -> int | None:
+    """$TDC_RESIZE on the SUPERVISOR's environment: the initial gang size
+    (the env-only hook for schedulers that cannot write the request file
+    before exec). Read once at run_gang entry."""
+    txt = os.environ.get("TDC_RESIZE", "").strip()
+    if not txt:
+        return None
+    return _parse_size(txt, "$TDC_RESIZE", echo)
+
+
 def run_gang(
     cmd: list[str],
     num_processes: int,
@@ -206,9 +293,11 @@ def run_gang(
     drain_grace: float = 30.0,
     backoff_base: float = 0.5,
     backoff_max: float = 30.0,
+    resize_request_path: str | None = None,
     echo=_default_echo,
 ) -> GangResult:
-    """Run `cmd` as a gang of `num_processes` workers; restart on failure.
+    """Run `cmd` as a gang of `num_processes` workers; restart on failure,
+    resize on request (see the module docstring's resize bullet).
 
     Args:
       cmd: the worker command line, identical for every worker — workers read
@@ -235,6 +324,13 @@ def run_gang(
       backoff_base / backoff_max: exponential-backoff-with-jitter bounds
         between FAILURE relaunches (base * 2^failures, capped; preemption
         relaunches are immediate). backoff_base=0 disables (tests).
+      resize_request_path: the resize-request file (one integer: the
+        desired gang size). Default `<log_dir>/resize`. Polled while the
+        gang runs (a write drains the gang and relaunches at the new
+        size; SIGHUP forces an immediate re-read) and consulted as a
+        standing request before every preemption/failure relaunch.
+        Resize relaunches charge neither budget. Needs a shared (or no)
+        checkpoint dir; requests are ignored loudly otherwise.
 
     Returns GangResult on success; raises GangFailed when the restart budget
     runs out, GangPreempted when the supervisor itself was told to drain.
@@ -243,23 +339,48 @@ def run_gang(
         raise ValueError(
             f"need 1 (shared) or {num_processes} ckpt_dirs, got {len(ckpt_dirs)}"
         )
-    if ckpt_dirs is not None and len(ckpt_dirs) == 1:
-        ckpt_dirs = ckpt_dirs * num_processes
-    elif ckpt_dirs is not None and num_processes > 1:
-        echo("supervisor: warning — per-worker ckpt_dirs with a "
-             "jax.distributed gang will not recover (the gang's checkpoints "
-             "are written by process 0 only; non-primary dirs stay empty and "
-             "align_checkpoints then wipes everything). Use one shared dir "
-             "unless the workers run independent single-process fits.")
-    os.makedirs(log_dir, exist_ok=True)
-    base_env = dict(os.environ if env is None else env)
+    shared_ckpt: str | None = None
+    fixed_ckpt_dirs: list[str] | None = None
+    if ckpt_dirs is not None:
+        if len(set(ckpt_dirs)) == 1:
+            shared_ckpt = ckpt_dirs[0]
+        else:
+            fixed_ckpt_dirs = list(ckpt_dirs)
+            if num_processes > 1:
+                echo("supervisor: warning — per-worker ckpt_dirs with a "
+                     "jax.distributed gang will not recover (the gang's "
+                     "checkpoints are written by process 0 only; non-primary "
+                     "dirs stay empty and align_checkpoints then wipes "
+                     "everything). Use one shared dir unless the workers run "
+                     "independent single-process fits.")
+    # Resize needs per-size checkpoint-dir semantics: a shared dir (or
+    # none) broadcasts to any size; distinct per-worker dirs do not.
+    resizable = fixed_ckpt_dirs is None
 
-    # Supervisor-level SIGTERM: forward to the gang and drain. Installed
-    # only on the main thread (signal.signal's requirement); elsewhere the
-    # supervisor simply has no drain path of its own.
+    def dirs_for(size: int) -> list[str] | None:
+        if shared_ckpt is not None:
+            return [shared_ckpt] * size
+        return fixed_ckpt_dirs  # per-worker (never resized) or None
+
+    os.makedirs(log_dir, exist_ok=True)
+    # Heartbeat hygiene: a previous supervisor run's (possibly other-sized)
+    # hb files must not linger into this run's attempt numbering.
+    _prune_stale_heartbeats(log_dir)
+    base_env = dict(os.environ if env is None else env)
+    resize_path = resize_request_path
+    if resize_path is None:
+        resize_path = os.path.join(log_dir, "resize")
+
+    # Supervisor-level SIGTERM: forward to the gang and drain. SIGHUP:
+    # re-read the resize request immediately. Installed only on the main
+    # thread (signal.signal's requirement); elsewhere the supervisor
+    # simply has no drain/resize-signal path of its own.
     sigterm_box: list[float] = []
+    sighup_box: list[float] = []
     old_handler = None
     handler_installed = False
+    old_hup = None
+    hup_installed = False
     if threading.current_thread() is threading.main_thread():
         try:
             old_handler = signal.signal(
@@ -268,18 +389,77 @@ def run_gang(
             handler_installed = True
         except (ValueError, OSError):  # exotic embeddings
             pass
+        try:
+            old_hup = signal.signal(
+                signal.SIGHUP, lambda *_: sighup_box.append(time.time())
+            )
+            hup_installed = True
+        except (ValueError, OSError, AttributeError):  # no SIGHUP here
+            pass
 
     from tdc_tpu.testing.faults import fault_point
 
     attempt = 0  # launch index: TDC_ATTEMPT and log-file naming
     budget_used = 0
     preemptions = 0
+    resizes = 0
+    size_history: list[int] = []
     restart_delays: list[float] = []
     last_step: int | None = None  # aligned step at the previous relaunch
+    cur_size = num_processes
+    resize_denied_echoed = False
+
+    def _deny_resize() -> None:
+        """One loud (once-per-run) line for the per-worker-ckpt_dirs case."""
+        nonlocal resize_denied_echoed
+        if not resize_denied_echoed:
+            echo("supervisor: resize requested but per-worker ckpt_dirs "
+                 "cannot change size — ignoring (use one shared "
+                 "checkpoint dir to enable elastic resize)")
+            resize_denied_echoed = True
+
+    def _apply_standing_resize(reason: str) -> None:
+        """Honor a pending resize request at a relaunch boundary."""
+        nonlocal cur_size, resizes
+        want = _read_resize_request(resize_path, echo)
+        if want is None or want == cur_size:
+            return
+        if not resizable:
+            _deny_resize()
+            return
+        fault_point("supervisor.resize")
+        echo(f"supervisor: resizing gang {cur_size} -> {want} ({reason}); "
+             "relaunching from the latest aligned checkpoint")
+        cur_size = want
+        resizes += 1
+
+    env_size = _read_env_resize(echo)
+    if env_size is not None and env_size != cur_size:
+        if resizable:
+            echo(f"supervisor: $TDC_RESIZE — starting the gang at size "
+                 f"{env_size} instead of {num_processes}")
+            cur_size = env_size
+        else:
+            _deny_resize()
+    # A request file surviving from BEFORE this run (possibly a previous
+    # supervisor in the same log_dir) is a standing request: it will not
+    # interrupt the gang, but WILL be honored at the first relaunch
+    # boundary — say so at launch, so a week-old leftover can never
+    # resize a new run silently (rm the file to cancel).
+    standing = _read_resize_request(resize_path, echo)
+    if standing is not None and standing != cur_size:
+        if not resizable:
+            _deny_resize()
+        else:
+            echo(f"supervisor: standing resize request for size {standing} "
+                 f"found at startup (gang starts at {cur_size}); it "
+                 f"applies at the next relaunch boundary — remove "
+                 f"{resize_path} to cancel")
     try:
         while True:
-            if attempt > 0 and ckpt_dirs is not None:
-                step = align_checkpoints(ckpt_dirs, log=echo)
+            launch_dirs = dirs_for(cur_size)
+            if attempt > 0 and launch_dirs is not None:
+                step = align_checkpoints(launch_dirs, log=echo)
                 echo(f"supervisor: attempt {attempt + 1}, resuming from "
                      f"{'scratch' if step is None else f'common step {step}'}")
                 last_step = step if step is not None else last_step
@@ -287,18 +467,29 @@ def run_gang(
             procs, logs, hb_files, log_paths = [], [], [], []
             failed_why = None
             preempted_attempt = False
+            resize_draining = False
+            last_resize_mtime = None
             drain_deadline = None
             forwarded = False
+            size_history.append(cur_size)
+            # The live resize watch compares request-file mtimes against
+            # the moment THIS attempt began — taken before the spawn
+            # loop, which can run for seconds on a big gang: a request
+            # written mid-spawn must drain the attempt, not silently
+            # demote to a standing request (heartbeat staleness keeps
+            # its own post-spawn `start` so spawn time never counts
+            # against the workers).
+            watch_since = time.time()
             try:
                 # Spawn inside the try so a mid-loop Popen/open failure (fd or
                 # memory exhaustion) still kills the workers already started —
                 # they would otherwise block forever in the coordinator
                 # handshake waiting for peers that never came up.
-                for pid in range(num_processes):
+                for pid in range(cur_size):
                     worker_env = dict(base_env)
                     worker_env.update(
                         TDC_PROCESS_ID=str(pid),
-                        TDC_NUM_PROCESSES=str(num_processes),
+                        TDC_NUM_PROCESSES=str(cur_size),
                         TDC_COORDINATOR=coordinator,
                         TDC_ATTEMPT=str(attempt),
                     )
@@ -307,8 +498,8 @@ def run_gang(
                         hb = os.path.join(log_dir, f"hb_a{attempt}_p{pid}")
                         worker_env["TDC_HEARTBEAT_FILE"] = hb
                     hb_files.append(hb)
-                    if ckpt_dirs is not None:
-                        worker_env["TDC_CKPT_DIR"] = ckpt_dirs[pid]
+                    if launch_dirs is not None:
+                        worker_env["TDC_CKPT_DIR"] = launch_dirs[pid]
                     log_path = os.path.join(log_dir,
                                             f"worker_a{attempt}_p{pid}.log")
                     log_paths.append(log_path)
@@ -331,9 +522,53 @@ def run_gang(
                                 p.terminate()
                         forwarded = True
                         drain_deadline = time.monotonic() + drain_grace
+                    if not forwarded and drain_deadline is None:
+                        # Live resize watch: SIGHUP forces a re-read;
+                        # otherwise only a request file WRITTEN during this
+                        # attempt triggers a drain (an older file is a
+                        # standing request, honored at the next relaunch
+                        # boundary — not grounds to interrupt a healthy
+                        # gang that already matches it or predates it).
+                        check = bool(sighup_box)
+                        if sighup_box:
+                            del sighup_box[:]
+                        else:
+                            try:
+                                mt = os.path.getmtime(resize_path)
+                            except OSError:
+                                mt = None
+                            if (mt is not None and mt >= watch_since
+                                    and mt != last_resize_mtime):
+                                last_resize_mtime = mt
+                                check = True
+                        if check:
+                            want = _read_resize_request(resize_path, echo)
+                            if want is not None and want != cur_size:
+                                if not resizable:
+                                    _deny_resize()
+                                else:
+                                    echo(f"supervisor: resize request "
+                                         f"{cur_size} -> {want} — draining "
+                                         f"the gang (grace {drain_grace}s)")
+                                    for p in procs:
+                                        if p.poll() is None:
+                                            p.terminate()
+                                    resize_draining = True
+                                    drain_deadline = (time.monotonic()
+                                                      + drain_grace)
                     codes = [p.poll() for p in procs]
+                    ok_codes = (0, PREEMPTED_EXIT_CODE)
+                    if resize_draining:
+                        # A worker that had no drain handler yet (still
+                        # importing jax at terminate time) dies from OUR
+                        # SIGTERM with -15: that is the resize drain doing
+                        # its job, not a worker failure — it must not
+                        # charge the budget a resize promises not to touch
+                        # (resume falls back to the last aligned step).
+                        ok_codes = (0, PREEMPTED_EXIT_CODE,
+                                    -signal.SIGTERM)
                     bad = [(i, c) for i, c in enumerate(codes)
-                           if c is not None and c not in (0, PREEMPTED_EXIT_CODE)]
+                           if c is not None and c not in ok_codes]
                     if bad:
                         failed_why = ", ".join(
                             f"worker {i} exited {c}" for i, c in bad)
@@ -361,8 +596,11 @@ def run_gang(
                                 preemptions=preemptions,
                                 budget_used=budget_used,
                                 restart_delays=restart_delays,
+                                resizes=resizes,
+                                size_history=size_history,
                             )
-                        # remaining codes are 75s (+0s): a clean drain
+                        # remaining codes are 75s (+0s; resize drains may
+                        # add -15s — see ok_codes above): a clean drain
                         preempted_attempt = True
                         break
                     if drain_deadline is not None:
@@ -373,8 +611,13 @@ def run_gang(
                             # max_preemption_restarts times for free);
                             # a supervisor-SIGTERM drain still raises
                             # GangPreempted below regardless.
-                            failed_why = ("drain grace expired (worker(s) "
-                                          "hung during preemption drain)")
+                            failed_why = (
+                                "drain grace expired (worker(s) hung "
+                                "during "
+                                + ("resize" if resize_draining
+                                   else "preemption")
+                                + " drain)"
+                            )
                             break
                     elif heartbeat_timeout is not None:
                         now = time.time()
@@ -400,8 +643,8 @@ def run_gang(
 
             if forwarded:
                 step = None
-                if ckpt_dirs is not None:
-                    step = align_checkpoints(ckpt_dirs, log=echo)
+                if launch_dirs is not None:
+                    step = align_checkpoints(launch_dirs, log=echo)
                 echo("supervisor: gang drained after SIGTERM"
                      + ("" if step is None else f"; resume step {step}"))
                 raise GangPreempted(
@@ -412,6 +655,17 @@ def run_gang(
                 )
 
             if preempted_attempt:
+                if resize_draining:
+                    # Operator-initiated drain: a RESIZE, not a preemption —
+                    # it charges neither the failure budget nor the
+                    # preemption cap, and the accounting must not inflate
+                    # `preemptions` (tests and autoscalers key on it).
+                    _apply_standing_resize("resize request")
+                    echo(f"supervisor: gang attempt {attempt + 1} drained "
+                         f"for resize — relaunching at size {cur_size} "
+                         "without charging the restart budget")
+                    attempt += 1
+                    continue
                 preemptions += 1
                 if preemptions > max_preemption_restarts:
                     raise GangFailed(
@@ -419,6 +673,11 @@ def run_gang(
                         f"(max_preemption_restarts={max_preemption_restarts})"
                         " — refusing to relaunch forever"
                     )
+                # Capacity just changed under us: a standing resize request
+                # rides along with the preemption relaunch (losing a slice
+                # for good must shrink the gang, not crash-loop it).
+                _apply_standing_resize("standing request at preemption "
+                                       "relaunch")
                 echo(f"supervisor: gang attempt {attempt + 1} preempted — "
                      "relaunching without charging the restart budget")
                 attempt += 1
@@ -427,8 +686,8 @@ def run_gang(
             echo(f"supervisor: gang attempt {attempt + 1} failed ({failed_why})")
             # Progress-aware budget: a failure AFTER the checkpoint advanced
             # is a workload that recovers — reset the crash-loop counter.
-            if ckpt_dirs is not None:
-                cur = _common_step(ckpt_dirs)
+            if launch_dirs is not None:
+                cur = _common_step(launch_dirs)
                 if (cur is not None and last_step is not None
                         and cur > last_step and budget_used):
                     echo(f"supervisor: progress since last restart (step "
@@ -465,10 +724,16 @@ def run_gang(
                     if sigterm_box:
                         raise GangPreempted(
                             "supervisor SIGTERM during restart backoff",
-                            step=_common_step(ckpt_dirs) if ckpt_dirs else None,
+                            step=(_common_step(launch_dirs)
+                                  if launch_dirs else None),
                         )
                     time.sleep(min(poll_interval,
                                    max(deadline - time.monotonic(), 0.01)))
+            # A standing resize request also applies to a FAILURE relaunch:
+            # if the crash was the capacity change (peer slice gone for
+            # good), relaunching at the old size would just fail again.
+            # The failure itself stays charged above.
+            _apply_standing_resize("standing request at failure relaunch")
             attempt += 1
     finally:
         if handler_installed:
@@ -478,6 +743,11 @@ def run_gang(
             signal.signal(
                 signal.SIGTERM,
                 old_handler if old_handler is not None else signal.SIG_DFL,
+            )
+        if hup_installed:
+            signal.signal(
+                signal.SIGHUP,
+                old_hup if old_hup is not None else signal.SIG_DFL,
             )
 
 

@@ -39,11 +39,14 @@ string targets one worker on one attempt.
 Instrumented points (grep fault_point for the live list):
     ckpt.save.pre_replace   between the tmp write and the atomic rename
     ckpt.restore            before loading a step's state
+    ckpt.restore.layout     reading a checkpoint's mesh-layout manifest
     stream.batch            each streamed-fit batch boundary
     supervisor.spawn        before each worker Popen
+    supervisor.resize       before a resize relaunch at the new gang size
     serve.dispatch          before each micro-batch engine run
     data.load               dataset open
     resident.chunk          each HBM-resident compiled-chunk boundary
+    reshard.redistribute    restoring state saved under a different layout
 """
 
 from __future__ import annotations
@@ -66,11 +69,14 @@ ENV_VAR = "TDC_FAULTS"
 KNOWN_POINTS = frozenset({
     "ckpt.save.pre_replace",
     "ckpt.restore",
+    "ckpt.restore.layout",
     "stream.batch",
     "supervisor.spawn",
+    "supervisor.resize",
     "serve.dispatch",
     "data.load",
     "resident.chunk",
+    "reshard.redistribute",
 })
 
 # Exit code used by the 'crash' action: 128+9, what a shell reports for a

@@ -5,6 +5,15 @@ was the append-only results CSV).
 Checkpoint state = (centroids, iteration, RNG key, batch cursor) per the
 SURVEY plan, persisted with orbax. Works for the in-jit fits (save at the end)
 and the streamed fits (save every N iterations, resume mid-run).
+
+Size portability: every array is persisted as a FULL host-side copy
+(sharded state is gathered before the write — sharded_k's
+_GatheringCheckpointer), and the streamed drivers record a layout
+manifest in `meta` (`layout_*` keys, parallel/reshard.py) naming the
+mesh the save was taken under. Restore therefore never depends on the
+world size: a save taken at N devices restores fp32-bit-exactly at M,
+and the drivers redistribute placement onto whatever mesh the resumed
+run has (the elastic-resize contract; parallel/supervisor.py).
 """
 
 from __future__ import annotations

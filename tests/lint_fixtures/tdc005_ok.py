@@ -1,7 +1,14 @@
 """MUST-NOT-FLAG TDC005: registry and call sites agree exactly, both
-directions."""
+directions — including the PR-6 elastic-resize point names (dotted,
+multi-segment), which the rule must see as ordinary registered points."""
 
-KNOWN_POINTS = frozenset({"ckpt.save", "stream.batch"})
+KNOWN_POINTS = frozenset({
+    "ckpt.save",
+    "ckpt.restore.layout",
+    "stream.batch",
+    "supervisor.resize",
+    "reshard.redistribute",
+})
 
 
 def fault_point(name):
@@ -11,3 +18,9 @@ def fault_point(name):
 def instrumented():
     fault_point("ckpt.save")
     fault_point("stream.batch")
+
+
+def resize_paths():
+    fault_point("supervisor.resize")
+    fault_point("ckpt.restore.layout")
+    fault_point("reshard.redistribute")

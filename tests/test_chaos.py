@@ -545,3 +545,78 @@ class TestSupervisorPreemptionAccounting:
         out, _ = proc.communicate(timeout=60)
         assert proc.returncode == PREEMPTED_EXIT_CODE, out[-2000:]
         assert "drained" in out
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.multiproc
+def test_chaos_shrink_mid_fit_resizes_to_one(tmp_path):
+    """The elastic-shrink soak (PR-6 acceptance): a 2-process gloo gang is
+    preempted mid-fit (injected SIGTERM on worker 1, drained at the pass
+    boundary) with a standing resize request for size 1 — the supervisor
+    relaunches ONE process from the boundary checkpoint, charging neither
+    the failure budget nor the preemption accounting twice; the resumed
+    fit redistributes the 4-device state onto its 2-device mesh
+    (reshard_redistribute in the worker log) and converges within the
+    documented 1e-4 of the fault-free run. The persistent XLA compile
+    cache is enabled throughout: the resized relaunch compiles fresh
+    per-size executables without tripping the PR-5 cache machinery."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_CHAOS_WORKER)
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    log_dir = tmp_path / "logs"
+    log_dir.mkdir()
+    (log_dir / "resize").write_text("1")  # standing request: shrink to 1
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # Worker 1, attempt 0, pass-2 batch boundary (4 stream.batch hits per
+    # pass): the drivers agree at the end of pass 2, checkpoint step 2,
+    # and exit 75 — a clean preemption with steps 1..2 on disk.
+    env["TDC_FAULTS"] = "stream.batch=sigterm@6&attempt=0&pid=1"
+    # Satellite regression: resize + the PR-5 persistent compile cache.
+    env["TDC_COMPILE_CACHE"] = str(tmp_path / "xla_cache")
+
+    echoes = []
+    res = run_gang(
+        [sys.executable, str(worker), str(outdir)], 2,
+        max_restarts=1, ckpt_dirs=[str(ckpt_dir)],
+        log_dir=str(log_dir),
+        heartbeat_timeout=180.0, env=env, echo=echoes.append,
+        backoff_base=0.05,
+    )
+    assert res.preemptions == 1, (res, echoes)
+    assert res.resizes == 1, (res, echoes)
+    assert res.budget_used == 0, (res, echoes)  # neither drain charged
+    assert res.size_history[0] == 2 and res.size_history[-1] == 1, res
+    assert any("resizing gang 2 -> 1" in m for m in echoes), echoes
+    resumed = [m for m in echoes if "resuming from" in m]
+    assert resumed and all("scratch" not in m for m in resumed), echoes
+
+    final = res.attempts - 1
+    iters = int((outdir / f"iters_run_0_a{final}").read_text())
+    assert 0 < iters < 5  # resumed from the boundary ckpt, not scratch
+    # The resized worker redistributed the saved state onto its smaller
+    # mesh (4 devices at 2 procs -> 2 devices at 1 proc) and said so.
+    a_log = (log_dir / f"worker_a{final}_p0.log").read_text()
+    assert "reshard_redistribute" in a_log
+    assert "gang_init" in a_log
+
+    c0 = np.load(outdir / "centroids_0.npy")
+
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    x = _blobs()
+
+    def batches():
+        for b in range(4):
+            yield x[b * 256 : (b + 1) * 256]
+
+    want = streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=5,
+                               tol=-1.0)
+    np.testing.assert_allclose(c0, np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)

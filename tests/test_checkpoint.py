@@ -641,3 +641,221 @@ def test_independent_per_host_checkpoints_no_deadlock(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i}:\n{out[-2500:]}"
         assert f"INDEP_OK {i}" in out
+
+
+class TestSizePortableRestore:
+    """Elastic-resize checkpoint contract (parallel/reshard.py): a save
+    taken at N devices restores at M — fp32-bit-exact state, and the
+    continued fit matches the uninterrupted same-size run within the
+    documented cross-size tolerance (psum association order is the only
+    difference). Simulated sizes via the conftest 8-virtual-device CPU
+    mesh; the 4-way GLOO gang counterpart lives in test_supervisor.py."""
+
+    def _stream(self, x, rows=256):
+        def batches():
+            for i in range(0, x.shape[0], rows):
+                yield x[i:i + rows]
+
+        return batches
+
+    @pytest.fixture()
+    def blobs4(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1024, 4)).astype(np.float32)
+        x[:256] += 4.0
+        x[256:512] -= 4.0
+        return x
+
+    def test_dense_save_at_4_restore_at_2_and_8(self, blobs4, tmp_path,
+                                                monkeypatch):
+        from tdc_tpu.parallel.mesh import make_mesh
+        from tdc_tpu.testing import faults
+
+        x = blobs4
+        init = x[:5]
+        d = str(tmp_path / "ck")
+        streamed_kmeans_fit(self._stream(x), 5, 4, init=init, max_iters=2,
+                            tol=-1.0, mesh=make_mesh(4), ckpt_dir=d,
+                            ckpt_every=1)
+        saved = restore_checkpoint(d)
+        assert saved.n_iter == 2
+        full = streamed_kmeans_fit(self._stream(x), 5, 4, init=init,
+                                   max_iters=5, tol=-1.0, mesh=make_mesh(4))
+        for n_dev in (2, 8):
+            import shutil
+
+            dn = str(tmp_path / f"ck{n_dev}")
+            shutil.copytree(d, dn)
+            # Zero-iterations-left restore: the returned centroids ARE the
+            # restored state — placement at the new size must be
+            # fp32-BIT-exact, and the resize must be observable (the
+            # reshard.redistribute fault point passes exactly once).
+            monkeypatch.setenv("TDC_FAULTS", "reshard.redistribute=delay:0")
+            faults.reset()
+            res0 = streamed_kmeans_fit(self._stream(x), 5, 4, init=init,
+                                       max_iters=2, tol=-1.0,
+                                       mesh=make_mesh(n_dev), ckpt_dir=dn)
+            assert faults.hit_count("reshard.redistribute") == 1
+            monkeypatch.delenv("TDC_FAULTS")
+            faults.reset()
+            np.testing.assert_array_equal(
+                np.asarray(res0.centroids), np.asarray(saved.centroids)
+            )
+            # Continue 3 more iterations at the new size: matches the
+            # uninterrupted 4-device run within the documented cross-size
+            # tolerance (f32 reduce association is the only difference).
+            res = streamed_kmeans_fit(self._stream(x), 5, 4, init=init,
+                                      max_iters=5, tol=-1.0,
+                                      mesh=make_mesh(n_dev), ckpt_dir=dn)
+            assert int(res.n_iter) == 5
+            np.testing.assert_allclose(
+                np.asarray(res.centroids), np.asarray(full.centroids),
+                rtol=1e-4, atol=1e-4,
+            )
+            # "Identical final inertia": empirically ~1 ulp across sizes
+            # (only the psum association differs); 1e-6 pins that.
+            np.testing.assert_allclose(
+                float(res.sse), float(full.sse), rtol=1e-6
+            )
+
+    def test_dense_restore_on_single_device(self, blobs4, tmp_path):
+        """Shrink all the way to mesh=None: the degenerate resize."""
+        x = blobs4
+        d = str(tmp_path / "ck")
+        from tdc_tpu.parallel.mesh import make_mesh
+
+        streamed_kmeans_fit(self._stream(x), 5, 4, init=x[:5], max_iters=2,
+                            tol=-1.0, mesh=make_mesh(4), ckpt_dir=d,
+                            ckpt_every=1)
+        saved = restore_checkpoint(d)
+        res0 = streamed_kmeans_fit(self._stream(x), 5, 4, init=x[:5],
+                                   max_iters=2, tol=-1.0, ckpt_dir=d)
+        np.testing.assert_array_equal(
+            np.asarray(res0.centroids), np.asarray(saved.centroids)
+        )
+
+    def test_sharded_save_restore_across_model_split(self, blobs4, tmp_path):
+        """The K-sharded path: save under (data=2, model=2), restore under
+        (2, 4) and (4, 2) — the gathered checkpoint re-slices bit-exactly
+        onto the new model split (the old code REFUSED any shard_model
+        change), and the continued fit matches the uninterrupted run."""
+        import shutil
+
+        from tdc_tpu.parallel.sharded_k import (
+            make_mesh_2d,
+            streamed_kmeans_fit_sharded,
+        )
+
+        x = blobs4
+        init = x[:8]
+        d = str(tmp_path / "ck")
+        streamed_kmeans_fit_sharded(self._stream(x), 8, 4, make_mesh_2d(2, 2),
+                                    init=init, max_iters=2, tol=-1.0,
+                                    ckpt_dir=d, ckpt_every=1)
+        saved = restore_checkpoint(d)
+        assert saved.n_iter == 2
+        full = streamed_kmeans_fit_sharded(self._stream(x), 8, 4,
+                                           make_mesh_2d(2, 2), init=init,
+                                           max_iters=5, tol=-1.0)
+        for shape in ((2, 4), (4, 2)):
+            dn = str(tmp_path / f"ck{shape[0]}x{shape[1]}")
+            shutil.copytree(d, dn)
+            res0 = streamed_kmeans_fit_sharded(
+                self._stream(x), 8, 4, make_mesh_2d(*shape), init=init,
+                max_iters=2, tol=-1.0, ckpt_dir=dn,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(res0.centroids), np.asarray(saved.centroids)
+            )
+            res = streamed_kmeans_fit_sharded(
+                self._stream(x), 8, 4, make_mesh_2d(*shape), init=init,
+                max_iters=5, tol=-1.0, ckpt_dir=dn,
+            )
+            np.testing.assert_allclose(
+                np.asarray(res.centroids), np.asarray(full.centroids),
+                rtol=1e-4, atol=1e-4,
+            )
+            # "Identical final inertia": empirically ~1 ulp across sizes
+            # (only the psum association differs); 1e-6 pins that.
+            np.testing.assert_allclose(
+                float(res.sse), float(full.sse), rtol=1e-6
+            )
+
+    def test_sharded_fuzzy_restore_across_model_split(self, blobs4,
+                                                      tmp_path):
+        from tdc_tpu.parallel.sharded_k import (
+            make_mesh_2d,
+            streamed_fuzzy_fit_sharded,
+        )
+
+        x = blobs4
+        d = str(tmp_path / "ck")
+        streamed_fuzzy_fit_sharded(self._stream(x), 8, 4, make_mesh_2d(2, 2),
+                                   init=x[:8], max_iters=2, tol=-1.0,
+                                   ckpt_dir=d, ckpt_every=1)
+        saved = restore_checkpoint(d)
+        res0 = streamed_fuzzy_fit_sharded(
+            self._stream(x), 8, 4, make_mesh_2d(2, 4), init=x[:8],
+            max_iters=2, tol=-1.0, ckpt_dir=d,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res0.centroids), np.asarray(saved.centroids)
+        )
+
+    def test_streamed_gmm_save_restore_across_sizes(self, blobs4, tmp_path):
+        """The streamed GMM carries the manifest too: its state is full
+        host-side replicated arrays, so restore at any size is bit-exact
+        by construction — this pins the manifest + redistribute wiring
+        (4-device save -> 2-device and single-device resume)."""
+        from tdc_tpu.models.gmm import streamed_gmm_fit
+        from tdc_tpu.parallel import reshard
+        from tdc_tpu.parallel.mesh import make_mesh
+
+        x = blobs4
+        d = str(tmp_path / "ck")
+        streamed_gmm_fit(self._stream(x), 3, 4, max_iters=2, tol=-1.0,
+                         mesh=make_mesh(4), ckpt_dir=d, ckpt_every=1)
+        saved = restore_checkpoint(d)
+        man = reshard.layout_from_meta(saved.meta)
+        assert man is not None and man.n_devices == 4
+        for mesh in (make_mesh(2), None):
+            res = streamed_gmm_fit(self._stream(x), 3, 4, max_iters=2,
+                                   tol=-1.0, mesh=mesh, ckpt_dir=d)
+            np.testing.assert_array_equal(
+                np.asarray(res.means), np.asarray(saved.centroids)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(res.weights), np.asarray(saved.meta["weights"])
+            )
+
+    def test_layout_manifest_written_and_legacy_restores(self, blobs4,
+                                                         tmp_path):
+        """Every streamed save carries layout_* meta; a checkpoint WITHOUT
+        one (pre-manifest era) still restores, placement-only."""
+        from tdc_tpu.parallel import reshard
+        from tdc_tpu.parallel.mesh import make_mesh
+
+        x = blobs4
+        d = str(tmp_path / "ck")
+        streamed_kmeans_fit(self._stream(x), 5, 4, init=x[:5], max_iters=1,
+                            tol=-1.0, mesh=make_mesh(2), ckpt_dir=d)
+        saved = restore_checkpoint(d)
+        man = reshard.layout_from_meta(saved.meta)
+        assert man is not None and man.n_devices == 2
+
+        # Legacy: strip the manifest keys and resume — must not raise.
+        d2 = str(tmp_path / "legacy")
+        meta = {k: v for k, v in saved.meta.items()
+                if not k.startswith(reshard.LAYOUT_META_PREFIX)}
+        save_checkpoint(
+            d2,
+            ClusterState(np.asarray(saved.centroids), saved.n_iter,
+                         saved.key, 0, meta),
+            step=saved.n_iter,
+        )
+        res = streamed_kmeans_fit(self._stream(x), 5, 4, init=x[:5],
+                                  max_iters=1, tol=-1.0, mesh=make_mesh(4),
+                                  ckpt_dir=d2)
+        np.testing.assert_array_equal(
+            np.asarray(res.centroids), np.asarray(saved.centroids)
+        )

@@ -463,9 +463,10 @@ def test_fault_points_match_registry():
     from tdc_tpu.testing import faults
 
     assert faults.KNOWN_POINTS == {
-        "ckpt.save.pre_replace", "ckpt.restore", "stream.batch",
-        "supervisor.spawn", "serve.dispatch", "data.load",
-        "resident.chunk",
+        "ckpt.save.pre_replace", "ckpt.restore", "ckpt.restore.layout",
+        "stream.batch", "supervisor.spawn", "supervisor.resize",
+        "serve.dispatch", "data.load", "resident.chunk",
+        "reshard.redistribute",
     }
 
 

@@ -1,0 +1,188 @@
+"""MeshSpec layout algebra + reshard size-portable redistribution.
+
+The elastic-resize contract (parallel/reshard.py, parallel/meshspec.py):
+one spec object answers every host-side layout question the drivers used
+to re-derive, checkpoints carry a layout manifest, and restoring state
+saved under a different layout redistributes with full observability
+(structlog event + the reshard.redistribute fault point). Cross-size
+END-TO-END restores live in tests/test_checkpoint.py and the gang tests
+in tests/test_supervisor.py / tests/test_chaos.py; these are the unit
+contracts.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from tdc_tpu.parallel import reshard
+from tdc_tpu.parallel.mesh import make_hierarchical_mesh, make_mesh
+from tdc_tpu.parallel.meshspec import MeshSpec
+from tdc_tpu.parallel.sharded_k import make_mesh_2d
+from tdc_tpu.testing import faults
+
+
+class TestMeshSpec:
+    def test_single_device_spec(self):
+        s = MeshSpec.of(None)
+        assert s.kind == "single"
+        assert s.n_devices == 1 and s.n_data == 1 and s.n_model == 1
+        assert not s.gang
+        assert s.pad_multiple == 1 and s.process_scale == 1
+        assert s.data_axes == ()
+
+    def test_data1d_spec_and_cache(self):
+        m = make_mesh(4)
+        s = MeshSpec.of(m)
+        assert s.kind == "data1d"
+        assert s.n_devices == 4 == s.n_data and s.n_model == 1
+        assert not s.gang
+        # Single process: batches are global, padded to the mesh size.
+        assert s.pad_multiple == 4 and s.process_scale == 1
+        assert MeshSpec.of(m) is s  # cached per mesh (hot-loop lookup)
+
+    def test_hierarchical_spec(self):
+        m = make_hierarchical_mesh(n_hosts=2, n_devices=8)
+        s = MeshSpec.of(m)
+        assert s.kind == "hier"
+        assert s.n_devices == 8 == s.n_data and s.n_model == 1
+        assert s.data_axes == ("dcn", "ici")
+
+    def test_data_model_spec(self):
+        s = MeshSpec.of(make_mesh_2d(2, 4))
+        assert s.kind == "data_model"
+        assert s.n_devices == 8 and s.n_data == 2 and s.n_model == 4
+        # Data-axis padding granularity; identical-global-batch contract.
+        assert s.pad_multiple == 2 and s.process_scale == 1
+
+    def test_legacy_mesh_layout_delegates(self):
+        from tdc_tpu.models.streaming import _mesh_layout
+
+        m = make_mesh(4)
+        s = MeshSpec.of(m)
+        assert _mesh_layout(m) == (s.n_processes, s.n_local)
+
+    def test_replicate_and_named(self):
+        s = MeshSpec.of(make_mesh(2))
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        np.testing.assert_array_equal(np.asarray(s.replicate(x)), x)
+        sh = s.named(P("data"))
+        assert sh.mesh is s.mesh
+        with pytest.raises(ValueError, match="needs a mesh"):
+            MeshSpec.of(None).named(P())
+        # Single-device replicate is a plain device array.
+        np.testing.assert_array_equal(
+            np.asarray(MeshSpec.of(None).replicate(x)), x
+        )
+
+
+class TestLayoutManifest:
+    def test_meta_roundtrip(self):
+        spec = MeshSpec.of(make_mesh_2d(2, 4))
+        meta = reshard.layout_meta(spec)
+        # npz round trip: meta values survive np.asarray like the manual
+        # checkpoint format stores them.
+        meta = {k: np.asarray(v) for k, v in meta.items()}
+        got = reshard.layout_from_meta(meta)
+        assert got == reshard.manifest_of(spec)
+        assert got.n_data == 2 and got.n_model == 4 and got.n_devices == 8
+        assert "2dev" not in got.describe()  # 8 devices, 1 process
+        assert got.describe() == "8dev/1proc(data=2,model=4)"
+
+    def test_absent_manifest_is_none(self):
+        assert reshard.layout_from_meta({}) is None
+        assert reshard.layout_from_meta(None) is None
+        assert reshard.layout_from_meta({"k": 5}) is None
+
+    def test_manifest_read_passes_fault_point(self, monkeypatch):
+        monkeypatch.setenv(
+            "TDC_FAULTS", "ckpt.restore.layout=raise:RuntimeError"
+        )
+        faults.reset()
+        meta = reshard.layout_meta(MeshSpec.of(None))
+        with pytest.raises(RuntimeError, match="ckpt.restore.layout"):
+            reshard.layout_from_meta(meta)
+        # An absent manifest (pre-manifest checkpoint) must NOT pass the
+        # point — no layout is being read.
+        assert reshard.layout_from_meta({}) is None
+        faults.reset()
+
+
+class TestRedistribute:
+    def test_same_layout_places_without_firing(self, monkeypatch):
+        monkeypatch.setenv(
+            "TDC_FAULTS", "reshard.redistribute=raise:RuntimeError"
+        )
+        faults.reset()
+        spec = MeshSpec.of(make_mesh(2))
+        x = np.ones((4, 2), np.float32)
+        out = reshard.redistribute(
+            x, reshard.manifest_of(spec), spec, place=spec.replicate
+        )
+        np.testing.assert_array_equal(np.asarray(out), x)
+        # Pre-manifest checkpoints (old=None) also place quietly.
+        reshard.redistribute(x, None, spec, place=spec.replicate)
+        faults.reset()
+
+    def test_layout_change_fires_event_and_fault_point(self, monkeypatch,
+                                                       capsys):
+        monkeypatch.setenv(
+            "TDC_FAULTS", "reshard.redistribute=raise:RuntimeError"
+        )
+        faults.reset()
+        old = reshard.manifest_of(MeshSpec.of(make_mesh(4)))
+        spec = MeshSpec.of(make_mesh(2))
+        with pytest.raises(RuntimeError, match="reshard.redistribute"):
+            reshard.redistribute(np.ones((4, 2), np.float32), old, spec,
+                                 place=spec.replicate)
+        # The structlog event fired BEFORE the fault (postmortem contract).
+        assert "reshard_redistribute" in capsys.readouterr().err
+        faults.reset()
+
+    def test_model_split_change_is_bit_exact(self):
+        """The all-gather-then-slice redistribution: a gathered (K, d)
+        array re-placed under a different model split carries the exact
+        fp32 bytes onto the new shards."""
+        rng = np.random.default_rng(0)
+        c = rng.normal(size=(8, 4)).astype(np.float32)
+        old = reshard.manifest_of(MeshSpec.of(make_mesh_2d(2, 2)))
+        spec = MeshSpec.of(make_mesh_2d(2, 4))
+        placed = reshard.redistribute(
+            c, old, spec,
+            place=lambda t: jax.device_put(t, spec.named(P("model", None))),
+        )
+        np.testing.assert_array_equal(np.asarray(placed), c)
+        assert placed.sharding.spec == P("model", None)
+
+
+class TestRedistributeDeferred:
+    def test_fold_preserves_slot_sum(self):
+        rng = np.random.default_rng(1)
+        tree = {
+            "sums": rng.normal(size=(4, 8, 2)).astype(np.float32),
+            "counts": rng.normal(size=(4, 8)).astype(np.float32),
+        }
+        out = reshard.redistribute_deferred(tree, 2)
+        for k in tree:
+            assert out[k].shape == (2,) + tree[k].shape[1:]
+            np.testing.assert_allclose(
+                out[k].sum(axis=0), tree[k].sum(axis=0), rtol=1e-6
+            )
+            # Everything lands in slot 0; the rest are exact zeros.
+            np.testing.assert_array_equal(
+                out[k][1:], np.zeros_like(out[k][1:])
+            )
+
+    def test_grow_and_place(self):
+        tree = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = reshard.redistribute_deferred(
+            tree, 4, place=lambda t: jax.numpy.asarray(t)
+        )
+        assert isinstance(out, jax.Array) and out.shape == (4, 3)
+
+    def test_rejects_scalar_leaves_and_bad_slots(self):
+        with pytest.raises(ValueError, match="leading device axis"):
+            reshard.redistribute_deferred(np.float32(1.0), 2)
+        with pytest.raises(ValueError, match="n_slots"):
+            reshard.redistribute_deferred(np.ones((2, 3), np.float32), 0)

@@ -187,14 +187,16 @@ class TestPlanner:
         residency='auto' refused bf16 datasets that actually fit."""
         from tdc_tpu.data.loader import NpzStream
         from tdc_tpu.models.streaming import _plan_1d_residency
+        from tdc_tpu.parallel.meshspec import MeshSpec
 
         x = _data(1000)
+        spec = MeshSpec.of(None)  # the drivers' layout object (PR 6)
         kw = dict(weighted=False, kernel="xla", cursor=0, label="t")
         f32_plan, _ = _plan_1d_residency(
-            "auto", NpzStream(x, 256), 8, 8, None, **kw
+            "auto", NpzStream(x, 256), 8, 8, spec, **kw
         )
         bf16_plan, _ = _plan_1d_residency(
-            "auto", NpzStream(x.astype(jnp.bfloat16), 256), 8, 8, None, **kw
+            "auto", NpzStream(x.astype(jnp.bfloat16), 256), 8, 8, spec, **kw
         )
         assert f32_plan.resident_bytes == 1000 * 8 * 4
         assert bf16_plan.resident_bytes == 1000 * 8 * 2

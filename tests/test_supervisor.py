@@ -571,3 +571,362 @@ def test_sharded_gang_kill_and_resume_matches_uninterrupted(tmp_path):
         )
     )
     np.testing.assert_allclose(c0, want_c, rtol=1e-5, atol=1e-5)
+
+
+class TestResize:
+    """Elastic resize: the supervisor's third outcome (resize request file
+    / $TDC_RESIZE / SIGHUP -> drain -> relaunch at the new size, charging
+    neither the failure budget nor the preemption cap)."""
+
+    def _resize_file(self, tmp_path, content):
+        log_dir = tmp_path / "logs"
+        log_dir.mkdir(exist_ok=True)
+        (log_dir / "resize").write_text(content)
+        return str(log_dir)
+
+    def test_standing_resize_applied_at_preemption_relaunch(self, tmp_path):
+        """A pre-written request is a STANDING one: honored when the gang
+        next relaunches (here: a preemption exit), not by interrupting a
+        healthy gang that predates it."""
+        script = textwrap.dedent("""
+            import os, sys
+            if os.environ["TDC_ATTEMPT"] == "0":
+                sys.exit(75)  # preempted: capacity went away
+            assert os.environ["TDC_NUM_PROCESSES"] == "1", \\
+                os.environ["TDC_NUM_PROCESSES"]
+        """)
+        log_dir = self._resize_file(tmp_path, "1")
+        echoes = []
+        res = run_gang([sys.executable, "-c", script], 2, max_restarts=0,
+                       log_dir=log_dir, echo=echoes.append, backoff_base=0)
+        assert res.size_history == [2, 1], (res, echoes)
+        assert res.resizes == 1 and res.preemptions == 1
+        assert res.budget_used == 0
+        assert len(res.returncodes) == 1  # the final attempt ran 1 worker
+        assert any("resizing gang 2 -> 1" in m for m in echoes), echoes
+
+    def test_live_resize_drains_and_relaunches(self, tmp_path):
+        """A request WRITTEN while the gang runs drains it (SIGTERM ->
+        workers exit 75 at their boundary) and relaunches at the new size;
+        the drain counts as a resize, not a preemption."""
+        import threading
+        import time as _time
+
+        outdir = tmp_path / "out"
+        outdir.mkdir()
+        log_dir = tmp_path / "logs"
+        log_dir.mkdir()
+        script = textwrap.dedent(f"""
+            import os, signal, sys, time
+            if os.environ["TDC_ATTEMPT"] == "0":
+                signal.signal(signal.SIGTERM, lambda *_: sys.exit(75))
+                open(os.path.join({str(outdir)!r},
+                     "ready_" + os.environ["TDC_PROCESS_ID"]), "w").close()
+                time.sleep(120)
+            assert os.environ["TDC_NUM_PROCESSES"] == "1"
+        """)
+
+        def write_request():
+            deadline = _time.time() + 60
+            while _time.time() < deadline:
+                if all((outdir / f"ready_{p}").exists() for p in range(2)):
+                    break
+                _time.sleep(0.05)
+            (log_dir / "resize").write_text("1")
+
+        t = threading.Thread(target=write_request)
+        t.start()
+        echoes = []
+        res = run_gang([sys.executable, "-c", script], 2, max_restarts=0,
+                       log_dir=str(log_dir), echo=echoes.append,
+                       backoff_base=0, drain_grace=10.0)
+        t.join()
+        assert res.size_history == [2, 1], (res, echoes)
+        assert res.resizes == 1 and res.preemptions == 0
+        assert res.budget_used == 0
+        assert any("resize request 2 -> 1" in m for m in echoes), echoes
+
+    def test_live_resize_drains_handlerless_workers_without_charging(
+            self, tmp_path):
+        """A worker terminated before it installed the drain handler dies
+        from the supervisor's OWN SIGTERM (returncode -15): that is the
+        resize drain doing its job, not a worker failure — with
+        max_restarts=0 a charged budget would raise GangFailed here."""
+        import threading
+        import time as _time
+
+        outdir = tmp_path / "out"
+        outdir.mkdir()
+        log_dir = tmp_path / "logs"
+        log_dir.mkdir()
+        script = textwrap.dedent(f"""
+            import os, sys, time
+            if os.environ["TDC_ATTEMPT"] == "0":
+                # NO SIGTERM handler: the drain kills us with -15.
+                open(os.path.join({str(outdir)!r},
+                     "ready_" + os.environ["TDC_PROCESS_ID"]), "w").close()
+                time.sleep(120)
+            assert os.environ["TDC_NUM_PROCESSES"] == "1"
+        """)
+
+        def write_request():
+            deadline = _time.time() + 60
+            while _time.time() < deadline:
+                if all((outdir / f"ready_{p}").exists() for p in range(2)):
+                    break
+                _time.sleep(0.05)
+            (log_dir / "resize").write_text("1")
+
+        t = threading.Thread(target=write_request)
+        t.start()
+        echoes = []
+        res = run_gang([sys.executable, "-c", script], 2, max_restarts=0,
+                       log_dir=str(log_dir), echo=echoes.append,
+                       backoff_base=0, drain_grace=10.0)
+        t.join()
+        assert res.size_history == [2, 1], (res, echoes)
+        assert res.resizes == 1 and res.preemptions == 0
+        assert res.budget_used == 0, (res, echoes)
+
+    def test_standing_request_echoed_at_startup(self, tmp_path):
+        """A request file surviving from a previous run must be LOUD at
+        launch — a week-old leftover in a reused log_dir must never
+        resize a new run silently."""
+        log_dir = self._resize_file(tmp_path, "1")
+        echoes = []
+        res = run_gang([sys.executable, "-c", "pass"], 2, max_restarts=0,
+                       log_dir=log_dir, echo=echoes.append, backoff_base=0)
+        # Completed in one attempt: the standing request never applied —
+        # but it was announced, with the cancel instruction.
+        assert res.size_history == [2] and res.resizes == 0
+        assert any("standing resize request for size 1" in m
+                   and "remove" in m for m in echoes), echoes
+
+    def test_sighup_forces_reread_of_predating_request(self, tmp_path):
+        """A request file older than the attempt does not interrupt the
+        gang on its own — SIGHUP is the operator's 'apply it NOW'."""
+        import signal as _signal
+        import threading
+        import time as _time
+
+        outdir = tmp_path / "out"
+        outdir.mkdir()
+        log_dir = self._resize_file(tmp_path, "1")  # predates the gang
+        script = textwrap.dedent(f"""
+            import os, signal, sys, time
+            if os.environ["TDC_ATTEMPT"] == "0":
+                signal.signal(signal.SIGTERM, lambda *_: sys.exit(75))
+                open(os.path.join({str(outdir)!r},
+                     "ready_" + os.environ["TDC_PROCESS_ID"]), "w").close()
+                time.sleep(120)
+            assert os.environ["TDC_NUM_PROCESSES"] == "1"
+        """)
+
+        def hup_when_ready():
+            deadline = _time.time() + 60
+            while _time.time() < deadline:
+                if all((outdir / f"ready_{p}").exists() for p in range(2)):
+                    break
+                _time.sleep(0.05)
+            _time.sleep(0.3)  # let the poll loop observe steady state
+            os.kill(os.getpid(), _signal.SIGHUP)
+
+        t = threading.Thread(target=hup_when_ready)
+        t.start()
+        echoes = []
+        res = run_gang([sys.executable, "-c", script], 2, max_restarts=0,
+                       log_dir=log_dir, echo=echoes.append,
+                       backoff_base=0, drain_grace=10.0)
+        t.join()
+        assert res.size_history == [2, 1], (res, echoes)
+        assert res.resizes == 1 and res.preemptions == 0
+
+    def test_env_tdc_resize_sets_initial_size(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TDC_RESIZE", "1")
+        script = 'import os; assert os.environ["TDC_NUM_PROCESSES"] == "1"'
+        env = {k: v for k, v in os.environ.items() if k != "TDC_RESIZE"}
+        res = run_gang([sys.executable, "-c", script], 2, max_restarts=0,
+                       log_dir=str(tmp_path / "logs"), env=env,
+                       echo=lambda _: None, backoff_base=0)
+        assert res.size_history == [1] and res.resizes == 0
+        assert res.attempts == 1
+
+    def test_resize_grow(self, tmp_path):
+        """Grow 1 -> 2: more capacity offered, same machinery."""
+        script = textwrap.dedent("""
+            import os, sys
+            if os.environ["TDC_ATTEMPT"] == "0":
+                sys.exit(75)
+            assert os.environ["TDC_NUM_PROCESSES"] == "2"
+        """)
+        log_dir = self._resize_file(tmp_path, "2")
+        res = run_gang([sys.executable, "-c", script], 1, max_restarts=0,
+                       log_dir=log_dir, echo=lambda _: None, backoff_base=0)
+        assert res.size_history == [1, 2] and res.resizes == 1
+        assert len(res.returncodes) == 2
+
+    def test_resize_ignored_with_per_worker_ckpt_dirs(self, tmp_path):
+        """Per-worker checkpoint dirs have no meaning at another size —
+        the request is ignored LOUDLY and the gang keeps its size."""
+        script = textwrap.dedent("""
+            import os, sys
+            sys.exit(75 if os.environ["TDC_ATTEMPT"] == "0" else 0)
+        """)
+        d1, d2 = tmp_path / "c1", tmp_path / "c2"
+        d1.mkdir(); d2.mkdir()
+        log_dir = self._resize_file(tmp_path, "1")
+        echoes = []
+        res = run_gang([sys.executable, "-c", script], 2, max_restarts=0,
+                       ckpt_dirs=[str(d1), str(d2)], log_dir=log_dir,
+                       echo=echoes.append, backoff_base=0)
+        assert res.size_history == [2, 2] and res.resizes == 0
+        assert any("cannot change size" in m for m in echoes), echoes
+
+    def test_malformed_request_ignored_loudly(self, tmp_path):
+        script = textwrap.dedent("""
+            import os, sys
+            sys.exit(75 if os.environ["TDC_ATTEMPT"] == "0" else 0)
+        """)
+        log_dir = self._resize_file(tmp_path, "banana")
+        echoes = []
+        res = run_gang([sys.executable, "-c", script], 2, max_restarts=0,
+                       log_dir=log_dir, echo=echoes.append, backoff_base=0)
+        assert res.size_history == [2, 2] and res.resizes == 0
+        assert any("not an integer" in m for m in echoes), echoes
+
+    def test_resize_fault_point_fires(self, tmp_path, monkeypatch):
+        from tdc_tpu.testing import faults
+
+        script = textwrap.dedent("""
+            import os, sys
+            sys.exit(75 if os.environ["TDC_ATTEMPT"] == "0" else 0)
+        """)
+        log_dir = self._resize_file(tmp_path, "1")
+        # Target the SUPERVISOR's fault point only (workers get a clean env).
+        worker_env = {k: v for k, v in os.environ.items()
+                      if k != "TDC_FAULTS"}
+        monkeypatch.setenv("TDC_FAULTS",
+                           "supervisor.resize=raise:RuntimeError")
+        faults.reset()
+        with pytest.raises(RuntimeError, match="supervisor.resize"):
+            run_gang([sys.executable, "-c", script], 2, max_restarts=0,
+                     log_dir=log_dir, env=worker_env, echo=lambda _: None,
+                     backoff_base=0)
+        faults.reset()
+
+    def test_stale_heartbeat_files_pruned(self, tmp_path):
+        """Entry + per-attempt pruning: hb files from a previous
+        supervisor run (possibly a different size) are removed up front,
+        and a completed run leaves none behind — a resized relaunch can
+        never read the old size's files."""
+        log_dir = tmp_path / "logs"
+        log_dir.mkdir()
+        for name in ("hb_a0_p0", "hb_a0_p3", "hb_a7_p1"):
+            (log_dir / name).write_text("stale")
+        (log_dir / "not_a_heartbeat").write_text("keep me")
+        res = run_gang([sys.executable, "-c", "pass"], 1, max_restarts=0,
+                       heartbeat_timeout=60.0, log_dir=str(log_dir),
+                       echo=lambda _: None, backoff_base=0)
+        assert res.attempts == 1
+        left = sorted(os.listdir(log_dir))
+        assert not any(n.startswith("hb_a") for n in left), left
+        assert "not_a_heartbeat" in left
+
+
+_SAVE_AT_4_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from tdc_tpu.parallel.multihost import (
+        barrier, global_mesh, host_shard_bounds, initialize_from_env,
+    )
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    pid, nproc = initialize_from_env()
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 4)).astype(np.float32)
+    X[:256] += 4.0; X[256:512] -= 4.0
+
+    def batches():
+        for b in range(4):
+            lo = b * 256
+            start, end = host_shard_bounds(256)
+            yield X[lo + start : lo + end]
+
+    streamed_kmeans_fit(
+        batches, 5, 4, init=X[:5], max_iters=2, tol=-1.0,
+        mesh=global_mesh(), ckpt_dir=os.environ["TDC_CKPT_DIR"],
+        ckpt_every=1,
+    )
+    print("SAVE4_OK", pid, flush=True)
+    barrier()
+""")
+
+
+@pytest.mark.multiproc
+def test_gang_save_at_4way_restores_at_2_and_8(tmp_path):
+    """Size-portable checkpoints, the GANG half: a 4-process gloo gang
+    (1 device each) checkpoints at an iteration boundary; the save then
+    restores fp32-BIT-exactly at a simulated 2-way and 8-way mesh, and
+    the continued fits match the uninterrupted fit (identical inertia to
+    float noise — only the reduce association differs across sizes)."""
+    import shutil
+
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+    from tdc_tpu.parallel.mesh import make_mesh
+    from tdc_tpu.utils.checkpoint import restore_checkpoint
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_SAVE_AT_4_WORKER)
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    res = run_gang(
+        [sys.executable, str(worker)], 4, max_restarts=1,
+        ckpt_dirs=[str(ckpt_dir)], log_dir=str(tmp_path / "logs"),
+        heartbeat_timeout=180.0, env=env, echo=lambda _: None,
+        backoff_base=0.05,
+    )
+    assert res.size_history[0] == 4
+    saved = restore_checkpoint(str(ckpt_dir))
+    assert saved is not None and saved.n_iter == 2
+    from tdc_tpu.parallel import reshard
+
+    man = reshard.layout_from_meta(saved.meta)
+    assert man is not None and man.n_processes == 4 and man.n_devices == 4
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1024, 4)).astype(np.float32)
+    x[:256] += 4.0
+    x[256:512] -= 4.0
+
+    def batches():
+        for b in range(4):
+            yield x[b * 256 : (b + 1) * 256]
+
+    full = streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=5,
+                               tol=-1.0, mesh=make_mesh(4))
+    for n_dev in (2, 8):
+        dn = str(tmp_path / f"ck{n_dev}")
+        shutil.copytree(ckpt_dir, dn)
+        res0 = streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=2,
+                                   tol=-1.0, mesh=make_mesh(n_dev),
+                                   ckpt_dir=dn)
+        np.testing.assert_array_equal(
+            np.asarray(res0.centroids), np.asarray(saved.centroids)
+        )
+        cont = streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=5,
+                                   tol=-1.0, mesh=make_mesh(n_dev),
+                                   ckpt_dir=dn)
+        np.testing.assert_allclose(
+            np.asarray(cont.centroids), np.asarray(full.centroids),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(float(cont.sse), float(full.sse),
+                                   rtol=1e-6)
