@@ -58,14 +58,19 @@ fi
 # Chaos smoke (tests/test_chaos.py soak): 1 kill -9 + 1 preemption SIGTERM
 # injected via TDC_FAULTS into the 2-process gloo gang (recover both,
 # refund the SIGTERM restart, match the fault-free fit), the resident-fit
-# preemption drain, and the PR-6 elastic shrink-mid-fit case (SIGTERM one
+# preemption drain, the PR-6 elastic shrink-mid-fit case (SIGTERM one
 # worker with a standing resize request: the supervisor relaunches ONE
 # process from the boundary checkpoint, charging neither budget, within
-# 1e-4 of fault-free). slow-marked so the main sweep above keeps its time
-# budget; run here timeout-wrapped (~60 s).
+# 1e-4 of fault-free), and the PR-7 online-update soak (NaN-poisoned fold
+# batch quarantined + crash at online.swap leaves serving bit-exact on
+# the last-good generation, the relaunched sidecar publishes a validated
+# generation, and a forced post-swap regression auto-rolls-back within
+# one validation window). slow-marked so the main sweep above keeps its
+# time budget; run here timeout-wrapped (~90 s clean; 600 covers a
+# loaded box re-importing jax across the soaks' subprocess relaunches).
 chaos_rc=0
 if [ -z "$SKIP_CHAOS_SMOKE" ]; then
-    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
         python -m pytest tests/test_chaos.py -q -m 'chaos and slow' \
         --strict-markers -p no:cacheprovider || chaos_rc=$?
 fi

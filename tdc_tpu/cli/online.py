@@ -1,0 +1,185 @@
+"""Online-update sidecar + admin CLI (serve/online.py).
+
+Sidecar mode — fold sampled traffic from a feed directory into a served
+model dir, through the guarded validate/publish/rollback pipeline; the
+serving process (cli/serve) picks each publish up via its normal
+hot-reload poll of the same dir:
+
+    python -m tdc_tpu.cli.online --model_dir /models/km \\
+        --feed_dir /models/km_feed --interval 2.0
+
+Admin verbs — drive the ledger in the model dir directly (works whether
+the updater is a sidecar or in-process, but do NOT run a verb while a
+sidecar is mid-tick on the same dir: one writer at a time):
+
+    python -m tdc_tpu.cli.online --model_dir /models/km --rollback
+    python -m tdc_tpu.cli.online --model_dir /models/km --pin
+    python -m tdc_tpu.cli.online --model_dir /models/km --status
+
+The sidecar honors the PR-3 preemption contract: SIGTERM finishes the
+current tick (state is atomically persisted every event) and exits 75,
+so a supervisor relaunch is budget-free and resumes from the ledger.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def add_config_flags(p: argparse.ArgumentParser, prefix: str = "") -> None:
+    """The OnlineConfig knobs, shared between this CLI (bare names) and
+    cli/serve (prefix='online_') so the two surfaces cannot drift."""
+    from tdc_tpu.serve.online import OnlineConfig
+
+    dflt = OnlineConfig()
+    p.add_argument(f"--{prefix}mode", type=str, default=dflt.mode,
+                   choices=("minibatch", "streaming"),
+                   help="fold rule: Sculley per-center rates, or decayed "
+                        "sufficient-stats (models/streaming.streaming_fold)")
+    p.add_argument(f"--{prefix}decay", type=float, default=dflt.decay,
+                   help="streaming-mode forgetting per fold (1.0 = none)")
+    p.add_argument(f"--{prefix}prior_count", type=float,
+                   default=dflt.prior_count,
+                   help="pseudo-points seeding each center's fold mass")
+    p.add_argument(f"--{prefix}min_fold_rows", type=int,
+                   default=dflt.min_fold_rows,
+                   help="pending rows before a fold is attempted")
+    p.add_argument(f"--{prefix}holdback_rows", type=int,
+                   default=dflt.holdback_rows,
+                   help="sliding shadow-validation window size")
+    p.add_argument(f"--{prefix}min_holdback_rows", type=int,
+                   default=dflt.min_holdback_rows,
+                   help="validation evidence floor before any publish")
+    p.add_argument(f"--{prefix}max_inertia_ratio", type=float,
+                   default=dflt.max_inertia_ratio,
+                   help="candidate/live holdback-inertia publish ceiling")
+    p.add_argument(f"--{prefix}max_churn", type=float,
+                   default=dflt.max_churn,
+                   help="candidate vs live assignment-churn ceiling")
+    p.add_argument(f"--{prefix}min_entropy_ratio", type=float,
+                   default=dflt.min_entropy_ratio,
+                   help="candidate/live cluster-size entropy floor")
+    p.add_argument(f"--{prefix}rollback_ratio", type=float,
+                   default=dflt.rollback_inertia_ratio,
+                   help="live/last-good inertia auto-rollback trigger")
+    p.add_argument(f"--{prefix}keep", type=int,
+                   default=dflt.keep_generations,
+                   help="generations retained (live+last-good pinned)")
+    p.add_argument(f"--{prefix}seed", type=int, default=dflt.seed,
+                   help="holdback-sampling PRNG seed")
+
+
+def config_from(args, prefix: str = "", **overrides):
+    from tdc_tpu.serve.online import OnlineConfig
+
+    def g(name):
+        return getattr(args, prefix + name)
+
+    return OnlineConfig(
+        mode=g("mode"),
+        decay=g("decay"),
+        prior_count=g("prior_count"),
+        min_fold_rows=g("min_fold_rows"),
+        holdback_rows=g("holdback_rows"),
+        min_holdback_rows=g("min_holdback_rows"),
+        max_inertia_ratio=g("max_inertia_ratio"),
+        max_churn=g("max_churn"),
+        min_entropy_ratio=g("min_entropy_ratio"),
+        rollback_inertia_ratio=g("rollback_ratio"),
+        keep_generations=g("keep"),
+        seed=g("seed"),
+        **overrides,
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tdc_tpu.online",
+        description="Online-update sidecar / admin for a served model dir",
+    )
+    p.add_argument("--model_dir", type=str, required=True,
+                   help="save_fitted model dir (the one cli/serve polls)")
+    p.add_argument("--feed_dir", type=str, default=None,
+                   help="directory a server exports sampled traffic "
+                        "batches into (cli/serve --feed_dir)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between sidecar ticks")
+    p.add_argument("--max_ticks", type=int, default=0,
+                   help="exit 0 after this many ticks (0 = run forever)")
+    p.add_argument("--log_file", type=str, default=None,
+                   help="JSONL event log (utils/structlog.RunLog)")
+    verbs = p.add_mutually_exclusive_group()
+    verbs.add_argument("--rollback", action="store_true",
+                       help="republish the last-good generation and exit")
+    verbs.add_argument("--pin", action="store_true",
+                       help="freeze publishes/auto-rollback and exit")
+    verbs.add_argument("--unpin", action="store_true",
+                       help="resume publishes/auto-rollback and exit")
+    verbs.add_argument("--status", action="store_true",
+                       help="print the ledger status as JSON and exit")
+    add_config_flags(p)
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    from tdc_tpu.serve.online import OnlineUpdater, feed_drain
+    from tdc_tpu.utils.structlog import RunLog
+
+    # No --log_file: leave log unset so updater events route through
+    # structlog.emit (stderr + $TDC_RUNLOG) instead of a no-op RunLog —
+    # a sidecar's recovery story must be greppable somewhere by default.
+    log = RunLog(args.log_file) if args.log_file else None
+    try:
+        updater = OnlineUpdater(
+            args.model_dir, config=config_from(args), log=log,
+        )
+    except (ValueError, FileNotFoundError) as e:
+        raise SystemExit(f"tdc_tpu.online: {e}") from None
+
+    if args.status:
+        print(json.dumps(updater.status(), indent=1, sort_keys=True))
+        return 0
+    if args.rollback:
+        try:
+            version = updater.rollback(reason="admin_cli")
+        except ValueError as e:
+            raise SystemExit(f"tdc_tpu.online: {e}") from None
+        print(f"rolled back to {version}", flush=True)
+        return 0
+    if args.pin or args.unpin:
+        updater.pin() if args.pin else updater.unpin()
+        print(f"pinned={updater.status()['pinned']}", flush=True)
+        return 0
+
+    if args.feed_dir is None:
+        parser.error("sidecar mode needs --feed_dir (or pass an admin "
+                     "verb: --rollback/--pin/--unpin/--status)")
+
+    from tdc_tpu.utils import preempt
+    from tdc_tpu.utils.preempt import Preempted, install_preemption_handler
+
+    install_preemption_handler()  # SIGTERM -> finish the tick, exit 75
+    print(f"online sidecar on {args.model_dir} "
+          f"(feed {args.feed_dir}, live {updater.live_version})", flush=True)
+    ticks = 0
+    while True:
+        feed_drain(args.feed_dir, updater)
+        updater.tick()
+        ticks += 1
+        if args.max_ticks and ticks >= args.max_ticks:
+            return 0
+        if preempt.requested():
+            # Everything is already persisted (ledger + fold state are
+            # atomic-replace per event): drain is just a clean exit 75.
+            raise Preempted()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "default $TDC_COMPILE_CACHE) — a restarted server "
                         "deserializes its warmup/predict executables "
                         "instead of recompiling (utils/compile_cache)")
+    # Online updates (serve/online): fold sampled traffic back into a
+    # registered kmeans model through the guarded screen -> shadow-validate
+    # -> atomic-swap -> auto-rollback pipeline.
+    p.add_argument("--online", type=str, default=None, metavar="ID",
+                   help="run the in-process online updater for this "
+                        "registered model (kmeans fitted-model dirs only)")
+    p.add_argument("--online_tick", type=float, default=5.0,
+                   help="seconds between online fold/validate ticks")
+    p.add_argument("--feed_dir", type=str, default=None,
+                   help="export every --feed_sample'th dispatched device "
+                        "batch under <feed_dir>/<model_id>/ for a "
+                        "tdc_tpu.cli.online sidecar (point its --feed_dir "
+                        "at the per-model subdirectory)")
+    p.add_argument("--feed_sample", type=int, default=1,
+                   help="feed-dir sampling stride (1 = every batch)")
+    from tdc_tpu.cli.online import add_config_flags
+
+    add_config_flags(p, prefix="online_")
     return p
 
 
@@ -132,8 +150,41 @@ def make_app(args):
         max_wait_ms=args.max_wait_ms,
         max_queue_rows=args.max_queue_rows,
         poll_interval=args.poll_interval,
+        feed_dir=getattr(args, "feed_dir", None),
+        feed_sample=getattr(args, "feed_sample", 1),
     )
     return app, log
+
+
+def _attach_online(app, args, pairs, log) -> None:
+    """--online=ID: build the in-process updater for a registered model.
+    Loud CLI-vocabulary failures: a typo'd id or a fuzzy/gmm model must
+    not silently serve without the promised update loop."""
+    from tdc_tpu.cli.online import config_from
+    from tdc_tpu.serve.online import OnlineUpdater
+
+    paths = dict(pairs)
+    if args.online not in paths:
+        raise SystemExit(
+            f"--online={args.online!r} is not a registered model id "
+            f"(have {sorted(paths)})"
+        )
+    try:
+        updater = OnlineUpdater(
+            paths[args.online],
+            model_id=args.online,
+            registry=app.registry,
+            config=config_from(
+                args, prefix="online_", tick_interval=args.online_tick
+            ),
+            log=log,
+        )
+    except ValueError as e:
+        raise SystemExit(f"--online: {e}") from None
+    app.attach_online(args.online, updater)
+    print(f"online updates on {args.online}: mode={updater.config.mode} "
+          f"live={updater.live_version} "
+          f"(pinned={updater.status()['pinned']})", flush=True)
 
 
 def main(argv=None) -> int:
@@ -145,6 +196,8 @@ def main(argv=None) -> int:
         entry = app.registry.add(mid, path, log=log)
         print(f"loaded {mid}: {entry.fitted.model} K={entry.fitted.k} "
               f"d={entry.fitted.d} version={entry.version}", flush=True)
+    if args.online:
+        _attach_online(app, args, pairs, log)
     buckets = [int(b) for b in args.warmup_buckets.split(",") if b]
     if buckets:  # '' really does skip warmup (engine.warmup defaults [])
         for mid, _ in pairs:

@@ -7,6 +7,7 @@ from tdc_tpu.models.streaming import (
     mean_combine_fit,
     streamed_fuzzy_fit,
     streamed_kmeans_fit,
+    streaming_fold,
 )
 from tdc_tpu.models.bisecting import bisecting_kmeans_fit
 from tdc_tpu.models.estimators import (
@@ -40,6 +41,7 @@ __all__ = [
     "mean_combine_fit",
     "streamed_kmeans_fit",
     "streamed_fuzzy_fit",
+    "streaming_fold",
     "KMeans",
     "BisectingKMeans",
     "bisecting_kmeans_fit",

@@ -38,6 +38,7 @@ def minibatch_step(
     state: MiniBatchState,
     batch: jax.Array,
     n_valid: jax.Array | None = None,
+    sample_weight: jax.Array | None = None,
     *,
     reassignment_ratio: float = 0.0,
     kernel: str = "xla",
@@ -50,6 +51,14 @@ def minibatch_step(
     batches are padded to the device multiple); the padding's exact
     contribution — argmin-‖c‖² cluster count and sse, zero Σx — is removed,
     the same correction as models/streaming.
+
+    sample_weight (when given, shape (rows,)) folds each row with its
+    weight: per-center lifetime counts become weight mass, a weight-w row
+    contributes exactly like w duplicated rows. Padding then carries ZERO
+    weight instead of the n_valid correction (zero-weight rows contribute
+    nothing to sums/mass/sse), the same contract as the weighted streamed
+    drivers — the serve/online fold path leans on this to fold sampled
+    request windows with per-batch confidence weights.
 
     reassignment_ratio > 0 enables sklearn MiniBatchKMeans' low-count-center
     reassignment (round-3 VERDICT weak #4: empty clusters were left dead —
@@ -73,7 +82,25 @@ def minibatch_step(
         # Same fail-fast as every other driver: an unknown value must not
         # silently run (and record) the XLA path under another label.
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
-    if kernel == "pallas":
+    if sample_weight is not None:
+        if kernel == "pallas" and mesh is not None:
+            raise ValueError(
+                "sample_weight with kernel='pallas' on a mesh is not "
+                "supported for mini-batch steps; use kernel='xla'"
+            )
+        if kernel == "pallas":
+            from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto_weighted
+
+            stats = lloyd_stats_auto_weighted(
+                batch, state.centroids, sample_weight
+            )
+        else:
+            from tdc_tpu.ops.assign import lloyd_stats_weighted
+
+            stats = lloyd_stats_weighted(
+                batch, state.centroids, sample_weight
+            )
+    elif kernel == "pallas":
         if mesh is not None:
             from tdc_tpu.parallel.collectives import distributed_lloyd_stats
 
@@ -86,7 +113,9 @@ def minibatch_step(
             stats = lloyd_stats_auto(batch, state.centroids)
     else:
         stats = lloyd_stats(batch, state.centroids)
-    if n_valid is not None:
+    # Zero-weight rows already contribute exactly nothing: the n_valid pad
+    # correction only applies to the unweighted path.
+    if n_valid is not None and sample_weight is None:
         n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(
             jnp.float32
         )
@@ -120,6 +149,10 @@ def minibatch_step(
             scores = jax.random.uniform(sub, (n,))
             if n_valid is not None:
                 scores = jnp.where(jnp.arange(n) < n_valid, scores, -jnp.inf)
+            if sample_weight is not None:
+                # Zero-weight rows (incl. weighted-path padding) must never
+                # seed a center: they are not data.
+                scores = jnp.where(sample_weight > 0, scores, -jnp.inf)
             cand = jnp.argsort(-scores)[:k]  # (k,) distinct row indices
             # A center only reassigns onto a REAL row (few valid rows in a
             # heavily-padded batch leave some candidates at -inf).
@@ -179,8 +212,28 @@ class MiniBatchKMeans:
             key=step_key,
         )
 
-    def partial_fit(self, batch) -> "MiniBatchKMeans":
+    def partial_fit(self, batch, sample_weight=None) -> "MiniBatchKMeans":
         self._ensure_init(jnp.asarray(batch) if self.mesh is None else batch)
+        if sample_weight is not None:
+            w = jnp.asarray(sample_weight, jnp.float32)
+            if self.mesh is not None:
+                # Zero-weight padding: weighted rows need no n_valid
+                # correction (see minibatch_step).
+                from tdc_tpu.models.streaming import _prepare_weighted_batch
+
+                xb, wb, _ = _prepare_weighted_batch(batch, w, self.mesh)
+                self._state = minibatch_step(
+                    self._state, xb, None, wb,
+                    reassignment_ratio=self.reassignment_ratio,
+                    kernel=self.kernel, mesh=self.mesh,
+                )
+            else:
+                self._state = minibatch_step(
+                    self._state, jnp.asarray(batch), None, w,
+                    reassignment_ratio=self.reassignment_ratio,
+                    kernel=self.kernel,
+                )
+            return self
         if self.mesh is not None:
             # Pad to the mesh multiple and shard; the step removes the
             # padding's exact contribution (zero rows -> argmin-‖c‖² cluster).
@@ -199,6 +252,64 @@ class MiniBatchKMeans:
                 kernel=self.kernel,
             )
         return self
+
+    @classmethod
+    def from_fitted(
+        cls,
+        fitted,
+        *,
+        counts=None,
+        prior_count: float = 0.0,
+        key=None,
+        mesh=None,
+        reassignment_ratio: float = 0.0,
+        kernel: str = "xla",
+    ) -> "MiniBatchKMeans":
+        """Resume mini-batch folding FROM a served model: a
+        models/persist.FittedModel (or a path load_fitted accepts) becomes
+        a live partial_fit state — the serve/online update loop's entry
+        point into this driver.
+
+        counts seeds the per-center lifetime counts (e.g. the persisted
+        fold state of a previous updater incarnation); without it every
+        center starts at `prior_count` pseudo-points, which sets how hard
+        the first folded batches can pull the published centroids
+        (rate ≈ batch_mass / (prior_count + batch_mass)). `key` is used
+        directly as the step PRNG key (reassignment stream)."""
+        if isinstance(fitted, str):
+            from tdc_tpu.models.persist import load_fitted
+
+            fitted = load_fitted(fitted)
+        if fitted.model != "kmeans":
+            raise ValueError(
+                f"MiniBatchKMeans.from_fitted needs a kmeans model, got "
+                f"{fitted.model!r} (fuzzy/gmm parameters are not fit under "
+                "the hard-assignment mini-batch objective)"
+            )
+        c0 = jnp.asarray(fitted.arrays["centroids"], jnp.float32)
+        k, d = int(c0.shape[0]), int(c0.shape[-1])
+        mbk = cls(k, d, init=c0, key=key, mesh=mesh,
+                  reassignment_ratio=reassignment_ratio, kernel=kernel)
+        if mesh is not None:
+            from tdc_tpu.parallel import mesh as mesh_lib
+
+            c0 = mesh_lib.replicate(c0, mesh)
+        if counts is None:
+            counts = jnp.full((k,), float(prior_count), jnp.float32)
+        else:
+            counts = jnp.asarray(counts, jnp.float32)
+            if counts.shape != (k,):
+                raise ValueError(
+                    f"counts shape {counts.shape} != ({k},)"
+                )
+        mbk._state = MiniBatchState(
+            centroids=c0,
+            counts=counts,
+            step=jnp.asarray(0, jnp.int32),
+            last_sse=jnp.asarray(jnp.inf, jnp.float32),
+            key=key if key is not None else jax.random.PRNGKey(0),
+        )
+        return mbk
 
     @property
     def centroids(self) -> jax.Array:

@@ -93,6 +93,40 @@ def _result_to_payload(result) -> tuple[str, dict, dict]:
     )
 
 
+def stage_arrays(model_dir: str, arrays: dict[str, np.ndarray]) -> str:
+    """Write the arrays file for `arrays` WITHOUT touching the manifest;
+    returns the content-hash version. Idempotent (the file is content-
+    addressed). This is the first half of a publish: until save_fitted
+    swaps the manifest, readers cannot load the staged version — a
+    publisher that dies between the two leaves the previous model fully
+    live and nothing half-readable (the serve/online crash-mid-swap
+    contract)."""
+    version = _arrays_version(arrays)
+    os.makedirs(model_dir, exist_ok=True)
+    arrays_path = os.path.join(model_dir, f"arrays-{version}.npz")
+    if not os.path.exists(arrays_path):
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        tmp = arrays_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+        os.replace(tmp, arrays_path)
+    return version
+
+
+def list_array_versions(model_dir: str) -> list[str]:
+    """Content-hash versions with an arrays file currently on disk."""
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        return []
+    return sorted(
+        n[len("arrays-"):-len(".npz")]
+        for n in names
+        if n.startswith("arrays-") and n.endswith(".npz")
+    )
+
+
 def save_fitted(
     model_dir: str,
     result=None,
@@ -102,6 +136,7 @@ def save_fitted(
     kernel: str = "auto",
     params: dict | None = None,
     keep_versions: int = 2,
+    pinned_versions=(),
 ) -> str:
     """Persist a fitted model; returns its content-hash version.
 
@@ -110,6 +145,11 @@ def save_fitted(
     hot-reload publish path: arrays land first, the manifest swap is
     atomic, and the previous `keep_versions` arrays files are retained so
     a reader mid-load of the old manifest never sees its arrays vanish.
+
+    pinned_versions: content-hash versions whose arrays files must
+    survive retention regardless of age — the serve/online updater pins
+    the live and last-good generations so an eviction sweep can never
+    race a rollback out of its target.
     """
     if result is not None:
         model, arr, auto_params = _result_to_payload(result)
@@ -128,18 +168,8 @@ def save_fitted(
 
     first = arr[_MODEL_ARRAYS[model][0]]
     k, d = int(first.shape[0]), int(first.shape[-1])
-    version = _arrays_version(arr)
-
-    os.makedirs(model_dir, exist_ok=True)
+    version = stage_arrays(model_dir, arr)
     arrays_name = f"arrays-{version}.npz"
-    arrays_path = os.path.join(model_dir, arrays_name)
-    if not os.path.exists(arrays_path):
-        buf = io.BytesIO()
-        np.savez(buf, **arr)
-        tmp = arrays_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(buf.getvalue())
-        os.replace(tmp, arrays_path)
 
     manifest = {
         "format_version": _FORMAT_VERSION,
@@ -157,15 +187,20 @@ def save_fitted(
         json.dump(manifest, f, indent=1, sort_keys=True)
     os.replace(tmp, os.path.join(model_dir, MANIFEST_NAME))
 
-    _prune_old_arrays(model_dir, keep=keep_versions, current=arrays_name)
+    _prune_old_arrays(model_dir, keep=keep_versions, current=arrays_name,
+                      pinned=pinned_versions)
     return version
 
 
-def _prune_old_arrays(model_dir: str, keep: int, current: str) -> None:
+def _prune_old_arrays(
+    model_dir: str, keep: int, current: str, pinned=()
+) -> None:
+    protect = {current} | {f"arrays-{v}.npz" for v in pinned}
     old = sorted(
         (os.path.getmtime(os.path.join(model_dir, n)), n)
         for n in os.listdir(model_dir)
-        if n.startswith("arrays-") and n.endswith(".npz") and n != current
+        if n.startswith("arrays-") and n.endswith(".npz")
+        and n not in protect
     )
     for _, name in old[: max(len(old) - (keep - 1), 0)]:
         try:

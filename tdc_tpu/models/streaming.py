@@ -466,6 +466,57 @@ def _accumulate_weighted(
     )
 
 
+@jax.jit
+def streaming_fold(
+    centroids: jax.Array,
+    counts: jax.Array,
+    batch: jax.Array,
+    n_valid: jax.Array | None = None,
+    sample_weight: jax.Array | None = None,
+    decay=1.0,
+):
+    """One exact sufficient-stats fold of `batch` into a running
+    (centroids, counts) state with exponential forgetting — the streamed
+    drivers' accumulate-then-update collapsed to a single incremental
+    step, the partial-update entry point the serve/online loop folds
+    sampled request traffic through.
+
+    decay=1.0 is the lifetime running average (algebraically the Sculley
+    mini-batch update without reassignment); decay<1 down-weights history
+    by `decay` per fold so the model tracks drifting traffic with an
+    effective memory of ~1/(1-decay) folds. Empty clusters keep their
+    centroid (zero mass moves nothing). n_valid marks zero-padded rows
+    (same exact correction as the streamed drivers); with sample_weight,
+    padding must carry zero weight instead and counts are weight mass.
+
+    Returns (new_centroids, new_counts, window_sse) — window_sse is the
+    batch's assignment SSE against the PRE-fold centroids, the
+    inertia-per-window drift signal exported on /metrics."""
+    c = centroids.astype(jnp.float32)
+    if sample_weight is not None:
+        from tdc_tpu.ops.assign import lloyd_stats_weighted
+
+        s = lloyd_stats_weighted(batch, c, sample_weight)
+        bcounts, bsums, bsse = s.counts, s.sums, s.sse
+    else:
+        s = lloyd_stats(batch, c)
+        bcounts, bsums, bsse = s.counts, s.sums, s.sse
+        if n_valid is not None:
+            from tdc_tpu.parallel.sharded_k import padding_correction
+
+            n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(
+                jnp.float32
+            )
+            bcounts, bsse = padding_correction(bcounts, bsse, c, n_pad)
+    prior = counts.astype(jnp.float32) * jnp.asarray(decay, jnp.float32)
+    new_counts = prior + bcounts
+    new_c = (prior[:, None] * c + bsums) / jnp.maximum(
+        new_counts, 1e-12
+    )[:, None]
+    new_c = jnp.where(new_counts[:, None] > 0, new_c, c)
+    return new_c, new_counts, bsse
+
+
 @partial(jax.jit, static_argnames=("m", "mesh"))
 def _accumulate_fuzzy_weighted(acc, batch, w, centroids, m: float, mesh=None):
     from tdc_tpu.ops.assign import fuzzy_stats_weighted

@@ -56,6 +56,10 @@ class MicroBatcher:
       before the batch is dispatched anyway (the latency the throughput
       is bought with).
     max_queue_rows: bounded-queue backpressure threshold over ALL queues.
+    tap: optional callable (model_id, method, x) invoked with each
+      coalesced device batch as it dispatches — the serve/online traffic
+      sample. Tap errors are swallowed (logged): observation must never
+      fail serving.
     """
 
     def __init__(
@@ -67,6 +71,7 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         max_queue_rows: int = 65536,
         log=None,
+        tap=None,
     ):
         if max_batch_rows > engine.max_bucket:
             raise ValueError(
@@ -79,6 +84,7 @@ class MicroBatcher:
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue_rows = int(max_queue_rows)
         self.log = log
+        self.tap = tap
         # key = (model_id, method, generation) -> FIFO of requests
         self._pending: dict[tuple, collections.deque[_Request]] = {}
         self._arrival = asyncio.Event()
@@ -182,6 +188,16 @@ class MicroBatcher:
         self._pending.clear()
         self._queued_rows = 0
 
+    def _run_tap(self, model_id: str, method: str, x) -> None:
+        try:
+            self.tap(model_id, method, x)
+        except Exception as te:  # observation never fails serving
+            if self.log is not None:
+                self.log.event(
+                    "tap_error", model=model_id,
+                    error=f"{type(te).__name__}: {te}",
+                )
+
     def _oldest_key(self) -> tuple:
         return min(
             self._pending, key=lambda k: self._pending[k][0].enqueued_at
@@ -235,6 +251,14 @@ class MicroBatcher:
                     head.x if len(batch) == 1
                     else np.concatenate([r.x for r in batch])
                 )
+                if self.tap is not None:
+                    # Off-loop: the tap does host work (screening, ledger
+                    # / feed-file writes) that must never stall dispatch
+                    # — a flood of quarantinable batches would otherwise
+                    # add per-batch disk I/O to every model's hot path.
+                    loop.run_in_executor(
+                        None, self._run_tap, head.model_id, head.method, x
+                    )
                 # The device call blocks; run it off-loop so new submits
                 # keep queueing (they form the next batch) while the
                 # current batch computes.

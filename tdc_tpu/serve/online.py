@@ -1,0 +1,771 @@
+"""Online model updates: close the fit→serve loop without ever letting a
+bad update reach the hot path.
+
+`tdc_tpu/serve/` predicts from frozen fitted models while production
+traffic drifts; the paper's own minibatch/streaming update rules
+(models/minibatch.minibatch_step, models/streaming.streaming_fold) are
+exactly the fold operation an online path needs. But a serving fleet that
+rewrites its own models is a new failure surface, so every update goes
+through a guarded rollout pipeline:
+
+1. **Health screen** (`observe`): every sampled request batch is checked
+   for NaN/Inf and row-norm blowup against the traffic the model has
+   already seen. A failing batch is QUARANTINED — counted, logged, never
+   folded. A fold whose result is non-finite is discarded the same way.
+2. **Holdback window**: a random slice of every healthy batch is held
+   back from folding into a sliding shadow-validation window, so the
+   candidate is always judged on traffic it did not train on.
+3. **Shadow validation** (`online.validate`): the fold candidate must
+   beat the live generation's inertia-per-point on the holdback window
+   (within `max_inertia_ratio`), keep assignment churn under
+   `max_churn`, and not collapse cluster-size entropy below
+   `min_entropy_ratio` of the live generation's. A rejected candidate is
+   rolled back in memory — the live model is untouched.
+4. **Atomic publish** (`online.swap`): arrays are content-addressed and
+   staged first (persist.stage_arrays), then the manifest swap publishes
+   them (persist.save_fitted, atomic os.replace) — a crash anywhere in
+   between leaves the previous generation fully live and nothing
+   half-readable. The serving registry picks the swap up via its normal
+   hot-reload poll. Retention keeps `keep_generations` arrays files with
+   the live AND last-good generations pinned against eviction.
+5. **Post-swap monitoring + automatic rollback** (`online.rollback`):
+   after a publish, every tick re-scores the live generation AGAINST the
+   last-good generation on the current holdback window; if live is worse
+   by `rollback_inertia_ratio`, the last-good generation is republished
+   (its content hash is unchanged, so the swap is exactly "point the
+   manifest back"). `pin()` freezes the loop for operators.
+
+All updater state (generation ledger, fold counts, counters) lives in
+the model dir next to the manifest — atomic-replace JSON/npz — so a
+killed updater relaunches into a consistent view: the manifest is the
+source of truth for what is live, the ledger for what was last good.
+
+Two deployments share this class: the in-process tap (ServeApp wires the
+micro-batcher's dispatch tap into `observe`, a loop task calls `tick`)
+and a sidecar process (cli/online) that drains sampled batches from a
+feed directory and publishes into the same model dir the server polls.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+
+import numpy as np
+
+from tdc_tpu.models.persist import (
+    list_array_versions,
+    load_fitted,
+    save_fitted,
+    stage_arrays,
+)
+from tdc_tpu.testing.faults import fault_point
+from tdc_tpu.utils.structlog import emit
+
+LEDGER_NAME = "online.json"
+FOLD_STATE_NAME = "online_state.npz"
+_LEDGER_FORMAT = 1
+
+
+@dataclass
+class OnlineConfig:
+    """Thresholds and cadence for the guarded online-update pipeline.
+    Defaults are deliberately conservative: a candidate must be close to
+    live quality to publish, and live must be clearly worse than
+    last-good to auto-roll-back (docs/OPERATIONS.md "Online updates &
+    rollback" discusses tuning)."""
+
+    mode: str = "minibatch"  # 'minibatch' (Sculley) | 'streaming' (decayed)
+    decay: float = 1.0  # streaming-mode forgetting per fold (1.0 = none)
+    prior_count: float = 256.0  # pseudo-points seeding each center's mass
+    min_fold_rows: int = 256  # pending rows before a fold is attempted
+    fold_batch_rows: int = 256  # fixed device-batch shape (one jit trace)
+    holdback_fraction: float = 0.125  # share of each batch held for shadow
+    holdback_rows: int = 512  # sliding shadow-validation window size
+    min_holdback_rows: int = 64  # evidence floor before any publish
+    max_pending_rows: int = 0  # fold-buffer cap (0 = 8 x min_fold_rows)
+    max_inertia_ratio: float = 1.05  # candidate vs live inertia ceiling
+    max_churn: float = 0.5  # candidate vs live label-change ceiling
+    min_entropy_ratio: float = 0.5  # candidate vs live size-entropy floor
+    rollback_inertia_ratio: float = 1.2  # live vs last-good ceiling
+    outlier_norm_factor: float = 10.0  # batch vs seen median-norm screen
+    keep_generations: int = 4  # arrays versions retained (live+good pinned)
+    tick_interval: float = 5.0  # in-process loop cadence (seconds)
+    seed: int = 0  # holdback-sampling PRNG seed
+
+
+@dataclass
+class _Quality:
+    inertia: float  # mean min-distance² per point
+    entropy: float  # cluster-size entropy (nats) of the assignment
+    labels: np.ndarray = field(repr=False, default=None)
+
+
+def _atomic_json(path: str, obj: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+class OnlineUpdater:
+    """The guarded fit→serve fold loop for ONE kmeans model dir.
+
+    Thread-safe: `observe` may be called from the serving loop while
+    `tick` runs on a worker thread; both take the instance lock around
+    state mutation (device folds happen outside it).
+    """
+
+    def __init__(self, model_dir: str, *, model_id: str | None = None,
+                 registry=None, config: OnlineConfig | None = None,
+                 log=None):
+        self.model_dir = str(model_dir)
+        self.model_id = model_id or os.path.basename(
+            os.path.normpath(self.model_dir)
+        )
+        self.registry = registry
+        self.config = config or OnlineConfig()
+        self.log = log
+        if self.config.mode not in ("minibatch", "streaming"):
+            raise ValueError(
+                f"unknown online fold mode {self.config.mode!r} "
+                "(use 'minibatch' or 'streaming')"
+            )
+        if self.config.keep_generations < 2:
+            # live + last-good are pinned anyway; fewer than 2 would make
+            # retention fight the pins every publish.
+            raise ValueError("keep_generations must be >= 2")
+        self._lock = threading.Lock()
+        # Serializes the pipeline operations that touch the model dir
+        # (tick's publish, rollback, pin) against each other: an admin
+        # rollback from an HTTP handler thread must not interleave its
+        # manifest/ledger writes with a tick publishing on the loop's
+        # executor thread. Reentrant: tick's sentinel calls rollback().
+        self._op_lock = threading.RLock()
+        self._rng = np.random.default_rng(self.config.seed)
+        self._pending: list[np.ndarray] = []
+        self._pending_rows = 0
+        self._holdback: list[np.ndarray] = []  # sliding window, row chunks
+        self._holdback_rows = 0
+        self._seen_norm_median: float | None = None
+        self.counters = {
+            "observed_batches": 0,
+            "quarantined_batches": 0,
+            "folds": 0,
+            "publishes": 0,
+            "rejects": 0,
+            "rollbacks": 0,
+        }
+        self.last_validation: dict | None = None
+        self._load_live()
+        self._recover_ledger()
+
+    # ---------------- persistence / recovery ----------------
+
+    def _load_live(self) -> None:
+        from tdc_tpu.models.persist import MANIFEST_NAME
+
+        if not os.path.exists(os.path.join(self.model_dir, MANIFEST_NAME)):
+            # Raw checkpoint dirs have no content-hash manifest; the
+            # publish/rollback machinery is built on one.
+            raise ValueError(
+                f"{self.model_dir} is not a save_fitted model dir (no "
+                "manifest); online updates need the content-addressed "
+                "publish path"
+            )
+        fitted = load_fitted(self.model_dir)
+        if fitted.model != "kmeans":
+            raise ValueError(
+                f"online updates need a kmeans model, {self.model_dir} "
+                f"holds {fitted.model!r} — fuzzy/gmm parameters are not "
+                "fit under the hard-assignment fold objective"
+            )
+        self.fitted = fitted
+        self.live_version = fitted.version
+        self.live_centroids = np.asarray(
+            fitted.arrays["centroids"], np.float32
+        )
+        self.k, self.d = fitted.k, fitted.d
+
+    def _ledger_path(self) -> str:
+        return os.path.join(self.model_dir, LEDGER_NAME)
+
+    def _recover_ledger(self) -> None:
+        """Reconcile the ledger with the manifest. The manifest is the
+        source of truth for LIVE (its swap is the publish); the ledger for
+        LAST-GOOD and the counters. A crash between the two (the
+        online.swap window) leaves ledger.live == the previous manifest
+        version, which is exactly the last-good of the new live."""
+        self.pinned = False
+        self.generation = 0
+        self.last_good_version: str | None = None
+        adopted = False
+        led = None
+        try:
+            with open(self._ledger_path()) as f:
+                led = json.load(f)
+        except (OSError, ValueError):
+            led = None
+        if led is not None:
+            self.generation = int(led.get("generation", 0))
+            self.pinned = bool(led.get("pinned", False))
+            for key, val in led.get("counters", {}).items():
+                if key in self.counters:
+                    self.counters[key] = int(val)
+            on_disk = set(list_array_versions(self.model_dir))
+            ledger_live = led.get("live")
+            last_good = led.get("last_good")
+            if ledger_live == self.live_version:
+                if last_good in on_disk:
+                    self.last_good_version = last_good
+            elif ledger_live in on_disk:
+                # Crash after the manifest swap, before the ledger write:
+                # the previous live IS the new last-good.
+                self.last_good_version = ledger_live
+                self.generation += 1
+                adopted = True
+                self._emit("online_recover",
+                           adopted_live=self.live_version,
+                           last_good=self.last_good_version)
+        self._fold_state = self._load_fold_state()
+        # Only write when construction actually changed the picture: a
+        # read-only consumer (the --status verb, a metrics scrape helper)
+        # must not race a live sidecar's ledger writes with a rewrite of
+        # its own just-loaded snapshot.
+        if led is None or adopted:
+            self._write_ledger()
+
+    def _load_fold_state(self):
+        """(counts, step) for the live version, or a fresh prior state.
+        The state file records which version it belongs to: folding a
+        rolled-back model with the bad generation's mass would let the
+        bad fold keep steering."""
+        path = os.path.join(self.model_dir, FOLD_STATE_NAME)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                if str(z["version"]) == self.live_version:
+                    return (np.asarray(z["counts"], np.float32),
+                            int(z["step"]))
+        except (OSError, ValueError, KeyError):
+            pass
+        return (np.full((self.k,), self.config.prior_count, np.float32), 0)
+
+    def _write_fold_state(self) -> None:
+        counts, step = self._fold_state
+        path = os.path.join(self.model_dir, FOLD_STATE_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, version=self.live_version, counts=counts, step=step)
+        os.replace(tmp, path)
+
+    def _write_ledger(self) -> None:
+        _atomic_json(self._ledger_path(), {
+            "format": _LEDGER_FORMAT,
+            "model_id": self.model_id,
+            "live": self.live_version,
+            "last_good": self.last_good_version,
+            "generation": self.generation,
+            "pinned": self.pinned,
+            "counters": dict(self.counters),
+            "config": asdict(self.config),
+            "updated_at": round(time.time(), 3),
+        })
+
+    def _emit(self, event: str, **fields) -> None:
+        # Every caller passes a string LITERAL (grep `self._emit("` for the
+        # inventory); this helper only fans one literal out to the RunLog
+        # vs stderr transport, hence the TDC006 suppressions.
+        if self.log is not None:
+            self.log.event(event, model=self.model_id, **fields)  # tdclint: disable=TDC006 literal at call sites
+        else:
+            emit(event, model=self.model_id, **fields)  # tdclint: disable=TDC006 literal at call sites
+
+    # ---------------- ingest: screen + holdback ----------------
+
+    def observe(self, x) -> bool:
+        """Screen one sampled request batch; returns True when accepted.
+        Quarantined batches are counted and never folded. Accepted rows
+        are split between the holdback window (shadow validation) and the
+        pending fold buffer."""
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[-1] != self.d or x.shape[0] == 0:
+            self._quarantine("bad_shape", x.shape)
+            return False
+        if not np.isfinite(x).all():
+            self._quarantine("nonfinite", x.shape)
+            return False
+        norms = np.linalg.norm(x, axis=-1)
+        med = float(np.median(norms))
+        with self._lock:
+            seen = self._seen_norm_median
+        if seen is not None and seen > 0 and (
+            med > self.config.outlier_norm_factor * seen
+        ):
+            self._quarantine("norm_outlier", x.shape, median_norm=med,
+                             seen_median_norm=seen)
+            return False
+        with self._lock:
+            self.counters["observed_batches"] += 1
+            self._seen_norm_median = (
+                med if seen is None else 0.9 * seen + 0.1 * med
+            )
+            hold = self._rng.random(x.shape[0]) < self.config.holdback_fraction
+            if not hold.any() and x.shape[0] > 1:
+                hold[int(self._rng.integers(x.shape[0]))] = True
+            held, rest = x[hold], x[~hold]
+            if held.shape[0]:
+                self._holdback.append(held)
+                self._holdback_rows += held.shape[0]
+                while (self._holdback_rows - self._holdback[0].shape[0]
+                       >= self.config.holdback_rows):
+                    self._holdback_rows -= self._holdback[0].shape[0]
+                    self._holdback.pop(0)
+            if rest.shape[0]:
+                self._pending.append(rest)
+                self._pending_rows += rest.shape[0]
+                # Bound the fold buffer: a pinned (or slow-ticking)
+                # updater under steady traffic must not grow RAM without
+                # limit. Drop the OLDEST batches — for a drift-tracking
+                # fold the freshest window is the one worth keeping.
+                cap = (self.config.max_pending_rows
+                       or 8 * self.config.min_fold_rows)
+                while self._pending and self._pending_rows > cap:
+                    self._pending_rows -= self._pending.pop(0).shape[0]
+        return True
+
+    def _quarantine(self, reason: str, shape, **fields) -> None:
+        with self._lock:
+            self.counters["quarantined_batches"] += 1
+        self._emit("online_quarantine", reason=reason,
+                   rows=int(shape[0]) if len(shape) else 0, **fields)
+        self._write_ledger()
+
+    # ---------------- quality scoring ----------------
+
+    def _quality(self, centroids: np.ndarray, x: np.ndarray) -> _Quality:
+        """Inertia-per-point + assignment + cluster-size entropy of `x`
+        under `centroids` — matmul-form distances (no (W,K,d) broadcast),
+        host-side: the holdback window is small by construction."""
+        c = np.asarray(centroids, np.float32)
+        d2 = (
+            (x * x).sum(-1, keepdims=True)
+            - 2.0 * (x @ c.T)
+            + (c * c).sum(-1)[None, :]
+        )
+        labels = np.argmin(d2, axis=1)
+        inertia = float(np.maximum(d2[np.arange(x.shape[0]), labels], 0).mean())
+        sizes = np.bincount(labels, minlength=c.shape[0]).astype(np.float64)
+        p = sizes[sizes > 0] / sizes.sum()
+        entropy = float(-(p * np.log(p)).sum())
+        return _Quality(inertia=inertia, entropy=entropy, labels=labels)
+
+    def _holdback_matrix(self) -> np.ndarray | None:
+        with self._lock:
+            if self._holdback_rows < self.config.min_holdback_rows:
+                return None
+            return np.concatenate(self._holdback, axis=0)
+
+    # ---------------- fold / validate / publish ----------------
+
+    def _fold_candidate(self, batches: list[np.ndarray]):
+        """Fold `batches` into a candidate (centroids, counts, window_sse)
+        starting from the live generation. Every device batch is padded to
+        the fixed fold_batch_rows shape (zero rows + n_valid / zero
+        weight), so arbitrary traffic shapes cost ONE jit trace."""
+        import jax.numpy as jnp
+
+        from tdc_tpu.models.minibatch import MiniBatchState, minibatch_step
+        from tdc_tpu.models.streaming import streaming_fold
+
+        counts0, step0 = self._fold_state
+        rows = np.concatenate(batches, axis=0)
+        bs = int(self.config.fold_batch_rows)
+        window_sse = 0.0
+        if self.config.mode == "minibatch":
+            state = MiniBatchState(
+                centroids=jnp.asarray(self.live_centroids),
+                counts=jnp.asarray(counts0),
+                step=jnp.asarray(step0, jnp.int32),
+                last_sse=jnp.asarray(jnp.inf, jnp.float32),
+                key=None,
+            )
+            for lo in range(0, rows.shape[0], bs):
+                chunk = rows[lo:lo + bs]
+                n_valid = chunk.shape[0]
+                if n_valid < bs:
+                    chunk = np.pad(chunk, ((0, bs - n_valid), (0, 0)))
+                state = minibatch_step(
+                    state, jnp.asarray(chunk),
+                    jnp.asarray(n_valid, jnp.int32),
+                )
+                window_sse += float(state.last_sse)
+            return (np.asarray(state.centroids), np.asarray(state.counts),
+                    int(state.step), window_sse)
+        c = jnp.asarray(self.live_centroids)
+        counts = jnp.asarray(counts0)
+        for lo in range(0, rows.shape[0], bs):
+            chunk = rows[lo:lo + bs]
+            n_valid = chunk.shape[0]
+            if n_valid < bs:
+                chunk = np.pad(chunk, ((0, bs - n_valid), (0, 0)))
+            c, counts, sse = streaming_fold(
+                c, counts, jnp.asarray(chunk),
+                jnp.asarray(n_valid, jnp.int32),
+                decay=self.config.decay,
+            )
+            window_sse += float(sse)
+        n_folds = step0 + math.ceil(rows.shape[0] / bs)
+        return np.asarray(c), np.asarray(counts), n_folds, window_sse
+
+    def tick(self) -> dict:
+        """One pipeline turn. The post-swap rollback sentinel runs FIRST:
+        a live generation that regresses against last-good on current
+        traffic must be rolled back before any new fold builds on its
+        centroids. Then, if enough pending traffic has accumulated:
+        fold, shadow-validate, publish. Returns a status summary (what
+        the admin surface reports)."""
+        with self._op_lock:
+            outcome = "idle"
+            hb = self._holdback_matrix()
+            if hb is not None and self._rollback_check(hb):
+                # the rollback dropped the pending window: nothing to fold
+                return {"outcome": "rollback", **self.status()}
+            with self._lock:
+                ready = (self._pending_rows >= self.config.min_fold_rows
+                         and not self.pinned)
+                batches, n_rows = self._pending, self._pending_rows
+                if ready and hb is not None:
+                    self._pending, self._pending_rows = [], 0
+            if ready and hb is not None:
+                outcome = self._fold_validate_publish(batches, n_rows, hb)
+            return {"outcome": outcome, **self.status()}
+
+    def _fold_validate_publish(self, batches, n_rows: int, hb) -> str:
+        fault_point("online.fold")
+        cand, counts, step, window_sse = self._fold_candidate(batches)
+        with self._lock:
+            self.counters["folds"] += 1
+        if not np.isfinite(cand).all():
+            # A poisoned fold that slipped the per-batch screen (or a
+            # degenerate update): discard the whole window, keep live.
+            self._quarantine("nonfinite_fold", (n_rows,))
+            self._emit("online_fold_discarded", rows=n_rows)
+            return "discarded"
+        fault_point("online.validate")
+        live_q = self._quality(self.live_centroids, hb)
+        cand_q = self._quality(cand, hb)
+        churn = float((live_q.labels != cand_q.labels).mean())
+        checks = {
+            "inertia": cand_q.inertia
+            <= live_q.inertia * self.config.max_inertia_ratio,
+            "churn": churn <= self.config.max_churn,
+            "entropy": cand_q.entropy
+            >= live_q.entropy * self.config.min_entropy_ratio,
+        }
+        self.last_validation = {
+            "live_inertia": live_q.inertia,
+            "candidate_inertia": cand_q.inertia,
+            "window_sse_per_row": window_sse / max(n_rows, 1),
+            "churn": churn,
+            "live_entropy": live_q.entropy,
+            "candidate_entropy": cand_q.entropy,
+            "holdback_rows": int(hb.shape[0]),
+            "fold_rows": n_rows,
+            "accepted": all(checks.values()),
+            "failed": sorted(k for k, ok in checks.items() if not ok),
+        }
+        self._emit("online_validate", **self.last_validation)
+        if not all(checks.values()):
+            with self._lock:
+                self.counters["rejects"] += 1
+            self._write_ledger()
+            return "rejected"
+        self._publish(cand, counts, step)
+        return "published"
+
+    def _publish(self, centroids: np.ndarray, counts: np.ndarray,
+                 step: int) -> None:
+        """Stage arrays → online.swap → manifest swap → ledger. A crash at
+        the fault point leaves the staged (content-addressed, unreferenced)
+        arrays on disk and the old manifest live — nothing half-readable."""
+        arrays = {"centroids": np.asarray(centroids, np.float32)}
+        stage_arrays(self.model_dir, arrays)
+        fault_point("online.swap")
+        pinned = {self.live_version}
+        if self.last_good_version:
+            pinned.add(self.last_good_version)
+        version = save_fitted(
+            self.model_dir, None, model="kmeans", arrays=arrays,
+            kernel=self.fitted.kernel, params=self.fitted.params,
+            keep_versions=self.config.keep_generations,
+            pinned_versions=pinned,
+        )
+        with self._lock:
+            self.last_good_version = self.live_version
+            self.live_version = version
+            self.live_centroids = arrays["centroids"]
+            self.generation += 1
+            self.counters["publishes"] += 1
+            self._fold_state = (np.asarray(counts, np.float32), int(step))
+        self._write_fold_state()
+        self._write_ledger()
+        self._emit("online_publish", version=version,
+                   last_good=self.last_good_version,
+                   generation=self.generation)
+        if self.registry is not None:
+            self.registry.poll_once(log=self.log)
+
+    # ---------------- rollback ----------------
+
+    def _rollback_check(self, hb) -> bool:
+        """Post-swap monitor: live vs LAST-GOOD on the current holdback
+        window — validation at publish time used the traffic of that
+        moment; this catches the generation that regresses on what users
+        send NOW."""
+        with self._lock:
+            last_good = self.last_good_version
+            pinned = self.pinned
+        if pinned or not last_good or last_good == self.live_version:
+            return False
+        good_c = self._version_centroids(last_good)
+        if good_c is None:
+            return False
+        live_q = self._quality(self.live_centroids, hb)
+        good_q = self._quality(good_c, hb)
+        self.last_validation = {
+            **(self.last_validation or {}),
+            "live_inertia": live_q.inertia,
+            "last_good_inertia": good_q.inertia,
+        }
+        if live_q.inertia <= (
+            good_q.inertia * self.config.rollback_inertia_ratio
+        ):
+            return False
+        self.rollback(
+            reason=f"live inertia {live_q.inertia:.4g} > "
+                   f"{self.config.rollback_inertia_ratio} x last-good "
+                   f"{good_q.inertia:.4g} on {hb.shape[0]} holdback rows"
+        )
+        return True
+
+    def _version_centroids(self, version: str) -> np.ndarray | None:
+        path = os.path.join(self.model_dir, f"arrays-{version}.npz")
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                return np.asarray(z["centroids"], np.float32)
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def rollback(self, reason: str = "manual") -> str:
+        """Republish the last-good generation (content hash unchanged —
+        the manifest swings back to arrays already on disk). Discards the
+        pending fold window and the folded mass: they steered the bad
+        generation. Returns the version rolled back to. Serialized
+        against a concurrent tick publish via the op lock (an admin
+        rollback can land from any HTTP handler thread)."""
+        with self._op_lock:
+            return self._rollback_inner(reason)
+
+    def _rollback_inner(self, reason: str) -> str:
+        with self._lock:
+            last_good = self.last_good_version
+        if not last_good or last_good == self.live_version:
+            raise ValueError(
+                f"no last-good generation to roll {self.model_id!r} back "
+                "to (nothing was published, or already rolled back)"
+            )
+        good_c = self._version_centroids(last_good)
+        if good_c is None:
+            raise ValueError(
+                f"last-good arrays for {last_good} are gone from "
+                f"{self.model_dir} — retention should have pinned them"
+            )
+        fault_point("online.rollback")
+        bad = self.live_version
+        save_fitted(
+            self.model_dir, None, model="kmeans",
+            arrays={"centroids": good_c},
+            kernel=self.fitted.kernel, params=self.fitted.params,
+            keep_versions=self.config.keep_generations,
+            pinned_versions={last_good, bad},
+        )
+        with self._lock:
+            self.live_version = last_good
+            self.live_centroids = good_c
+            self.generation += 1
+            self.counters["rollbacks"] += 1
+            self._pending, self._pending_rows = [], 0
+            self._fold_state = (
+                np.full((self.k,), self.config.prior_count, np.float32), 0
+            )
+        self._write_fold_state()
+        self._write_ledger()
+        self._emit("online_rollback", to_version=last_good,
+                   from_version=bad, reason=reason,
+                   generation=self.generation)
+        if self.registry is not None:
+            self.registry.poll_once(log=self.log)
+        return last_good
+
+    def pin(self) -> None:
+        """Freeze the loop: no folds publish, no auto-rollback fires.
+        Observation (screen/holdback/metrics) continues, with the fold
+        buffer bounded at max_pending_rows (oldest dropped)."""
+        with self._op_lock:
+            with self._lock:
+                self.pinned = True
+            self._write_ledger()
+        self._emit("online_pin", pinned=True)
+
+    def unpin(self) -> None:
+        with self._op_lock:
+            with self._lock:
+                self.pinned = False
+            self._write_ledger()
+        self._emit("online_pin", pinned=False)
+
+    # ---------------- introspection ----------------
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "model": self.model_id,
+                "model_dir": self.model_dir,
+                "mode": self.config.mode,
+                "live_version": self.live_version,
+                "last_good_version": self.last_good_version,
+                "generation": self.generation,
+                "pinned": self.pinned,
+                "pending_rows": self._pending_rows,
+                "holdback_rows": self._holdback_rows,
+                "counters": dict(self.counters),
+                "last_validation": self.last_validation,
+            }
+
+    def metrics(self) -> dict:
+        """Flat name->value gauges/counters for /metrics exposition."""
+        with self._lock:
+            out = {
+                "tdc_online_quarantined_batches_total":
+                    self.counters["quarantined_batches"],
+                "tdc_online_observed_batches_total":
+                    self.counters["observed_batches"],
+                "tdc_online_folds_total": self.counters["folds"],
+                "tdc_online_publishes_total": self.counters["publishes"],
+                "tdc_online_rejected_candidates_total":
+                    self.counters["rejects"],
+                "tdc_online_rollbacks_total": self.counters["rollbacks"],
+                "tdc_online_pending_rows": self._pending_rows,
+                "tdc_online_holdback_rows": self._holdback_rows,
+                "tdc_online_pinned": int(self.pinned),
+            }
+        lv = self.last_validation or {}
+        for key, name in (
+            ("live_inertia", "tdc_online_live_inertia_per_point"),
+            ("candidate_inertia", "tdc_online_candidate_inertia_per_point"),
+            ("window_sse_per_row", "tdc_online_window_sse_per_row"),
+            ("churn", "tdc_online_assignment_churn"),
+        ):
+            if key in lv:
+                out[name] = round(float(lv[key]), 6)
+        return out
+
+
+def ledger_metrics(model_dir: str) -> dict | None:
+    """The sidecar-visibility half of the /metrics story: a server whose
+    updater runs in ANOTHER process still exports that updater's counters
+    by reading the ledger it publishes next to the manifest."""
+    try:
+        with open(os.path.join(model_dir, LEDGER_NAME)) as f:
+            led = json.load(f)
+    except (OSError, ValueError):
+        return None
+    counters = led.get("counters", {})
+    return {
+        "tdc_online_quarantined_batches_total":
+            int(counters.get("quarantined_batches", 0)),
+        "tdc_online_publishes_total": int(counters.get("publishes", 0)),
+        "tdc_online_rejected_candidates_total":
+            int(counters.get("rejects", 0)),
+        "tdc_online_rollbacks_total": int(counters.get("rollbacks", 0)),
+        "tdc_online_pinned": int(bool(led.get("pinned", False))),
+    }
+
+
+# ---------------- sidecar feed (directory hand-off) ----------------
+
+
+def feed_next_seq(feed_dir: str) -> int:
+    """1 + the highest batch sequence currently in `feed_dir` (0 when
+    empty/missing). A restarted producer MUST resume from here: counting
+    from zero again would feed_write over queued batches a lagging
+    consumer has not drained yet."""
+    try:
+        names = os.listdir(feed_dir)
+    except OSError:
+        return 1
+    top = 0
+    for n in names:
+        if n.startswith("batch-") and n.endswith(".npy"):
+            try:
+                top = max(top, int(n[len("batch-"):-len(".npy")]))
+            except ValueError:
+                continue
+    return top + 1
+
+
+def feed_write(feed_dir: str, x: np.ndarray, seq: int) -> str:
+    """Atomically publish one sampled batch into a sidecar feed dir.
+    Content lands under a tmp name first; the rename is the hand-off, so
+    a consumer never loads a half-written file."""
+    os.makedirs(feed_dir, exist_ok=True)
+    name = f"batch-{seq:012d}.npy"
+    tmp = os.path.join(feed_dir, f".{name}.tmp")
+    with open(tmp, "wb") as f:
+        np.save(f, np.asarray(x, np.float32))
+    os.replace(tmp, os.path.join(feed_dir, name))
+    return name
+
+
+def feed_drain(feed_dir: str, updater: OnlineUpdater,
+               max_batches: int = 1024) -> int:
+    """Consume (observe + delete) queued feed batches in sequence order;
+    returns how many were consumed. Unreadable files are quarantined and
+    removed — a torn producer must not wedge the feed forever."""
+    try:
+        names = sorted(
+            n for n in os.listdir(feed_dir)
+            if n.startswith("batch-") and n.endswith(".npy")
+        )
+    except OSError:
+        return 0
+    consumed = 0
+    for name in names[:max_batches]:
+        path = os.path.join(feed_dir, name)
+        try:
+            x = np.load(path, allow_pickle=False)
+        except (OSError, ValueError):
+            updater._quarantine("unreadable_feed", (0,), file=name)
+        else:
+            updater.observe(x)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        consumed += 1
+    return consumed
+
+
+__all__ = [
+    "LEDGER_NAME",
+    "OnlineConfig",
+    "OnlineUpdater",
+    "feed_drain",
+    "feed_next_seq",
+    "feed_write",
+    "ledger_metrics",
+]

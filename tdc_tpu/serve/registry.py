@@ -127,6 +127,15 @@ class ModelRegistry:
     def ids(self) -> list[str]:
         return sorted(self._entries)
 
+    def entries(self) -> list[ModelEntry]:
+        """Snapshot of the live entries (id-sorted) — the /metrics walk."""
+        return [self._entries[mid] for mid in sorted(self._entries)]
+
+    def path_of(self, model_id: str) -> str | None:
+        """The tracked model dir for `model_id` (None if untracked) — where
+        a sidecar updater's ledger lives."""
+        return self._paths.get(model_id)
+
     def list_models(self) -> list[dict]:
         return [self._entries[mid].info() for mid in sorted(self._entries)]
 

@@ -38,6 +38,7 @@ import asyncio
 import collections
 import concurrent.futures
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -75,6 +76,8 @@ class ServeApp:
         max_queue_rows: int = 65536,
         poll_interval: float = 2.0,
         request_timeout: float = 30.0,
+        feed_dir: str | None = None,
+        feed_sample: int = 1,
     ):
         self.log = log
         self.registry = registry or ModelRegistry()
@@ -86,9 +89,24 @@ class ServeApp:
             max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows,
             log=log,
+            tap=self._dispatch_tap,
         )
         self.poll_interval = float(poll_interval)
         self.request_timeout = float(request_timeout)
+        # Online-update surface: in-process updaters (serve/online) keyed
+        # by model id, ticked by a loop task; and/or a sidecar feed dir
+        # every 'feed_sample'-th dispatched batch is exported to — one
+        # SUBDIRECTORY per model (feed_dir/<model_id>/), so a sidecar on
+        # one model never folds another model's traffic. Sequence numbers
+        # resume past what is already on disk (feed_next_seq): a server
+        # restart must not overwrite batches a lagging sidecar has not
+        # drained yet.
+        self.updaters: dict = {}
+        self.feed_dir = feed_dir
+        self.feed_sample = max(int(feed_sample), 1)
+        self._feed_seq: dict[str, int] = {}
+        self._tap_batches = 0
+        self._online_tasks: list = []
         self.started_at = time.time()
         self._draining = False
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -118,6 +136,10 @@ class ServeApp:
             self._poll_task = asyncio.run_coroutine_threadsafe(
                 self._poll_models(), loop
             )
+        for model_id, updater in self.updaters.items():
+            self._online_tasks.append(asyncio.run_coroutine_threadsafe(
+                self._online_loop(model_id, updater), loop
+            ))
 
     def begin_drain(self, linger: float = 5.0) -> None:
         """Start a drain WITHOUT closing the HTTP listener: /readyz flips
@@ -156,6 +178,9 @@ class ServeApp:
             if self._poll_task is not None:
                 self._poll_task.cancel()
                 self._poll_task = None
+            for task in self._online_tasks:
+                task.cancel()
+            self._online_tasks = []
             try:
                 drained = asyncio.run_coroutine_threadsafe(
                     self.batcher.drain(drain_timeout), loop
@@ -190,6 +215,89 @@ class ServeApp:
                     self.log.event(
                         "poll_error", error=f"{type(e).__name__}: {e}"
                     )
+
+    # ---------------- online updates (serve/online) ----------------
+
+    def attach_online(self, model_id: str, updater) -> None:
+        """Attach an in-process OnlineUpdater for a registered model: the
+        micro-batcher tap feeds it sampled traffic, a loop task ticks the
+        fold/validate/publish/rollback pipeline, /metrics exports its
+        counters, and /admin/{rollback,pin,unpin} drive it."""
+        self.registry.get(model_id)  # KeyError if unknown — fail loudly
+        self.updaters[model_id] = updater
+        if self._loop is not None:
+            self._online_tasks.append(asyncio.run_coroutine_threadsafe(
+                self._online_loop(model_id, updater), self._loop
+            ))
+
+    async def _online_loop(self, model_id: str, updater) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(updater.config.tick_interval)
+            try:
+                # tick() folds on device; keep it off the serving loop.
+                await loop.run_in_executor(None, updater.tick)
+            except Exception as e:  # the updater must never kill serving
+                if self.log is not None:
+                    self.log.event(
+                        "online_tick_error", model=model_id,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+
+    def _dispatch_tap(self, model_id: str, method: str, x) -> None:
+        """MicroBatcher dispatch tap: sample coalesced device batches into
+        the in-process updater and/or the sidecar feed dir. Runs on the
+        batcher's executor (off the serving loop) with errors swallowed
+        — observation must never stall or fail dispatch."""
+        updater = self.updaters.get(model_id)
+        if updater is None and self.feed_dir is None:
+            return
+        self._tap_batches += 1
+        if updater is not None:
+            updater.observe(x)
+        if self.feed_dir is not None and (
+            self._tap_batches % self.feed_sample == 0
+        ):
+            from tdc_tpu.serve.online import feed_next_seq, feed_write
+
+            sub = os.path.join(self.feed_dir, model_id)
+            seq = self._feed_seq.get(model_id)
+            if seq is None:
+                seq = feed_next_seq(sub)
+            else:
+                seq += 1
+            self._feed_seq[model_id] = seq
+            feed_write(sub, x, seq)
+
+    def handle_admin(self, action: str, payload: dict) -> tuple[int, dict]:
+        """POST /admin/<action> — rollback | pin | unpin, body
+        {"model": id}. Only models with an IN-PROCESS updater are
+        drivable here; sidecar-managed models are driven with
+        `python -m tdc_tpu.cli.online` against the model dir (the two
+        must not race each other's ledger)."""
+        model_id = payload.get("model")
+        if not isinstance(model_id, str):
+            return 400, {"error": "body must be {'model': id}"}
+        updater = self.updaters.get(model_id)
+        if updater is None:
+            return 404, {
+                "error": f"no in-process online updater for {model_id!r}",
+                "detail": "sidecar-managed models: use "
+                          "python -m tdc_tpu.cli.online on the model dir",
+            }
+        try:
+            if action == "rollback":
+                version = updater.rollback(reason="admin_http")
+                return 200, {"model": model_id, "rolled_back_to": version}
+            if action == "pin":
+                updater.pin()
+            elif action == "unpin":
+                updater.unpin()
+            else:
+                return 404, {"error": f"unknown admin action {action!r}"}
+        except ValueError as e:
+            return 409, {"error": str(e)}
+        return 200, {"model": model_id, "pinned": updater.status()["pinned"]}
 
     # ---------------- request handling (transport-agnostic) ----------------
 
@@ -288,6 +396,28 @@ class ServeApp:
             if reason is not None:
                 body["reason"] = reason
             return status, "application/json", json.dumps(body)
+        if path == "/online":
+            # Online-update status: in-process updaters report live; for
+            # sidecar-managed models the ledger next to the manifest is
+            # the (slightly stale, atomically-replaced) truth.
+            body = {"updaters": {
+                mid: u.status() for mid, u in sorted(self.updaters.items())
+            }}
+            sidecars = {}
+            for mid in self.registry.ids():
+                if mid in self.updaters:
+                    continue
+                mpath = self.registry.path_of(mid)
+                if mpath is None:
+                    continue
+                try:
+                    with open(os.path.join(mpath, "online.json")) as f:
+                        sidecars[mid] = json.load(f)
+                except (OSError, ValueError):
+                    continue
+            body["sidecars"] = sidecars
+            self._counters[("online", 200)] += 1
+            return 200, "application/json", json.dumps(body)
         if path == "/metrics":
             return 200, "text/plain; version=0.0.4", self.metrics_text()
         return 404, "application/json", json.dumps(
@@ -349,6 +479,60 @@ class ServeApp:
         for name, typ, help_, val in scalar:
             lines += [f"# HELP {name} {help_}", f"# TYPE {name} {typ}",
                       f"{name} {val}"]
+        # Per-model generation/staleness: generation is the registry's
+        # monotonic reload counter (bumps on every swap, incl. online
+        # publishes and rollbacks); age is seconds since that generation
+        # went live — the "never goes stale" dashboard signal.
+        now = time.time()
+        entries = self.registry.entries()
+        lines += [
+            "# HELP tdc_model_generation Monotonic reload generation per "
+            "model.",
+            "# TYPE tdc_model_generation gauge",
+        ]
+        lines += [
+            f'tdc_model_generation{{model="{e.model_id}"}} {e.generation}'
+            for e in entries
+        ]
+        lines += [
+            "# HELP tdc_model_generation_age_seconds Seconds since the "
+            "serving generation was loaded.",
+            "# TYPE tdc_model_generation_age_seconds gauge",
+        ]
+        lines += [
+            f'tdc_model_generation_age_seconds{{model="{e.model_id}"}} '
+            f"{round(now - e.loaded_at, 3)}"
+            for e in entries
+        ]
+        # Online-update pipeline counters/gauges: live from in-process
+        # updaters; for sidecar-managed model dirs, from the ledger the
+        # sidecar atomically publishes next to the manifest.
+        online: dict[str, dict[str, float]] = {}
+        for mid, updater in self.updaters.items():
+            online[mid] = updater.metrics()
+        from tdc_tpu.serve.online import ledger_metrics
+
+        for mid in self.registry.ids():
+            if mid in online:
+                continue
+            mpath = self.registry.path_of(mid)
+            if mpath is None:
+                continue
+            led = ledger_metrics(mpath)
+            if led is not None:
+                online[mid] = led
+        online_names: dict[str, list[str]] = {}
+        for mid, vals in sorted(online.items()):
+            for name, val in vals.items():
+                online_names.setdefault(name, []).append(
+                    f'{name}{{model="{mid}"}} {val}'
+                )
+        for name, rows in sorted(online_names.items()):
+            typ = "counter" if name.endswith("_total") else "gauge"
+            lines += [
+                f"# HELP {name} serve/online updater metric.",
+                f"# TYPE {name} {typ}",
+            ] + rows
         lines += [
             "# HELP tdc_serve_latency_ms Recent end-to-end latency "
             "quantiles per endpoint.",
@@ -422,7 +606,12 @@ def _make_httpd(app: ServeApp, host: str, port: int) -> ThreadingHTTPServer:
                 self._reply(400, "application/json",
                             json.dumps({"error": f"bad JSON body: {e}"}))
                 return
-            status, body = app.request(endpoint, payload)
+            if endpoint.startswith("admin/"):
+                status, body = app.handle_admin(
+                    endpoint[len("admin/"):], payload
+                )
+            else:
+                status, body = app.request(endpoint, payload)
             self._reply(status, "application/json", json.dumps(body))
 
     return ThreadingHTTPServer((host, port), Handler)

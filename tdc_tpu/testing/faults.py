@@ -47,6 +47,10 @@ Instrumented points (grep fault_point for the live list):
     data.load               dataset open
     resident.chunk          each HBM-resident compiled-chunk boundary
     reshard.redistribute    restoring state saved under a different layout
+    online.fold             before folding a window of sampled traffic
+    online.validate         before shadow-validating a fold candidate
+    online.swap             between staged arrays and the manifest swap
+    online.rollback         before republishing the last-good generation
 """
 
 from __future__ import annotations
@@ -77,6 +81,10 @@ KNOWN_POINTS = frozenset({
     "data.load",
     "resident.chunk",
     "reshard.redistribute",
+    "online.fold",
+    "online.validate",
+    "online.swap",
+    "online.rollback",
 })
 
 # Exit code used by the 'crash' action: 128+9, what a shell reports for a

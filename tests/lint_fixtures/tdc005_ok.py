@@ -1,6 +1,8 @@
 """MUST-NOT-FLAG TDC005: registry and call sites agree exactly, both
 directions — including the PR-6 elastic-resize point names (dotted,
-multi-segment), which the rule must see as ordinary registered points."""
+multi-segment) and the PR-7 online-update points (several points
+registered and called from ONE pipeline function), which the rule must
+see as ordinary registered points."""
 
 KNOWN_POINTS = frozenset({
     "ckpt.save",
@@ -8,6 +10,8 @@ KNOWN_POINTS = frozenset({
     "stream.batch",
     "supervisor.resize",
     "reshard.redistribute",
+    "online.fold",
+    "online.swap",
 })
 
 
@@ -24,3 +28,8 @@ def resize_paths():
     fault_point("supervisor.resize")
     fault_point("ckpt.restore.layout")
     fault_point("reshard.redistribute")
+
+
+def online_pipeline():
+    fault_point("online.fold")
+    fault_point("online.swap")

@@ -620,3 +620,166 @@ def test_chaos_shrink_mid_fit_resizes_to_one(tmp_path):
                                tol=-1.0)
     np.testing.assert_allclose(c0, np.asarray(want.centroids),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_online_poisoned_fold_and_crash_mid_swap(tmp_path):
+    """The PR-7 online-update soak (ISSUE acceptance): a sidecar updater
+    (cli/online) feeding a live in-process server hits, in order,
+
+    1. a NaN-poisoned fold batch (data-level poison in the feed) PLUS a
+       $TDC_FAULTS crash at `online.swap` — i.e. after the candidate's
+       arrays are staged but before the manifest swap. Serving must stay
+       bit-exact on the last-good generation throughout (the staged
+       orphan is never loadable), the poisoned batch is quarantined, not
+       folded;
+    2. a clean relaunch that folds fresh traffic and publishes a
+       validated generation the server hot-swaps to;
+    3. a forced post-swap quality regression (a garbage generation
+       published externally, the buggy-offline-trainer scenario) that the
+       sentinel auto-rolls-back within one validation window —
+
+    all visible via structlog events and /metrics."""
+    import json as _json
+    import urllib.request  # noqa: F401  (parity with the serve soaks)
+
+    import jax
+
+    from tdc_tpu.models.kmeans import kmeans_fit, kmeans_predict
+    from tdc_tpu.models.persist import (
+        list_array_versions,
+        load_fitted,
+        save_fitted,
+    )
+    from tdc_tpu.serve import ServeApp
+    from tdc_tpu.serve.online import feed_write
+
+    rng = np.random.default_rng(2)
+    centers = np.array(
+        [[6.0, 6.0, 0, 0], [6.0, -6.0, 0, 0],
+         [-6.0, 6.0, 0, 0], [-6.0, -6.0, 0, 0]], np.float32
+    )
+    x = np.concatenate([
+        rng.normal(c, 0.6, size=(300, 4)).astype(np.float32)
+        for c in centers
+    ])
+    km = kmeans_fit(x, 4, key=jax.random.PRNGKey(0), max_iters=10)
+    mdir = str(tmp_path / "km")
+    feed = str(tmp_path / "feed")
+    save_fitted(mdir, km)
+    v0 = load_fitted(mdir).version
+    c0 = np.asarray(km.centroids)
+    probe = x[5::97][:24]
+    want0 = np.asarray(kmeans_predict(probe, c0)).tolist()
+
+    app = ServeApp(poll_interval=0)
+    app.registry.add("km", mdir)
+    app.start()
+    env = {k: v for k, v in os.environ.items() if k != "TDC_FAULTS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def sidecar(runlog, faults_spec=None, ticks=5):
+        e = dict(env)
+        e["TDC_RUNLOG"] = str(tmp_path / runlog)
+        if faults_spec:
+            e["TDC_FAULTS"] = faults_spec
+        return subprocess.run(
+            [sys.executable, "-m", "tdc_tpu.cli.online",
+             "--model_dir", mdir, "--feed_dir", feed,
+             "--interval", "0.05", "--max_ticks", str(ticks),
+             "--min_fold_rows", "64", "--min_holdback_rows", "32",
+             "--max_inertia_ratio", "2.0", "--max_churn", "1.0"],
+            env=e, capture_output=True, text=True, timeout=300,
+        )
+
+    def serve_labels():
+        st, body = app.request(
+            "predict", {"model": "km", "points": probe.tolist()}
+        )
+        assert st == 200, body
+        return body["labels"], body["version"]
+
+    def ledger():
+        return _json.load(open(os.path.join(mdir, "online.json")))
+
+    try:
+        labels, ver = serve_labels()
+        assert (labels, ver) == (want0, v0)
+
+        # ---- phase 1: poison + crash mid-swap --------------------------
+        feed_write(feed, np.full((16, 4), np.nan, np.float32), 1)
+        for i in range(6):
+            feed_write(feed, x[i * 100:(i + 1) * 100] + np.float32(0.3),
+                       2 + i)
+        p1 = sidecar("online_run1.jsonl",
+                     faults_spec="online.swap=crash@1")
+        from tdc_tpu.testing.faults import CRASH_EXIT_CODE
+
+        assert p1.returncode == CRASH_EXIT_CODE, (p1.returncode, p1.stderr)
+        # the manifest never moved: the staged candidate is an orphan, the
+        # server's poll sees nothing, and serving is bit-exact on v0
+        assert load_fitted(mdir).version == v0
+        assert len(list_array_versions(mdir)) == 2  # v0 + staged orphan
+        assert app.registry.poll_once() == []
+        labels, ver = serve_labels()
+        assert (labels, ver) == (want0, v0)
+        led = ledger()
+        assert led["counters"]["quarantined_batches"] == 1
+        assert led["counters"]["publishes"] == 0
+        run1 = (tmp_path / "online_run1.jsonl").read_text()
+        assert '"point": "online.swap"' in run1
+        assert "online_quarantine" in run1 and "nonfinite" in run1
+
+        # ---- phase 2: relaunch folds fresh traffic and publishes -------
+        for i in range(6):
+            feed_write(feed, x[i * 100:(i + 1) * 100] + np.float32(0.3),
+                       10 + i)
+        p2 = sidecar("online_run2.jsonl")
+        assert p2.returncode == 0, (p2.returncode, p2.stderr[-2000:])
+        led = ledger()
+        assert led["counters"]["publishes"] == 1
+        v1 = load_fitted(mdir).version
+        assert v1 != v0 and led["live"] == v1 and led["last_good"] == v0
+        assert "online_publish" in (tmp_path / "online_run2.jsonl").read_text()
+        assert app.registry.poll_once() == ["km"]
+        c1 = load_fitted(mdir).arrays["centroids"]
+        want1 = np.asarray(kmeans_predict(probe, c1)).tolist()
+        labels, ver = serve_labels()
+        assert (labels, ver) == (want1, v1)
+
+        # ---- phase 3: forced post-swap regression -> auto rollback -----
+        bad = np.tile(np.float32([100.0, 100.0, 0.0, 0.0]), (4, 1))
+        save_fitted(mdir, None, model="kmeans",
+                    arrays={"centroids": bad})
+        assert app.registry.poll_once() == ["km"]  # garbage goes live
+        for i in range(6):
+            feed_write(feed, x[i * 100:(i + 1) * 100], 20 + i)
+        p3 = sidecar("online_run3.jsonl")
+        assert p3.returncode == 0, (p3.returncode, p3.stderr[-2000:])
+        led = ledger()
+        assert led["counters"]["rollbacks"] == 1
+        assert led["live"] == v1  # rolled back to the validated generation
+        assert load_fitted(mdir).version == v1
+        assert "online_rollback" in (
+            tmp_path / "online_run3.jsonl"
+        ).read_text()
+        assert app.registry.poll_once() == ["km"]
+        labels, ver = serve_labels()
+        assert (labels, ver) == (want1, v1)
+
+        # ---- /metrics: the whole story on one scrape -------------------
+        m = app.metrics_text()
+        assert 'tdc_online_quarantined_batches_total{model="km"} 1' in m
+        assert 'tdc_online_rollbacks_total{model="km"} 1' in m
+        assert 'tdc_online_publishes_total{model="km"} 1' in m
+        gen_line = next(
+            ln for ln in m.splitlines()
+            if ln.startswith('tdc_model_generation{model="km"}')
+        )
+        assert int(gen_line.rsplit(" ", 1)[1]) == 4  # add + 3 swaps
+        assert 'tdc_model_generation_age_seconds{model="km"}' in m
+    finally:
+        app.stop()

@@ -1,0 +1,681 @@
+"""serve/online: the guarded fit→serve update loop.
+
+Covers the pipeline stage by stage — health screen/quarantine, holdback
+shadow validation, atomic publish through the persist manifest machinery,
+post-swap monitoring with automatic rollback, generation retention with
+live/last-good pinning — plus the serve wiring (batcher tap, /metrics
+generation+age gauges, admin surface, sidecar feed) and the fault
+points. The crash-mid-swap + poisoned-batch soak lives in test_chaos.py.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from tdc_tpu.models.kmeans import kmeans_fit, kmeans_predict
+from tdc_tpu.models.persist import (
+    list_array_versions,
+    load_fitted,
+    save_fitted,
+)
+from tdc_tpu.serve import (
+    ModelRegistry,
+    OnlineConfig,
+    OnlineUpdater,
+    ServeApp,
+)
+from tdc_tpu.serve.online import feed_drain, feed_write, ledger_metrics
+from tdc_tpu.testing import faults
+
+K, DIM = 4, 4
+
+
+@pytest.fixture(scope="module")
+def traffic():
+    """Two regions: P (around +6) and Q (around -6), two clusters each —
+    the drift scenarios shift traffic between them."""
+    rng = np.random.default_rng(11)
+    centers = np.array(
+        [[6.0, 6.0, 0, 0], [6.0, -6.0, 0, 0],
+         [-6.0, 6.0, 0, 0], [-6.0, -6.0, 0, 0]], np.float32
+    )
+    per = 300
+    x = np.concatenate([
+        rng.normal(c, 0.6, size=(per, DIM)).astype(np.float32)
+        for c in centers
+    ])
+    p, q = x[: 2 * per], x[2 * per:]
+    return x, p, q
+
+
+@pytest.fixture()
+def model_dir(traffic, tmp_path):
+    x, _, _ = traffic
+    km = kmeans_fit(x, K, key=jax.random.PRNGKey(0), max_iters=10)
+    d = str(tmp_path / "km")
+    save_fitted(d, km)
+    return d
+
+
+def _cfg(**kw):
+    kw.setdefault("min_fold_rows", 64)
+    kw.setdefault("fold_batch_rows", 64)
+    kw.setdefault("min_holdback_rows", 32)
+    kw.setdefault("holdback_rows", 256)
+    kw.setdefault("max_inertia_ratio", 2.0)
+    kw.setdefault("max_churn", 1.0)
+    kw.setdefault("tick_interval", 0.05)
+    return OnlineConfig(**kw)
+
+
+def _feed(u, x, batches=6, shift=0.0):
+    rows = x.shape[0] // batches
+    for i in range(batches):
+        u.observe(x[i * rows:(i + 1) * rows] + np.float32(shift))
+
+
+class TestScreen:
+    def test_nan_inf_quarantined_not_folded(self, model_dir):
+        u = OnlineUpdater(model_dir, config=_cfg())
+        assert u.observe(np.full((8, DIM), np.nan, np.float32)) is False
+        bad = np.zeros((8, DIM), np.float32)
+        bad[3, 1] = np.inf
+        assert u.observe(bad) is False
+        assert u.counters["quarantined_batches"] == 2
+        assert u.status()["pending_rows"] == 0
+        # ...and the ledger already carries the count (sidecar visibility)
+        assert ledger_metrics(model_dir)[
+            "tdc_online_quarantined_batches_total"] == 2
+
+    def test_norm_outlier_quarantined_after_traffic(self, traffic,
+                                                    model_dir):
+        _, p, _ = traffic
+        u = OnlineUpdater(model_dir, config=_cfg())
+        assert u.observe(p[:100]) is True
+        assert u.observe(p[:50] * np.float32(1e4)) is False
+        assert u.counters["quarantined_batches"] == 1
+
+    def test_bad_shape_quarantined(self, model_dir):
+        u = OnlineUpdater(model_dir, config=_cfg())
+        assert u.observe(np.zeros((4, DIM + 2), np.float32)) is False
+        assert u.observe(np.zeros((0, DIM), np.float32)) is False
+        assert u.counters["quarantined_batches"] == 2
+
+    def test_nonfinite_fold_discarded(self, traffic, model_dir,
+                                      monkeypatch):
+        """A fold whose RESULT is non-finite (poison past the per-batch
+        screen) is discarded wholesale: live stays, counters say so."""
+        _, p, _ = traffic
+        u = OnlineUpdater(model_dir, config=_cfg())
+        _feed(u, p)
+        v0 = u.live_version
+        monkeypatch.setattr(
+            u, "_fold_candidate",
+            lambda batches: (np.full((K, DIM), np.nan, np.float32),
+                             np.ones(K, np.float32), 1, 0.0),
+        )
+        out = u.tick()
+        assert out["outcome"] == "discarded"
+        assert u.live_version == v0
+        assert u.counters["quarantined_batches"] == 1
+        assert load_fitted(model_dir).version == v0
+
+
+class TestFoldPublish:
+    def test_publish_swaps_manifest_and_ledger(self, traffic, model_dir):
+        _, p, _ = traffic
+        reg = ModelRegistry()
+        e0 = reg.add("km", model_dir)
+        u = OnlineUpdater(model_dir, model_id="km", registry=reg,
+                          config=_cfg())
+        v0 = u.live_version
+        _feed(u, p, shift=0.3)
+        out = u.tick()
+        assert out["outcome"] == "published", out
+        assert u.live_version != v0
+        assert u.last_good_version == v0
+        assert u.generation == 1
+        assert load_fitted(model_dir).version == u.live_version
+        # the registry was polled: serving swapped atomically
+        assert reg.get("km").generation == e0.generation + 1
+        led = json.load(open(os.path.join(model_dir, "online.json")))
+        assert led["live"] == u.live_version
+        assert led["last_good"] == v0
+
+    def test_streaming_mode_publishes(self, traffic, model_dir):
+        _, p, _ = traffic
+        u = OnlineUpdater(
+            model_dir, config=_cfg(mode="streaming", decay=0.9)
+        )
+        _feed(u, p, shift=0.3)
+        assert u.tick()["outcome"] == "published"
+
+    def test_pinned_blocks_publish_and_persists(self, traffic, model_dir):
+        _, p, _ = traffic
+        u = OnlineUpdater(model_dir, config=_cfg())
+        u.pin()
+        _feed(u, p, shift=0.3)
+        assert u.tick()["outcome"] == "idle"
+        assert u.counters["publishes"] == 0
+        # pin survives a relaunch (it lives in the ledger)
+        u2 = OnlineUpdater(model_dir, config=_cfg())
+        assert u2.pinned is True
+        u2.unpin()
+        assert OnlineUpdater(model_dir, config=_cfg()).pinned is False
+
+    def test_validation_rejects_and_restores(self, traffic, model_dir):
+        """An impossible inertia bar rejects every candidate: live is
+        untouched, the reject is counted, and the fold mass is NOT kept
+        (a rejected candidate must not steer the next fold)."""
+        _, p, _ = traffic
+        u = OnlineUpdater(model_dir, config=_cfg(max_inertia_ratio=1e-9))
+        v0 = u.live_version
+        counts0 = u._fold_state[0].copy()
+        _feed(u, p, shift=1.0)
+        out = u.tick()
+        assert out["outcome"] == "rejected"
+        assert u.live_version == v0
+        assert load_fitted(model_dir).version == v0
+        assert u.counters["rejects"] == 1
+        np.testing.assert_array_equal(u._fold_state[0], counts0)
+        assert u.last_validation["accepted"] is False
+        assert "inertia" in u.last_validation["failed"]
+
+    def test_no_publish_without_holdback_evidence(self, traffic,
+                                                  model_dir):
+        _, p, _ = traffic
+        u = OnlineUpdater(
+            model_dir, config=_cfg(min_holdback_rows=10 ** 6)
+        )
+        _feed(u, p, shift=0.3)
+        assert u.tick()["outcome"] == "idle"
+        assert u.counters["folds"] == 0
+        assert u.status()["pending_rows"] > 0  # buffered, not dropped
+
+    def test_pending_buffer_bounded_while_pinned(self, traffic,
+                                                 model_dir):
+        """Observation under pin must not grow RAM without limit: the
+        fold buffer drops its OLDEST batches past max_pending_rows."""
+        _, p, _ = traffic
+        u = OnlineUpdater(
+            model_dir, config=_cfg(min_fold_rows=64, max_pending_rows=200)
+        )
+        u.pin()
+        for _ in range(40):
+            u.observe(p[:50])
+        assert u.status()["pending_rows"] <= 200
+
+    def test_readonly_construction_does_not_rewrite_ledger(self,
+                                                           model_dir):
+        """--status-style consumers construct an updater concurrently
+        with a live sidecar: construction over a consistent ledger must
+        not write it back (last-writer-wins would revert counters)."""
+        u = OnlineUpdater(model_dir, config=_cfg())
+        u.observe(np.full((4, DIM), np.nan, np.float32))  # bump a counter
+        path = os.path.join(model_dir, "online.json")
+        before = open(path).read()
+        OnlineUpdater(model_dir, config=_cfg())  # a pure read
+        assert open(path).read() == before
+
+    def test_kmeans_only_and_manifest_required(self, traffic, tmp_path):
+        from tdc_tpu.models.gmm import gmm_fit
+        from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
+
+        x, _, _ = traffic
+        gm_dir = str(tmp_path / "gm")
+        save_fitted(gm_dir, gmm_fit(x, 3, key=jax.random.PRNGKey(1),
+                                    max_iters=4))
+        with pytest.raises(ValueError, match="kmeans"):
+            OnlineUpdater(gm_dir, config=_cfg())
+        ck_dir = str(tmp_path / "ck")
+        save_checkpoint(
+            ck_dir,
+            ClusterState(np.zeros((K, DIM), np.float32), 1, None, 0,
+                         {"k": K, "d": DIM}),
+            step=1, gang=False,
+        )
+        with pytest.raises(ValueError, match="manifest"):
+            OnlineUpdater(ck_dir, config=_cfg())
+
+
+class TestRollback:
+    def _published(self, traffic, model_dir, **cfg_kw):
+        _, p, _ = traffic
+        u = OnlineUpdater(model_dir, config=_cfg(**cfg_kw))
+        _feed(u, p, shift=0.3)
+        assert u.tick()["outcome"] == "published"
+        return u
+
+    def test_manual_rollback_restores_last_good(self, traffic, model_dir):
+        u = self._published(traffic, model_dir)
+        v_new, v_good = u.live_version, u.last_good_version
+        gen = u.generation
+        back = u.rollback(reason="test")
+        assert back == v_good
+        assert u.live_version == v_good
+        assert load_fitted(model_dir).version == v_good
+        assert u.generation == gen + 1  # a rollback IS a new generation
+        assert u.counters["rollbacks"] == 1
+        # the bad generation's arrays stay on disk for forensics
+        assert v_new in list_array_versions(model_dir)
+
+    def test_rollback_without_last_good_raises(self, model_dir):
+        u = OnlineUpdater(model_dir, config=_cfg())
+        with pytest.raises(ValueError, match="last-good"):
+            u.rollback()
+
+    def test_auto_rollback_on_post_swap_regression(self, traffic,
+                                                   model_dir):
+        """The drift sentinel: an externally-published garbage generation
+        (buggy offline trainer) is adopted as live on relaunch, scored
+        against last-good on fresh traffic, and rolled back within one
+        validation window."""
+        _, p, q = traffic
+        u = self._published(traffic, model_dir)
+        v_good = u.live_version
+        bad = np.tile(np.float32([100.0, 100.0, 0, 0]), (K, 1))
+        save_fitted(model_dir, None, model="kmeans",
+                    arrays={"centroids": bad})
+        u2 = OnlineUpdater(model_dir, config=_cfg())
+        # recovery adopted the external publish, keeping the real
+        # last-good for the sentinel
+        assert u2.live_version != v_good
+        assert u2.last_good_version == v_good
+        _feed(u2, q)
+        out = u2.tick()
+        assert out["outcome"] == "rollback", out
+        assert u2.live_version == v_good
+        assert load_fitted(model_dir).version == v_good
+        assert u2.counters["rollbacks"] == 1
+
+    def test_retention_pins_live_and_last_good_against_eviction(
+        self, traffic, model_dir
+    ):
+        """Satellite: keep-last-N eviction racing a rollback — after many
+        publishes with keep_generations=2, the last-good arrays MUST
+        still be on disk and the rollback must succeed."""
+        _, p, _ = traffic
+        u = OnlineUpdater(
+            model_dir,
+            config=_cfg(keep_generations=2, min_fold_rows=32,
+                        min_holdback_rows=8, max_inertia_ratio=100.0),
+        )
+        rng = np.random.default_rng(5)
+        for i in range(4):
+            for _ in range(4):
+                u.observe(
+                    p[rng.integers(0, p.shape[0] - 40):][:40]
+                    + np.float32(0.2 * (i + 1))
+                )
+            assert u.tick()["outcome"] == "published"
+        on_disk = list_array_versions(model_dir)
+        assert u.live_version in on_disk
+        assert u.last_good_version in on_disk
+        # eviction did run: we published 4 + initial = 5 versions total
+        assert len(on_disk) < 5
+        back = u.rollback(reason="race-test")
+        assert load_fitted(model_dir).version == back
+
+    def test_crash_between_swap_and_ledger_recovers(self, traffic,
+                                                    model_dir):
+        """The online.swap crash window: manifest swapped, ledger not yet
+        written. A relaunched updater adopts the manifest as live and the
+        ledger's live as last-good — rollback still has its target."""
+        _, p, _ = traffic
+        ledger_path = os.path.join(model_dir, "online.json")
+        u = OnlineUpdater(model_dir, config=_cfg())
+        v0 = u.live_version
+        pre_publish_ledger = open(ledger_path).read()
+        _feed(u, p, shift=0.3)
+        assert u.tick()["outcome"] == "published"
+        v1 = u.live_version
+        # simulate dying before the ledger write
+        with open(ledger_path, "w") as f:
+            f.write(pre_publish_ledger)
+        u2 = OnlineUpdater(model_dir, config=_cfg())
+        assert u2.live_version == v1
+        assert u2.last_good_version == v0
+        assert u2.rollback(reason="post-crash") == v0
+
+
+class TestFaultPoints:
+    @pytest.mark.parametrize("point,drive", [
+        ("online.fold", "tick"),
+        ("online.validate", "tick"),
+        ("online.swap", "tick"),
+        ("online.rollback", "rollback"),
+    ])
+    def test_injected_raise_fires(self, traffic, model_dir, monkeypatch,
+                                  point, drive):
+        _, p, _ = traffic
+        u = OnlineUpdater(model_dir, config=_cfg())
+        _feed(u, p, shift=0.3)
+        if drive == "rollback":
+            assert u.tick()["outcome"] == "published"
+            _feed(u, p, shift=0.3)
+        monkeypatch.setenv(faults.ENV_VAR, f"{point}=raise:RuntimeError")
+        faults.reset()
+        try:
+            with pytest.raises(RuntimeError, match=point):
+                if drive == "tick":
+                    u.tick()
+                else:
+                    u.rollback(reason="fault-test")
+        finally:
+            monkeypatch.delenv(faults.ENV_VAR)
+            faults.reset()
+
+    def test_swap_fault_leaves_old_manifest_live(self, traffic, model_dir,
+                                                 monkeypatch):
+        """A failure at online.swap is AFTER arrays staging and BEFORE the
+        manifest swap: the staged candidate is on disk but unreferenced —
+        nothing half-published is loadable."""
+        _, p, _ = traffic
+        u = OnlineUpdater(model_dir, config=_cfg())
+        v0 = u.live_version
+        _feed(u, p, shift=0.3)
+        monkeypatch.setenv(faults.ENV_VAR, "online.swap=raise:RuntimeError")
+        faults.reset()
+        try:
+            with pytest.raises(RuntimeError):
+                u.tick()
+        finally:
+            monkeypatch.delenv(faults.ENV_VAR)
+            faults.reset()
+        assert load_fitted(model_dir).version == v0
+        assert len(list_array_versions(model_dir)) == 2  # staged orphan
+
+
+def _mk_app(model_dir, **kw):
+    kw.setdefault("poll_interval", 0)
+    kw.setdefault("max_wait_ms", 5.0)
+    app = ServeApp(**kw)
+    app.registry.add("km", model_dir)
+    app.start()
+    return app
+
+
+def _run_async(app, coro, timeout=120):
+    return asyncio.run_coroutine_threadsafe(coro, app._loop).result(timeout)
+
+
+def _metric(text, name, label=""):
+    for line in text.splitlines():
+        if line.startswith(f"{name}{label}") and not line.startswith("#"):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"{name}{label} not in metrics:\n{text}")
+
+
+class TestServeIntegration:
+    def test_swap_resets_age_and_bumps_generation(self, traffic,
+                                                  model_dir):
+        """Satellite: tdc_model_generation bumps on a swap and the age
+        gauge resets — the 'never goes stale' dashboard signal."""
+        _, p, _ = traffic
+        app = _mk_app(model_dir)
+        try:
+            entry = app.registry.get("km")
+            entry.loaded_at -= 1000.0  # age the generation artificially
+            m = app.metrics_text()
+            g0 = _metric(m, "tdc_model_generation", '{model="km"}')
+            assert _metric(
+                m, "tdc_model_generation_age_seconds", '{model="km"}'
+            ) > 999.0
+            c2 = load_fitted(model_dir).arrays["centroids"] + np.float32(0.5)
+            save_fitted(model_dir, None, model="kmeans",
+                        arrays={"centroids": c2})
+            assert app.registry.poll_once() == ["km"]
+            m = app.metrics_text()
+            assert _metric(
+                m, "tdc_model_generation", '{model="km"}'
+            ) == g0 + 1
+            assert _metric(
+                m, "tdc_model_generation_age_seconds", '{model="km"}'
+            ) < 100.0
+        finally:
+            app.stop()
+
+    def test_batcher_tap_feeds_updater_and_metrics(self, traffic,
+                                                   model_dir):
+        import time as _time
+
+        _, p, _ = traffic
+        app = _mk_app(model_dir)
+        try:
+            u = OnlineUpdater(model_dir, model_id="km",
+                              registry=app.registry,
+                              config=_cfg(tick_interval=3600))
+            app.attach_online("km", u)
+            for lo in range(0, 200, 40):
+                _run_async(app, app.batcher.submit(
+                    "km", "predict", p[lo:lo + 40]
+                ))
+            # the tap runs off-loop on the batcher's executor: poll
+            deadline = _time.time() + 10
+            while (u.counters["observed_batches"] == 0
+                   and _time.time() < deadline):
+                _time.sleep(0.01)
+            assert u.counters["observed_batches"] >= 1
+            st = u.status()
+            assert st["pending_rows"] + st["holdback_rows"] > 0
+            m = app.metrics_text()
+            assert _metric(
+                m, "tdc_online_quarantined_batches_total", '{model="km"}'
+            ) == 0
+            assert _metric(
+                m, "tdc_online_observed_batches_total", '{model="km"}'
+            ) >= 1
+        finally:
+            app.stop()
+
+    def test_feed_dir_export_and_sidecar_drain(self, traffic, model_dir,
+                                               tmp_path):
+        import time as _time
+
+        _, p, _ = traffic
+        feed = str(tmp_path / "feed")
+        app = _mk_app(model_dir, feed_dir=feed, feed_sample=1)
+        try:
+            for lo in range(0, 120, 40):
+                _run_async(app, app.batcher.submit(
+                    "km", "predict", p[lo:lo + 40]
+                ))
+            # one subdirectory per model; tap writes off-loop, so poll
+            # until every dispatched batch (3 sequential submits) landed
+            sub = os.path.join(feed, "km")
+            deadline = _time.time() + 10
+            names = []
+            while len(names) < 3 and _time.time() < deadline:
+                names = ([n for n in os.listdir(sub) if n.endswith(".npy")]
+                         if os.path.isdir(sub) else [])
+                _time.sleep(0.01)
+            assert len(names) == 3, names
+            u = OnlineUpdater(model_dir, config=_cfg())
+            consumed = feed_drain(sub, u)
+            assert consumed == len(names)
+            assert u.counters["observed_batches"] == len(names)
+            assert [n for n in os.listdir(sub) if n.endswith(".npy")] == []
+        finally:
+            app.stop()
+
+    def test_feed_seq_resumes_past_existing_batches(self, tmp_path):
+        """A restarted producer must append after what is on disk, not
+        feed_write over undrained batches (feed_next_seq)."""
+        from tdc_tpu.serve.online import feed_next_seq, feed_write
+
+        feed = str(tmp_path / "feed")
+        assert feed_next_seq(feed) == 1  # missing dir: start at 1
+        feed_write(feed, np.zeros((2, DIM), np.float32), 7)
+        assert feed_next_seq(feed) == 8
+
+    def test_feed_drain_quarantines_unreadable_file(self, model_dir,
+                                                    tmp_path):
+        feed = tmp_path / "feed"
+        feed.mkdir()
+        (feed / "batch-000000000001.npy").write_bytes(b"not numpy")
+        u = OnlineUpdater(model_dir, config=_cfg())
+        assert feed_drain(str(feed), u) == 1
+        assert u.counters["quarantined_batches"] == 1
+        assert list(feed.glob("*.npy")) == []  # torn file removed
+
+    def test_admin_surface(self, traffic, model_dir):
+        _, p, _ = traffic
+        app = _mk_app(model_dir)
+        try:
+            st, body = app.handle_admin("pin", {"model": "km"})
+            assert st == 404  # no in-process updater attached
+            u = OnlineUpdater(model_dir, model_id="km",
+                              registry=app.registry,
+                              config=_cfg(tick_interval=3600))
+            app.attach_online("km", u)
+            st, body = app.handle_admin("pin", {"model": "km"})
+            assert (st, body["pinned"]) == (200, True)
+            st, body = app.handle_admin("unpin", {"model": "km"})
+            assert (st, body["pinned"]) == (200, False)
+            # rollback with nothing published is a 409, not a 500
+            st, body = app.handle_admin("rollback", {"model": "km"})
+            assert st == 409
+            st, body = app.handle_admin("nope", {"model": "km"})
+            assert st == 404
+            st, body = app.handle_admin("pin", {})
+            assert st == 400
+            # /online reports the attached updater
+            st, _, body = app.handle_get("/online")
+            assert st == 200
+            assert json.loads(body)["updaters"]["km"]["model"] == "km"
+        finally:
+            app.stop()
+
+    def test_admin_http_routing_and_online_endpoint(self, traffic,
+                                                    model_dir):
+        import urllib.error
+        import urllib.request
+
+        _, p, _ = traffic
+        app = _mk_app(model_dir)
+        u = OnlineUpdater(model_dir, model_id="km", registry=app.registry,
+                          config=_cfg(tick_interval=3600))
+        app.attach_online("km", u)
+        port = app.start_http(port=0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            req = urllib.request.Request(
+                base + "/admin/pin",
+                data=json.dumps({"model": "km"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["pinned"] is True
+            with urllib.request.urlopen(base + "/online") as r:
+                body = json.loads(r.read())
+            assert body["updaters"]["km"]["pinned"] is True
+        finally:
+            app.stop()
+
+    def test_online_loop_ticks_and_publishes(self, traffic, model_dir):
+        """The in-process loop end to end: traffic through the batcher
+        tap, the loop task folds/validates/publishes, serving hot-swaps."""
+        import time as _time
+
+        _, p, _ = traffic
+        app = _mk_app(model_dir)
+        try:
+            e0_gen = app.registry.get("km").generation
+            u = OnlineUpdater(
+                model_dir, model_id="km", registry=app.registry,
+                config=_cfg(tick_interval=0.05, min_fold_rows=64,
+                            min_holdback_rows=16),
+            )
+            app.attach_online("km", u)
+            rng = np.random.default_rng(3)
+            deadline = _time.time() + 30
+            while u.counters["publishes"] == 0 and _time.time() < deadline:
+                lo = int(rng.integers(0, p.shape[0] - 50))
+                _run_async(app, app.batcher.submit(
+                    "km", "predict", p[lo:lo + 50] + np.float32(0.3)
+                ))
+                _time.sleep(0.02)
+            assert u.counters["publishes"] >= 1, u.status()
+            assert app.registry.get("km").generation > e0_gen
+        finally:
+            app.stop()
+
+
+class TestOnlineCLI:
+    def test_status_and_pin_verbs(self, model_dir, capsys):
+        from tdc_tpu.cli.online import main
+
+        assert main(["--model_dir", model_dir, "--status"]) == 0
+        st = json.loads(capsys.readouterr().out)
+        assert st["generation"] == 0 and st["pinned"] is False
+        assert main(["--model_dir", model_dir, "--pin"]) == 0
+        assert "pinned=True" in capsys.readouterr().out
+        assert main(["--model_dir", model_dir, "--unpin"]) == 0
+
+    def test_rollback_verb_without_target_fails_loudly(self, model_dir):
+        from tdc_tpu.cli.online import main
+
+        with pytest.raises(SystemExit, match="last-good"):
+            main(["--model_dir", model_dir, "--rollback"])
+
+    def test_sidecar_needs_feed_dir(self, model_dir):
+        from tdc_tpu.cli.online import main
+
+        with pytest.raises(SystemExit):
+            main(["--model_dir", model_dir])
+
+    def test_non_kmeans_model_dir_fails_loudly(self, traffic, tmp_path):
+        from tdc_tpu.cli.online import main
+        from tdc_tpu.models.gmm import gmm_fit
+
+        x, _, _ = traffic
+        gm_dir = str(tmp_path / "gm")
+        save_fitted(gm_dir, gmm_fit(x, 3, key=jax.random.PRNGKey(1),
+                                    max_iters=3))
+        with pytest.raises(SystemExit, match="kmeans"):
+            main(["--model_dir", gm_dir, "--status"])
+
+    def test_serve_online_flag_validation(self, traffic, model_dir,
+                                          tmp_path):
+        from tdc_tpu.cli.serve import _attach_online, build_parser
+        from tdc_tpu.models.gmm import gmm_fit
+
+        x, _, _ = traffic
+        parser = build_parser()
+        app = ServeApp(poll_interval=0)
+        app.registry.add("km", model_dir)
+        args = parser.parse_args(
+            ["--model", f"km={model_dir}", "--online", "typo"]
+        )
+        with pytest.raises(SystemExit, match="registered model id"):
+            _attach_online(app, args, [("km", model_dir)], None)
+        gm_dir = str(tmp_path / "gm")
+        save_fitted(gm_dir, gmm_fit(x, 3, key=jax.random.PRNGKey(1),
+                                    max_iters=3))
+        app.registry.add("gm", gm_dir)
+        args = parser.parse_args(
+            ["--model", f"gm={gm_dir}", "--online", "gm"]
+        )
+        with pytest.raises(SystemExit, match="kmeans"):
+            _attach_online(app, args, [("gm", gm_dir)], None)
+
+    def test_serve_online_attach_happy_path(self, model_dir, capsys):
+        from tdc_tpu.cli.serve import _attach_online, build_parser
+
+        parser = build_parser()
+        app = ServeApp(poll_interval=0)
+        app.registry.add("km", model_dir)
+        args = parser.parse_args(
+            ["--model", f"km={model_dir}", "--online", "km",
+             "--online_max_churn", "0.25"]
+        )
+        _attach_online(app, args, [("km", model_dir)], None)
+        assert "km" in app.updaters
+        assert app.updaters["km"].config.max_churn == 0.25
+        assert "online updates on km" in capsys.readouterr().out
