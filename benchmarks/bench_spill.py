@@ -1,0 +1,238 @@
+"""Spill-tier benchmark: synchronous streaming vs the async H2D
+double-buffered prefetch ring (data/spill.py), plus the measured overlap
+fraction.
+
+What this measures, per config (the bench_resident.py iteration-differencing
+methodology):
+
+- **streamed_iter_s / spill_iter_s** — the marginal cost of ONE more Lloyd
+  iteration on each path: `(wall(I2) - wall(I1)) / (I2 - I1)` with tol=-1
+  pinning the iteration counts. Everything iteration count does not scale
+  (compile, init, the final reporting pass) cancels, isolating exactly what
+  the spill tier claims to change: per-batch host staging + H2D copy paid
+  serially in line with compute (streamed) vs hidden behind the previous
+  batch's compute on the producer thread (spill).
+- **overlap_fraction** — (copy time hidden) / (total copy time), by the
+  same differencing: `(streamed_iter_s - spill_iter_s) / copy_s_per_pass`,
+  where `copy_s_per_pass` is the fit result's measured producer pipeline
+  time (`h2d.copy_s`: stream read + decode + pad + device_put + transfer
+  completion). The wall-clock delta IS the copy time that left the
+  critical path. The per-fit `h2d` report also carries the raw stall
+  accounting (`stall_s`, exported as `tdc_h2d_copy_stall_seconds_total`
+  on `/metrics`) — the conservative consumer-side view a dashboard can
+  alarm on.
+- **bitexact** — spill centroids vs plain-streamed centroids via
+  `np.array_equal` (the PR-5 parity bar): the ring changes WHEN batches are
+  staged, never WHAT the accumulate ops see.
+
+The stream models the realistic over-HBM-budget source: an int8-quantized
+host store decoded to f32 per batch (a dataset kept compressed in host RAM
+precisely because it cannot live in HBM), with an optional per-batch
+`read_latency_s` emulating a cold-store read (memmap page fault / NFS /
+object-store GET — the latency component of a true out-of-core pass).
+
+CAVEAT — what a 1-core CI box can and cannot show. The CI host exposes a
+single core, so producer-side CPU work (the int8 decode, the memcpy)
+cannot genuinely parallelize with XLA compute there — only LATENCY (the
+emulated cold read; on real hardware also the DMA-driven H2D itself)
+truly overlaps. The smoke therefore gates the latency-hiding claim
+(read_latency_s > 0, the regime the spill tier exists for), and the
+warm-store sweep rows document the CPU-work-bound behavior honestly
+(speedup ≈ 1x, noise-dominated on one core). On a real TPU host the
+decode rides a spare host core and the copy rides the DMA engine, so the
+smoke's floor is conservative for both components.
+
+Run:
+  JAX_PLATFORMS=cpu python benchmarks/bench_spill.py           # sweep -> CSV
+  python benchmarks/bench_spill.py --smoke                     # CI gate
+
+Writes benchmarks/spill_cpu.csv; one JSON line per config on stdout.
+"""
+
+import csv
+import json
+import os
+import sys
+import time
+
+# Runnable as a plain script from any cwd (the serve_latency.py pattern).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tdc_tpu.data.device_cache import SizedBatches  # noqa: E402
+from tdc_tpu.models.streaming import streamed_kmeans_fit  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "spill_cpu.csv")
+FIELDS = [
+    "config", "K", "d", "n", "batch_rows", "n_batches", "i1", "i2",
+    "read_latency_ms", "streamed_iter_s", "spill_iter_s", "speedup",
+    "overlap_fraction", "copy_s_per_pass", "stall_s_per_pass",
+    "h2d_mb_per_pass", "bitexact",
+]
+
+
+def _int8_store(n, d, k, seed=123129):
+    """Clustered data quantized to an int8 host store + per-column scale —
+    the compressed at-rest form an over-budget dataset streams from."""
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10.0, 10.0, size=(k, d)).astype(np.float32)
+    x = np.repeat(centers, n // k, axis=0) + rng.normal(
+        0, 0.5, size=(n // k * k, d)
+    ).astype(np.float32)
+    rng.shuffle(x)
+    scale = (np.abs(x).max(axis=0) / 127.0).astype(np.float32)
+    x8 = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+    return x8, scale, centers
+
+
+def _stream(x8, scale, batch_rows, read_latency_s=0.0):
+    """Decode int8 -> f32 per batch, after an optional emulated cold-store
+    read wait. Exposes the spill ring's RANGED protocol (`read_batch`,
+    thread-safe) so the ring can run `slots` reads concurrently — which is
+    how the per-read latency actually hides (cold reads overlap each other
+    AND compute), while the decode (astype + multiply) is plain CPU work."""
+
+    def read(i):
+        if read_latency_s > 0.0:
+            time.sleep(read_latency_s)
+        s = i * batch_rows
+        return x8[s : s + batch_rows].astype(np.float32) * scale
+
+    def gen():
+        for i in range(-(-len(x8) // batch_rows)):
+            yield read(i)
+
+    return SizedBatches(gen, len(x8), batch_rows, itemsize=4,
+                        read_batch=read)
+
+
+def _fit(x8, scale, centers, k, d, batch_rows, iters, residency,
+         read_latency_s=0.0):
+    batches = _stream(x8, scale, batch_rows, read_latency_s)
+    t0 = time.perf_counter()
+    res = streamed_kmeans_fit(
+        batches, k, d, init=centers, max_iters=iters, tol=-1.0,
+        residency=residency,
+    )
+    jax.block_until_ready(res.centroids)
+    return time.perf_counter() - t0, res
+
+
+def run_one(config, k, d, n, batch_rows, i1, i2, repeats=3,
+            read_latency_s=0.0):
+    x8, scale, centers = _int8_store(n, d, k)
+
+    # Warm the compile caches on both paths.
+    _fit(x8, scale, centers, k, d, batch_rows, i1, "stream")
+    _fit(x8, scale, centers, k, d, batch_rows, i1, "spill")
+
+    def marginal(residency):
+        samples, r2 = [], None
+        for _ in range(repeats):
+            w1, _ = _fit(x8, scale, centers, k, d, batch_rows, i1, residency,
+                         read_latency_s)
+            w2, r2 = _fit(x8, scale, centers, k, d, batch_rows, i2, residency,
+                          read_latency_s)
+            samples.append((w2 - w1) / (i2 - i1))
+        # Median across repeats absorbs scheduler noise; clamp like
+        # bench_resident.marginal so a loaded box cannot crash the smoke.
+        return max(float(np.median(samples)), 1e-6), r2
+
+    s_iter, rs = marginal("stream")
+    p_iter, rp = marginal("spill")
+    h = rp.h2d
+    passes = i2 + 1  # iterations + the final reporting pass
+    copy_per_iter = h.copy_s / passes
+    # (copy time hidden) / (total copy time) by differencing: the
+    # wall-clock per-iteration delta is exactly the staging-pipeline time
+    # that left the critical path (everything else is identical between
+    # the two paths — same ops, same order, bit-exact results).
+    overlap = (
+        max(0.0, min(1.0, (s_iter - p_iter) / copy_per_iter))
+        if copy_per_iter > 0 else 0.0
+    )
+    row = {
+        "config": config, "K": k, "d": d, "n": n,
+        "batch_rows": batch_rows, "n_batches": -(-n // batch_rows),
+        "i1": i1, "i2": i2,
+        "read_latency_ms": round(read_latency_s * 1e3, 1),
+        "streamed_iter_s": round(s_iter, 6),
+        "spill_iter_s": round(p_iter, 6),
+        "speedup": round(s_iter / p_iter, 3),
+        "overlap_fraction": round(overlap, 3),
+        "copy_s_per_pass": round(copy_per_iter, 6),
+        "stall_s_per_pass": round(h.stall_s / passes, 6),
+        "h2d_mb_per_pass": round(h.h2d_bytes / passes / 2**20, 2),
+        "bitexact": bool(
+            np.array_equal(np.asarray(rs.centroids), np.asarray(rp.centroids))
+        ),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main(argv):
+    smoke = "--smoke" in argv
+
+    if smoke:
+        # Compute-heavy sizing: few large batches so per-batch Python
+        # dispatch is amortized and the marginal streamed iteration is
+        # cold read + decode + H2D copy + stats compute in series; the
+        # ring's concurrent reads hide the latency behind compute. The
+        # 25 ms/batch emulated cold read makes the gate load-robust on
+        # the 1-core CI box (latency hiding survives contention;
+        # CPU-work hiding does not — module docstring) while staying in
+        # range of real NFS/object-store latencies for 8 MB reads.
+        # 1.2x floor; measured headroom documented in spill_cpu.csv.
+        row = run_one("smoke_cold", k=16, d=64, n=1 << 18,
+                      batch_rows=1 << 15, i1=2, i2=5, repeats=3,
+                      read_latency_s=0.025)
+        ok = row["speedup"] >= 1.2 and row["bitexact"]
+        print(
+            "SPILL-SMOKE "
+            + ("PASS" if ok else "FAIL")
+            + f": streamed={row['streamed_iter_s'] * 1e3:.1f} ms/iter, "
+            f"spill={row['spill_iter_s'] * 1e3:.1f} ms/iter, "
+            f"speedup={row['speedup']}x (floor 1.2x), "
+            f"overlap={row['overlap_fraction']}, "
+            f"stall={row['stall_s_per_pass'] * 1e3:.1f} ms/pass of "
+            f"copy={row['copy_s_per_pass'] * 1e3:.1f} ms/pass, "
+            f"bitexact={row['bitexact']}"
+        )
+        return 0 if ok else 1
+
+    rows = [
+        # The smoke's cold-store config (emulated read latency: the
+        # honestly-overlappable component on this 1-core box) ...
+        run_one("smoke_cold", k=16, d=64, n=1 << 18, batch_rows=1 << 15,
+                i1=2, i2=5, read_latency_s=0.025),
+        # ... deeper cold read: more to hide — the win grows with the
+        # latency until the concurrent readers saturate ...
+        run_one("colder", k=16, d=64, n=1 << 18, batch_rows=1 << 15,
+                i1=2, i2=5, read_latency_s=0.050),
+        # ... warm store: decode + memcpy only — pure CPU work the 1-core
+        # CAVEAT says cannot genuinely parallelize with compute; any
+        # measured win here is scheduling slack, treat as noise-prone and
+        # ungated (real hosts hide this for real on spare cores).
+        run_one("warm_cpu_bound", k=16, d=64, n=1 << 18, batch_rows=1 << 15,
+                i1=2, i2=5),
+        # ... compute-dominated (large K): copies are a small fraction,
+        # speedup honestly shrinks toward 1x while overlap stays high —
+        # the copies still hide, there is just less of them to hide.
+        run_one("compute_heavy", k=128, d=64, n=1 << 18,
+                batch_rows=1 << 15, i1=2, i2=5, read_latency_s=0.025),
+    ]
+    with open(OUT, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=FIELDS)
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {OUT} ({len(rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,15 +1,15 @@
 #!/usr/bin/env bash
 # Tier-1 verify, one command (ROADMAP.md "Tier-1 verify"): the CPU-mesh
 # test suite (8 virtual devices via tests/conftest.py) minus slow-marked
-# tests, the comms + resident + chaos smokes, and the tdclint
+# tests, the comms + resident + spill + chaos smokes, and the tdclint
 # static-analysis gate. The suite-green invariant every PR must hold.
 #
 #   scripts/ci_tier1.sh            # tests + smokes + lint
 #   SKIP_LINT=1 scripts/ci_tier1.sh
 #
 # Exit code: the FIRST failing stage's code (pytest, then comms smoke,
-# then resident smoke, then chaos smoke, then lint), with every failed
-# stage named on stderr —
+# then resident smoke, then spill smoke, then chaos smoke, then lint),
+# with every failed stage named on stderr —
 # a run where pytest passes but both smokes fail must say so, not
 # silently collapse into one opaque code.
 set -o pipefail
@@ -55,6 +55,18 @@ if [ -z "$SKIP_RESIDENT_SMOKE" ]; then
         | tail -n 1 || resident_rc=$?
 fi
 
+# Spill smoke (benchmarks/bench_spill.py): proves the spill tier's async
+# H2D prefetch ring beats synchronous streaming by the documented >=1.2x
+# floor on the compute-heavy cold-store config, stays fp32-bit-exact with
+# it, and reports a measured overlap fraction. ~2 min (each pass carries
+# the emulated cold-read latency the ring exists to hide).
+spill_rc=0
+if [ -z "$SKIP_SPILL_SMOKE" ]; then
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        python benchmarks/bench_spill.py --smoke \
+        | tail -n 1 || spill_rc=$?
+fi
+
 # Chaos smoke (tests/test_chaos.py soak): 1 kill -9 + 1 preemption SIGTERM
 # injected via TDC_FAULTS into the 2-process gloo gang (recover both,
 # refund the SIGTERM restart, match the fault-free fit), the resident-fit
@@ -97,7 +109,8 @@ fi
 # the rest — "exit 1" with pytest green left comms vs chaos ambiguous.
 overall=0
 for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
-             "resident-smoke:$resident_rc" "chaos-smoke:$chaos_rc" \
+             "resident-smoke:$resident_rc" "spill-smoke:$spill_rc" \
+             "chaos-smoke:$chaos_rc" \
              "tdclint:$lint_rc" "ruff:$ruff_rc"; do
     name=${stage%%:*}
     rc=${stage##*:}
@@ -107,6 +120,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, chaos-smoke, lint)" >&2
 fi
 exit "$overall"

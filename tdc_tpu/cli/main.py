@@ -154,15 +154,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "(K, d) sums on the wire with error feedback "
                         "(1-D meshes only)")
     p.add_argument("--residency", type=str, default="stream",
-                   choices=("stream", "auto", "hbm"),
+                   choices=("stream", "auto", "hbm", "spill"),
                    help="streamed kmeans/fuzzy dataset residency "
                         "(data/device_cache.py): 'hbm' caches the padded "
                         "batches in device HBM during iteration 1 and runs "
                         "iterations 2..N as a compiled on-device loop with "
-                        "zero host transfers per iteration; 'auto' does the "
-                        "same when dataset + accumulators fit the HBM "
-                        "budget and falls back to streaming (loudly) when "
-                        "they don't")
+                        "zero host transfers per iteration; 'spill' "
+                        "double-buffers staging + H2D copies on a producer "
+                        "thread 2+ slots ahead of compute (data/spill.py — "
+                        "the over-HBM-budget tier, bit-exact with plain "
+                        "streaming); 'auto' picks hbm when dataset + "
+                        "accumulators fit the HBM budget, spill when only "
+                        "a slot ring fits, and falls back to streaming "
+                        "(loudly) when neither does")
     p.add_argument("--native_loader", action="store_true",
                    help="stream batches through the C++ prefetch loader "
                         "(requires --data_file pointing at an .npy)")
@@ -728,8 +732,20 @@ def run_experiment(args) -> dict:
             of at most (pad_multiple-1)/batch_rows. In the sliver where
             they disagree the planner still decides — worst case a
             slightly-too-large cap makes the fill abandon loudly and the
-            fit streams; never a silent OOM spiral."""
-            if args.residency == "stream":
+            fit streams; never a silent OOM spiral.
+
+            Explicit --residency=spill skips the cap: the ring pins only
+            (slots+1) batch slots, not the whole cache — the full-cache
+            `pinned` math below would wrongly shrink batches for a fit
+            that never builds a cache. Note an explicit spill whose ring
+            exceeds the budget is FORCED past the planner's model
+            (residency_forced_over_budget, like --residency=hbm) and can
+            OOM during staging — only 'auto' degrades ring-doesn't-fit
+            to streaming. Under 'auto' the full-cache math stays: it is
+            exactly the hbm-tier feasibility pre-check, and when the
+            cache can't fit the pinned >= budget early-return below
+            already skips the cap."""
+            if args.residency in ("stream", "spill"):
                 return rows
             from tdc_tpu.data.batching import (
                 auto_batch_size,

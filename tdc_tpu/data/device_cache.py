@@ -20,10 +20,14 @@ Three pieces:
   whether dataset + accumulators + per-batch working set fit the
   per-device HBM budget (`data/batching.device_hbm_bytes`, same safety
   fraction as `auto_batch_size`). Policy knob `residency="auto"|"hbm"|
-  "stream"`: `auto` falls back to today's streaming path when over budget
-  — LOUDLY (structlog `residency_fallback` event), never by silently
-  truncating the dataset; `hbm` forces the cache (the planner still logs
-  when its model says it won't fit).
+  "spill"|"stream"`: when the cache is over budget, `auto` first tries the
+  SPILL tier (data/spill.py — a double-buffered prefetch ring that hides
+  H2D copies behind compute; chosen when a `(slots+1)`-slot ring fits the
+  budget, announced via a structlog `residency_spill` event) and only then
+  falls back to today's synchronous streaming path — LOUDLY (structlog
+  `residency_fallback` event), never by silently truncating the dataset;
+  `hbm`/`spill` force their tier (the planner still logs when its model
+  says it won't fit).
 - `DeviceCacheBuilder` — fills the cache during the first streamed pass:
   full batches land in one preallocated stacked (n_full, B_pad, d) device
   array (donated dynamic-update-slice per batch: peak HBM = dataset + one
@@ -55,7 +59,7 @@ from tdc_tpu.data.batching import (
     working_set_row_bytes,
 )
 
-RESIDENCY_MODES = ("stream", "auto", "hbm")
+RESIDENCY_MODES = ("stream", "auto", "hbm", "spill")
 
 # Device-resident model-state copies the budget reserves next to the cache:
 # accumulator + fresh per-batch stats + old/new centroids + the deferred
@@ -129,15 +133,20 @@ def stream_itemsize(batches) -> int | None:
 class SizedBatches:
     """Attach the sizing protocol to an arbitrary zero-arg batch callable
     so the residency planner can budget it (tests/benchmarks; NpzStream
-    and NativePrefetchStream already advertise natively)."""
+    and NativePrefetchStream already advertise natively). `read_batch`
+    optionally attaches the spill ring's RANGED protocol (a thread-safe
+    random-access batch read, data/spill.ranged_reader) so the spill tier
+    can overlap several reads."""
 
     def __init__(self, fn, n_rows: int, batch_rows: int,
-                 itemsize: int | None = None):
+                 itemsize: int | None = None, read_batch=None):
         self._fn = fn
         self.n_rows = int(n_rows)
         self.batch_rows = int(batch_rows)
         if itemsize is not None:
             self.itemsize = int(itemsize)
+        if read_batch is not None:
+            self.read_batch = read_batch
 
     @property
     def num_batches(self) -> int:
@@ -149,8 +158,8 @@ class SizedBatches:
 
 @dataclass(frozen=True)
 class ResidencyPlan:
-    """The planner's decision. mode is what the fit will DO ("hbm" or
-    "stream"); requested is what the caller asked for."""
+    """The planner's decision. mode is what the fit will DO ("hbm",
+    "spill", or "stream"); requested is what the caller asked for."""
 
     mode: str
     requested: str
@@ -159,10 +168,16 @@ class ResidencyPlan:
     resident_bytes: int  # per-device cache bytes (0 when streaming)
     reserve_bytes: int  # per-device working set reserved next to it
     budget_bytes: int  # per-device HBM budget (safety-scaled)
+    spill_bytes: int = 0  # per-device slot-ring bytes (spill mode only)
+    spill_slots: int = 0  # ring slots the spill mode will run with
 
     @property
     def resident(self) -> bool:
         return self.mode == "hbm"
+
+    @property
+    def spill(self) -> bool:
+        return self.mode == "spill"
 
 
 def _round_up(n: int, multiple: int) -> int:
@@ -185,8 +200,9 @@ def plan_residency(
     mid_pass_ckpt: bool = False,
     device=None,
     label: str = "fit",
+    spill_slots: int | None = None,
 ) -> ResidencyPlan:
-    """Decide streaming vs HBM residency for one fit.
+    """Decide streaming vs HBM residency vs the spill tier for one fit.
 
     Geometry: `hints` describe THIS PROCESS's stream; each full batch of
     `batch_rows` local rows is padded to `pad_multiple` and becomes
@@ -199,17 +215,37 @@ def plan_residency(
       + _STATE_COPIES * K * d * 4              (accumulators + centroids)
       <= hbm_budget_bytes                      (the safety-scaled HBM)
 
-    `auto` over budget (or without hints) falls back to streaming with a
-    structlog `residency_fallback` event — loud, never a silent truncation.
-    `hbm` forces the cache (logging when the model disagrees); it requires
-    hints, and a mid-pass resume cursor degrades both modes to streaming
-    (the cache fill cannot replay a half-consumed pass).
+    The SPILL tier (data/spill.py) sits between the two: when the full
+    cache is over budget but a `(spill_slots + 1)`-deep ring of prepared
+    batch slots fits —
+
+        (spill_slots + 1) * batch_rows_per_dev * d * itemsize   the ring
+      + reserve (one batch's stats pass + the state copies)
+      <= hbm_budget_bytes
+
+    — `auto` streams WITH async double-buffered H2D prefetch instead of
+    synchronously: the copy of batch i+1 overlaps batch i's compute.
+    Requesting `"spill"` forces the ring (like `hbm`, logging
+    `residency_forced_over_budget` when the model disagrees; unlike `hbm`
+    it works without hints — the ring needs no geometry, only the budget
+    check does). Every spill selection emits a structlog `residency_spill`
+    event naming the trigger.
+
+    `auto` over budget (cache AND ring; or without hints) falls back to
+    streaming with a structlog `residency_fallback` event — loud, never a
+    silent truncation. `hbm` forces the cache (logging when the model
+    disagrees); it requires hints, and a mid-pass resume cursor degrades
+    every mode to streaming (the cache fill cannot replay a half-consumed
+    pass, and the ring would re-stage a replay prefix the consumer skips).
 
     `mid_pass_ckpt` (the fit's ckpt_every_batches) is INCOMPATIBLE with
-    residency: the compiled chunk has no host batch boundaries, so the
+    HBM residency: the compiled chunk has no host batch boundaries, so the
     resident iterations could not honor the bounded-loss durability the
     knob promises — `hbm` raises, `auto` falls back loudly rather than
-    silently narrowing the PR-3 contract to chunk-boundary saves.
+    silently narrowing the PR-3 contract to chunk-boundary saves. The
+    spill tier PRESERVES host batch boundaries (heartbeats, mid-pass
+    saves, preemption drains all land per batch), so `"spill"` composes
+    with ckpt_every_batches unchanged.
 
     Elastic resize (parallel/reshard.py): the cache is derived state and
     is never persisted — a gang relaunched at a different size replans
@@ -222,13 +258,18 @@ def plan_residency(
 
     if requested not in RESIDENCY_MODES:
         raise ValueError(
-            f"residency={requested!r}: use 'stream', 'auto', or 'hbm'"
+            f"residency={requested!r}: use one of {RESIDENCY_MODES}"
         )
+    from tdc_tpu.data.spill import DEFAULT_SPILL_SLOTS
+
+    slots = DEFAULT_SPILL_SLOTS if spill_slots is None else int(spill_slots)
+    if slots < 2:
+        raise ValueError(f"spill_slots must be >= 2, got {slots}")
     budget = hbm_budget_bytes(device)
     if requested == "stream":
         return ResidencyPlan("stream", requested, "requested", hints, 0, 0,
                              budget)
-    if mid_pass_ckpt:
+    if mid_pass_ckpt and requested != "spill":
         if requested == "hbm":
             raise ValueError(
                 "residency='hbm' is incompatible with ckpt_every_batches: "
@@ -258,10 +299,19 @@ def plan_residency(
                 "NativePrefetchStream, or wrap the callable in "
                 "data.device_cache.SizedBatches(fn, n_rows, batch_rows)"
             )
+        if requested == "spill":
+            # The ring is geometry-free; only its budget check needs hints.
+            emit("residency_spill", label=label, requested=requested,
+                 reason="requested_no_hints", spill_slots=slots,
+                 detail="stream advertises no size — running the prefetch "
+                        "ring without a budget feasibility check")
+            return ResidencyPlan("spill", requested, "requested_no_hints",
+                                 None, 0, 0, budget, spill_bytes=0,
+                                 spill_slots=slots)
         emit("residency_fallback", label=label, requested=requested,
              reason="no_size_hints",
              detail="stream advertises no num_batches/batch_rows/n_rows; "
-                    "cannot budget a cache — streaming")
+                    "cannot budget a cache or a spill ring — streaming")
         return ResidencyPlan("stream", requested, "no_size_hints", None,
                              0, 0, budget)
 
@@ -279,7 +329,12 @@ def plan_residency(
                                               kernel=kernel)
         + state_reserve_bytes(k, d)
     )
-    if resident + reserve <= budget:
+    # The spill ring's HBM footprint: `slots - 1` queued + one in the
+    # producer's hand + one being consumed (data/spill.py's peak bound).
+    slot = batch_per_dev * d * itemsize + (batch_per_dev * 4 if weighted
+                                           else 0)
+    ring = (slots + 1) * slot
+    if requested != "spill" and resident + reserve <= budget:
         return ResidencyPlan("hbm", requested, "fits", hints, resident,
                              reserve, budget)
     if requested == "hbm":
@@ -291,11 +346,35 @@ def plan_residency(
                     "streaming")
         return ResidencyPlan("hbm", requested, "forced", hints, resident,
                              reserve, budget)
+    if ring + reserve <= budget:
+        reason = "requested" if requested == "spill" else "cache_over_budget"
+        emit("residency_spill", label=label, requested=requested,
+             reason=reason, spill_slots=slots, spill_bytes=ring,
+             resident_bytes=resident, reserve_bytes=reserve,
+             budget_bytes=budget,
+             detail="prefetch ring fits the per-device budget; H2D copies "
+                    "will overlap compute"
+                    + ("" if requested == "spill"
+                       else " (full HBM cache is over budget)"))
+        return ResidencyPlan("spill", requested, reason, hints, resident,
+                             reserve, budget, spill_bytes=ring,
+                             spill_slots=slots)
+    if requested == "spill":
+        emit("residency_forced_over_budget", label=label,
+             resident_bytes=resident, reserve_bytes=reserve,
+             spill_bytes=ring, budget_bytes=budget,
+             detail="residency='spill' forced past the planner's budget "
+                    "model (even the slot ring exceeds it); an HBM OOM "
+                    "during staging will fail the fit")
+        return ResidencyPlan("spill", requested, "forced", hints, resident,
+                             reserve, budget, spill_bytes=ring,
+                             spill_slots=slots)
     emit("residency_fallback", label=label, requested=requested,
          reason="over_budget", resident_bytes=resident,
-         reserve_bytes=reserve, budget_bytes=budget,
-         detail="dataset + accumulators exceed the per-device HBM budget; "
-                "streaming every pass instead (no truncation)")
+         reserve_bytes=reserve, spill_bytes=ring, budget_bytes=budget,
+         detail="dataset + accumulators exceed the per-device HBM budget "
+                "and even the spill slot ring does not fit; streaming "
+                "every pass instead (no truncation)")
     return ResidencyPlan("stream", requested, "over_budget", hints,
                          resident, reserve, budget)
 

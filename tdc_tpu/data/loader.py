@@ -145,9 +145,17 @@ class NpzStream:
         self.batch_rows = int(batch_rows)
 
     def __call__(self) -> Iterator[np.ndarray]:
-        n = self.x.shape[0]
-        for start in range(0, n, self.batch_rows):
-            yield np.ascontiguousarray(self.x[start : start + self.batch_rows])
+        for i in range(self.num_batches):
+            yield self.read_batch(i)
+
+    def read_batch(self, i: int) -> np.ndarray:
+        """Random-access batch read (the spill ring's RANGED protocol,
+        data/spill.ranged_reader): batch `i` of the `__call__` order.
+        Thread-safe — a pure slice-copy of the backing (mem)map, so the
+        spill tier can run several reads concurrently to hide per-read
+        latency (cold page faults on a memmapped .npy)."""
+        start = i * self.batch_rows
+        return np.ascontiguousarray(self.x[start : start + self.batch_rows])
 
     @property
     def num_batches(self) -> int:

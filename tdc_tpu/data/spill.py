@@ -1,0 +1,401 @@
+"""Spill tier: async host→HBM double-buffered prefetch for over-budget
+streamed fits.
+
+The HBM cache (data/device_cache.py) makes iterations 2..N zero-round-trip
+when the whole dataset fits the per-device budget; past that budget the
+streamed path pays every batch's host staging + H2D copy serially, in line
+with compute. This module is the middle tier: a bounded ring of in-flight
+device batches, filled ahead of the consumer by a producer thread that runs
+the driver's staging path (pad → `jax.device_put`, mesh-laid-out) — so the
+copy of batch i+1 overlaps batch i's compute, the same
+movement-off-the-critical-path discipline Mesh-TensorFlow-era SPMD systems
+apply at supercomputer scale (PAPERS.md, arXiv:1811.02084) and the
+portable-redistribution work makes explicit for bulk array movement
+(arXiv:2112.01075).
+
+Design constraints, in order:
+
+- **Bit-exactness.** The ring changes WHEN a prepared batch exists, never
+  WHAT it is: the consumer sees the exact `(xb, n_valid, n_local[, wb])`
+  tuples the synchronous path would have built, in stream order, feeding
+  the same accumulate ops — so spill results are fp32-bit-exact with plain
+  streaming (the PR-5 parity bar, `assert_array_equal`).
+- **Bounded HBM.** The queue holds at most `slots - 1` staged batches, the
+  producer one more in hand, the consumer one being computed on: peak
+  extra HBM is `(slots + 1)` batch slots, the number `plan_residency`
+  budgets. A consumed batch's buffer frees when the step drops its
+  reference (XLA reclaims it once the dispatched compute has read it) —
+  that refcount hand-back is the slot reuse; nothing is copied twice.
+- **Boundary contract (PR 3).** Host batch boundaries are PRESERVED:
+  heartbeats, mid-pass checkpoint saves, and preemption drains all still
+  land per batch on the consumer — unlike the resident chunk loop, spill
+  changes no durability or liveness cadence.
+
+`prefetch_map` is the producer-thread machinery — the generalization of
+`models/streaming._prefetched` (which now delegates here): same bounded
+queue, stop-event + drain on generator close (no leaked threads pinning
+batches), producer exceptions re-raised at the consumer. `spill_stream`
+wraps a driver's batch stream with a staging `prepare` on that thread plus
+the H2D accounting (`H2DCounter`) the fit result and `/metrics` surface.
+
+Streams that additionally expose the RANGED protocol — a thread-safe
+`read_batch(i)` next to `num_batches` (NpzStream does natively) — get
+CONCURRENT staging: up to `slots` reads+copies in flight on a small pool,
+delivered strictly in order. Sequential-iterator streams keep the serial
+producer (staging still leaves the dispatch thread); the ranged path is
+what hides per-read LATENCY (cold memmap page faults, NFS/object-store
+GETs) rather than just moving CPU work aside — overlapping reads with each
+other is the same discipline tf.data's parallel interleave applies, and
+the reason the over-budget billion-row pass can approach compute-bound.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import NamedTuple
+
+# In-flight device batch slots the ring targets ahead of the consumer.
+# 2 = classic double buffering: one slot computing, one filling.
+DEFAULT_SPILL_SLOTS = 2
+
+
+class StagedBatch(NamedTuple):
+    """One prepared batch: device-resident, padded, mesh-laid-out — exactly
+    what the drivers' inline staging (`_prepare_batch` / `put_batch`)
+    produces, carried across the ring so the consumer step skips staging."""
+
+    xb: object  # device points (B_pad, d)
+    n_valid: object  # global valid-row count (host int)
+    n_local: object  # this host's raw row count (resume accounting)
+    wb: object = None  # device weights (B_pad,) for weighted streams
+
+
+class H2DCounter:
+    """Host-side tally of the spill ring's transfer work (the
+    parallel/reduce.CommsCounter pattern): logical bytes staged host→device,
+    batches staged, seconds the PRODUCER spent on the full staging pipeline
+    per batch — stream read/decode + pad + `device_put` + transfer
+    completion (`copy_s`), seconds the CONSUMER stalled waiting on the ring
+    (`stall_s`), and the deepest ring fill observed. Thread-safe: the
+    producer and consumer threads write concurrently and the serve /metrics
+    scrape reads from a third."""
+
+    def __init__(self, _mirror=None):
+        self._lock = threading.Lock()
+        self._mirror = _mirror
+        self.h2d_bytes = 0
+        self.batches = 0
+        self.copy_s = 0.0
+        self.stall_s = 0.0
+        self.depth_max = 0
+
+    def add_copy(self, nbytes: int, seconds: float) -> None:
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
+            self.batches += 1
+            self.copy_s += float(seconds)
+        if self._mirror is not None:
+            self._mirror.add_copy(nbytes, seconds)
+
+    def add_stall(self, seconds: float) -> None:
+        with self._lock:
+            self.stall_s += float(seconds)
+        if self._mirror is not None:
+            self._mirror.add_stall(seconds)
+
+    def sample_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self.depth_max:
+                self.depth_max = depth
+        if self._mirror is not None:
+            self._mirror.sample_depth(depth)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "h2d_bytes": self.h2d_bytes,
+                "batches": self.batches,
+                "copy_s": self.copy_s,
+                "stall_s": self.stall_s,
+                "depth_max": self.depth_max,
+            }
+
+    def report(self, slots: int) -> "SpillReport":
+        s = self.snapshot()
+        return SpillReport(
+            slots=int(slots),
+            batches=s["batches"],
+            h2d_bytes=s["h2d_bytes"],
+            copy_s=s["copy_s"],
+            stall_s=s["stall_s"],
+            depth_max=s["depth_max"],
+        )
+
+
+# Process-wide counter (mirrored into by every per-fit counter); surfaced
+# by the serve /metrics endpoint as tdc_h2d_*.
+GLOBAL_H2D = H2DCounter()
+
+
+class SpillReport(NamedTuple):
+    """Per-fit spill-ring summary attached to fit results (the CommsReport
+    sibling). `copy_s` and `stall_s` are the observable stall accounting:
+    total producer staging-pipeline seconds vs how long the consumer
+    actually waited on the ring. The authoritative overlap fraction —
+    (copy time hidden) / (total copy time) — is measured by wall-clock
+    iteration differencing (benchmarks/bench_spill.py), because on
+    async-dispatch backends the consumer thread runs ahead of device
+    compute and its ring waits over-count the unhidden copy time; the
+    in-report `overlap_lower_bound` is exactly that conservative
+    consumer-side view, useful as a starvation alarm (a pipeline whose
+    bound drops toward 0 is producer-starved), not as the headline."""
+
+    slots: int  # ring slots requested
+    batches: int  # batches staged through the ring
+    h2d_bytes: int  # logical bytes staged host→device
+    copy_s: float  # producer seconds: read/decode + pad + put + completion
+    stall_s: float  # consumer seconds stalled waiting on the ring
+    depth_max: int  # deepest ring fill observed
+
+    @property
+    def overlap_lower_bound(self) -> float:
+        """1 - stall_s/copy_s, clamped to [0, 1]: the consumer-side
+        conservative floor on the hidden-copy fraction (see class doc)."""
+        if self.copy_s <= 0.0:
+            return 0.0
+        return max(0.0, min(1.0, 1.0 - self.stall_s / self.copy_s))
+
+
+def prefetch_map(it, depth: int, counter: H2DCounter | None = None):
+    """Pull `it` on a background thread through a bounded queue — the
+    producer-thread machinery behind both `models/streaming._prefetched`
+    (host-side batch staging overlap) and `spill_stream` (whose staged
+    iterator runs the device staging — the H2D copy itself — on this
+    thread, ahead of the consumer).
+
+    depth <= 0 yields `it` inline (the degenerate synchronous path, used
+    only as a guard). Producer exceptions — raised by the iterator,
+    including any staging composed into it — re-raise in the consumer
+    after any already-queued items — promptly, never as a hung stream.
+    Early consumer exit (break / .close() / GC of the generator) sets a
+    stop event and drains the queue, so a producer blocked on `q.put`
+    into the full bounded queue wakes and terminates instead of parking
+    forever on a daemon thread that pins every produced batch in memory.
+
+    `counter` (spill only) books the consumer's ring-wait seconds
+    (`add_stall`) and samples the queue depth after each successful put.
+    """
+    if depth <= 0:
+        yield from it
+        return
+    import queue as _queue
+
+    q = _queue.Queue(maxsize=depth)
+    _END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for item in it:
+                if not _put(item):
+                    return
+                if stop.is_set():
+                    # A put parked on the full queue can still succeed
+                    # AFTER close (the close-path drain frees its slot);
+                    # re-check here so the producer never pulls another
+                    # item from the source past the consumer's exit.
+                    return
+                if counter is not None:
+                    counter.sample_depth(q.qsize())
+            _put(_END)
+        except BaseException as e:  # propagate (incl. injected test crashes)
+            _put(e)
+
+    t = threading.Thread(target=produce, name="tdc-prefetch", daemon=True)
+    t.start()
+    try:
+        while True:
+            if counter is None:
+                item = q.get()
+            else:
+                t0 = time.perf_counter()
+                item = q.get()
+                counter.add_stall(time.perf_counter() - t0)
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        # Drain so a producer mid-put frees its slot immediately (it would
+        # otherwise wake only on the 0.1 s poll) and queued batches drop
+        # their references.
+        try:
+            while True:
+                q.get_nowait()
+        except _queue.Empty:
+            pass
+
+
+def _staged_iter(batches, prepare, counter: H2DCounter | None):
+    """One staged pass: pull the raw stream, run `prepare`, block until the
+    staged leaves are device-resident (the slot is only handed over FULL —
+    which is also what makes `copy_s` the real read+stage+transfer time per
+    batch, not the async enqueue time), book bytes + wall seconds. Runs
+    entirely on prefetch_map's producer thread."""
+    import jax
+
+    it = iter(batches())
+    while True:
+        t0 = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        staged = prepare(batch)
+        leaves = [staged.xb] if staged.wb is None else [staged.xb, staged.wb]
+        jax.block_until_ready(leaves)
+        if counter is not None:
+            counter.add_copy(
+                sum(int(leaf.nbytes) for leaf in leaves),
+                time.perf_counter() - t0,
+            )
+        yield staged
+
+
+def ranged_reader(batches):
+    """Read the RANGED protocol off a stream: `read_batch(i)` (thread-safe
+    random-access batch read, 0 <= i < num_batches, batch i identical to
+    the i-th item of `batches()`) next to `num_batches`. Returns
+    (read_batch, n_batches) or None when the stream only iterates
+    sequentially (bare generators, the C++ NativePrefetchStream)."""
+    rb = getattr(batches, "read_batch", None)
+    nb = getattr(batches, "num_batches", None)
+    if rb is None or nb is None:
+        return None
+    try:
+        nb = int(nb)
+    except (TypeError, ValueError):
+        return None
+    return (rb, nb) if nb >= 1 else None
+
+
+def _concurrent_staged(read_batch, n_batches: int, prepare, slots: int,
+                       counter: H2DCounter | None):
+    """One staged pass with up to `slots` read+stage pipelines in flight,
+    delivered strictly in stream order (bit-exactness: order is the
+    consumer's, concurrency only changes WHEN slots fill). In-flight
+    device memory is bounded by the `slots` outstanding futures plus the
+    batch being consumed — the same (slots + 1) bound the serial ring and
+    `plan_residency` use. Early close cancels undispatched reads and joins
+    the pool; a read/staging exception re-raises at the consumer in order,
+    promptly."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    def stage(i):
+        t0 = time.perf_counter()
+        staged = prepare(read_batch(i))
+        leaves = ([staged.xb] if staged.wb is None
+                  else [staged.xb, staged.wb])
+        jax.block_until_ready(leaves)
+        if counter is not None:
+            counter.add_copy(
+                sum(int(leaf.nbytes) for leaf in leaves),
+                time.perf_counter() - t0,
+            )
+        return staged
+
+    ex = ThreadPoolExecutor(max_workers=max(slots, 1),
+                            thread_name_prefix="tdc-spill")
+    try:
+        futs = deque(ex.submit(stage, i)
+                     for i in range(min(slots, n_batches)))
+        nxt = len(futs)
+        while futs:
+            t0 = time.perf_counter()
+            staged = futs.popleft().result()
+            if counter is not None:
+                counter.add_stall(time.perf_counter() - t0)
+                counter.sample_depth(sum(f.done() for f in futs))
+            if nxt < n_batches:
+                futs.append(ex.submit(stage, nxt))
+                nxt += 1
+            yield staged
+    finally:
+        # Generator close / consumer exception: drop queued reads, join
+        # the workers (bounded: at most `slots` stages finish and are
+        # dropped with their references).
+        for f in futs:
+            f.cancel()
+        ex.shutdown(wait=True)
+
+
+def spill_stream(batches, prepare, *, slots: int = DEFAULT_SPILL_SLOTS,
+                 counter: H2DCounter | None = None):
+    """Wrap a zero-arg batch stream so the stream read + staging + H2D run
+    `slots` deep ahead of the consumer. `prepare(batch) -> StagedBatch` is
+    the driver's own inline staging path, moved off the dispatch thread
+    unchanged — the consumer's step recognizes StagedBatch and skips
+    staging, so the op sequence (and therefore the fp32 result) is
+    identical to plain streaming. Ranged streams (`ranged_reader`) get
+    `slots` CONCURRENT read+stage pipelines with in-order delivery;
+    sequential streams get the single-producer bounded ring. Returns a
+    zero-arg callable with the same re-iterable protocol (fresh
+    threads per pass)."""
+    slots = max(int(slots), 2)
+    ranged = ranged_reader(batches)
+
+    def stream():
+        if ranged is not None:
+            return _concurrent_staged(ranged[0], ranged[1], prepare, slots,
+                                      counter)
+        return prefetch_map(_staged_iter(batches, prepare, counter),
+                            slots - 1, counter=counter)
+
+    return stream
+
+
+def wrap_stream(plan, batches, prepare):
+    """The streamed drivers' ONE spill wiring point: when `plan` (a
+    device_cache.ResidencyPlan or None) selected the spill tier, return
+    (ring-wrapped stream, per-fit H2DCounter mirrored into GLOBAL_H2D);
+    otherwise (batches, None) and the caller keeps its inline staging and
+    prefetch knob. A spill-wrapped stream supersedes `_prefetched` — pass
+    prefetch 0 when the counter is non-None. Shared so the four drivers'
+    staging-to-ring bridges cannot drift (the _make_put_batch lesson)."""
+    if plan is None or not plan.spill:
+        return batches, None
+    counter = H2DCounter(_mirror=GLOBAL_H2D)
+    return (
+        spill_stream(batches, prepare, slots=plan.spill_slots,
+                     counter=counter),
+        counter,
+    )
+
+
+__all__ = [
+    "DEFAULT_SPILL_SLOTS",
+    "GLOBAL_H2D",
+    "H2DCounter",
+    "SpillReport",
+    "StagedBatch",
+    "prefetch_map",
+    "ranged_reader",
+    "spill_stream",
+    "wrap_stream",
+]

@@ -41,6 +41,9 @@ class FuzzyCMeansResult(NamedTuple):
     # parallel/reduce.CommsReport — cross-device stats-reduce accounting,
     # filled by the streamed drivers (None for in-memory fits).
     comms: object = None
+    # data/spill.SpillReport — H2D prefetch-ring accounting, filled when
+    # the fit ran the spill residency tier (None otherwise).
+    h2d: object = None
 
 
 def _fuzzy_stats_fn(kernel: str, m: float, block_rows: int, mesh=None):

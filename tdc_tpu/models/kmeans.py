@@ -41,6 +41,10 @@ class KMeansResult(NamedTuple):
     # parallel/reduce.CommsReport — cross-device stats-reduce accounting,
     # filled by the streamed drivers (None for in-memory fits).
     comms: object = None
+    # data/spill.SpillReport — H2D prefetch-ring accounting (bytes staged,
+    # stall seconds, overlap fraction), filled when the fit ran the spill
+    # residency tier (None otherwise).
+    h2d: object = None
 
 
 def _normalize(c: jax.Array) -> jax.Array:

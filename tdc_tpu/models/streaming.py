@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tdc_tpu.data import device_cache as device_cache_lib
+from tdc_tpu.data import spill as spill_lib
 from tdc_tpu.models import resident as resident_lib
 from tdc_tpu.ops.assign import (
     FuzzyStats,
@@ -138,56 +139,12 @@ def _prefetched(it, depth: int):
     `q.put` into the full bounded queue wakes and terminates instead of
     parking forever on a daemon thread that pins every produced batch in
     memory (each abandoned pass leaked `depth`+1 batches until process
-    exit)."""
-    if depth <= 0:
-        yield from it
-        return
-    import queue as _queue
-    import threading
+    exit).
 
-    q = _queue.Queue(maxsize=depth)
-    _END = object()
-    stop = threading.Event()
-
-    def _put(item) -> bool:
-        """Bounded put that gives up when the consumer is gone."""
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except _queue.Full:
-                continue
-        return False
-
-    def produce():
-        try:
-            for item in it:
-                if not _put(item):
-                    return
-            _put(_END)
-        except BaseException as e:  # propagate (incl. injected test crashes)
-            _put(e)
-
-    t = threading.Thread(target=produce, name="tdc-prefetch", daemon=True)
-    t.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _END:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        stop.set()
-        # Drain so a producer mid-put frees its slot immediately (it would
-        # otherwise wake only on the 0.1 s poll) and queued batches drop
-        # their references.
-        try:
-            while True:
-                q.get_nowait()
-        except _queue.Empty:
-            pass
+    The bounded-queue machinery itself lives in data/spill.py
+    (`prefetch_map`), where the spill tier reuses it with the device
+    staging (`jax.device_put`) moved onto the same producer thread."""
+    return spill_lib.prefetch_map(it, depth)
 
 
 # Ready-wait cadence for the streamed pass loop (see _run_pass docstring):
@@ -756,7 +713,8 @@ def _plan_1d_residency(residency, batches, k, d, spec: MeshSpec, *,
     validates and returns (None, None) with zero overhead."""
     if residency not in device_cache_lib.RESIDENCY_MODES:
         raise ValueError(
-            f"residency={residency!r}: use 'stream', 'auto', or 'hbm'"
+            f"residency={residency!r}: use one of "
+            f"{device_cache_lib.RESIDENCY_MODES}"
         )
     if residency == "stream":
         return None, None
@@ -1159,8 +1117,8 @@ def streamed_kmeans_fit(
         any strategy reduce in two stages, ICI first. See
         parallel/reduce.py; the fit result's `comms` field reports reduces
         issued and logical bytes moved.
-      residency: "stream" (default — today's behavior), "hbm", or "auto"
-        (data/device_cache.py). Under "hbm"/"auto", iteration 1 streams AND
+      residency: "stream" (default — today's behavior), "hbm", "spill", or
+        "auto" (data/device_cache.py). Under "hbm"/"auto", iteration 1 streams AND
         fills a per-device HBM cache of the (padded, mesh-laid-out)
         dataset; iterations 2..N then run as a compiled on-device loop
         (models/resident.py) with donated centroid carry, the convergence
@@ -1171,9 +1129,21 @@ def streamed_kmeans_fit(
         the streamed path: the cache replays the exact per-batch geometry
         and accumulation order. "auto" requires the stream to advertise
         its size (NpzStream does; see device_cache.stream_hints) and falls
-        back to streaming — loudly, via a structlog `residency_fallback`
-        event — when the dataset + accumulators exceed the HBM budget;
-        it never truncates. A mid-pass checkpoint resume also degrades to
+        back — loudly, via structlog events — when the dataset +
+        accumulators exceed the HBM budget; it never truncates. The
+        fallback is two-tier: an over-budget dataset whose per-batch slot
+        ring still fits runs as "spill" (data/spill.py — a producer
+        thread stages + `jax.device_put`s batches 2+ slots ahead of the
+        consumer, hiding each batch's H2D copy behind the previous
+        batch's compute; results stay fp32-bit-exact with plain
+        streaming, and the fit result's `h2d` field reports bytes
+        staged, consumer stall seconds, and the measured overlap
+        fraction), and only when even the ring does not fit does `auto`
+        degrade to synchronous streaming (`residency_fallback`).
+        "spill" forces the ring explicitly; unlike "hbm" it preserves
+        host batch boundaries, so it composes with ckpt_every_batches,
+        per-batch heartbeats, and preemption drains unchanged. A
+        mid-pass checkpoint resume degrades every mode to
         streaming for that run (the fill cannot replay a partial pass).
     """
     if kernel not in ("xla", "pallas"):
@@ -1241,11 +1211,25 @@ def streamed_kmeans_fit(
     deferred, n_mesh_dev = _reduce_plan(
         strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=state.cursor
     )
-    _, builder = _plan_1d_residency(
+    r_plan, builder = _plan_1d_residency(
         residency, batches, k, d, spec, weighted=weighted, kernel=kernel,
         cursor=state.cursor, label="streamed_kmeans_fit",
         mid_pass_ckpt=ckpt_every_batches is not None,
     )
+
+    def _stage(batch):
+        # The driver's staging path — shared by the inline step and the
+        # spill ring's producer thread, so the consumer sees identical
+        # arrays either way (the spill parity bar).
+        if weighted:
+            xb, wb, n_local = _prepare_weighted_batch(batch[0], batch[1],
+                                                      mesh)
+            return spill_lib.StagedBatch(xb, xb.shape[0], n_local, wb)
+        xb, n_valid, n_local = _prepare_batch(batch, mesh)
+        return spill_lib.StagedBatch(xb, n_valid, n_local)
+
+    run_stream, h2d = spill_lib.wrap_stream(r_plan, stream, _stage)
+    run_prefetch = prefetch if h2d is None else 0
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
     passes = [0]
     axes = mesh_lib.data_axes(mesh) if mesh is not None else ()
@@ -1266,10 +1250,10 @@ def streamed_kmeans_fit(
         bdt = ["float32"]
 
         def step(acc, batch):
+            sb = (batch if isinstance(batch, spill_lib.StagedBatch)
+                  else _stage(batch))
             if weighted:
-                xb, wb, n_local = _prepare_weighted_batch(
-                    batch[0], batch[1], mesh
-                )
+                xb, wb, n_local = sb.xb, sb.wb, sb.n_local
                 if fill is not None:
                     fill.add(xb, xb.shape[0], wb)
                 if deferred:
@@ -1281,7 +1265,7 @@ def streamed_kmeans_fit(
                                          mesh),
                     n_local,
                 )
-            xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            xb, n_valid, n_local = sb.xb, sb.n_valid, sb.n_local
             if fill is not None:
                 fill.add(xb, n_valid)
             if deferred:
@@ -1296,7 +1280,7 @@ def streamed_kmeans_fit(
             )
 
         acc = _run_pass(
-            stream, prefetch, d_zero if deferred else zero_stats, step,
+            run_stream, run_prefetch, d_zero if deferred else zero_stats, step,
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
@@ -1419,6 +1403,7 @@ def streamed_kmeans_fit(
             strategy=strategy.label(), reduces=counter.reduces,
             logical_bytes=counter.logical_bytes, passes=passes[0],
         ),
+        h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
     )
 
 
@@ -1588,10 +1573,13 @@ def streamed_fuzzy_fit(
     with sample_weight_batches — no weighted Pallas kernel), the
     `reduce=` strategy knob ("per_batch" / "per_pass" /
     "per_pass:bf16|int8" — see streamed_kmeans_fit and
-    parallel/reduce.py), and the `residency=` HBM-cache knob ("stream" /
-    "auto" / "hbm" — iteration 1 fills a per-device HBM cache, iterations
-    2..N run as a compiled on-device loop with zero host transfers per
-    iteration; see streamed_kmeans_fit and data/device_cache.py)."""
+    parallel/reduce.py), and the `residency=` knob ("stream" / "auto" /
+    "hbm" / "spill" — "hbm" fills a per-device HBM cache during iteration
+    1 and runs iterations 2..N as a compiled on-device loop with zero
+    host transfers per iteration; "spill" double-buffers H2D copies
+    behind compute for over-budget datasets; "auto" picks hbm, then
+    spill, then plain streaming, all loudly; see streamed_kmeans_fit,
+    data/device_cache.py, and data/spill.py)."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     if kernel not in ("xla", "pallas"):
@@ -1658,11 +1646,24 @@ def streamed_fuzzy_fit(
     deferred, n_mesh_dev = _reduce_plan(
         strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=state.cursor
     )
-    _, builder = _plan_1d_residency(
+    r_plan, builder = _plan_1d_residency(
         residency, batches, k, d, spec, weighted=weighted, kernel=kernel,
         cursor=state.cursor, label="streamed_fuzzy_fit",
         mid_pass_ckpt=ckpt_every_batches is not None,
     )
+
+    def _stage(batch):
+        # Shared by the inline step and the spill ring's producer thread
+        # (streamed_kmeans_fit's rule: identical arrays either way).
+        if weighted:
+            xb, wb, n_local = _prepare_weighted_batch(batch[0], batch[1],
+                                                      mesh)
+            return spill_lib.StagedBatch(xb, xb.shape[0], n_local, wb)
+        xb, n_valid, n_local = _prepare_batch(batch, mesh)
+        return spill_lib.StagedBatch(xb, n_valid, n_local)
+
+    run_stream, h2d = spill_lib.wrap_stream(r_plan, stream, _stage)
+    run_prefetch = prefetch if h2d is None else 0
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
     passes = [0]
     axes = mesh_lib.data_axes(mesh) if mesh is not None else ()
@@ -1683,10 +1684,10 @@ def streamed_fuzzy_fit(
         bdt = ["float32"]
 
         def step(acc, batch):
+            sb = (batch if isinstance(batch, spill_lib.StagedBatch)
+                  else _stage(batch))
             if weighted:
-                xb, wb, n_local = _prepare_weighted_batch(
-                    batch[0], batch[1], mesh
-                )
+                xb, wb, n_local = sb.xb, sb.wb, sb.n_local
                 if fill is not None:
                     fill.add(xb, xb.shape[0], wb)
                 if deferred:
@@ -1697,7 +1698,7 @@ def streamed_fuzzy_fit(
                     _accumulate_fuzzy_weighted(acc, xb, wb, c, m, mesh),
                     n_local,
                 )
-            xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            xb, n_valid, n_local = sb.xb, sb.n_valid, sb.n_local
             if fill is not None:
                 fill.add(xb, n_valid)
             if deferred:
@@ -1712,7 +1713,7 @@ def streamed_fuzzy_fit(
             )
 
         acc = _run_pass(
-            stream, prefetch, d_zero if deferred else zero_stats, step,
+            run_stream, run_prefetch, d_zero if deferred else zero_stats, step,
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
@@ -1822,4 +1823,5 @@ def streamed_fuzzy_fit(
             strategy=strategy.label(), reduces=counter.reduces,
             logical_bytes=counter.logical_bytes, passes=passes[0],
         ),
+        h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
     )

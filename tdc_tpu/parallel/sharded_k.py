@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tdc_tpu.data import spill as spill_lib
 from tdc_tpu.parallel.compat import shard_map
 from tdc_tpu.parallel.meshspec import MeshSpec
 from tdc_tpu.parallel import reshard as reshard_lib
@@ -1102,7 +1103,7 @@ def _plan_sharded_residency(residency, batches, k, d, spec: MeshSpec, *,
 
     if residency not in dc.RESIDENCY_MODES:
         raise ValueError(
-            f"residency={residency!r}: use 'stream', 'auto', or 'hbm'"
+            f"residency={residency!r}: use one of {dc.RESIDENCY_MODES}"
         )
     if residency == "stream":
         return None, None
@@ -1299,14 +1300,20 @@ def streamed_kmeans_fit_sharded(
     The fit result's `comms` field reports reduces issued / logical bytes.
     Quantized encodings are wired for the 1-D streamed fits only.
 
-    residency: "stream" (default), "hbm", or "auto" — under "hbm"/"auto"
-    iteration 1 streams AND fills a per-device HBM cache of the padded,
-    data-axis-sharded batches (replicated over the model axis; the bf16
-    `dtype` cast halves the cache), and iterations 2..N run as a compiled
-    on-device chunk loop with zero host transfers per iteration
-    (models/resident.py; same contract as streamed_kmeans_fit). "auto"
-    falls back to streaming — loudly, via a structlog `residency_fallback`
-    event — when dataset + accumulators exceed the per-device HBM budget.
+    residency: "stream" (default), "hbm", "spill", or "auto" — under
+    "hbm"/"auto" iteration 1 streams AND fills a per-device HBM cache of
+    the padded, data-axis-sharded batches (replicated over the model axis;
+    the bf16 `dtype` cast halves the cache), and iterations 2..N run as a
+    compiled on-device chunk loop with zero host transfers per iteration
+    (models/resident.py; same contract as streamed_kmeans_fit). An
+    over-budget dataset whose slot ring still fits runs as "spill"
+    (data/spill.py): the host-side cast + `device_put` staging moves onto
+    a producer thread 2+ slots ahead of the consumer, hiding each batch's
+    H2D copy behind the previous batch's compute, fp32-bit-exact with
+    plain streaming; the result's `h2d` field reports the ring's transfer
+    accounting. Only when even the ring does not fit does "auto" fall
+    back to synchronous streaming — loudly, via a structlog
+    `residency_fallback` event.
 
     `batches` follows the models/streaming contract: a zero-arg callable
     returning a fresh iterator of (rows, d) arrays per Lloyd iteration.
@@ -1431,7 +1438,7 @@ def streamed_kmeans_fit_sharded(
 
     stats_fn = make_sharded_stats(mesh, kernel, block_rows,
                                   reduce_data=not deferred)
-    _, r_builder = _plan_sharded_residency(
+    r_plan, r_builder = _plan_sharded_residency(
         residency, batches, k, d, spec,
         pad_multiple=pad_multiple, kernel=kernel, dtype=dtype,
         cursor=state.cursor, label="streamed_kmeans_fit_sharded",
@@ -1484,7 +1491,10 @@ def streamed_kmeans_fit_sharded(
             return _finalize_jit(acc, c, jnp.asarray(n_pad, jnp.float32))
 
         def step_batch(acc, batch, c, fill=None):
-            xb, n_valid = put_batch(batch)
+            if isinstance(batch, spill_lib.StagedBatch):
+                xb, n_valid = batch.xb, batch.n_valid
+            else:
+                xb, n_valid = put_batch(batch)
             if fill is not None:
                 fill.add(xb, n_valid)
             pad_cell[0] += xb.shape[0] - n_valid
@@ -1523,7 +1533,10 @@ def streamed_kmeans_fit_sharded(
             )
 
         def step_batch(acc, batch, c, fill=None):
-            xb, n_valid = put_batch(batch)
+            if isinstance(batch, spill_lib.StagedBatch):
+                xb, n_valid = batch.xb, batch.n_valid
+            else:
+                xb, n_valid = put_batch(batch)
             if fill is not None:
                 fill.add(xb, n_valid)
             counter.add(*cost_reduce)
@@ -1618,9 +1631,17 @@ def streamed_kmeans_fit_sharded(
         return (cost_reduce[0] * cache.n_batches,
                 cost_reduce[1] * cache.n_batches)
 
+    def _stage(batch):
+        xb, n_valid = put_batch(batch)
+        return spill_lib.StagedBatch(xb, n_valid, n_valid)
+
+    loop_batches, h2d = spill_lib.wrap_stream(r_plan, batches, _stage)
+    loop_prefetch = prefetch if h2d is None else 0
+
     c, n_iter, start_iter, shift, converged, history, final_acc = (
         _sharded_stream_loop(
-            batches=batches, prefetch=prefetch, ckpt=ckpt, ckpt_dir=ckpt_dir,
+            batches=loop_batches, prefetch=loop_prefetch, ckpt=ckpt,
+            ckpt_dir=ckpt_dir,
             ckpt_every=ckpt_every, ckpt_every_batches=ckpt_every_batches,
             max_iters=max_iters, tol=tol, c=c, state=state, put_acc=put_acc,
             zero_acc=zero_acc, step_batch=step_batch, update=update,
@@ -1644,6 +1665,7 @@ def streamed_kmeans_fit_sharded(
             logical_bytes=counter.logical_bytes,
             passes=(n_iter - start_iter) + 1,
         ),
+        h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
     )
 
 
@@ -1772,7 +1794,7 @@ def streamed_fuzzy_fit_sharded(
         mesh, m, eps, block_rows=block_rows, kernel=kernel,
         reduce_data=not deferred,
     )
-    _, r_builder = _plan_sharded_residency(
+    r_plan, r_builder = _plan_sharded_residency(
         residency, batches, k, d, spec,
         pad_multiple=pad_multiple, kernel=kernel, dtype=dtype,
         cursor=state.cursor, label="streamed_fuzzy_fit_sharded",
@@ -1824,7 +1846,10 @@ def streamed_fuzzy_fit_sharded(
             )
 
         def step_batch(acc, batch, c, fill=None):
-            xb, n_valid = put_batch(batch)
+            if isinstance(batch, spill_lib.StagedBatch):
+                xb, n_valid = batch.xb, batch.n_valid
+            else:
+                xb, n_valid = put_batch(batch)
             if fill is not None:
                 fill.add(xb, n_valid)
             pad_cell[0] += xb.shape[0] - n_valid
@@ -1866,7 +1891,10 @@ def streamed_fuzzy_fit_sharded(
             )
 
         def step_batch(acc, batch, c, fill=None):
-            xb, n_valid = put_batch(batch)
+            if isinstance(batch, spill_lib.StagedBatch):
+                xb, n_valid = batch.xb, batch.n_valid
+            else:
+                xb, n_valid = put_batch(batch)
             if fill is not None:
                 fill.add(xb, n_valid)
             counter.add(*cost_reduce)
@@ -1964,9 +1992,17 @@ def streamed_fuzzy_fit_sharded(
         return (cost_reduce[0] * cache.n_batches,
                 cost_reduce[1] * cache.n_batches)
 
+    def _stage(batch):
+        xb, n_valid = put_batch(batch)
+        return spill_lib.StagedBatch(xb, n_valid, n_valid)
+
+    loop_batches, h2d = spill_lib.wrap_stream(r_plan, batches, _stage)
+    loop_prefetch = prefetch if h2d is None else 0
+
     c, n_iter, start_iter, shift, converged, history, final_acc = (
         _sharded_stream_loop(
-            batches=batches, prefetch=prefetch, ckpt=ckpt, ckpt_dir=ckpt_dir,
+            batches=loop_batches, prefetch=loop_prefetch, ckpt=ckpt,
+            ckpt_dir=ckpt_dir,
             ckpt_every=ckpt_every, ckpt_every_batches=ckpt_every_batches,
             max_iters=max_iters, tol=tol, c=c, state=state, put_acc=put_acc,
             zero_acc=zero_acc, step_batch=step_batch, update=update,
@@ -1991,6 +2027,7 @@ def streamed_fuzzy_fit_sharded(
             logical_bytes=counter.logical_bytes,
             passes=(n_iter - start_iter) + 1,
         ),
+        h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
     )
 
 

@@ -428,10 +428,12 @@ class ServeApp:
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the request/batch/engine stats."""
+        from tdc_tpu.data.spill import GLOBAL_H2D
         from tdc_tpu.parallel.reduce import GLOBAL_COMMS
 
         e, b = self.engine.stats, self.batcher.stats
         comms = GLOBAL_COMMS.snapshot()
+        h2d = GLOBAL_H2D.snapshot()
         lines = [
             "# HELP tdc_serve_requests_total Requests by endpoint and status.",
             "# TYPE tdc_serve_requests_total counter",
@@ -475,6 +477,23 @@ class ServeApp:
             ("tdc_comms_stats_logical_bytes_total", "counter",
              "Logical payload bytes moved by stats reduces.",
              comms["logical_bytes"]),
+            # Spill-tier H2D prefetch-ring accounting (data/spill.py):
+            # bytes staged host->device ahead of compute by fits running
+            # in this process, how much of that copy time the consumer
+            # still stalled on, and the deepest ring fill observed.
+            ("tdc_h2d_bytes_total", "counter",
+             "Logical host->device bytes staged by the spill prefetch "
+             "ring (data/spill.py).", h2d["h2d_bytes"]),
+            ("tdc_h2d_batches_total", "counter",
+             "Batches staged through the spill prefetch ring.",
+             h2d["batches"]),
+            ("tdc_h2d_copy_stall_seconds_total", "counter",
+             "Seconds spill-fit consumers stalled waiting on H2D "
+             "staging (copy time the overlap failed to hide).",
+             round(h2d["stall_s"], 3)),
+            ("tdc_h2d_prefetch_depth", "gauge",
+             "Deepest spill prefetch-ring fill observed.",
+             h2d["depth_max"]),
         ]
         for name, typ, help_, val in scalar:
             lines += [f"# HELP {name} {help_}", f"# TYPE {name} {typ}",

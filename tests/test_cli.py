@@ -184,6 +184,26 @@ def test_cli_streamed(tmp_path):
     assert row["num_batches"] == "4"
 
 
+def test_cli_streamed_spill_residency(tmp_path):
+    """--residency=spill runs the streamed fit through the H2D prefetch
+    ring (data/spill.py) and completes with an ok row; a non-streamed fit
+    refuses the knob loudly (the standing --residency vocabulary rule)."""
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=4000 --n_dim=4 --K=3 --n_max_iters=10 --seed=1 "
+        f"--log_file={log} --n_GPUs=1 --num_batches=4 "
+        f"--residency=spill".split()
+    )
+    assert rc == 0
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["status"] == "ok"
+    with pytest.raises(SystemExit, match="streamed"):
+        cli_main(
+            f"--n_obs=100 --n_dim=4 --K=3 --log_file={log} --n_GPUs=1 "
+            f"--residency=spill".split()
+        )
+
+
 def test_cli_error_captured_in_csv(tmp_path):
     # A malformed data file (1-D array) must land as an error row with the
     # exception name in the metric columns (reference :362-377 semantics),

@@ -1,0 +1,530 @@
+"""Spill tier: async H2D double-buffered prefetch for over-budget
+streamed fits (data/spill.py + the `residency="spill"` outcome).
+
+The contract under test:
+- the planner's two-tier fallback — `auto` picks hbm when the cache fits,
+  SPILL when only the slot ring fits (structlog `residency_spill`), and
+  plain streaming only when neither does (`residency_fallback`, distinct
+  reason) — never silently;
+- spill results are fp32-BIT-EXACT with plain streaming on every driver
+  (1-D kmeans/fuzzy, weighted, deferred reduce, K-sharded): the ring
+  changes WHEN a batch is staged, never WHAT the accumulate ops see;
+- host batch boundaries are preserved (mid-pass checkpointing composes);
+- the H2D accounting (fit result `h2d`, /metrics `tdc_h2d_*`) is
+  populated and the ring's threads never leak or hang.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.data import device_cache as dc
+from tdc_tpu.data import spill as spill_lib
+from tdc_tpu.data.device_cache import SizedBatches, StreamHints, plan_residency
+from tdc_tpu.data.loader import NpzStream
+from tdc_tpu.models.streaming import streamed_fuzzy_fit, streamed_kmeans_fit
+from tdc_tpu.parallel.mesh import make_mesh
+
+HINTS = StreamHints(n_rows=1000, batch_rows=256, n_batches=4)
+
+
+def _data(n=1003, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=8, size=(8, d)).astype(np.float32)
+    x = centers[rng.integers(0, 8, n)] + rng.normal(
+        size=(n, d)
+    ).astype(np.float32)
+    return x.astype(np.float32)
+
+
+def _sized(x, rows, ranged=False):
+    def gen():
+        for i in range(0, x.shape[0], rows):
+            yield x[i : i + rows]
+
+    read = (lambda i: x[i * rows : (i + 1) * rows]) if ranged else None
+    return SizedBatches(gen, x.shape[0], rows, read_batch=read)
+
+
+def _events(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture
+def runlog(tmp_path, monkeypatch):
+    path = tmp_path / "runlog.jsonl"
+    monkeypatch.setenv("TDC_RUNLOG", str(path))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Planner: the third residency outcome
+# ---------------------------------------------------------------------------
+
+
+class TestSpillPlanner:
+    def test_spill_is_a_residency_mode(self):
+        assert "spill" in dc.RESIDENCY_MODES
+
+    def test_requested_spill_fits(self, runlog):
+        plan = plan_residency("spill", hints=HINTS, d=8, k=8)
+        assert plan.mode == "spill" and plan.reason == "requested"
+        assert plan.spill_slots >= 2
+        # ring = (slots + 1) per-device batch slots
+        assert plan.spill_bytes == (plan.spill_slots + 1) * 256 * 8 * 4
+        ev = [e for e in _events(runlog) if e["event"] == "residency_spill"]
+        assert ev and ev[0]["reason"] == "requested"
+
+    def test_auto_picks_spill_when_only_the_ring_fits(
+        self, runlog, monkeypatch
+    ):
+        probe = plan_residency("spill", hints=HINTS, d=8, k=8)
+        budget = probe.reserve_bytes + probe.spill_bytes + 1
+        monkeypatch.setattr(dc, "hbm_budget_bytes",
+                            lambda device=None: budget)
+        plan = plan_residency("auto", hints=HINTS, d=8, k=8)
+        assert plan.mode == "spill" and plan.reason == "cache_over_budget"
+        assert plan.resident_bytes + plan.reserve_bytes > budget  # cache out
+        # (the budget probe above emitted its own requested-spill event;
+        # the auto decision is the cache_over_budget one)
+        ev = [e for e in _events(runlog)
+              if e["event"] == "residency_spill"
+              and e["reason"] == "cache_over_budget"]
+        assert ev and ev[0]["requested"] == "auto"
+
+    def test_auto_streams_loudly_when_even_the_ring_does_not_fit(
+        self, runlog, monkeypatch
+    ):
+        monkeypatch.setattr(dc, "hbm_budget_bytes", lambda device=None: 10)
+        plan = plan_residency("auto", hints=HINTS, d=8, k=8)
+        assert plan.mode == "stream" and plan.reason == "over_budget"
+        ev = [e for e in _events(runlog)
+              if e["event"] == "residency_fallback"]
+        assert ev and ev[0]["reason"] == "over_budget"
+        assert "slot ring" in ev[0]["detail"]
+        assert "no truncation" in ev[0]["detail"]
+
+    def test_requested_spill_over_budget_is_forced_loudly(
+        self, runlog, monkeypatch
+    ):
+        monkeypatch.setattr(dc, "hbm_budget_bytes", lambda device=None: 10)
+        plan = plan_residency("spill", hints=HINTS, d=8, k=8)
+        assert plan.mode == "spill" and plan.reason == "forced"
+        assert any(e["event"] == "residency_forced_over_budget"
+                   for e in _events(runlog))
+
+    def test_requested_spill_without_hints_runs_geometry_free(self, runlog):
+        plan = plan_residency("spill", hints=None, d=8, k=8)
+        assert plan.mode == "spill" and plan.reason == "requested_no_hints"
+        ev = [e for e in _events(runlog) if e["event"] == "residency_spill"]
+        assert ev and ev[0]["reason"] == "requested_no_hints"
+
+    def test_spill_mid_pass_cursor_degrades_to_stream(self, runlog):
+        plan = plan_residency("spill", hints=HINTS, d=8, k=8, cursor=2)
+        assert plan.mode == "stream" and plan.reason == "mid_pass_resume"
+
+    def test_spill_composes_with_mid_pass_ckpt(self):
+        """Unlike hbm, spill PRESERVES host batch boundaries — the
+        ckpt_every_batches durability contract needs no fallback."""
+        plan = plan_residency("spill", hints=HINTS, d=8, k=8,
+                              mid_pass_ckpt=True)
+        assert plan.mode == "spill"
+        # auto still keeps its pinned conservative behavior
+        plan = plan_residency("auto", hints=HINTS, d=8, k=8,
+                              mid_pass_ckpt=True)
+        assert plan.mode == "stream" and plan.reason == "mid_pass_ckpt"
+
+    def test_bad_slots_rejected(self):
+        with pytest.raises(ValueError, match="spill_slots"):
+            plan_residency("spill", hints=HINTS, d=8, k=8, spill_slots=1)
+
+    def test_weighted_ring_counts_weight_rows(self):
+        plain = plan_residency("spill", hints=HINTS, d=8, k=8)
+        weighted = plan_residency("spill", hints=HINTS, d=8, k=8,
+                                  weighted=True)
+        assert weighted.spill_bytes > plain.spill_bytes
+
+
+# ---------------------------------------------------------------------------
+# Ring machinery: ranged protocol, ordering, failure modes
+# ---------------------------------------------------------------------------
+
+
+class TestRingMachinery:
+    def test_ranged_reader_protocol(self):
+        x = _data(512, 4)
+        assert spill_lib.ranged_reader(NpzStream(x, 128)) is not None
+        assert spill_lib.ranged_reader(_sized(x, 128, ranged=True)) is not None
+        assert spill_lib.ranged_reader(_sized(x, 128)) is None
+        assert spill_lib.ranged_reader(lambda: iter([x])) is None
+
+    def test_npz_stream_read_batch_matches_iteration(self):
+        x = _data(1003, 4)
+        s = NpzStream(x, 256)
+        for i, b in enumerate(s()):
+            np.testing.assert_array_equal(b, s.read_batch(i))
+
+    def test_concurrent_staging_preserves_order(self):
+        x = _data(2048, 4)
+        s = NpzStream(x, 128)
+        counter = spill_lib.H2DCounter()
+        stream = spill_lib.spill_stream(
+            s, lambda b: spill_lib.StagedBatch(jnp.asarray(b), b.shape[0],
+                                               b.shape[0]),
+            slots=4, counter=counter,
+        )
+        got = np.concatenate([np.asarray(sb.xb) for sb in stream()])
+        np.testing.assert_array_equal(got, x)
+        snap = counter.snapshot()
+        assert snap["batches"] == 16
+        assert snap["h2d_bytes"] == x.nbytes
+        assert snap["copy_s"] > 0.0
+
+    def test_staging_exception_surfaces_promptly_in_order(self):
+        """A read that dies must re-raise at the consumer (in order, after
+        the good batches) — not hang the fit as a wedged stream."""
+        x = _data(512, 4)
+
+        def read(i):
+            if i == 2:
+                raise RuntimeError("cold store died")
+            return x[i * 128 : (i + 1) * 128]
+
+        s = SizedBatches(lambda: (read(i) for i in range(4)), 512, 128,
+                         read_batch=read)
+        stream = spill_lib.spill_stream(
+            s, lambda b: spill_lib.StagedBatch(jnp.asarray(b), b.shape[0],
+                                               b.shape[0]),
+            slots=3,
+        )
+        it = stream()
+        t0 = time.monotonic()
+        assert np.asarray(next(it).xb).shape == (128, 4)
+        assert np.asarray(next(it).xb).shape == (128, 4)
+        with pytest.raises(RuntimeError, match="cold store died"):
+            next(it)
+        assert time.monotonic() - t0 < 10.0
+
+    def test_serial_ring_staging_exception_surfaces(self):
+        """Same promptness on the sequential-iterator (non-ranged) path,
+        where the exception rides prefetch_map's queue."""
+        x = _data(512, 4)
+
+        def gen():
+            yield x[:128]
+            raise RuntimeError("io died mid-pass")
+
+        stream = spill_lib.spill_stream(
+            SizedBatches(gen, 512, 128),
+            lambda b: spill_lib.StagedBatch(jnp.asarray(b), b.shape[0],
+                                            b.shape[0]),
+            slots=2,
+        )
+        it = stream()
+        next(it)
+        with pytest.raises(RuntimeError, match="io died mid-pass"):
+            next(it)
+
+    @staticmethod
+    def _spill_threads():
+        return [
+            t for t in threading.enumerate()
+            if t.name.startswith(("tdc-spill", "tdc-prefetch"))
+            and t.is_alive()
+        ]
+
+    def _assert_threads_die(self, baseline, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self._spill_threads()) <= baseline:
+                return
+            time.sleep(0.02)
+        raise AssertionError(
+            f"spill staging threads still alive: {self._spill_threads()}"
+        )
+
+    def test_close_mid_fill_joins_workers(self):
+        """Early exit (convergence, preemption, an exception in the fit)
+        closes the staged generator mid-fill: the pool must join without
+        leaking threads that pin staged device batches."""
+        x = _data(4096, 4)
+        baseline = len(self._spill_threads())
+
+        def slow_read(i):
+            time.sleep(0.02)
+            return x[i * 128 : (i + 1) * 128]
+
+        s = SizedBatches(lambda: (slow_read(i) for i in range(32)), 4096,
+                         128, read_batch=slow_read)
+        stream = spill_lib.spill_stream(
+            s, lambda b: spill_lib.StagedBatch(jnp.asarray(b), b.shape[0],
+                                               b.shape[0]),
+            slots=4,
+        )
+        it = stream()
+        next(it)
+        it.close()
+        self._assert_threads_die(baseline)
+
+    def test_serial_close_mid_fill_joins_producer(self):
+        x = _data(4096, 4)
+        baseline = len(self._spill_threads())
+        stream = spill_lib.spill_stream(
+            SizedBatches(lambda: iter([x[i: i + 128] for i in range(0, 4096, 128)]),
+                         4096, 128),
+            lambda b: spill_lib.StagedBatch(jnp.asarray(b), b.shape[0],
+                                            b.shape[0]),
+            slots=2,
+        )
+        it = stream()
+        next(it)
+        it.close()
+        self._assert_threads_die(baseline)
+
+    def test_report_overlap_lower_bound_clamped(self):
+        r = spill_lib.SpillReport(slots=2, batches=4, h2d_bytes=1,
+                                  copy_s=1.0, stall_s=0.25, depth_max=1)
+        assert r.overlap_lower_bound == 0.75
+        starved = r._replace(stall_s=5.0)
+        assert starved.overlap_lower_bound == 0.0
+        empty = r._replace(copy_s=0.0)
+        assert empty.overlap_lower_bound == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Driver parity: spill is bit-exact with plain streaming everywhere
+# ---------------------------------------------------------------------------
+
+
+class TestSpillParity:
+    X = _data(1003, 8)
+
+    def _kmeans(self, residency, rows=200, ranged=True, **kw):
+        kw.setdefault("max_iters", 4)
+        kw.setdefault("tol", -1.0)
+        return streamed_kmeans_fit(
+            _sized(self.X, rows, ranged=ranged), 8, 8, init=self.X[:8],
+            residency=residency, **kw,
+        )
+
+    def test_kmeans_bit_exact_ranged_and_serial(self):
+        base = self._kmeans("stream")
+        for ranged in (True, False):
+            res = self._kmeans("spill", ranged=ranged)
+            np.testing.assert_array_equal(
+                np.asarray(base.centroids), np.asarray(res.centroids)
+            )
+            assert float(base.sse) == float(res.sse)
+
+    def test_h2d_report_populated(self):
+        res = self._kmeans("spill")
+        h = res.h2d
+        # 4 iterations + the final reporting pass, 6 batches each
+        assert h.batches == 5 * 6
+        assert h.h2d_bytes > 0 and h.copy_s > 0.0
+        assert h.slots >= 2 and h.depth_max >= 0
+        assert 0.0 <= h.overlap_lower_bound <= 1.0
+        assert self._kmeans("stream").h2d is None
+
+    def test_fuzzy_bit_exact(self):
+        base = streamed_fuzzy_fit(_sized(self.X, 200, ranged=True), 8, 8,
+                                  init=self.X[:8], max_iters=3,
+                                  residency="stream")
+        res = streamed_fuzzy_fit(_sized(self.X, 200, ranged=True), 8, 8,
+                                 init=self.X[:8], max_iters=3,
+                                 residency="spill")
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+        assert res.h2d.batches == 4 * 6
+
+    def test_weighted_bit_exact(self):
+        w = np.abs(_data(1003, 1, seed=3)).ravel() + 0.1
+
+        def fit(residency):
+            return streamed_kmeans_fit(
+                _sized(self.X, 200, ranged=True), 8, 8, init=self.X[:8],
+                max_iters=3, tol=-1.0,
+                sample_weight_batches=_sized(w, 200),
+                residency=residency,
+            )
+
+        base, res = fit("stream"), fit("spill")
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+        # weighted streams zip (x, w): the ring runs its serial producer
+        assert res.h2d.batches == 4 * 6
+
+    def test_mesh_and_deferred_reduce_bit_exact(self):
+        mesh = make_mesh(4)
+        for reduce in ("per_batch", "per_pass"):
+            base = self._kmeans("stream", mesh=mesh, reduce=reduce)
+            res = self._kmeans("spill", mesh=mesh, reduce=reduce)
+            np.testing.assert_array_equal(
+                np.asarray(base.centroids), np.asarray(res.centroids)
+            )
+
+    def test_spherical_bit_exact(self):
+        base = self._kmeans("stream", spherical=True)
+        res = self._kmeans("spill", spherical=True)
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+
+    def test_auto_selects_spill_end_to_end(self, runlog, monkeypatch):
+        """The acceptance pin: an over-budget dataset under
+        --residency auto provably runs the spill tier (structlog event)
+        and still matches plain streaming bit-exactly."""
+        probe = plan_residency(
+            "spill",
+            hints=dc.stream_hints(_sized(self.X, 200)),
+            d=8, k=8,
+        )
+        monkeypatch.setattr(
+            dc, "hbm_budget_bytes",
+            lambda device=None: probe.reserve_bytes + probe.spill_bytes + 1,
+        )
+        base = self._kmeans("stream")
+        res = self._kmeans("auto")
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+        assert res.h2d is not None and res.h2d.batches > 0
+        # (the budget probe above emitted its own requested-spill event;
+        # the driver's auto decision carries the fit label)
+        ev = [e for e in _events(runlog)
+              if e["event"] == "residency_spill"
+              and e["reason"] == "cache_over_budget"]
+        assert ev and ev[0]["label"] == "streamed_kmeans_fit"
+
+    def test_spill_composes_with_mid_pass_ckpt(self, tmp_path):
+        """Host batch boundaries are preserved: ckpt_every_batches writes
+        mid-pass cursor saves under spill, and a cursor resume degrades
+        that run to streaming (the planner rule) while completing."""
+        ckpt = str(tmp_path / "ck")
+        base = self._kmeans("stream", max_iters=3, tol=1e-6)
+        res = self._kmeans("spill", max_iters=3, tol=1e-6, ckpt_dir=ckpt,
+                           ckpt_every_batches=2)
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+
+    @pytest.mark.parametrize("fit_name", ["streamed_kmeans_fit_sharded",
+                                          "streamed_fuzzy_fit_sharded"])
+    def test_sharded_drivers_bit_exact(self, fit_name):
+        from tdc_tpu.parallel import sharded_k
+
+        fit = getattr(sharded_k, fit_name)
+        mesh = sharded_k.make_mesh_2d(2, 4)
+        kw = dict(init=self.X[:8], max_iters=3, tol=-1.0)
+        base = fit(_sized(self.X, 200, ranged=True), 8, 8, mesh,
+                   residency="stream", **kw)
+        res = fit(_sized(self.X, 200, ranged=True), 8, 8, mesh,
+                  residency="spill", **kw)
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+        assert res.h2d.batches == 4 * 6 and base.h2d is None
+
+    def test_bad_mode_still_rejected(self):
+        with pytest.raises(ValueError, match="residency="):
+            self._kmeans("spil")
+
+
+# ---------------------------------------------------------------------------
+# Observability: process-wide counters on /metrics
+# ---------------------------------------------------------------------------
+
+
+class TestSpillMetrics:
+    def test_global_counter_mirrors_fits(self):
+        before = spill_lib.GLOBAL_H2D.snapshot()
+        x = _data(600, 4, seed=5)
+        streamed_kmeans_fit(_sized(x, 200, ranged=True), 4, 4, init=x[:4],
+                            max_iters=2, tol=-1.0, residency="spill")
+        after = spill_lib.GLOBAL_H2D.snapshot()
+        assert after["h2d_bytes"] - before["h2d_bytes"] == x.nbytes * 3
+        assert after["batches"] - before["batches"] == 9
+
+    def test_metrics_endpoint_exports_h2d(self, tmp_path):
+        from tdc_tpu.models.kmeans import kmeans_fit
+        from tdc_tpu.models.persist import save_fitted
+        from tdc_tpu.serve.server import ServeApp
+
+        x = _data(200, 4, seed=6)
+        km = kmeans_fit(x, 3, key=jax.random.PRNGKey(0), max_iters=4)
+        save_fitted(str(tmp_path / "km"), km)
+        app = ServeApp(poll_interval=0)
+        app.registry.add("km", str(tmp_path / "km"))
+        app.start()
+        try:
+            text = app.metrics_text()
+        finally:
+            app.stop()
+        for name in ("tdc_h2d_bytes_total", "tdc_h2d_batches_total",
+                     "tdc_h2d_copy_stall_seconds_total",
+                     "tdc_h2d_prefetch_depth"):
+            assert name in text
+
+
+# ---------------------------------------------------------------------------
+# Loader sizing-protocol audit (satellite): every stream type data/loader
+# can produce must advertise hints + itemsize, so spill/hbm eligibility
+# under --residency auto never silently degrades.
+# ---------------------------------------------------------------------------
+
+
+class TestLoaderSizingAudit:
+    def test_npz_stream_advertises_everything(self):
+        x = _data(1000, 8)
+        s = NpzStream(x, 256)
+        assert dc.stream_hints(s) == StreamHints(1000, 256, 4)
+        assert dc.stream_itemsize(s) == 4
+        assert spill_lib.ranged_reader(s) is not None
+        bf = NpzStream(x.astype(jnp.bfloat16), 256)
+        assert dc.stream_itemsize(bf) == 2
+
+    def test_native_stream_advertises_sizes(self, tmp_path):
+        native = pytest.importorskip("tdc_tpu.data.native_loader")
+        x = _data(512, 4)
+        p = str(tmp_path / "pts.npy")
+        np.save(p, x)
+        try:
+            s = native.NativePrefetchStream(p, 128)
+        except OSError as e:  # no compiler on this box — skip, not fail
+            pytest.skip(f"native loader unavailable: {e}")
+        try:
+            assert dc.stream_hints(s) == StreamHints(512, 128, 4)
+            assert dc.stream_itemsize(s) == 4
+            # sequential C++ reader: no ranged protocol — the spill ring
+            # must use its serial producer, never misread the protocol
+            assert spill_lib.ranged_reader(s) is None
+        finally:
+            s.close()
+
+    def test_bare_generator_falls_back_with_distinct_reason(
+        self, runlog
+    ):
+        """A stream with no sizing protocol under auto must stream with
+        the pinned `no_size_hints` reason — silent spill-eligibility
+        degradation would hide a misconfigured loader forever."""
+        x = _data(600, 4)
+        res = streamed_kmeans_fit(
+            lambda: iter([x[:300], x[300:]]), 4, 4, init=x[:4],
+            max_iters=2, tol=-1.0, residency="auto",
+        )
+        assert res.h2d is None  # streamed, no ring
+        ev = [e for e in _events(runlog)
+              if e["event"] == "residency_fallback"]
+        assert ev and ev[0]["reason"] == "no_size_hints"

@@ -150,6 +150,82 @@ def test_prefetched_producer_terminates_on_consumer_close():
     _assert_prefetch_threads_die(baseline)
 
 
+def test_prefetched_exception_before_first_item_surfaces_promptly():
+    """A producer that dies before producing anything must raise at the
+    consumer's FIRST pull, bounded in time — not present as a hung stream
+    (the spill tier stages device batches through this machinery; a
+    wedged q.get here would wedge a whole fit)."""
+    import time
+
+    import pytest
+
+    from tdc_tpu.models.streaming import _prefetched
+
+    def dead():
+        raise OSError("mount gone")
+        yield  # pragma: no cover — makes this a generator
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError, match="mount gone"):
+        next(_prefetched(dead(), depth=2))
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_prefetched_exception_behind_full_queue_surfaces_in_order():
+    """The spill-tier shape: the producer ran AHEAD (queue full), then the
+    source died. The consumer must still receive every staged batch in
+    order, then the exception — never a silent truncation or a hang."""
+    import time
+
+    import pytest
+
+    from tdc_tpu.models.streaming import _prefetched
+
+    items = [np.full((2, 2), i) for i in range(3)]
+
+    def dies_after_filling():
+        yield from items
+        raise RuntimeError("read 3 failed")
+
+    it = _prefetched(dies_after_filling(), depth=2)
+    # Give the producer time to fill the bounded queue and park.
+    time.sleep(0.2)
+    got = []
+    with pytest.raises(RuntimeError, match="read 3 failed"):
+        for b in it:
+            got.append(b)
+    assert len(got) == 3
+    for a, b in zip(got, items):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prefetched_close_mid_fill_joins_producer_thread():
+    """Closing while the producer is mid-fill (blocked on the full queue,
+    more items pending) must JOIN the thread — a leaked daemon thread
+    pins every staged batch until process exit (the leak the spill tier
+    cannot afford: its items are device-resident)."""
+    from tdc_tpu.models.streaming import _prefetched
+
+    baseline = len(_prefetch_threads())
+    produced = []
+
+    def tracked():
+        for i in range(100):
+            produced.append(i)
+            yield np.full((2, 2), i)
+
+    gen = _prefetched(tracked(), depth=2)
+    next(gen)
+    gen.close()
+    _assert_prefetch_threads_die(baseline)
+    # Bounded-ring proof: the producer never ran ahead of the ring.
+    # depth queued + one in-hand + the consumed one, plus at most ONE
+    # more: a put parked on the full queue can complete after close when
+    # the drain frees its slot, and the producer may pull the next item
+    # before it observes the stop flag.
+    assert len(produced) <= 2 + 2 + 1
+
+
 def test_prefetched_producer_terminates_on_midstream_break():
     """The for-loop-break shape every driver hits on early convergence or
     an exception mid-pass."""
