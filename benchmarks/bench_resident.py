@@ -41,6 +41,10 @@ import os
 import sys
 import time
 
+# Script invocation puts benchmarks/ (not the repo root) on sys.path;
+# mirror bench_spill.py so the smoke runs without an external PYTHONPATH.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if "xla_force_host_platform_device_count" not in os.environ.get(
     "XLA_FLAGS", ""

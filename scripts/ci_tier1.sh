@@ -73,13 +73,19 @@ fi
 # preemption drain, the PR-6 elastic shrink-mid-fit case (SIGTERM one
 # worker with a standing resize request: the supervisor relaunches ONE
 # process from the boundary checkpoint, charging neither budget, within
-# 1e-4 of fault-free), and the PR-7 online-update soak (NaN-poisoned fold
+# 1e-4 of fault-free), the PR-7 online-update soak (NaN-poisoned fold
 # batch quarantined + crash at online.swap leaves serving bit-exact on
 # the last-good generation, the relaunched sidecar publishes a validated
 # generation, and a forced post-swap regression auto-rolls-back within
-# one validation window). slow-marked so the main sweep above keeps its
-# time budget; run here timeout-wrapped (~90 s clean; 600 covers a
-# loaded box re-importing jax across the soaks' subprocess relaunches).
+# one validation window), and the PR-10 flaky-store ingest case (~30%
+# injected transient read failures + one globally-poisoned batch on the
+# 2-process gang: one launch, no collective deadlock, retries > 0,
+# quarantined_batches == 1, within 1e-4 of fault-free). slow-marked so
+# the main sweep above keeps its time budget; run here timeout-wrapped
+# (re-measured with the ingest case: ~60 s clean on the CI box — the new
+# soak adds ~5 s, one gang launch with no relaunches; 600 unchanged,
+# still covering a loaded box re-importing jax across the soaks'
+# subprocess relaunches).
 chaos_rc=0
 if [ -z "$SKIP_CHAOS_SMOKE" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu \

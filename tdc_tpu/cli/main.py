@@ -201,6 +201,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --ckpt_dir: also checkpoint mid-pass every N "
                         "batches (accumulator + batch cursor; resume is "
                         "bit-identical)")
+    # Defaults live in ONE place — data/ingest.IngestPolicy; None here
+    # means "not set on the command line" so the fail-fast gate below can
+    # distinguish an explicit value from the default without re-deriving
+    # the numbers.
+    p.add_argument("--io_retries", type=int, default=None,
+                   help="streamed kmeans/fuzzy: transient stream-read "
+                        "failures retried per batch read with exponential "
+                        "backoff + jitter (data/ingest.py; 0 disables "
+                        "retry; permanent failures never retry; "
+                        "default 2)")
+    p.add_argument("--io_backoff", type=float, default=None,
+                   help="base retry backoff seconds (attempt n sleeps "
+                        "~base * 2^(n-1) with deterministic jitter; "
+                        "default 0.05)")
+    p.add_argument("--io_deadline", type=float, default=None,
+                   help="wall-clock budget in seconds for one batch read "
+                        "including retries (default: none)")
+    p.add_argument("--max_bad_fraction", type=float, default=None,
+                   help="largest fraction of a pass's rows the ingest "
+                        "quarantine may drop before the fit aborts loudly. "
+                        "The strict default 0.0 aborts on ANY quarantined "
+                        "batch — raise only when bounded data loss is "
+                        "acceptable and monitored (tdc_ingest_* metrics)")
     p.add_argument("--ckpt_keep_last_n", type=int, default=None,
                    help="with --ckpt_dir (streamed kmeans/fuzzy): retain "
                         "only the newest N checkpoint steps (default all; "
@@ -426,6 +449,16 @@ def validate_args(parser, args):
                 or args.method_name == "gaussianMixture"):
             parser.error("--ckpt_keep_last_n applies to the 1-D streamed "
                          "kmeans/fuzzy fits only")
+    if args.io_retries is not None and args.io_retries < 0:
+        parser.error("--io_retries must be >= 0")
+    if args.io_backoff is not None and args.io_backoff < 0:
+        parser.error("--io_backoff must be >= 0")
+    if args.io_deadline is not None and args.io_deadline <= 0:
+        parser.error("--io_deadline must be > 0 seconds")
+    if args.max_bad_fraction is not None and not (
+        0.0 <= args.max_bad_fraction <= 1.0
+    ):
+        parser.error("--max_bad_fraction must be in [0, 1]")
     if not (0 <= args.reassignment_ratio <= 1):
         parser.error("--reassignment_ratio must be in [0, 1]")
     if args.reassignment_ratio != 0.01 and not args.minibatch:
@@ -712,6 +745,42 @@ def run_experiment(args) -> dict:
                     "no mid-pass boundaries to checkpoint at — drop one, "
                     "or use --residency=auto to prefer mid-pass durability"
                 )
+        from tdc_tpu.data.ingest import IngestPolicy
+
+        ingest_overrides = {
+            name: val for name, val in (
+                ("io_retries", args.io_retries),
+                ("io_backoff", args.io_backoff),
+                ("io_deadline", args.io_deadline),
+                ("max_bad_fraction", args.max_bad_fraction),
+            ) if val is not None
+        }
+        if ingest_overrides:
+            # Standing rule: fail fast instead of silently ignoring knobs
+            # on a path that never routes through the ingest guard. The
+            # K-sharded kmeans path always runs its (guarded) streamed
+            # driver; K-sharded fuzzy only when streamed/checkpointed.
+            guarded = (
+                streamed
+                or (mesh2d is not None
+                    and (args.method_name == "distributedKMeans"
+                         or (args.method_name == "distributedFuzzyCMeans"
+                             and (args.ckpt_dir
+                                  or args.ckpt_every_batches))))
+            )
+            unsupported = (
+                not guarded or args.mean_combine or args.minibatch
+                or args.method_name in ("bisectingKMeans", "gaussianMixture")
+            )
+            if unsupported:
+                raise SystemExit(
+                    "--io_retries/--io_backoff/--io_deadline/"
+                    "--max_bad_fraction apply to the streamed kmeans/fuzzy "
+                    "drivers (add --streamed/--num_batches); "
+                    "gaussianMixture/bisecting/mean_combine/minibatch "
+                    "streams are not routed through the ingest guard"
+                )
+        ingest_policy = IngestPolicy(**ingest_overrides)
 
         def residency_rows(rows: int, itemsize: int = 4,
                            n_cache_devices: int | None = None) -> int:
@@ -866,6 +935,7 @@ def run_experiment(args) -> dict:
                     ckpt_every_batches=args.ckpt_every_batches,
                     reduce=_sharded_reduce(args),
                     residency=args.residency,
+                    ingest=ingest_policy,
                 )
             from tdc_tpu.parallel.sharded_k import fuzzy_fit_sharded
 
@@ -927,6 +997,7 @@ def run_experiment(args) -> dict:
                 ckpt_every_batches=args.ckpt_every_batches,
                 reduce=_sharded_reduce(args),
                 residency=args.residency,
+                ingest=ingest_policy,
             )
         if args.method_name == "gaussianMixture":
             if streamed:
@@ -999,6 +1070,7 @@ def run_experiment(args) -> dict:
                     kernel=args.kernel or "xla",
                     reduce=args.reduce,
                     residency=args.residency,
+                    ingest=ingest_policy,
                 )
             return fuzzy_cmeans_fit(
                 xx, args.K, m=args.fuzzifier, init=args.init, key=key,
@@ -1037,6 +1109,7 @@ def run_experiment(args) -> dict:
                 kernel=args.kernel or "xla",
                 reduce=args.reduce,
                 residency=args.residency,
+                ingest=ingest_policy,
             )
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,

@@ -4,13 +4,20 @@ from tdc_tpu.data.synthetic import make_blobs, make_classification_data, save_np
 from tdc_tpu.data.loader import (
     NpzStream,
     batch_iterator,
+    crc_sidecar_path,
     load_points,
     load_points_feature_major,
     to_feature_major,
+    write_crc_sidecar,
 )
 from tdc_tpu.data.batching import auto_batch_size, oom_adaptive
+from tdc_tpu.data.ingest import IngestPolicy, IngestReport
 
 __all__ = [
+    "IngestPolicy",
+    "IngestReport",
+    "crc_sidecar_path",
+    "write_crc_sidecar",
     "make_blobs",
     "make_classification_data",
     "save_npz",

@@ -1,0 +1,781 @@
+"""Hardened ingest tier: retry/backoff on flaky stores, corrupt-batch
+quarantine, and bounded-loss accounting for every streamed fit.
+
+The streamed drivers (models/streaming.py, parallel/sharded_k.py) treat the
+input pipeline as perfect: one transient `read_batch` error from a cold
+store — the exact path the spill ring (data/spill.py) now hammers with
+concurrent reads — or a single NaN-poisoned batch aborts an entire
+multi-chip fit, and in a gang a unilaterally *skipped* batch would deadlock
+the next collective. Production streaming systems treat input faults as
+data, not exceptions (per-record error bounding a la tf.data service;
+Goodput-style accounting of lost work, PAPERS.md): this module extends the
+PR-7 online-quarantine discipline down into the fit data plane with the
+same loud, bounded, chaos-provable guarantees. Three pieces:
+
+- **I/O retry** (`GuardedStream`): read failures are CLASSIFIED transient
+  vs permanent (`classify_error`); transient ones retry with bounded
+  exponential backoff + deterministic jitter under a per-read deadline.
+  Retries live wherever the read itself runs — inside the spill ring's
+  producer threads for ranged streams (retries overlap compute; in-order
+  delivery is preserved because the ring already orders delivery) and on
+  the dispatch thread for the inline staging path. Every attempt is a loud
+  structlog `ingest_retry` event; abandoned reads emit ONE `ingest_failed`
+  event naming the batch index and store before raising — exhausted
+  transients as `IngestReadError`, permanents as the ORIGINAL exception
+  (its type is the caller's contract) — never a raw producer-thread
+  traceback surfacing out-of-order from the prefetch queue. Sequential
+  (generator) streams cannot be re-read, so they get classification + the
+  loud failure but no retry: retries need the RANGED protocol
+  (`read_batch(i)`).
+
+- **Gang-consistent quarantine**: each delivered batch passes an integrity
+  screen (`screen_batch`: shape check, non-finite scan, plus the CRC
+  sidecar verification NpzStream performs inside `read_batch`). A failed
+  screen never *skips* the batch — skipping is the gang deadlock — it
+  replaces it with a `Quarantined` marker the drivers stage as the
+  ALL-PADDING batch: zero rows, zero valid count (zero weights on the
+  weighted path). The existing zero-pad correction algebra then makes its
+  contribution exactly zero mass, so the verdict is folded into the stats
+  as a validity weight: control flow, collective count, and batch geometry
+  are verdict-INDEPENDENT, which is what makes all workers agree by
+  construction with no extra collective. This composes with per_batch and
+  per_pass/quantized-EF reduces, the K-sharded towers (every process
+  streams identical global batches there, so verdicts are symmetric by
+  construction), mid-pass checkpoints (row accounting uses the raw stream
+  geometry), and the HBM fill pass (a quarantined full batch breaks the
+  advertised geometry, so the cache abandons loudly and the fit keeps
+  streaming). When every batch is clean the guard yields the raw stream's
+  arrays untouched — fp32 bit-exact with the unguarded drivers.
+
+  Multi-process 1-D gangs stream per-host slices, so the screen sees only
+  the local slice; the quarantine contract extends the existing
+  equal-local-rows contract: verdicts must agree across hosts (true for a
+  corrupt batch in a shared/replicated store and for globally-poisoned
+  data). The first-pass row crosscheck also compares quarantined-row
+  totals, so divergent per-host corruption fails loudly instead of
+  desynchronizing replicated state.
+
+- **Bounded-loss accounting**: a per-fit `IngestCounter` (mirrored into
+  `GLOBAL_INGEST`, exported as `tdc_ingest_*` on serve /metrics) feeds the
+  `IngestReport` attached to every streamed fit result: retries,
+  quarantined batches/rows, and the dropped mass fraction. The
+  `max_bad_fraction` policy bounds how much data may be quarantined before
+  the fit ABORTS loudly (`ingest_abort` + `IngestAbort`) — the strict
+  default 0.0 means any quarantine aborts: production (checkpointed) fits
+  should not silently fit on reduced data unless the operator bounded the
+  loss explicitly.
+
+Chaos: the `data.read.transient` / `data.read.permanent` fault points fire
+on every guarded read attempt and `data.corrupt` inside the screen, so a
+$TDC_FAULTS spec can inject flaky stores and poisoned batches
+deterministically (tests/test_chaos.py drives a 2-process gloo gang
+through 30% transient read failures plus one poisoned batch).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import zlib
+from typing import NamedTuple
+
+import numpy as np
+
+from tdc_tpu.data import spill as spill_lib
+from tdc_tpu.testing.faults import fault_point
+from tdc_tpu.utils.structlog import emit
+
+
+class IngestPolicy(NamedTuple):
+    """Knobs for one fit's ingest guard (CLI: --io_retries / --io_backoff /
+    --io_deadline / --max_bad_fraction).
+
+    io_retries: transient read failures retried per logical batch read
+      (0 disables retry; permanent failures never retry).
+    io_backoff: base backoff seconds; attempt n sleeps
+      io_backoff * 2^(n-1) * jitter with deterministic jitter in
+      [0.5, 1.0) (no RNG: chaos runs stay reproducible).
+    io_deadline: wall-clock budget in seconds for one logical read
+      including its retries; a retry that cannot fit the remaining budget
+      fails permanent-style instead of sleeping past it. None = no
+      deadline.
+    max_bad_fraction: largest fraction of a pass's rows that may be
+      quarantined before the fit aborts loudly. The strict default 0.0
+      aborts on the FIRST quarantine — checkpointed production fits should
+      not silently fit on reduced data; raise it only when bounded loss is
+      acceptable and monitored.
+    screen: run the per-batch integrity screen (shape + non-finite scan).
+      Costs one min/max pass over each host batch; disable only for
+      trusted stores on CPU-bound hosts.
+    """
+
+    io_retries: int = 2
+    io_backoff: float = 0.05
+    io_deadline: float | None = None
+    max_bad_fraction: float = 0.0
+    screen: bool = True
+
+
+DEFAULT_POLICY = IngestPolicy()
+
+# The guard as a pure pass-through (no retry, no screen): the A/B policy
+# the transparency tests use to prove the guarded drivers are bit-exact
+# with the pre-guard code path.
+PASSTHROUGH_POLICY = IngestPolicy(io_retries=0, screen=False,
+                                  max_bad_fraction=1.0)
+
+
+def resolve_policy(ingest) -> IngestPolicy:
+    """Driver-facing coercion: None -> DEFAULT_POLICY, an IngestPolicy
+    passes through, a dict overrides defaults field-wise."""
+    if ingest is None:
+        return DEFAULT_POLICY
+    if isinstance(ingest, IngestPolicy):
+        return ingest
+    if isinstance(ingest, dict):
+        return IngestPolicy(**ingest)
+    raise TypeError(
+        f"ingest must be an IngestPolicy, dict, or None; got {type(ingest)}"
+    )
+
+
+class CorruptBatch(Exception):
+    """A store-level integrity failure detected DURING the read (CRC
+    sidecar mismatch, torn record): surfaced to the guard as a quarantine
+    verdict, not a crash. `shape`/`dtype` let the guard build the
+    zero-mass replacement batch without re-reading corrupt bytes."""
+
+    def __init__(self, message: str, *, batch: int, reason: str,
+                 shape=None, dtype=None):
+        super().__init__(message)
+        self.batch = int(batch)
+        self.reason = reason
+        self.shape = None if shape is None else tuple(shape)
+        self.dtype = dtype
+
+
+class IngestReadError(RuntimeError):
+    """A transient-classified batch read the retry policy could not
+    recover (retries exhausted or the per-read deadline spent). Always
+    preceded by one `ingest_failed` structlog event naming the batch
+    index and store. Permanent-classified failures re-raise the ORIGINAL
+    exception instead (after the same event): contract errors — a short
+    weight stream's strict-zip ValueError, a missing file — must keep
+    their types for callers that match on them."""
+
+
+class IngestAbort(RuntimeError):
+    """Quarantined mass exceeded the fit's max_bad_fraction budget: too
+    much data is gone to trust the result. Always preceded by one
+    `ingest_abort` structlog event."""
+
+
+# Error classification: transient = worth retrying against a flaky/cold
+# store; permanent = retrying cannot help (missing file, bad format, code
+# bug). Unknown exception types default to permanent — retrying an
+# unclassified error hides bugs.
+_PERMANENT_OS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                 NotADirectoryError)
+_TRANSIENT = (ConnectionError, TimeoutError, InterruptedError,
+              BlockingIOError)
+
+
+def classify_error(e: BaseException) -> str:
+    """'transient' | 'permanent' | 'corrupt' for one read failure."""
+    if isinstance(e, CorruptBatch):
+        return "corrupt"
+    if isinstance(e, _PERMANENT_OS):
+        return "permanent"
+    if isinstance(e, _TRANSIENT):
+        return "transient"
+    if isinstance(e, OSError):
+        # Residual OSErrors (EIO, ESTALE, network-filesystem hiccups) are
+        # the cold-store faults the retry tier exists for.
+        return "transient"
+    return "permanent"
+
+
+def backoff_delay(base: float, attempt: int, label: str, batch: int) -> float:
+    """Bounded exponential backoff with DETERMINISTIC jitter: attempt n
+    sleeps base * 2^(n-1) * u, u in [0.5, 1.0) derived from a crc32 of
+    (label, batch, attempt) — reproducible under $TDC_FAULTS chaos runs,
+    unlike random jitter, while still decorrelating concurrent ring
+    reads. Capped at 5 s so a long retry ladder cannot stall a heartbeat
+    window."""
+    u = 0.5 + (zlib.crc32(f"{label}:{batch}:{attempt}".encode())
+               % 1024) / 2048.0
+    return min(float(base) * (2.0 ** max(attempt - 1, 0)) * u, 5.0)
+
+
+def describe_store(batches) -> str:
+    """Human-readable store identity for events: a path-ish attribute when
+    the stream advertises one, else its type name."""
+    for attr in ("path", "source", "name"):
+        v = getattr(batches, attr, None)
+        if isinstance(v, str) and v:
+            return v
+    return type(batches).__name__
+
+
+class Quarantined:
+    """One quarantined batch: the zero-mass replacement the drivers stage
+    as the all-padding batch (zero rows, zero valid count; zero weights on
+    the weighted path). Carries the original batch GEOMETRY so the
+    equal-local-rows / advertised-geometry contracts hold verdict-
+    independently."""
+
+    __slots__ = ("x", "w", "index", "reason")
+
+    def __init__(self, x: np.ndarray, w: np.ndarray | None, index: int,
+                 reason: str):
+        self.x = x
+        self.w = w
+        self.index = index
+        self.reason = reason
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return (f"Quarantined(batch={self.index}, reason={self.reason!r}, "
+                f"shape={tuple(self.x.shape)})")
+
+
+class IngestCounter:
+    """Thread-safe tally of the guard's work (the H2DCounter pattern): one
+    per fit, mirrored into the process-wide GLOBAL_INGEST that serve
+    /metrics exports as tdc_ingest_*. Quarantine counts here are EVENT
+    counts (a batch re-screened every pass counts every pass); the
+    per-fit IngestReport's distinct-batch view lives on the guard."""
+
+    def __init__(self, _mirror=None):
+        self._lock = threading.Lock()
+        self._mirror = _mirror
+        self.retries = 0
+        self.read_failures = 0
+        self.quarantined_batches = 0
+        self.quarantined_rows = 0
+        self.crc_failures = 0
+
+    def add_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+        if self._mirror is not None:
+            self._mirror.add_retry()
+
+    def add_failure(self) -> None:
+        with self._lock:
+            self.read_failures += 1
+        if self._mirror is not None:
+            self._mirror.add_failure()
+
+    def add_quarantine(self, rows: int, crc: bool = False) -> None:
+        with self._lock:
+            self.quarantined_batches += 1
+            self.quarantined_rows += int(rows)
+            if crc:
+                self.crc_failures += 1
+        if self._mirror is not None:
+            self._mirror.add_quarantine(rows, crc)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "retries": self.retries,
+                "read_failures": self.read_failures,
+                "quarantined_batches": self.quarantined_batches,
+                "quarantined_rows": self.quarantined_rows,
+                "crc_failures": self.crc_failures,
+            }
+
+
+# Process-wide counter (mirrored into by every per-fit counter); surfaced
+# by the serve /metrics endpoint as tdc_ingest_*.
+GLOBAL_INGEST = IngestCounter()
+
+
+class IngestReport(NamedTuple):
+    """Per-fit ingest summary attached to streamed fit results (the
+    CommsReport / SpillReport sibling). Quarantine fields are the DISTINCT
+    per-pass view: a poisoned batch re-quarantined on every pass counts
+    once, and `quarantined_rows` is the mass one pass drops — the number
+    `dropped_fraction` and the max_bad_fraction budget are about."""
+
+    retries: int  # read attempts retried after transient failures
+    read_failures: int  # reads abandoned (permanent / retries exhausted)
+    quarantined_batches: int  # distinct stream batch indices quarantined
+    quarantined_rows: int  # rows those batches held (one pass's worth)
+    rows_per_pass: int  # total rows one full pass streams (0 = unknown)
+    crc_failures: int  # quarantines from CRC sidecar mismatches
+
+    @property
+    def dropped_fraction(self) -> float:
+        """quarantined_rows / rows_per_pass — the fraction of the fit's
+        mass the quarantine dropped (0.0 when nothing was quarantined or
+        the pass size is unknown)."""
+        if self.rows_per_pass <= 0:
+            return 0.0
+        return self.quarantined_rows / self.rows_per_pass
+
+
+def screen_batch(x, *, d: int | None = None, w=None) -> str | None:
+    """Integrity screen for one host-side batch: returns None when clean,
+    else a short reason string. Checks the feature-width/shape contract
+    and scans for non-finite values (min/max — one cheap pass, NaN
+    poisons both ends); weighted streams also scan the weight row.
+    Device-resident batches (pre-staged jax.Arrays) pass unscreened: a
+    D2H fetch per batch would cost more than the fit step (the
+    _prepare_batch rule).
+
+    The `data.corrupt` fault point fires first, so $TDC_FAULTS can inject
+    a poisoned-batch verdict (`data.corrupt=raise:ValueError@N`)
+    deterministically without touching the data."""
+    try:
+        fault_point("data.corrupt")
+    except Exception as e:
+        return f"injected:{type(e).__name__}"
+    if not isinstance(x, np.ndarray):
+        return None
+    if x.ndim != 2 or (d is not None and x.shape[1] != d):
+        return f"bad_shape:{tuple(x.shape)}"
+    if x.size:
+        lo, hi = np.min(x), np.max(x)
+        if not (math.isfinite(float(lo)) and math.isfinite(float(hi))):
+            return "nonfinite"
+    if w is not None and isinstance(w, np.ndarray) and w.size:
+        wl, wh = np.min(w), np.max(w)
+        if not (math.isfinite(float(wl)) and math.isfinite(float(wh))):
+            return "nonfinite_weights"
+    return None
+
+
+class GuardedStream:
+    """The hardened wrapper around a driver's batch stream.
+
+    Preserves the stream protocols the drivers and the spill ring rely
+    on: zero-arg `__call__` -> fresh per-pass iterator; the RANGED
+    protocol (`read_batch(i)` + `num_batches`) when the raw stream has it
+    — so the spill ring's producer pool reads THROUGH the guard and
+    retries/screening run on those threads, overlapped with compute; and
+    the sizing protocol (`num_batches`/`batch_rows`/`n_rows`/...) by
+    attribute delegation, so residency planning is unchanged.
+
+    Yields raw batches untouched when clean, `Quarantined` markers when
+    not. Thread-safe: the spill ring screens concurrently.
+    """
+
+    def __init__(self, batches, policy: IngestPolicy, *, d: int | None = None,
+                 weighted: bool = False, label: str = "fit",
+                 counter: IngestCounter | None = None):
+        self._raw = batches
+        self.policy = policy
+        self.d = d
+        self.weighted = weighted
+        self.label = label
+        self.counter = (counter if counter is not None
+                        else IngestCounter(_mirror=GLOBAL_INGEST))
+        self.store = describe_store(batches)
+        self._lock = threading.Lock()
+        self._q_rows: dict[int, int] = {}  # distinct index -> rows dropped
+        self._reads = 0  # lifetime logical reads (pass windows = // nb)
+        self._pass_rows = 0
+        self._pass_q_rows = 0
+        self._rows_per_pass = 0  # total of the last completed pass
+        self._ranged = spill_lib.ranged_reader(batches)
+        if self._ranged is not None:
+            # Instance attribute so spill_lib.ranged_reader(guard) finds
+            # the GUARDED read — retries then run on the ring's producer
+            # pool, exactly where the read latency lives.
+            self.read_batch = self._read_guarded
+        hints = None
+        try:
+            from tdc_tpu.data import device_cache as _dc
+
+            hints = _dc.stream_hints(batches)
+        except Exception:
+            hints = None
+        self._known_rows = None if hints is None else int(hints.n_rows)
+
+    # Sizing-protocol passthrough (num_batches, batch_rows, n_rows, x,
+    # dtype, itemsize, ...): the guard must not hide the raw stream's
+    # advertised geometry from the residency planner.
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
+
+    # ---------------- reads ----------------
+
+    def _read_retrying(self, i: int, read):
+        """The retry/deadline ladder around one replayable read. Raises
+        via _fail on corrupt/permanent/exhausted; returns the raw batch."""
+        p = self.policy
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                fault_point("data.read.transient")
+                fault_point("data.read.permanent")
+                return read(i)
+            except Exception as e:
+                kind = classify_error(e)
+                if kind == "corrupt":
+                    raise
+                attempt += 1
+                delay = backoff_delay(p.io_backoff, attempt, self.label, i)
+                elapsed = time.monotonic() - t0
+                retryable = (
+                    kind == "transient"
+                    and attempt <= p.io_retries
+                    and (p.io_deadline is None
+                         or elapsed + delay <= p.io_deadline)
+                )
+                if not retryable:
+                    self._fail(i, kind, attempt, e)
+                delay_s = round(delay, 4)
+                self.counter.add_retry()
+                emit("ingest_retry", label=self.label, store=self.store,
+                     batch=i, attempt=attempt, kind=kind, delay_s=delay_s,
+                     error=f"{type(e).__name__}: {e}"[:200])
+                time.sleep(delay)
+
+    def _read_guarded(self, i: int):
+        """One logical ranged read: classify/retry/deadline around the raw
+        read_batch, then screen. Runs wherever the caller runs — the spill
+        producer pool for ranged spill fits, the dispatch thread inline."""
+        try:
+            batch = self._read_retrying(i, self._ranged[0])
+        except CorruptBatch as e:
+            return self._quarantine_corrupt(i, e)
+        return self._admit(i, batch)
+
+    def first_batch(self):
+        """Retry + screen the stream's FIRST batch for the drivers' init
+        resolution and equal-rows peek — the one read that otherwise
+        happened on the raw stream, outside the guard. Books NO pass
+        accounting (the first pass re-reads it). Returns the raw batch
+        when clean, a Quarantined marker when not: callers deriving an
+        INIT from it must refuse the marker (seeding from zeroed data
+        would silently produce garbage centroids), while geometry-only
+        peeks can read the marker's shapes."""
+        if self._ranged is not None:
+            try:
+                batch = self._read_retrying(0, self._ranged[0])
+            except CorruptBatch as e:
+                return self._peek_quarantined(0, e)
+        else:
+            try:
+                fault_point("data.read.transient")
+                fault_point("data.read.permanent")
+                batch = next(iter(self._raw()))
+            except StopIteration:
+                raise ValueError(
+                    f"{self.label}: empty batch stream ({self.store})"
+                ) from None
+            except Exception as e:
+                self._fail(0, classify_error(e), 1, e)
+        if self.weighted and isinstance(batch, tuple):
+            x, w = batch
+        else:
+            x, w = batch, None
+        reason = (screen_batch(x, d=self.d, w=w)
+                  if self.policy.screen else None)
+        if reason is None:
+            return batch
+        emit("ingest_quarantine", label=self.label, store=self.store,
+             batch=0, rows=self._rows_of(x), reason=reason, peek=True)
+        shape = (self._expected_shape(x)
+                 if reason.startswith("bad_shape") else np.asarray(x).shape)
+        if shape is None:
+            self._fail(0, "corrupt", 1, CorruptBatch(
+                f"first batch has shape {tuple(np.asarray(x).shape)} and "
+                "the expected geometry is unknown", batch=0, reason=reason,
+            ))
+        zx = np.zeros(shape, np.float32)
+        zw = (np.zeros(zx.shape[0], np.float32)
+              if (self.weighted or w is not None) else None)
+        return Quarantined(zx, zw, 0, reason)
+
+    def _peek_quarantined(self, i: int, e: CorruptBatch):
+        shape = e.shape if e.shape is not None else self._expected_shape()
+        if shape is None:
+            self._fail(i, "corrupt", 1, e)
+        zw = np.zeros(shape[0], np.float32) if self.weighted else None
+        return Quarantined(np.zeros(shape, np.float32), zw, i,
+                           f"crc:{e.reason}")
+
+    def _fail(self, i: int, kind: str, attempts: int, e: Exception):
+        """Abandoned read: ONE ingest_failed event naming the batch and
+        store BEFORE anything raises — never a raw reader traceback
+        surfacing out-of-order from the prefetch queue with nothing
+        pointing at the store. Permanent failures then re-raise the
+        original exception (its type is the caller's contract); exhausted
+        transient ones wrap in IngestReadError with the retry context."""
+        self.counter.add_failure()
+        emit("ingest_failed", label=self.label, store=self.store, batch=i,
+             kind=kind, attempts=attempts,
+             error=f"{type(e).__name__}: {e}"[:300])
+        if kind != "transient":
+            raise e
+        raise IngestReadError(
+            f"{self.label}: batch {i} of {self.store} failed "
+            f"({kind}, {attempts} attempt(s)): {type(e).__name__}: {e}"
+        ) from e
+
+    def _expected_shape(self, x=None) -> tuple[int, int] | None:
+        """The geometry the REPLACEMENT batch must have: the raw batch's
+        row count (stream geometry — the equal-rows contract) times the
+        fit's feature width. The corrupt batch's own shape is exactly
+        what cannot be trusted (a truncated record's wrong width would
+        crash the accumulate kernel, the crash the screen exists to
+        prevent)."""
+        rows = None
+        if x is not None:
+            shape = getattr(np.asarray(x), "shape", None)
+            # Trust the row count only off a 2-D batch (wrong WIDTH);
+            # a flat/deeper array's leading dim is not a row count.
+            if shape is not None and len(shape) == 2:
+                rows = int(shape[0])
+        if rows is None:
+            br = getattr(self._raw, "batch_rows", None)
+            try:
+                rows = int(br)
+            except (TypeError, ValueError):
+                return None
+        return None if self.d is None else (rows, int(self.d))
+
+    def _quarantine_corrupt(self, i: int, e: CorruptBatch):
+        """Store-detected corruption (CRC mismatch): build the zero-mass
+        replacement from the error's geometry (a CRC mismatch leaves the
+        batch's shape intact — only its bytes are wrong)."""
+        shape = e.shape
+        if shape is None:
+            shape = self._expected_shape()
+            if shape is None:
+                self._fail(i, "corrupt", 1, e)
+        zeros = np.zeros(shape, e.dtype if e.dtype is not None
+                         else np.float32)
+        zw = np.zeros(shape[0], np.float32) if self.weighted else None
+        return self._book_quarantine(i, zeros, zw, f"crc:{e.reason}",
+                                     crc=True)
+
+    # ---------------- screen + accounting ----------------
+
+    def _admit(self, i: int, batch):
+        """Screen one successfully-read batch and book pass accounting."""
+        if self.weighted and isinstance(batch, tuple):
+            x, w = batch
+        else:
+            x, w = batch, None
+        reason = (screen_batch(x, d=self.d, w=w)
+                  if self.policy.screen else None)
+        if reason is None:
+            self._book_rows(self._rows_of(x))
+            return batch
+        xa = np.asarray(x)
+        if reason.startswith("bad_shape"):
+            # The corrupt batch's OWN shape is the problem (truncated
+            # record, wrong width): the replacement must carry the
+            # EXPECTED geometry or the accumulate kernel crashes — the
+            # exact crash the quarantine exists to prevent.
+            shape = self._expected_shape(x)
+            if shape is None:
+                self._fail(i, "corrupt", 1, CorruptBatch(
+                    f"batch {i} has shape {tuple(xa.shape)} and the "
+                    "expected geometry is unknown (no feature width / "
+                    "batch_rows to rebuild from)",
+                    batch=i, reason=reason,
+                ))
+        else:
+            shape = xa.shape
+        zx = np.zeros(shape, xa.dtype if xa.dtype.kind in "fiu"
+                      else np.float32)
+        zw = (np.zeros(zx.shape[0], np.float32)
+              if (self.weighted or w is not None) else None)
+        return self._book_quarantine(i, zx, zw, reason)
+
+    @staticmethod
+    def _rows_of(x) -> int:
+        # Shape attribute only — np.asarray here would D2H-copy a
+        # pre-staged device batch per read (the _prepare_batch rule).
+        shape = getattr(x, "shape", None)
+        if shape is not None and len(shape) > 0:
+            return int(shape[0])
+        return int(np.asarray(x).shape[0])
+
+    def _book_rows(self, rows: int) -> None:
+        with self._lock:
+            self._begin_read_locked()
+            self._pass_rows += rows
+            over = self._end_read_locked()
+        if over:
+            self._abort(over)
+
+    def _book_quarantine(self, i: int, zx, zw, reason: str,
+                         crc: bool = False):
+        rows = self._rows_of(zx)
+        self.counter.add_quarantine(rows, crc=crc)
+        emit("ingest_quarantine", label=self.label, store=self.store,
+             batch=i, rows=rows, reason=reason)
+        with self._lock:
+            self._begin_read_locked()
+            self._pass_rows += rows
+            self._q_rows[i] = rows
+            self._pass_q_rows += rows
+            over = (self._budget_exceeded_locked(at_pass_end=False)
+                    or self._end_read_locked())
+        if over:
+            self._abort(over)
+        return Quarantined(zx, zw, i, reason)
+
+    def _begin_read_locked(self) -> None:
+        nb = self._num_batches()
+        if nb and self._reads % nb == 0:
+            # First read of a new pass window: reset per-pass tallies.
+            self._pass_rows = 0
+            self._pass_q_rows = 0
+        self._reads += 1
+
+    def _end_read_locked(self) -> str | None:
+        """Pass-window bookkeeping after one logical read; returns the
+        abort detail when the completed pass exceeded the loss budget
+        (the no-advertised-size case the per-quarantine check defers)."""
+        nb = self._num_batches()
+        if nb and self._reads % nb == 0:
+            self._rows_per_pass = self._pass_rows
+            return self._budget_exceeded_locked(at_pass_end=True)
+        return None
+
+    def _num_batches(self) -> int | None:
+        if self._ranged is not None:
+            return int(self._ranged[1])
+        nb = getattr(self._raw, "num_batches", None)
+        try:
+            return int(nb)
+        except (TypeError, ValueError):
+            return None
+
+    def _budget_exceeded_locked(self, at_pass_end: bool) -> str | None:
+        """The bounded-loss policy: returns the abort detail when the
+        quarantined fraction provably exceeds max_bad_fraction. Evaluated
+        against the advertised pass size when the stream has one (stable
+        at quarantine time), else deferred to pass end."""
+        if self._pass_q_rows <= 0:
+            return None
+        mbf = float(self.policy.max_bad_fraction)
+        if mbf <= 0.0:
+            return (f"{self._pass_q_rows} row(s) quarantined under the "
+                    "strict max_bad_fraction=0.0 policy")
+        total = self._known_rows
+        if total is None and at_pass_end:
+            total = self._pass_rows
+        if total and self._pass_q_rows / total > mbf:
+            return (f"quarantined {self._pass_q_rows}/{total} rows "
+                    f"({self._pass_q_rows / total:.3f}) > "
+                    f"max_bad_fraction={mbf}")
+        return None
+
+    def _abort(self, detail: str):
+        emit("ingest_abort", label=self.label, store=self.store,
+             quarantined_batches=len(self._q_rows),
+             quarantined_rows=self._pass_q_rows, detail=detail)
+        raise IngestAbort(
+            f"{self.label}: too much data quarantined to trust the result "
+            f"({detail}); raise max_bad_fraction only if bounded loss is "
+            "acceptable, or fix the store"
+        )
+
+    # ---------------- iteration ----------------
+
+    def __call__(self):
+        if self._ranged is not None:
+            return self._iter_ranged()
+        return self._iter_sequential()
+
+    def _iter_ranged(self):
+        for i in range(int(self._ranged[1])):
+            yield self._read_guarded(i)
+
+    def _iter_sequential(self):
+        """Sequential (generator) streams: a failed `next` cannot be
+        replayed — the raising generator is CLOSED, and on a weighted
+        stream continuing past the zip would silently misalign points and
+        weights — so every read failure here classifies + fails loudly
+        without retry (CorruptBatch included: quarantining a corrupt READ
+        needs the ranged path's independent reads). The screen and its
+        quarantine verdicts run unchanged."""
+        it = iter(self._raw())
+        i = 0
+        while True:
+            try:
+                fault_point("data.read.transient")
+                fault_point("data.read.permanent")
+                batch = next(it)
+            except StopIteration:
+                with self._lock:
+                    self._rows_per_pass = self._pass_rows
+                    over = self._budget_exceeded_locked(at_pass_end=True)
+                    # Reset here too: sequential streams may not advertise
+                    # num_batches, so the pass window is the iterator.
+                    self._pass_rows = 0
+                    self._pass_q_rows = 0
+                    self._reads = 0
+                if over:
+                    self._abort(over)
+                return
+            except Exception as e:
+                self._fail(i, classify_error(e), 1, e)
+            yield self._admit(i, batch)
+            i += 1
+
+    # ---------------- report ----------------
+
+    def quarantined_rows_seen(self) -> int:
+        """Distinct quarantined rows so far (the first-pass gang
+        crosscheck compares this across hosts)."""
+        with self._lock:
+            return sum(self._q_rows.values())
+
+    def report(self) -> IngestReport:
+        c = self.counter.snapshot()
+        with self._lock:
+            q_rows = sum(self._q_rows.values())
+            rows_pp = self._rows_per_pass or self._pass_rows
+            if not rows_pp and self._known_rows:
+                rows_pp = self._known_rows
+            return IngestReport(
+                retries=c["retries"],
+                read_failures=c["read_failures"],
+                quarantined_batches=len(self._q_rows),
+                quarantined_rows=q_rows,
+                rows_per_pass=int(rows_pp),
+                crc_failures=c["crc_failures"],
+            )
+
+
+def guard_stream(batches, ingest, *, d: int | None = None,
+                 weighted: bool = False, label: str = "fit") -> GuardedStream:
+    """The streamed drivers' ONE ingest wiring point (the wrap_stream
+    sibling): resolve the policy and wrap the (possibly weighted-zipped)
+    stream. Wrap BEFORE spill_lib.wrap_stream so the ring's ranged reads
+    go through the guard and retries run on its producer threads."""
+    return GuardedStream(
+        batches, resolve_policy(ingest), d=d, weighted=weighted, label=label,
+    )
+
+
+__all__ = [
+    "DEFAULT_POLICY",
+    "GLOBAL_INGEST",
+    "PASSTHROUGH_POLICY",
+    "CorruptBatch",
+    "GuardedStream",
+    "IngestAbort",
+    "IngestCounter",
+    "IngestPolicy",
+    "IngestReadError",
+    "IngestReport",
+    "Quarantined",
+    "backoff_delay",
+    "classify_error",
+    "describe_store",
+    "guard_stream",
+    "resolve_policy",
+    "screen_batch",
+]

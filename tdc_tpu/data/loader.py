@@ -11,6 +11,7 @@ via jax's async dispatch.
 from __future__ import annotations
 
 import os
+import zlib
 from typing import Iterator
 
 import numpy as np
@@ -133,16 +134,114 @@ def to_feature_major(
     return dst_path
 
 
+CRC_SIDECAR_SUFFIX = ".crc.json"
+
+
+def crc_sidecar_path(data_path: str) -> str:
+    """Conventional sidecar location next to a data file."""
+    return data_path + CRC_SIDECAR_SUFFIX
+
+
+def write_crc_sidecar(x: np.ndarray, batch_rows: int, path: str) -> str:
+    """Write the CRC32 sidecar for a batched array: one checksum per
+    `read_batch(i)` slice, computed over the batch's contiguous bytes.
+    Written at SAVE time (to_npy does it with crc=True) so ranged reads
+    can verify bytes end-to-end — bit rot or a torn object-store write is
+    then surfaced as a quarantine (data/ingest.py CorruptBatch), never as
+    silently-wrong centroids."""
+    import json
+
+    batch_rows = int(batch_rows)
+    n = x.shape[0]
+    crcs = []
+    for start in range(0, n, batch_rows):
+        b = np.ascontiguousarray(x[start : start + batch_rows])
+        crcs.append(zlib.crc32(b.tobytes()))
+    meta = {
+        "batch_rows": batch_rows,
+        "n_rows": int(n),
+        "dtype": str(np.dtype(x.dtype)),
+        "crcs": crcs,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path)
+    return path
+
+
 class NpzStream:
     """Re-iterable batch stream over a memmapped array or in-memory array.
 
     `callable` protocol matches models/streaming.py: stream() returns a fresh
     iterator each call (one full pass per Lloyd iteration).
+
+    `crc_sidecar` (a path written by `write_crc_sidecar`, or its loaded
+    dict) arms per-batch CRC32 verification on every ranged read:
+    corrupt-on-disk bytes raise `data.ingest.CorruptBatch`, which the
+    ingest guard turns into a zero-mass quarantine instead of a crash.
+    The sidecar's batch_rows must match the stream's — a mismatched
+    sidecar would verify nothing and is rejected loudly.
     """
 
-    def __init__(self, x: np.ndarray, batch_rows: int):
+    def __init__(self, x: np.ndarray, batch_rows: int, crc_sidecar=None):
         self.x = x
         self.batch_rows = int(batch_rows)
+        self._crcs = None
+        if crc_sidecar is not None:
+            if isinstance(crc_sidecar, str):
+                import json
+
+                with open(crc_sidecar) as f:
+                    crc_sidecar = json.load(f)
+            if int(crc_sidecar.get("batch_rows", -1)) != self.batch_rows:
+                raise ValueError(
+                    "CRC sidecar was written for batch_rows="
+                    f"{crc_sidecar.get('batch_rows')}, stream uses "
+                    f"{self.batch_rows} — re-generate the sidecar "
+                    "(write_crc_sidecar) for this batch size"
+                )
+            if int(crc_sidecar.get("n_rows", -1)) != int(x.shape[0]):
+                raise ValueError(
+                    f"CRC sidecar covers {crc_sidecar.get('n_rows')} rows, "
+                    f"stream holds {x.shape[0]}"
+                )
+            self._crcs = [int(c) for c in crc_sidecar["crcs"]]
+
+    @classmethod
+    def from_npy(cls, path: str, batch_rows: int, *, mmap: bool = True,
+                 verify_crc: str = "auto") -> "NpzStream":
+        """Open a .npy as a stream, auto-arming CRC verification when the
+        conventional sidecar exists (verify_crc: 'auto' | 'require' |
+        'off')."""
+        if verify_crc not in ("auto", "require", "off"):
+            # An unknown value silently disabling verification would be
+            # the exact quiet failure the sidecar exists to prevent.
+            raise ValueError(
+                f"verify_crc={verify_crc!r}: use 'auto', 'require', "
+                "or 'off'"
+            )
+        x = np.load(path, mmap_mode="r" if mmap else None)
+        sidecar = crc_sidecar_path(path)
+        have = os.path.exists(sidecar)
+        if verify_crc == "require" and not have:
+            raise FileNotFoundError(
+                f"verify_crc='require' but no sidecar at {sidecar}"
+            )
+        use = have and verify_crc != "off"
+        s = cls(_restore_bf16(x), batch_rows,
+                crc_sidecar=sidecar if use else None)
+        s.path = path  # store identity for ingest events
+        return s
+
+    def write_crc_sidecar(self, path: str) -> str:
+        """Write (and arm) the sidecar for this stream's geometry."""
+        out = write_crc_sidecar(self.x, self.batch_rows, path)
+        import json
+
+        with open(out) as f:
+            self._crcs = [int(c) for c in json.load(f)["crcs"]]
+        return out
 
     def __call__(self) -> Iterator[np.ndarray]:
         for i in range(self.num_batches):
@@ -153,17 +252,36 @@ class NpzStream:
         data/spill.ranged_reader): batch `i` of the `__call__` order.
         Thread-safe — a pure slice-copy of the backing (mem)map, so the
         spill tier can run several reads concurrently to hide per-read
-        latency (cold page faults on a memmapped .npy)."""
+        latency (cold page faults on a memmapped .npy). With an armed CRC
+        sidecar the copied bytes are verified here, INSIDE the ranged
+        read, so corruption surfaces on the thread that read it and the
+        ingest guard can quarantine instead of crash."""
         start = i * self.batch_rows
-        return np.ascontiguousarray(self.x[start : start + self.batch_rows])
+        b = np.ascontiguousarray(self.x[start : start + self.batch_rows])
+        if self._crcs is not None:
+            got = zlib.crc32(b.tobytes())
+            want = self._crcs[i]
+            if got != want:
+                from tdc_tpu.data.ingest import CorruptBatch
+
+                raise CorruptBatch(
+                    f"batch {i} CRC mismatch (want {want}, got {got})",
+                    batch=i, reason="crc_mismatch", shape=b.shape,
+                    dtype=b.dtype,
+                )
+        return b
 
     @property
     def num_batches(self) -> int:
         return -(-self.x.shape[0] // self.batch_rows)
 
     @staticmethod
-    def to_npy(npz_path: str, npy_path: str, key: str = "X", chunk: int = 1 << 22) -> str:
-        """One-time .npz → memmappable .npy conversion for out-of-core runs."""
+    def to_npy(npz_path: str, npy_path: str, key: str = "X",
+               chunk: int = 1 << 22, crc_batch_rows: int | None = None) -> str:
+        """One-time .npz → memmappable .npy conversion for out-of-core runs.
+        `crc_batch_rows` additionally writes the CRC32 sidecar at save time
+        (one checksum per future `read_batch` slice of that size) so
+        `from_npy` streams verify reads end-to-end."""
         with np.load(npz_path, allow_pickle=False) as z:
             src = z[key]
             out = np.lib.format.open_memmap(
@@ -172,4 +290,7 @@ class NpzStream:
             for s in range(0, src.shape[0], chunk):
                 out[s : s + chunk] = src[s : s + chunk]
             out.flush()
+            if crc_batch_rows:
+                write_crc_sidecar(out, crc_batch_rows,
+                                  crc_sidecar_path(npy_path))
         return npy_path

@@ -44,6 +44,10 @@ class FuzzyCMeansResult(NamedTuple):
     # data/spill.SpillReport — H2D prefetch-ring accounting, filled when
     # the fit ran the spill residency tier (None otherwise).
     h2d: object = None
+    # data/ingest.IngestReport — hardened-ingest accounting (read retries,
+    # quarantined batches/rows, dropped mass fraction), filled by the
+    # streamed drivers (None for in-memory fits).
+    ingest: object = None
 
 
 def _fuzzy_stats_fn(kernel: str, m: float, block_rows: int, mesh=None):

@@ -45,6 +45,10 @@ class KMeansResult(NamedTuple):
     # stall seconds, overlap fraction), filled when the fit ran the spill
     # residency tier (None otherwise).
     h2d: object = None
+    # data/ingest.IngestReport — hardened-ingest accounting (read retries,
+    # quarantined batches/rows, dropped mass fraction), filled by the
+    # streamed drivers (None for in-memory fits).
+    ingest: object = None
 
 
 def _normalize(c: jax.Array) -> jax.Array:

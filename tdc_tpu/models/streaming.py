@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tdc_tpu.data import device_cache as device_cache_lib
+from tdc_tpu.data import ingest as ingest_lib
 from tdc_tpu.data import spill as spill_lib
 from tdc_tpu.models import resident as resident_lib
 from tdc_tpu.ops.assign import (
@@ -166,6 +167,7 @@ def _run_pass(
     rows0: int = 0,
     save_args=None,
     crosscheck_mesh=None,
+    crosscheck_quarantine=None,
     preempt_batch: bool = False,
     preempt_can_save: bool = False,
 ):
@@ -232,7 +234,15 @@ def _run_pass(
                         f"preempted during resume replay at batch {i + 1}"
                     )
                 # Weighted streams yield (x, w) pairs; rows come from x.
-                xb = batch[0] if isinstance(batch, tuple) else batch
+                # Quarantined markers (data/ingest.py) carry the raw batch
+                # GEOMETRY — resume accounting counts stream rows, not
+                # validity, so quarantine verdicts cannot shift the cursor.
+                if isinstance(batch, ingest_lib.Quarantined):
+                    xb = batch.x
+                elif isinstance(batch, tuple):
+                    xb = batch[0]
+                else:
+                    xb = batch
                 # Replay prefix only; xb is the host-side stream batch
                 # (shape read, no device value involved).
                 skipped_rows += np.asarray(xb).shape[0]  # tdclint: disable=TDC002
@@ -276,7 +286,11 @@ def _run_pass(
             mismatch = True
         if not mismatch:
             if crosscheck_mesh is not None:
-                _crosscheck_pass_rows(crosscheck_mesh, rows)
+                _crosscheck_pass_rows(
+                    crosscheck_mesh, rows,
+                    quarantined=(crosscheck_quarantine()
+                                 if crosscheck_quarantine else 0),
+                )
             return acc
         import sys
 
@@ -338,39 +352,73 @@ def _prepare_batch(batch, mesh):
     return mesh_lib.shard_points(padded, mesh), n_local, n_local
 
 
-def _crosscheck_pass_rows(mesh, rows: int) -> None:
+def _crosscheck_pass_rows(mesh, rows: int, quarantined: int = 0) -> None:
     """End-of-pass counterpart of _check_equal_local_rows: a host whose
     stream diverges in ROW TOTALS on a later batch (ragged tail) gets a
     clear error pointing at batch sizing instead of a wrong accumulation
     (round-2 advisor finding). One cheap allgather of this host's per-pass
-    row total, run on the first full pass only. Limitation: hosts with
-    different BATCH COUNTS still hang/die inside the per-batch collective
-    before reaching this check — only equal-batch-count divergence is
-    diagnosable post-pass."""
+    (row, quarantined-row) totals, run on the first full pass only — the
+    quarantine totals enforce the symmetric-verdict contract of the
+    ingest guard (data/ingest.py): per-host-divergent corruption would
+    otherwise silently desynchronize replicated state. Limitation: hosts
+    with different BATCH COUNTS still hang/die inside the per-batch
+    collective before reaching this check — only equal-batch-count
+    divergence is diagnosable post-pass."""
     if mesh is None or _mesh_layout(mesh)[0] <= 1:
         return
     from jax.experimental import multihost_utils
 
-    counts = np.asarray(multihost_utils.process_allgather(np.int64(rows)))
-    if not (counts == counts.flat[0]).all():
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([rows, quarantined], np.int64)
+    )).reshape(-1, 2)
+    if not (counts[:, 0] == counts[0, 0]).all():
         raise ValueError(
             "multi-process streamed fit: per-pass row totals diverge "
-            f"across hosts ({counts.ravel().tolist()}) — every host must "
+            f"across hosts ({counts[:, 0].tolist()}) — every host must "
             "stream the same local row count per pass (ragged tail or "
             "unequal batch counts somewhere after the first batch); use "
             "host_shard_bounds with totals divisible by the process count"
         )
+    if not (counts[:, 1] == counts[0, 1]).all():
+        raise ValueError(
+            "multi-process streamed fit: ingest quarantine verdicts "
+            f"diverge across hosts (quarantined rows {counts[:, 1].tolist()}"
+            ") — the gang-consistent quarantine contract requires every "
+            "host to reach the same verdict per batch (corruption confined "
+            "to one host's store replica); repair or re-replicate the "
+            "divergent store instead of fitting on asymmetric data"
+        )
 
 
-def _check_equal_local_rows(batches, first, mesh):
+def _first_for_init(guard):
+    """The init-resolution peek, THROUGH the ingest guard (retries +
+    screen apply to batch 0 like any other batch). A quarantine verdict
+    refuses loudly: resolving a data-dependent init from a zeroed
+    replacement batch would silently seed garbage centroids."""
+    fb = guard.first_batch()
+    if isinstance(fb, ingest_lib.Quarantined):
+        raise ingest_lib.IngestAbort(
+            f"{guard.label}: the stream's first batch failed the ingest "
+            f"screen ({fb.reason}) and the init must be derived from it — "
+            "pass an explicit init array, or repair the store"
+        )
+    return fb
+
+
+def _check_equal_local_rows(batches, first, mesh, read_first=None):
     """One-time validation of the equal-local-rows contract (first batch
     only): unequal per-host counts would otherwise surface as a cross-host
     shape mismatch or a silently hung collective with nothing pointing at
-    batch sizing. Reuses `first` when the init path already read it."""
+    batch sizing. Reuses `first` when the init path already read it;
+    `read_first` (the ingest guard's first_batch) keeps the fallback read
+    inside the guard — a Quarantined peek still carries the geometry this
+    check needs."""
     if mesh is None or _mesh_layout(mesh)[0] <= 1:
         return
     if first is None:
-        first = next(iter(batches()))
+        first = read_first() if read_first else next(iter(batches()))
+    if isinstance(first, ingest_lib.Quarantined):
+        first = first.x
     if isinstance(first, tuple):  # weighted stream: rows come from x
         first = first[0]
     from jax.experimental import multihost_utils
@@ -1064,6 +1112,7 @@ def streamed_kmeans_fit(
     kernel: str = "xla",
     reduce="per_batch",
     residency: str = "stream",
+    ingest=None,
 ) -> KMeansResult:
     """Exact Lloyd over a re-iterable stream of (B, d) batches.
 
@@ -1145,6 +1194,18 @@ def streamed_kmeans_fit(
         per-batch heartbeats, and preemption drains unchanged. A
         mid-pass checkpoint resume degrades every mode to
         streaming for that run (the fill cannot replay a partial pass).
+      ingest: data/ingest.IngestPolicy (or dict / None for the strict
+        default) — the hardened-ingest guard every pass streams through:
+        transient read failures retry with backoff+jitter (`io_retries`,
+        `io_backoff`, `io_deadline`; ranged streams retry inside the spill
+        ring's producer threads, overlapped with compute), corrupt batches
+        (non-finite rows, shape breaks, CRC sidecar mismatches) are
+        QUARANTINED as zero-mass batches rather than skipped — collective
+        schedule and batch geometry stay verdict-independent, so a gang
+        cannot deadlock on a bad batch — and `max_bad_fraction` bounds the
+        dropped mass before the fit aborts loudly (strict 0.0 default).
+        The result's `ingest` field carries the IngestReport; with a clean
+        stream the guarded fit is fp32-bit-exact with the unguarded one.
     """
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
@@ -1157,9 +1218,11 @@ def streamed_kmeans_fit(
             "the explicit kernel"
         )
     stream = _weighted_stream(batches, sample_weight_batches)
+    guard = ingest_lib.guard_stream(stream, ingest, d=d, weighted=weighted,
+                                    label="streamed_kmeans_fit")
     first = None
     if not hasattr(init, "shape"):
-        fb = next(iter(stream()))
+        fb = _first_for_init(guard)
         first_w = None
         if weighted:
             fb, first_w = fb
@@ -1175,7 +1238,8 @@ def streamed_kmeans_fit(
         raise ValueError(f"init shape {c.shape} != {(k, d)}")
     if spherical:
         c = _normalize(c)
-    _check_equal_local_rows(stream, first, mesh)
+    _check_equal_local_rows(stream, first, mesh,
+                            read_first=guard.first_batch)
     if mesh is not None:
         c = mesh_lib.replicate(c, mesh)
 
@@ -1220,7 +1284,19 @@ def streamed_kmeans_fit(
     def _stage(batch):
         # The driver's staging path — shared by the inline step and the
         # spill ring's producer thread, so the consumer sees identical
-        # arrays either way (the spill parity bar).
+        # arrays either way (the spill parity bar). A Quarantined marker
+        # (data/ingest.py) stages as the ALL-PADDING batch: zero rows with
+        # zero valid count (zero weights when weighted), so the existing
+        # pad-correction algebra makes its contribution exactly zero mass
+        # with no verdict-dependent control flow.
+        if isinstance(batch, ingest_lib.Quarantined):
+            if weighted:
+                xb, wb, n_local = _prepare_weighted_batch(
+                    batch.x, batch.w, mesh
+                )
+                return spill_lib.StagedBatch(xb, xb.shape[0], n_local, wb)
+            xb, _, n_local = _prepare_batch(batch.x, mesh)
+            return spill_lib.StagedBatch(xb, 0, n_local)
         if weighted:
             xb, wb, n_local = _prepare_weighted_batch(batch[0], batch[1],
                                                       mesh)
@@ -1228,7 +1304,7 @@ def streamed_kmeans_fit(
         xb, n_valid, n_local = _prepare_batch(batch, mesh)
         return spill_lib.StagedBatch(xb, n_valid, n_local)
 
-    run_stream, h2d = spill_lib.wrap_stream(r_plan, stream, _stage)
+    run_stream, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     run_prefetch = prefetch if h2d is None else 0
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
     passes = [0]
@@ -1284,6 +1360,7 @@ def streamed_kmeans_fit(
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
+            crosscheck_quarantine=guard.quarantined_rows_seen,
             preempt_batch=not ckpt.gang,
             preempt_can_save=bool(ckpt_every_batches) and not deferred,
         )
@@ -1404,6 +1481,7 @@ def streamed_kmeans_fit(
             logical_bytes=counter.logical_bytes, passes=passes[0],
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
+        ingest=guard.report(),
     )
 
 
@@ -1564,6 +1642,7 @@ def streamed_fuzzy_fit(
     kernel: str = "xla",
     reduce="per_batch",
     residency: str = "stream",
+    ingest=None,
 ) -> FuzzyCMeansResult:
     """Exact streamed Fuzzy C-Means — same contract as streamed_kmeans_fit,
     including checkpoint/resume (per-iteration and mid-pass, with the
@@ -1579,7 +1658,11 @@ def streamed_fuzzy_fit(
     host transfers per iteration; "spill" double-buffers H2D copies
     behind compute for over-budget datasets; "auto" picks hbm, then
     spill, then plain streaming, all loudly; see streamed_kmeans_fit,
-    data/device_cache.py, and data/spill.py)."""
+    data/device_cache.py, and data/spill.py), and the `ingest=` hardened
+    ingest policy (I/O retry + zero-mass corrupt-batch quarantine +
+    bounded-loss accounting with a strict max_bad_fraction=0.0 default;
+    see streamed_kmeans_fit and data/ingest.py — the IngestReport rides
+    the result's `ingest` field)."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
     if kernel not in ("xla", "pallas"):
@@ -1593,9 +1676,11 @@ def streamed_fuzzy_fit(
             "explicit kernel"
         )
     stream = _weighted_stream(batches, sample_weight_batches)
+    guard = ingest_lib.guard_stream(stream, ingest, d=d, weighted=weighted,
+                                    label="streamed_fuzzy_fit")
     first = None
     if not hasattr(init, "shape"):
-        fb = next(iter(stream()))
+        fb = _first_for_init(guard)
         first_w = None
         if weighted:
             fb, first_w = fb
@@ -1607,7 +1692,8 @@ def streamed_fuzzy_fit(
     c = jnp.asarray(init, jnp.float32)
     if c.shape != (k, d):
         raise ValueError(f"init shape {c.shape} != {(k, d)}")
-    _check_equal_local_rows(stream, first, mesh)
+    _check_equal_local_rows(stream, first, mesh,
+                            read_first=guard.first_batch)
     if mesh is not None:
         c = mesh_lib.replicate(c, mesh)
 
@@ -1655,6 +1741,16 @@ def streamed_fuzzy_fit(
     def _stage(batch):
         # Shared by the inline step and the spill ring's producer thread
         # (streamed_kmeans_fit's rule: identical arrays either way).
+        # Quarantined markers stage as the all-padding zero-mass batch
+        # (see streamed_kmeans_fit._stage).
+        if isinstance(batch, ingest_lib.Quarantined):
+            if weighted:
+                xb, wb, n_local = _prepare_weighted_batch(
+                    batch.x, batch.w, mesh
+                )
+                return spill_lib.StagedBatch(xb, xb.shape[0], n_local, wb)
+            xb, _, n_local = _prepare_batch(batch.x, mesh)
+            return spill_lib.StagedBatch(xb, 0, n_local)
         if weighted:
             xb, wb, n_local = _prepare_weighted_batch(batch[0], batch[1],
                                                       mesh)
@@ -1662,7 +1758,7 @@ def streamed_fuzzy_fit(
         xb, n_valid, n_local = _prepare_batch(batch, mesh)
         return spill_lib.StagedBatch(xb, n_valid, n_local)
 
-    run_stream, h2d = spill_lib.wrap_stream(r_plan, stream, _stage)
+    run_stream, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     run_prefetch = prefetch if h2d is None else 0
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
     passes = [0]
@@ -1717,6 +1813,7 @@ def streamed_fuzzy_fit(
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
+            crosscheck_quarantine=guard.quarantined_rows_seen,
             preempt_batch=not ckpt.gang,
             preempt_can_save=bool(ckpt_every_batches) and not deferred,
         )
@@ -1824,4 +1921,5 @@ def streamed_fuzzy_fit(
             logical_bytes=counter.logical_bytes, passes=passes[0],
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
+        ingest=guard.report(),
     )

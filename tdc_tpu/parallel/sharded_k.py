@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tdc_tpu.data import ingest as ingest_lib
 from tdc_tpu.data import spill as spill_lib
 from tdc_tpu.parallel.compat import shard_map
 from tdc_tpu.parallel.meshspec import MeshSpec
@@ -1285,6 +1286,7 @@ def streamed_kmeans_fit_sharded(
     ckpt_every_batches: int | None = None,
     reduce="per_batch",
     residency: str = "stream",
+    ingest=None,
 ) -> KMeansResult:
     """Exact out-of-core Lloyd under the 2-D (data × model) layout — the
     1B×768, K=16,384 configuration: batches stream host→device, each batch's
@@ -1320,6 +1322,13 @@ def streamed_kmeans_fit_sharded(
     `dtype` (e.g. jnp.bfloat16) converts batches host-side before transfer —
     the MXU fast path for the bf16 K=16,384 regime; stats stay f32.
 
+    ingest: the hardened-ingest policy (data/ingest.IngestPolicy; see
+    streamed_kmeans_fit) — read retry/backoff, zero-mass corrupt-batch
+    quarantine (every process streams IDENTICAL global batches here, so
+    quarantine verdicts are symmetric across a gang by construction), and
+    bounded-loss accounting on the result's `ingest` field with the
+    strict max_bad_fraction=0.0 default.
+
     ckpt_dir enables checkpoint/resume with the models/streaming contract
     (per-iteration saves every `ckpt_every` iterations; mid-pass accumulator
     + batch-cursor saves every `ckpt_every_batches` batches; resume is
@@ -1336,6 +1345,7 @@ def streamed_kmeans_fit_sharded(
     """
     from tdc_tpu.models.streaming import (
         _StreamCheckpointer,
+        _first_for_init,
         _history_array,
         _lloyd_example,
         _mesh_layout,
@@ -1384,6 +1394,8 @@ def streamed_kmeans_fit_sharded(
     )
     if gang:
         ckpt = _GatheringCheckpointer(ckpt)
+    guard = ingest_lib.guard_stream(batches, ingest, d=d,
+                                    label="streamed_kmeans_fit_sharded")
     # Restore FIRST (models/streaming convention): a resume must not re-pay
     # init resolution, and must report the checkpointed state faithfully.
     state = ckpt.restore(_ShardedAcc, None)
@@ -1399,7 +1411,7 @@ def streamed_kmeans_fit_sharded(
         restored = False
         first = None
         if not hasattr(init, "shape"):
-            first = np.asarray(next(iter(batches())))
+            first = np.asarray(_first_for_init(guard))
             if spherical:
                 first = np.asarray(
                     _normalize(jnp.asarray(first, jnp.float32))
@@ -1491,14 +1503,16 @@ def streamed_kmeans_fit_sharded(
             return _finalize_jit(acc, c, jnp.asarray(n_pad, jnp.float32))
 
         def step_batch(acc, batch, c, fill=None):
-            if isinstance(batch, spill_lib.StagedBatch):
-                xb, n_valid = batch.xb, batch.n_valid
-            else:
-                xb, n_valid = put_batch(batch)
+            # _stage (below) handles raw AND Quarantined batches; rows for
+            # resume accounting come from n_local (stream geometry), which
+            # a quarantine verdict never changes.
+            sb = (batch if isinstance(batch, spill_lib.StagedBatch)
+                  else _stage(batch))
+            xb, n_valid = sb.xb, sb.n_valid
             if fill is not None:
                 fill.add(xb, n_valid)
             pad_cell[0] += xb.shape[0] - n_valid
-            return accumulate(acc, xb, c), n_valid
+            return accumulate(acc, xb, c), sb.n_local
 
         def zero_acc() -> _ShardedAcc:
             # Sharding-first zeros: this runs once per pass and the
@@ -1533,14 +1547,13 @@ def streamed_kmeans_fit_sharded(
             )
 
         def step_batch(acc, batch, c, fill=None):
-            if isinstance(batch, spill_lib.StagedBatch):
-                xb, n_valid = batch.xb, batch.n_valid
-            else:
-                xb, n_valid = put_batch(batch)
+            sb = (batch if isinstance(batch, spill_lib.StagedBatch)
+                  else _stage(batch))
+            xb, n_valid = sb.xb, sb.n_valid
             if fill is not None:
                 fill.add(xb, n_valid)
             counter.add(*cost_reduce)
-            return accumulate(acc, xb, c, n_valid), n_valid
+            return accumulate(acc, xb, c, n_valid), sb.n_local
 
         def zero_acc() -> _ShardedAcc:
             return _ShardedAcc(
@@ -1632,10 +1645,16 @@ def streamed_kmeans_fit_sharded(
                 cost_reduce[1] * cache.n_batches)
 
     def _stage(batch):
+        # Quarantined (data/ingest.py): stage the all-padding zero-mass
+        # batch — zero rows, zero valid count; n_local keeps the raw
+        # stream row count for resume accounting.
+        if isinstance(batch, ingest_lib.Quarantined):
+            xb, n_valid = put_batch(batch.x)
+            return spill_lib.StagedBatch(xb, 0, n_valid)
         xb, n_valid = put_batch(batch)
         return spill_lib.StagedBatch(xb, n_valid, n_valid)
 
-    loop_batches, h2d = spill_lib.wrap_stream(r_plan, batches, _stage)
+    loop_batches, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     loop_prefetch = prefetch if h2d is None else 0
 
     c, n_iter, start_iter, shift, converged, history, final_acc = (
@@ -1666,6 +1685,7 @@ def streamed_kmeans_fit_sharded(
             passes=(n_iter - start_iter) + 1,
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
+        ingest=guard.report(),
     )
 
 
@@ -1695,6 +1715,7 @@ def streamed_fuzzy_fit_sharded(
     ckpt_every_batches: int | None = None,
     reduce="per_batch",
     residency: str = "stream",
+    ingest=None,
 ):
     """Exact out-of-core Fuzzy C-Means under the 2-D (data × model) layout —
     the large-K regime of the reference's fastest algorithm, streamed: each
@@ -1715,11 +1736,14 @@ def streamed_fuzzy_fit_sharded(
     membership-normalizer psum still runs per batch).
     residency="hbm"/"auto" caches the padded batches in HBM during
     iteration 1 and runs iterations 2..N as a compiled on-device chunk
-    loop (streamed_kmeans_fit_sharded's contract).
+    loop (streamed_kmeans_fit_sharded's contract). ingest= is the
+    hardened-ingest policy (retry + zero-mass quarantine + bounded-loss
+    accounting; streamed_kmeans_fit_sharded's contract).
     """
     from tdc_tpu.models.fuzzy import FuzzyCMeansResult
     from tdc_tpu.models.streaming import (
         _StreamCheckpointer,
+        _first_for_init,
         _fuzzy_example,
         _history_array,
         _mesh_layout,
@@ -1757,6 +1781,8 @@ def streamed_fuzzy_fit_sharded(
         key=key,
         spec=spec,
     )
+    guard = ingest_lib.guard_stream(batches, ingest, d=d,
+                                    label="streamed_fuzzy_fit_sharded")
     state = ckpt.restore(_ShardedFuzzyAcc, None)
     if state.cursor:
         _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
@@ -1772,7 +1798,7 @@ def streamed_fuzzy_fit_sharded(
         )
     else:
         if not hasattr(init, "shape"):
-            first = np.asarray(next(iter(batches())))
+            first = np.asarray(_first_for_init(guard))
             init = _resolve_init_sharded(first, k, init, key)
         c = jnp.asarray(init, jnp.float32)
         if c.shape != (k, d):
@@ -1846,15 +1872,14 @@ def streamed_fuzzy_fit_sharded(
             )
 
         def step_batch(acc, batch, c, fill=None):
-            if isinstance(batch, spill_lib.StagedBatch):
-                xb, n_valid = batch.xb, batch.n_valid
-            else:
-                xb, n_valid = put_batch(batch)
+            sb = (batch if isinstance(batch, spill_lib.StagedBatch)
+                  else _stage(batch))
+            xb, n_valid = sb.xb, sb.n_valid
             if fill is not None:
                 fill.add(xb, n_valid)
             pad_cell[0] += xb.shape[0] - n_valid
             cast_cell[0] = str(xb.dtype)
-            return accumulate(acc, xb, c), n_valid
+            return accumulate(acc, xb, c), sb.n_local
 
         def zero_acc() -> _ShardedFuzzyAcc:
             # Sharding-first zeros (see reduce.zero_deferred).
@@ -1891,14 +1916,13 @@ def streamed_fuzzy_fit_sharded(
             )
 
         def step_batch(acc, batch, c, fill=None):
-            if isinstance(batch, spill_lib.StagedBatch):
-                xb, n_valid = batch.xb, batch.n_valid
-            else:
-                xb, n_valid = put_batch(batch)
+            sb = (batch if isinstance(batch, spill_lib.StagedBatch)
+                  else _stage(batch))
+            xb, n_valid = sb.xb, sb.n_valid
             if fill is not None:
                 fill.add(xb, n_valid)
             counter.add(*cost_reduce)
-            return accumulate(acc, xb, c, n_valid), n_valid
+            return accumulate(acc, xb, c, n_valid), sb.n_local
 
         def zero_acc() -> _ShardedFuzzyAcc:
             return _ShardedFuzzyAcc(
@@ -1993,10 +2017,15 @@ def streamed_fuzzy_fit_sharded(
                 cost_reduce[1] * cache.n_batches)
 
     def _stage(batch):
+        # Quarantined: the all-padding zero-mass batch (see
+        # streamed_kmeans_fit_sharded._stage).
+        if isinstance(batch, ingest_lib.Quarantined):
+            xb, n_valid = put_batch(batch.x)
+            return spill_lib.StagedBatch(xb, 0, n_valid)
         xb, n_valid = put_batch(batch)
         return spill_lib.StagedBatch(xb, n_valid, n_valid)
 
-    loop_batches, h2d = spill_lib.wrap_stream(r_plan, batches, _stage)
+    loop_batches, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     loop_prefetch = prefetch if h2d is None else 0
 
     c, n_iter, start_iter, shift, converged, history, final_acc = (
@@ -2028,6 +2057,7 @@ def streamed_fuzzy_fit_sharded(
             passes=(n_iter - start_iter) + 1,
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
+        ingest=guard.report(),
     )
 
 

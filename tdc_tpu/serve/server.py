@@ -428,12 +428,14 @@ class ServeApp:
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the request/batch/engine stats."""
+        from tdc_tpu.data.ingest import GLOBAL_INGEST
         from tdc_tpu.data.spill import GLOBAL_H2D
         from tdc_tpu.parallel.reduce import GLOBAL_COMMS
 
         e, b = self.engine.stats, self.batcher.stats
         comms = GLOBAL_COMMS.snapshot()
         h2d = GLOBAL_H2D.snapshot()
+        ing = GLOBAL_INGEST.snapshot()
         lines = [
             "# HELP tdc_serve_requests_total Requests by endpoint and status.",
             "# TYPE tdc_serve_requests_total counter",
@@ -494,6 +496,26 @@ class ServeApp:
             ("tdc_h2d_prefetch_depth", "gauge",
              "Deepest spill prefetch-ring fill observed.",
              h2d["depth_max"]),
+            # Hardened-ingest accounting (data/ingest.py): stream read
+            # retries/failures and corrupt-batch quarantines booked by
+            # fits running in this process. A rising retry counter means
+            # a flaky store; ANY quarantine deserves triage (see
+            # docs/OPERATIONS.md "Flaky or corrupt input data").
+            ("tdc_ingest_retries_total", "counter",
+             "Stream read attempts retried after transient failures "
+             "(data/ingest.py).", ing["retries"]),
+            ("tdc_ingest_read_failures_total", "counter",
+             "Stream reads abandoned: permanent classification or "
+             "retries/deadline exhausted.", ing["read_failures"]),
+            ("tdc_ingest_quarantined_batches_total", "counter",
+             "Batches quarantined (zero mass) by the ingest integrity "
+             "screen.", ing["quarantined_batches"]),
+            ("tdc_ingest_quarantined_rows_total", "counter",
+             "Rows held by quarantined batches.",
+             ing["quarantined_rows"]),
+            ("tdc_ingest_crc_failures_total", "counter",
+             "Quarantines caused by CRC sidecar mismatches "
+             "(corrupt-on-disk).", ing["crc_failures"]),
         ]
         for name, typ, help_, val in scalar:
             lines += [f"# HELP {name} {help_}", f"# TYPE {name} {typ}",

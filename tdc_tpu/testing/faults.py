@@ -41,6 +41,12 @@ Instrumented points (grep fault_point for the live list):
     ckpt.restore            before loading a step's state
     ckpt.restore.layout     reading a checkpoint's mesh-layout manifest
     stream.batch            each streamed-fit batch boundary
+    data.read.transient     each guarded stream read attempt (ingest.py);
+                            raise: injections here classify transient
+    data.read.permanent     same site; raise: e.g. ValueError classifies
+                            permanent (no retry)
+    data.corrupt            the ingest integrity screen — raise: injects a
+                            poisoned-batch quarantine verdict
     supervisor.spawn        before each worker Popen
     supervisor.resize       before a resize relaunch at the new gang size
     serve.dispatch          before each micro-batch engine run
@@ -75,6 +81,9 @@ KNOWN_POINTS = frozenset({
     "ckpt.restore",
     "ckpt.restore.layout",
     "stream.batch",
+    "data.read.transient",
+    "data.read.permanent",
+    "data.corrupt",
     "supervisor.spawn",
     "supervisor.resize",
     "serve.dispatch",

@@ -1,8 +1,10 @@
 """MUST-NOT-FLAG TDC005: registry and call sites agree exactly, both
 directions — including the PR-6 elastic-resize point names (dotted,
-multi-segment) and the PR-7 online-update points (several points
-registered and called from ONE pipeline function), which the rule must
-see as ordinary registered points."""
+multi-segment), the PR-7 online-update points (several points registered
+and called from ONE pipeline function), and the PR-10 ingest points
+(adjacent fault_point calls inside a retry loop, plus one inside a
+try/except that CATCHES the injected exception), which the rule must see
+as ordinary registered points."""
 
 KNOWN_POINTS = frozenset({
     "ckpt.save",
@@ -12,6 +14,9 @@ KNOWN_POINTS = frozenset({
     "reshard.redistribute",
     "online.fold",
     "online.swap",
+    "data.read.transient",
+    "data.read.permanent",
+    "data.corrupt",
 })
 
 
@@ -33,3 +38,17 @@ def resize_paths():
 def online_pipeline():
     fault_point("online.fold")
     fault_point("online.swap")
+
+
+def guarded_read():
+    while True:
+        fault_point("data.read.transient")
+        fault_point("data.read.permanent")
+        return
+
+
+def integrity_screen():
+    try:
+        fault_point("data.corrupt")
+    except Exception:
+        return "injected"

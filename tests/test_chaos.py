@@ -622,6 +622,133 @@ def test_chaos_shrink_mid_fit_resizes_to_one(tmp_path):
                                rtol=1e-4, atol=1e-4)
 
 
+_INGEST_CHAOS_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from tdc_tpu.data.device_cache import SizedBatches
+    from tdc_tpu.data.ingest import IngestPolicy
+    from tdc_tpu.parallel.multihost import (
+        barrier, global_mesh, host_shard_bounds, initialize_from_env,
+    )
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    outdir = sys.argv[1]
+    pid, nproc = initialize_from_env()
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 4)).astype(np.float32)
+    X[:256] += 4.0; X[256:512] -= 4.0
+    XP = X.copy()
+    XP[512:768] = np.nan  # batch 2 poisoned GLOBALLY: verdicts symmetric
+    n_batches, per_batch = 4, 256
+
+    def read_batch(b):
+        # Thread-safe ranged read of THIS host's slice (the retry tier
+        # applies to ranged streams; every fault here is $TDC_FAULTS).
+        lo = b * per_batch
+        start, end = host_shard_bounds(per_batch)
+        return XP[lo + start : lo + end]
+
+    local = per_batch // nproc
+    batches = SizedBatches(
+        lambda: (read_batch(b) for b in range(n_batches)),
+        local * n_batches, local, read_batch=read_batch,
+    )
+    res = streamed_kmeans_fit(
+        batches, 5, 4, init=X[:5], max_iters=5, tol=-1.0,
+        mesh=global_mesh(),
+        ingest=IngestPolicy(io_retries=4, io_backoff=0.01,
+                            max_bad_fraction=0.5),
+    )
+    np.save(os.path.join(outdir, f"centroids_{pid}.npy"),
+            np.asarray(res.centroids))
+    rep = res.ingest
+    with open(os.path.join(outdir, f"ingest_{pid}.json"), "w") as f:
+        json.dump({"retries": rep.retries,
+                   "quarantined_batches": rep.quarantined_batches,
+                   "quarantined_rows": rep.quarantined_rows,
+                   "dropped_fraction": rep.dropped_fraction}, f)
+    print("CHAOS_OK", pid, flush=True)
+    barrier()
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.multiproc
+def test_chaos_flaky_store_and_poisoned_batch_gang(tmp_path):
+    """The PR-10 hardened-ingest soak (ISSUE acceptance): a 2-process gloo
+    gang streams a ranged store where ~30% of read attempts fail
+    transiently ($TDC_FAULTS at data.read.transient, both workers) AND one
+    batch is NaN-poisoned globally. The fit must complete in ONE launch —
+    retries are transparent and the quarantine never skips a batch, so no
+    collective deadlocks — with retries > 0 and quarantined_batches == 1
+    on every worker, bit-identical replicated state across workers, and
+    centroids within the documented 1e-4 of the fault-free oracle (the
+    same stream with the poisoned batch's rows absent: the zero-mass
+    quarantine identity, end to end)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_INGEST_CHAOS_WORKER)
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # 5 passes + the final reporting pass = 24 logical reads per worker;
+    # entries every 3rd guarded-read hit (each fired entry consumes one
+    # extra hit for its retried attempt) ≈ 30% transient failure rate,
+    # symmetric across workers (no pid filter — retries are host-local
+    # and change nothing but timing).
+    env["TDC_FAULTS"] = ",".join(
+        f"data.read.transient=raise:ConnectionError@{n}"
+        for n in range(2, 40, 3)
+    )
+
+    echoes = []
+    res = run_gang(
+        [sys.executable, str(worker), str(outdir)], 2,
+        max_restarts=0, log_dir=str(tmp_path / "logs"),
+        heartbeat_timeout=180.0, env=env, echo=echoes.append,
+        backoff_base=0.05,
+    )
+    # No deadlock, no restart: the gang completes on its first attempt.
+    assert res.attempts == 1 and res.returncodes == [0, 0], (res, echoes)
+
+    for pid in range(2):
+        rep = __import__("json").load(
+            open(outdir / f"ingest_{pid}.json")
+        )
+        assert rep["retries"] > 0, rep
+        assert rep["quarantined_batches"] == 1, rep
+        assert rep["quarantined_rows"] == 128, rep  # this host's slice
+        log = (tmp_path / "logs" / f"worker_a0_p{pid}.log").read_text()
+        assert "ingest_retry" in log and "ingest_quarantine" in log
+
+    c0 = np.load(outdir / "centroids_0.npy")
+    c1 = np.load(outdir / "centroids_1.npy")
+    np.testing.assert_array_equal(c0, c1)  # replicated state agrees bitwise
+
+    # Fault-free oracle: the same global stream with the poisoned batch's
+    # rows ABSENT (single process) — the quarantine's zero-mass identity.
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    x = _blobs()
+
+    def batches():
+        for b in (0, 1, 3):
+            yield x[b * 256 : (b + 1) * 256]
+
+    want = streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=5,
+                               tol=-1.0)
+    np.testing.assert_allclose(c0, np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_chaos_online_poisoned_fold_and_crash_mid_swap(tmp_path):

@@ -204,6 +204,38 @@ def test_cli_streamed_spill_residency(tmp_path):
         )
 
 
+def test_cli_ingest_knobs(tmp_path):
+    """--io_retries/--max_bad_fraction thread into the streamed drivers'
+    ingest guard; non-streamed / un-guarded paths refuse the knobs loudly
+    (the standing vocabulary rule); bad values are parse errors."""
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=4000 --n_dim=4 --K=3 --n_max_iters=5 --seed=1 "
+        f"--log_file={log} --n_GPUs=1 --num_batches=4 "
+        f"--io_retries=4 --io_backoff=0.01 --max_bad_fraction=0.1".split()
+    )
+    assert rc == 0
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["status"] == "ok"
+    with pytest.raises(SystemExit, match="ingest guard"):
+        cli_main(
+            f"--n_obs=100 --n_dim=4 --K=3 --log_file={log} --n_GPUs=1 "
+            f"--io_retries=4".split()
+        )
+    with pytest.raises(SystemExit, match="ingest guard"):
+        cli_main(
+            f"--n_obs=1000 --n_dim=4 --K=3 --log_file={log} --n_GPUs=1 "
+            f"--num_batches=4 --minibatch --max_bad_fraction=0.5".split()
+        )
+    for bad in ("--max_bad_fraction=1.5", "--io_retries=-1",
+                "--io_deadline=0"):
+        with pytest.raises(SystemExit):
+            cli_main(
+                f"--n_obs=100 --n_dim=4 --K=3 --num_batches=4 "
+                f"{bad}".split()
+            )
+
+
 def test_cli_error_captured_in_csv(tmp_path):
     # A malformed data file (1-D array) must land as an error row with the
     # exception name in the metric columns (reference :362-377 semantics),

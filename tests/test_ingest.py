@@ -1,0 +1,850 @@
+"""Hardened ingest tier (data/ingest.py + the drivers' `ingest=` knob).
+
+The contract under test:
+- transient read failures retry with backoff+jitter and are TRANSPARENT
+  (the recovered fit is bit-exact with a fault-free run); permanent
+  failures raise `IngestReadError` after ONE `ingest_failed` event naming
+  the batch and store — including from the spill ring's producer threads;
+- a corrupt batch (non-finite rows, shape break, CRC sidecar mismatch,
+  injected `data.corrupt` verdict) is QUARANTINED as the zero-mass
+  all-padding batch, exactly equivalent to dropping it — never a skip
+  (which would deadlock a gang) and never a crash;
+- the validity-mask identity: a quarantined batch contributes exactly
+  zero under the weighted stats, and an all-clean guarded fit is
+  `assert_array_equal` with the pass-through (pre-PR) driver output on
+  every streamed driver and reduce mode;
+- bounded loss: `max_bad_fraction` (strict 0.0 default) aborts loudly via
+  `ingest_abort` + `IngestAbort` once too much data is gone; the
+  IngestReport on every streamed fit result and tdc_ingest_* on /metrics
+  carry the accounting.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.data import ingest as ingest_lib
+from tdc_tpu.data.device_cache import SizedBatches
+from tdc_tpu.data.ingest import (
+    CorruptBatch,
+    IngestAbort,
+    IngestPolicy,
+    IngestReadError,
+    PASSTHROUGH_POLICY,
+    Quarantined,
+    backoff_delay,
+    classify_error,
+    screen_batch,
+)
+from tdc_tpu.data.loader import NpzStream, crc_sidecar_path, write_crc_sidecar
+from tdc_tpu.models.streaming import streamed_fuzzy_fit, streamed_kmeans_fit
+from tdc_tpu.parallel.mesh import make_mesh
+from tdc_tpu.testing import faults
+
+
+def _data(n=1003, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=8, size=(8, d)).astype(np.float32)
+    x = centers[rng.integers(0, 8, n)] + rng.normal(size=(n, d)).astype(
+        np.float32
+    )
+    return x.astype(np.float32)
+
+
+def _events(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture
+def runlog(tmp_path, monkeypatch):
+    path = tmp_path / "runlog.jsonl"
+    monkeypatch.setenv("TDC_RUNLOG", str(path))
+    return path
+
+
+@pytest.fixture
+def inject(monkeypatch):
+    """Set a $TDC_FAULTS spec with clean hit counters, reset after."""
+
+    def _set(spec):
+        monkeypatch.setenv("TDC_FAULTS", spec)
+        faults.reset()
+
+    yield _set
+    faults.reset()
+
+
+def _transient_spec(start=2, stop=40, step=3):
+    """~1/3 of guarded read attempts fail transiently (each fired entry
+    consumes one extra hit for its retry, so entries every 3rd hit are a
+    ~30% failure rate over the fit)."""
+    return ",".join(
+        f"data.read.transient=raise:ConnectionError@{n}"
+        for n in range(start, stop, step)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Unit: classification, backoff, screen
+# ---------------------------------------------------------------------------
+
+
+class TestClassification:
+    def test_transient_kinds(self):
+        for e in (ConnectionError("x"), TimeoutError("x"),
+                  OSError(5, "EIO"), InterruptedError("x")):
+            assert classify_error(e) == "transient"
+
+    def test_permanent_kinds(self):
+        for e in (FileNotFoundError("x"), PermissionError("x"),
+                  ValueError("x"), TypeError("x"), RuntimeError("x")):
+            assert classify_error(e) == "permanent"
+
+    def test_corrupt_kind(self):
+        assert classify_error(
+            CorruptBatch("x", batch=0, reason="crc_mismatch")
+        ) == "corrupt"
+
+    def test_backoff_deterministic_bounded_exponential(self):
+        d1 = backoff_delay(0.1, 1, "fit", 3)
+        assert d1 == backoff_delay(0.1, 1, "fit", 3)  # deterministic
+        assert 0.05 <= d1 < 0.1  # jitter in [0.5, 1.0) of base
+        d3 = backoff_delay(0.1, 3, "fit", 3)
+        assert d3 >= 2 * d1 * 0.5  # exponential growth
+        assert backoff_delay(100.0, 10, "fit", 0) == 5.0  # capped
+
+    def test_screen_clean_and_verdicts(self):
+        x = _data(64, 4)
+        assert screen_batch(x, d=4) is None
+        bad = x.copy()
+        bad[3, 2] = np.nan
+        assert screen_batch(bad, d=4) == "nonfinite"
+        bad[3, 2] = np.inf
+        assert screen_batch(bad, d=4) == "nonfinite"
+        assert screen_batch(x, d=5).startswith("bad_shape")
+        assert screen_batch(x.ravel(), d=4).startswith("bad_shape")
+        w = np.ones(64, np.float32)
+        assert screen_batch(x, d=4, w=w) is None
+        w[5] = np.nan
+        assert screen_batch(x, d=4, w=w) == "nonfinite_weights"
+
+    def test_screen_passes_device_batches_unfetched(self):
+        # Pre-staged device batches must not be pulled D2H per batch.
+        xb = jnp.zeros((8, 4), jnp.float32)
+        assert screen_batch(xb, d=4) is None
+
+    def test_policy_resolution(self):
+        assert ingest_lib.resolve_policy(None) == ingest_lib.DEFAULT_POLICY
+        assert ingest_lib.DEFAULT_POLICY.max_bad_fraction == 0.0  # strict
+        p = ingest_lib.resolve_policy({"io_retries": 7})
+        assert p.io_retries == 7 and p.screen
+        with pytest.raises(TypeError):
+            ingest_lib.resolve_policy(3)
+
+
+# ---------------------------------------------------------------------------
+# Retry / failure routing (incl. the spill producer-thread bugfix)
+# ---------------------------------------------------------------------------
+
+
+class TestRetry:
+    X = _data(1003, 8)
+
+    def _fit(self, stream=None, **kw):
+        kw.setdefault("max_iters", 3)
+        kw.setdefault("tol", -1.0)
+        return streamed_kmeans_fit(
+            stream if stream is not None else NpzStream(self.X, 200),
+            8, 8, init=self.X[:8], **kw,
+        )
+
+    def test_transient_retries_are_transparent(self, inject, runlog):
+        base = self._fit()
+        inject(_transient_spec())
+        res = self._fit(ingest=IngestPolicy(io_retries=3, io_backoff=1e-3))
+        assert res.ingest.retries > 0
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_retry"]
+        assert ev and ev[0]["kind"] == "transient"
+        assert ev[0]["store"] == "NpzStream" and "batch" in ev[0]
+
+    def test_retries_exhausted_fails_loudly(self, inject, runlog):
+        inject("data.read.transient=raise:ConnectionError@3+")
+        with pytest.raises(IngestReadError, match="transient"):
+            self._fit(ingest=IngestPolicy(io_retries=2, io_backoff=1e-3))
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_failed"]
+        assert len(ev) == 1 and ev[0]["attempts"] == 3
+
+    def test_permanent_never_retries_and_keeps_its_type(self, inject,
+                                                        runlog):
+        # Permanent failures re-raise the ORIGINAL exception type (the
+        # caller's contract) after the loud event — not a rewrap.
+        inject("data.read.permanent=raise:ValueError@3")
+        with pytest.raises(ValueError, match="injected fault"):
+            self._fit(ingest=IngestPolicy(io_retries=5, io_backoff=1e-3))
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_failed"]
+        assert len(ev) == 1 and ev[0]["attempts"] == 1
+        assert ev[0]["kind"] == "permanent"
+        assert "batch" in ev[0] and ev[0]["store"] == "NpzStream"
+        assert not [e for e in _events(runlog)
+                    if e["event"] == "ingest_retry"]
+
+    def test_deadline_bounds_the_retry_ladder(self, inject, runlog):
+        inject("data.read.transient=raise:ConnectionError@1+")
+        with pytest.raises(IngestReadError):
+            self._fit(ingest=IngestPolicy(io_retries=100, io_backoff=0.2,
+                                          io_deadline=0.3))
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_failed"]
+        assert ev and ev[0]["attempts"] < 100
+
+    def test_spill_producer_failure_classified_not_raw(self, inject, runlog):
+        """The PR bugfix: a reader exception on the spill ring's producer
+        threads must arrive pre-classified — one ingest_failed event
+        naming batch + store — not as a raw traceback off the queue."""
+        inject("data.read.permanent=raise:ValueError@6")
+        with pytest.raises(ValueError, match="injected fault"):
+            self._fit(residency="spill",
+                      ingest=IngestPolicy(io_retries=2, io_backoff=1e-3))
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_failed"]
+        assert len(ev) == 1 and ev[0]["kind"] == "permanent"
+        assert "batch" in ev[0] and "store" in ev[0]
+
+    def test_spill_retries_on_producer_threads_transparent(self, inject):
+        base = self._fit()
+        inject(_transient_spec())
+        res = self._fit(residency="spill",
+                        ingest=IngestPolicy(io_retries=3, io_backoff=1e-3))
+        assert res.ingest.retries > 0 and res.h2d is not None
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+
+    def test_sequential_stream_failure_is_loud_no_retry(self, runlog):
+        """Generators cannot be re-read: classify + ingest_failed, no
+        retry, prompt error."""
+
+        def gen():
+            yield self.X[:200]
+            raise ConnectionError("cold store died")
+
+        with pytest.raises(IngestReadError, match="batch 1"):
+            self._fit(stream=lambda: gen(),
+                      ingest=IngestPolicy(io_retries=5))
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_failed"]
+        assert len(ev) == 1
+
+
+# ---------------------------------------------------------------------------
+# Quarantine: the validity-mask identity
+# ---------------------------------------------------------------------------
+
+
+class TestQuarantine:
+    X = _data(1003, 8)
+
+    def _poisoned(self, rows=200, bad=slice(400, 600), val=np.nan):
+        xp = self.X.copy()
+        xp[bad] = val
+        return NpzStream(xp, rows)
+
+    def _without_batch2(self):
+        def gen():
+            for i in (0, 1, 3, 4, 5):
+                yield self.X[i * 200:(i + 1) * 200]
+
+        return lambda: gen()
+
+    def test_quarantined_equals_removed_bitwise_kmeans(self, runlog):
+        res = streamed_kmeans_fit(
+            self._poisoned(), 8, 8, init=self.X[:8], max_iters=4, tol=-1.0,
+            ingest=IngestPolicy(max_bad_fraction=0.5),
+        )
+        oracle = streamed_kmeans_fit(
+            self._without_batch2(), 8, 8, init=self.X[:8], max_iters=4,
+            tol=-1.0,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res.centroids), np.asarray(oracle.centroids)
+        )
+        assert float(res.sse) == float(oracle.sse)
+        rep = res.ingest
+        assert rep.quarantined_batches == 1
+        assert rep.quarantined_rows == 200
+        assert rep.rows_per_pass == 1003
+        assert rep.dropped_fraction == pytest.approx(200 / 1003)
+        ev = [e for e in _events(runlog)
+              if e["event"] == "ingest_quarantine"]
+        assert ev and ev[0]["reason"] == "nonfinite" and ev[0]["batch"] == 2
+
+    def test_quarantined_equals_removed_fuzzy(self):
+        res = streamed_fuzzy_fit(
+            self._poisoned(val=np.inf), 8, 8, init=self.X[:8], max_iters=3,
+            tol=-1.0, ingest=IngestPolicy(max_bad_fraction=0.5),
+        )
+        oracle = streamed_fuzzy_fit(
+            self._without_batch2(), 8, 8, init=self.X[:8], max_iters=3,
+            tol=-1.0,
+        )
+        # The fuzzy zero-row correction subtracts n_pad*v against a summed
+        # Σv — exact to accumulation rounding, not bitwise.
+        np.testing.assert_allclose(
+            np.asarray(res.centroids), np.asarray(oracle.centroids),
+            rtol=1e-6, atol=1e-6,
+        )
+        assert res.ingest.quarantined_batches == 1
+
+    def test_quarantine_is_zero_weight_under_weighted_stats(self):
+        """The property the masking rests on: folding a quarantined
+        (zeroed rows, zero weights) batch through the weighted stats adds
+        exactly nothing — bitwise."""
+        from tdc_tpu.ops.assign import lloyd_stats_weighted
+
+        c = jnp.asarray(self.X[:8])
+        acc = lloyd_stats_weighted(jnp.asarray(self.X[:256]), c,
+                                   jnp.ones(256))
+        z = lloyd_stats_weighted(jnp.zeros((128, 8)), c, jnp.zeros(128))
+        assert float(z.counts.sum()) == 0.0
+        assert float(jnp.abs(z.sums).sum()) == 0.0
+        assert float(z.sse) == 0.0
+        folded = jax.tree.map(lambda a, b: a + b, acc, z)
+        for got, want in zip(jax.tree.leaves(folded), jax.tree.leaves(acc)):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_weighted_driver_quarantine(self):
+        w = np.abs(_data(1003, 1, seed=3)).ravel() + 0.1
+
+        def fit(stream):
+            return streamed_kmeans_fit(
+                stream, 8, 8, init=self.X[:8], max_iters=3, tol=-1.0,
+                sample_weight_batches=NpzStream(w.astype(np.float32), 200),
+                ingest=IngestPolicy(max_bad_fraction=0.5),
+            )
+
+        res = fit(self._poisoned())
+        assert res.ingest.quarantined_batches == 1
+        assert np.isfinite(np.asarray(res.centroids)).all()
+        # nonfinite WEIGHTS quarantine too
+        wbad = w.copy().astype(np.float32)
+        wbad[450] = np.nan
+        res2 = streamed_kmeans_fit(
+            NpzStream(self.X, 200), 8, 8, init=self.X[:8], max_iters=3,
+            tol=-1.0, sample_weight_batches=NpzStream(wbad, 200),
+            ingest=IngestPolicy(max_bad_fraction=0.5),
+        )
+        assert res2.ingest.quarantined_batches == 1
+
+    def test_bad_shape_batch_quarantined_with_expected_geometry(
+        self, runlog
+    ):
+        """Review regression: a truncated record (wrong feature width)
+        must quarantine with the EXPECTED (rows, d) replacement, not crash
+        the accumulate kernel with the corrupt shape."""
+
+        def read(i):
+            b = self.X[i * 200:(i + 1) * 200]
+            return b[:, :5] if i == 2 else b  # batch 2 truncated to d=5
+
+        stream = SizedBatches(lambda: (read(i) for i in range(5)), 1000,
+                              200, read_batch=read)
+        res = streamed_kmeans_fit(
+            stream, 8, 8, init=self.X[:8], max_iters=3, tol=-1.0,
+            ingest=IngestPolicy(max_bad_fraction=0.5),
+        )
+        assert res.ingest.quarantined_batches == 1
+        assert np.isfinite(np.asarray(res.centroids)).all()
+
+        def oracle():
+            for i in (0, 1, 3, 4):
+                yield self.X[i * 200:(i + 1) * 200]
+
+        want = streamed_kmeans_fit(lambda: oracle(), 8, 8,
+                                   init=self.X[:8], max_iters=3, tol=-1.0)
+        np.testing.assert_array_equal(
+            np.asarray(res.centroids), np.asarray(want.centroids)
+        )
+        ev = [e for e in _events(runlog)
+              if e["event"] == "ingest_quarantine"]
+        assert ev and ev[0]["reason"].startswith("bad_shape")
+
+    def test_corrupt_read_on_weighted_stream_fails_loudly(self, runlog):
+        """Review regression: a CorruptBatch raised by a weighted fit's
+        stream must surface as ONE classified ingest_failed event naming
+        the batch — not a confusing weight-shape crash. (It cannot
+        quarantine: the weighted zip is sequential, and continuing past a
+        raise would misalign points and weights.)"""
+
+        def read(i):
+            if i == 2:
+                raise CorruptBatch("torn record", batch=i,
+                                   reason="torn_record", shape=(200, 8),
+                                   dtype=np.float32)
+            return self.X[i * 200:(i + 1) * 200]
+
+        stream = SizedBatches(lambda: (read(i) for i in range(5)), 1000,
+                              200, read_batch=read)
+        w = np.ones(1000, np.float32)
+        with pytest.raises(CorruptBatch, match="torn record"):
+            streamed_kmeans_fit(
+                stream, 8, 8, init=self.X[:8], max_iters=3, tol=-1.0,
+                sample_weight_batches=NpzStream(w, 200),
+                ingest=IngestPolicy(max_bad_fraction=0.5),
+            )
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_failed"]
+        assert len(ev) == 1 and ev[0]["kind"] == "corrupt"
+
+    def test_corrupt_read_on_ranged_stream_quarantined(self):
+        """The ranged path's reads are independent, so a CorruptBatch
+        from read_batch IS quarantined (the CRC scenario) — bitwise equal
+        to dropping the batch."""
+
+        def read(i):
+            if i == 2:
+                raise CorruptBatch("torn record", batch=i,
+                                   reason="torn_record", shape=(200, 8),
+                                   dtype=np.float32)
+            return self.X[i * 200:(i + 1) * 200]
+
+        stream = SizedBatches(lambda: (read(i) for i in range(5)), 1000,
+                              200, read_batch=read)
+        res = streamed_kmeans_fit(
+            stream, 8, 8, init=self.X[:8], max_iters=3, tol=-1.0,
+            ingest=IngestPolicy(max_bad_fraction=0.5),
+        )
+        assert res.ingest.quarantined_batches == 1
+        assert res.ingest.crc_failures >= 1
+
+        def oracle():
+            for i in (0, 1, 3, 4):
+                yield self.X[i * 200:(i + 1) * 200]
+
+        want = streamed_kmeans_fit(lambda: oracle(), 8, 8,
+                                   init=self.X[:8], max_iters=3, tol=-1.0)
+        np.testing.assert_array_equal(
+            np.asarray(res.centroids), np.asarray(want.centroids)
+        )
+
+    def test_init_peek_goes_through_the_guard(self, inject, runlog):
+        """Review regression: a name-based init reads the first batch
+        THROUGH the guard — a transient failure on batch 0 retries
+        instead of crashing the fit before the guard ever wraps."""
+        inject("data.read.transient=raise:ConnectionError@1")
+        res = streamed_kmeans_fit(
+            NpzStream(self.X, 200), 8, 8, init="kmeans++",
+            key=jax.random.PRNGKey(0), max_iters=2, tol=-1.0,
+            ingest=IngestPolicy(io_retries=3, io_backoff=1e-3),
+        )
+        assert res.ingest.retries >= 1
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_retry"]
+        assert ev and ev[0]["batch"] == 0
+
+    def test_init_from_poisoned_first_batch_refused(self, runlog):
+        """Review regression: a quarantined FIRST batch cannot seed a
+        data-dependent init (zeroed replacement rows would silently
+        produce garbage centroids) — the fit refuses loudly even under a
+        permissive loss budget."""
+        xp = self.X.copy()
+        xp[:200] = np.nan
+        with pytest.raises(IngestAbort, match="explicit init"):
+            streamed_kmeans_fit(
+                NpzStream(xp, 200), 8, 8, init="kmeans++",
+                key=jax.random.PRNGKey(0), max_iters=2, tol=-1.0,
+                ingest=IngestPolicy(max_bad_fraction=1.0),
+            )
+        # An EXPLICIT init over the same stream completes (batch 0
+        # quarantined like any other).
+        res = streamed_kmeans_fit(
+            NpzStream(xp, 200), 8, 8, init=self.X[:8], max_iters=2,
+            tol=-1.0, ingest=IngestPolicy(max_bad_fraction=1.0),
+        )
+        assert res.ingest.quarantined_batches == 1
+
+    def test_injected_corrupt_verdict(self, inject, runlog):
+        inject("data.corrupt=raise:ValueError@2")
+        res = streamed_kmeans_fit(
+            NpzStream(self.X, 200), 8, 8, init=self.X[:8], max_iters=2,
+            tol=-1.0, ingest=IngestPolicy(max_bad_fraction=0.5),
+        )
+        assert res.ingest.quarantined_batches == 1
+        ev = [e for e in _events(runlog)
+              if e["event"] == "ingest_quarantine"]
+        assert ev and ev[0]["reason"] == "injected:ValueError"
+
+    def test_mesh_and_reduce_modes_quarantine(self):
+        """per_batch / per_pass / int8-EF on the 4-device mesh: the
+        zero-mass fold composes with the deferred + quantized reduces."""
+        mesh = make_mesh(4)
+        for reduce in ("per_batch", "per_pass", "per_pass:int8"):
+            res = streamed_kmeans_fit(
+                self._poisoned(), 8, 8, init=self.X[:8], max_iters=3,
+                tol=-1.0, mesh=mesh, reduce=reduce,
+                ingest=IngestPolicy(max_bad_fraction=0.5),
+            )
+            assert res.ingest.quarantined_batches == 1, reduce
+            assert np.isfinite(np.asarray(res.centroids)).all()
+
+    @pytest.mark.parametrize("fit_name", ["streamed_kmeans_fit_sharded",
+                                          "streamed_fuzzy_fit_sharded"])
+    def test_sharded_towers_quarantine(self, fit_name):
+        from tdc_tpu.parallel import sharded_k
+
+        fit = getattr(sharded_k, fit_name)
+        mesh = sharded_k.make_mesh_2d(2, 4)
+        res = fit(self._poisoned(), 8, 8, mesh, init=self.X[:8],
+                  max_iters=3, tol=-1.0,
+                  ingest=IngestPolicy(max_bad_fraction=0.5))
+        assert res.ingest.quarantined_batches == 1
+        assert np.isfinite(np.asarray(res.centroids)).all()
+
+    def test_spill_quarantine_bit_exact_with_plain(self, runlog):
+        policy = IngestPolicy(max_bad_fraction=0.5)
+        base = streamed_kmeans_fit(self._poisoned(), 8, 8, init=self.X[:8],
+                                   max_iters=3, tol=-1.0, ingest=policy)
+        res = streamed_kmeans_fit(self._poisoned(), 8, 8, init=self.X[:8],
+                                  max_iters=3, tol=-1.0, ingest=policy,
+                                  residency="spill")
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+        assert res.h2d is not None and res.ingest.quarantined_batches == 1
+
+    def test_hbm_fill_abandons_loudly_and_fit_completes(self, runlog):
+        """ISSUE acceptance: bad batch ⇒ the cache fill abandons loudly
+        and the fit keeps streaming, matching the quarantined streamed
+        result exactly."""
+        xp = self.X.copy()
+        xp[400:600] = np.nan
+        stream = SizedBatches(
+            lambda: (xp[i:i + 200] for i in range(0, 1003, 200)), 1003, 200
+        )
+        res = streamed_kmeans_fit(stream, 8, 8, init=self.X[:8],
+                                  max_iters=3, tol=-1.0, residency="hbm",
+                                  ingest=IngestPolicy(max_bad_fraction=0.5))
+        oracle = streamed_kmeans_fit(
+            self._without_batch2(), 8, 8, init=self.X[:8], max_iters=3,
+            tol=-1.0,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res.centroids), np.asarray(oracle.centroids)
+        )
+        assert any(e["event"] == "residency_cache_abandoned"
+                   for e in _events(runlog))
+
+    def test_midpass_ckpt_resume_with_quarantine(self, tmp_path):
+        """Quarantine verdicts never shift the resume cursor: rows are
+        accounted from the raw stream geometry, so a mid-pass resume over
+        a poisoned stream is bit-identical to the uninterrupted run."""
+        from tdc_tpu.utils import preempt
+        from tdc_tpu.utils.preempt import Preempted
+
+        policy = IngestPolicy(max_bad_fraction=0.5)
+        xp = self.X[:1000].copy()
+        xp[250:375] = np.nan  # poisons batch 2 of 8 (125-row batches)
+
+        def mk(trip_at=None):
+            seen = {"n": 0}
+
+            def batches():
+                for i in range(0, 1000, 125):
+                    seen["n"] += 1
+                    if trip_at is not None and seen["n"] == trip_at:
+                        preempt.request()
+                    yield xp[i:i + 125]
+
+            return batches
+
+        full = streamed_kmeans_fit(mk(), 8, 8, init=self.X[:8],
+                                   max_iters=4, tol=-1.0, ingest=policy)
+        d = str(tmp_path / "ck")
+        preempt.reset()
+        with pytest.raises(Preempted):
+            streamed_kmeans_fit(mk(trip_at=21), 8, 8, init=self.X[:8],
+                                max_iters=4, tol=-1.0, ckpt_dir=d,
+                                ckpt_every=100, ckpt_every_batches=100,
+                                ingest=policy)
+        preempt.reset()
+        resumed = streamed_kmeans_fit(mk(), 8, 8, init=self.X[:8],
+                                      max_iters=4, tol=-1.0, ckpt_dir=d,
+                                      ckpt_every=100,
+                                      ckpt_every_batches=100, ingest=policy)
+        np.testing.assert_array_equal(
+            np.asarray(resumed.centroids), np.asarray(full.centroids)
+        )
+
+
+# ---------------------------------------------------------------------------
+# CRC sidecar (NpzStream)
+# ---------------------------------------------------------------------------
+
+
+class TestCrcSidecar:
+    def test_sidecar_roundtrip_clean(self, tmp_path):
+        x = _data(800, 4, seed=1)
+        p = str(tmp_path / "pts.npy")
+        np.save(p, x)
+        write_crc_sidecar(x, 200, crc_sidecar_path(p))
+        s = NpzStream.from_npy(p, 200)
+        for i, b in enumerate(s()):
+            np.testing.assert_array_equal(b, x[i * 200:(i + 1) * 200])
+
+    def test_sidecar_batch_rows_mismatch_rejected(self, tmp_path):
+        x = _data(800, 4, seed=1)
+        p = str(tmp_path / "pts.npy")
+        np.save(p, x)
+        write_crc_sidecar(x, 100, crc_sidecar_path(p))
+        with pytest.raises(ValueError, match="batch_rows"):
+            NpzStream.from_npy(p, 200)
+
+    def test_from_npy_require_missing_sidecar(self, tmp_path):
+        x = _data(100, 4)
+        p = str(tmp_path / "pts.npy")
+        np.save(p, x)
+        with pytest.raises(FileNotFoundError):
+            NpzStream.from_npy(p, 50, verify_crc="require")
+        assert NpzStream.from_npy(p, 50)._crcs is None  # auto: unarmed
+
+    def test_from_npy_rejects_unknown_verify_crc(self, tmp_path):
+        # Review regression: a typo must not silently disable the check.
+        x = _data(100, 4)
+        p = str(tmp_path / "pts.npy")
+        np.save(p, x)
+        write_crc_sidecar(x, 50, crc_sidecar_path(p))
+        with pytest.raises(ValueError, match="verify_crc"):
+            NpzStream.from_npy(p, 50, verify_crc="on")
+        assert NpzStream.from_npy(p, 50, verify_crc="off")._crcs is None
+
+    def test_bit_flip_quarantined_not_crashed(self, tmp_path, runlog):
+        """The satellite regression: corrupt-on-disk bytes in a verified
+        stream surface as a quarantine, and the fit matches the stream
+        with that batch dropped — bitwise."""
+        x = _data(800, 4, seed=2)
+        p = str(tmp_path / "pts.npy")
+        np.save(p, x)
+        write_crc_sidecar(x, 200, crc_sidecar_path(p))
+        with open(p, "r+b") as f:
+            f.seek(128 + 200 * 4 * 4 + 37)  # into batch 1's bytes
+            b = f.read(1)
+            f.seek(-1, 1)
+            f.write(bytes([b[0] ^ 0x10]))
+        s = NpzStream.from_npy(p, 200)
+        with pytest.raises(CorruptBatch):
+            s.read_batch(1)
+        res = streamed_kmeans_fit(
+            NpzStream.from_npy(p, 200), 4, 4, init=x[:4], max_iters=3,
+            tol=-1.0, ingest=IngestPolicy(max_bad_fraction=0.5),
+        )
+        assert res.ingest.quarantined_batches == 1
+        assert res.ingest.crc_failures >= 1
+
+        def without_b1():
+            for i in (0, 2, 3):
+                yield x[i * 200:(i + 1) * 200]
+
+        oracle = streamed_kmeans_fit(lambda: without_b1(), 4, 4, init=x[:4],
+                                     max_iters=3, tol=-1.0)
+        np.testing.assert_array_equal(
+            np.asarray(res.centroids), np.asarray(oracle.centroids)
+        )
+        ev = [e for e in _events(runlog)
+              if e["event"] == "ingest_quarantine"]
+        assert ev and ev[0]["reason"] == "crc:crc_mismatch"
+        assert ev[0]["store"] == p  # store identity names the file
+
+    def test_to_npy_writes_sidecar_at_save_time(self, tmp_path):
+        x = _data(400, 4, seed=3)
+        npz = str(tmp_path / "pts.npz")
+        np.savez(npz, X=x)
+        npy = str(tmp_path / "pts.npy")
+        NpzStream.to_npy(npz, npy, crc_batch_rows=100)
+        assert os.path.exists(crc_sidecar_path(npy))
+        s = NpzStream.from_npy(npy, 100, verify_crc="require")
+        np.testing.assert_array_equal(s.read_batch(3), x[300:])
+
+
+# ---------------------------------------------------------------------------
+# Bounded loss: max_bad_fraction
+# ---------------------------------------------------------------------------
+
+
+class TestBoundedLoss:
+    X = _data(1003, 8)
+
+    def _poisoned(self, bad=slice(400, 600)):
+        xp = self.X.copy()
+        xp[bad] = np.nan
+        return NpzStream(xp, 200)
+
+    def test_strict_default_aborts_on_first_quarantine(self, runlog):
+        with pytest.raises(IngestAbort, match="max_bad_fraction"):
+            streamed_kmeans_fit(self._poisoned(), 8, 8, init=self.X[:8],
+                                max_iters=3, tol=-1.0)
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_abort"]
+        assert len(ev) == 1 and ev[0]["quarantined_rows"] == 200
+
+    def test_fraction_budget_allows_bounded_loss(self):
+        res = streamed_kmeans_fit(
+            self._poisoned(), 8, 8, init=self.X[:8], max_iters=2, tol=-1.0,
+            ingest=IngestPolicy(max_bad_fraction=0.25),
+        )
+        assert res.ingest.dropped_fraction < 0.25
+
+    def test_fraction_budget_exceeded_aborts(self, runlog):
+        xp = self.X.copy()
+        xp[200:600] = np.nan  # 2 of 6 batches, ~40%
+        with pytest.raises(IngestAbort, match="max_bad_fraction"):
+            streamed_kmeans_fit(NpzStream(xp, 200), 8, 8, init=self.X[:8],
+                                max_iters=2, tol=-1.0,
+                                ingest=IngestPolicy(max_bad_fraction=0.25))
+        assert [e for e in _events(runlog) if e["event"] == "ingest_abort"]
+
+    def test_sequential_stream_budget_checked_at_pass_end(self):
+        """No advertised size: the fraction is only knowable once the
+        pass ends — it must still abort there, not silently continue."""
+        xp = self.X.copy()
+        xp[0:400] = np.nan
+
+        def gen():
+            for i in range(0, 1003, 200):
+                yield xp[i:i + 200]
+
+        with pytest.raises(IngestAbort):
+            streamed_kmeans_fit(lambda: gen(), 8, 8, init=self.X[:8],
+                                max_iters=2, tol=-1.0,
+                                ingest=IngestPolicy(max_bad_fraction=0.25))
+
+
+# ---------------------------------------------------------------------------
+# All-clean transparency: guarded == pass-through, every driver/mode
+# ---------------------------------------------------------------------------
+
+
+class TestCleanTransparency:
+    X = _data(1003, 8)
+
+    def _pair(self, fit, *args, **kw):
+        base = fit(*args, ingest=PASSTHROUGH_POLICY, **kw)
+        res = fit(*args, **kw)  # default (screening) policy
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+        return res
+
+    def test_1d_kmeans_all_reduce_modes(self):
+        mesh = make_mesh(4)
+        for reduce in ("per_batch", "per_pass", "per_pass:int8"):
+            res = self._pair(
+                streamed_kmeans_fit, NpzStream(self.X, 200), 8, 8,
+                init=self.X[:8], max_iters=3, tol=-1.0, mesh=mesh,
+                reduce=reduce,
+            )
+            assert res.ingest.quarantined_batches == 0
+            assert res.ingest.retries == 0
+
+    def test_1d_fuzzy(self):
+        self._pair(streamed_fuzzy_fit, NpzStream(self.X, 200), 8, 8,
+                   init=self.X[:8], max_iters=3, tol=-1.0)
+
+    @pytest.mark.parametrize("fit_name", ["streamed_kmeans_fit_sharded",
+                                          "streamed_fuzzy_fit_sharded"])
+    @pytest.mark.parametrize("reduce", ["per_batch", "per_pass"])
+    def test_sharded(self, fit_name, reduce):
+        from tdc_tpu.parallel import sharded_k
+
+        fit = getattr(sharded_k, fit_name)
+        mesh = sharded_k.make_mesh_2d(2, 4)
+        res = self._pair(fit, NpzStream(self.X, 200), 8, 8, mesh,
+                         init=self.X[:8], max_iters=3, tol=-1.0,
+                         reduce=reduce)
+        assert res.ingest is not None and res.ingest.rows_per_pass == 1003
+
+    def test_report_rides_every_streamed_result(self):
+        res = streamed_kmeans_fit(NpzStream(self.X, 200), 8, 8,
+                                  init=self.X[:8], max_iters=2, tol=-1.0)
+        rep = res.ingest
+        assert rep.retries == 0 and rep.read_failures == 0
+        assert rep.quarantined_batches == 0 and rep.dropped_fraction == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Observability: /metrics
+# ---------------------------------------------------------------------------
+
+
+class TestIngestMetrics:
+    def test_global_counter_mirrors_fits(self):
+        before = ingest_lib.GLOBAL_INGEST.snapshot()
+        x = _data(600, 4, seed=5)
+        xp = x.copy()
+        xp[200:400] = np.nan
+        streamed_kmeans_fit(NpzStream(xp, 200), 4, 4, init=x[:4],
+                            max_iters=2, tol=-1.0,
+                            ingest=IngestPolicy(max_bad_fraction=0.5))
+        after = ingest_lib.GLOBAL_INGEST.snapshot()
+        assert after["quarantined_batches"] > before["quarantined_batches"]
+        assert (after["quarantined_rows"] - before["quarantined_rows"]) \
+            % 200 == 0
+
+    def test_metrics_endpoint_exports_ingest(self, tmp_path):
+        from tdc_tpu.models.kmeans import kmeans_fit
+        from tdc_tpu.models.persist import save_fitted
+        from tdc_tpu.serve.server import ServeApp
+
+        x = _data(200, 4, seed=6)
+        km = kmeans_fit(x, 3, key=jax.random.PRNGKey(0), max_iters=4)
+        save_fitted(str(tmp_path / "km"), km)
+        app = ServeApp(poll_interval=0)
+        app.registry.add("km", str(tmp_path / "km"))
+        app.start()
+        try:
+            text = app.metrics_text()
+        finally:
+            app.stop()
+        for name in ("tdc_ingest_retries_total",
+                     "tdc_ingest_read_failures_total",
+                     "tdc_ingest_quarantined_batches_total",
+                     "tdc_ingest_quarantined_rows_total",
+                     "tdc_ingest_crc_failures_total"):
+            assert name in text
+
+
+# ---------------------------------------------------------------------------
+# Guard protocol passthrough
+# ---------------------------------------------------------------------------
+
+
+class TestGuardProtocol:
+    def test_sizing_and_ranged_protocols_forwarded(self):
+        from tdc_tpu.data import device_cache as dc
+        from tdc_tpu.data import spill as spill_lib
+
+        x = _data(1000, 8)
+        g = ingest_lib.guard_stream(NpzStream(x, 250), None, d=8)
+        assert dc.stream_hints(g) == dc.StreamHints(1000, 250, 4)
+        assert dc.stream_itemsize(g) == 4
+        ranged = spill_lib.ranged_reader(g)
+        assert ranged is not None and ranged[1] == 4
+        np.testing.assert_array_equal(ranged[0](2), x[500:750])
+
+    def test_bare_generator_stays_sequential(self):
+        from tdc_tpu.data import spill as spill_lib
+
+        x = _data(400, 8)
+        g = ingest_lib.guard_stream(lambda: iter([x[:200], x[200:]]), None,
+                                    d=8)
+        assert spill_lib.ranged_reader(g) is None
+        got = np.concatenate(list(g()))
+        np.testing.assert_array_equal(got, x)
+
+    def test_quarantined_marker_carries_geometry(self):
+        q = Quarantined(np.zeros((5, 3), np.float32), None, 7, "nonfinite")
+        assert q.x.shape == (5, 3) and q.index == 7
+        assert "nonfinite" in repr(q)

@@ -469,6 +469,8 @@ def test_fault_points_match_registry():
         "reshard.redistribute",
         # PR-7 online-update pipeline (serve/online.py)
         "online.fold", "online.validate", "online.swap", "online.rollback",
+        # PR-10 hardened ingest (data/ingest.py)
+        "data.read.transient", "data.read.permanent", "data.corrupt",
     }
 
 
