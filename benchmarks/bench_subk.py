@@ -1,0 +1,182 @@
+"""Sub-linear assignment benchmark: exact all-K vs coarse→refine stats.
+
+What this measures, per (K, probe) config (hierarchical blobs — the
+codebook-training workload's shape: coarse super-cluster structure with
+per-cluster spread; a structureless uniform-random codebook is the
+documented worst case for ANY IVF-style pruner, see docs/ARCHITECTURE.md
+"Sub-linear assignment"):
+
+- **assignment-phase speedup** — wall clock of ONE jitted sufficient-stats
+  call (assignment + fold, the whole per-batch body the streamed drivers
+  pay per pass): ops.assign.lloyd_stats (exact, distance + argmin +
+  one-hot stats) vs ops.subk.lloyd_stats_subk (coarse plan + tile-pruned
+  refine + sorted stats). Median of repeats, block_until_ready-bounded.
+- **relative inertia loss** — (sse_coarse − sse_exact) / sse_exact of two
+  full streamed_kmeans_fit runs from the same init (the fit-level number:
+  assignment errors COMPOUND through centroid updates, so this is the
+  honest quality metric, not single-pass agreement).
+- **probe=all bit-exactness** — a streamed fit with assign="coarse",
+  probe="all" must assert_array_equal the assign="exact" fit (probe
+  covering every tile routes to the exact path by construction —
+  ops/subk.resolve_assign; this is the safety valve the smoke pins).
+
+CI acceptance (--smoke, the ci_tier1.sh `subk-smoke` stage): >= 2x
+assignment-phase speedup at the emulated K=4096 CPU config AND
+probe=all bit-exactness AND relative inertia loss <= 1e-2.
+The full sweep adds the K=16,384 rows (>= 3x floor, the ROADMAP item-2
+acceptance) and writes benchmarks/subk_cpu.csv.
+
+CAVEAT (the bench_resident lesson): on CPU the exact path's matmuls run
+far below an MXU's utilization, so the measured speedup tracks the FLOP
+reduction less the sort/gather overhead — a conservative floor for TPU,
+where the pruned path keeps feeding the MXU whole (probe·S, d) tiles by
+construction (the Mesh-TensorFlow blockwise discipline).
+
+Run:
+  JAX_PLATFORMS=cpu python benchmarks/bench_subk.py           # sweep -> CSV
+  JAX_PLATFORMS=cpu python benchmarks/bench_subk.py --smoke   # CI gate
+"""
+
+import csv
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "subk_cpu.csv")
+FIELDS = [
+    "K", "d", "n", "n_tiles", "tile_size", "probe", "scan_rows_per_block",
+    "exact_stats_s", "subk_stats_s", "speedup", "rel_inertia_loss",
+    "pruned_fraction", "probe_all_bitexact",
+]
+
+
+def hier_data(k, d, n, seed=20260804, fan=64, sub_sigma=1.0, noise=0.2):
+    """Hierarchical blobs: k//fan super-centers, each fanning `fan`
+    sub-centers (the codebook), points around the sub-centers. fan=64
+    puts the super structure at the √K-ish granularity the coarse cells
+    quantize at — the friendly end of the IVF spectrum; the ARCHITECTURE
+    doc records the structureless-codebook worst case and its knobs."""
+    rng = np.random.default_rng(seed)
+    n_super = max(1, k // fan)
+    supers = rng.uniform(-10.0, 10.0, size=(n_super, d)).astype(np.float32)
+    centers = (
+        np.repeat(supers, k // n_super, axis=0)
+        + rng.normal(0, sub_sigma, size=(k, d))
+    ).astype(np.float32)
+    x = np.repeat(centers, n // k, axis=0) + rng.normal(
+        0, noise, size=(n // k * k, d)
+    ).astype(np.float32)
+    rng.shuffle(x)
+    return x, centers
+
+
+def _timed(fn, xj, cj, repeats):
+    jax.block_until_ready(fn(xj, cj))  # warm the compile
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(xj, cj))
+        samples.append(time.perf_counter() - t0)
+    return max(float(np.median(samples)), 1e-6)
+
+
+def run_one(k, d, n, probe, *, iters=3, batch_rows=16384, repeats=3):
+    import jax.numpy as jnp
+
+    from tdc_tpu.data.device_cache import SizedBatches
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+    from tdc_tpu.ops import subk
+    from tdc_tpu.ops.assign import lloyd_stats
+
+    x, centers = hier_data(k, d, n)
+    spec = subk.resolve_assign("coarse", k, probe=probe, label="bench_subk")
+    xj, cj = jnp.asarray(x), jnp.asarray(centers)
+
+    f_exact = jax.jit(lloyd_stats)
+    f_subk = jax.jit(lambda xx, cc: subk.lloyd_stats_subk(xx, cc, spec))
+    t_exact = _timed(f_exact, xj, cj, repeats)
+    t_subk = _timed(f_subk, xj, cj, repeats)
+
+    def mk():
+        return SizedBatches(
+            lambda: (x[i: i + batch_rows]
+                     for i in range(0, len(x), batch_rows)),
+            len(x), batch_rows,
+        )
+
+    r_exact = streamed_kmeans_fit(mk(), k, d, init=centers, max_iters=iters,
+                                  tol=-1.0)
+    r_coarse = streamed_kmeans_fit(mk(), k, d, init=centers, max_iters=iters,
+                                   tol=-1.0, assign="coarse", probe=probe)
+    r_all = streamed_kmeans_fit(mk(), k, d, init=centers, max_iters=iters,
+                                tol=-1.0, assign="coarse", probe="all")
+    rel = (float(r_coarse.sse) - float(r_exact.sse)) / float(r_exact.sse)
+    bitexact = bool(np.array_equal(np.asarray(r_all.centroids),
+                                   np.asarray(r_exact.centroids)))
+    row = {
+        "K": k, "d": d, "n": n,
+        "n_tiles": spec.n_tiles, "tile_size": spec.tile_size,
+        "probe": spec.probe,
+        "scan_rows_per_block": spec.probe * spec.tile_size + spec.n_tiles,
+        "exact_stats_s": round(t_exact, 6),
+        "subk_stats_s": round(t_subk, 6),
+        "speedup": round(t_exact / t_subk, 3),
+        "rel_inertia_loss": float(f"{rel:.3e}"),
+        "pruned_fraction": round(r_coarse.assign.pruned_fraction, 4),
+        "probe_all_bitexact": bitexact,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main(argv):
+    smoke = "--smoke" in argv
+    if smoke:
+        # The emulated K=4096 CPU config: big enough that the exact pass
+        # is genuinely FLOP-bound on the CI box, small enough for the CI
+        # time budget. probe=8 is the √n_tiles default at T=64.
+        row = run_one(4096, 32, 65536, 8, iters=3)
+        ok = (
+            row["speedup"] >= 2.0
+            and row["probe_all_bitexact"]
+            and row["rel_inertia_loss"] <= 1e-2
+        )
+        print(
+            "SUBK-SMOKE "
+            + ("PASS" if ok else "FAIL")
+            + f": exact={row['exact_stats_s'] * 1e3:.0f} ms/pass, "
+            f"subk={row['subk_stats_s'] * 1e3:.0f} ms/pass, "
+            f"speedup={row['speedup']}x (floor 2x), "
+            f"rel_inertia_loss={row['rel_inertia_loss']:.2e} "
+            f"(bound 1e-2), pruned={row['pruned_fraction']}, "
+            f"probe_all_bitexact={row['probe_all_bitexact']}"
+        )
+        return 0 if ok else 1
+
+    rows = [
+        run_one(4096, 32, 65536, 4),
+        run_one(4096, 32, 65536, 8),
+        run_one(4096, 32, 65536, 16),
+        # The ROADMAP item-2 acceptance row: K=16,384, >= 3x floor.
+        run_one(16384, 32, 65536, 8, iters=2),
+        run_one(16384, 32, 65536, 11, iters=2),  # √n_tiles default at T=128
+        run_one(16384, 32, 65536, 24, iters=2),
+    ]
+    with open(OUT, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=FIELDS)
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {OUT} ({len(rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -67,6 +67,20 @@ if [ -z "$SKIP_SPILL_SMOKE" ]; then
         | tail -n 1 || spill_rc=$?
 fi
 
+# Sub-linear-assignment smoke (benchmarks/bench_subk.py): proves the
+# coarse->refine tile-pruned assignment beats the exact all-K stats pass
+# by the documented >=2x floor at the emulated K=4096 CPU config, keeps
+# the relative inertia loss within the documented 1e-2 bound on the
+# hierarchical-blobs config, AND that probe=all routes to the exact path
+# fp32-bit-exactly. ~3 min (the exact all-K passes it benchmarks against
+# are the expensive part).
+subk_rc=0
+if [ -z "$SKIP_SUBK_SMOKE" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python benchmarks/bench_subk.py --smoke \
+        | tail -n 1 || subk_rc=$?
+fi
+
 # Chaos smoke (tests/test_chaos.py soak): 1 kill -9 + 1 preemption SIGTERM
 # injected via TDC_FAULTS into the 2-process gloo gang (recover both,
 # refund the SIGTERM restart, match the fault-free fit), the resident-fit
@@ -116,7 +130,7 @@ fi
 overall=0
 for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
              "resident-smoke:$resident_rc" "spill-smoke:$spill_rc" \
-             "chaos-smoke:$chaos_rc" \
+             "subk-smoke:$subk_rc" "chaos-smoke:$chaos_rc" \
              "tdclint:$lint_rc" "ruff:$ruff_rc"; do
     name=${stage%%:*}
     rc=${stage##*:}
@@ -126,6 +140,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, chaos-smoke, lint)" >&2
 fi
 exit "$overall"

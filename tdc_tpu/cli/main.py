@@ -121,8 +121,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference :310 approximation, for apples-to-apples "
                         "iters-to-converge comparisons; kmeans only)")
     p.add_argument("--class_sep", type=float, default=1.5)
+    p.add_argument("--assign", type=str, default=None,
+                   choices=("exact", "auto", "coarse"),
+                   help="assignment strategy for streamed/K-sharded "
+                        "kmeans: 'exact' (default, all-K), 'coarse' "
+                        "(sub-linear coarse->refine tile-pruned "
+                        "assignment, ops/subk.py — bounded-loss; see "
+                        "benchmarks/bench_subk.py), or 'auto' (coarse at "
+                        "large K, exact below; logged as "
+                        "assign_selected)")
+    p.add_argument("--probe", type=str, default=None,
+                   help="coarse tiles scanned per point block for "
+                        "--assign coarse/auto: an integer or 'all' "
+                        "(probing every tile routes to the exact path "
+                        "and is bit-exact by construction); default "
+                        "~sqrt(n_tiles)")
     p.add_argument("--kernel", type=str, default=None,
-                   choices=("xla", "pallas", "refined"),
+                   choices=("xla", "pallas", "refined", "auto"),
                    help="sufficient-stats kernel for K-Means: 'pallas' = "
                         "fused single-pass VMEM kernel (single-device and "
                         "mesh; with --shard_k, the blockwise online-argmin "
@@ -133,7 +148,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "convergence). Default: 'xla', except --layout=auto "
                         "may route narrow-d in-memory fits to the feature-"
                         "major tall kernel; passing --kernel explicitly "
-                        "pins the sample-major layout")
+                        "pins the sample-major layout. 'auto' picks the "
+                        "fused Pallas path when the (K, d) block fits "
+                        "VMEM on TPU and falls back to XLA loudly "
+                        "(kernel_selected event; "
+                        "ops/pallas_kernels.resolve_kernel)")
     p.add_argument("--shard_k", type=int, default=1,
                    help="model-axis size: shard the K centroids/components "
                         "this many ways over a 2-D (data x model) mesh (the "
@@ -297,6 +316,28 @@ def validate_args(parser, args):
                 parser.error("--shard_k gaussianMixture seeds from a host "
                              "subsample; --init=kmeans (a full K-Means "
                              "pre-fit) is the unsharded mode")
+    if args.probe is not None and args.assign is None:
+        parser.error("--probe needs --assign coarse|auto")
+    if args.probe is not None and args.probe != "all":
+        _valid_int(parser, "--probe", args.probe, 1)
+    if args.assign is not None:
+        # Sub-linear assignment rides the streamed / K-sharded kmeans
+        # drivers (models/streaming.py, parallel/sharded_k.py).
+        if args.method_name != "distributedKMeans":
+            parser.error("--assign is distributedKMeans only")
+        if not (args.streamed or args.num_batches > 1 or args.shard_k > 1):
+            parser.error("--assign needs a streamed or K-sharded fit "
+                         "(--streamed / --num_batches / --shard_k)")
+        if args.minibatch or args.mean_combine:
+            parser.error("--assign supports the exact streamed driver "
+                         "only (not --minibatch / --mean_combine)")
+        if args.weight_file:
+            parser.error("--assign coarse has no weighted fold; drop "
+                         "--weight_file or --assign")
+        if args.kernel in ("pallas", "refined"):
+            parser.error("--assign coarse is its own tile-pruned stats "
+                         "path; --kernel pallas/refined cannot combine "
+                         "with it")
     if args.minibatch and args.method_name != "distributedKMeans":
         parser.error("--minibatch supports distributedKMeans only")
     if args.method_name == "gaussianMixture":
@@ -896,6 +937,16 @@ def run_experiment(args) -> dict:
                 ckpt_dir=args.ckpt_dir,
                 kernel=args.kernel or "xla",
             )
+        # --assign/--probe pass-through for the streamed kmeans drivers
+        # (validate_args already restricted the combinations).
+        assign_kw = {}
+        if args.assign is not None:
+            assign_kw = {
+                "assign": args.assign,
+                "probe": (args.probe if args.probe in (None, "all")
+                          else int(args.probe)),
+            }
+
         def shard_block(rows_per_pass: int) -> int:
             """N-block for the K-sharded towers: --block_rows, or the
             auto size bounding the per-(data-shard, K-shard) intermediates
@@ -998,6 +1049,7 @@ def run_experiment(args) -> dict:
                 reduce=_sharded_reduce(args),
                 residency=args.residency,
                 ingest=ingest_policy,
+                **assign_kw,
             )
         if args.method_name == "gaussianMixture":
             if streamed:
@@ -1110,6 +1162,7 @@ def run_experiment(args) -> dict:
                 reduce=args.reduce,
                 residency=args.residency,
                 ingest=ingest_policy,
+                **assign_kw,
             )
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,

@@ -199,6 +199,15 @@ def fuzzy_cmeans_fit(
                 history=np.asarray(res.history)[: int(res.n_iter)]
             )
         return res
+    if kernel == "auto":
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        kernel = resolve_kernel(
+            kernel, k=k, d=int(x.shape[1]), itemsize=x.dtype.itemsize,
+            model="fuzzy", label="fuzzy_fit",
+            ineligible=("the weighted fuzzy stats run in f32 XLA for mass "
+                        "exactness" if sample_weight is not None else None),
+        )
     w = None
     if sample_weight is not None:
         if kernel == "pallas":

@@ -356,6 +356,20 @@ def gmm_fit(
     # work, and each solve's RHS is (d, N) with N data-sharded, which XLA
     # distributes column-wise like any batched op; the Σ r·xxᵀ contraction
     # reduces over the sharded N axis into a psum'd (K, d, d)).
+    if kernel == "auto":
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        kernel = resolve_kernel(
+            kernel, k=k, d=d, itemsize=x.dtype.itemsize, model="gmm",
+            label="gmm_fit",
+            ineligible=(
+                "the fused E-step is diag/spherical, unweighted, "
+                "single-device only"
+                if (covariance_type not in ("diag", "spherical")
+                    or sample_weight is not None or mesh is not None)
+                else None
+            ),
+        )
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
     if kernel == "pallas" and (
@@ -841,6 +855,19 @@ def streamed_gmm_fit(
         )
     # full covariance runs under the mesh too (see gmm_fit's note: the
     # solves' RHS shards over N; the round-4 gate was overcautious).
+    if kernel == "auto":
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        kernel = resolve_kernel(
+            kernel, k=k, d=d, model="gmm", label="streamed_gmm_fit",
+            ineligible=(
+                "the fused E-step is diag/spherical, unweighted, "
+                "single-device only"
+                if (covariance_type not in ("diag", "spherical")
+                    or sample_weight_batches is not None or mesh is not None)
+                else None
+            ),
+        )
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
     if kernel == "pallas" and mesh is not None:

@@ -49,6 +49,10 @@ class KMeansResult(NamedTuple):
     # quarantined batches/rows, dropped mass fraction), filled by the
     # streamed drivers (None for in-memory fits).
     ingest: object = None
+    # ops/subk.AssignReport — sub-linear-assignment accounting (tiles
+    # probed vs total, pruned fraction), filled when the fit ran
+    # assign='coarse' (None on the exact path).
+    assign: object = None
 
 
 def _normalize(c: jax.Array) -> jax.Array:
@@ -423,6 +427,19 @@ def kmeans_fit(
             )
         return res
 
+    if kernel == "auto":
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        kernel = resolve_kernel(
+            kernel, k=k, d=int(x.shape[1]), itemsize=x.dtype.itemsize,
+            model=("kmeans_weighted" if sample_weight is not None
+                   else "kmeans"),
+            label="kmeans_fit",
+            ineligible=(
+                "sample weights with a mesh have no weighted Pallas tower"
+                if sample_weight is not None and mesh is not None else None
+            ),
+        )
     if sample_weight is not None and kernel == "refined":
         # The exact-champion path has no weighted variant; an explicit
         # kernel request must not silently record xla numbers as refined.

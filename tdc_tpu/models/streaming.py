@@ -38,6 +38,7 @@ from tdc_tpu.ops.assign import (
 )
 from tdc_tpu.models.kmeans import KMeansResult, resolve_init, _normalize
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult
+from tdc_tpu.ops import subk as subk_lib
 from tdc_tpu.parallel import mesh as mesh_lib
 from tdc_tpu.parallel import reduce as reduce_lib
 from tdc_tpu.parallel import reshard as reshard_lib
@@ -99,6 +100,35 @@ def _accumulate(
     counts, sse = padding_correction(s.counts, s.sse, cd, n_pad)
     return SufficientStats(
         sums=acc.sums + s.sums, counts=acc.counts + counts, sse=acc.sse + sse
+    )
+
+
+@partial(jax.jit, static_argnames=("spherical", "spec"))
+def _accumulate_subk(
+    acc: SufficientStats,
+    batch: jax.Array,
+    centroids: jax.Array,
+    n_valid: jax.Array,
+    spherical: bool,
+    spec: subk_lib.CoarseSpec,
+    plan: subk_lib.CoarsePlan | None = None,
+) -> SufficientStats:
+    """One batch's stats under coarse→refine assignment (ops/subk.py).
+    NO padding correction here: lloyd_stats_subk masks rows >= n_valid
+    internally (sentinel labels, zero sse) — coarse probing gives no
+    guarantee a zero pad row's champion would be the argmin-‖c‖² cluster
+    the exact correction assumes. The streamed pass supplies `plan`
+    (subk.plan_for, built ONCE per pass — centroids are pass-constant);
+    the resident chunk loop passes None so the plan rebuilds in-trace
+    from the carried centroids (never stale; bitwise-identical values
+    either way, build_plan being deterministic in the centroids)."""
+    if spherical:
+        norms = jnp.linalg.norm(batch, axis=-1, keepdims=True)
+        batch = jnp.where(norms > 0, batch / jnp.maximum(norms, 1e-12), batch)
+    s = subk_lib.lloyd_stats_subk(batch, centroids, spec, n_valid, plan)
+    return SufficientStats(
+        sums=acc.sums + s.sums, counts=acc.counts + s.counts,
+        sse=acc.sse + s.sse,
     )
 
 
@@ -787,14 +817,18 @@ def _plan_1d_residency(residency, batches, k, d, spec: MeshSpec, *,
 
 @lru_cache(maxsize=32)
 def _resident_lloyd_fns(mesh, k, d, spherical, kernel, quantize, weighted,
-                        deferred, tol, chunk_iters):
+                        deferred, tol, chunk_iters,
+                        aspec=subk_lib.EXACT):
     """(chunk, pass_only) for streamed_kmeans_fit's resident mode — the
     compiled R-iteration loop over the DeviceCache plus the final
     reporting pass. Cached per configuration (the _lloyd_fit_fns
     rationale: fresh closures would re-trace every fit). The pass body is
     the streamed pass's exact op sequence — per-batch _accumulate (or the
     deferred d_add + ONE per-pass reduce + whole-pass padding correction)
-    in stream order."""
+    in stream order. `aspec` (ops/subk.CoarseSpec) swaps the per-batch
+    stats for the coarse→refine path — the plan is rebuilt from the
+    carried centroids inside the compiled pass, so residency composes
+    with sub-linear assignment with zero extra host boundaries."""
     if deferred:
         _, d_add, d_reduce = _deferred_lloyd_fns(
             mesh, k, d, spherical, kernel, quantize, weighted
@@ -810,6 +844,8 @@ def _resident_lloyd_fns(mesh, k, d, spherical, kernel, quantize, weighted,
 
     def pass_fn(c, aux, cache):
         def one(a, xb, wb, nv):
+            if aspec.coarse:
+                return _accumulate_subk(a, xb, c, nv, spherical, aspec)
             if deferred:
                 return d_add(a, xb, wb, c) if weighted else d_add(a, xb, c)
             if weighted:
@@ -1113,6 +1149,8 @@ def streamed_kmeans_fit(
     reduce="per_batch",
     residency: str = "stream",
     ingest=None,
+    assign: str = "exact",
+    probe=None,
 ) -> KMeansResult:
     """Exact Lloyd over a re-iterable stream of (B, d) batches.
 
@@ -1206,17 +1244,66 @@ def streamed_kmeans_fit(
         dropped mass before the fit aborts loudly (strict 0.0 default).
         The result's `ingest` field carries the IngestReport; with a clean
         stream the guarded fit is fp32-bit-exact with the unguarded one.
+      assign: "exact" (default — today's all-K assignment, untouched),
+        "coarse" (sub-linear coarse→refine tile-pruned assignment,
+        ops/subk.py: ~(T + probe·S)·d FLOPs per point instead of K·d,
+        bounded-loss — benchmarks/bench_subk.py publishes the
+        speedup/inertia-loss tradeoff), or "auto" (coarse at
+        K >= subk.AUTO_MIN_K, exact below — the choice is logged as an
+        `assign_selected` structlog event). probe= tunes tiles scanned
+        per point block ("all" or probe >= n_tiles routes to the exact
+        path and is therefore fp32-bit-exact by construction). Coarse
+        composes with residency tiers and the ingest guard (quarantined
+        batches carry n_valid=0 and mask to zero mass); it refuses
+        sample weights, kernel='pallas', and multi-device per_pass
+        reduce loudly (those compositions ride the K-sharded driver).
+        The result's `assign` field carries the AssignReport (tiles
+        probed vs total, pruned fraction).
     """
+    weighted = sample_weight_batches is not None
+    # Assign resolves FIRST: a coarse verdict makes the Pallas kernels
+    # inapplicable, which kernel='auto' must treat as an ineligibility
+    # reason, not a user error (the explicit-pallas guard below is for
+    # users who NAMED the kernel).
+    aspec = subk_lib.resolve_assign(assign, k, probe=probe,
+                                    label="streamed_kmeans_fit")
+    from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+    if aspec.coarse:
+        ineligible = "coarse assignment runs its own tile-pruned stats path"
+    elif weighted and mesh is not None:
+        ineligible = "sample weights with a mesh have no weighted Pallas tower"
+    else:
+        ineligible = None
+    kernel = resolve_kernel(
+        kernel, k=k, d=d,
+        itemsize=device_cache_lib.stream_itemsize(batches) or 4,
+        model="kmeans_weighted" if weighted else "kmeans",
+        label="streamed_kmeans_fit",
+        ineligible=ineligible,
+    )
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
     strategy = reduce_lib.resolve_reduce(reduce)
-    weighted = sample_weight_batches is not None
     if weighted and kernel == "pallas" and mesh is not None:
         raise ValueError(
             "kernel='pallas' with sample_weight_batches is single-device "
             "(the weighted kernels have no shard_map tower); drop mesh or "
             "the explicit kernel"
         )
+    if aspec.coarse:
+        if weighted:
+            raise ValueError(
+                "assign='coarse' does not support sample_weight_batches "
+                "(the tile-pruned stats have no weighted fold); use "
+                "assign='exact'"
+            )
+        if kernel == "pallas":
+            raise ValueError(
+                "assign='coarse' is its own tile-pruned stats path and "
+                "cannot combine with kernel='pallas'; drop the explicit "
+                "kernel (or use assign='exact')"
+            )
     stream = _weighted_stream(batches, sample_weight_batches)
     guard = ingest_lib.guard_stream(stream, ingest, d=d, weighted=weighted,
                                     label="streamed_kmeans_fit")
@@ -1275,10 +1362,20 @@ def streamed_kmeans_fit(
     deferred, n_mesh_dev = _reduce_plan(
         strategy, mesh, ckpt_dir, ckpt_every_batches, cursor=state.cursor
     )
+    if deferred and aspec.coarse:
+        raise ValueError(
+            "assign='coarse' with a multi-device per_pass reduce is wired "
+            "through the K-sharded driver (streamed_kmeans_fit_sharded); "
+            "here use reduce='per_batch' or assign='exact'"
+        )
     r_plan, builder = _plan_1d_residency(
         residency, batches, k, d, spec, weighted=weighted, kernel=kernel,
         cursor=state.cursor, label="streamed_kmeans_fit",
         mid_pass_ckpt=ckpt_every_batches is not None,
+    )
+    assign_counter = (
+        subk_lib.AssignCounter(_mirror=subk_lib.GLOBAL_ASSIGN)
+        if aspec.coarse else None
     )
 
     def _stage(batch):
@@ -1324,6 +1421,10 @@ def streamed_kmeans_fit(
         passes[0] += 1
         pad = [0.0]
         bdt = ["float32"]
+        # Coarse plan ONCE per pass (centroids are pass-constant); a
+        # per-batch rebuild would redo the cluster-the-centroids work
+        # num_batches times (subk.plan_for — bitwise-identical values).
+        pass_plan = subk_lib.plan_for(c, aspec) if aspec.coarse else None
 
         def step(acc, batch):
             sb = (batch if isinstance(batch, spill_lib.StagedBatch)
@@ -1344,6 +1445,15 @@ def streamed_kmeans_fit(
             xb, n_valid, n_local = sb.xb, sb.n_valid, sb.n_local
             if fill is not None:
                 fill.add(xb, n_valid)
+            if aspec.coarse:
+                fault_point("assign.refine")
+                counter.add(*cost_pb)
+                assign_counter.add(*subk_lib.assign_cost(xb.shape[0], aspec))
+                return (
+                    _accumulate_subk(acc, xb, c, jnp.asarray(n_valid),
+                                     spherical, aspec, pass_plan),
+                    n_local,
+                )
             if deferred:
                 pad[0] += xb.shape[0] - n_valid
                 bdt[0] = str(xb.dtype)
@@ -1430,10 +1540,16 @@ def streamed_kmeans_fit(
             break
         if cache is not None:
             break  # iterations 2..N run on-device over the cache
+    if cache is not None and assign_counter is not None:
+        # Resident passes run inside the compiled chunk loop — book their
+        # tile accounting by extrapolating the (deterministic, geometry-
+        # only) per-pass totals the streamed fill pass already tallied.
+        _snap1 = assign_counter.snapshot()
+        _passes_before_resident = passes[0]
     if cache is not None:
         chunk, pass_only = _resident_lloyd_fns(
             mesh, k, d, bool(spherical), kernel, strategy.quantize,
-            weighted, deferred, float(tol), chunk_iters,
+            weighted, deferred, float(tol), chunk_iters, aspec,
         )
         aux = (err_state[0]
                if deferred and strategy.quantize is not None else ())
@@ -1466,6 +1582,10 @@ def streamed_kmeans_fit(
         if deferred and strategy.quantize is not None:
             err_state[0] = aux
         sse = facc.sse
+        if assign_counter is not None:
+            extra = passes[0] - _passes_before_resident
+            assign_counter.add(_snap1["tiles_probed"] * extra,
+                               _snap1["tiles_total"] * extra)
     else:
         sse = full_pass(c).sse
     return KMeansResult(
@@ -1482,6 +1602,8 @@ def streamed_kmeans_fit(
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
         ingest=guard.report(),
+        assign=(None if assign_counter is None
+                else subk_lib.report(aspec, assign_counter)),
     )
 
 
@@ -1665,10 +1787,19 @@ def streamed_fuzzy_fit(
     the result's `ingest` field)."""
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
+    weighted = sample_weight_batches is not None
+    from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+    kernel = resolve_kernel(
+        kernel, k=k, d=d,
+        itemsize=device_cache_lib.stream_itemsize(batches) or 4,
+        model="fuzzy", label="streamed_fuzzy_fit",
+        ineligible=("the weighted fuzzy stats run in f32 XLA for mass "
+                    "exactness" if weighted else None),
+    )
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
     strategy = reduce_lib.resolve_reduce(reduce)
-    weighted = sample_weight_batches is not None
     if weighted and kernel == "pallas":
         raise ValueError(
             "kernel='pallas' does not support sample_weight_batches (the "

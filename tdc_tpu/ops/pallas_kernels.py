@@ -24,6 +24,7 @@ bigger N×K×M tensor.
 from __future__ import annotations
 
 import functools
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -95,13 +96,33 @@ def argmin_block_k(k: int, d: int, itemsize: int = 2, *, block_n: int = 1024,
     return bk if tiles + temps <= budget else 512
 
 
+def champion_tile(d2, ids=None):
+    """(per-row min (rows, 1), champion id (rows, 1)) — THE distance→champion
+    fold, shared by every hard-assignment consumer: a keepdims row min plus
+    the masked-iota argmin (neither jnp.argmin nor f32↔i32 vector casts
+    legalize in Mosaic, so the argmin is an all-i32 min over masked column
+    indices). Pure jnp: it runs identically inside a Pallas kernel body and
+    as plain XLA, which is how ops/subk.py's tile-pruned refine reuses this
+    exact fold on gathered candidate tiles instead of growing another copy.
+
+    `ids` overrides the per-column iota (broadcastable int32, same trailing
+    width as d2): the caller maps columns to global/original centroid ids —
+    ties then resolve to the smallest id, the same deterministic tie-break
+    as the iota form."""
+    tile_min = jnp.min(d2, axis=1, keepdims=True)
+    if ids is None:
+        ids = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+    masked = jnp.where(d2 <= tile_min, ids, _ARG_SENTINEL)
+    return tile_min, jnp.min(masked, axis=1, keepdims=True)
+
+
 def _distance_argmin_kernel(
     x_ref, c_ref, c2_ref, mind_ref, arg_ref, *, block_k: int, halves: int
 ):
     """`halves` > 1 splits the x-block into sub-blocks whose cross matmuls
     are all issued before any VPU work, so Mosaic can overlap one sub-block's
     min/argmin chain with the next's MXU matmul (the same interleave as
-    _fused_lloyd_kernel; identical math at any value)."""
+    the fused epilogue kernel; identical math at any value)."""
     j = pl.program_id(1)
     sub = x_ref.shape[0] // halves
     xs = [x_ref[h * sub:(h + 1) * sub, :] for h in range(halves)]
@@ -118,12 +139,9 @@ def _distance_argmin_kernel(
     tile_args = []
     for cross in crosses:
         d2 = c2_ref[...] - 2.0 * cross  # ‖x‖² row-constant, omitted
-        tile_min = jnp.min(d2, axis=1, keepdims=True)  # (sub, 1)
-        # Manual argmin: first column index achieving the min, all-i32
-        # (neither jnp.argmin nor f32<->i32 vector casts legalize in Mosaic).
         col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1) + j * block_k
-        masked = jnp.where(d2 <= tile_min, col, _ARG_SENTINEL)
-        tile_args.append(jnp.min(masked, axis=1, keepdims=True))  # (sub, 1)
+        tile_min, tile_arg = champion_tile(d2, col)
+        tile_args.append(tile_arg)  # (sub, 1)
         tile_mins.append(tile_min)
     tile_min = jnp.concatenate(tile_mins, axis=0)  # (BN, 1)
     tile_arg = jnp.concatenate(tile_args, axis=0)
@@ -237,69 +255,129 @@ def distance_argmin(
     return arg, mind
 
 
-def _fused_lloyd_kernel(
-    x_ref, c_ref, c2_ref, sums_ref, counts_ref, sse_ref,
-    acc_sums, acc_counts, acc_sse, *, halves: int,
-):
-    """Grid over N-blocks; K fully VMEM-resident. Per block: distances →
-    argmin (iota trick) → exact one-hot (col == argmin) → MXU accumulate into
-    VMEM scratch; outputs written once at the last block.
+# ---------------------------------------------------------------------------
+# The epilogue-parametric fused kernel.
+#
+# The four fused stats kernels (Lloyd, weighted Lloyd, fuzzy, diag-GMM
+# E-step) were four hand-copies of the same distance-matmul skeleton: grid
+# over N-blocks with the (K_pad, ·) state fully VMEM-resident, accumulators
+# zeroed at block 0, per-block MXU cross products issued for every sub-block
+# BEFORE any VPU work (so Mosaic overlaps sub-block i's K-wide VPU chain
+# with sub-block i+1's matmul — worth ~10% at the K=1024·d=128 bench shape,
+# benchmarks/kernel_tuning.py; halves=1 reproduces the strictly sequential
+# kernel bit-for-bit), the per-model epilogue folded into VMEM scratch, and
+# outputs written once at the last block. ONE body now owns that skeleton;
+# each model is a KernelEpilogue — the next epilogue (Elkan bounds, a
+# Triton lowering, the subk refine) is a function argument, not a fifth
+# copy. The refactor is proven bit-exact against pre-refactor goldens
+# (tests/test_pallas_parity.py / tests/golden/pallas_parity.npz).
+# ---------------------------------------------------------------------------
 
-    `halves` > 1 splits the block into sub-blocks whose cross matmuls are all
-    issued before any VPU work, so Mosaic can overlap sub-block i's K-wide
-    VPU chain (min/argmin/one-hot) with sub-block i+1's MXU matmul — worth
-    ~10% at the K=1024, d=128 bench shape (benchmarks/kernel_tuning.py;
-    halves=1 reproduces the strictly sequential kernel bit-for-bit).
 
-    Σ‖x‖² (needed only for the SSE) is computed here from the already-loaded
-    x tile — a d-wide pass, ~d/K of the K-wide VPU work — NOT passed in as an
-    (N, 1) input: profiling showed the host-side Σx² reduce plus the
-    T(1,128)→T(8,128) relayout copy XLA inserts for an (N, 1) custom-call
-    operand cost 22% of the whole iteration (benchmarks/ROOFLINE.md)."""
+class KernelEpilogue(NamedTuple):
+    """One fused-stats epilogue for _fused_epilogue_kernel.
+
+    n_row: leading operands blocked over N and sliced per sub-block (x, and
+      the weight column for the weighted kernel); the remaining inputs are
+      K-resident and read whole (centroid tile, ‖c‖² row, GMM parameter
+      tiles).
+    n_acc: accumulator/output pairs (each an out_ref + a VMEM scratch).
+    mxu(subs, resident) -> crosses: the MXU prologue for ONE sub-block —
+      issued for every sub-block before any fold runs (the interleave
+      contract above).
+    fold(subs, crosses, resident) -> n_acc deltas, added to the scratch
+      accumulators in order. Pure jnp on arrays — the same fold functions
+      run outside Pallas (ops/subk.py reuses champion_tile / the Lloyd fold
+      math on gathered candidate tiles).
+    """
+
+    name: str
+    n_row: int
+    n_acc: int
+    mxu: Callable
+    fold: Callable
+
+
+def _fused_epilogue_kernel(*refs, epilogue: KernelEpilogue, halves: int):
+    """Grid over N-blocks; K-resident state in VMEM. The one kernel body
+    behind lloyd_stats_fused / lloyd_stats_fused_weighted /
+    fuzzy_stats_fused / gmm_stats_fused.
+
+    Σ‖x‖²-style row terms are computed by the epilogues from the
+    already-loaded x tile — a d-wide pass, ~d/K of the K-wide VPU work —
+    NOT passed in as (N, 1) operands: profiling showed the host-side Σx²
+    reduce plus the T(1,128)→T(8,128) relayout copy XLA inserts for an
+    (N, 1) custom-call operand cost 22% of the whole iteration
+    (benchmarks/ROOFLINE.md)."""
+    n_row, n_acc = epilogue.n_row, epilogue.n_acc
+    row_refs = refs[:n_row]
+    resident_refs = refs[n_row:len(refs) - 2 * n_acc]
+    out_refs = refs[len(refs) - 2 * n_acc:len(refs) - n_acc]
+    acc_refs = refs[len(refs) - n_acc:]
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _():
-        acc_sums[...] = jnp.zeros_like(acc_sums)
-        acc_counts[...] = jnp.zeros_like(acc_counts)
-        acc_sse[...] = jnp.zeros_like(acc_sse)
+        for a in acc_refs:
+            a[...] = jnp.zeros_like(a)
 
-    sub = x_ref.shape[0] // halves
-    xs = [x_ref[h * sub:(h + 1) * sub, :] for h in range(halves)]
-    crosses = [
-        jax.lax.dot_general(
-            xh,
-            c_ref[...],
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (BN/halves, K)
-        for xh in xs
+    resident = tuple(r[...] for r in resident_refs)
+    sub = row_refs[0].shape[0] // halves
+    subs = [
+        tuple(r[h * sub:(h + 1) * sub, :] for r in row_refs)
+        for h in range(halves)
     ]
-    for xh, cross in zip(xs, crosses):
-        d2 = c2_ref[...] - 2.0 * cross
-        tile_min = jnp.min(d2, axis=1, keepdims=True)  # (sub, 1)
-        col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
-        masked = jnp.where(d2 <= tile_min, col, _ARG_SENTINEL)
-        tile_arg = jnp.min(masked, axis=1, keepdims=True)  # (sub, 1)
-        one_hot = (col == tile_arg).astype(xh.dtype)  # exact single 1 per row
-        acc_sums[...] += jax.lax.dot_general(
-            one_hot,
-            xh,
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_counts[...] += jnp.sum(
-            one_hot.astype(jnp.float32), axis=0, keepdims=True
-        )
-        # True SSE needs the dropped ‖x‖² back: Σ(min d2') + Σ‖x‖².
-        xf = xh.astype(jnp.float32)
-        acc_sse[...] += jnp.sum(tile_min) + jnp.sum(xf * xf)
+    crosses = [epilogue.mxu(s, resident) for s in subs]
+    for s, cr in zip(subs, crosses):
+        for a, delta in zip(acc_refs, epilogue.fold(s, cr, resident)):
+            a[...] += delta
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
-        sums_ref[...] = acc_sums[...]
-        counts_ref[...] = acc_counts[...]
-        sse_ref[...] = acc_sse[...]
+        for o, a in zip(out_refs, acc_refs):
+            o[...] = a[...]
+
+
+def _cross_mxu(subs, resident):
+    """The shared MXU prologue of the Lloyd/weighted/fuzzy epilogues: one
+    -2·x·cᵀ-shaped cross product per sub-block (x is subs[0], the centroid
+    tile is resident[0])."""
+    return (
+        jax.lax.dot_general(
+            subs[0],
+            resident[0],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ),  # (BN/halves, K)
+    )
+
+
+def _lloyd_fold(subs, crosses, resident):
+    """Lloyd epilogue: shifted distances → champion (iota trick) → exact
+    one-hot (col == argmin) → MXU-accumulated (Σx, counts, sse) deltas.
+    True SSE needs the dropped ‖x‖² back: Σ(min d2') + Σ‖x‖²."""
+    (xh,) = subs
+    (cross,) = crosses
+    c2 = resident[1]
+    d2 = c2 - 2.0 * cross
+    tile_min, tile_arg = champion_tile(d2)
+    col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+    one_hot = (col == tile_arg).astype(xh.dtype)  # exact single 1 per row
+    sums = jax.lax.dot_general(
+        one_hot,
+        xh,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    counts = jnp.sum(one_hot.astype(jnp.float32), axis=0, keepdims=True)
+    xf = xh.astype(jnp.float32)
+    sse = jnp.sum(tile_min) + jnp.sum(xf * xf)
+    return sums, counts, sse
+
+
+_LLOYD_EPILOGUE = KernelEpilogue(
+    name="lloyd", n_row=1, n_acc=3, mxu=_cross_mxu, fold=_lloyd_fold
+)
 
 
 @functools.partial(jax.jit, static_argnames=("block_n", "halves", "interpret"))
@@ -358,7 +436,8 @@ def lloyd_stats_fused(
     n_blocks = n_pad // block_n
 
     sums, counts, sse = pl.pallas_call(
-        functools.partial(_fused_lloyd_kernel, halves=halves),
+        functools.partial(_fused_epilogue_kernel, epilogue=_LLOYD_EPILOGUE,
+                          halves=halves),
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec((block_n, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -397,62 +476,40 @@ def lloyd_stats_fused(
     )
 
 
-def _fused_lloyd_weighted_kernel(
-    x_ref, w_ref, c_ref, c2_ref, sums_ref, counts_ref, sse_ref,
-    acc_sums, acc_counts, acc_sse, *, halves: int,
-):
-    """Weighted variant of _fused_lloyd_kernel: the (BN, 1) f32 weight
-    column scales the one-hot rows, so the same MXU contraction produces
-    Σ w·x per cluster and the column sum produces the mass. Everything
-    accumulates in f32 (bf16 one-hot rounding would bias the mass — the
-    same exactness contract as ops/assign.lloyd_stats_weighted), which
-    costs the bf16 inputs their half-width stats matmul; the distance pass
-    keeps the input dtype. Zero-weight rows (including padding) contribute
-    exactly nothing, so the wrapper needs no padding correction."""
-    i = pl.program_id(0)
+def _lloyd_weighted_fold(subs, crosses, resident):
+    """Weighted Lloyd epilogue: the (BN, 1) f32 weight column scales the
+    one-hot rows, so the same MXU contraction produces Σ w·x per cluster
+    and the column sum produces the mass. Everything accumulates in f32
+    (bf16 one-hot rounding would bias the mass — the same exactness
+    contract as ops/assign.lloyd_stats_weighted), which costs the bf16
+    inputs their half-width stats matmul; the distance pass keeps the
+    input dtype. Zero-weight rows (including padding) contribute exactly
+    nothing, so the wrapper needs no padding correction."""
+    xh, wh = subs
+    (cross,) = crosses
+    c2 = resident[1]
+    d2 = c2 - 2.0 * cross
+    tile_min, tile_arg = champion_tile(d2)
+    col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+    one_hot_w = (col == tile_arg).astype(jnp.float32) * wh  # (sub, K)
+    xf = xh.astype(jnp.float32)
+    sums = jax.lax.dot_general(
+        one_hot_w,
+        xf,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    counts = jnp.sum(one_hot_w, axis=0, keepdims=True)
+    # Weighted SSE: Σ w·(shifted min + ‖x‖²).
+    x2 = jnp.sum(xf * xf, axis=1, keepdims=True)
+    sse = jnp.sum(wh * (tile_min + x2))
+    return sums, counts, sse
 
-    @pl.when(i == 0)
-    def _():
-        acc_sums[...] = jnp.zeros_like(acc_sums)
-        acc_counts[...] = jnp.zeros_like(acc_counts)
-        acc_sse[...] = jnp.zeros_like(acc_sse)
 
-    sub = x_ref.shape[0] // halves
-    xs = [x_ref[h * sub:(h + 1) * sub, :] for h in range(halves)]
-    ws = [w_ref[h * sub:(h + 1) * sub, :] for h in range(halves)]
-    crosses = [
-        jax.lax.dot_general(
-            xh,
-            c_ref[...],
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        for xh in xs
-    ]
-    for xh, wh, cross in zip(xs, ws, crosses):
-        d2 = c2_ref[...] - 2.0 * cross
-        tile_min = jnp.min(d2, axis=1, keepdims=True)  # (sub, 1)
-        col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
-        masked = jnp.where(d2 <= tile_min, col, _ARG_SENTINEL)
-        tile_arg = jnp.min(masked, axis=1, keepdims=True)
-        one_hot_w = (col == tile_arg).astype(jnp.float32) * wh  # (sub, K)
-        xf = xh.astype(jnp.float32)
-        acc_sums[...] += jax.lax.dot_general(
-            one_hot_w,
-            xf,
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_counts[...] += jnp.sum(one_hot_w, axis=0, keepdims=True)
-        # Weighted SSE: Σ w·(shifted min + ‖x‖²).
-        x2 = jnp.sum(xf * xf, axis=1, keepdims=True)
-        acc_sse[...] += jnp.sum(wh * (tile_min + x2))
-
-    @pl.when(i == pl.num_programs(0) - 1)
-    def _():
-        sums_ref[...] = acc_sums[...]
-        counts_ref[...] = acc_counts[...]
-        sse_ref[...] = acc_sse[...]
+_LLOYD_WEIGHTED_EPILOGUE = KernelEpilogue(
+    name="lloyd_weighted", n_row=2, n_acc=3, mxu=_cross_mxu,
+    fold=_lloyd_weighted_fold,
+)
 
 
 @functools.partial(jax.jit, static_argnames=("block_n", "halves", "interpret"))
@@ -508,7 +565,8 @@ def lloyd_stats_fused_weighted(
     d_pad = xp.shape[1]
 
     sums, counts, sse = pl.pallas_call(
-        functools.partial(_fused_lloyd_weighted_kernel, halves=halves),
+        functools.partial(_fused_epilogue_kernel,
+                          epilogue=_LLOYD_WEIGHTED_EPILOGUE, halves=halves),
         grid=(n_pad // block_n,),
         in_specs=[
             pl.BlockSpec((block_n, d_pad), lambda i: (i, 0),
@@ -561,63 +619,41 @@ def lloyd_stats_auto_weighted(
     return lloyd_stats_sorted_weighted(x, centroids, sample_weight, **kw)
 
 
-def _fused_fuzzy_kernel(
-    x_ref, c_ref, c2_ref, wsums_ref, weights_ref, obj_ref,
-    acc_wsums, acc_weights, acc_obj, *, m: float, eps: float, halves: int,
-):
-    """Grid over N-blocks; K fully VMEM-resident. Per block: distances →
-    memberships u = (d²+eps)^(-1/(m-1)) normalized → MU = u^m → MXU-weighted
-    sums into VMEM scratch; outputs written once at the last block. The (N, K)
-    membership matrix never exists anywhere (the reference materialized it
-    per tower, scripts/distribuitedClustering.py:117-137).
+def _fuzzy_fold_for(m: float, eps: float):
+    """Fuzzy epilogue factory: distances → memberships
+    u = (d²+eps)^(-1/(m-1)) normalized → MU = u^m → MXU-weighted sum
+    deltas. The (N, K) membership matrix never exists anywhere (the
+    reference materialized it per tower,
+    scripts/distribuitedClustering.py:117-137).
 
     Per-row ‖x‖² (memberships need true distance magnitudes — the argmin
     shift trick does not apply here) is computed from the VMEM-resident x
     tile: a d-wide pass instead of an (N, 1) custom-call operand, whose HBM
     reduce + relayout copy cost 22% per iteration on the Lloyd kernel
-    (benchmarks/ROOFLINE.md). `halves` interleaves sub-blocks exactly like
-    _fused_lloyd_kernel."""
-    i = pl.program_id(0)
+    (benchmarks/ROOFLINE.md)."""
 
-    @pl.when(i == 0)
-    def _():
-        acc_wsums[...] = jnp.zeros_like(acc_wsums)
-        acc_weights[...] = jnp.zeros_like(acc_weights)
-        acc_obj[...] = jnp.zeros_like(acc_obj)
-
-    sub = x_ref.shape[0] // halves
-    xs = [x_ref[h * sub:(h + 1) * sub, :] for h in range(halves)]
-    crosses = [
-        jax.lax.dot_general(
-            xh,
-            c_ref[...],
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (BN/halves, K)
-        for xh in xs
-    ]
-    for xh, cross in zip(xs, crosses):
+    def fold(subs, crosses, resident):
+        (xh,) = subs
+        (cross,) = crosses
+        c2 = resident[1]
         xf = xh.astype(jnp.float32)
         x2 = jnp.sum(xf * xf, axis=1, keepdims=True)  # (sub, 1)
         # True squared distances, clamped at 0 like pairwise_sq_dist.
-        d2 = jnp.maximum(x2 + c2_ref[...] - 2.0 * cross, 0.0)
+        d2 = jnp.maximum(x2 + c2 - 2.0 * cross, 0.0)
         inv = (d2 + eps) ** (-1.0 / (m - 1.0))  # padded-centroid rows → ~0
         u = inv / jnp.sum(inv, axis=1, keepdims=True)
         mu = u**m  # (sub, K)
-        acc_wsums[...] += jax.lax.dot_general(
+        wsums = jax.lax.dot_general(
             mu,
             xf,
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        acc_weights[...] += jnp.sum(mu, axis=0, keepdims=True)
-        acc_obj[...] += jnp.sum(mu * d2)
+        return wsums, jnp.sum(mu, axis=0, keepdims=True), jnp.sum(mu * d2)
 
-    @pl.when(i == pl.num_programs(0) - 1)
-    def _():
-        wsums_ref[...] = acc_wsums[...]
-        weights_ref[...] = acc_weights[...]
-        obj_ref[...] = acc_obj[...]
+    return KernelEpilogue(
+        name="fuzzy", n_row=1, n_acc=3, mxu=_cross_mxu, fold=fold
+    )
 
 
 @functools.partial(
@@ -680,8 +716,10 @@ def fuzzy_stats_fused(
     d_pad = xp.shape[1]
 
     wsums, weights, obj = pl.pallas_call(
-        functools.partial(_fused_fuzzy_kernel, m=float(m), eps=float(eps),
-                          halves=halves),
+        functools.partial(
+            _fused_epilogue_kernel,
+            epilogue=_fuzzy_fold_for(float(m), float(eps)), halves=halves,
+        ),
         grid=(n_pad // block_n,),
         in_specs=[
             pl.BlockSpec((block_n, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -722,6 +760,80 @@ def fuzzy_stats_fused(
         weights=weights,
         objective=jnp.maximum(obj, 0.0),
     )
+
+
+def resolve_kernel(
+    kernel: str,
+    *,
+    k: int,
+    d: int,
+    itemsize: int = 4,
+    model: str = "kmeans",
+    platform: str | None = None,
+    label: str = "",
+    ineligible: str | None = None,
+) -> str:
+    """The default-kernel auto policy (ROADMAP item 1b): kernel='auto'
+    resolves to 'pallas' when the fused (K, d) block fits VMEM on TPU via
+    the SAME feasibility predicates the kernels themselves gate on
+    (fused_block_n / twopass_blocks / gmm_block_n), and falls back to 'xla'
+    LOUDLY otherwise — one structlog `kernel_selected` event names the
+    choice and the reason every time auto decides. Explicitly named
+    kernels ('xla', 'pallas', ...) pass through untouched, so existing
+    behavior is bit-identical when the knob is spelled out.
+
+    `k` is the per-device centroid count (callers on the K-sharded towers
+    pass K / n_model — VMEM feasibility is a per-shard question).
+    `model`: 'kmeans' | 'kmeans_weighted' | 'kmeans_sharded' | 'fuzzy' |
+    'fuzzy_sharded' | 'gmm' — picks the matching predicate
+    ('kmeans_sharded' runs the blockwise online-argmin kernel, feasible at
+    any K·d; 'fuzzy_sharded' the two-pass streaming kernels).
+    `platform` overrides the device-platform probe (tests exercise the
+    TPU branch from the CPU CI this way). `ineligible` names a caller-side
+    reason the Pallas path cannot apply at all (e.g. weighted + mesh has
+    no weighted shard_map tower) — auto then resolves to 'xla' with that
+    reason in the event instead of tripping the explicit-kernel guard."""
+    if kernel != "auto":
+        return kernel
+    from tdc_tpu.utils.structlog import emit
+
+    if platform is None:
+        platform = jax.devices()[0].platform
+    if ineligible is not None:
+        choice, reason = "xla", ineligible
+    elif platform != "tpu":
+        choice = "xla"
+        reason = (
+            f"platform={platform}: the fused kernels are TPU Mosaic "
+            "lowerings (interpret mode off-TPU is strictly slower than XLA)"
+        )
+    else:
+        if model == "gmm":
+            feasible = gmm_block_n(k, d, itemsize) > 0
+        elif model == "fuzzy":
+            feasible = fused_block_n(k, d, itemsize, temps=3) > 0
+        elif model == "fuzzy_sharded":
+            feasible = twopass_blocks(k, d, itemsize)[0] > 0
+        elif model == "kmeans_weighted":
+            feasible = fused_block_n(k, d, itemsize, temps=2) > 0
+        elif model == "kmeans_sharded":
+            # The per-shard tower runs the blockwise online-argmin kernel +
+            # windowed sorted stats — no (K, d)-resident accumulator, so
+            # there is no VMEM ceiling to gate on.
+            feasible = True
+        elif model == "kmeans":
+            feasible = fused_block_n(k, d, itemsize) > 0
+        else:
+            raise ValueError(f"resolve_kernel: unknown model {model!r}")
+        choice = "pallas" if feasible else "xla"
+        reason = (
+            f"(K={k}, d={d}) fits the fused-kernel VMEM model"
+            if feasible
+            else f"(K={k}, d={d}) exceeds the fused-kernel VMEM model"
+        )
+    emit("kernel_selected", kernel=choice, model=model, k=int(k), d=int(d),
+         reason=reason, label=label)
+    return choice
 
 
 def lloyd_stats_auto(x: jax.Array, centroids: jax.Array, **kw):
@@ -1083,53 +1195,51 @@ def fuzzy_stats_twopass(
     )
 
 
-def _fused_gmm_kernel(
-    x_ref, inv_ref, muinv_ref, bias_ref, nk_ref, sx_ref, sxx_ref, ll_ref,
-    acc_nk, acc_sx, acc_sxx, acc_ll,
-):
-    """Fused diag-GMM E-step: per N-block, log-probs via two MXU matmuls
-    (the ops/distance.py expansion applied to the Mahalanobis form),
-    responsibilities via an in-register logsumexp, and the three moment
-    accumulations — the (N, K) responsibility matrix never exists."""
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _():
-        acc_nk[...] = jnp.zeros_like(acc_nk)
-        acc_sx[...] = jnp.zeros_like(acc_sx)
-        acc_sxx[...] = jnp.zeros_like(acc_sxx)
-        acc_ll[...] = jnp.zeros_like(acc_ll)
-
-    xf = x_ref[...].astype(jnp.float32)  # (BN, d)
+def _gmm_mxu(subs, resident):
+    """Diag-GMM MXU prologue: the two Mahalanobis matmuls of the
+    ops/distance.py expansion — Σ_d x²/σ² and Σ_d x·μ/σ²."""
+    (xh,) = subs
+    inv, muinv, _ = resident
+    xf = xh.astype(jnp.float32)  # (BN, d)
     xsq = xf * xf
     t1 = jax.lax.dot_general(
-        xsq, inv_ref[...], (((1,), (1,)), ((), ())),
+        xsq, inv, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # (BN, K) — Σ_d x²/σ²
+    )  # (BN, K)
     t2 = jax.lax.dot_general(
-        xf, muinv_ref[...], (((1,), (1,)), ((), ())),
+        xf, muinv, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # (BN, K) — Σ_d x·μ/σ²
-    logp = -0.5 * t1 + t2 + bias_ref[...]  # (BN, K); padded K → -1e30
+    )  # (BN, K)
+    return t1, t2
+
+
+def _gmm_fold(subs, crosses, resident):
+    """Diag-GMM E-step epilogue: log-probs from the two MXU crosses,
+    responsibilities via an in-register logsumexp, and the three moment
+    deltas — the (N, K) responsibility matrix never exists."""
+    (xh,) = subs
+    t1, t2 = crosses
+    bias = resident[2]
+    xf = xh.astype(jnp.float32)
+    xsq = xf * xf
+    logp = -0.5 * t1 + t2 + bias  # (BN, K); padded K → -1e30
     mx = jnp.max(logp, axis=1, keepdims=True)
     ex = jnp.exp(logp - mx)
     norm = mx + jnp.log(jnp.sum(ex, axis=1, keepdims=True))  # logsumexp
     r = jnp.exp(logp - norm)  # (BN, K)
-    acc_nk[...] += jnp.sum(r, axis=0, keepdims=True)
-    acc_sx[...] += jax.lax.dot_general(
+    nk = jnp.sum(r, axis=0, keepdims=True)
+    sx = jax.lax.dot_general(
         r, xf, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
-    acc_sxx[...] += jax.lax.dot_general(
+    sxx = jax.lax.dot_general(
         r, xsq, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
-    acc_ll[...] += jnp.sum(norm)
+    return nk, sx, sxx, jnp.sum(norm)
 
-    @pl.when(i == pl.num_programs(0) - 1)
-    def _():
-        nk_ref[...] = acc_nk[...]
-        sx_ref[...] = acc_sx[...]
-        sxx_ref[...] = acc_sxx[...]
-        ll_ref[...] = acc_ll[...]
+
+_GMM_EPILOGUE = KernelEpilogue(
+    name="gmm", n_row=1, n_acc=4, mxu=_gmm_mxu, fold=_gmm_fold
+)
 
 
 def gmm_block_n(
@@ -1200,7 +1310,8 @@ def gmm_stats_fused(
     k_pad = invp.shape[0]
 
     nk, sx, sxx, ll = pl.pallas_call(
-        _fused_gmm_kernel,
+        functools.partial(_fused_epilogue_kernel, epilogue=_GMM_EPILOGUE,
+                          halves=1),
         grid=(n_pad // block_n,),
         in_specs=[
             pl.BlockSpec((block_n, d_pad), lambda i: (i, 0),

@@ -133,9 +133,20 @@ def _block_stats(x_blk, c_loc, kernel: str, shifted: bool = False):
 def make_sharded_stats(
     mesh: Mesh, kernel: str = "xla", block_rows: int = 0,
     shifted: bool = False, reduce_data: bool = True,
+    assign_spec=None,
 ):
     """Returns a jit-able fn(x, c) → (sums, counts, sse): x sharded (data,),
     c sharded (model,); sums/counts stay K-sharded, sse replicated.
+
+    assign_spec (ops/subk.CoarseSpec, coarse mode) swaps the all-K
+    champion pass for the coarse→refine tile-pruned assignment: each model
+    shard clusters its OWN K/Pm local centroids into tiles and refines
+    only the top-`probe` tiles per point block (the plan build is
+    shard-local — zero collectives — and the champion all_gather is
+    unchanged, so the collective schedule is assignment-mode-independent).
+    The returned fn then takes (x, c, n_valid): zero-padding rows are
+    masked INSIDE (sentinel champions, zero sse on every shard), so
+    callers must skip the exact path's padding_correction.
 
     block_rows > 0 scans the local points in (block_rows, d) tiles so the
     per-shard intermediates never exceed O(block_rows · K/Pm) regardless of N
@@ -158,6 +169,73 @@ def make_sharded_stats(
         else (P(DATA_AXIS, MODEL_AXIS, None), P(DATA_AXIS, MODEL_AXIS),
               P(DATA_AXIS))
     )
+
+    if assign_spec is not None and assign_spec.coarse:
+        from tdc_tpu.ops import subk as subk_lib
+
+        aspec = assign_spec
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None), P()),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+        def stats_coarse(x_loc, c_loc, n_valid):
+            from tdc_tpu.ops.sorted_stats import sorted_cluster_stats
+
+            n_loc = x_loc.shape[0]
+            k_per = c_loc.shape[0]
+            m_idx = jax.lax.axis_index(MODEL_AXIS)
+            d_idx = jax.lax.axis_index(DATA_AXIS)
+            # Zero-padding rows sit at the END of the global batch, so
+            # each data shard's valid prefix is a clipped remainder.
+            nv_loc = jnp.clip(n_valid - d_idx * n_loc, 0, n_loc)
+            # The per-shard plan rebuilds per stats call (= per batch on
+            # the streamed drivers): hoisting it per pass would thread
+            # model-sharded plan operands through every accumulate
+            # signature. O(K/Pm·(T + log K)·d) vs the refine's
+            # O(rows·(T + probe·S)·d) — amortized by the large batches
+            # the huge-K regime runs anyway (ARCHITECTURE §"Sub-linear
+            # assignment"; the 1-D driver hoists via subk.plan_for).
+            plan = subk_lib.build_plan(c_loc, aspec)
+            labels, lmin = subk_lib.coarse_champions(
+                x_loc, plan, nv_loc, aspec
+            )
+            # Local → global champion ids; pad rows stay sentinel on every
+            # shard and report min 0.0, so the cross-shard reduction keeps
+            # them sentinel/zero (no padding correction anywhere).
+            larg = jnp.where(labels < subk_lib.ARG_SENTINEL,
+                             labels + m_idx * k_per, subk_lib.ARG_SENTINEL)
+            mins = jax.lax.all_gather(lmin, MODEL_AXIS)  # (Pm, n_loc)
+            args = jax.lax.all_gather(larg, MODEL_AXIS)
+            gmin = jnp.min(mins, axis=0)
+            garg = jnp.min(
+                jnp.where(mins == gmin[None, :], args, 2**30), axis=0
+            )
+            rel = garg - m_idx * k_per  # sentinel stays >= k_per → dropped
+            sums, counts = sorted_cluster_stats(
+                x_loc, rel, k_per, pallas=(kernel == "pallas")
+            )
+            valid = jnp.arange(n_loc) < nv_loc
+            if shifted:
+                sse = jnp.sum(jnp.where(valid, gmin, 0.0))
+            else:
+                xf = x_loc.astype(jnp.float32)
+                x2 = jnp.sum(xf * xf, axis=1)
+                sse = jnp.sum(
+                    jnp.where(valid, jnp.maximum(gmin + x2, 0.0), 0.0)
+                )
+            if not reduce_data:
+                return sums[None], counts[None], sse[None]
+            return (
+                jax.lax.psum(sums, DATA_AXIS),
+                jax.lax.psum(counts, DATA_AXIS),
+                jax.lax.psum(sse, DATA_AXIS),
+            )
+
+        return stats_coarse
 
     @partial(
         shard_map,
@@ -257,6 +335,7 @@ def make_sharded_lloyd_step(
     kernel: str = "xla",
     block_rows: int = 0,
     spherical: bool = False,
+    assign_spec=None,
 ):
     """Returns a jit'd step: (x (data,)-sharded, c (model,)-sharded, n_valid)
     → (new_c (model,)-sharded, shift, sse). Zero-padding rows beyond n_valid
@@ -276,18 +355,30 @@ def make_sharded_lloyd_step(
     updates are unaffected (champions are shift-invariant); only the scalar
     SSE report degrades. Pre-center such data, or call the step without
     x2sum for an exact final report."""
-    stats_fn = make_sharded_stats(mesh, kernel, block_rows)
-    stats_shifted = make_sharded_stats(mesh, kernel, block_rows, shifted=True)
+    coarse = assign_spec is not None and assign_spec.coarse
+    stats_fn = make_sharded_stats(mesh, kernel, block_rows,
+                                  assign_spec=assign_spec)
+    stats_shifted = make_sharded_stats(mesh, kernel, block_rows, shifted=True,
+                                       assign_spec=assign_spec)
 
     @jax.jit
     def step(x, c, n_valid, x2sum=None):
-        if x2sum is None:
+        if coarse:
+            # Coarse stats mask padding internally (sentinel champions,
+            # zero sse contributions) — no correction term exists.
+            if x2sum is None:
+                sums, counts, sse = stats_fn(x, c, n_valid)
+            else:
+                sums, counts, sse = stats_shifted(x, c, n_valid)
+                sse = jnp.maximum(sse + x2sum, 0.0)
+        elif x2sum is None:
             sums, counts, sse = stats_fn(x, c)
         else:
             sums, counts, sse = stats_shifted(x, c)
             sse = jnp.maximum(sse + x2sum, 0.0)
-        n_pad = x.shape[0] - n_valid
-        counts, sse = padding_correction(counts, sse, c, n_pad)
+        if not coarse:
+            n_pad = x.shape[0] - n_valid
+            counts, sse = padding_correction(counts, sse, c, n_pad)
         cf = c.astype(jnp.float32)
         new_c = jnp.where(
             counts[:, None] > 0,
@@ -414,10 +505,17 @@ def kmeans_fit_sharded(
     spherical: bool = False,
     kernel: str = "xla",
     block_rows: int = 0,
+    assign: str = "exact",
+    probe=None,
 ) -> KMeansResult:
     """Lloyd K-Means with points sharded over 'data' and centroids over
     'model' (the K=16,384 regime). init may be a (K, d) array or an init name
     ('kmeans++'/'random'/'first_k'/'kmeans||'), resolved on a host subsample.
+
+    assign="coarse"/"auto" + probe: sub-linear coarse→refine tile-pruned
+    assignment per model shard (ops/subk.py; streamed_kmeans_fit_sharded's
+    contract — bounded-loss, probe='all' routes to the exact path;
+    kernel='auto' resolves via ops/pallas_kernels.resolve_kernel).
 
     Multi-process meshes (SURVEY §7 step 7: sharded centroid tiles at pod
     scale) are supported by passing `x` as the full NUMPY array, identical on
@@ -447,8 +545,16 @@ def kmeans_fit_sharded(
     # Whole fit loop device-side (round-4 VERDICT weak #2: the Python
     # iterate-and-float() loop here cost one device round trip per
     # iteration). Host syncs per fit: the loop-result fetch + the final SSE.
+    from tdc_tpu.ops import subk as subk_lib
+    from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+    kernel = resolve_kernel(kernel, k=k // n_model, d=x.shape[1],
+                            model="kmeans_sharded",
+                            label="kmeans_fit_sharded")
+    aspec = subk_lib.resolve_assign(assign, k // n_model, probe=probe,
+                                    label="kmeans_fit_sharded")
     run, step = _lloyd_fit_fns(mesh, kernel, block_rows, spherical,
-                               int(max_iters), float(tol))
+                               int(max_iters), float(tol), aspec)
     x2sum = sum_sq(x)  # once per fit; the step then skips the ‖x‖² re-read
     c, shift_dev, i_dev, hist = run(x, c, x2sum)
     n_iter = int(i_dev)
@@ -460,6 +566,17 @@ def kmeans_fit_sharded(
     # INPUT centroids, so re-invoking the already-compiled step and
     # discarding its update gives exactly that with no extra compile.
     _, _, sse = step(x, c, x.shape[0], x2sum)
+    assign_report = None
+    if aspec.coarse:
+        # The whole fit ran inside the compiled while_loop: book the
+        # (deterministic, geometry-only) tile tallies after the fact —
+        # n_iter loop passes plus the final reporting step, each refining
+        # every (data, model) shard pair's blocks against its own tiles.
+        counter = subk_lib.AssignCounter(_mirror=subk_lib.GLOBAL_ASSIGN)
+        probed, total = subk_lib.assign_cost(x.shape[0] // n_data, aspec)
+        scale = n_data * n_model * (n_iter + 1)
+        counter.add(probed * scale, total * scale)
+        assign_report = subk_lib.report(aspec, counter)
     return KMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),
@@ -467,18 +584,22 @@ def kmeans_fit_sharded(
         shift=jnp.asarray(shift, jnp.float32),
         converged=jnp.asarray(converged),
         history=np.asarray(hist)[:n_iter],
+        assign=assign_report,
     )
 
 
 @lru_cache(maxsize=64)
-def _lloyd_fit_fns(mesh, kernel, block_rows, spherical, max_iters, tol):
+def _lloyd_fit_fns(mesh, kernel, block_rows, spherical, max_iters, tol,
+                   assign_spec=None):
     """Per-configuration jitted (loop, step) pair for kmeans_fit_sharded,
     cached module-wide: a fit call otherwise builds FRESH jit closures and
     re-traces + re-compiles the whole while_loop every invocation —
     measured ~6 s per fit through the remote-compile tunnel even with the
     persistent XLA cache warm (round-5; repeated fits are the sweep
-    harness's bread and butter). Keyed by everything the trace closes over."""
-    step = make_sharded_lloyd_step(mesh, kernel, block_rows, spherical)
+    harness's bread and butter). Keyed by everything the trace closes over
+    (assign_spec is the hashable ops/subk.CoarseSpec)."""
+    step = make_sharded_lloyd_step(mesh, kernel, block_rows, spherical,
+                                   assign_spec)
 
     @jax.jit
     def run(x, c0, x2sum):
@@ -784,6 +905,11 @@ def fuzzy_fit_sharded(
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
+    from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+    kernel = resolve_kernel(kernel, k=k // n_model, d=x.shape[1],
+                            model="fuzzy_sharded",
+                            label="fuzzy_fit_sharded")
     c = _resolve_init_sharded(x, k, init, key)
     x, n_pad = _pad_rows_sharded(x, n_data, block_rows)
     x = jax.device_put(_cast_points(x, dtype),
@@ -1088,6 +1214,19 @@ def _make_put_batch(mesh, pad_multiple: int, dtype, spherical: bool = False):
     return put_batch
 
 
+def _stream_kernel_itemsize(batches, dtype) -> int:
+    """Element width the kernels will actually see for a streamed fit:
+    the host-side `dtype` cast when one is requested, else the stream's
+    own element size (stream_itemsize; bf16 .npz streams advertise 2),
+    else the f32 default — so kernel='auto' evaluates VMEM feasibility
+    against the real operand width, not a pessimistic f32 guess."""
+    from tdc_tpu.data import device_cache as dc
+
+    if dtype is not None:
+        return int(np.dtype(dtype).itemsize)
+    return dc.stream_itemsize(batches) or 4
+
+
 def _plan_sharded_residency(residency, batches, k, d, spec: MeshSpec, *,
                             pad_multiple, kernel, dtype, cursor, label,
                             mid_pass_ckpt=False):
@@ -1177,9 +1316,13 @@ def _sharded_stream_loop(
     at chunk boundaries. resident_cost(cache) -> the per-resident-iteration
     comms (reduces, bytes) the counter should book.
 
-    Returns (c, n_iter, start_iter, shift, converged, history, final_acc)
-    where final_acc is one extra pass at the RETURNED centroids (its cost
-    is the fit's reported SSE/objective — parity with streamed_kmeans_fit).
+    Returns (c, n_iter, start_iter, shift, converged, history, final_acc,
+    resident_passes) where final_acc is one extra pass at the RETURNED
+    centroids (its cost is the fit's reported SSE/objective — parity with
+    streamed_kmeans_fit) and resident_passes counts the passes that ran
+    inside the compiled resident chunk loop (the drivers extrapolate
+    per-pass host-side accounting — e.g. assign tile tallies — across
+    them).
     """
     from tdc_tpu.models import resident as resident_lib
     from tdc_tpu.models.streaming import _run_pass
@@ -1238,11 +1381,13 @@ def _sharded_stream_loop(
         if cache is not None:
             break  # iterations 2..N run on-device over the cache
     chunk_fns = None
+    resident_passes = 0
     if cache is not None and make_resident is not None:
         chunk_fns = make_resident(cache)
         cost_ri = resident_cost(cache)
         if n_iter < max_iters and not (tol >= 0 and float(shift) <= tol):
             shift = float(shift)
+            iter_before_resident = n_iter
             c, _, n_iter, shift, converged, history = (
                 resident_lib.run_resident_loop(
                     chunk=chunk_fns[0], cache=cache, c=c, aux=(),
@@ -1253,17 +1398,20 @@ def _sharded_stream_loop(
                     comms_per_iter=cost_ri,
                 )
             )
+            resident_passes += n_iter - iter_before_resident
     shift = float(shift)  # one deferred fetch on the async path
     if chunk_fns is not None:
         final_acc, _ = resident_lib.final_pass(
             chunk_fns[1], c, (), cache, counter=counter,
             comms_per_iter=cost_ri,
         )
+        resident_passes += 1
     else:
         final_acc = full_pass(c)
         if finalize is not None:
             final_acc = finalize(final_acc, c)
-    return c, n_iter, start_iter, shift, converged, history, final_acc
+    return (c, n_iter, start_iter, shift, converged, history, final_acc,
+            resident_passes)
 
 
 def streamed_kmeans_fit_sharded(
@@ -1287,6 +1435,8 @@ def streamed_kmeans_fit_sharded(
     reduce="per_batch",
     residency: str = "stream",
     ingest=None,
+    assign: str = "exact",
+    probe=None,
 ) -> KMeansResult:
     """Exact out-of-core Lloyd under the 2-D (data × model) layout — the
     1B×768, K=16,384 configuration: batches stream host→device, each batch's
@@ -1357,6 +1507,19 @@ def streamed_kmeans_fit_sharded(
     n_data, n_model = spec.n_data, spec.n_model
     if k % n_model != 0:
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
+    from tdc_tpu.ops import subk as subk_lib
+    from tdc_tpu.ops.pallas_kernels import resolve_kernel
+    from tdc_tpu.testing.faults import fault_point
+
+    kernel = resolve_kernel(
+        kernel, k=k // n_model, d=d,
+        itemsize=_stream_kernel_itemsize(batches, dtype),
+        model="kmeans_sharded",
+        label="streamed_kmeans_fit_sharded")
+    # Tiles are per model shard: the coarse plan (and the auto threshold)
+    # see K/Pm local centroids, mirroring where the pruning runs.
+    aspec = subk_lib.resolve_assign(assign, k // n_model, probe=probe,
+                                    label="streamed_kmeans_fit_sharded")
     strategy = reduce_lib.resolve_reduce(reduce)
     deferred, _ = _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
                                allow_quantize=False)
@@ -1449,7 +1612,8 @@ def streamed_kmeans_fit_sharded(
         )
 
     stats_fn = make_sharded_stats(mesh, kernel, block_rows,
-                                  reduce_data=not deferred)
+                                  reduce_data=not deferred,
+                                  assign_spec=aspec)
     r_plan, r_builder = _plan_sharded_residency(
         residency, batches, k, d, spec,
         pad_multiple=pad_multiple, kernel=kernel, dtype=dtype,
@@ -1458,6 +1622,17 @@ def streamed_kmeans_fit_sharded(
     )
     chunk_iters = _chunk_iters_for(ckpt_dir, ckpt_every)
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
+    assign_counter = (
+        subk_lib.AssignCounter(_mirror=subk_lib.GLOBAL_ASSIGN)
+        if aspec.coarse else None
+    )
+
+    def _book_assign(rows_padded: int) -> None:
+        # Every (data, model) shard pair refines its own blocks against
+        # its own tiles: the logical tile tally scales by both axes.
+        probed, total = subk_lib.assign_cost(rows_padded // n_data, aspec)
+        scale = n_data * n_model
+        assign_counter.add(probed * scale, total * scale)
     cost_reduce = (
         reduce_lib.tree_reduce_cost(_lloyd_example(k, d), (DATA_AXIS,))
         if n_data > 1 else (0, 0)
@@ -1485,8 +1660,11 @@ def streamed_kmeans_fit_sharded(
         # donate_argnums: see reduce.make_deferred_fns — the deferred
         # accumulator is n_data× the reduced one; update it in place.
         @partial(jax.jit, donate_argnums=(0,))
-        def accumulate(acc: _ShardedAcc, x, c) -> _ShardedAcc:
-            sums, counts, sse = stats_fn(x, c)
+        def accumulate(acc: _ShardedAcc, x, c, n_valid=None) -> _ShardedAcc:
+            if aspec.coarse:
+                sums, counts, sse = stats_fn(x, c, n_valid)
+            else:
+                sums, counts, sse = stats_fn(x, c)
             return _ShardedAcc(
                 acc.sums + sums, acc.counts + counts, acc.sse + sse
             )
@@ -1498,6 +1676,8 @@ def streamed_kmeans_fit_sharded(
             return _ShardedAcc(sums, counts, sse)
 
         def finalize(acc, c):
+            # Coarse stats mask padding internally — pad_cell stays 0 and
+            # the correction is the identity there.
             n_pad, pad_cell[0] = pad_cell[0], 0.0
             counter.add(*cost_reduce)
             return _finalize_jit(acc, c, jnp.asarray(n_pad, jnp.float32))
@@ -1511,6 +1691,11 @@ def streamed_kmeans_fit_sharded(
             xb, n_valid = sb.xb, sb.n_valid
             if fill is not None:
                 fill.add(xb, n_valid)
+            if aspec.coarse:
+                fault_point("assign.refine")
+                _book_assign(xb.shape[0])
+                return (accumulate(acc, xb, c, jnp.asarray(n_valid)),
+                        sb.n_local)
             pad_cell[0] += xb.shape[0] - n_valid
             return accumulate(acc, xb, c), sb.n_local
 
@@ -1539,9 +1724,13 @@ def streamed_kmeans_fit_sharded(
 
         @jax.jit
         def accumulate(acc: _ShardedAcc, x, c, n_valid) -> _ShardedAcc:
-            sums, counts, sse = stats_fn(x, c)
-            n_pad = x.shape[0] - n_valid
-            counts, sse = padding_correction(counts, sse, c, n_pad)
+            if aspec.coarse:
+                # Padding masked inside the coarse stats — no correction.
+                sums, counts, sse = stats_fn(x, c, n_valid)
+            else:
+                sums, counts, sse = stats_fn(x, c)
+                n_pad = x.shape[0] - n_valid
+                counts, sse = padding_correction(counts, sse, c, n_pad)
             return _ShardedAcc(
                 acc.sums + sums, acc.counts + counts, acc.sse + sse
             )
@@ -1553,6 +1742,9 @@ def streamed_kmeans_fit_sharded(
             if fill is not None:
                 fill.add(xb, n_valid)
             counter.add(*cost_reduce)
+            if aspec.coarse:
+                fault_point("assign.refine")
+                _book_assign(xb.shape[0])
             return accumulate(acc, xb, c, n_valid), sb.n_local
 
         def zero_acc() -> _ShardedAcc:
@@ -1594,16 +1786,20 @@ def streamed_kmeans_fit_sharded(
                 )
 
                 def one(a, xb, wb, nv):
-                    sums, counts, sse = stats_fn(xb, c)
+                    if aspec.coarse:
+                        sums, counts, sse = stats_fn(xb, c, nv)
+                    else:
+                        sums, counts, sse = stats_fn(xb, c)
                     return _ShardedAcc(
                         a.sums + sums, a.counts + counts, a.sse + sse
                     )
 
                 acc = dc.scan_cache(acc, cache_, one, False)
                 sums, counts, sse = _dred(acc.sums, acc.counts, acc.sse)
-                counts, sse = padding_correction(
-                    counts, sse, c, dc.cache_pad_rows(cache_)
-                )
+                if not aspec.coarse:  # coarse masks padding internally
+                    counts, sse = padding_correction(
+                        counts, sse, c, dc.cache_pad_rows(cache_)
+                    )
                 return _ShardedAcc(sums, counts, sse), aux
 
             acc = _ShardedAcc(
@@ -1619,10 +1815,13 @@ def streamed_kmeans_fit_sharded(
             )
 
             def one(a, xb, wb, nv):
-                sums, counts, sse = stats_fn(xb, c)
-                counts, sse = padding_correction(
-                    counts, sse, c, xb.shape[0] - nv
-                )
+                if aspec.coarse:
+                    sums, counts, sse = stats_fn(xb, c, nv)
+                else:
+                    sums, counts, sse = stats_fn(xb, c)
+                    counts, sse = padding_correction(
+                        counts, sse, c, xb.shape[0] - nv
+                    )
                 return _ShardedAcc(
                     a.sums + sums, a.counts + counts, a.sse + sse
                 )
@@ -1657,7 +1856,7 @@ def streamed_kmeans_fit_sharded(
     loop_batches, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     loop_prefetch = prefetch if h2d is None else 0
 
-    c, n_iter, start_iter, shift, converged, history, final_acc = (
+    c, n_iter, start_iter, shift, converged, history, final_acc, res_p = (
         _sharded_stream_loop(
             batches=loop_batches, prefetch=loop_prefetch, ckpt=ckpt,
             ckpt_dir=ckpt_dir,
@@ -1670,6 +1869,15 @@ def streamed_kmeans_fit_sharded(
             mesh=mesh, gang=gang, counter=counter,
         )
     )
+    if assign_counter is not None and res_p:
+        # Resident passes ran inside the compiled chunk loop; every pass
+        # books identical (geometry-only) tile tallies, so extrapolate
+        # from the streamed passes' average (approximate only under a
+        # mid-pass resume, where the first streamed pass was partial).
+        streamed_p = max((n_iter - start_iter) + 1 - res_p, 1)
+        snap = assign_counter.snapshot()
+        assign_counter.add(snap["tiles_probed"] // streamed_p * res_p,
+                           snap["tiles_total"] // streamed_p * res_p)
     sse = float(final_acc.sse)
     return KMeansResult(
         centroids=c,
@@ -1686,6 +1894,8 @@ def streamed_kmeans_fit_sharded(
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
         ingest=guard.report(),
+        assign=(None if assign_counter is None
+                else subk_lib.report(aspec, assign_counter)),
     )
 
 
@@ -1757,6 +1967,12 @@ def streamed_fuzzy_fit_sharded(
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
     if m <= 1.0:
         raise ValueError(f"fuzzifier m must be > 1, got {m}")
+    from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+    kernel = resolve_kernel(kernel, k=k // n_model, d=d,
+                            itemsize=_stream_kernel_itemsize(batches, dtype),
+                            model="fuzzy_sharded",
+                            label="streamed_fuzzy_fit_sharded")
     strategy = reduce_lib.resolve_reduce(reduce)
     deferred, _ = _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
                                allow_quantize=False)
@@ -2028,7 +2244,7 @@ def streamed_fuzzy_fit_sharded(
     loop_batches, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     loop_prefetch = prefetch if h2d is None else 0
 
-    c, n_iter, start_iter, shift, converged, history, final_acc = (
+    c, n_iter, start_iter, shift, converged, history, final_acc, _ = (
         _sharded_stream_loop(
             batches=loop_batches, prefetch=loop_prefetch, ckpt=ckpt,
             ckpt_dir=ckpt_dir,

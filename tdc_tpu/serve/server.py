@@ -430,12 +430,14 @@ class ServeApp:
         """Prometheus text exposition of the request/batch/engine stats."""
         from tdc_tpu.data.ingest import GLOBAL_INGEST
         from tdc_tpu.data.spill import GLOBAL_H2D
+        from tdc_tpu.ops.subk import GLOBAL_ASSIGN
         from tdc_tpu.parallel.reduce import GLOBAL_COMMS
 
         e, b = self.engine.stats, self.batcher.stats
         comms = GLOBAL_COMMS.snapshot()
         h2d = GLOBAL_H2D.snapshot()
         ing = GLOBAL_INGEST.snapshot()
+        asn = GLOBAL_ASSIGN.snapshot()
         lines = [
             "# HELP tdc_serve_requests_total Requests by endpoint and status.",
             "# TYPE tdc_serve_requests_total counter",
@@ -516,6 +518,23 @@ class ServeApp:
             ("tdc_ingest_crc_failures_total", "counter",
              "Quarantines caused by CRC sidecar mismatches "
              "(corrupt-on-disk).", ing["crc_failures"]),
+            # Sub-linear-assignment accounting (ops/subk.py): centroid
+            # tiles scanned vs total across coarse-assignment refine
+            # steps booked by fits running in this process. The pruned
+            # fraction is the FLOP reduction the coarse path bought; a
+            # fraction near 0 on an assign=coarse fit means probe ~
+            # n_tiles and the knobs need retuning (docs/OPERATIONS.md).
+            ("tdc_assign_tiles_probed_total", "counter",
+             "Centroid tiles scanned by coarse-assignment refine steps "
+             "(ops/subk.py).", asn["tiles_probed"]),
+            ("tdc_assign_tiles_total", "counter",
+             "Centroid tiles an exact all-K scan would have touched "
+             "across the same refine steps.", asn["tiles_total"]),
+            ("tdc_assign_pruned_fraction", "gauge",
+             "Fraction of centroid tiles pruned by coarse assignment "
+             "(1 - probed/total; 0 when no coarse fit ran).",
+             round(1.0 - asn["tiles_probed"] / asn["tiles_total"], 6)
+             if asn["tiles_total"] else 0.0),
         ]
         for name, typ, help_, val in scalar:
             lines += [f"# HELP {name} {help_}", f"# TYPE {name} {typ}",

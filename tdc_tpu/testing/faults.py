@@ -53,6 +53,8 @@ Instrumented points (grep fault_point for the live list):
     data.load               dataset open
     resident.chunk          each HBM-resident compiled-chunk boundary
     reshard.redistribute    restoring state saved under a different layout
+    assign.refine           each coarse-assignment tile-pruned refine step
+                            (ops/subk.py via the streamed kmeans drivers)
     online.fold             before folding a window of sampled traffic
     online.validate         before shadow-validating a fold candidate
     online.swap             between staged arrays and the manifest swap
@@ -90,6 +92,7 @@ KNOWN_POINTS = frozenset({
     "data.load",
     "resident.chunk",
     "reshard.redistribute",
+    "assign.refine",
     "online.fold",
     "online.validate",
     "online.swap",

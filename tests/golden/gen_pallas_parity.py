@@ -1,0 +1,115 @@
+"""Regenerate tests/golden/pallas_parity.npz — the pre-refactor parity pins.
+
+The .npz was produced by THIS script running against the pre-refactor
+four-hand-copy kernels (PR 11: the epilogue-parametric refactor), in
+interpret mode on the CPU CI image. tests/test_pallas_parity.py
+assert_array_equal's the refactored kernels against it, which is the proof
+that the refactor changed zero bits of any epilogue's output.
+
+Only rerun this if the GOLDEN CONTRACT itself must change (new jax image
+with different CPU fp semantics, new cases added) — rerunning it against
+already-refactored kernels and committing the result would turn the pin
+into a tautology, so say so in the PR when you do.
+
+  JAX_PLATFORMS=cpu python tests/golden/gen_pallas_parity.py
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+import numpy as np  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "pallas_parity.npz")
+
+# (name, n, d, k, dtype, extra) — ragged n (not a block_n multiple) is on
+# purpose: the zero-row padding corrections are part of each wrapper's
+# contract and must survive the refactor bit-for-bit too.
+CASES = [
+    ("lloyd_f32", 300, 40, 24, np.float32, {}),
+    ("lloyd_bf16", 260, 33, 16, "bfloat16", {}),
+    ("lloyd_w_f32", 300, 40, 24, np.float32, {"weighted": True}),
+    ("lloyd_w_bf16", 260, 33, 16, "bfloat16", {"weighted": True}),
+    ("fuzzy_f32", 260, 33, 16, np.float32, {"m": 2.0}),
+    ("fuzzy_bf16", 196, 17, 8, "bfloat16", {"m": 1.7}),
+    ("gmm_f32", 300, 24, 12, np.float32, {"gmm": True}),
+]
+BLOCK_N = 128
+HALVES = 2  # exercises the sub-block interleave path
+
+
+def _inputs(name, n, d, k, dtype, rng):
+    import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
+
+    x = rng.normal(0.0, 2.0, size=(n, d)).astype(np.float32)
+    c = rng.normal(0.0, 2.0, size=(k, d)).astype(np.float32)
+    x = x.astype(np.dtype(dtype))
+    w = rng.uniform(0.1, 3.0, size=(n,)).astype(np.float32)
+    return x, c, w
+
+
+def main():
+    import jax.numpy as jnp
+
+    from tdc_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(20260804)
+    out = {}
+    for name, n, d, k, dtype, extra in CASES:
+        x, c, w = _inputs(name, n, d, k, dtype, rng)
+        out[f"{name}__x"] = np.asarray(x, np.float32)  # inputs pinned too
+        out[f"{name}__c"] = c
+        if extra.get("gmm"):
+            var = rng.uniform(0.5, 2.0, size=(k, d)).astype(np.float32)
+            wt = rng.uniform(0.2, 1.0, size=(k,)).astype(np.float32)
+            wt /= wt.sum()
+            out[f"{name}__var"] = var
+            out[f"{name}__wt"] = wt
+            ll, nk, sx, sxx = pk.gmm_stats_fused(
+                jnp.asarray(x), jnp.asarray(c), jnp.asarray(var),
+                jnp.asarray(wt), block_n=BLOCK_N,
+            )
+            out[f"{name}__ll"] = np.asarray(ll)
+            out[f"{name}__nk"] = np.asarray(nk)
+            out[f"{name}__sx"] = np.asarray(sx)
+            out[f"{name}__sxx"] = np.asarray(sxx)
+        elif "m" in extra:
+            fs = pk.fuzzy_stats_fused(
+                jnp.asarray(x), jnp.asarray(c), m=extra["m"],
+                block_n=BLOCK_N, halves=HALVES,
+            )
+            out[f"{name}__wsums"] = np.asarray(fs.weighted_sums)
+            out[f"{name}__weights"] = np.asarray(fs.weights)
+            out[f"{name}__obj"] = np.asarray(fs.objective)
+        elif extra.get("weighted"):
+            out[f"{name}__w"] = w
+            s = pk.lloyd_stats_fused_weighted(
+                jnp.asarray(x), jnp.asarray(c), jnp.asarray(w),
+                block_n=BLOCK_N, halves=HALVES,
+            )
+            out[f"{name}__sums"] = np.asarray(s.sums)
+            out[f"{name}__counts"] = np.asarray(s.counts)
+            out[f"{name}__sse"] = np.asarray(s.sse)
+        else:
+            s = pk.lloyd_stats_fused(
+                jnp.asarray(x), jnp.asarray(c), block_n=BLOCK_N,
+                halves=HALVES,
+            )
+            out[f"{name}__sums"] = np.asarray(s.sums)
+            out[f"{name}__counts"] = np.asarray(s.counts)
+            out[f"{name}__sse"] = np.asarray(s.sse)
+        print(f"golden: {name} done")
+    np.savez_compressed(OUT, **out)
+    print(f"wrote {OUT} ({len(out)} arrays)")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ KNOWN_POINTS = frozenset({
     "data.read.transient",
     "data.read.permanent",
     "data.corrupt",
+    "assign.refine",
 })
 
 
@@ -45,6 +46,10 @@ def guarded_read():
         fault_point("data.read.transient")
         fault_point("data.read.permanent")
         return
+
+
+def pruned_refine_step():
+    fault_point("assign.refine")
 
 
 def integrity_screen():

@@ -67,6 +67,38 @@ def test_cli_end_to_end_fuzzy(tmp_path):
     assert row["status"] == "ok"
 
 
+def test_cli_coarse_assign_end_to_end(tmp_path):
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=8192 --n_dim=8 --K=64 --n_max_iters=4 --seed=1 "
+        f"--streamed --num_batches=4 --assign=coarse --probe=4 "
+        f"--log_file={log} --n_GPUs=1".split()
+    )
+    assert rc == 0
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["status"] == "ok"
+
+
+@pytest.mark.parametrize("argstr,msg", [
+    ("--n_obs=100 --n_dim=4 --K=8 --assign=coarse", "streamed"),
+    ("--n_obs=100 --n_dim=4 --K=8 --streamed --assign=coarse "
+     "--method_name=distributedFuzzyCMeans", "distributedKMeans"),
+    ("--n_obs=100 --n_dim=4 --K=8 --probe=4", "--assign"),
+    ("--n_obs=100 --n_dim=4 --K=8 --streamed --assign=coarse "
+     "--kernel=pallas", "tile-pruned"),
+    ("--n_obs=100 --n_dim=4 --K=8 --streamed --assign=coarse "
+     "--probe=junk", "integer"),
+    ("--n_obs=100 --n_dim=4 --K=8 --streamed --assign=coarse "
+     "--minibatch", "exact streamed driver"),
+])
+def test_cli_assign_knob_validation(argstr, msg, capsys):
+    p = build_parser()
+    args = p.parse_args(argstr.split())
+    with pytest.raises(SystemExit):
+        validate_args(p, args)
+    assert msg in capsys.readouterr().err
+
+
 def test_cli_multidevice(tmp_path):
     log = str(tmp_path / "log.csv")
     rc = cli_main(

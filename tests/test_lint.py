@@ -467,6 +467,8 @@ def test_fault_points_match_registry():
         "stream.batch", "supervisor.spawn", "supervisor.resize",
         "serve.dispatch", "data.load", "resident.chunk",
         "reshard.redistribute",
+        # PR-11 sub-linear assignment (ops/subk.py refine steps)
+        "assign.refine",
         # PR-7 online-update pipeline (serve/online.py)
         "online.fold", "online.validate", "online.swap", "online.rollback",
         # PR-10 hardened ingest (data/ingest.py)

@@ -1,0 +1,498 @@
+"""Sub-linear (coarse→refine) assignment: ops/subk.py + driver wiring.
+
+Covers the PR-11 tentpole contracts:
+- resolve_assign knob semantics (exact passthrough, auto threshold,
+  probe='all'/probe>=n_tiles routing to the exact path).
+- build_plan invariants (every centroid packed exactly once; pad slots
+  sentinel; cell map consistent).
+- champion agreement + internal n_valid masking (no padding-correction
+  dependence).
+- driver wiring: probe=all fits are fp32-bit-exact with assign='exact'
+  across the 1-D streamed, K-sharded streamed, and in-memory sharded
+  drivers; coarse fits hold the documented inertia-loss bound on the
+  hierarchical-blobs config; composition with residency='hbm' (bit-exact
+  with coarse streaming), reduce='per_pass' (1 reduce/pass), and the
+  ingest quarantine (zero mass, no schedule change).
+- AssignReport / tdc_assign_* accounting and the kernel='auto' policy.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tdc_tpu.data.device_cache import SizedBatches
+from tdc_tpu.models.streaming import streamed_kmeans_fit
+from tdc_tpu.ops import subk
+from tdc_tpu.ops.assign import lloyd_stats
+
+
+def hier_data(k, d, n, seed=0, fan=16, sub_sigma=1.0, noise=0.2):
+    rng = np.random.default_rng(seed)
+    n_super = max(1, k // fan)
+    supers = rng.uniform(-10, 10, size=(n_super, d)).astype(np.float32)
+    centers = (np.repeat(supers, k // n_super, axis=0)
+               + rng.normal(0, sub_sigma, size=(k, d))).astype(np.float32)
+    x = np.repeat(centers, n // k, axis=0) + rng.normal(
+        0, noise, size=(n // k * k, d)
+    ).astype(np.float32)
+    rng.shuffle(x)
+    return x, centers
+
+
+def batches_of(x, rows):
+    return SizedBatches(
+        lambda: (x[i: i + rows] for i in range(0, len(x), rows)),
+        len(x), rows,
+    )
+
+
+# ---------------------------------------------------------------------------
+# resolve_assign / spec
+# ---------------------------------------------------------------------------
+
+
+class TestResolveAssign:
+    def test_exact_passthrough(self):
+        assert subk.resolve_assign("exact", 10_000) == subk.EXACT
+
+    def test_exact_rejects_probe(self):
+        with pytest.raises(ValueError, match="probe"):
+            subk.resolve_assign("exact", 10_000, probe=4)
+
+    def test_unknown_mode(self):
+        with pytest.raises(ValueError, match="assign"):
+            subk.resolve_assign("fuzzy", 1024)
+
+    def test_auto_below_threshold_is_exact(self):
+        assert not subk.resolve_assign("auto", subk.AUTO_MIN_K - 1).coarse
+
+    def test_auto_at_threshold_is_coarse(self):
+        spec = subk.resolve_assign("auto", subk.AUTO_MIN_K)
+        assert spec.coarse
+        assert spec.n_tiles == subk.default_tiles(subk.AUTO_MIN_K)
+
+    def test_probe_all_routes_to_exact(self):
+        assert not subk.resolve_assign("coarse", 4096, probe="all").coarse
+
+    def test_probe_ge_tiles_routes_to_exact(self):
+        t = subk.default_tiles(4096)
+        assert not subk.resolve_assign("coarse", 4096, probe=t).coarse
+
+    def test_probe_validation(self):
+        with pytest.raises(ValueError, match="probe"):
+            subk.resolve_assign("coarse", 4096, probe=0)
+
+    def test_default_probe_is_sqrt_tiles(self):
+        spec = subk.resolve_assign("coarse", 16384)
+        assert spec.n_tiles == 128 and spec.tile_size == 128
+        assert spec.probe == round(np.sqrt(128))
+
+    def test_default_tiles_power_of_two_sqrt(self):
+        assert subk.default_tiles(4096) == 64
+        assert subk.default_tiles(16384) == 128
+        assert subk.default_tiles(1) == 1
+
+    def test_spec_hashable(self):
+        # CoarseSpec rides lru_cache keys and jit static closures.
+        spec = subk.resolve_assign("coarse", 4096, probe=4)
+        hash(spec)
+
+
+# ---------------------------------------------------------------------------
+# plan + champions
+# ---------------------------------------------------------------------------
+
+
+class TestPlanAndChampions:
+    def test_plan_packs_every_centroid_once(self):
+        _, centers = hier_data(96, 8, 96)
+        spec = subk.CoarseSpec(mode="coarse", n_tiles=8, tile_size=12,
+                               probe=3, block_rows=128)
+        plan = subk.build_plan(jnp.asarray(centers), spec)
+        ids = np.asarray(plan.ids).ravel()
+        real = ids[ids >= 0]
+        assert sorted(real.tolist()) == list(range(96))
+        # pad slots carry -1 ids and far rows
+        assert (np.asarray(plan.tiles)[np.asarray(plan.ids) < 0] >= 1e14).all()
+        # slot_cell sentinel on pads, valid cell elsewhere
+        sc = np.asarray(plan.slot_cell)
+        assert (sc[np.asarray(plan.ids) < 0] == spec.n_tiles).all()
+        assert (sc[np.asarray(plan.ids) >= 0] < spec.n_tiles).all()
+
+    def test_champion_agreement_on_structured_codebook(self):
+        x, centers = hier_data(512, 16, 16384, fan=32)
+        spec = subk.resolve_assign("coarse", 512, probe=6)
+        xj, cj = jnp.asarray(x), jnp.asarray(centers)
+        plan = subk.build_plan(cj, spec)
+        lab, _ = subk.coarse_champions(xj, plan, len(x), spec)
+        lab_e = np.asarray(jnp.argmin(
+            jnp.sum(cj * cj, 1)[None, :] - 2 * xj @ cj.T, axis=1))
+        assert float(np.mean(np.asarray(lab) == lab_e)) >= 0.999
+
+    def test_n_valid_masks_pad_rows(self):
+        x, centers = hier_data(64, 8, 1024)
+        spec = subk.CoarseSpec(mode="coarse", n_tiles=8, tile_size=8,
+                               probe=3, block_rows=128)
+        xp = np.concatenate([x[:500], np.zeros((36, 8), np.float32)])
+        plan = subk.build_plan(jnp.asarray(centers), spec)
+        lab, mind = subk.coarse_champions(jnp.asarray(xp), plan, 500, spec)
+        lab, mind = np.asarray(lab), np.asarray(mind)
+        assert (lab[500:] == subk.ARG_SENTINEL).all()
+        assert (mind[500:] == 0.0).all()
+        assert (lab[:500] < 64).all()
+
+    def test_stats_mask_parity_and_mass(self):
+        x, centers = hier_data(64, 8, 1024)
+        spec = subk.CoarseSpec(mode="coarse", n_tiles=8, tile_size=8,
+                               probe=3, block_rows=128)
+        xp = np.concatenate([x[:500], np.zeros((36, 8), np.float32)])
+        s_pad = subk.lloyd_stats_subk(jnp.asarray(xp), jnp.asarray(centers),
+                                      spec, n_valid=500)
+        s_raw = subk.lloyd_stats_subk(jnp.asarray(x[:500]),
+                                      jnp.asarray(centers), spec)
+        assert float(jnp.sum(s_pad.counts)) == 500.0
+        np.testing.assert_allclose(np.asarray(s_pad.sums),
+                                   np.asarray(s_raw.sums), rtol=1e-6)
+        np.testing.assert_allclose(float(s_pad.sse), float(s_raw.sse),
+                                   rtol=1e-5)
+
+    def test_stats_match_exact_when_probing_everything(self):
+        # Not the probe='all' shortcut: a genuine coarse pass whose probe
+        # covers all but one tile still agrees with exact stats on
+        # well-separated data (the quality mechanism, not the escape
+        # hatch).
+        x, centers = hier_data(64, 8, 4096, fan=8)
+        spec = subk.CoarseSpec(mode="coarse", n_tiles=8, tile_size=8,
+                               probe=7, block_rows=256)
+        s_c = subk.lloyd_stats_subk(jnp.asarray(x), jnp.asarray(centers),
+                                    spec)
+        s_e = lloyd_stats(jnp.asarray(x), jnp.asarray(centers))
+        np.testing.assert_allclose(np.asarray(s_c.counts),
+                                   np.asarray(s_e.counts))
+        np.testing.assert_allclose(float(s_c.sse), float(s_e.sse),
+                                   rtol=1e-4)
+
+    def test_effective_block_tracks_cell_share(self):
+        spec = subk.CoarseSpec(mode="coarse", n_tiles=16, tile_size=16,
+                               probe=4, block_rows=1024)
+        assert subk.effective_block(16384, spec) == 1024
+        assert subk.effective_block(2048, spec) == 128
+        assert subk.effective_block(100, spec) == 128
+
+    def test_assign_cost_counts_blocks(self):
+        spec = subk.CoarseSpec(mode="coarse", n_tiles=16, tile_size=16,
+                               probe=4, block_rows=1024)
+        probed, total = subk.assign_cost(2048, spec)
+        assert (probed, total) == (16 * 4, 16 * 16)
+        assert subk.assign_cost(2048, subk.EXACT) == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# 1-D streamed driver
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def blobs256():
+    return hier_data(256, 16, 16384, seed=3)
+
+
+class TestStreamedDriver:
+    def test_probe_all_bit_exact(self, blobs256):
+        x, centers = blobs256
+        kw = dict(init=centers, max_iters=3, tol=-1.0)
+        r_ex = streamed_kmeans_fit(batches_of(x, 2048), 256, 16, **kw)
+        r_all = streamed_kmeans_fit(batches_of(x, 2048), 256, 16,
+                                    assign="coarse", probe="all", **kw)
+        np.testing.assert_array_equal(np.asarray(r_all.centroids),
+                                      np.asarray(r_ex.centroids))
+        assert r_all.assign is None  # routed to exact
+
+    def test_coarse_quality_and_report(self, blobs256):
+        x, centers = blobs256
+        kw = dict(init=centers, max_iters=3, tol=-1.0)
+        r_ex = streamed_kmeans_fit(batches_of(x, 2048), 256, 16, **kw)
+        r_co = streamed_kmeans_fit(batches_of(x, 2048), 256, 16,
+                                   assign="coarse", probe=6, **kw)
+        rel = (float(r_co.sse) - float(r_ex.sse)) / float(r_ex.sse)
+        assert rel <= 1e-2
+        rep = r_co.assign
+        assert rep.mode == "coarse" and rep.probe == 6
+        assert rep.tiles_probed > 0
+        assert 0.5 <= rep.pruned_fraction < 1.0
+
+    def test_coarse_mirrors_global_counter(self, blobs256):
+        x, centers = blobs256
+        subk.GLOBAL_ASSIGN.reset()
+        r = streamed_kmeans_fit(batches_of(x, 2048), 256, 16, init=centers,
+                                max_iters=2, tol=-1.0, assign="coarse",
+                                probe=6)
+        snap = subk.GLOBAL_ASSIGN.snapshot()
+        assert snap["tiles_probed"] == r.assign.tiles_probed
+        assert snap["tiles_total"] == r.assign.tiles_total
+
+    def test_hbm_residency_bit_exact_with_coarse_stream(self, blobs256):
+        x, centers = blobs256
+        kw = dict(init=centers, max_iters=3, tol=-1.0, assign="coarse",
+                  probe=6)
+        r_s = streamed_kmeans_fit(batches_of(x, 2048), 256, 16, **kw)
+        r_h = streamed_kmeans_fit(batches_of(x, 2048), 256, 16,
+                                  residency="hbm", **kw)
+        np.testing.assert_array_equal(np.asarray(r_h.centroids),
+                                      np.asarray(r_s.centroids))
+        # the resident passes are booked by extrapolation
+        assert r_h.assign.tiles_total == r_s.assign.tiles_total
+
+    def test_auto_kernel_composes_with_coarse(self, blobs256):
+        # kernel='auto' + assign='coarse' must NOT trip the explicit-
+        # pallas guard: the coarse verdict is an auto-ineligibility
+        # reason, not a user error (resolve order: assign first).
+        x, centers = blobs256
+        r = streamed_kmeans_fit(batches_of(x, 4096), 256, 16, init=centers,
+                                max_iters=2, tol=-1.0, kernel="auto",
+                                assign="coarse", probe=6)
+        assert r.assign.mode == "coarse"
+
+    def test_plan_for_matches_in_trace_build(self, blobs256):
+        # The per-pass hoisted plan is bitwise-identical to the in-trace
+        # rebuild (the resident chunk path) — build_plan is deterministic
+        # in the centroids.
+        _, centers = blobs256
+        spec = subk.resolve_assign("coarse", 256, probe=6)
+        cj = jnp.asarray(centers)
+        hoisted = subk.plan_for(cj, spec)
+        inline = subk.build_plan(cj, spec)
+        for a, b in zip(hoisted, inline):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_coarse_refuses_weights(self, blobs256):
+        x, centers = blobs256
+        w = np.ones(len(x), np.float32)
+        with pytest.raises(ValueError, match="sample_weight"):
+            streamed_kmeans_fit(
+                batches_of(x, 2048), 256, 16, init=centers, max_iters=1,
+                assign="coarse",
+                sample_weight_batches=lambda: (w[i: i + 2048]
+                                               for i in range(0, len(x),
+                                                              2048)),
+            )
+
+    def test_coarse_refuses_pallas(self, blobs256):
+        x, centers = blobs256
+        with pytest.raises(ValueError, match="pallas"):
+            streamed_kmeans_fit(batches_of(x, 2048), 256, 16, init=centers,
+                                max_iters=1, assign="coarse",
+                                kernel="pallas")
+
+    def test_coarse_refuses_multidevice_per_pass(self, blobs256):
+        from tdc_tpu.parallel.mesh import make_mesh
+
+        x, centers = blobs256
+        with pytest.raises(ValueError, match="per_pass"):
+            streamed_kmeans_fit(batches_of(x, 2048), 256, 16, init=centers,
+                                max_iters=1, assign="coarse",
+                                reduce="per_pass", mesh=make_mesh(8))
+
+    def test_quarantine_composes_zero_mass(self, blobs256, tmp_path):
+        from tdc_tpu.data.ingest import IngestPolicy
+
+        x, centers = blobs256
+        xq = x.copy()
+        xq[2048:2055] = np.nan
+        r = streamed_kmeans_fit(
+            batches_of(xq, 2048), 256, 16, init=centers, max_iters=2,
+            tol=-1.0, assign="coarse", probe=6,
+            ingest=IngestPolicy(max_bad_fraction=0.5),
+        )
+        assert r.ingest.quarantined_batches == 1
+        assert np.isfinite(float(r.sse))
+        assert np.isfinite(np.asarray(r.centroids)).all()
+
+
+# ---------------------------------------------------------------------------
+# K-sharded drivers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    from tdc_tpu.parallel.sharded_k import make_mesh_2d
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh_2d(4, 2)
+
+
+class TestShardedDriver:
+    def test_probe_all_bit_exact(self, blobs256, mesh2d):
+        from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+        x, centers = blobs256
+        kw = dict(init=centers, max_iters=3, tol=-1.0)
+        r_ex = streamed_kmeans_fit_sharded(
+            lambda: iter([x[:8192], x[8192:]]), 256, 16, mesh2d, **kw)
+        r_all = streamed_kmeans_fit_sharded(
+            lambda: iter([x[:8192], x[8192:]]), 256, 16, mesh2d,
+            assign="coarse", probe="all", **kw)
+        np.testing.assert_array_equal(np.asarray(r_all.centroids),
+                                      np.asarray(r_ex.centroids))
+
+    def test_coarse_quality_and_per_pass_compose(self, blobs256, mesh2d):
+        from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+        x, centers = blobs256
+        kw = dict(init=centers, max_iters=3, tol=-1.0, assign="coarse",
+                  probe=6)
+        r_ex = streamed_kmeans_fit_sharded(
+            lambda: iter([x[:8192], x[8192:]]), 256, 16, mesh2d,
+            init=centers, max_iters=3, tol=-1.0)
+        r_co = streamed_kmeans_fit_sharded(
+            lambda: iter([x[:8192], x[8192:]]), 256, 16, mesh2d, **kw)
+        rel = (float(r_co.sse) - float(r_ex.sse)) / float(r_ex.sse)
+        assert rel <= 1e-2
+        assert r_co.assign.mode == "coarse"
+        assert r_co.assign.pruned_fraction > 0.4
+        r_pp = streamed_kmeans_fit_sharded(
+            lambda: iter([x[:8192], x[8192:]]), 256, 16, mesh2d,
+            reduce="per_pass", **kw)
+        assert r_pp.comms.reduces_per_pass == 1.0
+        np.testing.assert_allclose(float(r_pp.sse), float(r_co.sse),
+                                   rtol=1e-5)
+
+    def test_in_memory_sharded_coarse(self, blobs256, mesh2d):
+        from tdc_tpu.parallel.sharded_k import kmeans_fit_sharded
+
+        x, centers = blobs256
+        r_ex = kmeans_fit_sharded(x, 256, mesh2d, init=centers,
+                                  max_iters=3, tol=-1.0)
+        r_co = kmeans_fit_sharded(x, 256, mesh2d, init=centers,
+                                  max_iters=3, tol=-1.0, assign="coarse",
+                                  probe=6)
+        rel = (float(r_co.sse) - float(r_ex.sse)) / float(r_ex.sse)
+        assert rel <= 1e-2
+        # the in-memory driver books its (post-hoc, geometry-only) tile
+        # tallies too — the OPERATIONS triage flow reads result.assign
+        assert r_co.assign is not None and r_co.assign.mode == "coarse"
+        assert r_co.assign.tiles_probed > 0
+        assert r_ex.assign is None
+
+    def test_sharded_ragged_tail_masked(self, blobs256, mesh2d):
+        # A ragged final batch forces zero-padding; coarse masks it
+        # internally — counts must total the REAL rows.
+        from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+        x, centers = blobs256
+        xr = x[:10_000]  # not a multiple of n_data=4
+        r = streamed_kmeans_fit_sharded(
+            lambda: iter([xr[:4096], xr[4096:]]), 256, 16, mesh2d,
+            init=centers, max_iters=1, tol=-1.0, assign="coarse", probe=6)
+        assert np.isfinite(float(r.sse))
+        assert np.isfinite(np.asarray(r.centroids)).all()
+
+
+# ---------------------------------------------------------------------------
+# kernel='auto' policy
+# ---------------------------------------------------------------------------
+
+
+class TestKernelAuto:
+    def test_explicit_kernels_pass_through(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        for k in ("xla", "pallas", "refined", "tall"):
+            assert resolve_kernel(k, k=64, d=8) == k
+
+    def test_auto_on_cpu_is_xla(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto", k=64, d=8) == "xla"
+
+    def test_auto_on_tpu_fused_feasible_is_pallas(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto", k=1024, d=128, itemsize=2,
+                              platform="tpu") == "pallas"
+
+    def test_auto_on_tpu_over_vmem_is_xla(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        # K=16384 x d=768: the fused (K, d) accumulator cannot fit VMEM.
+        assert resolve_kernel("auto", k=16384, d=768, itemsize=2,
+                              platform="tpu") == "xla"
+
+    def test_auto_sharded_lloyd_always_pallas_on_tpu(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto", k=16384, d=768, itemsize=2,
+                              model="kmeans_sharded",
+                              platform="tpu") == "pallas"
+
+    def test_auto_ineligible_forces_xla(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto", k=1024, d=128, platform="tpu",
+                              ineligible="no weighted tower") == "xla"
+
+    def test_auto_gmm_uses_gmm_predicate(self):
+        from tdc_tpu.ops.pallas_kernels import gmm_block_n, resolve_kernel
+
+        assert gmm_block_n(256, 32) > 0
+        assert resolve_kernel("auto", k=256, d=32, model="gmm",
+                              platform="tpu") == "pallas"
+
+    def test_auto_unknown_model_rejected(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        with pytest.raises(ValueError, match="model"):
+            resolve_kernel("auto", k=64, d=8, model="nope", platform="tpu")
+
+    def test_streamed_fit_accepts_auto(self, blobs256):
+        x, centers = blobs256
+        r_auto = streamed_kmeans_fit(batches_of(x, 4096), 256, 16,
+                                     init=centers, max_iters=2, tol=-1.0,
+                                     kernel="auto")
+        r_xla = streamed_kmeans_fit(batches_of(x, 4096), 256, 16,
+                                    init=centers, max_iters=2, tol=-1.0,
+                                    kernel="xla")
+        # on the CPU CI auto resolves to xla — bit-identical
+        np.testing.assert_array_equal(np.asarray(r_auto.centroids),
+                                      np.asarray(r_xla.centroids))
+
+    def test_kmeans_fit_accepts_auto(self, blobs256):
+        from tdc_tpu.models.kmeans import kmeans_fit
+
+        x, centers = blobs256
+        r = kmeans_fit(x[:4096], 16, init="first_k", max_iters=3,
+                       kernel="auto")
+        assert np.isfinite(float(r.sse))
+
+
+# ---------------------------------------------------------------------------
+# metrics surface
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_surface_names():
+    """The /metrics text carries the tdc_assign_* family off
+    GLOBAL_ASSIGN (the CommsCounter pattern) — pin the names and the
+    pruned-fraction math without spinning a server."""
+    subk.GLOBAL_ASSIGN.reset()
+    subk.GLOBAL_ASSIGN.add(25, 100)
+    snap = subk.GLOBAL_ASSIGN.snapshot()
+    assert snap == {"tiles_probed": 25, "tiles_total": 100}
+    rep = subk.report(
+        subk.CoarseSpec(mode="coarse", n_tiles=8, tile_size=8, probe=2,
+                        block_rows=128),
+        subk.GLOBAL_ASSIGN,
+    )
+    assert rep.pruned_fraction == pytest.approx(0.75)
+    import inspect
+
+    from tdc_tpu.serve import server
+
+    src = inspect.getsource(server)
+    for name in ("tdc_assign_tiles_probed_total", "tdc_assign_tiles_total",
+                 "tdc_assign_pruned_fraction"):
+        assert name in src
+    subk.GLOBAL_ASSIGN.reset()
