@@ -135,6 +135,25 @@ def main(argv):
     n_dev = len(jax.devices())
     mesh = make_mesh(min(8, n_dev))
 
+    if "--trace" in argv:
+        # Phase attribution for the iteration-differencing numbers: run
+        # the dispatch-dominated config ONCE traced and print the
+        # per-pass timeline (read/stage/compute/reduce self-times from
+        # the same spans the Chrome export carries) — where the
+        # marginal streamed iteration actually goes.
+        from tdc_tpu.obs import trace
+
+        at = argv.index("--trace")
+        if at + 1 >= len(argv) or argv[at + 1].startswith("-"):
+            print("usage: bench_resident.py --trace <dir>", file=sys.stderr)
+            return 2
+        trace.configure(argv[at + 1])
+        x, centers = _data(16384, 16, 16)
+        _, res = _fit(x, centers, 16, 16, 128, 4, None, "stream")
+        print(trace.format_timeline(res.timeline, label="stream k16 d16"))
+        print(f"trace written: {trace.flush()}", flush=True)
+        return 0
+
     if smoke:
         # Dispatch-dominated sizing: 128 small batches per pass, trivial
         # stats compute — the marginal streamed iteration is almost pure

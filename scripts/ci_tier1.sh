@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # Tier-1 verify, one command (ROADMAP.md "Tier-1 verify"): the CPU-mesh
 # test suite (8 virtual devices via tests/conftest.py) minus slow-marked
-# tests, the comms + resident + spill + chaos smokes, and the tdclint
-# static-analysis gate. The suite-green invariant every PR must hold.
+# tests, the comms + resident + spill + subk + obs + chaos smokes, and
+# the tdclint static-analysis gate. The suite-green invariant every PR
+# must hold.
 #
 #   scripts/ci_tier1.sh            # tests + smokes + lint
 #   SKIP_LINT=1 scripts/ci_tier1.sh
@@ -81,6 +82,18 @@ if [ -z "$SKIP_SUBK_SMOKE" ]; then
         | tail -n 1 || subk_rc=$?
 fi
 
+# Observability smoke (scripts/obs_smoke.py): a tiny traced 2-process
+# gloo-gang streamed fit must export valid Chrome-trace JSON per process
+# (spans nested, per-pass read/stage/compute/reduce phases present) and
+# merge_trace must render one well-formed merged timeline with both
+# processes on pass_boundary-aligned tracks. ~40 s (two jax imports).
+obs_rc=0
+if [ -z "$SKIP_OBS_SMOKE" ]; then
+    timeout -k 10 300 \
+        python scripts/obs_smoke.py \
+        | tail -n 1 || obs_rc=$?
+fi
+
 # Chaos smoke (tests/test_chaos.py soak): 1 kill -9 + 1 preemption SIGTERM
 # injected via TDC_FAULTS into the 2-process gloo gang (recover both,
 # refund the SIGTERM restart, match the fault-free fit), the resident-fit
@@ -130,7 +143,8 @@ fi
 overall=0
 for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
              "resident-smoke:$resident_rc" "spill-smoke:$spill_rc" \
-             "subk-smoke:$subk_rc" "chaos-smoke:$chaos_rc" \
+             "subk-smoke:$subk_rc" "obs-smoke:$obs_rc" \
+             "chaos-smoke:$chaos_rc" \
              "tdclint:$lint_rc" "ruff:$ruff_rc"; do
     name=${stage%%:*}
     rc=${stage##*:}
@@ -140,6 +154,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, obs-smoke, chaos-smoke, lint)" >&2
 fi
 exit "$overall"

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""obs-smoke (tier-1 stage): a tiny TRACED 2-process gloo-gang streamed
+fit must export one valid Chrome-trace JSON per process (parseable,
+spans correctly nested, the per-pass read/stage/compute/reduce phases
+present, pass_boundary anchors emitted), and
+`python -m tdc_tpu.obs.merge_trace` must render ONE well-formed merged
+timeline with both processes on aligned tracks.
+
+Run:  python scripts/obs_smoke.py            # parent: spawn + validate
+      python scripts/obs_smoke.py --worker … # internal (spawned)
+
+Prints exactly one final PASS/FAIL line (the ci_tier1.sh contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def worker(port: str, pid: int, nproc: int, trace_dir: str) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["TDC_TRACE"] = trace_dir
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from tdc_tpu.obs import trace
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+    from tdc_tpu.parallel.multihost import global_mesh, initialize_distributed
+
+    initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
+    assert trace.enabled(), "TDC_TRACE did not enable tracing"
+    mesh = global_mesh()
+    # Identical init everywhere; per-host local slices with equal rows.
+    rng = np.random.default_rng(0)
+    init = rng.normal(size=(4, 8)).astype(np.float32)
+    local = np.random.default_rng(100 + pid).normal(
+        size=(480, 8)
+    ).astype(np.float32)
+    batches = lambda: iter(np.split(local, 4))  # noqa: E731
+    res = streamed_kmeans_fit(
+        batches, 4, 8, init=init, max_iters=3, tol=-1.0, mesh=mesh,
+        reduce="per_pass",
+    )
+    assert res.timeline, "traced fit returned no timeline"
+    path = trace.flush()
+    print(f"WORKER_OK {pid} {path}", flush=True)
+
+
+def _assert_nested(doc: dict, label: str) -> None:
+    by_track: dict[tuple, list] = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "X":
+            by_track.setdefault((e["pid"], e["tid"]), []).append(
+                (float(e["ts"]), float(e["ts"]) + float(e["dur"]))
+            )
+    eps = 1e-2
+    for track, spans in by_track.items():
+        spans.sort()
+        for a in spans:
+            for b in spans:
+                if a == b:
+                    continue
+                disjoint = b[0] >= a[1] - eps or b[1] <= a[0] + eps
+                contained = (
+                    (b[0] >= a[0] - eps and b[1] <= a[1] + eps)
+                    or (a[0] >= b[0] - eps and a[1] <= b[1] + eps)
+                )
+                assert disjoint or contained, (
+                    f"{label}: overlapping non-nested spans on {track}: "
+                    f"{a} vs {b}"
+                )
+
+
+def parent() -> int:
+    import tempfile
+
+    trace_dir = tempfile.mkdtemp(prefix="tdc_obs_smoke_")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "TDC_TRACE")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             str(port), str(i), "2", trace_dir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"WORKER_OK {i}" not in out:
+            print(out[-3000:], file=sys.stderr)
+            print(f"obs-smoke: FAIL (worker {i} rc={p.returncode})")
+            return 1
+
+    files = sorted(f for f in os.listdir(trace_dir)
+                   if f.startswith("trace_") and f.endswith(".json"))
+    if len(files) != 2:
+        print(f"obs-smoke: FAIL (expected 2 trace exports, got {files})")
+        return 1
+    want_spans = {"pass", "read", "stage", "compute", "reduce",
+                  "pass_boundary"}
+    for fn in files:
+        doc = json.load(open(os.path.join(trace_dir, fn)))
+        if not isinstance(doc.get("traceEvents"), list):
+            print(f"obs-smoke: FAIL ({fn}: not Chrome trace JSON)")
+            return 1
+        names = {e["name"] for e in doc["traceEvents"]}
+        missing = want_spans - names
+        if missing:
+            print(f"obs-smoke: FAIL ({fn}: missing spans {sorted(missing)})")
+            return 1
+        _assert_nested(doc, fn)
+
+    merged_path = os.path.join(trace_dir, "merged.json")
+    from tdc_tpu.obs import merge_trace
+
+    rc = merge_trace.main([trace_dir, "--out", merged_path])
+    if rc != 0:
+        print(f"obs-smoke: FAIL (merge_trace exit {rc})")
+        return 1
+    merged = json.load(open(merged_path))
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    anchors: dict[int, dict[int, float]] = {}
+    for e in merged["traceEvents"]:
+        if e.get("name") == "pass_boundary":
+            anchors.setdefault(e["pid"], {})[e["args"]["pass"]] = e["ts"]
+    if len(pids) != 2 or len(anchors) != 2:
+        print(f"obs-smoke: FAIL (merged tracks: pids={pids})")
+        return 1
+    if merged["otherData"]["alignment"] != "pass_boundary":
+        print("obs-smoke: FAIL (merged without pass_boundary alignment)")
+        return 1
+    a, b = anchors.values()
+    common = set(a) & set(b)
+    # merge_trace anchors on the earliest REAL iteration pass (pass 0 is
+    # the end-of-fit reporting pass) — check alignment at that anchor.
+    anchor = min(common - {0}) if common - {0} else min(common)
+    if a[anchor] != b[anchor]:
+        print(f"obs-smoke: FAIL (anchor pass {anchor} misaligned: "
+              f"{a[anchor]} vs {b[anchor]})")
+        return 1
+    _assert_nested(merged, "merged")
+    print("obs-smoke: PASS (2-proc traced fit -> 2 valid exports, nested "
+          f"spans, merged timeline aligned on pass {anchor})")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]), sys.argv[5])
+        sys.exit(0)
+    sys.exit(parent())

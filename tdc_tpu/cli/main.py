@@ -189,6 +189,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--native_loader", action="store_true",
                    help="stream batches through the C++ prefetch loader "
                         "(requires --data_file pointing at an .npy)")
+    p.add_argument("--trace", type=str, default=None, metavar="DIR",
+                   help="enable obs/trace span tracing: export Chrome-trace"
+                        " JSON per process into DIR (also $TDC_TRACE) and "
+                        "print the per-pass fit timeline; merge a gang's "
+                        "traces with python -m tdc_tpu.obs.merge_trace DIR")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="write a jax.profiler trace here (nvprof equivalent)")
     p.add_argument("--run_log", type=str, default=None,
@@ -530,6 +535,13 @@ def run_experiment(args) -> dict:
     Mirrors the reference main() (:320-409): 3-phase timers, OOM-adaptive
     batching, error capture handled by the caller.
     """
+    # Span tracing (obs/trace, stdlib-only): enabled before any fit code
+    # runs so pass/phase spans land from the first batch.
+    if args.trace:
+        from tdc_tpu.obs import trace as trace_lib
+
+        trace_lib.configure(args.trace)
+
     # Deferred imports so --help works instantly and --backend can take effect.
     if args.backend:
         import jax
@@ -1225,6 +1237,19 @@ def run_experiment(args) -> dict:
             w.writerow(["iteration", cost_col, "shift"])
             for i, (cost_i, shift_i) in enumerate(np.asarray(result.history), 1):
                 w.writerow([i, cost_i, shift_i])
+
+    if args.trace:
+        from tdc_tpu.obs import trace as trace_lib
+
+        rows = getattr(result, "timeline", None)
+        if rows:
+            print(trace_lib.format_timeline(rows, label=args.method_name))
+        else:
+            print("timeline: this fit path records no per-pass timeline "
+                  "(streamed kmeans/fuzzy drivers only)", file=sys.stderr)
+        tpath = trace_lib.flush()
+        if tpath:
+            print(f"trace written: {tpath}", file=sys.stderr)
 
     metrics = None
     if args.metrics:

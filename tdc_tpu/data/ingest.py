@@ -83,6 +83,7 @@ from typing import NamedTuple
 import numpy as np
 
 from tdc_tpu.data import spill as spill_lib
+from tdc_tpu.obs import trace
 from tdc_tpu.testing.faults import fault_point
 from tdc_tpu.utils.structlog import emit
 
@@ -433,6 +434,10 @@ class GuardedStream:
                 emit("ingest_retry", label=self.label, store=self.store,
                      batch=i, attempt=attempt, kind=kind, delay_s=delay_s,
                      error=f"{type(e).__name__}: {e}"[:200])
+                # Retries are visible on the trace track they stall
+                # (inline: the consumer; ranged spill: a producer).
+                trace.instant("ingest_retry", batch=i, attempt=attempt,
+                              delay_s=delay_s)
                 time.sleep(delay)
 
     def _read_guarded(self, i: int):

@@ -250,7 +250,8 @@ def all_rules() -> list:
     from tdc_tpu.lint.rules_hostsync import HostSyncInHotLoop, RecompileHazard
     from tdc_tpu.lint.rules_signal import SignalUnsafeHandler
     from tdc_tpu.lint.rules_drift import (
-        FaultPointDrift, StructlogEventDrift, NondeterministicCkptPath,
+        FaultPointDrift, MetricNameDrift, NondeterministicCkptPath,
+        StructlogEventDrift,
     )
 
     return [
@@ -262,6 +263,7 @@ def all_rules() -> list:
         StructlogEventDrift(),
         NondeterministicCkptPath(),
         AxisNameMismatch(),
+        MetricNameDrift(),
     ]
 
 

@@ -1,13 +1,14 @@
 """TDC005 fault-point-drift, TDC006 structlog-event-drift, TDC007
-nondeterministic-ckpt-path.
+nondeterministic-ckpt-path, TDC009 metric-name-drift.
 
-All three are *registry* rules: the value of a fault-point name, a
-structlog event name, or a checkpoint path lies entirely in other code
-(and other people's greps) finding it later. Drift — a renamed point the
-chaos spec still targets, two spellings of one event, a timestamp in a
-path a resume must re-derive — never fails a unit test; it fails the 3 am
-postmortem. TDC005/TDC006 are whole-program checks (finalize()); TDC007
-is lexical.
+All four are *registry* rules: the value of a fault-point name, a
+structlog event name, a checkpoint path, or a Prometheus series name
+lies entirely in other code (and other people's greps/dashboards)
+finding it later. Drift — a renamed point the chaos spec still targets,
+two spellings of one event, a timestamp in a path a resume must
+re-derive, a test asserting a metric the registry never exports — never
+fails a unit test; it fails the 3 am postmortem. TDC005/TDC006/TDC009
+are whole-program checks (finalize()); TDC007 is lexical.
 """
 
 from __future__ import annotations
@@ -271,3 +272,92 @@ class NondeterministicCkptPath:
 
     def finalize(self):
         return ()
+
+
+# No trailing underscore: a "tdc_online_" literal is a PREFIX (string
+# matching), not a series name.
+_METRIC_NAME_OK = re.compile(r"^tdc_[a-z0-9_]*[a-z0-9]$")
+_METRIC_SERIES_SUFFIX = re.compile(r"_(bucket|sum|count)$")
+# Non-metric tdc_ string literals the codebase legitimately uses: the
+# package's own name and the exit-barrier tag (parallel/multihost.barrier).
+_NON_METRIC_LITERALS = frozenset({"tdc_tpu", "tdc_exit"})
+
+
+class MetricNameDrift:
+    code = "TDC009"
+    name = "metric-name-drift"
+    description = (
+        "literal tdc_* metric names referenced anywhere must match the "
+        "CATALOG registry in obs/metrics.py — a drifted name makes a "
+        "dashboard query (or a /metrics test assertion) silently match "
+        "nothing, the TDC006 structlog-event discipline applied to the "
+        "Prometheus namespace"
+    )
+
+    def __init__(self):
+        self._refs: list[tuple[str, Finding]] = []
+        self._catalog: dict[str, Finding] | None = None
+        self._catalog_seen = False
+
+    def check(self, ctx: FileContext):
+        # Any linted file assigning a CATALOG dict is treated as the
+        # registry (the TDC005 KNOWN_POINTS approach — obs/metrics.py in
+        # the real tree, a self-contained file in the fixtures). The
+        # registry file's other literals are still collected as
+        # references — definitions match `known` trivially, and a typo'd
+        # literal inside the registry module deserves the same finding.
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "CATALOG"
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            self._catalog_seen = True
+            self._catalog = {}
+            for key in node.value.keys:
+                s = str_const(key)
+                if s is None:
+                    yield ctx.finding(
+                        self, key,
+                        "CATALOG keys must be string literals — a "
+                        "computed family name cannot be cross-checked "
+                        "against references (or grepped for from a "
+                        "dashboard)",
+                    )
+                    continue
+                if not _METRIC_NAME_OK.match(s):
+                    yield ctx.finding(
+                        self, key,
+                        f"metric family {s!r} is not tdc_-prefixed "
+                        "lowercase_snake (tdc_[a-z0-9_]+) — one "
+                        "namespace, one convention",
+                    )
+                    continue
+                self._catalog[s] = ctx.finding(self, key, "")
+        for node in ast.walk(ctx.tree):
+            s = str_const(node)
+            if (s is None or not s.startswith("tdc_")
+                    or s in _NON_METRIC_LITERALS
+                    or not _METRIC_NAME_OK.match(s)):
+                continue
+            self._refs.append((s, ctx.finding(self, node, "")))
+
+    def finalize(self):
+        if not self._catalog_seen:
+            # Registry not in the linted file set (e.g. spot-checking one
+            # file): the cross-check cannot run.
+            return
+        known = set(self._catalog or ())
+        for ref, at in self._refs:
+            base = _METRIC_SERIES_SUFFIX.sub("", ref)
+            if ref in known or base in known:
+                continue
+            yield Finding(
+                self.code, self.name, at.path, at.line, at.col,
+                f"metric name {ref!r} is not registered in "
+                "obs/metrics.CATALOG — register the family there (and in "
+                "docs/OBSERVABILITY.md) or fix the typo; a dashboard or "
+                "test referencing it matches no exported series",
+                at.snippet,
+            )

@@ -48,6 +48,11 @@ class FuzzyCMeansResult(NamedTuple):
     # quarantined batches/rows, dropped mass fraction), filled by the
     # streamed drivers (None for in-memory fits).
     ingest: object = None
+    # obs/trace per-fit timeline: per-pass rows (batches, read_s/stage_s/
+    # compute_s/reduce_s/ckpt_s, shift) assembled from the trace spans;
+    # filled by the streamed drivers when tracing ($TDC_TRACE / --trace)
+    # is enabled, None otherwise.
+    timeline: object = None
 
 
 def _fuzzy_stats_fn(kernel: str, m: float, block_rows: int, mesh=None):

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tdc_tpu.obs import trace
 from tdc_tpu.testing.faults import fault_point
 from tdc_tpu.utils import preempt
 from tdc_tpu.utils.heartbeat import maybe_beat
@@ -166,13 +167,19 @@ def run_resident_loop(
             # drifts off the multiple would never satisfy it.
             step = min(step, ckpt_every - n_iter % ckpt_every)
         cap = place_scalar(step, mesh)
-        with jax.transfer_guard("disallow"):
-            c, aux, shift_dev, did_dev, hist = chunk(c, aux, cap, cache)
-        did = int(did_dev)
+        # The chunk span closes over the n_done fetch, so its duration is
+        # device truth for all `did` iterations (the mid-chunk silence IS
+        # the zero-round-trip property — there is nothing finer to time).
+        chunk_span = trace.span("resident_chunk", cap=int(step))
+        with chunk_span:
+            with jax.transfer_guard("disallow"):
+                c, aux, shift_dev, did_dev, hist = chunk(c, aux, cap, cache)
+            did = int(did_dev)
         rows = np.asarray(hist)[:did]
         shift = float(shift_dev)
         history.extend((float(a), float(b)) for a, b in rows)
         n_iter += did
+        trace.timeline_chunk(n_iter, did, chunk_span.seconds, shift)
         if counter is not None and did:
             counter.add(comms_per_iter[0] * did, comms_per_iter[1] * did)
         if passes is not None:
@@ -207,8 +214,12 @@ def final_pass(pass_only, c, aux, cache, *, counter=None,
                comms_per_iter=(0, 0), passes=None):
     """The end-of-fit reporting pass over the cache (SSE/objective at the
     RETURNED centroids) — same zero-transfer contract as the chunk."""
-    with jax.transfer_guard("disallow"):
-        acc, aux = pass_only(c, aux, cache)
+    with trace.span("final_pass"):
+        with jax.transfer_guard("disallow"):
+            acc, aux = pass_only(c, aux, cache)
+        # The sync's 1-element fetch must land OUTSIDE the transfer
+        # guard (tracing-only device-truth fence).
+        trace.sync(acc)
     if counter is not None:
         counter.add(*comms_per_iter)
     if passes is not None:

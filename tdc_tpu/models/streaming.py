@@ -38,6 +38,7 @@ from tdc_tpu.ops.assign import (
 )
 from tdc_tpu.models.kmeans import KMeansResult, resolve_init, _normalize
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult
+from tdc_tpu.obs import trace
 from tdc_tpu.ops import subk as subk_lib
 from tdc_tpu.parallel import mesh as mesh_lib
 from tdc_tpu.parallel import reduce as reduce_lib
@@ -248,72 +249,95 @@ def _run_pass(
         skipped_rows = 0
         prefix_ok = skip == 0
         mismatch = False
-        for i, batch in enumerate(_prefetched(batches(), prefetch)):
-            maybe_beat(progress=f"iter={n_iter} batch={i}")
-            # (also while replaying a resume prefix: reading the skipped
-            # batches is real progress, and a silent replay would trip the
-            # supervisor's hang detector and loop the gang restart)
-            fault_point("stream.batch")
-            if i < skip:
-                if preempt_batch and preempt.requested():
-                    # Preempted while replaying a resume prefix: the
-                    # on-disk checkpoint already covers exactly this state
-                    # — exit now (no save needed) rather than replaying a
-                    # possibly-long prefix into the grace window.
-                    raise Preempted(
-                        f"preempted during resume replay at batch {i + 1}"
-                    )
-                # Weighted streams yield (x, w) pairs; rows come from x.
-                # Quarantined markers (data/ingest.py) carry the raw batch
-                # GEOMETRY — resume accounting counts stream rows, not
-                # validity, so quarantine verdicts cannot shift the cursor.
-                if isinstance(batch, ingest_lib.Quarantined):
-                    xb = batch.x
-                elif isinstance(batch, tuple):
-                    xb = batch[0]
-                else:
-                    xb = batch
-                # Replay prefix only; xb is the host-side stream batch
-                # (shape read, no device value involved).
-                skipped_rows += np.asarray(xb).shape[0]  # tdclint: disable=TDC002
-                if i == skip - 1:
-                    if skipped_rows != rows0:
-                        mismatch = True
-                        break
-                    prefix_ok = True
-                continue
-            acc, n_rows = step_fn(acc, batch)
-            # n_rows is the step's host-side local row count (from
-            # _prepare_batch), never a traced value — no device sync here.
-            rows += int(n_rows)  # tdclint: disable=TDC002
-            consumed = i + 1
-            if consumed % _BACKPRESSURE_EVERY == 0:
-                jax.block_until_ready(jax.tree_util.tree_leaves(acc))
-            can_save = (n_iter > 0 and ckpt is not None
-                        and ckpt.dir is not None)
-            # Host-side checkpoint bookkeeping (plain Python values).
-            saved_midpass = bool(can_save and ckpt_every_batches  # tdclint: disable=TDC002
-                                 and consumed % ckpt_every_batches == 0)
-            if saved_midpass:
-                c, shift, history = save_args
-                ckpt.save(n_iter - 1, c, shift, history,
-                          batch_cursor=consumed, acc=acc, rows_seen=rows)
-            if preempt_batch and preempt.requested():
-                # Drain save, unless the periodic save just wrote this
-                # exact (cursor, acc) state — a second full serialization
-                # inside the grace window buys nothing.
-                if preempt_can_save and can_save and not saved_midpass:
+        # Span tracing (obs/trace): the pass_boundary instant is the
+        # gang-merge alignment anchor; the per-batch read/compute spans
+        # + the driver-side stage spans are what the per-fit timeline
+        # aggregates. All no-ops unless $TDC_TRACE / --trace is set. The
+        # with-block guarantees the pass span closes (and pops off the
+        # thread-local span stack) even on the designed raise paths —
+        # Preempted drains, IngestAbort, stream read errors.
+        trace.begin_pass(n_iter)
+        with trace.span("pass", n_iter=n_iter):
+            for i, batch in enumerate(
+                    trace.timed_iter(_prefetched(batches(), prefetch),
+                                     "read")):
+                maybe_beat(progress=f"iter={n_iter} batch={i}")
+                # (also while replaying a resume prefix: reading the
+                # skipped batches is real progress, and a silent replay
+                # would trip the supervisor's hang detector and loop the
+                # gang restart)
+                fault_point("stream.batch")
+                if i < skip:
+                    if preempt_batch and preempt.requested():
+                        # Preempted while replaying a resume prefix: the
+                        # on-disk checkpoint already covers exactly this
+                        # state — exit now (no save needed) rather than
+                        # replaying a possibly-long prefix into the grace
+                        # window.
+                        raise Preempted(
+                            f"preempted during resume replay at batch "
+                            f"{i + 1}"
+                        )
+                    # Weighted streams yield (x, w) pairs; rows come from
+                    # x. Quarantined markers (data/ingest.py) carry the
+                    # raw batch GEOMETRY — resume accounting counts stream
+                    # rows, not validity, so quarantine verdicts cannot
+                    # shift the cursor.
+                    if isinstance(batch, ingest_lib.Quarantined):
+                        xb = batch.x
+                    elif isinstance(batch, tuple):
+                        xb = batch[0]
+                    else:
+                        xb = batch
+                    # Replay prefix only; xb is the host-side stream batch
+                    # (shape read, no device value involved).
+                    skipped_rows += np.asarray(xb).shape[0]  # tdclint: disable=TDC002
+                    if i == skip - 1:
+                        if skipped_rows != rows0:
+                            mismatch = True
+                            break
+                        prefix_ok = True
+                    continue
+                with trace.span("compute", batch=i):
+                    acc, n_rows = step_fn(acc, batch)
+                # n_rows is the step's host-side local row count (from
+                # _prepare_batch), never a traced value — no device sync
+                # here.
+                rows += int(n_rows)  # tdclint: disable=TDC002
+                consumed = i + 1
+                if consumed % _BACKPRESSURE_EVERY == 0:
+                    jax.block_until_ready(jax.tree_util.tree_leaves(acc))
+                can_save = (n_iter > 0 and ckpt is not None
+                            and ckpt.dir is not None)
+                # Host-side checkpoint bookkeeping (plain Python values).
+                saved_midpass = bool(can_save and ckpt_every_batches  # tdclint: disable=TDC002
+                                     and consumed % ckpt_every_batches == 0)
+                if saved_midpass:
                     c, shift, history = save_args
                     ckpt.save(n_iter - 1, c, shift, history,
-                              batch_cursor=consumed, acc=acc, rows_seen=rows)
-                raise Preempted(
-                    f"preempted at batch boundary {consumed} of iteration "
-                    f"{n_iter}"
-                )
-        if not mismatch and not prefix_ok:
-            # Stream ended inside the skip prefix: fewer batches than the
-            # cursor — layout definitely changed.
-            mismatch = True
+                              batch_cursor=consumed, acc=acc,
+                              rows_seen=rows)
+                if preempt_batch and preempt.requested():
+                    # Drain save, unless the periodic save just wrote this
+                    # exact (cursor, acc) state — a second full
+                    # serialization inside the grace window buys nothing.
+                    if preempt_can_save and can_save and not saved_midpass:
+                        c, shift, history = save_args
+                        ckpt.save(n_iter - 1, c, shift, history,
+                                  batch_cursor=consumed, acc=acc,
+                                  rows_seen=rows)
+                    raise Preempted(
+                        f"preempted at batch boundary {consumed} of "
+                        f"iteration {n_iter}"
+                    )
+            if not mismatch and not prefix_ok:
+                # Stream ended inside the skip prefix: fewer batches than
+                # the cursor — layout definitely changed.
+                mismatch = True
+            if not mismatch:
+                # Device truth at the pass boundary (tracing only): the
+                # pass span reads device wall time, not dispatch time.
+                trace.sync(acc)
         if not mismatch:
             if crosscheck_mesh is not None:
                 _crosscheck_pass_rows(
@@ -614,6 +638,38 @@ def _prepare_weighted_batch(batch, w, mesh):
     pw, _ = mesh_lib.pad_to_multiple(w, n_dev, 0.0)
     return (mesh_lib.shard_points(pb, mesh),
             mesh_lib.shard_points(pw, mesh), n_local)
+
+
+def _make_stage(mesh, weighted: bool):
+    """The 1-D streamed drivers' staging closure — shared by the inline
+    step and the spill ring's producer thread, so the consumer sees
+    identical arrays either way (the spill parity bar), and ONE copy for
+    both drivers (kmeans/fuzzy previously carried byte-identical
+    closures that had to change in lockstep). A Quarantined marker
+    (data/ingest.py) stages as the ALL-PADDING batch: zero rows with
+    zero valid count (zero weights when weighted), so the existing
+    pad-correction algebra makes its contribution exactly zero mass with
+    no verdict-dependent control flow."""
+
+    def _stage(batch):
+        with trace.span("stage"):
+            if isinstance(batch, ingest_lib.Quarantined):
+                if weighted:
+                    xb, wb, n_local = _prepare_weighted_batch(
+                        batch.x, batch.w, mesh
+                    )
+                    return spill_lib.StagedBatch(xb, xb.shape[0], n_local,
+                                                 wb)
+                xb, _, n_local = _prepare_batch(batch.x, mesh)
+                return spill_lib.StagedBatch(xb, 0, n_local)
+            if weighted:
+                xb, wb, n_local = _prepare_weighted_batch(batch[0],
+                                                          batch[1], mesh)
+                return spill_lib.StagedBatch(xb, xb.shape[0], n_local, wb)
+            xb, n_valid, n_local = _prepare_batch(batch, mesh)
+            return spill_lib.StagedBatch(xb, n_valid, n_local)
+
+    return _stage
 
 
 # ---------------------------------------------------------------------------
@@ -1093,6 +1149,12 @@ class _StreamCheckpointer:
 
     def save(self, n_iter, c, shift, history, *, batch_cursor=0, acc=None,
              rows_seen=0):
+        with trace.span("checkpoint", step=n_iter, cursor=batch_cursor):
+            self._save(n_iter, c, shift, history, batch_cursor=batch_cursor,
+                       acc=acc, rows_seen=rows_seen)
+
+    def _save(self, n_iter, c, shift, history, *, batch_cursor=0, acc=None,
+              rows_seen=0):
         from tdc_tpu.utils.checkpoint import ClusterState, save_checkpoint
 
         meta = {"k": self.k, "d": self.d, "shift": float(shift)}
@@ -1329,6 +1391,8 @@ def streamed_kmeans_fit(
                             read_first=guard.first_batch)
     if mesh is not None:
         c = mesh_lib.replicate(c, mesh)
+    # Per-fit timeline (obs/trace): None unless tracing is enabled.
+    tl = trace.begin_fit("streamed_kmeans_fit", k=k, d=d)
 
     def zero_stats():
         z = SufficientStats(
@@ -1378,29 +1442,7 @@ def streamed_kmeans_fit(
         if aspec.coarse else None
     )
 
-    def _stage(batch):
-        # The driver's staging path — shared by the inline step and the
-        # spill ring's producer thread, so the consumer sees identical
-        # arrays either way (the spill parity bar). A Quarantined marker
-        # (data/ingest.py) stages as the ALL-PADDING batch: zero rows with
-        # zero valid count (zero weights when weighted), so the existing
-        # pad-correction algebra makes its contribution exactly zero mass
-        # with no verdict-dependent control flow.
-        if isinstance(batch, ingest_lib.Quarantined):
-            if weighted:
-                xb, wb, n_local = _prepare_weighted_batch(
-                    batch.x, batch.w, mesh
-                )
-                return spill_lib.StagedBatch(xb, xb.shape[0], n_local, wb)
-            xb, _, n_local = _prepare_batch(batch.x, mesh)
-            return spill_lib.StagedBatch(xb, 0, n_local)
-        if weighted:
-            xb, wb, n_local = _prepare_weighted_batch(batch[0], batch[1],
-                                                      mesh)
-            return spill_lib.StagedBatch(xb, xb.shape[0], n_local, wb)
-        xb, n_valid, n_local = _prepare_batch(batch, mesh)
-        return spill_lib.StagedBatch(xb, n_valid, n_local)
-
+    _stage = _make_stage(mesh, weighted)
     run_stream, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     run_prefetch = prefetch if h2d is None else 0
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
@@ -1478,10 +1520,12 @@ def streamed_kmeans_fit(
             return acc
         # The ONE cross-device reduce of this pass (+ error feedback), then
         # the whole-pass padding correction against the pass-constant c.
-        if strategy.quantize is not None:
-            acc, err_state[0] = d_reduce(acc, err_state[0])
-        else:
-            acc = d_reduce(acc)
+        with trace.span("reduce", n_iter=n_iter):
+            if strategy.quantize is not None:
+                acc, err_state[0] = d_reduce(acc, err_state[0])
+            else:
+                acc = d_reduce(acc)
+            trace.sync(acc)
         counter.add(
             *reduce_lib.tree_reduce_cost(example, axes, strategy.quantize)
         )
@@ -1512,17 +1556,21 @@ def streamed_kmeans_fit(
             raise ValueError(
                 "all sample weights are zero — the weighted fit has no mass"
             )
-        new_c = apply_centroid_update(acc, c)
-        if spherical:
-            new_c = _normalize(new_c)
-        shift_dev = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
-        # The convergence test (tol >= 0) and checkpoint metadata need the
-        # shift on the host; otherwise stay fully async — a per-iteration
-        # device fetch costs a whole round trip on remote links (measured
-        # ~10x the iteration's compute on the tunneled chip).
-        sync = tol >= 0 or ckpt_dir is not None
-        shift = float(shift_dev) if sync else shift_dev
+        with trace.span("shift_check", n_iter=n_iter):
+            new_c = apply_centroid_update(acc, c)
+            if spherical:
+                new_c = _normalize(new_c)
+            shift_dev = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
+            # The convergence test (tol >= 0) and checkpoint metadata need
+            # the shift on the host; otherwise stay fully async — a
+            # per-iteration device fetch costs a whole round trip on
+            # remote links (measured ~10x the iteration's compute on the
+            # tunneled chip). Tracing opts into the fetch: phase spans
+            # must read device truth, not dispatch time.
+            sync = tol >= 0 or ckpt_dir is not None or trace.enabled()
+            shift = float(shift_dev) if sync else shift_dev
         history.append((float(acc.sse) if sync else acc.sse, shift))
+        trace.timeline_shift(n_iter, shift if sync else None)
         c = new_c
         done = sync and tol >= 0 and shift <= tol
         saved_now = ckpt_dir is not None and (done or n_iter % ckpt_every == 0
@@ -1604,6 +1652,7 @@ def streamed_kmeans_fit(
         ingest=guard.report(),
         assign=(None if assign_counter is None
                 else subk_lib.report(aspec, assign_counter)),
+        timeline=trace.end_fit(tl),
     )
 
 
@@ -1827,6 +1876,8 @@ def streamed_fuzzy_fit(
                             read_first=guard.first_batch)
     if mesh is not None:
         c = mesh_lib.replicate(c, mesh)
+    # Per-fit timeline (obs/trace): None unless tracing is enabled.
+    tl = trace.begin_fit("streamed_fuzzy_fit", k=k, d=d, m=float(m))
 
     def zero_stats():
         acc = FuzzyStats(
@@ -1869,26 +1920,7 @@ def streamed_fuzzy_fit(
         mid_pass_ckpt=ckpt_every_batches is not None,
     )
 
-    def _stage(batch):
-        # Shared by the inline step and the spill ring's producer thread
-        # (streamed_kmeans_fit's rule: identical arrays either way).
-        # Quarantined markers stage as the all-padding zero-mass batch
-        # (see streamed_kmeans_fit._stage).
-        if isinstance(batch, ingest_lib.Quarantined):
-            if weighted:
-                xb, wb, n_local = _prepare_weighted_batch(
-                    batch.x, batch.w, mesh
-                )
-                return spill_lib.StagedBatch(xb, xb.shape[0], n_local, wb)
-            xb, _, n_local = _prepare_batch(batch.x, mesh)
-            return spill_lib.StagedBatch(xb, 0, n_local)
-        if weighted:
-            xb, wb, n_local = _prepare_weighted_batch(batch[0], batch[1],
-                                                      mesh)
-            return spill_lib.StagedBatch(xb, xb.shape[0], n_local, wb)
-        xb, n_valid, n_local = _prepare_batch(batch, mesh)
-        return spill_lib.StagedBatch(xb, n_valid, n_local)
-
+    _stage = _make_stage(mesh, weighted)
     run_stream, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     run_prefetch = prefetch if h2d is None else 0
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
@@ -1950,10 +1982,12 @@ def streamed_fuzzy_fit(
         )
         if not deferred:
             return acc
-        if strategy.quantize is not None:
-            acc, err_state[0] = d_reduce(acc, err_state[0])
-        else:
-            acc = d_reduce(acc)
+        with trace.span("reduce", n_iter=n_iter):
+            if strategy.quantize is not None:
+                acc, err_state[0] = d_reduce(acc, err_state[0])
+            else:
+                acc = d_reduce(acc)
+            trace.sync(acc)
         counter.add(
             *reduce_lib.tree_reduce_cost(example, axes, strategy.quantize)
         )
@@ -1980,14 +2014,19 @@ def streamed_fuzzy_fit(
             raise ValueError(
                 "all sample weights are zero — the weighted fit has no mass"
             )
-        new_c = acc.weighted_sums / jnp.maximum(acc.weights[:, None], 1e-12)
-        shift_dev = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
-        # Same deferred-sync rule as streamed_kmeans_fit: only the
-        # convergence test / checkpointing justify a per-iteration fetch.
-        sync = tol >= 0 or ckpt_dir is not None
-        shift = float(shift_dev) if sync else shift_dev
+        with trace.span("shift_check", n_iter=n_iter):
+            new_c = acc.weighted_sums / jnp.maximum(
+                acc.weights[:, None], 1e-12
+            )
+            shift_dev = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
+            # Same deferred-sync rule as streamed_kmeans_fit: only the
+            # convergence test / checkpointing — or tracing's device-truth
+            # contract — justify a per-iteration fetch.
+            sync = tol >= 0 or ckpt_dir is not None or trace.enabled()
+            shift = float(shift_dev) if sync else shift_dev
         history.append((float(acc.objective) if sync else acc.objective,
                         shift))
+        trace.timeline_shift(n_iter, shift if sync else None)
         c = new_c
         done = sync and tol >= 0 and shift <= tol
         saved_now = ckpt_dir is not None and (done or n_iter % ckpt_every == 0
@@ -2053,4 +2092,5 @@ def streamed_fuzzy_fit(
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
         ingest=guard.report(),
+        timeline=trace.end_fit(tl),
     )

@@ -1,0 +1,25 @@
+"""Unified telemetry layer (PR 12): span tracing with gang-merged
+timelines (`obs/trace.py`, `python -m tdc_tpu.obs.merge_trace`) and the
+central metrics registry every `tdc_*` Prometheus series renders through
+(`obs/metrics.py`).
+
+Everything here is stdlib-only at import time (jax is imported lazily,
+only when a hard sync is actually requested), so the hot-path guards —
+`trace.span(...)` with tracing disabled, a registry that is never
+rendered — cost a flag check, not an import.
+"""
+
+from __future__ import annotations
+
+_LAZY = ("metrics", "trace")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return importlib.import_module(f"tdc_tpu.obs.{name}")
+    raise AttributeError(f"module 'tdc_tpu.obs' has no attribute {name!r}")
+
+
+__all__ = list(_LAZY)

@@ -1,0 +1,191 @@
+"""Align N gang processes' per-process Chrome traces onto one timeline.
+
+    python -m tdc_tpu.obs.merge_trace [--out merged_trace.json] DIR|FILE...
+
+Inputs are the `trace_p<i>_<pid>.json` files obs/trace.flush() writes
+(directories are globbed for `trace_*.json`). Each process keeps its own
+track group (pid), renamed `tdc p<process_index>`; timestamps are
+aligned on the `pass_boundary` instants the drivers emit — the earliest
+pass number present in EVERY input is the anchor, and each trace is
+shifted so its anchor lands at the same instant. Collective semantics
+make this sound: a gang cannot start pass n before every process
+finished pass n-1's reduce, so the anchor is a true simultaneity point
+up to one barrier latency. Traces with no common anchor (e.g. a serve
+process next to a fit) fall back to wall-clock alignment via the
+`wall_t0` each export records.
+
+Exit codes: 0 merged, 2 malformed/unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REQUIRED_EVENT_KEYS = ("name", "ph", "pid", "tid")
+
+
+class MergeError(Exception):
+    pass
+
+
+def load_trace(path: str) -> dict:
+    """Load + validate one per-process trace export."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MergeError(f"{path}: not readable JSON ({e})") from e
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise MergeError(
+            f"{path}: not a Chrome trace export (object with a "
+            "'traceEvents' list)"
+        )
+    for i, ev in enumerate(doc["traceEvents"]):
+        if not isinstance(ev, dict) or any(
+                k not in ev for k in _REQUIRED_EVENT_KEYS):
+            raise MergeError(
+                f"{path}: traceEvents[{i}] is missing required keys "
+                f"{_REQUIRED_EVENT_KEYS}"
+            )
+        if ev["ph"] != "M" and "ts" not in ev:
+            raise MergeError(f"{path}: traceEvents[{i}] has no 'ts'")
+    return doc
+
+
+def _anchors(doc: dict) -> dict[int, float]:
+    """pass number -> ts of the FIRST pass_boundary instant for it."""
+    out: dict[int, float] = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "i" and ev.get("name") == "pass_boundary":
+            n = ev.get("args", {}).get("pass")
+            if isinstance(n, int) and n not in out:
+                out[n] = float(ev["ts"])
+    return out
+
+
+def _collect_inputs(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "trace_*.json")))
+            if not found:
+                raise MergeError(f"{p}: no trace_*.json files")
+            files.extend(found)
+        elif os.path.exists(p):
+            files.append(p)
+        else:
+            raise MergeError(f"{p}: no such file or directory")
+    if not files:
+        raise MergeError("no input traces")
+    return files
+
+
+def merge(paths: list[str]) -> dict:
+    """Merge per-process exports into one aligned Chrome trace doc."""
+    files = _collect_inputs(paths)
+    docs = [load_trace(f) for f in files]
+
+    anchor_sets = [_anchors(d) for d in docs]
+    common = set(anchor_sets[0])
+    for a in anchor_sets[1:]:
+        common &= set(a)
+    mode = "pass_boundary"
+    if common:
+        # Pass 0 is the END-of-fit reporting pass; prefer the earliest
+        # real iteration boundary when one is shared.
+        anchor = min(common - {0}) if common - {0} else 0
+        shifts = [a[anchor] for a in anchor_sets]
+    else:
+        mode = "wall_clock"
+        walls = []
+        for f, d in zip(files, docs):
+            w = d.get("otherData", {}).get("wall_t0")
+            if not isinstance(w, (int, float)):
+                raise MergeError(
+                    f"{f}: no common pass_boundary anchor and no wall_t0 "
+                    "fallback — cannot align"
+                )
+            walls.append(float(w))
+        w0 = min(walls)
+        # Later wall start => its ts 0 is LATER on the merged timeline.
+        shifts = [-(w - w0) * 1e6 for w in walls]
+
+    events: list[dict] = []
+    seen_pids: set[int] = set()
+    for i, (f, doc, shift) in enumerate(zip(files, docs, shifts)):
+        other = doc.get("otherData", {})
+        pid = other.get("pid")
+        if not isinstance(pid, int) or pid in seen_pids:
+            pid = 1_000_000 + i  # synthetic, collision-free track id
+        seen_pids.add(pid)
+        pidx = other.get("process_index")
+        track = f"tdc p{pidx if pidx is not None else i} ({os.path.basename(f)})"
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": track},
+        })
+        events.append({
+            "name": "process_sort_index", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"sort_index": (
+                pidx if isinstance(pidx, int) else i
+            )},
+        })
+        for ev in doc["traceEvents"]:
+            if ev.get("name") == "process_name" and ev.get("ph") == "M":
+                continue  # replaced by the per-file track name above
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) - shift, 3)
+            events.append(ev)
+
+    # Normalize so the merged timeline starts at 0 (negative ts renders
+    # unreliably across viewers).
+    t_min = min((e["ts"] for e in events if "ts" in e), default=0.0)
+    for e in events:
+        if "ts" in e:
+            e["ts"] = round(e["ts"] - t_min, 3)
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": [os.path.basename(f) for f in files],
+            "alignment": mode,
+        },
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tdc_tpu.obs.merge_trace",
+        description=__doc__.splitlines()[0],
+    )
+    p.add_argument("inputs", nargs="+",
+                   help="trace files and/or directories of trace_*.json")
+    p.add_argument("--out", default="merged_trace.json",
+                   help="merged output path (default merged_trace.json)")
+    args = p.parse_args(argv)
+    try:
+        doc = merge(args.inputs)
+    except MergeError as e:
+        print(f"merge_trace: {e}", file=sys.stderr)
+        return 2
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    n = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
+    print(
+        f"merge_trace: {len(doc['otherData']['merged_from'])} traces, "
+        f"{n} events, alignment={doc['otherData']['alignment']} "
+        f"-> {args.out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,496 @@
+"""Central metrics registry: counter/gauge/histogram primitives and the
+ONE Prometheus text renderer every `tdc_*` series goes through.
+
+Before PR 12 the exposition was ~200 lines of hand-formatted text in
+serve/server.py reading five ad-hoc counter globals (GLOBAL_COMMS,
+GLOBAL_H2D, GLOBAL_INGEST, GLOBAL_ASSIGN, the online updaters), and the
+latency story was a recent-window quantile summary that could not answer
+"p999 under load". Now:
+
+- `Registry` owns typed metrics and renders them in registration order;
+  `Counter`/`Gauge`/`Histogram` are thread-safe primitives with optional
+  labels. `Histogram` is a REAL fixed-bucket Prometheus histogram
+  (cumulative `_bucket{le=...}` + `_sum` + `_count`), so p50/p99/p999
+  are derivable by any Prometheus stack from the scrape alone.
+- `Registry.callback(...)` registers a render-time value source — how
+  the pre-existing process-wide counters (parallel/reduce.GLOBAL_COMMS,
+  data/spill.GLOBAL_H2D, data/ingest.GLOBAL_INGEST,
+  ops/subk.GLOBAL_ASSIGN, serve/online ledgers) publish through the
+  registry without moving their (already thread-safe, already tested)
+  state. The per-fit report shapes (`result.comms`/`h2d`/`ingest`/
+  `assign`) are untouched.
+- `CATALOG` is the authoritative name registry: every `tdc_*` family
+  this repo exports, with type and help text. Registering a `tdc_*`
+  name that is not in the catalog raises — the discipline the TDC009
+  lint rule (metric-name drift) and the docs/OBSERVABILITY.md drift
+  test are anchored on.
+
+Stdlib-only; importable from anywhere (including producer threads and
+the lint-adjacent tests) without touching jax.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+# ---------------------------------------------------------------------------
+# The metric-name catalog. Keys are FAMILY names (a histogram family
+# `x` renders series `x_bucket`/`x_sum`/`x_count`). TDC009 cross-checks
+# every literal `tdc_*` reference in the tree against these keys, and
+# the docs/OBSERVABILITY.md drift test pins the doc's metrics table to
+# them — add here first, then register, then document.
+# ---------------------------------------------------------------------------
+
+CATALOG = {
+    # serve request layer (serve/server.py)
+    "tdc_serve_requests_total": (
+        "counter", "Requests by endpoint and status."),
+    "tdc_serve_batches_total": (
+        "counter", "Coalesced device batches executed."),
+    "tdc_serve_batched_requests_total": (
+        "counter", "Requests that went through the batcher."),
+    "tdc_serve_rejected_total": (
+        "counter", "Requests rejected with overloaded backpressure."),
+    "tdc_serve_engine_rows_total": (
+        "counter", "Real data rows computed on device."),
+    "tdc_serve_engine_padded_rows_total": (
+        "counter", "Bucket-padding rows computed on device."),
+    "tdc_serve_engine_compiles_total": (
+        "counter", "jit traces paid (bucket warmup)."),
+    "tdc_serve_engine_device_ms_total": (
+        "counter", "Device compute milliseconds."),
+    "tdc_serve_queue_wait_ms_total": (
+        "counter", "Milliseconds requests spent queued before dispatch."),
+    "tdc_serve_models": (
+        "gauge", "Models currently registered."),
+    "tdc_serve_draining": (
+        "gauge", "1 while the server is draining (rejecting new work, "
+                 "flushing in-flight batches)."),
+    # serve latency histograms (PR 12: real fixed-bucket histograms
+    # replacing the recent-window quantile summary)
+    "tdc_serve_latency_ms": (
+        "histogram", "End-to-end request latency per endpoint."),
+    "tdc_serve_queue_wait_ms": (
+        "histogram", "Per-request queue wait before batch dispatch."),
+    "tdc_serve_engine_batch_device_ms": (
+        "histogram", "Per-batch device compute milliseconds."),
+    # scrape health (standard idioms)
+    "tdc_up": (
+        "gauge", "1 while the serve process is scrapable."),
+    "tdc_build_info": (
+        "gauge", "Build metadata as labels; value is always 1."),
+    # cross-device stats-reduce accounting (parallel/reduce.py)
+    "tdc_comms_stats_reduces_total": (
+        "counter", "Cross-device stats reduces issued (parallel/reduce)."),
+    "tdc_comms_stats_logical_bytes_total": (
+        "counter", "Logical payload bytes moved by stats reduces."),
+    # spill-tier H2D prefetch ring (data/spill.py)
+    "tdc_h2d_bytes_total": (
+        "counter", "Logical host->device bytes staged by the spill "
+                   "prefetch ring (data/spill.py)."),
+    "tdc_h2d_batches_total": (
+        "counter", "Batches staged through the spill prefetch ring."),
+    "tdc_h2d_copy_stall_seconds_total": (
+        "counter", "Seconds spill-fit consumers stalled waiting on H2D "
+                   "staging (copy time the overlap failed to hide)."),
+    "tdc_h2d_prefetch_depth": (
+        "gauge", "Deepest spill prefetch-ring fill observed."),
+    # hardened ingest (data/ingest.py)
+    "tdc_ingest_retries_total": (
+        "counter", "Stream read attempts retried after transient failures "
+                   "(data/ingest.py)."),
+    "tdc_ingest_read_failures_total": (
+        "counter", "Stream reads abandoned: permanent classification or "
+                   "retries/deadline exhausted."),
+    "tdc_ingest_quarantined_batches_total": (
+        "counter", "Batches quarantined (zero mass) by the ingest "
+                   "integrity screen."),
+    "tdc_ingest_quarantined_rows_total": (
+        "counter", "Rows held by quarantined batches."),
+    "tdc_ingest_crc_failures_total": (
+        "counter", "Quarantines caused by CRC sidecar mismatches "
+                   "(corrupt-on-disk)."),
+    # sub-linear assignment (ops/subk.py)
+    "tdc_assign_tiles_probed_total": (
+        "counter", "Centroid tiles scanned by coarse-assignment refine "
+                   "steps (ops/subk.py)."),
+    "tdc_assign_tiles_total": (
+        "counter", "Centroid tiles an exact all-K scan would have touched "
+                   "across the same refine steps."),
+    "tdc_assign_pruned_fraction": (
+        "gauge", "Fraction of centroid tiles pruned by coarse assignment "
+                 "(1 - probed/total; 0 when no coarse fit ran)."),
+    # per-model registry state (serve/registry.py)
+    "tdc_model_generation": (
+        "gauge", "Monotonic reload generation per model."),
+    "tdc_model_generation_age_seconds": (
+        "gauge", "Seconds since the serving generation was loaded."),
+    # online-update pipeline (serve/online.py)
+    "tdc_online_quarantined_batches_total": (
+        "counter", "serve/online updater metric."),
+    "tdc_online_observed_batches_total": (
+        "counter", "serve/online updater metric."),
+    "tdc_online_folds_total": (
+        "counter", "serve/online updater metric."),
+    "tdc_online_publishes_total": (
+        "counter", "serve/online updater metric."),
+    "tdc_online_rejected_candidates_total": (
+        "counter", "serve/online updater metric."),
+    "tdc_online_rollbacks_total": (
+        "counter", "serve/online updater metric."),
+    "tdc_online_pending_rows": (
+        "gauge", "serve/online updater metric."),
+    "tdc_online_holdback_rows": (
+        "gauge", "serve/online updater metric."),
+    "tdc_online_pinned": (
+        "gauge", "serve/online updater metric."),
+    "tdc_online_live_inertia_per_point": (
+        "gauge", "serve/online updater metric."),
+    "tdc_online_candidate_inertia_per_point": (
+        "gauge", "serve/online updater metric."),
+    "tdc_online_window_sse_per_row": (
+        "gauge", "serve/online updater metric."),
+    "tdc_online_assignment_churn": (
+        "gauge", "serve/online updater metric."),
+}
+
+# Fixed buckets for the serve latency/queue-wait/device-ms histograms, in
+# milliseconds. Wide enough that p999 under overload still lands inside a
+# finite bucket on the CPU CI box, fine enough that p50 of a sub-ms warm
+# predict is not crushed into one bucket.
+LATENCY_MS_BUCKETS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = ("counter", "gauge", "histogram")
+
+
+def _fmt(v) -> str:
+    """Prometheus sample value: ints render bare ('3'), floats via str
+    ('0.0', '12.5') — byte-identical to the pre-registry hand renderer,
+    which interpolated the same Python values into f-strings."""
+    if isinstance(v, bool):
+        return str(int(v))
+    return str(v)
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: backslash, quote, newline."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _label_str(names, values) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{escape_label_value(v)}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Base: one family, optional labels; children keyed by label values."""
+
+    typ = "untyped"
+
+    def __init__(self, name: str, help_: str, labelnames=()):
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        for ln in self.labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"bad label name {ln!r} on {name}")
+        self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}
+        if not self.labelnames:
+            self._children[()] = self._new_child()
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def labels(self, **kv):
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(kv)} != declared "
+                f"{sorted(self.labelnames)}"
+            )
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_child()
+        return child
+
+    def _default(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labeled ({self.labelnames}); use .labels()"
+            )
+        return self._children[()]
+
+    def samples(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._children.items())
+        out = []
+        for key, child in items:
+            out.extend(child.render(self.name,
+                                    _label_str(self.labelnames, key),
+                                    self.labelnames, key))
+        return out
+
+
+class _CounterChild:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self, amount=1):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self.value += amount
+
+    def render(self, name, labels, _ln, _lv):
+        return [f"{name}{labels} {_fmt(self.value)}"]
+
+
+class Counter(_Metric):
+    typ = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount=1):
+        self._default().inc(amount)
+
+    @property
+    def value(self):
+        return self._default().value
+
+
+class _GaugeChild(_CounterChild):
+    def set(self, v):
+        with self._lock:
+            self.value = v
+
+    def inc(self, amount=1):
+        with self._lock:
+            self.value += amount
+
+
+class Gauge(_Metric):
+    typ = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, v):
+        self._default().set(v)
+
+    def inc(self, amount=1):
+        self._default().inc(amount)
+
+    @property
+    def value(self):
+        return self._default().value
+
+
+class _HistogramChild:
+    def __init__(self, buckets):
+        self._lock = threading.Lock()
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # last = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            i = 0
+            for i, ub in enumerate(self.buckets):  # noqa: B007
+                if v <= ub:
+                    break
+            else:
+                i = len(self.buckets)
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def render(self, name, labels, labelnames, labelvalues):
+        out = []
+        cum = 0
+        with self._lock:
+            counts = list(self.counts)
+            total, s = self.count, self.sum
+        for ub, n in zip(self.buckets, counts):
+            cum += n
+            le = _label_str(labelnames + ("le",), labelvalues + (_fmt(ub),))
+            out.append(f"{name}_bucket{le} {cum}")
+        le = _label_str(labelnames + ("le",), labelvalues + ("+Inf",))
+        out.append(f"{name}_bucket{le} {total}")
+        out.append(f"{name}_sum{labels} {_fmt(round(s, 6))}")
+        out.append(f"{name}_count{labels} {total}")
+        return out
+
+
+class Histogram(_Metric):
+    typ = "histogram"
+
+    def __init__(self, name, help_, buckets, labelnames=()):
+        buckets = tuple(float(b) for b in buckets)
+        if list(buckets) != sorted(set(buckets)):
+            raise ValueError(f"{name}: buckets must be strictly increasing")
+        if not buckets:
+            raise ValueError(f"{name}: at least one finite bucket required")
+        self.buckets = buckets
+        super().__init__(name, help_, labelnames)
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v):
+        self._default().observe(v)
+
+
+class _Callback:
+    """Render-time value source: fn() -> scalar, or -> iterable of
+    (labels_dict_or_None, value) rows. How the pre-existing counter
+    globals and per-model/online stats publish through the registry
+    without relocating their state."""
+
+    def __init__(self, name, typ, help_, fn):
+        self.name = name
+        self.typ = typ
+        self.help = help_
+        self.fn = fn
+
+    def samples(self) -> list[str]:
+        got = self.fn()
+        if isinstance(got, (int, float)):
+            return [f"{self.name} {_fmt(got)}"]
+        out = []
+        for labels, value in got:
+            if labels:
+                ln = tuple(labels)
+                ls = _label_str(ln, tuple(labels[n] for n in ln))
+            else:
+                ls = ""
+            out.append(f"{self.name}{ls} {_fmt(value)}")
+        return out
+
+
+class Registry:
+    """Ordered collection of metrics with the one text renderer.
+
+    Rendering order is registration order (the serve endpoint registers
+    in the historical exposition order, keeping the payload diffable
+    against pre-registry scrapes). `tdc_*` names must be in CATALOG.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _resolve(self, name, typ, help_):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        cat = CATALOG.get(name)
+        if name.startswith("tdc_") and cat is None:
+            raise ValueError(
+                f"{name!r} is not in obs/metrics.CATALOG — every tdc_* "
+                "family must be declared there first (TDC009 and the "
+                "docs drift test pin the catalog)"
+            )
+        if cat is not None:
+            if typ is not None and typ != cat[0]:
+                raise ValueError(
+                    f"{name}: type {typ!r} != catalog type {cat[0]!r}"
+                )
+            typ = cat[0]
+            help_ = help_ or cat[1]
+        if typ not in _TYPES:
+            raise ValueError(f"{name}: unknown metric type {typ!r}")
+        return typ, (help_ or name)
+
+    def _add(self, metric):
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"metric {metric.name!r} already registered")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def _get_or_make(self, name, typ, help_, factory):
+        typ, help_ = self._resolve(name, typ, help_)
+        with self._lock:
+            existing = self._metrics.get(name)
+        if existing is not None:
+            if existing.typ != typ:
+                raise ValueError(
+                    f"{name} already registered as {existing.typ}, not {typ}"
+                )
+            return existing
+        return self._add(factory(typ, help_))
+
+    def counter(self, name, help_=None, labelnames=()) -> Counter:
+        return self._get_or_make(
+            name, "counter", help_,
+            lambda typ, h: Counter(name, h, labelnames))
+
+    def gauge(self, name, help_=None, labelnames=()) -> Gauge:
+        return self._get_or_make(
+            name, "gauge", help_,
+            lambda typ, h: Gauge(name, h, labelnames))
+
+    def histogram(self, name, buckets=LATENCY_MS_BUCKETS, help_=None,
+                  labelnames=()) -> Histogram:
+        return self._get_or_make(
+            name, "histogram", help_,
+            lambda typ, h: Histogram(name, h, buckets, labelnames))
+
+    def callback(self, name, fn, typ=None, help_=None) -> None:
+        """Register a render-time value source (see _Callback)."""
+        typ, help_ = self._resolve(name, typ, help_)
+        if typ == "histogram":
+            raise ValueError(
+                f"{name}: histogram families need a real Histogram "
+                "(cumulative bucket state), not a callback"
+            )
+        self._add(_Callback(name, typ, help_, fn))
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return list(self._metrics)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            samples = m.samples()
+            if not samples and isinstance(m, _Callback):
+                # A row-valued callback with nothing to report (e.g. no
+                # models registered) still announces the family: HELP/
+                # TYPE with zero samples is valid and keeps the family
+                # discoverable.
+                pass
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.typ}")
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
+
+
+__all__ = [
+    "CATALOG",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LATENCY_MS_BUCKETS",
+    "Registry",
+    "escape_label_value",
+]

@@ -1,0 +1,494 @@
+"""Span tracing: nested wall-clock spans at pass/phase granularity,
+exported as per-process Chrome-trace-event JSON, plus the per-fit
+timeline (`result.timeline`) assembled from the same spans.
+
+Enablement: `$TDC_TRACE=<dir>` in the environment (read at import) or
+`trace.configure(dir)` (the CLI's `--trace <dir>`). Disabled — the
+default — every entry point is a flag check returning a shared no-op:
+no imports, no allocation, no syncs; the streamed drivers' async
+dispatch behavior is untouched (the bench-smoke <=1% overhead bar).
+
+Enabled, the contract changes deliberately at phase boundaries where
+device truth matters: `trace.sync(x)` runs `timing.hard_sync` (a real
+completion fence, not an enqueue ack), so a span that closes over a
+sync reads device wall time, not dispatch time. Per-BATCH compute spans
+stay dispatch-time (a per-batch fence would serialize the pipeline the
+spill tier exists to fill); the per-pass boundary sync is where truth
+is re-established.
+
+Export format: Chrome trace events (`"X"` complete events with ts/dur in
+microseconds, `"i"` instants, `"M"` metadata), one JSON file per process
+(`trace_p<process_index>_<pid>.json`) under the configured directory.
+Spans carry the caller's thread id, so the spill ring's producer
+threads land on their own tracks and the read/stage/H2D overlap is
+visible instead of inferred. Every pass emits a `pass_boundary` instant
+— the alignment anchor `python -m tdc_tpu.obs.merge_trace` uses to put
+N gang processes on one timeline.
+
+Span names are registered in KNOWN_SPANS (the docs/OBSERVABILITY.md
+drift test pins the doc's span table to it), mirroring
+testing/faults.KNOWN_POINTS.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+
+# Every span/instant name the instrumentation emits. Like
+# faults.KNOWN_POINTS: the name is an interface for greps and the merge
+# tool — add here AND to docs/OBSERVABILITY.md when instrumenting a new
+# phase.
+KNOWN_SPANS = frozenset({
+    "fit",            # whole streamed fit (1-D or K-sharded)
+    "pass",           # one accumulation pass over the stream
+    "read",           # pulling the next batch off the (possibly ringed) stream
+    "stage",          # pad/cast/shard/device_put of one batch
+    "compute",        # stats-accumulate dispatch for one batch
+    "reduce",         # the per-pass cross-device reduce (deferred mode)
+    "shift_check",    # centroid update + shift fetch (device truth boundary)
+    "checkpoint",     # one checkpoint save
+    "resident_chunk",  # one compiled R-iteration resident dispatch
+    "final_pass",     # the end-of-fit reporting pass
+    "produce",        # spill-ring producer: read+stage+H2D for one batch
+    "ingest_retry",   # instant: one retried read (data/ingest.py)
+    "pass_boundary",  # instant: gang alignment anchor, args {"pass": n}
+})
+
+# Span name -> per-fit timeline column. shift_check books into reduce_s:
+# it is the per-iteration finalization (update + device-truth fetch), the
+# same budget slot the deferred mode's explicit reduce occupies — so the
+# per_batch and per_pass timelines stay comparable column-for-column.
+_TIMELINE_PHASE = {
+    "read": "read_s",
+    "stage": "stage_s",
+    "compute": "compute_s",
+    "reduce": "reduce_s",
+    "shift_check": "reduce_s",
+    "checkpoint": "ckpt_s",
+}
+
+TIMELINE_COLUMNS = (
+    "pass", "iters", "batches", "read_s", "stage_s", "compute_s",
+    "reduce_s", "ckpt_s", "shift",
+)
+
+_MAX_EVENTS_DEFAULT = 1_000_000
+
+_enabled = False
+_lock = threading.Lock()
+_events: list[dict] = []
+_dropped = 0
+_dir: str | None = None
+_perf_t0 = 0.0
+_wall_t0 = 0.0
+_max_events = _MAX_EVENTS_DEFAULT
+_seen_tids: set[int] = set()
+_atexit_registered = False
+
+_tls = threading.local()  # .timeline (per-fit), .pass_n
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def configure(trace_dir: str) -> None:
+    """Enable tracing; exported JSON lands under `trace_dir` at flush()
+    and process exit. Idempotent; re-configuring redirects the output
+    directory but keeps already-recorded events."""
+    global _enabled, _dir, _perf_t0, _wall_t0, _max_events
+    global _atexit_registered
+    with _lock:
+        _dir = str(trace_dir)
+        if not _enabled:
+            _perf_t0 = time.perf_counter()
+            _wall_t0 = time.time()
+            _enabled = True
+        try:
+            _max_events = int(
+                os.environ.get("TDC_TRACE_MAX_EVENTS", _MAX_EVENTS_DEFAULT)
+            )
+        except ValueError:
+            _max_events = _MAX_EVENTS_DEFAULT
+        if not _atexit_registered:
+            atexit.register(flush)
+            _atexit_registered = True
+
+
+def disable() -> None:
+    """Disable and drop recorded state (tests)."""
+    global _enabled, _dropped
+    with _lock:
+        _enabled = False
+        _events.clear()
+        _seen_tids.clear()
+        _dropped = 0
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _perf_t0) * 1e6
+
+
+def _record(ev: dict) -> None:
+    global _dropped
+    with _lock:
+        if len(_events) >= _max_events:
+            _dropped += 1
+            return
+        tid = ev["tid"]
+        if tid not in _seen_tids:
+            _seen_tids.add(tid)
+            _events.append({
+                "name": "thread_name", "ph": "M", "pid": os.getpid(),
+                "tid": tid,
+                "args": {"name": threading.current_thread().name},
+            })
+        _events.append(ev)
+
+
+class _Span:
+    """One live span; records an 'X' complete event on exit (inclusive
+    wall time — the trace viewer nests children visually) and books its
+    SELF time (inclusive minus nested spans) into the ambient per-fit
+    timeline, so an inline-staged batch's stage_s is not double-counted
+    inside compute_s. `seconds` (inclusive) is readable after exit (the
+    resident loop re-books chunk rows explicitly)."""
+
+    __slots__ = ("name", "args", "_t0", "seconds", "child_seconds")
+
+    def __init__(self, name: str, args: dict):
+        self.name = name
+        self.args = args
+        self.seconds = 0.0
+        self.child_seconds = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        stack = getattr(_tls, "spans", None)
+        if stack is None:
+            stack = _tls.spans = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        self.seconds = dt
+        stack = getattr(_tls, "spans", None) or []
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:  # an unwound raise skipped a child's exit
+            while stack and stack[-1] is not self:
+                stack.pop()
+            if stack:
+                stack.pop()
+        if stack:
+            stack[-1].child_seconds += dt
+        ev = {
+            "name": self.name, "cat": "tdc", "ph": "X",
+            "ts": round((self._t0 - _perf_t0) * 1e6, 3),
+            "dur": round(dt * 1e6, 3),
+            "pid": os.getpid(), "tid": threading.get_ident(),
+        }
+        if self.args:
+            ev["args"] = self.args
+        _record(ev)
+        col = _TIMELINE_PHASE.get(self.name)
+        if col is not None:
+            tl = getattr(_tls, "timeline", None)
+            if tl is not None:
+                tl.add(col, max(dt - self.child_seconds, 0.0),
+                       inc_batches=(self.name == "compute"))
+        return False
+
+
+class _NoopSpan:
+    """Shared disabled-path span: __enter__/__exit__ do nothing."""
+
+    __slots__ = ()
+    seconds = 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, **args):
+    """Context manager for one wall-clock span. No-op when disabled."""
+    if not _enabled:
+        return _NOOP
+    return _Span(name, args)
+
+
+def instant(name: str, **args) -> None:
+    """One instant event (retries, anchors). No-op when disabled."""
+    if not _enabled:
+        return
+    ev = {
+        "name": name, "cat": "tdc", "ph": "i", "s": "p",
+        "ts": round(_now_us(), 3),
+        "pid": os.getpid(), "tid": threading.get_ident(),
+    }
+    if args:
+        ev["args"] = args
+    _record(ev)
+
+
+def sync(target) -> None:
+    """Device-truth fence at a phase boundary: `timing.hard_sync` when
+    tracing is enabled, nothing otherwise — the async-dispatch semantics
+    of untraced runs are untouched."""
+    if not _enabled or target is None:
+        return
+    from tdc_tpu.utils.timing import hard_sync
+
+    hard_sync(target)
+
+
+def timed_iter(it, name: str):
+    """Wrap an iterator so each __next__ is a span (the 'read' phase).
+    Returns `it` unchanged when disabled — zero per-batch overhead."""
+    if not _enabled:
+        return it
+
+    def gen():
+        iterator = iter(it)
+        while True:
+            with span(name):
+                try:
+                    item = next(iterator)
+                except StopIteration:
+                    return
+            yield item
+
+    return gen()
+
+
+# ---------------------------------------------------------------------------
+# Per-fit timeline: per-pass rows assembled from the same spans.
+# ---------------------------------------------------------------------------
+
+
+class Timeline:
+    """Per-fit aggregation of phase spans into per-pass rows.
+
+    Rows are dicts keyed by TIMELINE_COLUMNS; `pass` is the driver's
+    iteration number (0 = the end-of-fit reporting pass), `iters` > 1
+    marks a resident chunk row covering several on-device iterations.
+    Thread-ambient: spans book into the ACTIVATING thread's timeline
+    only (spill producer threads record chrome events on their own
+    track; their staging wall time is deliberately not added to the
+    consumer's per-pass budget — that double-count is exactly what the
+    merged trace view exists to disentangle)."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self._rows: dict[int, dict] = {}
+        self._order: list[int] = []
+        self._current = 0
+
+    def begin_pass(self, n: int) -> None:
+        n = int(n)
+        self._current = n
+        if n not in self._rows:
+            self._rows[n] = {
+                "pass": n, "iters": 1, "batches": 0, "read_s": 0.0,
+                "stage_s": 0.0, "compute_s": 0.0, "reduce_s": 0.0,
+                "ckpt_s": 0.0, "shift": None,
+            }
+            self._order.append(n)
+
+    def add(self, col: str, dt: float, inc_batches: bool = False) -> None:
+        row = self._rows.get(self._current)
+        if row is None:
+            self.begin_pass(self._current)
+            row = self._rows[self._current]
+        row[col] = row[col] + dt
+        if inc_batches:
+            row["batches"] += 1
+
+    def set_shift(self, n: int, shift) -> None:
+        row = self._rows.get(int(n))
+        if row is not None and shift is not None:
+            row["shift"] = float(shift)
+
+    def add_chunk(self, n_end: int, iters: int, seconds: float,
+                  shift) -> None:
+        """One resident chunk dispatch = `iters` on-device iterations
+        ending at iteration `n_end`, booked as a single compute row."""
+        self.begin_pass(n_end)
+        row = self._rows[int(n_end)]
+        row["iters"] = int(iters)
+        row["compute_s"] += float(seconds)
+        if shift is not None:
+            row["shift"] = float(shift)
+
+    def rows(self) -> list[dict]:
+        return [dict(self._rows[n]) for n in self._order]
+
+
+def begin_fit(label: str, **args):
+    """Activate a per-fit Timeline on this thread (None when disabled).
+    The matching end_fit() deactivates and returns the rows; an
+    exception path leaves the stale timeline ambient until the next
+    begin_fit — harmless (phase spans book into a dead object)."""
+    if not _enabled:
+        return None
+    instant("fit", label=label, **args)
+    tl = Timeline(label)
+    _tls.timeline = tl
+    return tl
+
+
+def end_fit(tl) -> list[dict] | None:
+    """Deactivate `tl` and return its per-pass rows (None when tracing
+    was off at begin_fit)."""
+    if tl is None:
+        return None
+    if getattr(_tls, "timeline", None) is tl:
+        _tls.timeline = None
+    return tl.rows()
+
+
+def begin_pass(n_iter: int) -> None:
+    """Open pass `n_iter` on the ambient timeline and emit the gang
+    alignment anchor. No-op when disabled."""
+    if not _enabled:
+        return
+    tl = getattr(_tls, "timeline", None)
+    if tl is not None:
+        tl.begin_pass(n_iter)
+    instant("pass_boundary", **{"pass": int(n_iter)})
+
+
+def timeline_shift(n_iter: int, shift) -> None:
+    if not _enabled:
+        return
+    tl = getattr(_tls, "timeline", None)
+    if tl is not None:
+        tl.set_shift(n_iter, shift)
+
+
+def timeline_chunk(n_end: int, iters: int, seconds: float, shift) -> None:
+    if not _enabled:
+        return
+    tl = getattr(_tls, "timeline", None)
+    if tl is not None:
+        tl.add_chunk(n_end, iters, seconds, shift)
+
+
+def format_timeline(rows, label: str = "") -> str:
+    """Fixed-width table of timeline rows (the CLI's --trace printout)."""
+    if not rows:
+        return "timeline: (no passes recorded)"
+    head = (f"timeline{f' ({label})' if label else ''}:\n"
+            "  pass iters batches   read_s  stage_s compute_s reduce_s"
+            "   ckpt_s      shift")
+    lines = [head]
+    for r in rows:
+        pname = "final" if r["pass"] == 0 else str(r["pass"])
+        shift = "-" if r.get("shift") is None else f"{r['shift']:.3g}"
+        lines.append(
+            f"  {pname:>4} {r['iters']:>5} {r['batches']:>7} "
+            f"{r['read_s']:>8.3f} {r['stage_s']:>8.3f} "
+            f"{r['compute_s']:>9.3f} {r['reduce_s']:>8.3f} "
+            f"{r['ckpt_s']:>8.3f} {shift:>10}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Export
+# ---------------------------------------------------------------------------
+
+
+def _process_index():
+    try:
+        from tdc_tpu.utils.structlog import process_index
+
+        return process_index()
+    except Exception:
+        return None
+
+
+def trace_path() -> str | None:
+    """The file flush() writes (None while disabled/unconfigured)."""
+    if not _enabled or _dir is None:
+        return None
+    idx = _process_index()
+    return os.path.join(
+        _dir, f"trace_p{0 if idx is None else int(idx)}_{os.getpid()}.json"
+    )
+
+
+def flush() -> str | None:
+    """Write the Chrome-trace JSON (atomic replace); returns the path.
+    Safe to call repeatedly — each call rewrites the full event list."""
+    path = trace_path()
+    if path is None:
+        return None
+    with _lock:
+        events = list(_events)
+        dropped = _dropped
+    idx = _process_index()
+    doc = {
+        "traceEvents": [{
+            "name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
+            "args": {"name": (
+                f"tdc p{0 if idx is None else int(idx)} (pid {os.getpid()})"
+            )},
+        }] + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "pid": os.getpid(),
+            "process_index": idx,
+            "wall_t0": _wall_t0,
+            "dropped_events": dropped,
+            "argv": " ".join(sys.argv[:4]),
+        },
+    }
+    os.makedirs(_dir, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+# $TDC_TRACE in the environment enables tracing for any entry point
+# (drivers, gang workers, benchmarks) without a flag to thread through.
+_env_dir = os.environ.get("TDC_TRACE")
+if _env_dir:
+    configure(_env_dir)
+del _env_dir
+
+
+__all__ = [
+    "KNOWN_SPANS",
+    "TIMELINE_COLUMNS",
+    "Timeline",
+    "begin_fit",
+    "begin_pass",
+    "configure",
+    "disable",
+    "enabled",
+    "end_fit",
+    "flush",
+    "format_timeline",
+    "instant",
+    "span",
+    "sync",
+    "timed_iter",
+    "timeline_chunk",
+    "timeline_shift",
+    "trace_path",
+]

@@ -54,7 +54,14 @@ def initialize_distributed(
         _enable_cpu_collectives()
         jax.distributed.initialize(coordinator_address=coordinator_address)
         _reassert_preemption_handler()
-    return jax.process_index(), jax.process_count()
+    out = jax.process_index(), jax.process_count()
+    if out[1] > 1:
+        # Gang log attribution: stamp process_index on every structlog
+        # record (interleaved gang stderr is otherwise unattributable).
+        from tdc_tpu.utils.structlog import set_process_index
+
+        set_process_index(out[0])
+    return out
 
 
 def _reassert_preemption_handler() -> None:

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tdc_tpu.data import ingest as ingest_lib
 from tdc_tpu.data import spill as spill_lib
+from tdc_tpu.obs import trace
 from tdc_tpu.parallel.compat import shard_map
 from tdc_tpu.parallel.meshspec import MeshSpec
 from tdc_tpu.parallel import reshard as reshard_lib
@@ -1197,19 +1198,21 @@ def _make_put_batch(mesh, pad_multiple: int, dtype, spherical: bool = False):
     the towers (the fuzzy cast_dtype episode)."""
 
     def put_batch(batch):
-        batch = np.asarray(batch)
-        n_valid = batch.shape[0]
-        rem = (-n_valid) % pad_multiple
-        if rem:
-            batch = np.pad(batch, ((0, rem), (0, 0)))
-        if dtype is not None:
-            import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
+        with trace.span("stage"):
+            batch = np.asarray(batch)
+            n_valid = batch.shape[0]
+            rem = (-n_valid) % pad_multiple
+            if rem:
+                batch = np.pad(batch, ((0, rem), (0, 0)))
+            if dtype is not None:
+                import ml_dtypes  # noqa: F401 — registers bfloat16 w/ numpy
 
-            batch = batch.astype(np.dtype(dtype))  # host-side cast
-        xb = jax.device_put(batch, NamedSharding(mesh, P(DATA_AXIS, None)))
-        if spherical:
-            xb = _spherical_rows(xb)
-        return xb, n_valid
+                batch = batch.astype(np.dtype(dtype))  # host-side cast
+            xb = jax.device_put(batch,
+                                NamedSharding(mesh, P(DATA_AXIS, None)))
+            if spherical:
+                xb = _spherical_rows(xb)
+            return xb, n_valid
 
     return put_batch
 
@@ -1365,12 +1368,21 @@ def _sharded_stream_loop(
             # for the final reporting pass below.
             cache = use_fill.finish()
         if finalize is not None:
-            acc = finalize(acc, c)
-        c, shift_dev = update(acc, c)
-        sync = tol >= 0 or ckpt_dir is not None
-        shift = float(shift_dev) if sync else shift_dev
+            # The pass's ONE cross-device reduce (per-pass mode); the
+            # span's hard sync (tracing only) reads device truth.
+            with trace.span("reduce", n_iter=n_iter):
+                acc = finalize(acc, c)
+                trace.sync(acc)
+        with trace.span("shift_check", n_iter=n_iter):
+            c, shift_dev = update(acc, c)
+            # Tracing re-establishes device truth per iteration (the
+            # span must not read dispatch time), accepting the fetch the
+            # async path otherwise defers.
+            sync = tol >= 0 or ckpt_dir is not None or trace.enabled()
+            shift = float(shift_dev) if sync else shift_dev
         cost = acc_cost(acc)
         history.append((float(cost) if sync else cost, shift))
+        trace.timeline_shift(n_iter, shift if sync else None)
         done = sync and tol >= 0 and shift <= tol
         if ckpt_dir is not None and (done or n_iter % ckpt_every == 0
                                      or n_iter == max_iters):
@@ -1409,7 +1421,9 @@ def _sharded_stream_loop(
     else:
         final_acc = full_pass(c)
         if finalize is not None:
-            final_acc = finalize(final_acc, c)
+            with trace.span("reduce", n_iter=0):
+                final_acc = finalize(final_acc, c)
+                trace.sync(final_acc)
     return (c, n_iter, start_iter, shift, converged, history, final_acc,
             resident_passes)
 
@@ -1855,6 +1869,8 @@ def streamed_kmeans_fit_sharded(
 
     loop_batches, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     loop_prefetch = prefetch if h2d is None else 0
+    # Per-fit timeline (obs/trace): None unless tracing is enabled.
+    tl = trace.begin_fit("streamed_kmeans_fit_sharded", k=k, d=d)
 
     c, n_iter, start_iter, shift, converged, history, final_acc, res_p = (
         _sharded_stream_loop(
@@ -1896,6 +1912,7 @@ def streamed_kmeans_fit_sharded(
         ingest=guard.report(),
         assign=(None if assign_counter is None
                 else subk_lib.report(aspec, assign_counter)),
+        timeline=trace.end_fit(tl),
     )
 
 
@@ -2243,6 +2260,8 @@ def streamed_fuzzy_fit_sharded(
 
     loop_batches, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     loop_prefetch = prefetch if h2d is None else 0
+    # Per-fit timeline (obs/trace): None unless tracing is enabled.
+    tl = trace.begin_fit("streamed_fuzzy_fit_sharded", k=k, d=d)
 
     c, n_iter, start_iter, shift, converged, history, final_acc, _ = (
         _sharded_stream_loop(
@@ -2274,6 +2293,7 @@ def streamed_fuzzy_fit_sharded(
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
         ingest=guard.report(),
+        timeline=trace.end_fit(tl),
     )
 
 

@@ -98,6 +98,9 @@ class MicroBatcher:
             "batches": 0,
             "queue_wait_ms_total": 0.0,
         }
+        # Optional obs/metrics.Histogram: per-request queue-wait samples
+        # (ServeApp attaches it; None = standalone batcher, no histogram).
+        self.queue_wait_hist = None
 
     # ---------------- client side ----------------
 
@@ -292,6 +295,8 @@ class MicroBatcher:
                 offset += n
                 wait_ms = (now - r.enqueued_at) * 1e3
                 self.stats["queue_wait_ms_total"] += wait_ms
+                if self.queue_wait_hist is not None:
+                    self.queue_wait_hist.observe(wait_ms)
                 if self.log is not None:
                     self.log.event(
                         "request", model=r.model_id, method=r.method,

@@ -95,6 +95,9 @@ class PredictEngine:
             "compiles": 0,
             "device_ms_total": 0.0,
         }
+        # Optional obs/metrics.Histogram: per-batch device-ms samples
+        # (ServeApp attaches it; None = standalone engine, no histogram).
+        self.device_ms_hist = None
         if mesh is not None and len(mesh.devices.shape) != 2:
             raise ValueError(
                 "PredictEngine mesh must be 2-D (data × model); use "
@@ -284,6 +287,8 @@ class PredictEngine:
         self.stats["rows"] += n
         self.stats["padded_rows"] += bucket - n
         self.stats["device_ms_total"] += device_ms
+        if self.device_ms_hist is not None:
+            self.device_ms_hist.observe(device_ms)
         meta = {
             "bucket": bucket,
             "kernel": kernel,

@@ -45,6 +45,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from tdc_tpu.obs import metrics as obs_metrics
 from tdc_tpu.serve.batcher import MicroBatcher, Overloaded
 from tdc_tpu.serve.engine import PredictEngine
 from tdc_tpu.serve.registry import ModelRegistry
@@ -114,9 +115,16 @@ class ServeApp:
         self._poll_task = None
         self._httpd: ThreadingHTTPServer | None = None
         self._counters: collections.Counter = collections.Counter()
-        self._latencies: dict[str, collections.deque] = {
-            ep: collections.deque(maxlen=2048) for ep in _PREDICT_ENDPOINTS
-        }
+        # The central metrics registry (obs/metrics.py): /metrics renders
+        # SOLELY through it. Real fixed-bucket histograms replace the old
+        # recent-window quantile summary, so p50/p99/p999 are derivable
+        # by any Prometheus stack; the engine/batcher observe their
+        # per-batch device-ms / queue-wait samples directly.
+        self.metrics_registry = obs_metrics.Registry()
+        self._online_snapshot: dict[str, dict[str, float]] = {}
+        self._register_metrics()
+        self.engine.device_ms_hist = self._hist_device
+        self.batcher.queue_wait_hist = self._hist_queue
 
     # ---------------- lifecycle ----------------
 
@@ -309,7 +317,7 @@ class ServeApp:
         ms = (time.perf_counter() - t0) * 1e3
         self._counters[(endpoint, status)] += 1
         if status == 200:
-            self._latencies[endpoint].append(ms)
+            self._hist_latency.labels(endpoint=endpoint).observe(ms)
         return status, body
 
     def _request_inner(self, endpoint: str, payload: dict) -> tuple[int, dict]:
@@ -426,147 +434,142 @@ class ServeApp:
 
     # ---------------- metrics ----------------
 
-    def metrics_text(self) -> str:
-        """Prometheus text exposition of the request/batch/engine stats."""
-        from tdc_tpu.data.ingest import GLOBAL_INGEST
-        from tdc_tpu.data.spill import GLOBAL_H2D
-        from tdc_tpu.ops.subk import GLOBAL_ASSIGN
-        from tdc_tpu.parallel.reduce import GLOBAL_COMMS
+    def _register_metrics(self) -> None:
+        """Populate the app's obs/metrics.Registry — the ONE place every
+        tdc_* family this server exports is wired to its value source.
+        Registration order is render order, kept aligned with the
+        pre-registry hand renderer so scrapes stay diffable."""
+        reg = self.metrics_registry
 
-        e, b = self.engine.stats, self.batcher.stats
-        comms = GLOBAL_COMMS.snapshot()
-        h2d = GLOBAL_H2D.snapshot()
-        ing = GLOBAL_INGEST.snapshot()
-        asn = GLOBAL_ASSIGN.snapshot()
-        lines = [
-            "# HELP tdc_serve_requests_total Requests by endpoint and status.",
-            "# TYPE tdc_serve_requests_total counter",
+        reg.callback(
+            "tdc_serve_requests_total",
+            lambda: [
+                ({"endpoint": endpoint, "status": str(status)}, n)
+                for (endpoint, status), n in sorted(self._counters.items())
+            ],
+        )
+        # Engine/batcher scalars read the live stats dicts at render time;
+        # the process-wide fit counters (parallel/reduce, data/spill,
+        # data/ingest, ops/subk) publish through their existing
+        # thread-safe snapshots — the registry is the renderer, the
+        # counters keep their state (and the per-fit report shapes).
+        b, e = self.batcher.stats, self.engine.stats
+        scalars = [
+            ("tdc_serve_batches_total", lambda: b["batches"]),
+            ("tdc_serve_batched_requests_total", lambda: b["requests"]),
+            ("tdc_serve_rejected_total", lambda: b["rejected"]),
+            ("tdc_serve_engine_rows_total", lambda: e["rows"]),
+            ("tdc_serve_engine_padded_rows_total",
+             lambda: e["padded_rows"]),
+            ("tdc_serve_engine_compiles_total", lambda: e["compiles"]),
+            ("tdc_serve_engine_device_ms_total",
+             lambda: round(e["device_ms_total"], 3)),
+            ("tdc_serve_queue_wait_ms_total",
+             lambda: round(b["queue_wait_ms_total"], 3)),
+            ("tdc_serve_models", lambda: len(self.registry.ids())),
+            ("tdc_serve_draining", lambda: int(self._draining)),
         ]
-        for (endpoint, status), n in sorted(self._counters.items()):
-            lines.append(
-                f'tdc_serve_requests_total{{endpoint="{endpoint}",'
-                f'status="{status}"}} {n}'
-            )
-        scalar = [
-            ("tdc_serve_batches_total", "counter",
-             "Coalesced device batches executed.", b["batches"]),
-            ("tdc_serve_batched_requests_total", "counter",
-             "Requests that went through the batcher.", b["requests"]),
-            ("tdc_serve_rejected_total", "counter",
-             "Requests rejected with overloaded backpressure.",
-             b["rejected"]),
-            ("tdc_serve_engine_rows_total", "counter",
-             "Real data rows computed on device.", e["rows"]),
-            ("tdc_serve_engine_padded_rows_total", "counter",
-             "Bucket-padding rows computed on device.", e["padded_rows"]),
-            ("tdc_serve_engine_compiles_total", "counter",
-             "jit traces paid (bucket warmup).", e["compiles"]),
-            ("tdc_serve_engine_device_ms_total", "counter",
-             "Device compute milliseconds.",
-             round(e["device_ms_total"], 3)),
-            ("tdc_serve_queue_wait_ms_total", "counter",
-             "Milliseconds requests spent queued before dispatch.",
-             round(b["queue_wait_ms_total"], 3)),
-            ("tdc_serve_models", "gauge",
-             "Models currently registered.", len(self.registry.ids())),
-            ("tdc_serve_draining", "gauge",
-             "1 while the server is draining (rejecting new work, "
-             "flushing in-flight batches).", int(self._draining)),
-            # Process-wide stats-reduce accounting (parallel/reduce.py):
-            # cross-device sufficient-stat reduces issued by fits running
-            # in this process, and the logical payload bytes they moved.
-            ("tdc_comms_stats_reduces_total", "counter",
-             "Cross-device stats reduces issued (parallel/reduce).",
-             comms["reduces"]),
-            ("tdc_comms_stats_logical_bytes_total", "counter",
-             "Logical payload bytes moved by stats reduces.",
-             comms["logical_bytes"]),
-            # Spill-tier H2D prefetch-ring accounting (data/spill.py):
-            # bytes staged host->device ahead of compute by fits running
-            # in this process, how much of that copy time the consumer
-            # still stalled on, and the deepest ring fill observed.
-            ("tdc_h2d_bytes_total", "counter",
-             "Logical host->device bytes staged by the spill prefetch "
-             "ring (data/spill.py).", h2d["h2d_bytes"]),
-            ("tdc_h2d_batches_total", "counter",
-             "Batches staged through the spill prefetch ring.",
-             h2d["batches"]),
-            ("tdc_h2d_copy_stall_seconds_total", "counter",
-             "Seconds spill-fit consumers stalled waiting on H2D "
-             "staging (copy time the overlap failed to hide).",
-             round(h2d["stall_s"], 3)),
-            ("tdc_h2d_prefetch_depth", "gauge",
-             "Deepest spill prefetch-ring fill observed.",
-             h2d["depth_max"]),
-            # Hardened-ingest accounting (data/ingest.py): stream read
-            # retries/failures and corrupt-batch quarantines booked by
-            # fits running in this process. A rising retry counter means
-            # a flaky store; ANY quarantine deserves triage (see
-            # docs/OPERATIONS.md "Flaky or corrupt input data").
-            ("tdc_ingest_retries_total", "counter",
-             "Stream read attempts retried after transient failures "
-             "(data/ingest.py).", ing["retries"]),
-            ("tdc_ingest_read_failures_total", "counter",
-             "Stream reads abandoned: permanent classification or "
-             "retries/deadline exhausted.", ing["read_failures"]),
-            ("tdc_ingest_quarantined_batches_total", "counter",
-             "Batches quarantined (zero mass) by the ingest integrity "
-             "screen.", ing["quarantined_batches"]),
-            ("tdc_ingest_quarantined_rows_total", "counter",
-             "Rows held by quarantined batches.",
-             ing["quarantined_rows"]),
-            ("tdc_ingest_crc_failures_total", "counter",
-             "Quarantines caused by CRC sidecar mismatches "
-             "(corrupt-on-disk).", ing["crc_failures"]),
-            # Sub-linear-assignment accounting (ops/subk.py): centroid
-            # tiles scanned vs total across coarse-assignment refine
-            # steps booked by fits running in this process. The pruned
-            # fraction is the FLOP reduction the coarse path bought; a
-            # fraction near 0 on an assign=coarse fit means probe ~
-            # n_tiles and the knobs need retuning (docs/OPERATIONS.md).
-            ("tdc_assign_tiles_probed_total", "counter",
-             "Centroid tiles scanned by coarse-assignment refine steps "
-             "(ops/subk.py).", asn["tiles_probed"]),
-            ("tdc_assign_tiles_total", "counter",
-             "Centroid tiles an exact all-K scan would have touched "
-             "across the same refine steps.", asn["tiles_total"]),
-            ("tdc_assign_pruned_fraction", "gauge",
-             "Fraction of centroid tiles pruned by coarse assignment "
-             "(1 - probed/total; 0 when no coarse fit ran).",
-             round(1.0 - asn["tiles_probed"] / asn["tiles_total"], 6)
-             if asn["tiles_total"] else 0.0),
+
+        def _comms():
+            from tdc_tpu.parallel.reduce import GLOBAL_COMMS
+
+            return GLOBAL_COMMS.snapshot()
+
+        def _h2d():
+            from tdc_tpu.data.spill import GLOBAL_H2D
+
+            return GLOBAL_H2D.snapshot()
+
+        def _ing():
+            from tdc_tpu.data.ingest import GLOBAL_INGEST
+
+            return GLOBAL_INGEST.snapshot()
+
+        def _asn():
+            from tdc_tpu.ops.subk import GLOBAL_ASSIGN
+
+            return GLOBAL_ASSIGN.snapshot()
+
+        def _pruned():
+            asn = _asn()
+            return (round(1.0 - asn["tiles_probed"] / asn["tiles_total"], 6)
+                    if asn["tiles_total"] else 0.0)
+
+        scalars += [
+            ("tdc_comms_stats_reduces_total",
+             lambda: _comms()["reduces"]),
+            ("tdc_comms_stats_logical_bytes_total",
+             lambda: _comms()["logical_bytes"]),
+            ("tdc_h2d_bytes_total", lambda: _h2d()["h2d_bytes"]),
+            ("tdc_h2d_batches_total", lambda: _h2d()["batches"]),
+            ("tdc_h2d_copy_stall_seconds_total",
+             lambda: round(_h2d()["stall_s"], 3)),
+            ("tdc_h2d_prefetch_depth", lambda: _h2d()["depth_max"]),
+            ("tdc_ingest_retries_total", lambda: _ing()["retries"]),
+            ("tdc_ingest_read_failures_total",
+             lambda: _ing()["read_failures"]),
+            ("tdc_ingest_quarantined_batches_total",
+             lambda: _ing()["quarantined_batches"]),
+            ("tdc_ingest_quarantined_rows_total",
+             lambda: _ing()["quarantined_rows"]),
+            ("tdc_ingest_crc_failures_total",
+             lambda: _ing()["crc_failures"]),
+            ("tdc_assign_tiles_probed_total",
+             lambda: _asn()["tiles_probed"]),
+            ("tdc_assign_tiles_total", lambda: _asn()["tiles_total"]),
+            ("tdc_assign_pruned_fraction", _pruned),
         ]
-        for name, typ, help_, val in scalar:
-            lines += [f"# HELP {name} {help_}", f"# TYPE {name} {typ}",
-                      f"{name} {val}"]
+        for name, fn in scalars:
+            reg.callback(name, fn)
+
         # Per-model generation/staleness: generation is the registry's
         # monotonic reload counter (bumps on every swap, incl. online
         # publishes and rollbacks); age is seconds since that generation
         # went live — the "never goes stale" dashboard signal.
-        now = time.time()
-        entries = self.registry.entries()
-        lines += [
-            "# HELP tdc_model_generation Monotonic reload generation per "
-            "model.",
-            "# TYPE tdc_model_generation gauge",
-        ]
-        lines += [
-            f'tdc_model_generation{{model="{e.model_id}"}} {e.generation}'
-            for e in entries
-        ]
-        lines += [
-            "# HELP tdc_model_generation_age_seconds Seconds since the "
-            "serving generation was loaded.",
-            "# TYPE tdc_model_generation_age_seconds gauge",
-        ]
-        lines += [
-            f'tdc_model_generation_age_seconds{{model="{e.model_id}"}} '
-            f"{round(now - e.loaded_at, 3)}"
-            for e in entries
-        ]
-        # Online-update pipeline counters/gauges: live from in-process
-        # updaters; for sidecar-managed model dirs, from the ledger the
-        # sidecar atomically publishes next to the manifest.
+        reg.callback(
+            "tdc_model_generation",
+            lambda: [({"model": en.model_id}, en.generation)
+                     for en in self.registry.entries()],
+        )
+        reg.callback(
+            "tdc_model_generation_age_seconds",
+            lambda: [
+                ({"model": en.model_id}, round(time.time() - en.loaded_at, 3))
+                for en in self.registry.entries()
+            ],
+        )
+        # Online-update pipeline counters/gauges: metrics_text refreshes
+        # self._online_snapshot ONCE per scrape (live updaters + sidecar
+        # ledgers — file reads the 13 family callbacks must not repeat).
+        for name in sorted(n for n in obs_metrics.CATALOG
+                           if n.startswith("tdc_online_")):
+            reg.callback(
+                name,
+                (lambda nm: lambda: [
+                    ({"model": mid}, vals[nm])
+                    for mid, vals in sorted(self._online_snapshot.items())
+                    if nm in vals
+                ])(name),
+            )
+        # Real fixed-bucket latency histograms (PR 12): p50/p99/p999 are
+        # derivable from the scrape by any Prometheus stack — the
+        # precondition for the ROADMAP item-3c closed-loop load harness.
+        self._hist_latency = reg.histogram(
+            "tdc_serve_latency_ms", labelnames=("endpoint",)
+        )
+        self._hist_queue = reg.histogram("tdc_serve_queue_wait_ms")
+        self._hist_device = reg.histogram("tdc_serve_engine_batch_device_ms")
+        # Scrape-health idioms.
+        from tdc_tpu import __version__
+
+        reg.callback("tdc_build_info",
+                     lambda: [({"version": __version__}, 1)])
+        reg.callback("tdc_up", lambda: 1)
+
+    def _collect_online(self) -> dict[str, dict[str, float]]:
+        """model id -> flat online metrics: live from in-process
+        updaters; for sidecar-managed model dirs, from the ledger the
+        sidecar atomically publishes next to the manifest."""
         online: dict[str, dict[str, float]] = {}
         for mid, updater in self.updaters.items():
             online[mid] = updater.metrics()
@@ -581,34 +584,13 @@ class ServeApp:
             led = ledger_metrics(mpath)
             if led is not None:
                 online[mid] = led
-        online_names: dict[str, list[str]] = {}
-        for mid, vals in sorted(online.items()):
-            for name, val in vals.items():
-                online_names.setdefault(name, []).append(
-                    f'{name}{{model="{mid}"}} {val}'
-                )
-        for name, rows in sorted(online_names.items()):
-            typ = "counter" if name.endswith("_total") else "gauge"
-            lines += [
-                f"# HELP {name} serve/online updater metric.",
-                f"# TYPE {name} {typ}",
-            ] + rows
-        lines += [
-            "# HELP tdc_serve_latency_ms Recent end-to-end latency "
-            "quantiles per endpoint.",
-            "# TYPE tdc_serve_latency_ms summary",
-        ]
-        for endpoint, window in sorted(self._latencies.items()):
-            if not window:
-                continue
-            arr = np.asarray(window)
-            for q in (0.5, 0.9, 0.99):
-                lines.append(
-                    f'tdc_serve_latency_ms{{endpoint="{endpoint}",'
-                    f'quantile="{q}"}} '
-                    f"{round(float(np.quantile(arr, q)), 3)}"
-                )
-        return "\n".join(lines) + "\n"
+        return online
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition — rendered solely through the
+        obs/metrics registry."""
+        self._online_snapshot = self._collect_online()
+        return self.metrics_registry.render()
 
     # ---------------- HTTP transport ----------------
 

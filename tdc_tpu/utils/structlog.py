@@ -12,6 +12,34 @@ import os
 import sys
 import time
 
+# Gang process index, stamped on every record once distributed init has
+# resolved it (parallel/multihost calls set_process_index). Interleaved
+# gang logs on a shared stderr are unattributable without it — pid alone
+# does not survive a relaunch, and grepping by pid across attempts pairs
+# nothing.
+_PROCESS_INDEX: int | None = None
+
+
+def set_process_index(index: int | None) -> None:
+    """Record this process's gang index for log attribution (multihost
+    init calls this; None clears — tests)."""
+    global _PROCESS_INDEX
+    _PROCESS_INDEX = None if index is None else int(index)
+
+
+def process_index() -> int | None:
+    return _PROCESS_INDEX
+
+
+def _stamp(rec: dict) -> dict:
+    """pid always, process_index when distributed init resolved one.
+    Stamped BEFORE caller fields so an explicit pid=/process_index=
+    field wins (the supervisor echoes workers' records verbatim)."""
+    rec["pid"] = os.getpid()
+    if _PROCESS_INDEX is not None:
+        rec["process_index"] = _PROCESS_INDEX
+    return rec
+
 
 def emit(event: str, **fields) -> None:
     """One ad-hoc JSONL ops/recovery event: always to stderr, and appended
@@ -22,7 +50,7 @@ def emit(event: str, **fields) -> None:
     echo): recovery events land machine-parseable next to the serve
     request log instead of as raw prose on stderr. Never raises.
     """
-    rec = {"ts": round(time.time(), 3), "event": event}
+    rec = _stamp({"ts": round(time.time(), 3), "event": event})
     rec.update(fields)
     line = json.dumps(rec, default=str)
     print(line, file=sys.stderr, flush=True)
@@ -46,7 +74,7 @@ class RunLog:
     def event(self, name: str, **fields) -> None:
         if not self.path:
             return
-        rec = {"ts": round(time.time(), 3), "event": name}
+        rec = _stamp({"ts": round(time.time(), 3), "event": name})
         rec.update(fields)
         with open(self.path, "a") as f:
             f.write(json.dumps(rec, default=str) + "\n")

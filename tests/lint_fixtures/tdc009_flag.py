@@ -1,0 +1,17 @@
+"""MUST-FLAG TDC009: references that drift from the CATALOG registry —
+a typo'd family, an unregistered family, a histogram suffix on an
+unregistered base — plus catalog hygiene (computed key, bad charset)."""
+
+SERVE_LATENCY = "tdc_serve_latency_ms"
+
+CATALOG = {
+    "tdc_serve_requests_total": ("counter", "Requests."),
+    SERVE_LATENCY: ("histogram", "computed key: uncheckable"),  # flagged
+    "tdc_Serve_MixedCase": ("gauge", "bad charset"),  # flagged
+}
+
+
+def dashboard_queries(metrics_text):
+    assert "tdc_serve_request_total" in metrics_text  # typo: missing 's'
+    assert "tdc_never_registered_total" in metrics_text  # no such family
+    assert "tdc_queue_wait_ms_bucket" in metrics_text  # unregistered base

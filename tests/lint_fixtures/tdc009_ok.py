@@ -1,0 +1,23 @@
+"""MUST-NOT-FLAG TDC009: references match the CATALOG registry exactly,
+including histogram series suffixes (_bucket/_sum/_count resolve to the
+family name), non-metric tdc_ literals (the package name, the exit
+barrier tag), and prefix literals (trailing underscore = string
+matching, not a series name)."""
+
+CATALOG = {
+    "tdc_serve_requests_total": ("counter", "Requests."),
+    "tdc_serve_latency_ms": ("histogram", "Latency."),
+    "tdc_up": ("gauge", "Scrape health."),
+}
+
+
+def render_and_assert(metrics_text):
+    assert "tdc_serve_requests_total" in metrics_text
+    assert 'tdc_serve_latency_ms_bucket{le="+Inf"}' .split("{")[0]
+    assert "tdc_serve_latency_ms_sum" in metrics_text
+    assert "tdc_serve_latency_ms_count" in metrics_text
+    assert "tdc_up" in metrics_text
+    package = "tdc_tpu"  # not a metric: package name
+    barrier = "tdc_exit"  # not a metric: multihost barrier tag
+    prefix = "tdc_serve_"  # not a metric: a startswith() prefix
+    return package, barrier, prefix

@@ -47,6 +47,8 @@ RULES = [
     ("TDC006", 4),  # f-string, bad charset, collision (both spellings)
     ("TDC007", 3),  # clock-derived name, random resume, uuid dir
     ("TDC008", 2),  # undeclared literal, typo'd axis_name kwarg
+    ("TDC009", 5),  # typo'd ref, unregistered ref, suffixed ref,
+    #                 computed catalog key, bad-charset catalog key
 ]
 
 

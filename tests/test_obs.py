@@ -1,0 +1,743 @@
+"""Unified telemetry layer (tdc_tpu.obs, PR 12): the metrics registry +
+Prometheus renderer (validator + pre-PR-12 golden compat), span tracing
+with per-fit timelines, the gang trace merger, the structlog pid /
+process_index stamps, and the docs/OBSERVABILITY.md drift tests.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+from tdc_tpu.obs import merge_trace as merge_mod
+from tdc_tpu.obs import metrics as obs_metrics
+from tdc_tpu.obs import trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _trace_reset():
+    """Tracing is process-global; never leak an enabled tracer into
+    other test files."""
+    yield
+    trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format validator
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"        # series name
+    r"(?:\{(.*)\})?"                       # optional label block
+    r" (-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|NaN))$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_labels(block: str) -> dict:
+    out = dict(_LABEL_RE.findall(block))
+    # The label block must be fully consumed by well-formed pairs —
+    # anything left over means broken escaping.
+    rebuilt = ",".join(f'{k}="{v}"' for k, v in out.items())
+    assert rebuilt == block, f"malformed label block: {block!r}"
+    return out
+
+
+def validate_prometheus_text(text: str) -> list[str]:
+    """Validate a /metrics payload: HELP/TYPE pairing before samples,
+    parseable samples + label escaping, no duplicate series, histogram
+    bucket monotonicity and the +Inf/_sum/_count invariants. Returns a
+    list of human-readable violations (empty = valid)."""
+    errors: list[str] = []
+    helps: dict[str, str] = {}
+    types: dict[str, str] = {}
+    seen_series: set[tuple] = set()
+    hists: dict[tuple, dict] = {}  # (family, labelkey) -> {les, sum, count}
+
+    def family_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                if types.get(base) == "histogram":
+                    return base
+        return name
+
+    for ln in text.rstrip("\n").split("\n"):
+        if ln.startswith("# HELP "):
+            parts = ln.split(" ", 3)
+            if len(parts) < 4 or not parts[3].strip():
+                errors.append(f"HELP without text: {ln!r}")
+                continue
+            helps[parts[2]] = parts[3]
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split(" ")
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                errors.append(f"bad TYPE line: {ln!r}")
+                continue
+            if parts[2] in types:
+                errors.append(f"duplicate TYPE for {parts[2]}")
+            types[parts[2]] = parts[3]
+            continue
+        if ln.startswith("#") or not ln.strip():
+            continue
+        m = _SAMPLE_RE.match(ln)
+        if m is None:
+            errors.append(f"unparseable sample line: {ln!r}")
+            continue
+        name, block, value = m.group(1), m.group(2), float(m.group(3))
+        labels = {}
+        if block is not None:
+            try:
+                labels = _parse_labels(block)
+            except AssertionError as e:
+                errors.append(str(e))
+                continue
+        fam = family_of(name)
+        if fam not in helps:
+            errors.append(f"sample {name} has no preceding HELP for {fam}")
+        if fam not in types:
+            errors.append(f"sample {name} has no preceding TYPE for {fam}")
+        key = (name, tuple(sorted(labels.items())))
+        if key in seen_series:
+            errors.append(f"duplicate series {key}")
+        seen_series.add(key)
+        if types.get(fam) == "histogram":
+            sub = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            h = hists.setdefault((fam, sub),
+                                 {"les": [], "sum": None, "count": None})
+            if name.endswith("_bucket"):
+                if "le" not in labels:
+                    errors.append(f"bucket without le: {ln!r}")
+                else:
+                    h["les"].append((labels["le"], value))
+            elif name.endswith("_sum"):
+                h["sum"] = value
+            elif name.endswith("_count"):
+                h["count"] = value
+            else:
+                errors.append(f"bare sample {name} for histogram {fam}")
+
+    for (fam, sub), h in hists.items():
+        where = f"{fam}{dict(sub) if sub else ''}"
+        if not h["les"]:
+            errors.append(f"{where}: no buckets")
+            continue
+        if h["les"][-1][0] != "+Inf":
+            errors.append(f"{where}: last bucket is not +Inf")
+        counts = [v for _, v in h["les"]]
+        if any(b > a for a, b in zip(counts[1:], counts)):
+            errors.append(f"{where}: bucket counts not monotone: {counts}")
+        finite = [float(le) for le, _ in h["les"][:-1]]
+        if finite != sorted(finite):
+            errors.append(f"{where}: le thresholds not sorted: {finite}")
+        if h["count"] is None:
+            errors.append(f"{where}: missing _count")
+        elif counts and counts[-1] != h["count"]:
+            errors.append(
+                f"{where}: +Inf bucket {counts[-1]} != _count {h['count']}"
+            )
+        if h["sum"] is None:
+            errors.append(f"{where}: missing _sum")
+    return errors
+
+
+def _fresh_app():
+    from tdc_tpu.serve.server import ServeApp
+
+    return ServeApp(poll_interval=0)
+
+
+class TestRegistry:
+    def test_counter_gauge_roundtrip(self):
+        reg = obs_metrics.Registry()
+        c = reg.counter("toy_requests_total", "Toy.",
+                        labelnames=("endpoint",))
+        c.labels(endpoint="predict").inc()
+        c.labels(endpoint="predict").inc(2)
+        g = reg.gauge("toy_depth", "Toy gauge.")
+        g.set(7)
+        text = reg.render()
+        assert 'toy_requests_total{endpoint="predict"} 3' in text
+        assert "toy_depth 7" in text
+        assert validate_prometheus_text(text) == []
+
+    def test_get_or_create_and_type_conflict(self):
+        reg = obs_metrics.Registry()
+        a = reg.counter("toy_total", "Toy.")
+        assert reg.counter("toy_total") is a
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("toy_total", "Toy.")
+
+    def test_unknown_tdc_name_refused(self):
+        reg = obs_metrics.Registry()
+        with pytest.raises(ValueError, match="CATALOG"):
+            reg.counter("tdc_not_in_catalog_total", "nope")  # tdclint: disable=TDC009 deliberately-unregistered name proving the registry refuses it
+
+    def test_catalog_names_are_valid(self):
+        for name, (typ, help_) in obs_metrics.CATALOG.items():
+            assert re.match(r"^tdc_[a-z0-9_]*[a-z0-9]$", name), name
+            assert typ in ("counter", "gauge", "histogram"), name
+            assert help_.strip(), name
+
+    def test_label_escaping(self):
+        reg = obs_metrics.Registry()
+        g = reg.gauge("toy_esc", "Esc.", labelnames=("path",))
+        g.labels(path='a"b\\c\nd').set(1)
+        text = reg.render()
+        assert 'path="a\\"b\\\\c\\nd"' in text
+        assert validate_prometheus_text(text) == []
+
+    def test_histogram_invariants(self):
+        reg = obs_metrics.Registry()
+        h = reg.histogram("toy_lat_ms", buckets=(1.0, 10.0, 100.0),
+                          help_="Toy latency.")
+        for v in (0.5, 5.0, 5.0, 50.0, 5000.0):
+            h.observe(v)
+        text = reg.render()
+        assert validate_prometheus_text(text) == []
+        assert 'toy_lat_ms_bucket{le="1.0"} 1' in text
+        assert 'toy_lat_ms_bucket{le="10.0"} 3' in text
+        assert 'toy_lat_ms_bucket{le="100.0"} 4' in text
+        assert 'toy_lat_ms_bucket{le="+Inf"} 5' in text
+        assert "toy_lat_ms_count 5" in text
+
+    def test_histogram_quantile_derivable(self):
+        """The point of the migration: a p99 estimate is computable from
+        the rendered buckets alone (what any Prometheus stack does)."""
+        reg = obs_metrics.Registry()
+        h = reg.histogram("toy_p99_ms", buckets=(1.0, 5.0, 25.0, 100.0),
+                          help_="Toy.")
+        for _ in range(99):
+            h.observe(3.0)
+        h.observe(80.0)
+        child = h._default()
+        cum, total = 0, child.count
+        for ub, n in zip(h.buckets, child.counts):
+            cum += n
+            if cum >= 0.99 * total:
+                break
+        assert ub == 5.0  # p99 lands in the 5ms bucket
+        # and the straggler is visible at p999+
+        assert child.counts[3] == 1
+
+
+class TestServeMetricsPayload:
+    def test_full_payload_validates(self):
+        app = _fresh_app()
+        # Populate every sample source: request counters, latency/queue/
+        # device histograms, batcher/engine stats.
+        app.request("predict", {"model": "m", "points": [[1.0, 2.0]]})
+        app._hist_latency.labels(endpoint="predict").observe(3.25)
+        app._hist_latency.labels(endpoint="transform").observe(11000.0)
+        app._hist_queue.observe(0.3)
+        app._hist_device.observe(7.5)
+        app.batcher.stats["batches"] += 2
+        app.batcher.stats["queue_wait_ms_total"] += 0.6
+        app.engine.stats["device_ms_total"] += 15.0
+        text = app.metrics_text()
+        assert validate_prometheus_text(text) == []
+
+    def test_every_pre_pr12_family_survives(self):
+        """Golden compat: every tdc_* family the pre-registry renderer
+        exported still renders (names pinned here independently of
+        CATALOG, so editing the catalog cannot silently drop one)."""
+        pre = [
+            "tdc_serve_requests_total", "tdc_serve_batches_total",
+            "tdc_serve_batched_requests_total", "tdc_serve_rejected_total",
+            "tdc_serve_engine_rows_total",
+            "tdc_serve_engine_padded_rows_total",
+            "tdc_serve_engine_compiles_total",
+            "tdc_serve_engine_device_ms_total",
+            "tdc_serve_queue_wait_ms_total", "tdc_serve_models",
+            "tdc_serve_draining", "tdc_comms_stats_reduces_total",
+            "tdc_comms_stats_logical_bytes_total", "tdc_h2d_bytes_total",
+            "tdc_h2d_batches_total", "tdc_h2d_copy_stall_seconds_total",
+            "tdc_h2d_prefetch_depth", "tdc_ingest_retries_total",
+            "tdc_ingest_read_failures_total",
+            "tdc_ingest_quarantined_batches_total",
+            "tdc_ingest_quarantined_rows_total",
+            "tdc_ingest_crc_failures_total",
+            "tdc_assign_tiles_probed_total", "tdc_assign_tiles_total",
+            "tdc_assign_pruned_fraction", "tdc_model_generation",
+            "tdc_model_generation_age_seconds",
+            "tdc_online_quarantined_batches_total",
+            "tdc_online_observed_batches_total", "tdc_online_folds_total",
+            "tdc_online_publishes_total",
+            "tdc_online_rejected_candidates_total",
+            "tdc_online_rollbacks_total", "tdc_online_pending_rows",
+            "tdc_online_holdback_rows", "tdc_online_pinned",
+            "tdc_serve_latency_ms",
+        ]
+        text = _fresh_app().metrics_text()
+        for name in pre:
+            assert f"# HELP {name} " in text, f"family {name} disappeared"
+            assert f"# TYPE {name} " in text, f"family {name} lost TYPE"
+            assert name in obs_metrics.CATALOG, f"{name} not in CATALOG"
+
+    def test_scalar_blocks_byte_compatible(self):
+        """The exact pre-PR-12 bytes for the app-local scalar families
+        (HELP + TYPE + zero-state sample)."""
+        text = _fresh_app().metrics_text()
+        for block in [
+            "# HELP tdc_serve_batches_total Coalesced device batches "
+            "executed.\n# TYPE tdc_serve_batches_total counter\n"
+            "tdc_serve_batches_total 0\n",
+            "# HELP tdc_serve_rejected_total Requests rejected with "
+            "overloaded backpressure.\n# TYPE tdc_serve_rejected_total "
+            "counter\ntdc_serve_rejected_total 0\n",
+            "# HELP tdc_serve_engine_device_ms_total Device compute "
+            "milliseconds.\n# TYPE tdc_serve_engine_device_ms_total "
+            "counter\ntdc_serve_engine_device_ms_total 0.0\n",
+            "# HELP tdc_serve_queue_wait_ms_total Milliseconds requests "
+            "spent queued before dispatch.\n"
+            "# TYPE tdc_serve_queue_wait_ms_total counter\n"
+            "tdc_serve_queue_wait_ms_total 0.0\n",
+            "# HELP tdc_serve_models Models currently registered.\n"
+            "# TYPE tdc_serve_models gauge\ntdc_serve_models 0\n",
+            "# HELP tdc_serve_draining 1 while the server is draining "
+            "(rejecting new work, flushing in-flight batches).\n"
+            "# TYPE tdc_serve_draining gauge\ntdc_serve_draining 0\n",
+        ]:
+            assert block in text, f"byte-compat block missing:\n{block}"
+
+    def test_requests_total_labels_byte_compatible(self):
+        app = _fresh_app()
+        # Not started -> 503; the labeled sample must render exactly as
+        # the old f-string did.
+        status, _ = app.request("predict", {"model": "m", "points": [[1.0]]})
+        assert status == 503
+        text = app.metrics_text()
+        assert ('tdc_serve_requests_total{endpoint="predict",'
+                'status="503"} 1') in text
+
+    def test_latency_is_a_real_histogram(self):
+        app = _fresh_app()
+        app._hist_latency.labels(endpoint="predict").observe(2.0)
+        text = app.metrics_text()
+        assert "# TYPE tdc_serve_latency_ms histogram" in text
+        assert ('tdc_serve_latency_ms_bucket{endpoint="predict",'
+                'le="+Inf"} 1') in text
+        assert 'tdc_serve_latency_ms_count{endpoint="predict"} 1' in text
+        assert 'quantile=' not in text  # the summary is gone
+
+    def test_build_info_and_up(self):
+        import tdc_tpu
+
+        text = _fresh_app().metrics_text()
+        assert f'tdc_build_info{{version="{tdc_tpu.__version__}"}} 1' in text
+        assert "\ntdc_up 1\n" in text
+
+    def test_rendered_families_all_in_catalog(self):
+        """Everything /metrics renders is a registered catalog family —
+        the registry cannot export an undeclared name."""
+        app = _fresh_app()
+        for name in app.metrics_registry.names():
+            assert name in obs_metrics.CATALOG, name
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+# ---------------------------------------------------------------------------
+
+
+class TestTrace:
+    def test_disabled_is_noop(self):
+        assert not trace.enabled()
+        s1, s2 = trace.span("pass"), trace.span("compute")
+        assert s1 is s2  # the shared no-op singleton
+        it = iter([1, 2])
+        assert trace.timed_iter(it, "read") is it
+        assert trace.begin_fit("x") is None
+        assert trace.end_fit(None) is None
+        trace.instant("pass_boundary")  # no crash, nothing recorded
+        assert trace.trace_path() is None
+        assert trace.flush() is None
+
+    def test_span_export_and_nesting(self, tmp_path):
+        trace.configure(str(tmp_path))
+        with trace.span("pass", n_iter=1):
+            with trace.span("compute", batch=0):
+                time.sleep(0.01)
+        trace.instant("pass_boundary", **{"pass": 1})
+        path = trace.flush()
+        doc = json.load(open(path))
+        assert os.path.basename(path).startswith("trace_p0_")
+        evs = {e["name"]: e for e in doc["traceEvents"]}
+        assert evs["pass"]["ph"] == "X" and evs["compute"]["ph"] == "X"
+        # nesting: child interval inside parent interval, same track
+        p, c = evs["pass"], evs["compute"]
+        assert c["ts"] >= p["ts"] - 1e-3
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 1e-3
+        assert c["tid"] == p["tid"] and c["pid"] == p["pid"]
+        assert evs["pass_boundary"]["ph"] == "i"
+        assert evs["pass_boundary"]["args"]["pass"] == 1
+        assert doc["otherData"]["pid"] == os.getpid()
+        assert "wall_t0" in doc["otherData"]
+
+    def test_timeline_self_time(self, tmp_path):
+        """A nested stage span's time is NOT double-counted into the
+        enclosing compute span's timeline column."""
+        trace.configure(str(tmp_path))
+        tl = trace.begin_fit("toy")
+        trace.begin_pass(1)
+        with trace.span("compute"):
+            with trace.span("stage"):
+                time.sleep(0.05)
+        rows = trace.end_fit(tl)
+        (row,) = rows
+        assert row["stage_s"] >= 0.04
+        assert row["compute_s"] < 0.04  # self time only
+        assert row["batches"] == 1
+
+    def test_known_spans_registry(self):
+        # Instrumentation emits only registered names (grep contract).
+        assert "pass_boundary" in trace.KNOWN_SPANS
+        for name in trace._TIMELINE_PHASE:
+            assert name in trace.KNOWN_SPANS
+
+
+def _chrome_assert_nested(doc):
+    """Every X event must be properly nested per (pid, tid): intervals
+    either disjoint or contained (the obs-smoke span-nesting check)."""
+    by_track: dict[tuple, list] = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "X":
+            by_track.setdefault((e["pid"], e["tid"]), []).append(
+                (float(e["ts"]), float(e["ts"]) + float(e["dur"]))
+            )
+    eps = 1e-2
+    for track, spans in by_track.items():
+        spans.sort()
+        for (a0, a1) in spans:
+            for (b0, b1) in spans:
+                if (a0, a1) == (b0, b1):
+                    continue
+                disjoint = b0 >= a1 - eps or b1 <= a0 + eps
+                contained = (b0 >= a0 - eps and b1 <= a1 + eps) or \
+                            (a0 >= b0 - eps and a1 <= b1 + eps)
+                assert disjoint or contained, (
+                    f"overlapping non-nested spans on {track}: "
+                    f"{(a0, a1)} vs {(b0, b1)}"
+                )
+
+
+class TestTracedFits:
+    def test_streamed_1d_traced(self, tmp_path):
+        from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+        trace.configure(str(tmp_path))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(512, 8)).astype(np.float32)
+        batches = lambda: iter(np.split(x, 4))  # noqa: E731
+        ckpt = str(tmp_path / "ckpt")
+        res = streamed_kmeans_fit(batches, 4, 8, init=x[:4], max_iters=3,
+                                  tol=-1.0, ckpt_dir=ckpt, ckpt_every=1)
+        rows = res.timeline
+        assert rows is not None and len(rows) == 4  # 3 passes + final
+        for r in rows[:-1]:
+            assert r["batches"] == 4
+            assert r["compute_s"] > 0.0
+            assert r["shift"] is not None
+        assert rows[0]["ckpt_s"] > 0.0  # ckpt_every=1 saves each pass
+        assert rows[-1]["pass"] == 0  # the final reporting pass
+        doc = json.load(open(trace.flush()))
+        names = {e["name"] for e in doc["traceEvents"]}
+        for want in ("pass", "read", "stage", "compute", "shift_check",
+                     "checkpoint", "pass_boundary", "fit"):
+            assert want in names, f"missing span {want}"
+        assert names <= (trace.KNOWN_SPANS
+                         | {"process_name", "thread_name"})
+        _chrome_assert_nested(doc)
+
+    def test_streamed_1d_untraced_has_no_timeline(self):
+        from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(256, 4)).astype(np.float32)
+        res = streamed_kmeans_fit(lambda: iter(np.split(x, 2)), 2, 4,
+                                  init=x[:2], max_iters=2, tol=-1.0)
+        assert res.timeline is None
+
+    def test_streamed_sharded_traced_with_reduce(self, tmp_path):
+        from tdc_tpu.data.loader import NpzStream
+        from tdc_tpu.parallel.sharded_k import (
+            make_mesh_2d, streamed_kmeans_fit_sharded,
+        )
+
+        trace.configure(str(tmp_path))
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(800, 6)).astype(np.float32)
+        mesh = make_mesh_2d(2, 4)
+        res = streamed_kmeans_fit_sharded(
+            NpzStream(x, 200), 8, 6, mesh, init=x[:8], max_iters=3,
+            tol=-1.0, reduce="per_pass",
+        )
+        rows = res.timeline
+        assert rows is not None and len(rows) >= 3
+        assert all(r["batches"] == 4 for r in rows)
+        assert any(r["reduce_s"] > 0.0 for r in rows)  # per-pass reduce
+        doc = json.load(open(trace.flush()))
+        names = {e["name"] for e in doc["traceEvents"]}
+        for want in ("pass", "read", "stage", "compute", "reduce",
+                     "shift_check", "pass_boundary"):
+            assert want in names, f"missing span {want}"
+        _chrome_assert_nested(doc)
+
+
+# ---------------------------------------------------------------------------
+# merge_trace
+# ---------------------------------------------------------------------------
+
+
+def _mk_trace(path, pid, pidx, offset_us, wall, with_anchor=True):
+    evs = []
+    if with_anchor:
+        evs.append({"name": "pass_boundary", "ph": "i", "s": "p",
+                    "ts": offset_us + 100.0, "pid": pid, "tid": 1,
+                    "args": {"pass": 1}})
+    evs.append({"name": "pass", "cat": "tdc", "ph": "X",
+                "ts": offset_us + 100.0, "dur": 50.0, "pid": pid, "tid": 1})
+    doc = {"traceEvents": evs, "displayTimeUnit": "ms",
+           "otherData": {"pid": pid, "process_index": pidx,
+                         "wall_t0": wall}}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+class TestMergeTrace:
+    def test_anchor_alignment(self, tmp_path):
+        a = _mk_trace(tmp_path / "trace_p0_1.json", 1, 0, 0.0, 100.0)
+        b = _mk_trace(tmp_path / "trace_p1_2.json", 2, 1, 5000.0, 100.2)
+        merged = merge_mod.merge([str(a), str(b)])
+        assert merged["otherData"]["alignment"] == "pass_boundary"
+        anchors = [e for e in merged["traceEvents"]
+                   if e["name"] == "pass_boundary"]
+        assert len(anchors) == 2
+        assert anchors[0]["ts"] == anchors[1]["ts"]  # aligned
+        assert anchors[0]["pid"] != anchors[1]["pid"]  # own tracks
+        assert min(e["ts"] for e in merged["traceEvents"]
+                   if "ts" in e) == 0.0
+        tracks = [e["args"]["name"] for e in merged["traceEvents"]
+                  if e["name"] == "process_name"]
+        assert any("p0" in t for t in tracks)
+        assert any("p1" in t for t in tracks)
+
+    def test_wall_clock_fallback(self, tmp_path):
+        a = _mk_trace(tmp_path / "trace_p0_1.json", 1, 0, 0.0, 100.0,
+                      with_anchor=False)
+        b = _mk_trace(tmp_path / "trace_p1_2.json", 2, 1, 0.0, 100.5,
+                      with_anchor=False)
+        merged = merge_mod.merge([str(a), str(b)])
+        assert merged["otherData"]["alignment"] == "wall_clock"
+        passes = sorted(
+            (e["ts"] for e in merged["traceEvents"] if e["name"] == "pass")
+        )
+        # 0.5 s wall offset => 5e5 us apart on the merged timeline
+        assert abs((passes[1] - passes[0]) - 5e5) < 1.0
+
+    def test_directory_glob(self, tmp_path):
+        _mk_trace(tmp_path / "trace_p0_1.json", 1, 0, 0.0, 100.0)
+        _mk_trace(tmp_path / "trace_p1_2.json", 2, 1, 0.0, 100.1)
+        merged = merge_mod.merge([str(tmp_path)])
+        assert len(merged["otherData"]["merged_from"]) == 2
+
+    def test_malformed_input(self, tmp_path):
+        bad = tmp_path / "trace_p0_9.json"
+        bad.write_text('{"nope": 1}')
+        with pytest.raises(merge_mod.MergeError, match="traceEvents"):
+            merge_mod.merge([str(bad)])
+        assert merge_mod.main([str(bad), "--out",
+                               str(tmp_path / "o.json")]) == 2
+
+    def test_cli_writes_output(self, tmp_path):
+        _mk_trace(tmp_path / "trace_p0_1.json", 1, 0, 0.0, 100.0)
+        _mk_trace(tmp_path / "trace_p1_2.json", 2, 1, 0.0, 100.1)
+        out = tmp_path / "merged.json"
+        assert merge_mod.main([str(tmp_path), "--out", str(out)]) == 0
+        doc = json.load(open(out))
+        assert {e["name"] for e in doc["traceEvents"]} >= {
+            "pass", "pass_boundary", "process_name"}
+
+    def test_merge_real_exports(self, tmp_path):
+        """Two real flush() exports (distinct synthetic process indices)
+        merge into one timeline with both tracks."""
+        from tdc_tpu.utils import structlog
+
+        trace.configure(str(tmp_path))
+        trace.begin_pass(1)
+        with trace.span("pass", n_iter=1):
+            pass
+        p0 = trace.flush()
+        structlog.set_process_index(1)
+        try:
+            p1 = trace.flush()  # same events, second track name
+        finally:
+            structlog.set_process_index(None)
+        assert p0 != p1
+        merged = merge_mod.merge([p0, p1])
+        assert merged["otherData"]["alignment"] == "pass_boundary"
+
+
+# ---------------------------------------------------------------------------
+# structlog stamps
+# ---------------------------------------------------------------------------
+
+
+class TestStructlogStamps:
+    def test_emit_stamps_pid(self, capsys):
+        from tdc_tpu.utils import structlog
+
+        structlog.emit("run_start", foo=1)
+        rec = json.loads(capsys.readouterr().err.strip().splitlines()[-1])
+        assert rec["pid"] == os.getpid()
+        assert "process_index" not in rec
+
+    def test_emit_stamps_process_index(self, capsys):
+        from tdc_tpu.utils import structlog
+
+        structlog.set_process_index(3)
+        try:
+            structlog.emit("gang_init")
+        finally:
+            structlog.set_process_index(None)
+        rec = json.loads(capsys.readouterr().err.strip().splitlines()[-1])
+        assert rec["process_index"] == 3
+
+    def test_runlog_stamps(self, tmp_path):
+        from tdc_tpu.utils.structlog import RunLog
+
+        log = RunLog(str(tmp_path / "run.jsonl"))
+        log.event("run_start")
+        rec = json.loads(open(tmp_path / "run.jsonl").read())
+        assert rec["pid"] == os.getpid()
+
+    def test_explicit_field_wins(self, capsys):
+        from tdc_tpu.utils import structlog
+
+        structlog.emit("supervisor", pid=1234)  # supervisor echo case
+        rec = json.loads(capsys.readouterr().err.strip().splitlines()[-1])
+        assert rec["pid"] == 1234
+
+
+# ---------------------------------------------------------------------------
+# docs/OBSERVABILITY.md drift
+# ---------------------------------------------------------------------------
+
+
+def _doc_section_names(section: str) -> set[str]:
+    text = open(os.path.join(REPO, "docs", "OBSERVABILITY.md")).read()
+    m = re.search(rf"^## {re.escape(section)}\n(.*?)(?=^## |\Z)", text,
+                  re.S | re.M)
+    assert m, f"docs/OBSERVABILITY.md section missing: {section}"
+    return set(re.findall(r"^[-|*] ?`([^`]+)`", m.group(1), re.M))
+
+
+def _source_event_names() -> set[str]:
+    """Every structlog event name in tdc_tpu/: literal first args of
+    emit()/*log*.event() (the TDC006 collection discipline) plus the
+    serve/online `self._emit(\"...\")` literal fanout."""
+    events: set[str] = set()
+    emit_re = re.compile(r'_emit\(\s*"([a-z0-9_.]+)"')
+    for root, dirs, files in os.walk(os.path.join(REPO, "tdc_tpu")):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(root, fn)).read()
+            events.update(emit_re.findall(src))
+            tree = ast.parse(src)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                f = node.func
+                name = (f.id if isinstance(f, ast.Name)
+                        else f.attr if isinstance(f, ast.Attribute)
+                        else None)
+                if name == "event" and isinstance(f, ast.Attribute):
+                    recv = ""
+                    v = f.value
+                    while isinstance(v, ast.Attribute):
+                        recv = v.attr + "." + recv
+                        v = v.value
+                    if isinstance(v, ast.Name):
+                        recv = v.id + "." + recv
+                    if "log" not in recv.lower():
+                        continue
+                elif name != "emit":
+                    continue
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    events.add(a.value)
+    return events
+
+
+class TestObservabilityDocDrift:
+    def test_metrics_catalog_matches_doc(self):
+        doc = _doc_section_names("Metrics")
+        cat = set(obs_metrics.CATALOG)
+        assert doc == cat, (
+            f"doc-only: {sorted(doc - cat)}; undocumented: "
+            f"{sorted(cat - doc)}"
+        )
+
+    def test_trace_spans_match_doc(self):
+        doc = _doc_section_names("Trace spans")
+        assert doc == set(trace.KNOWN_SPANS), (
+            f"doc-only: {sorted(doc - trace.KNOWN_SPANS)}; undocumented: "
+            f"{sorted(set(trace.KNOWN_SPANS) - doc)}"
+        )
+
+    def test_fault_points_match_doc(self):
+        from tdc_tpu.testing.faults import KNOWN_POINTS
+
+        doc = _doc_section_names("Fault points")
+        assert doc == set(KNOWN_POINTS), (
+            f"doc-only: {sorted(doc - KNOWN_POINTS)}; undocumented: "
+            f"{sorted(set(KNOWN_POINTS) - doc)}"
+        )
+
+    def test_structlog_events_match_doc(self):
+        doc = _doc_section_names("Structured run-log events")
+        src = _source_event_names()
+        assert doc == src, (
+            f"doc-only: {sorted(doc - src)}; undocumented: "
+            f"{sorted(src - doc)}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# CLI --trace
+# ---------------------------------------------------------------------------
+
+
+class TestCliTrace:
+    def test_cli_trace_prints_timeline_and_exports(self, tmp_path, capsys):
+        from tdc_tpu.cli.main import main
+
+        rc = main([
+            "--K", "3", "--n_obs", "600", "--n_dim", "4", "--streamed",
+            "--num_batches", "3", "--n_GPUs", "1",
+            "--trace", str(tmp_path),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "timeline (distributedKMeans):" in out
+        assert "compute_s" in out
+        files = [f for f in os.listdir(tmp_path)
+                 if f.startswith("trace_") and f.endswith(".json")]
+        assert files
+        doc = json.load(open(tmp_path / files[0]))
+        assert any(e["name"] == "pass_boundary"
+                   for e in doc["traceEvents"])
