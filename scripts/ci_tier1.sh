@@ -1,18 +1,18 @@
 #!/usr/bin/env bash
 # Tier-1 verify, one command (ROADMAP.md "Tier-1 verify"): the CPU-mesh
 # test suite (8 virtual devices via tests/conftest.py) minus slow-marked
-# tests, the comms + resident + spill + subk + obs + chaos smokes, and
-# the tdclint static-analysis gate. The suite-green invariant every PR
-# must hold.
+# tests, the comms + resident + spill + subk + obs + chaos smokes, the
+# tdcverify IR-audit stage, and the tdclint static-analysis gate. The
+# suite-green invariant every PR must hold.
 #
-#   scripts/ci_tier1.sh            # tests + smokes + lint
+#   scripts/ci_tier1.sh            # tests + smokes + verify + lint
 #   SKIP_LINT=1 scripts/ci_tier1.sh
 #
 # Exit code: the FIRST failing stage's code (pytest, then comms smoke,
-# then resident smoke, then spill smoke, then chaos smoke, then lint),
-# with every failed stage named on stderr —
-# a run where pytest passes but both smokes fail must say so, not
-# silently collapse into one opaque code.
+# then resident smoke, then spill smoke, then subk smoke, then obs
+# smoke, then verify, then chaos smoke, then lint), with every failed
+# stage named on stderr — a run where pytest passes but both smokes
+# fail must say so, not silently collapse into one opaque code.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -94,6 +94,21 @@ if [ -z "$SKIP_OBS_SMOKE" ]; then
         | tail -n 1 || obs_rc=$?
 fi
 
+# Verify stage (python -m tdc_tpu.verify, docs/VERIFICATION.md): the
+# IR-level compiled-artifact audits — every driver entry point's
+# collective schedule against the committed goldens
+# (tests/golden/collective_schedules/schedules.json), the host-transfer
+# walk, the donation (input-output aliasing) proof, and the recompile
+# (jit-cache identity) proof. Measured ~8 s on the CI box (the recompile
+# audit's 27 small compiles dominate); 120 is ~15x headroom for a loaded
+# box without masking a hang.
+verify_rc=0
+if [ -z "$SKIP_VERIFY" ]; then
+    timeout -k 10 120 \
+        python -m tdc_tpu.verify \
+        2>&1 | tail -n 3 || verify_rc=$?
+fi
+
 # Chaos smoke (tests/test_chaos.py soak): 1 kill -9 + 1 preemption SIGTERM
 # injected via TDC_FAULTS into the 2-process gloo gang (recover both,
 # refund the SIGTERM restart, match the fault-free fit), the resident-fit
@@ -144,7 +159,7 @@ overall=0
 for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
              "resident-smoke:$resident_rc" "spill-smoke:$spill_rc" \
              "subk-smoke:$subk_rc" "obs-smoke:$obs_rc" \
-             "chaos-smoke:$chaos_rc" \
+             "verify:$verify_rc" "chaos-smoke:$chaos_rc" \
              "tdclint:$lint_rc" "ruff:$ruff_rc"; do
     name=${stage%%:*}
     rc=${stage##*:}
@@ -154,6 +169,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, obs-smoke, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
 fi
 exit "$overall"

@@ -6,11 +6,20 @@
 #   scripts/lint.sh --format=github      # CI annotations
 #   scripts/lint.sh --write-baseline     # shrink the baseline after fixes
 #   scripts/lint.sh path/to/file.py      # spot-check specific paths
+#   scripts/lint.sh --verify [args...]   # the tdcverify IR-audit stage
+#                                        # instead (python -m
+#                                        # tdc_tpu.verify, needs jax;
+#                                        # docs/VERIFICATION.md)
 #
 # Extra args pass through; paths default to the repo-wide tree.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--verify" ]; then
+    shift
+    exec python -m tdc_tpu.verify "$@"
+fi
 
 args=()
 paths=()

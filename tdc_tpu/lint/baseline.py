@@ -31,7 +31,13 @@ BASELINE_VERSION = 1
 
 
 def fingerprint(f: Finding) -> str:
-    path = f.path.replace(os.sep, "/")
+    # normpath BEFORE hashing: findings walked from `./tdc_tpu/` carry
+    # "./"-prefixed paths, and a fingerprint keyed on the raw spelling
+    # fails to match the baseline generated from `tdc_tpu/` — every
+    # grandfathered finding then leaks as "new" (the CI annotation job's
+    # `--format=github` run sprayed the whole baseline onto PRs; see
+    # tests/test_lint.py::test_github_format_respects_baseline_dot_paths).
+    path = os.path.normpath(f.path).replace(os.sep, "/")
     key = f"{f.rule}|{path}|{f.snippet}"
     return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
 

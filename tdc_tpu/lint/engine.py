@@ -251,7 +251,7 @@ def all_rules() -> list:
     from tdc_tpu.lint.rules_signal import SignalUnsafeHandler
     from tdc_tpu.lint.rules_drift import (
         FaultPointDrift, MetricNameDrift, NondeterministicCkptPath,
-        StructlogEventDrift,
+        SpanNameDrift, StructlogEventDrift,
     )
 
     return [
@@ -264,6 +264,7 @@ def all_rules() -> list:
         NondeterministicCkptPath(),
         AxisNameMismatch(),
         MetricNameDrift(),
+        SpanNameDrift(),
     ]
 
 

@@ -1,215 +1,26 @@
-"""Compile-time collective-trace checking — the layer past source AST.
+"""Compile-time collective-trace checking — moved to tdc_tpu.verify.ir.
 
-TDC001 catches `if process_index: psum(...)` lexically; this module
-catches the same divergence class where it actually becomes binding: in
-the traced program. It walks a function's jaxpr and extracts the ordered
-sequence of collective primitives (psum / all_gather / ppermute / ...),
-then asserts two SPMD invariants:
+This module grew into the IR layer of tdcverify (PR 13): collective
+extraction now lives beside the transfer walk, donation inspection, and
+recompile proof in `tdc_tpu/verify/ir.py`, driven by the gating
+`python -m tdc_tpu.verify` CI stage (docs/VERIFICATION.md). The public
+names are re-exported here so existing imports keep working; new code
+should import from `tdc_tpu.verify` directly.
 
-1. **Branch uniformity** — under SPMD, one program runs on every shard,
-   so shards can only execute different collective sequences through
-   value-dependent control flow: `lax.cond`/`lax.switch` branches that
-   emit different collectives (asserted identical here), or a
-   `lax.while_loop` whose trip count varies per shard (undecidable
-   statically — such collectives are surfaced in
-   TraceReport.while_collectives and can be hard-rejected with
-   forbid_while_collectives=True). With uniform branches and no
-   while-body collectives, the emitted sequence is identical across
-   shards by construction — the static companion to test_reduce's
-   compiled-HLO no-collective proof.
-2. **Trace stability** — tracing twice yields the same sequence. A trace
-   that consults ambient state (a global counter, dict ordering, an RNG)
-   can emit different reduction orders per compile; with per-process jit
-   caches that means two processes that compiled at different times run
-   different programs — the quantized-reduce towers (int8 pmax + psum
-   pairs) fail *numerically*, not loudly, when that happens.
-
-Uses jax — imported by tests and explicit callers only, never by the
-`python -m tdc_tpu.lint` CLI (which must run with zero third-party
-imports).
+Like the original: uses jax, imported by tests and explicit callers
+only, never by the `python -m tdc_tpu.lint` CLI (which must run with
+zero third-party imports).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-
-# The collective primitive names as they appear in jaxpr eqns. pmean is
-# absent on purpose: it decomposes to psum + div before it reaches a
-# jaxpr.
-COLLECTIVE_PRIMITIVES = frozenset({
-    "psum", "pmax", "pmin", "ppermute", "all_gather", "all_to_all",
-    "psum_scatter", "reduce_scatter", "pgather", "pbroadcast",
-})
-
-
-class CollectiveDivergenceError(AssertionError):
-    """A cond/switch emits different collective sequences per branch, or
-    two traces of the same function disagree — some shard/process can
-    execute a collective sequence its peers don't, which deadlocks the
-    gang (or silently corrupts a quantized reduce)."""
-
-
-@dataclass
-class TraceReport:
-    sequence: list[str]  # e.g. ["psum[axes=('data',)]", ...]
-    divergences: list[str] = field(default_factory=list)
-    # Collectives inside lax.while_loop bodies (entries also appear in
-    # `sequence` with a "while:" prefix). A while loop's trip count is
-    # value-dependent: if the predicate consults shard-local values, the
-    # shards issue these collectives DIFFERENT numbers of times and the
-    # gang deadlocks — a divergence this static walk cannot prove or
-    # refute (the repo's in-jit Lloyd loops are safe because their
-    # predicate derives from the globally-psum'd shift, but that is a
-    # data-flow property). Callers wanting a hard guarantee pass
-    # forbid_while_collectives=True.
-    while_collectives: list[str] = field(default_factory=list)
-
-    @property
-    def ok(self) -> bool:
-        return not self.divergences
-
-
-def _axes_of(params: dict) -> str:
-    for key in ("axes", "axis_name", "axis_index_groups"):
-        if key in params and params[key] is not None and \
-                key != "axis_index_groups":
-            val = params[key]
-            if not isinstance(val, tuple):
-                val = (val,)
-            named = tuple(str(a) for a in val)
-            return f"axes={named}"
-    return "axes=?"
-
-
-def _subjaxprs(params: dict):
-    """Every Jaxpr/ClosedJaxpr reachable from an eqn's params — covers
-    pjit, shard_map, scan, while, cond, remat, custom_* generically."""
-    import jax.core as core
-
-    closed = getattr(core, "ClosedJaxpr", None)
-    open_ = getattr(core, "Jaxpr", None)
-
-    def visit(val):
-        if closed is not None and isinstance(val, closed):
-            yield val.jaxpr
-        elif open_ is not None and isinstance(val, open_):
-            yield val
-        elif isinstance(val, (tuple, list)):
-            for v in val:
-                yield from visit(v)
-
-    for key, val in params.items():
-        if key in ("branches",):
-            continue  # cond branches are compared, not inlined, below
-        yield from visit(val)
-
-
-def _walk(jaxpr, out: list[str], divergences: list[str],
-          while_out: list[str], in_while: bool = False) -> None:
-    for eqn in jaxpr.eqns:
-        prim = eqn.primitive.name
-        if prim in COLLECTIVE_PRIMITIVES:
-            entry = f"{prim}[{_axes_of(eqn.params)}]"
-            if in_while:
-                entry = f"while:{entry}"
-                while_out.append(entry)
-            out.append(entry)
-            continue
-        if prim == "while":
-            # Value-dependent trip count: body collectives repeat an
-            # unknowable number of times — recorded separately (see
-            # TraceReport.while_collectives) instead of silently inlined
-            # as if they ran once.
-            for key in ("cond_jaxpr", "body_jaxpr"):
-                sub = eqn.params.get(key)
-                if sub is not None:
-                    _walk(sub.jaxpr, out, divergences, while_out,
-                          in_while=True)
-            continue
-        if prim in ("cond", "switch"):
-            branch_seqs = []
-            for br in eqn.params.get("branches", ()):
-                seq: list[str] = []
-                _walk(br.jaxpr, seq, divergences, while_out, in_while)
-                branch_seqs.append(seq)
-            if branch_seqs and any(s != branch_seqs[0]
-                                   for s in branch_seqs[1:]):
-                divergences.append(
-                    f"cond branches emit different collective sequences "
-                    f"{branch_seqs} — a shard-varying predicate here "
-                    "desyncs the gang"
-                )
-            # Executed exactly once whichever branch wins; with uniform
-            # branches the subsequence is unconditionally part of the
-            # program order.
-            if branch_seqs:
-                out.extend(branch_seqs[0])
-            continue
-        for sub in _subjaxprs(eqn.params):
-            _walk(sub, out, divergences, while_out, in_while)
-
-
-def collective_trace(fn, *args, **kwargs) -> TraceReport:
-    """Trace fn(*args, **kwargs) and return its ordered collective
-    sequence plus any branch-divergence findings."""
-    import jax
-
-    closed = jax.make_jaxpr(fn)(*args, **kwargs)
-    out: list[str] = []
-    divergences: list[str] = []
-    while_out: list[str] = []
-    _walk(closed.jaxpr, out, divergences, while_out)
-    return TraceReport(sequence=out, divergences=divergences,
-                       while_collectives=while_out)
-
-
-def assert_uniform_collectives(fn, *args, n_traces: int = 2,
-                               require_collectives: bool = False,
-                               forbid_while_collectives: bool = False,
-                               **kwargs) -> TraceReport:
-    """The whole contract in one call: trace `fn` `n_traces` times,
-    assert (a) no divergent cond branches, (b) the sequence is identical
-    across traces, and optionally (c) at least one collective is present
-    (a tower that silently lost its psum 'passes' any divergence check).
-    Returns the report of the first trace.
-
-    Caveat (see TraceReport.while_collectives): collectives inside
-    lax.while_loop bodies run trip-count-many times, and trip-count
-    uniformity across shards is a data-flow property this static walk
-    cannot decide — a convergence loop whose predicate derives from a
-    globally-reduced value is safe; one consulting shard-local state is
-    a deadlock. Such collectives are reported, and hard-rejected with
-    forbid_while_collectives=True."""
-    reports = [collective_trace(fn, *args, **kwargs)
-               for _ in range(max(n_traces, 1))]
-    first = reports[0]
-    if first.divergences:
-        raise CollectiveDivergenceError("\n".join(first.divergences))
-    if forbid_while_collectives and first.while_collectives:
-        raise CollectiveDivergenceError(
-            f"collectives inside while-loop bodies "
-            f"{first.while_collectives}: the trip count is value-"
-            "dependent, so per-shard uniformity of these collectives "
-            "cannot be statically guaranteed — prove the predicate is "
-            "derived from globally-reduced values, or restructure with "
-            "a static-length lax.scan"
-        )
-    for i, rep in enumerate(reports[1:], start=2):
-        if rep.sequence != first.sequence:
-            raise CollectiveDivergenceError(
-                f"collective sequence is not stable across traces: trace 1 "
-                f"emitted {first.sequence} but trace {i} emitted "
-                f"{rep.sequence} — the trace consults ambient state, and "
-                "processes compiling at different times would run "
-                "different programs"
-            )
-    if require_collectives and not first.sequence:
-        raise CollectiveDivergenceError(
-            "no collective primitive found in the trace — the cross-shard "
-            "reduce was lost (or the wrong tower was checked)"
-        )
-    return first
-
+from tdc_tpu.verify.ir import (  # noqa: F401
+    COLLECTIVE_PRIMITIVES,
+    CollectiveDivergenceError,
+    TraceReport,
+    assert_uniform_collectives,
+    collective_trace,
+)
 
 __all__ = [
     "COLLECTIVE_PRIMITIVES",

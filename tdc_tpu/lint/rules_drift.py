@@ -1,15 +1,17 @@
 """TDC005 fault-point-drift, TDC006 structlog-event-drift, TDC007
-nondeterministic-ckpt-path, TDC009 metric-name-drift.
+nondeterministic-ckpt-path, TDC009 metric-name-drift, TDC010
+span-name-drift.
 
-All four are *registry* rules: the value of a fault-point name, a
-structlog event name, a checkpoint path, or a Prometheus series name
-lies entirely in other code (and other people's greps/dashboards)
-finding it later. Drift — a renamed point the chaos spec still targets,
-two spellings of one event, a timestamp in a path a resume must
-re-derive, a test asserting a metric the registry never exports — never
-fails a unit test; it fails the 3 am postmortem. TDC005/TDC006/TDC009
-are whole-program checks (finalize()); TDC007 is lexical.
-"""
+All of these (except lexical TDC007) are *registry* rules: the value of
+a fault-point name, a structlog event name, a checkpoint path, a
+Prometheus series name, or a trace-span name lies entirely in other
+code (and other people's greps/dashboards/merged timelines) finding it
+later. Drift — a renamed point the chaos spec still targets, two
+spellings of one event, a timestamp in a path a resume must re-derive,
+a test asserting a metric the registry never exports, a span
+merge_trace's phase attribution will never group — never fails a unit
+test; it fails the 3 am postmortem. The registry rules are
+whole-program checks (finalize())."""
 
 from __future__ import annotations
 
@@ -359,5 +361,102 @@ class MetricNameDrift:
                 "obs/metrics.CATALOG — register the family there (and in "
                 "docs/OBSERVABILITY.md) or fix the typo; a dashboard or "
                 "test referencing it matches no exported series",
+                at.snippet,
+            )
+
+
+_SPAN_NAME_OK = re.compile(r"^[a-z][a-z0-9_]*$")
+# obs.trace call shapes carrying a span/instant name: the name is arg 0
+# for span()/instant(), arg 1 for timed_iter(it, name).
+_SPAN_CALLS = {"span": 0, "instant": 0, "timed_iter": 1}
+
+
+class SpanNameDrift:
+    code = "TDC010"
+    name = "span-name-drift"
+    description = (
+        "literal span names passed to obs.trace span()/instant()/"
+        "timed_iter() must match the KNOWN_SPANS registry in obs/trace.py "
+        "— a drifted name breaks merge_trace's phase grouping and the "
+        "timeline column mapping silently (the TDC009 discipline applied "
+        "to the trace namespace)"
+    )
+
+    def __init__(self):
+        self._refs: list[tuple[str, Finding]] = []
+        self._registry: dict[str, Finding] | None = None
+        self._registry_seen = False
+
+    def check(self, ctx: FileContext):
+        # Any linted file assigning KNOWN_SPANS is treated as the registry
+        # (obs/trace.py in the real tree, a self-contained file in the
+        # fixtures) — the TDC005/TDC009 approach, charset-checked like
+        # TDC009's catalog keys.
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "KNOWN_SPANS"):
+                continue
+            self._registry_seen = True
+            self._registry = {}
+            for sub in ast.walk(node.value):
+                s = str_const(sub)
+                if s is None:
+                    continue
+                if not _SPAN_NAME_OK.match(s):
+                    yield ctx.finding(
+                        self, sub,
+                        f"span name {s!r} is not lowercase_snake "
+                        "([a-z][a-z0-9_]*) — one trace namespace, one "
+                        "convention",
+                    )
+                    continue
+                self._registry[s] = ctx.finding(self, sub, "")
+        for call in walk_calls(ctx.tree):
+            seg = last_seg(call_name(call))
+            if seg not in _SPAN_CALLS:
+                continue
+            # Only the obs.trace module's calls: a dotted receiver whose
+            # path mentions `trace` (trace.span, obs.trace.instant).
+            # trace.py's own bare internal calls (`span(name)` inside
+            # timed_iter) pass a variable by design and are not call
+            # sites of the literal interface.
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            recv = dotted_name(call.func.value) or ""
+            if "trace" not in recv.split("."):
+                continue
+            pos = _SPAN_CALLS[seg]
+            if len(call.args) <= pos:
+                continue
+            s = str_const(call.args[pos])
+            if s is None:
+                yield ctx.finding(
+                    self, call.args[pos],
+                    "span name must be a string literal — a computed name "
+                    "cannot be cross-checked against KNOWN_SPANS, grouped "
+                    "by merge_trace, or grepped from a timeline; put "
+                    "variability in span args, not the name",
+                )
+                continue
+            self._refs.append((s, ctx.finding(self, call.args[pos], "")))
+
+    def finalize(self):
+        if not self._registry_seen:
+            # Registry not in the linted file set (spot-checking one
+            # file): the cross-check cannot run; literal-ness was still
+            # enforced.
+            return
+        known = set(self._registry or ())
+        for ref, at in self._refs:
+            if ref in known:
+                continue
+            yield Finding(
+                self.code, self.name, at.path, at.line, at.col,
+                f"span name {ref!r} is not registered in obs/trace."
+                "KNOWN_SPANS — add it there (and to docs/OBSERVABILITY.md;"
+                " the drift test pins the doc) or fix the typo; "
+                "merge_trace and the timeline phase mapping will never "
+                "see this span",
                 at.snippet,
             )

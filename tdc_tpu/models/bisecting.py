@@ -284,37 +284,41 @@ def streamed_bisecting_kmeans_fit(
 
     # Pass 1: global (weighted) mean + per-batch row counts + host weight
     # chunks. Mirrors the in-memory fit's mean0/validate_sample_weight.
+    # sums AND mass are device-resident trackers: one fetch after the
+    # loop, never a per-batch host sync (the PR-4 mean_combine_fit rule).
     sums = jnp.zeros((d,), jnp.float32)
-    mass = 0.0
+    mass = jnp.zeros((), jnp.float32)
     rows = []
     w_chunks = [] if weighted else None
     for item in _prefetched(stream(), prefetch):
         if weighted:
             xb, wb = item
+        else:
+            xb, wb = item, None
+        xb = jnp.asarray(xb, jnp.float32)
+        rows.append(int(xb.shape[0]))
+        if wb is not None:
             wb = np.asarray(wb, np.float32)
-            if wb.shape != (np.asarray(xb).shape[0],):
+            if wb.shape != (xb.shape[0],):
                 raise ValueError(
-                    f"weight batch shape {wb.shape} != "
-                    f"({np.asarray(xb).shape[0]},)"
+                    f"weight batch shape {wb.shape} != ({xb.shape[0]},)"
                 )
             if not np.isfinite(wb).all():
                 raise ValueError("sample_weight entries must be finite")
             if (wb < 0).any():
                 raise ValueError("sample weights must be nonnegative")
             w_chunks.append(wb)
-        else:
-            xb, wb = item, None
-        xb = jnp.asarray(xb, jnp.float32)
-        rows.append(int(xb.shape[0]))
         if wb is None:
             sums = sums + jnp.sum(xb, axis=0)
-            mass += float(xb.shape[0])
+            mass = mass + xb.shape[0]
         else:
-            sums = sums + jnp.sum(xb * jnp.asarray(wb)[:, None], axis=0)
-            mass += float(wb.sum())
+            wbj = jnp.asarray(wb)
+            sums = sums + jnp.sum(xb * wbj[:, None], axis=0)
+            mass = mass + jnp.sum(wbj)
     n = sum(rows)
     if n < k:
         raise ValueError(f"n_obs={n} < K={k}")
+    mass = float(mass)  # the one post-loop fetch
     if weighted and mass <= 0:
         raise ValueError("all sample weights are zero")
     labels_chunks = [np.zeros(r, np.int64) for r in rows]
@@ -423,22 +427,31 @@ def streamed_bisecting_kmeans_fit(
                     res = r
             total_iters += int(res.n_iter)
             # Combined pass: side predict + label update (SSE follows once
-            # the new centers are installed below).
-            any_left = any_right = False
+            # the new centers are installed below). Split evidence rides
+            # device-resident boolean trackers — the per-batch host fetch
+            # is the ONE np.asarray the label update needs, not three.
+            left_t = jnp.zeros((), jnp.bool_)
+            right_t = jnp.zeros((), jnp.bool_)
             sides = []
             for i, item in enumerate(_prefetched(batches(), prefetch)):
-                side = np.asarray(
-                    kmeans_predict(jnp.asarray(item, jnp.float32),
-                                   res.centroids)
+                side_dev = kmeans_predict(
+                    jnp.asarray(item, jnp.float32), res.centroids
                 )
                 mask = labels_chunks[i] == target
-                sides.append((mask, side))
+                sides.append((mask, np.asarray(side_dev)))
                 # Positive-weight members only (the in-memory fit's rule):
                 # a zero-weight row alone on one side must not validate
                 # the split.
-                pos = mask if not weighted else (mask & (w_chunks[i] > 0))
-                any_left = any_left or bool((pos & (side == 0)).any())
-                any_right = any_right or bool((pos & (side == 1)).any())
+                pos = jnp.asarray(
+                    mask if not weighted else (mask & (w_chunks[i] > 0))
+                )
+                left_t = jnp.logical_or(
+                    left_t, jnp.any(pos & (side_dev == 0))
+                )
+                right_t = jnp.logical_or(
+                    right_t, jnp.any(pos & (side_dev == 1))
+                )
+            any_left, any_right = bool(left_t), bool(right_t)
             if not any_left or not any_right:
                 splittable[target] = False
                 continue

@@ -431,7 +431,10 @@ def minibatch_kmeans_fit(
         for batch in _prefetched(batches(), prefetch):
             maybe_beat()  # supervised-gang liveness
             if c_start is None and mbk._state is None:
-                mbk._ensure_init(jnp.asarray(np.asarray(batch)))
+                # jnp.asarray passes a jax.Array through untouched; the
+                # old np.asarray round trip copied device batches to host
+                # just to re-upload them (TDC002, now un-grandfathered).
+                mbk._ensure_init(jnp.asarray(batch))
             if c_start is None:
                 # minibatch_step donates the state, so snapshot a copy — the
                 # live buffer is invalidated by the first step.

@@ -1,0 +1,61 @@
+"""tdcverify — IR-level verification of the compiled artifacts.
+
+tdclint (tdc_tpu/lint) guards the *source* layer with stdlib AST rules;
+this package guards the layer where SPMD correctness actually becomes
+binding: the traced/lowered program. Four audits, run as one gating CI
+stage (`python -m tdc_tpu.verify`, docs/VERIFICATION.md):
+
+- **schedule** — every registry entry point's ordered collective
+  sequence (primitive, axis names, operand shapes/dtypes) is extracted
+  from its jaxpr and compared against committed goldens
+  (tests/golden/collective_schedules/schedules.json). Any drift fails
+  with a structured diff; regeneration is an explicit, reviewed step
+  (`--write-goldens`), the tdclint-baseline ratchet applied to the
+  collective contract. Cross-entry invariants (`same_schedule_as`, e.g.
+  coarse assignment must match exact's schedule) are machine-checked on
+  the live traces, not just the goldens.
+- **transfer** — the jaxpr walk proves no implicit host transfer
+  (callback/device_put/infeed primitives) hides inside a hot compiled
+  unit: the static generalization of models/resident's runtime
+  `transfer_guard`, covering paths the smoke never executes.
+- **donation** — every buffer a step factory declares in
+  `donate_argnums` is *actually aliased* in the lowered artifact
+  (`tf.aliasing_output` in the StableHLO): a shape/dtype mismatch that
+  silently defeats donation (copy-on-alias) fails the stage.
+- **recompile** — each jitted entry runs twice under perturbed but
+  static-compatible inputs and the jit cache must not grow: the
+  semantic companion of TDC003's syntactic recompile heuristic.
+
+Layout: `ir.py` (the jaxpr/MLIR toolkit — grown from lint/jaxpr_check,
+which remains as a thin re-export), `entries.py` (the driver-zoo
+registry), `schedule.py` (golden load/compare/write), `cli.py`.
+
+Like the lint package, importing `tdc_tpu.verify` itself stays cheap;
+jax is pulled in by the registry/CLI, never by `ir`'s module scope.
+"""
+
+from tdc_tpu.verify.ir import (  # noqa: F401
+    COLLECTIVE_PRIMITIVES,
+    CollectiveDivergenceError,
+    CollectiveOp,
+    TraceReport,
+    TRANSFER_PRIMITIVES,
+    assert_uniform_collectives,
+    collective_trace,
+    donation_report,
+    recompile_report,
+    transfer_ops,
+)
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES",
+    "CollectiveDivergenceError",
+    "CollectiveOp",
+    "TRANSFER_PRIMITIVES",
+    "TraceReport",
+    "assert_uniform_collectives",
+    "collective_trace",
+    "donation_report",
+    "recompile_report",
+    "transfer_ops",
+]

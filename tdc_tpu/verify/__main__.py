@@ -1,0 +1,5 @@
+import sys
+
+from tdc_tpu.verify.cli import main
+
+sys.exit(main())

@@ -1,0 +1,330 @@
+"""`python -m tdc_tpu.verify` — the IR-verification CLI over
+entries + ir + schedule (docs/VERIFICATION.md).
+
+Mirrors the tdclint CLI conventions: exit 0 clean, 1 findings, 2 usage
+error; `--format=json` is the machine interface; regeneration of the
+committed artifact (`--write-goldens`) is an explicit, reviewed step,
+never a side effect of a failing run.
+
+The stage runs on CPU CI against TPU-shaped meshes: before jax loads we
+force `JAX_PLATFORMS=cpu` (unless the caller pinned a platform) and 8
+virtual host devices — tests/conftest.py's environment, so the traced
+meshes are exactly the suite's.
+
+`--mutate=path/to/module.py` (test-only) loads a module whose
+`entries()` override registry entries by id — how the mutation suite
+proves the stage actually catches a process-branched psum, a dropped
+donation, and an f-string static argument (tests/verify_fixtures/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass
+
+AUDITS = ("schedule", "transfer", "donation", "recompile")
+
+
+def _force_cpu_mesh_env() -> None:
+    """Must run before jax is imported anywhere in this process."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+@dataclass(frozen=True)
+class VerifyFinding:
+    entry: str
+    audit: str
+    message: str
+
+    def location(self) -> str:
+        return f"{self.entry}:{self.audit}"
+
+
+def _load_mutations(paths: list[str]):
+    out = []
+    for i, p in enumerate(paths):
+        spec = importlib.util.spec_from_file_location(
+            f"_tdcverify_mutation_{i}", p)
+        if spec is None or spec.loader is None:
+            raise FileNotFoundError(f"cannot load mutation module: {p}")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if not hasattr(mod, "entries"):
+            raise ValueError(
+                f"mutation module {p} must define entries() -> "
+                "list[VerifyEntry]")
+        out.extend(mod.entries())
+    return out
+
+
+def _resolve_entries(mutate_paths: list[str], patterns: list[str]):
+    from tdc_tpu.verify.entries import entries as base_entries
+
+    ents = list(base_entries())
+    if mutate_paths:
+        overrides = _load_mutations(mutate_paths)
+        by_id = {e.id: i for i, e in enumerate(ents)}
+        for ov in overrides:
+            if ov.id in by_id:
+                ents[by_id[ov.id]] = ov
+            else:
+                ents.append(ov)
+    if patterns:
+        ents = [e for e in ents
+                if any(pat in e.id for pat in patterns)]
+    return ents
+
+
+def _run_entry(entry, audits, schedules, findings):
+    from tdc_tpu.verify import ir
+
+    try:
+        built = entry.build()
+    except Exception as e:  # a broken builder must gate, not crash the run
+        findings.append(VerifyFinding(
+            entry.id, "build", f"entry builder raised: {type(e).__name__}: "
+            f"{e}"))
+        return
+
+    if "schedule" in audits or "transfer" in audits:
+        args0 = built.fresh(0)
+    if "schedule" in audits:
+        try:
+            rep = ir.collective_trace(built.fn, *args0)
+            rep2 = ir.collective_trace(built.fn, *built.fresh(0))
+            if rep.divergences:
+                for dmsg in rep.divergences:
+                    findings.append(VerifyFinding(entry.id, "schedule", dmsg))
+            elif rep2.sequence != rep.sequence:
+                findings.append(VerifyFinding(
+                    entry.id, "schedule",
+                    f"collective sequence unstable across traces: "
+                    f"{rep.sequence} vs {rep2.sequence} — the trace "
+                    "consults ambient state",
+                ))
+            else:
+                schedules[entry.id] = rep.ops
+        except Exception as e:
+            findings.append(VerifyFinding(
+                entry.id, "schedule",
+                f"trace failed: {type(e).__name__}: {e}"))
+    if "transfer" in audits:
+        try:
+            hops = ir.transfer_ops(built.fn, *args0)
+            if hops:
+                findings.append(VerifyFinding(
+                    entry.id, "transfer",
+                    f"host-transfer/callback primitives inside the "
+                    f"compiled unit: {hops} — a per-dispatch round trip "
+                    "the runtime transfer_guard would reject (and a hot "
+                    "path the smoke may never execute)",
+                ))
+        except Exception as e:
+            findings.append(VerifyFinding(
+                entry.id, "transfer",
+                f"transfer walk failed: {type(e).__name__}: {e}"))
+    if "donation" in audits and entry.donated_leaves:
+        try:
+            drep = ir.donation_report(
+                built.jit_fn, *built.fresh(0),
+                declared=entry.donated_leaves)
+            if not drep.ok:
+                extra = f" (lowering: {drep.dropped})" if drep.dropped else ""
+                findings.append(VerifyFinding(
+                    entry.id, "donation",
+                    f"declared {drep.declared} donated leaves but the "
+                    f"lowered artifact aliases {drep.aliased} — a donated "
+                    "buffer is silently copied every dispatch (dropped "
+                    f"donate_argnums or shape/dtype mismatch){extra}",
+                ))
+        except Exception as e:
+            findings.append(VerifyFinding(
+                entry.id, "donation",
+                f"donation lowering failed: {type(e).__name__}: {e}"))
+    if "recompile" in audits and entry.recompile:
+        try:
+            rrep = ir.recompile_report(
+                built.jit_fn, built.fresh(1), built.fresh(2))
+            if not rrep.ok:
+                findings.append(VerifyFinding(
+                    entry.id, "recompile",
+                    f"second static-compatible call grew the jit cache by "
+                    f"{rrep.new_entries_second} entr(y/ies) — a static "
+                    "argument varies per call (TDC003's hazard, proven on "
+                    "the artifact cache)",
+                ))
+        except Exception as e:
+            findings.append(VerifyFinding(
+                entry.id, "recompile",
+                f"recompile proof failed: {type(e).__name__}: {e}"))
+
+
+def _check_same_schedule(ents, schedules, findings) -> None:
+    for e in ents:
+        if e.same_schedule_as is None:
+            continue
+        if e.id not in schedules or e.same_schedule_as not in schedules:
+            continue  # the missing trace already gated above
+        a = [op.legacy() for op in schedules[e.id]]
+        b = [op.legacy() for op in schedules[e.same_schedule_as]]
+        if a != b:
+            findings.append(VerifyFinding(
+                e.id, "schedule",
+                f"schedule must be identical to {e.same_schedule_as!r} "
+                f"(cross-entry invariant) but differs: {a} vs {b}",
+            ))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tdc_tpu.verify",
+        description="tdcverify: IR-level compiled-artifact verification "
+                    "(docs/VERIFICATION.md)",
+    )
+    p.add_argument("--audits", metavar="NAMES",
+                   help=f"comma-separated subset of {','.join(AUDITS)} "
+                        "(default: all)")
+    p.add_argument("--entries", metavar="SUBSTR", action="append",
+                   default=[],
+                   help="only entries whose id contains SUBSTR "
+                        "(repeatable)")
+    p.add_argument("--golden", metavar="PATH",
+                   help="golden schedule file (default: tests/golden/"
+                        "collective_schedules/schedules.json)")
+    p.add_argument("--write-goldens", action="store_true",
+                   help="rewrite the golden file from the live traces "
+                        "(REVIEW the diff before committing)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--mutate", metavar="PATH", action="append", default=[],
+                   help="test-only: load entry overrides from a module "
+                        "file (tests/verify_fixtures/)")
+    p.add_argument("--list-entries", action="store_true")
+    args = p.parse_args(argv)
+
+    audits = AUDITS
+    if args.audits:
+        audits = tuple(a.strip() for a in args.audits.split(",") if a.strip())
+        bad = set(audits) - set(AUDITS)
+        if bad:
+            p.error(f"unknown audits: {sorted(bad)} (want {AUDITS})")
+    if args.write_goldens and args.entries:
+        # The golden-file twin of tdclint's partial-path --write-baseline
+        # refusal: regenerating from an entry subset would drop every
+        # other entry's schedule from the committed ledger.
+        p.error("--write-goldens cannot be combined with --entries "
+                "(a partial regeneration would drop the other entries' "
+                "goldens)")
+    if args.write_goldens and args.audits:
+        # An audit subset omitting 'schedule' collects NO schedules — the
+        # regeneration would rewrite the ledger EMPTY; and one skipping
+        # the other audits is exactly the dirty-audit tree the findings
+        # refusal below exists to reject.
+        p.error("--write-goldens cannot be combined with --audits "
+                "(goldens are regenerated only from a fully-audited tree)")
+    if args.write_goldens and args.mutate:
+        # A mutated registry whose defect happens to trace uniformly
+        # would poison the committed contract silently.
+        p.error("--write-goldens cannot be combined with --mutate "
+                "(test-only overrides must never reach the committed "
+                "goldens)")
+
+    _force_cpu_mesh_env()
+
+    from tdc_tpu.verify import schedule as schedule_mod
+
+    golden_path = args.golden or schedule_mod.DEFAULT_GOLDEN_PATH
+
+    try:
+        ents = _resolve_entries(args.mutate, args.entries)
+    except (FileNotFoundError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.list_entries:
+        for e in ents:
+            marks = []
+            if e.donated_leaves:
+                marks.append(f"donate={e.donated_leaves}")
+            if e.same_schedule_as:
+                marks.append(f"same_as={e.same_schedule_as}")
+            suffix = f"  [{', '.join(marks)}]" if marks else ""
+            print(f"{e.id}{suffix}")
+        return 0
+
+    t0 = time.monotonic()
+    findings: list[VerifyFinding] = []
+    schedules: dict = {}
+    for entry in ents:
+        _run_entry(entry, audits, schedules, findings)
+    _check_same_schedule(ents, schedules, findings)
+
+    if args.write_goldens:
+        if findings:
+            for f in findings:
+                print(f"{f.location()}: {f.message}", file=sys.stderr)
+            print(
+                "tdcverify: refusing --write-goldens with audit findings "
+                "above — goldens must be regenerated from a tree whose "
+                "uniformity/transfer/donation/recompile audits pass",
+                file=sys.stderr,
+            )
+            return 1
+        schedule_mod.write_goldens(schedules, golden_path)
+        print(
+            f"tdcverify: goldens written to {golden_path} for "
+            f"{len(schedules)} entr(y/ies) — review the diff before "
+            "committing"
+        )
+        return 0
+
+    if "schedule" in audits:
+        try:
+            goldens = schedule_mod.load_goldens(golden_path)
+        except FileNotFoundError:
+            findings.append(VerifyFinding(
+                "*", "schedule",
+                f"golden file {golden_path} not found — generate it with "
+                "--write-goldens and commit it",
+            ))
+        else:
+            known = {e.id for e in ents} if not args.entries else None
+            for diff in schedule_mod.compare(schedules, goldens, known):
+                findings.append(VerifyFinding(
+                    diff.entry, "schedule", diff.message))
+
+    elapsed = time.monotonic() - t0
+    findings.sort(key=lambda f: (f.entry, f.audit))
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "entries": len(ents),
+            "audits": list(audits),
+            "elapsed_seconds": round(elapsed, 3),
+            "findings": [
+                {"entry": f.entry, "audit": f.audit, "message": f.message}
+                for f in findings
+            ],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"{f.location()}: {f.message}")
+        print(
+            f"tdcverify: {len(findings)} finding(s) across {len(ents)} "
+            f"entr(y/ies), audits={','.join(audits)}, in {elapsed:.1f}s",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

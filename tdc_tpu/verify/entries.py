@@ -1,0 +1,719 @@
+"""The driver-zoo registry tdcverify audits.
+
+Each VerifyEntry names one compiled unit a public driver dispatches —
+the per-batch stats towers, the per-pass deferred adds/reduces (plain,
+bf16/int8-quantized), the resident chunk loops, the coarse→refine
+assignment paths — across the config matrix the platform claims
+invariants for: 1-D vs K-sharded × kmeans/fuzzy/GMM × per_batch vs
+per_pass[:int8] × exact vs coarse assign × stream vs hbm residency.
+
+Tracing is abstract at heart (`jax.make_jaxpr` over small concrete
+examples — shapes are the contract, values are irrelevant), so CPU CI
+covers TPU-shaped meshes exactly the way tests/conftest.py does: 8
+virtual devices, the same meshes the drivers build on a pod slice.
+
+Entries whose jaxpr carries NO explicit collective (the 1-D flat-mesh
+per-batch paths, where XLA's GSPMD inserts the reduce during SPMD
+partitioning, below the jaxpr) golden an EMPTY schedule on purpose:
+"nothing explicit here" is itself a pinned property — an explicit
+collective appearing in such a path is drift worth reviewing.
+
+The registry is data: the CLI (and the mutation-test fixtures, via
+--mutate) consume `entries()`. Keep ids stable — they key the committed
+goldens in tests/golden/collective_schedules/schedules.json and the
+test-suite pins that assert against them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, NamedTuple
+
+
+class Built(NamedTuple):
+    """One materialized entry: `fn` is the traceable target (used for the
+    schedule + transfer walks), `jit_fn` the jitted callable for the
+    donation/recompile audits (identical to fn when the factory already
+    jits), `fresh(i)` builds a brand-new argument tuple (donated buffers
+    are consumed, so every audit call gets its own)."""
+
+    fn: Callable
+    jit_fn: Callable
+    fresh: Callable[[int], tuple]
+
+
+@dataclass(frozen=True)
+class VerifyEntry:
+    id: str
+    build: Callable[[], Built]
+    # Donated *leaves* the factory declares (0 = no donation contract —
+    # the donation audit is skipped, not trivially green).
+    donated_leaves: int = 0
+    # Skip the recompile proof (e.g. an entry kept trace-only).
+    recompile: bool = True
+    # Assert this entry's legacy collective sequence equals another
+    # entry's — the cross-entry invariants (coarse assignment must be
+    # schedule-identical to exact), machine-checked on live traces.
+    same_schedule_as: str | None = None
+    notes: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Shared fixtures (built lazily, once per process)
+# ---------------------------------------------------------------------------
+
+_K1, _D1 = 8, 4        # 1-D driver shapes
+_K2, _D2 = 16, 4       # K-sharded shapes (K % n_model == 0)
+_ROWS = 64             # batch rows (multiple of every data-axis extent)
+
+_cache = {}
+
+
+def _np():
+    import numpy as np
+
+    return np
+
+
+def _mesh1():
+    if "mesh1" not in _cache:
+        from tdc_tpu.parallel.mesh import make_mesh
+
+        _cache["mesh1"] = make_mesh(8)
+    return _cache["mesh1"]
+
+
+def _mesh_hier():
+    if "meshH" not in _cache:
+        from tdc_tpu.parallel.mesh import make_hierarchical_mesh
+
+        _cache["meshH"] = make_hierarchical_mesh(n_hosts=2)
+    return _cache["meshH"]
+
+
+def _mesh2d():
+    if "mesh2" not in _cache:
+        from tdc_tpu.parallel.sharded_k import make_mesh_2d
+
+        _cache["mesh2"] = make_mesh_2d(2, 4)
+    return _cache["mesh2"]
+
+
+def _rows(i: int, n: int = _ROWS, d: int = _D1):
+    """Deterministic full-rank-ish data; `i` perturbs values only (the
+    recompile audit's static-compatible second call)."""
+    np = _np()
+    base = np.arange(n * d, dtype=np.float32).reshape(n, d)
+    return (base % 17.0) + 0.25 * base / (n * d) + float(i)
+
+
+def _centroids(i: int, k: int, d: int):
+    np = _np()
+    return (np.arange(k * d, dtype=np.float32).reshape(k, d) % 5.0) + float(i)
+
+
+# ---------------------------------------------------------------------------
+# 1-D streamed driver units (models/streaming.py)
+# ---------------------------------------------------------------------------
+
+
+def _build_1d_per_batch(mesh_fn, k=_K1, d=_D1):
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from tdc_tpu.models.streaming import _accumulate
+        from tdc_tpu.ops.assign import SufficientStats
+
+        mesh = mesh_fn()
+        fn = jax.jit(
+            lambda acc, b, c, nv: _accumulate(acc, b, c, nv, False, "xla",
+                                              mesh)
+        )
+
+        def fresh(i):
+            acc = SufficientStats(
+                sums=jnp.zeros((k, d), jnp.float32),
+                counts=jnp.zeros((k,), jnp.float32),
+                sse=jnp.zeros((), jnp.float32),
+            )
+            return (acc, jnp.asarray(_rows(i)), jnp.asarray(_centroids(i, k, d)),
+                    jnp.asarray(float(_ROWS), jnp.float32))
+
+        return Built(fn, fn, fresh)
+
+    return build
+
+
+def _deferred_1d(model: str, quantize):
+    """(zero_acc, acc_add, reduce) for a 1-D per-pass family."""
+    mesh = _mesh1()
+    if model == "kmeans":
+        from tdc_tpu.models.streaming import _deferred_lloyd_fns
+
+        return _deferred_lloyd_fns(mesh, _K1, _D1, False, "xla", quantize,
+                                   False), mesh
+    if model == "fuzzy":
+        from tdc_tpu.models.streaming import _deferred_fuzzy_fns
+
+        return _deferred_fuzzy_fns(mesh, _K1, _D1, 2.0, "xla", quantize,
+                                   False), mesh
+    from tdc_tpu.models.gmm import _deferred_gmm_fns
+
+    return _deferred_gmm_fns(mesh, _K1, _D1, "xla", "diag", quantize,
+                             False), mesh
+
+
+def _gmm_params(i: int):
+    import jax.numpy as jnp
+
+    means = jnp.asarray(_centroids(i, _K1, _D1))
+    variances = jnp.ones((_K1, _D1), jnp.float32) + 0.1 * float(i)
+    weights = jnp.full((_K1,), 1.0 / _K1, jnp.float32)
+    return means, variances, weights
+
+
+def _build_acc_add(model: str):
+    def build():
+        import jax.numpy as jnp
+
+        (zero_acc, acc_add, _), _mesh = _deferred_1d(model, None)
+
+        def fresh(i):
+            acc = zero_acc()
+            x = jnp.asarray(_rows(i))
+            if model == "gmm":
+                return (acc, x, *_gmm_params(i))
+            return (acc, x, jnp.asarray(_centroids(i, _K1, _D1)))
+
+        return Built(acc_add, acc_add, fresh)
+
+    return build
+
+
+def _build_reduce(model: str, quantize):
+    def build():
+        from tdc_tpu.parallel import reduce as reduce_lib
+
+        (zero_acc, _, reducer), mesh = _deferred_1d(model, quantize)
+        if model == "kmeans":
+            from tdc_tpu.models.streaming import _lloyd_example
+
+            example = _lloyd_example(_K1, _D1)
+        elif model == "fuzzy":
+            from tdc_tpu.models.streaming import _fuzzy_example
+
+            example = _fuzzy_example(_K1, _D1)
+        else:
+            from tdc_tpu.models.gmm import _gmm_example
+
+            example = _gmm_example(_K1, _D1, "diag")
+
+        def fresh(i):
+            acc = zero_acc()
+            if quantize is None:
+                return (acc,)
+            err = reduce_lib.zero_deferred(mesh, example)
+            return (acc, err)
+
+        return Built(reducer, reducer, fresh)
+
+    return build
+
+
+def _build_coarse_accumulate():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from tdc_tpu.models.streaming import _accumulate_subk
+        from tdc_tpu.ops.assign import SufficientStats
+        from tdc_tpu.ops import subk as subk_lib
+
+        spec = subk_lib.resolve_assign("coarse", _K1, probe=2,
+                                      label="tdcverify")
+
+        def fn(acc, b, c, nv):
+            return _accumulate_subk(acc, b, c, nv, False, spec)
+
+        jit_fn = jax.jit(fn)
+
+        def fresh(i):
+            acc = SufficientStats(
+                sums=jnp.zeros((_K1, _D1), jnp.float32),
+                counts=jnp.zeros((_K1,), jnp.float32),
+                sse=jnp.zeros((), jnp.float32),
+            )
+            return (acc, jnp.asarray(_rows(i)),
+                    jnp.asarray(_centroids(i, _K1, _D1)),
+                    jnp.asarray(_ROWS, jnp.int32))
+
+        return Built(fn, jit_fn, fresh)
+
+    return build
+
+
+# ---------------------------------------------------------------------------
+# Resident (hbm) units (models/resident.py via streaming factories)
+# ---------------------------------------------------------------------------
+
+
+def _resident_cache():
+    """One shared 3-batch DeviceCache on the 1-D mesh (not donated — safe
+    to reuse across audits and entries)."""
+    if "rcache" not in _cache:
+        from tdc_tpu.data.device_cache import DeviceCacheBuilder
+        from tdc_tpu.models.streaming import _prepare_batch
+
+        mesh = _mesh1()
+        b = DeviceCacheBuilder(3, mesh=mesh)
+        for j in range(3):
+            xb, nv, _ = _prepare_batch(_rows(0, _ROWS, _D1) + j, mesh)
+            b.add(xb, nv)
+        _cache["rcache"] = b.finish()
+    return _cache["rcache"]
+
+
+def _resident_fns(model: str, deferred: bool, quantize, coarse: bool = False):
+    mesh = _mesh1()
+    if model == "fuzzy":
+        from tdc_tpu.models.streaming import _resident_fuzzy_fns
+
+        return _resident_fuzzy_fns(mesh, _K1, _D1, 2.0, "xla", quantize,
+                                   False, deferred, 1e-6, 4), mesh
+    from tdc_tpu.models.streaming import _resident_lloyd_fns
+    from tdc_tpu.ops import subk as subk_lib
+
+    aspec = (subk_lib.resolve_assign("coarse", _K1, probe=2,
+                                     label="tdcverify")
+             if coarse else subk_lib.EXACT)
+    return _resident_lloyd_fns(mesh, _K1, _D1, False, "xla", quantize,
+                               False, deferred, 1e-6, 4, aspec), mesh
+
+
+def _resident_aux(deferred: bool, quantize, model: str):
+    if not deferred or quantize is None:
+        return ()
+    from tdc_tpu.parallel import reduce as reduce_lib
+
+    if model == "fuzzy":
+        from tdc_tpu.models.streaming import _fuzzy_example
+
+        example = _fuzzy_example(_K1, _D1)
+    else:
+        from tdc_tpu.models.streaming import _lloyd_example
+
+        example = _lloyd_example(_K1, _D1)
+    return reduce_lib.zero_deferred(_mesh1(), example)
+
+
+def _build_resident(model: str, deferred: bool, quantize,
+                    coarse: bool = False, final_pass: bool = False):
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tdc_tpu.models import resident as resident_lib
+
+        (chunk, pass_only), mesh = _resident_fns(model, deferred, quantize,
+                                                 coarse)
+        cache = _resident_cache()
+        fn = pass_only if final_pass else chunk
+
+        def fresh(i):
+            c = jax.device_put(
+                jnp.asarray(_centroids(i, _K1, _D1)),
+                NamedSharding(mesh, P()),
+            )
+            aux = _resident_aux(deferred, quantize, model)
+            if final_pass:
+                return (c, aux, cache)
+            cap = resident_lib.place_scalar(4, mesh)
+            return (c, aux, cap, cache)
+
+        return Built(fn, fn, fresh)
+
+    return build
+
+
+# ---------------------------------------------------------------------------
+# K-sharded units (parallel/sharded_k.py)
+# ---------------------------------------------------------------------------
+
+
+def _sharded_args(i: int, with_nv: bool = False):
+    import jax.numpy as jnp
+
+    x = jnp.asarray(_rows(i, _ROWS, _D2))
+    c = jnp.asarray(_centroids(i, _K2, _D2))
+    if with_nv:
+        return (x, c, jnp.asarray(_ROWS, jnp.int32))
+    return (x, c)
+
+
+def _build_sharded_stats(coarse: bool, reduce_data: bool):
+    def build():
+        import jax
+
+        from tdc_tpu.parallel.sharded_k import make_sharded_stats
+        from tdc_tpu.ops import subk as subk_lib
+
+        # Local K/Pm = 4 → 2 default tiles; probe must stay below the
+        # tile count or resolve_assign routes back to exact.
+        aspec = (subk_lib.resolve_assign("coarse", _K2 // 4, probe=1,
+                                         label="tdcverify")
+                 if coarse else None)
+        if coarse:
+            assert aspec.coarse, aspec
+        fn = make_sharded_stats(_mesh2d(), reduce_data=reduce_data,
+                                assign_spec=aspec)
+        jit_fn = jax.jit(fn)
+
+        def fresh(i):
+            return _sharded_args(i, with_nv=coarse)
+
+        return Built(fn, jit_fn, fresh)
+
+    return build
+
+
+def _build_sharded_deferred_reduce():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tdc_tpu.parallel.sharded_k import (
+            DATA_AXIS, MODEL_AXIS, make_sharded_deferred_reduce,
+        )
+
+        mesh = _mesh2d()
+        fn = make_sharded_deferred_reduce(mesh)
+        jit_fn = jax.jit(fn)
+        n_data = 2
+
+        def fresh(i):
+            sums = jnp.zeros((n_data, _K2, _D2), jnp.float32,
+                             device=NamedSharding(
+                                 mesh, P(DATA_AXIS, MODEL_AXIS, None)))
+            counts = jnp.zeros((n_data, _K2), jnp.float32,
+                               device=NamedSharding(
+                                   mesh, P(DATA_AXIS, MODEL_AXIS)))
+            sse = jnp.zeros((n_data,), jnp.float32,
+                            device=NamedSharding(mesh, P(DATA_AXIS)))
+            return (sums + i, counts, sse)
+
+        return Built(fn, jit_fn, fresh)
+
+    return build
+
+
+def _build_sharded_deferred_accumulate(model: str):
+    def build():
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tdc_tpu.parallel import sharded_k as sk
+
+        mesh = _mesh2d()
+        n_data = 2
+        if model == "fuzzy":
+            stats_fn = sk.make_sharded_fuzzy_stats(mesh, reduce_data=False)
+            acc_cls = sk._ShardedFuzzyAcc
+
+            def zero():
+                return acc_cls(
+                    wsums=jnp.zeros(
+                        (n_data, _K2, _D2), jnp.float32,
+                        device=NamedSharding(
+                            mesh, P(sk.DATA_AXIS, sk.MODEL_AXIS, None))),
+                    weights=jnp.zeros(
+                        (n_data, _K2), jnp.float32,
+                        device=NamedSharding(
+                            mesh, P(sk.DATA_AXIS, sk.MODEL_AXIS))),
+                    obj=jnp.zeros(
+                        (n_data * 4,), jnp.float32,
+                        device=NamedSharding(
+                            mesh, P((sk.DATA_AXIS, sk.MODEL_AXIS)))),
+                )
+        else:
+            stats_fn = sk.make_sharded_stats(mesh, reduce_data=False)
+            acc_cls = sk._ShardedAcc
+
+            def zero():
+                return acc_cls(
+                    sums=jnp.zeros(
+                        (n_data, _K2, _D2), jnp.float32,
+                        device=NamedSharding(
+                            mesh, P(sk.DATA_AXIS, sk.MODEL_AXIS, None))),
+                    counts=jnp.zeros(
+                        (n_data, _K2), jnp.float32,
+                        device=NamedSharding(
+                            mesh, P(sk.DATA_AXIS, sk.MODEL_AXIS))),
+                    sse=jnp.zeros(
+                        (n_data,), jnp.float32,
+                        device=NamedSharding(mesh, P(sk.DATA_AXIS))),
+                )
+
+        fn = sk.make_sharded_deferred_accumulate(stats_fn, acc_cls)
+
+        def fresh(i):
+            return (zero(), *_sharded_args(i))
+
+        return Built(fn, fn, fresh)
+
+    return build
+
+
+def _build_sharded_fuzzy_stats(reduce_data: bool):
+    def build():
+        import jax
+
+        from tdc_tpu.parallel.sharded_k import make_sharded_fuzzy_stats
+
+        fn = make_sharded_fuzzy_stats(_mesh2d(), reduce_data=reduce_data)
+        jit_fn = jax.jit(fn)
+
+        def fresh(i):
+            return _sharded_args(i)
+
+        return Built(fn, jit_fn, fresh)
+
+    return build
+
+
+def _build_sharded_fuzzy_reduce():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tdc_tpu.parallel.sharded_k import (
+            DATA_AXIS, MODEL_AXIS, make_sharded_fuzzy_deferred_reduce,
+        )
+
+        mesh = _mesh2d()
+        fn = make_sharded_fuzzy_deferred_reduce(mesh)
+        jit_fn = jax.jit(fn)
+        n_data = 2
+
+        def fresh(i):
+            wsums = jnp.zeros((n_data, _K2, _D2), jnp.float32,
+                              device=NamedSharding(
+                                  mesh, P(DATA_AXIS, MODEL_AXIS, None)))
+            weights = jnp.zeros((n_data, _K2), jnp.float32,
+                                device=NamedSharding(
+                                    mesh, P(DATA_AXIS, MODEL_AXIS)))
+            obj = jnp.zeros((n_data * 4,), jnp.float32,
+                            device=NamedSharding(
+                                mesh, P((DATA_AXIS, MODEL_AXIS))))
+            return (wsums + i, weights, obj)
+
+        return Built(fn, jit_fn, fresh)
+
+    return build
+
+
+def _build_sharded_gmm_stats():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from tdc_tpu.parallel.sharded_k import make_sharded_gmm_stats
+
+        fn = make_sharded_gmm_stats(_mesh2d())
+        jit_fn = jax.jit(fn)
+
+        def fresh(i):
+            x = jnp.asarray(_rows(i, _ROWS, _D2))
+            means = jnp.asarray(_centroids(i, _K2, _D2))
+            variances = jnp.ones((_K2, _D2), jnp.float32)
+            weights = jnp.full((_K2,), 1.0 / _K2, jnp.float32)
+            return (x, means, variances, weights)
+
+        return Built(fn, jit_fn, fresh)
+
+    return build
+
+
+def _build_gmm_per_batch_hier():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from tdc_tpu.models.gmm import GMMStats, _accumulate_gmm
+
+        mesh = _mesh_hier()
+        fn = jax.jit(
+            lambda acc, b, mu, v, w, nv: _accumulate_gmm(
+                acc, b, mu, v, w, nv, "xla", "diag", mesh)
+        )
+
+        def fresh(i):
+            acc = GMMStats(
+                ll_sum=jnp.zeros((), jnp.float32),
+                nk=jnp.zeros((_K1,), jnp.float32),
+                sx=jnp.zeros((_K1, _D1), jnp.float32),
+                sxx=jnp.zeros((_K1, _D1), jnp.float32),
+            )
+            return (acc, jnp.asarray(_rows(i)), *_gmm_params(i),
+                    jnp.asarray(float(_ROWS), jnp.float32))
+
+        return Built(fn, fn, fresh)
+
+    return build
+
+
+# ---------------------------------------------------------------------------
+# The registry
+# ---------------------------------------------------------------------------
+
+
+def entries() -> list[VerifyEntry]:
+    """The whole driver zoo, id-keyed. Order is the goldens' file order —
+    append new entries at the family's end and regenerate goldens with
+    `python -m tdc_tpu.verify --write-goldens` (review the diff!)."""
+    return [
+        # ---- 1-D streamed kmeans -------------------------------------
+        VerifyEntry(
+            id="kmeans_1d.per_batch.stream",
+            build=_build_1d_per_batch(_mesh1),
+            notes="flat 1-D mesh: the reduce is GSPMD-implicit — empty "
+                  "explicit schedule is the pinned property",
+        ),
+        VerifyEntry(
+            id="kmeans_1d.per_batch.hier",
+            build=_build_1d_per_batch(_mesh_hier),
+            notes="hierarchical (dcn, ici) mesh: explicit two-stage tower",
+        ),
+        VerifyEntry(
+            id="kmeans_1d.per_pass.acc_add",
+            build=_build_acc_add("kmeans"),
+            donated_leaves=3,
+            notes="deferred per-batch add must stay collective-free",
+        ),
+        VerifyEntry(
+            id="kmeans_1d.per_pass.reduce",
+            build=_build_reduce("kmeans", None),
+        ),
+        VerifyEntry(
+            id="kmeans_1d.per_pass_int8.reduce",
+            build=_build_reduce("kmeans", "int8"),
+            notes="per-row scale pmax + payload psums, EF threaded",
+        ),
+        VerifyEntry(
+            id="kmeans_1d.coarse.accumulate",
+            build=_build_coarse_accumulate(),
+            same_schedule_as="kmeans_1d.per_batch.stream",
+            notes="coarse assignment adds no collectives on the 1-D path",
+        ),
+        # ---- 1-D streamed fuzzy --------------------------------------
+        VerifyEntry(
+            id="fuzzy_1d.per_pass.acc_add",
+            build=_build_acc_add("fuzzy"),
+            donated_leaves=3,
+        ),
+        VerifyEntry(
+            id="fuzzy_1d.per_pass.reduce",
+            build=_build_reduce("fuzzy", None),
+        ),
+        # ---- 1-D streamed GMM ----------------------------------------
+        VerifyEntry(
+            id="gmm_1d.per_batch.hier",
+            build=_build_gmm_per_batch_hier(),
+        ),
+        VerifyEntry(
+            id="gmm_1d.per_pass.reduce",
+            build=_build_reduce("gmm", None),
+        ),
+        VerifyEntry(
+            id="gmm_1d.per_pass_int8.reduce",
+            build=_build_reduce("gmm", "int8"),
+        ),
+        # ---- resident (hbm) tier -------------------------------------
+        VerifyEntry(
+            id="kmeans_1d.hbm.per_batch.chunk",
+            build=_build_resident("kmeans", False, None),
+            donated_leaves=1,
+        ),
+        VerifyEntry(
+            id="kmeans_1d.hbm.per_pass.chunk",
+            build=_build_resident("kmeans", True, None),
+            donated_leaves=1,
+            notes="exactly the one logical per-pass reduce in the while "
+                  "body (test_resident's pin, now golden-backed)",
+        ),
+        VerifyEntry(
+            id="kmeans_1d.hbm.per_pass.final_pass",
+            build=_build_resident("kmeans", True, None, final_pass=True),
+        ),
+        VerifyEntry(
+            id="kmeans_1d.hbm.per_pass_int8.chunk",
+            build=_build_resident("kmeans", True, "int8"),
+            donated_leaves=4,
+            notes="donated carry = centroids + the 3-leaf EF aux tree",
+        ),
+        VerifyEntry(
+            id="kmeans_1d.hbm.coarse.chunk",
+            build=_build_resident("kmeans", False, None, coarse=True),
+            donated_leaves=1,
+            same_schedule_as="kmeans_1d.hbm.per_batch.chunk",
+        ),
+        VerifyEntry(
+            id="fuzzy_1d.hbm.per_pass.chunk",
+            build=_build_resident("fuzzy", True, None),
+            donated_leaves=1,
+        ),
+        # ---- K-sharded towers ----------------------------------------
+        VerifyEntry(
+            id="sharded_k.kmeans.per_batch.exact",
+            build=_build_sharded_stats(coarse=False, reduce_data=True),
+            notes="2 champion all_gathers (model) + 3 stat psums (data)",
+        ),
+        VerifyEntry(
+            id="sharded_k.kmeans.per_batch.coarse",
+            build=_build_sharded_stats(coarse=True, reduce_data=True),
+            same_schedule_as="sharded_k.kmeans.per_batch.exact",
+            notes="assignment-mode independence: byte-identical schedule",
+        ),
+        VerifyEntry(
+            id="sharded_k.kmeans.per_pass.acc",
+            build=_build_sharded_stats(coarse=False, reduce_data=False),
+            notes="champion gathers remain; data-axis psums deferred",
+        ),
+        VerifyEntry(
+            id="sharded_k.kmeans.per_pass.reduce",
+            build=_build_sharded_deferred_reduce(),
+        ),
+        VerifyEntry(
+            id="sharded_k.kmeans.per_pass.accumulate",
+            build=_build_sharded_deferred_accumulate("kmeans"),
+            donated_leaves=3,
+        ),
+        VerifyEntry(
+            id="sharded_k.fuzzy.per_batch",
+            build=_build_sharded_fuzzy_stats(reduce_data=True),
+        ),
+        VerifyEntry(
+            id="sharded_k.fuzzy.per_pass.acc",
+            build=_build_sharded_fuzzy_stats(reduce_data=False),
+        ),
+        VerifyEntry(
+            id="sharded_k.fuzzy.per_pass.reduce",
+            build=_build_sharded_fuzzy_reduce(),
+        ),
+        VerifyEntry(
+            id="sharded_k.fuzzy.per_pass.accumulate",
+            build=_build_sharded_deferred_accumulate("fuzzy"),
+            donated_leaves=3,
+        ),
+        VerifyEntry(
+            id="sharded_k.gmm.per_batch",
+            build=_build_sharded_gmm_stats(),
+            notes="distributed logsumexp: model-axis pmax + psum per block",
+        ),
+    ]
+
+
+__all__ = ["Built", "VerifyEntry", "entries"]

@@ -1,0 +1,438 @@
+"""Compile-time IR checking — the layer past source AST.
+
+TDC001 catches `if process_index: psum(...)` lexically; this module
+catches the same divergence class where it actually becomes binding: in
+the traced program. It walks a function's jaxpr and extracts the ordered
+sequence of collective primitives (psum / all_gather / ppermute / ...),
+then asserts two SPMD invariants:
+
+1. **Branch uniformity** — under SPMD, one program runs on every shard,
+   so shards can only execute different collective sequences through
+   value-dependent control flow: `lax.cond`/`lax.switch` branches that
+   emit different collectives (asserted identical here), or a
+   `lax.while_loop` whose trip count varies per shard (undecidable
+   statically — such collectives are surfaced in
+   TraceReport.while_collectives and can be hard-rejected with
+   forbid_while_collectives=True). With uniform branches and no
+   while-body collectives, the emitted sequence is identical across
+   shards by construction — the static companion to test_reduce's
+   compiled-HLO no-collective proof.
+2. **Trace stability** — tracing twice yields the same sequence. A trace
+   that consults ambient state (a global counter, dict ordering, an RNG)
+   can emit different reduction orders per compile; with per-process jit
+   caches that means two processes that compiled at different times run
+   different programs — the quantized-reduce towers (int8 pmax + psum
+   pairs) fail *numerically*, not loudly, when that happens.
+
+On top of the collective walk (formerly lint/jaxpr_check, which now
+re-exports from here) this module adds the other three IR audits the
+verify CLI drives:
+
+- `transfer_ops` — host-transfer/callback primitives reachable from a
+  traced program (the static generalization of the resident drivers'
+  runtime `jax.transfer_guard("disallow")`);
+- `donation_report` — `tf.aliasing_output` attributes in the lowered
+  StableHLO, the compiled-artifact truth of `donate_argnums` (a
+  shape/dtype mismatch silently drops the alias and the "donated"
+  buffer is copied every step);
+- `recompile_report` — jit-cache identity across two perturbed but
+  static-compatible calls (the semantic form of TDC003).
+
+Uses jax — imported by tests and explicit callers only, never by the
+`python -m tdc_tpu.lint` CLI (which must run with zero third-party
+imports); every jax import below is function-local for that reason.
+"""
+
+from __future__ import annotations
+
+import re
+import warnings
+from dataclasses import dataclass, field
+
+# The collective primitive names as they appear in jaxpr eqns. pmean is
+# absent on purpose: it decomposes to psum + div before it reaches a
+# jaxpr.
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "all_gather", "all_to_all",
+    "psum_scatter", "reduce_scatter", "pgather", "pbroadcast",
+})
+
+# Primitives that imply a host round trip (or a host-driven callback)
+# inside a compiled program: a `jax.device_put` traced into a hot step, a
+# `jax.debug.print`/`pure_callback`/`io_callback` in a path that runs per
+# batch, or the infeed/outfeed legacy channels. Any of these inside a
+# registry entry defeats the zero-transfer contract the resident tier's
+# runtime transfer_guard enforces — this walk proves it statically, for
+# every traced path rather than the one the smoke happened to execute.
+TRANSFER_PRIMITIVES = frozenset({
+    "device_put", "pure_callback", "io_callback", "debug_callback",
+    "callback", "infeed", "outfeed",
+})
+
+
+class CollectiveDivergenceError(AssertionError):
+    """A cond/switch emits different collective sequences per branch, or
+    two traces of the same function disagree — some shard/process can
+    execute a collective sequence its peers don't, which deadlocks the
+    gang (or silently corrupts a quantized reduce)."""
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One collective eqn in program order — the golden-schedule record:
+    primitive, named axes, and every operand's shape/dtype (the contract
+    arXiv 2112.01075 verifies; a dtype change on the wire is drift even
+    when the primitive sequence is unchanged)."""
+
+    prim: str
+    axes: str  # "axes=('data',)" — _axes_of's format (legacy-pinned)
+    operands: tuple[tuple[tuple[int, ...], str], ...]  # ((shape, dtype),...)
+    in_while: bool = False
+
+    def legacy(self) -> str:
+        """The string format TraceReport.sequence has always used (and
+        tests pin): 'psum[axes=(...)]', 'while:'-prefixed in loop
+        bodies."""
+        s = f"{self.prim}[{self.axes}]"
+        return f"while:{s}" if self.in_while else s
+
+    def to_json(self) -> dict:
+        return {
+            "prim": self.prim,
+            "axes": self.axes,
+            "operands": [
+                {"shape": list(shape), "dtype": dtype}
+                for shape, dtype in self.operands
+            ],
+            "while": self.in_while,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "CollectiveOp":
+        return CollectiveOp(
+            prim=d["prim"],
+            axes=d["axes"],
+            operands=tuple(
+                (tuple(o["shape"]), o["dtype"]) for o in d["operands"]
+            ),
+            in_while=bool(d.get("while", False)),
+        )
+
+
+@dataclass
+class TraceReport:
+    sequence: list[str]  # e.g. ["psum[axes=('data',)]", ...]
+    divergences: list[str] = field(default_factory=list)
+    # Collectives inside lax.while_loop bodies (entries also appear in
+    # `sequence` with a "while:" prefix). A while loop's trip count is
+    # value-dependent: if the predicate consults shard-local values, the
+    # shards issue these collectives DIFFERENT numbers of times and the
+    # gang deadlocks — a divergence this static walk cannot prove or
+    # refute (the repo's in-jit Lloyd loops are safe because their
+    # predicate derives from the globally-psum'd shift, but that is a
+    # data-flow property). Callers wanting a hard guarantee pass
+    # forbid_while_collectives=True.
+    while_collectives: list[str] = field(default_factory=list)
+    # The detailed per-op records `sequence` is derived from (shapes and
+    # dtypes included) — what the schedule goldens serialize.
+    ops: list[CollectiveOp] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.divergences
+
+
+def _axes_of(params: dict) -> str:
+    for key in ("axes", "axis_name", "axis_index_groups"):
+        if key in params and params[key] is not None and \
+                key != "axis_index_groups":
+            val = params[key]
+            if not isinstance(val, tuple):
+                val = (val,)
+            named = tuple(str(a) for a in val)
+            return f"axes={named}"
+    return "axes=?"
+
+
+def _operands_of(eqn) -> tuple[tuple[tuple[int, ...], str], ...]:
+    out = []
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        shape = tuple(int(s) for s in getattr(aval, "shape", ()))
+        dtype = str(getattr(aval, "dtype", "?"))
+        out.append((shape, dtype))
+    return tuple(out)
+
+
+def _subjaxprs(params: dict):
+    """Every Jaxpr/ClosedJaxpr reachable from an eqn's params — covers
+    pjit, shard_map, scan, while, cond, remat, custom_* generically."""
+    import jax.core as core
+
+    closed = getattr(core, "ClosedJaxpr", None)
+    open_ = getattr(core, "Jaxpr", None)
+
+    def visit(val):
+        if closed is not None and isinstance(val, closed):
+            yield val.jaxpr
+        elif open_ is not None and isinstance(val, open_):
+            yield val
+        elif isinstance(val, (tuple, list)):
+            for v in val:
+                yield from visit(v)
+
+    for key, val in params.items():
+        if key in ("branches",):
+            continue  # cond branches are compared, not inlined, below
+        yield from visit(val)
+
+
+def _walk(jaxpr, out: list[CollectiveOp], divergences: list[str],
+          in_while: bool = False) -> None:
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim in COLLECTIVE_PRIMITIVES:
+            out.append(CollectiveOp(
+                prim=prim, axes=_axes_of(eqn.params),
+                operands=_operands_of(eqn), in_while=in_while,
+            ))
+            continue
+        if prim == "while":
+            # Value-dependent trip count: body collectives repeat an
+            # unknowable number of times — recorded separately (see
+            # TraceReport.while_collectives) instead of silently inlined
+            # as if they ran once.
+            for key in ("cond_jaxpr", "body_jaxpr"):
+                sub = eqn.params.get(key)
+                if sub is not None:
+                    _walk(sub.jaxpr, out, divergences, in_while=True)
+            continue
+        if prim in ("cond", "switch"):
+            branch_seqs: list[list[CollectiveOp]] = []
+            for br in eqn.params.get("branches", ()):
+                seq: list[CollectiveOp] = []
+                _walk(br.jaxpr, seq, divergences, in_while)
+                branch_seqs.append(seq)
+            if branch_seqs and any(
+                    [o.legacy() for o in s]
+                    != [o.legacy() for o in branch_seqs[0]]
+                    for s in branch_seqs[1:]):
+                legacy = [[o.legacy() for o in s] for s in branch_seqs]
+                divergences.append(
+                    f"cond branches emit different collective sequences "
+                    f"{legacy} — a shard-varying predicate here "
+                    "desyncs the gang"
+                )
+            # Executed exactly once whichever branch wins; with uniform
+            # branches the subsequence is unconditionally part of the
+            # program order.
+            if branch_seqs:
+                out.extend(branch_seqs[0])
+            continue
+        for sub in _subjaxprs(eqn.params):
+            _walk(sub, out, divergences, in_while)
+
+
+def collective_trace(fn, *args, **kwargs) -> TraceReport:
+    """Trace fn(*args, **kwargs) and return its ordered collective
+    sequence plus any branch-divergence findings."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    ops: list[CollectiveOp] = []
+    divergences: list[str] = []
+    _walk(closed.jaxpr, ops, divergences)
+    return TraceReport(
+        sequence=[o.legacy() for o in ops],
+        divergences=divergences,
+        while_collectives=[o.legacy() for o in ops if o.in_while],
+        ops=ops,
+    )
+
+
+def assert_uniform_collectives(fn, *args, n_traces: int = 2,
+                               require_collectives: bool = False,
+                               forbid_while_collectives: bool = False,
+                               **kwargs) -> TraceReport:
+    """The whole contract in one call: trace `fn` `n_traces` times,
+    assert (a) no divergent cond branches, (b) the sequence is identical
+    across traces, and optionally (c) at least one collective is present
+    (a tower that silently lost its psum 'passes' any divergence check).
+    Returns the report of the first trace.
+
+    Caveat (see TraceReport.while_collectives): collectives inside
+    lax.while_loop bodies run trip-count-many times, and trip-count
+    uniformity across shards is a data-flow property this static walk
+    cannot decide — a convergence loop whose predicate derives from a
+    globally-reduced value is safe; one consulting shard-local state is
+    a deadlock. Such collectives are reported, and hard-rejected with
+    forbid_while_collectives=True."""
+    reports = [collective_trace(fn, *args, **kwargs)
+               for _ in range(max(n_traces, 1))]
+    first = reports[0]
+    if first.divergences:
+        raise CollectiveDivergenceError("\n".join(first.divergences))
+    if forbid_while_collectives and first.while_collectives:
+        raise CollectiveDivergenceError(
+            f"collectives inside while-loop bodies "
+            f"{first.while_collectives}: the trip count is value-"
+            "dependent, so per-shard uniformity of these collectives "
+            "cannot be statically guaranteed — prove the predicate is "
+            "derived from globally-reduced values, or restructure with "
+            "a static-length lax.scan"
+        )
+    for i, rep in enumerate(reports[1:], start=2):
+        if rep.sequence != first.sequence:
+            raise CollectiveDivergenceError(
+                f"collective sequence is not stable across traces: trace 1 "
+                f"emitted {first.sequence} but trace {i} emitted "
+                f"{rep.sequence} — the trace consults ambient state, and "
+                "processes compiling at different times would run "
+                "different programs"
+            )
+    if require_collectives and not first.sequence:
+        raise CollectiveDivergenceError(
+            "no collective primitive found in the trace — the cross-shard "
+            "reduce was lost (or the wrong tower was checked)"
+        )
+    return first
+
+
+# ---------------------------------------------------------------------------
+# Transfer audit (jaxpr walk)
+# ---------------------------------------------------------------------------
+
+
+def transfer_ops(fn, *args, **kwargs) -> list[str]:
+    """Host-transfer/callback primitives reachable from tracing
+    fn(*args) — 'device_put', 'debug_callback(while)' etc., in program
+    order ('(while)' marks ops inside a while body, where they repeat
+    per iteration). Empty list = the zero-transfer contract holds
+    statically."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    found: list[str] = []
+
+    def walk(jaxpr, in_while: bool) -> None:
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim in TRANSFER_PRIMITIVES:
+                found.append(f"{prim}(while)" if in_while else prim)
+            if prim == "while":
+                for key in ("cond_jaxpr", "body_jaxpr"):
+                    sub = eqn.params.get(key)
+                    if sub is not None:
+                        walk(sub.jaxpr, True)
+                continue
+            if prim in ("cond", "switch"):
+                for br in eqn.params.get("branches", ()):
+                    walk(br.jaxpr, in_while)
+                continue
+            for sub in _subjaxprs(eqn.params):
+                walk(sub, in_while)
+
+    walk(closed.jaxpr, False)
+    return found
+
+
+# ---------------------------------------------------------------------------
+# Donation audit (lowered-artifact inspection)
+# ---------------------------------------------------------------------------
+
+# Donation in the lowered artifact takes two spellings: a definite
+# input→output alias (`tf.aliasing_output = N`, single-device/committed
+# layouts) or a compiler-delegated donation (`jax.buffer_donor = true`,
+# sharded args whose aliasing XLA resolves at compile time). Either one
+# means the donate_argnums contract survived lowering; a shape/dtype
+# mismatch drops BOTH (with a "donated buffers were not usable" warning).
+_ALIAS_RE = re.compile(r"tf\.aliasing_output|jax\.buffer_donor")
+
+
+@dataclass(frozen=True)
+class DonationReport:
+    """declared = donated leaves the factory contract promises; aliased =
+    input-output aliases actually present in the lowered artifact. A
+    shortfall means some 'donated' buffer is silently copied every
+    dispatch (shape/dtype mismatch between the donated input and every
+    output, or a dropped donate_argnums)."""
+
+    declared: int
+    aliased: int
+    dropped: tuple[str, ...]  # lowering warnings naming unusable buffers
+
+    @property
+    def ok(self) -> bool:
+        return self.aliased == self.declared
+
+
+def donation_report(jit_fn, *args, declared: int, **kwargs) -> DonationReport:
+    """Lower `jit_fn(*args)` and count `tf.aliasing_output` argument
+    attributes in the StableHLO — the compiled-artifact truth of
+    donate_argnums. `declared` is the number of donated *leaves* the
+    entry promises (every leaf of every donated argument)."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        text = jit_fn.lower(*args, **kwargs).as_text()
+    aliased = len(_ALIAS_RE.findall(text))
+    dropped = tuple(
+        str(w.message) for w in caught
+        if "donated" in str(w.message).lower()
+    )
+    return DonationReport(declared=declared, aliased=aliased,
+                          dropped=dropped)
+
+
+# ---------------------------------------------------------------------------
+# Recompile audit (jit-cache identity)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RecompileReport:
+    """Cache growth across two static-compatible calls. new_entries_second
+    must be 0: a second compile for inputs that only changed *values*
+    means some static argument (an f-string config, a fresh closure, a
+    non-hashable-coerced object) varies per call — TDC003's hazard, proven
+    on the artifact cache instead of the source."""
+
+    new_entries_first: int
+    new_entries_second: int
+
+    @property
+    def ok(self) -> bool:
+        return self.new_entries_second == 0
+
+
+def recompile_report(jit_fn, args_first, args_second) -> RecompileReport:
+    """Call `jit_fn` with two freshly-built, perturbed-but-compatible
+    argument tuples and report jit-cache growth per call. Arguments must
+    be fresh per call (donated buffers are consumed)."""
+    import jax
+
+    size = jit_fn._cache_size
+    before = size()
+    jax.block_until_ready(jit_fn(*args_first))
+    mid = size()
+    jax.block_until_ready(jit_fn(*args_second))
+    after = size()
+    return RecompileReport(
+        new_entries_first=mid - before,
+        new_entries_second=after - mid,
+    )
+
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES",
+    "CollectiveDivergenceError",
+    "CollectiveOp",
+    "DonationReport",
+    "RecompileReport",
+    "TRANSFER_PRIMITIVES",
+    "TraceReport",
+    "assert_uniform_collectives",
+    "collective_trace",
+    "donation_report",
+    "recompile_report",
+    "transfer_ops",
+]

@@ -1,0 +1,185 @@
+"""Collective-schedule goldens: load, compare, ratchet.
+
+The committed golden file (tests/golden/collective_schedules/
+schedules.json) is the single source of truth for every registry entry's
+collective schedule — primitive, named axes, operand shapes/dtypes, and
+while-body membership, in program order. The CI stage compares live
+traces against it and fails with a structured diff on ANY difference;
+regeneration is an explicit, reviewed step:
+
+    python -m tdc_tpu.verify --write-goldens
+    git diff tests/golden/collective_schedules/schedules.json  # REVIEW!
+
+exactly the tdclint-baseline workflow (docs/LINTING.md): the diff of the
+committed JSON reads as a schedule ledger, and a regeneration that adds
+or reorders collectives is a reviewable event, never an invisible one.
+
+Tests assert against the same file via `golden_sequence(entry_id)`
+(legacy 'psum[axes=(...)]' strings, shape-independent) so the scattered
+assert_uniform_collectives pins and the CI goldens can never disagree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from functools import lru_cache
+
+from tdc_tpu.verify.ir import CollectiveOp
+
+GOLDEN_VERSION = 1
+
+# Repo-relative default; resolved against this file so the CLI works from
+# any cwd (the lint CLI's path discipline).
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_GOLDEN_PATH = os.path.join(
+    _REPO, "tests", "golden", "collective_schedules", "schedules.json")
+
+
+@dataclass(frozen=True)
+class ScheduleDiff:
+    """One entry's golden-vs-live difference, human-structured: the first
+    divergent position plus both full legacy sequences."""
+
+    entry: str
+    message: str
+
+
+def load_goldens(path: str = DEFAULT_GOLDEN_PATH) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != GOLDEN_VERSION:
+        raise ValueError(
+            f"golden {path}: unsupported version {data.get('version')!r} "
+            f"(want {GOLDEN_VERSION})"
+        )
+    return data
+
+
+@lru_cache(maxsize=4)
+def _load_cached(path: str) -> dict:
+    """Read-only consumers (the test pins call golden_sequence several
+    times per test) share one parse per path; the gate and the regen
+    path go through the uncached load_goldens."""
+    return load_goldens(path)
+
+
+def golden_ops(entry_id: str, path: str = DEFAULT_GOLDEN_PATH) \
+        -> list[CollectiveOp]:
+    """The committed CollectiveOps for one entry (KeyError if absent —
+    a test asserting against a missing golden must fail loudly)."""
+    data = _load_cached(path)
+    ent = data["entries"][entry_id]
+    return [CollectiveOp.from_json(d) for d in ent["collectives"]]
+
+
+def golden_sequence(entry_id: str, path: str = DEFAULT_GOLDEN_PATH) \
+        -> list[str]:
+    """The committed legacy-format sequence ('psum[axes=(...)]', while:
+    prefixed) for one entry — what the migrated test pins assert against.
+    Shape-independent on purpose: tests trace their own (smaller) configs
+    of the same factory."""
+    return [op.legacy() for op in golden_ops(entry_id, path)]
+
+
+def write_goldens(schedules: dict[str, list[CollectiveOp]],
+                  path: str = DEFAULT_GOLDEN_PATH) -> dict:
+    """Serialize `schedules` (entry id → traced ops) as the new golden
+    file — sorted keys, one op per JSON object, trailing newline, atomic
+    replace (the baseline writer's conventions)."""
+    data = {
+        "version": GOLDEN_VERSION,
+        "note": (
+            "tdcverify collective-schedule goldens — ONE source of truth "
+            "for every driver entry point's compiled collective sequence "
+            "(docs/VERIFICATION.md). Regenerate with `python -m "
+            "tdc_tpu.verify --write-goldens` and REVIEW the diff: a new/"
+            "reordered/retyped collective here is a cross-gang contract "
+            "change, not noise."
+        ),
+        "entries": {
+            eid: {"collectives": [op.to_json() for op in ops]}
+            for eid, ops in sorted(schedules.items())
+        },
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def _fmt_op(op: CollectiveOp) -> str:
+    shapes = ", ".join(
+        f"{dtype}[{'x'.join(map(str, shape))}]" for shape, dtype in
+        op.operands
+    )
+    return f"{op.legacy()} <{shapes}>"
+
+
+def compare(schedules: dict[str, list[CollectiveOp]],
+            goldens: dict,
+            known_ids: set[str] | None = None) -> list[ScheduleDiff]:
+    """Structured golden-vs-live diff over the whole registry. Every
+    difference is a finding: sequence drift (with the first divergent
+    index), entries missing a golden (regen + review), and stale goldens
+    whose entry no longer exists (regen so the ledger doesn't rot).
+
+    known_ids: every registry id the run attempted (traced or not). A
+    golden whose id is known but absent from `schedules` already produced
+    a trace-failure finding upstream — reporting it stale here would
+    steer the operator into a ledger-wiping regeneration. None skips the
+    stale sweep entirely (a filtered --entries run, the lint partial-run
+    rule)."""
+    diffs: list[ScheduleDiff] = []
+    recorded = goldens.get("entries", {})
+    for eid, ops in sorted(schedules.items()):
+        if eid not in recorded:
+            diffs.append(ScheduleDiff(
+                eid,
+                "no committed golden for this entry — run `python -m "
+                "tdc_tpu.verify --write-goldens`, review the diff, and "
+                "commit tests/golden/collective_schedules/schedules.json",
+            ))
+            continue
+        want = [CollectiveOp.from_json(d)
+                for d in recorded[eid]["collectives"]]
+        if ops == want:
+            continue
+        live_s = [_fmt_op(o) for o in ops]
+        want_s = [_fmt_op(o) for o in want]
+        first = next(
+            (i for i, (a, b) in enumerate(zip(live_s, want_s)) if a != b),
+            min(len(live_s), len(want_s)),
+        )
+        diffs.append(ScheduleDiff(
+            eid,
+            f"collective schedule drifted from golden at position {first}: "
+            f"live={live_s} golden={want_s} — if the change is intended, "
+            "regenerate with --write-goldens and review the diff",
+        ))
+    if known_ids is not None:
+        for eid in sorted(set(recorded) - known_ids):
+            diffs.append(ScheduleDiff(
+                eid,
+                "golden entry has no registry entry point (renamed or "
+                "removed) — regenerate goldens so the ledger tracks the "
+                "zoo",
+            ))
+    return diffs
+
+
+__all__ = [
+    "DEFAULT_GOLDEN_PATH",
+    "GOLDEN_VERSION",
+    "ScheduleDiff",
+    "compare",
+    "golden_ops",
+    "golden_sequence",
+    "load_goldens",
+    "write_goldens",
+]

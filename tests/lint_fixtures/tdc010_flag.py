@@ -1,0 +1,21 @@
+"""MUST-FLAG TDC010: span names that drift from KNOWN_SPANS — a typo'd
+span, an unregistered instant, a timed_iter name nobody registered, a
+computed (f-string) name, plus registry charset hygiene."""
+
+from tdc_tpu.obs import trace
+
+KNOWN_SPANS = frozenset({
+    "pass",
+    "read",
+    "Resident-Chunk",  # flagged: not lowercase_snake
+})
+
+
+def run_pass(batches, n_iter, phase):
+    with trace.span("pas", n_iter=n_iter):  # typo: not in registry
+        for batch in trace.timed_iter(batches, "reed"):  # typo'd iter name
+            consume = batch
+        trace.instant("pass_bound", n=n_iter)  # unregistered instant
+    with trace.span(f"pass_{phase}"):  # computed name: uncheckable
+        pass
+    return consume

@@ -1,0 +1,47 @@
+"""MUST-NOT-FLAG TDC010: span call sites match the KNOWN_SPANS registry
+exactly — span()/instant() name at arg 0, timed_iter() name at arg 1;
+bare (non-trace-receiver) `span(...)` calls and other objects' .span()
+methods are out of scope."""
+
+from tdc_tpu.obs import trace
+
+KNOWN_SPANS = frozenset({
+    "pass",
+    "read",
+    "compute",
+    "checkpoint",
+    "pass_boundary",
+})
+
+
+def run_pass(batches, n_iter):
+    with trace.span("pass", n_iter=n_iter):
+        for batch in trace.timed_iter(batches, "read"):
+            with trace.span("compute", n_iter=n_iter):
+                consume = batch
+        trace.instant("pass_boundary", n=n_iter)
+    return consume
+
+
+def save(trace_dir, n_iter):
+    with trace.span("checkpoint", step=n_iter):
+        pass
+
+
+def internal_helper(name):
+    # trace.py's own interior: a bare call forwarding a variable is the
+    # implementation, not a call site of the literal interface.
+    def span(n):
+        return n
+
+    return span(name)
+
+
+class Tracer:
+    def span(self, anything):
+        return anything
+
+
+def other_receiver(tracer: Tracer, label):
+    # Not obs.trace: a .span() method on some other object.
+    return tracer.span(label)

@@ -49,6 +49,8 @@ RULES = [
     ("TDC008", 2),  # undeclared literal, typo'd axis_name kwarg
     ("TDC009", 5),  # typo'd ref, unregistered ref, suffixed ref,
     #                 computed catalog key, bad-charset catalog key
+    ("TDC010", 5),  # typo'd span, typo'd timed_iter name, unregistered
+    #                 instant, f-string name, bad-charset registry entry
 ]
 
 
@@ -324,6 +326,39 @@ def test_github_format(tmp_path, capsys):
         and "title=TDC002" in out
 
 
+def test_github_format_respects_baseline_dot_paths(tmp_path, capsys):
+    """Regression (ISSUE 13 satellite): the CI annotation job invoked the
+    linter with `./`-prefixed paths; the baseline fingerprint hashed the
+    raw walked path (`./pkg/mod.py` vs the recorded `pkg/mod.py`), so
+    every grandfathered finding leaked onto PRs as a `::error`
+    annotation. github format must only surface NEW findings regardless
+    of path spelling."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(_HOT_SYNC.format(suffix=""))
+    bl = tmp_path / "bl.json"
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        assert lint_main([f"--baseline={bl}", "--write-baseline",
+                          "pkg"]) == 0
+        capsys.readouterr()
+        # Same tree, dot-prefixed spelling: everything is grandfathered,
+        # so github format must print NOTHING and exit 0.
+        rc = lint_main([f"--baseline={bl}", "--format=github", "./pkg"])
+        out = capsys.readouterr().out.strip()
+        assert rc == 0 and out == "", out
+        # A genuinely new finding still annotates under dot-paths.
+        (pkg / "mod.py").write_text(
+            _HOT_SYNC.format(suffix="\n        w = float(loss)"))
+        rc = lint_main([f"--baseline={bl}", "--format=github", "./pkg"])
+        out = capsys.readouterr().out.strip()
+        assert rc == 1
+        assert out.count("::error") == 1 and "title=TDC002" in out
+    finally:
+        os.chdir(cwd)
+
+
 def test_syntax_error_gates(tmp_path):
     f = tmp_path / "broken.py"
     f.write_text("def f(:\n")
@@ -478,6 +513,17 @@ def test_fault_points_match_registry():
     }
 
 
+def test_span_names_match_registry():
+    # ISSUE 13 satellite: TDC010 — every literal obs.trace span/instant/
+    # timed_iter name across the package AND the tests must be in
+    # trace.KNOWN_SPANS (the docs/OBSERVABILITY.md drift test pins the
+    # registry to the doc; this pins the call sites to the registry).
+    found = run_paths([os.path.join(REPO, "tdc_tpu"),
+                       os.path.join(REPO, "tests")],
+                      select={"TDC010"}).findings
+    assert found == [], [f.location() for f in found]
+
+
 # ---------------------------------------------------------------------------
 # jaxpr collective-trace checker (the compile-time layer)
 # ---------------------------------------------------------------------------
@@ -510,6 +556,12 @@ class TestJaxprCheck:
         assert len(psums) == 3 and all("data" in p for p in psums)
         # scan-based tower: no value-dependent-trip-count collectives
         assert rep.while_collectives == []
+        # ...and the sequence is the committed tdcverify golden (ONE
+        # source of truth; docs/VERIFICATION.md).
+        from tdc_tpu.verify.schedule import golden_sequence
+
+        assert rep.sequence == golden_sequence(
+            "sharded_k.kmeans.per_batch.exact")
 
     def test_deferred_tower_emits_no_collectives(self, mesh2d):
         """The deferred (reduce_data=False) tower is the per-pass

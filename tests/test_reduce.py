@@ -145,7 +145,12 @@ def test_per_pass_accumulate_compiles_with_no_collectives():
     """The deferred accumulate must be collective-free (the whole point:
     per-batch work stays shard-local) and the deferred reduce must carry
     the pass's all-reduce — checked on the compiled HLO, not trust in the
-    host-side counter."""
+    host-side counter, AND pinned jaxpr-level against the committed
+    tdcverify goldens (the one source of truth `python -m tdc_tpu.verify`
+    gates on; docs/VERIFICATION.md)."""
+    from tdc_tpu.lint.jaxpr_check import collective_trace
+    from tdc_tpu.verify.schedule import golden_sequence
+
     mesh = make_mesh(8)
     k, d = 4, 8
     zero_acc, acc_add, reducer = _deferred_lloyd_fns(
@@ -161,6 +166,14 @@ def test_per_pass_accumulate_compiles_with_no_collectives():
     assert "all-reduce" not in add_hlo
     red_hlo = jax.jit(reducer).lower(acc).compile().as_text()
     assert "all-reduce" in red_hlo
+    # Golden pins (shape-independent legacy format): the add's explicit
+    # schedule is EMPTY, the reduce's is the 3 data-axis stat psums —
+    # same strings the verify stage compares every CI run.
+    assert collective_trace(acc_add, acc, xb, c).sequence == \
+        golden_sequence("kmeans_1d.per_pass.acc_add") == []
+    assert collective_trace(reducer, acc).sequence == \
+        golden_sequence("kmeans_1d.per_pass.reduce") == \
+        ["psum[axes=('data',)]"] * 3
 
 
 def test_per_pass_matches_per_batch_fuzzy(blobs_small):

@@ -541,14 +541,20 @@ class TestTransferGuard:
                 )
 
     def test_resident_chunk_collectives_uniform(self):
-        """jaxpr pin (satellite): the resident chunk's while body carries
-        EXACTLY the one logical per-pass reduce — 3 data-axis psums (sums,
-        counts, sse), identical across traces, no divergent branches. The
-        loop predicate derives from the globally-reduced shift, so the
-        while-collective caveat is satisfied by construction."""
+        """jaxpr pin: the resident chunk's while body carries EXACTLY the
+        one logical per-pass reduce, identical across traces, no
+        divergent branches — asserted against the COMMITTED tdcverify
+        goldens (tests/golden/collective_schedules/schedules.json), the
+        one source of truth `python -m tdc_tpu.verify` gates on
+        (docs/VERIFICATION.md); the legacy golden_sequence format is
+        shape-independent, so this smaller config traces the same
+        strings. The loop predicate derives from the globally-reduced
+        shift, so the while-collective caveat is satisfied by
+        construction."""
         from tdc_tpu.lint.jaxpr_check import assert_uniform_collectives
         from tdc_tpu.models import resident as resident_lib
         from tdc_tpu.models.streaming import _resident_lloyd_fns
+        from tdc_tpu.verify.schedule import golden_sequence
 
         mesh = make_mesh(4)
         x = _data(515, d=4)
@@ -567,9 +573,14 @@ class TestTransferGuard:
         cap = resident_lib.place_scalar(4, mesh)
         rep = assert_uniform_collectives(chunk, c, (), cap, cache,
                                          require_collectives=True)
+        assert rep.sequence == golden_sequence("kmeans_1d.hbm.per_pass.chunk")
+        # The golden itself must still say what it always said — the
+        # migration may not weaken the pin.
         assert rep.sequence == ["while:psum[axes=('data',)]"] * 3
         rep2 = assert_uniform_collectives(pass_only, c, (), cache,
                                           require_collectives=True)
+        assert rep2.sequence == golden_sequence(
+            "kmeans_1d.hbm.per_pass.final_pass")
         assert rep2.sequence == ["psum[axes=('data',)]"] * 3
 
 

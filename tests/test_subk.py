@@ -496,3 +496,47 @@ def test_metrics_surface_names():
                  "tdc_assign_pruned_fraction"):
         assert name in src
     subk.GLOBAL_ASSIGN.reset()
+
+
+# ---------------------------------------------------------------------------
+# collective-schedule goldens (tdcverify is the one source of truth)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_coarse_schedule_matches_committed_goldens():
+    """Acceptance pin (ISSUE 13): the coarse→refine sharded tower's
+    collective schedule is byte-identical to exact's — asserted against
+    the COMMITTED tdcverify goldens (tests/golden/collective_schedules/
+    schedules.json, the file `python -m tdc_tpu.verify` gates CI on;
+    docs/VERIFICATION.md) so this test and the CI stage can never
+    disagree. The legacy golden_sequence format is shape-independent:
+    this smaller (2,2) mesh traces the same strings as the registry's
+    (2,4)."""
+    from tdc_tpu.lint.jaxpr_check import assert_uniform_collectives
+    from tdc_tpu.parallel.sharded_k import make_mesh_2d, make_sharded_stats
+    from tdc_tpu.verify.schedule import golden_sequence
+
+    mesh = make_mesh_2d(2, 2)
+    k, d = 16, 4  # local K/Pm = 8 -> 4 tiles; probe=2 stays coarse
+    x = jnp.zeros((32, d), jnp.float32)
+    c = jnp.ones((k, d), jnp.float32)
+    exact = make_sharded_stats(mesh)
+    aspec = subk.resolve_assign("coarse", k // 2, probe=2, label="test")
+    assert aspec.coarse
+    coarse = make_sharded_stats(mesh, assign_spec=aspec)
+
+    golden = golden_sequence("sharded_k.kmeans.per_batch.exact")
+    assert golden_sequence("sharded_k.kmeans.per_batch.coarse") == golden
+    # The committed schedule still says what it always said (the
+    # migration may not weaken the pin): 2 champion all_gathers over the
+    # model axis + the 3 data-axis stat psums, nothing else.
+    assert golden == ["all_gather[axes=('model',)]"] * 2 + \
+        ["psum[axes=('data',)]"] * 3
+
+    rep_e = assert_uniform_collectives(exact, x, c, require_collectives=True)
+    rep_c = assert_uniform_collectives(coarse, x, c,
+                                       jnp.asarray(32, jnp.int32),
+                                       require_collectives=True)
+    assert rep_e.sequence == golden
+    assert rep_c.sequence == golden
+    assert rep_c.while_collectives == []

@@ -1,0 +1,349 @@
+"""tdcverify suite (ISSUE 13): the IR toolkit's unit behavior, the
+registry's hygiene, the golden round-trip (regen on a clean tree is
+byte-identical), the mutation proofs (a process-branched psum, a dropped
+donation, and an f-string static arg each make the gating stage exit
+non-zero), and the docs/VERIFICATION.md drift pin.
+
+Marked `verify` so the suite can run standalone:
+    pytest tests/test_verify.py -m verify
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+from functools import partial
+
+import pytest
+
+pytestmark = pytest.mark.verify
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "verify_fixtures")
+GOLDEN = os.path.join(REPO, "tests", "golden", "collective_schedules",
+                      "schedules.json")
+
+
+def _cli(*args: str, timeout: int = 240) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "tdc_tpu.verify", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+# ---------------------------------------------------------------------------
+# IR toolkit units
+# ---------------------------------------------------------------------------
+
+
+class TestIrToolkit:
+    def test_transfer_walk_flags_callbacks_and_device_put(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tdc_tpu.verify.ir import transfer_ops
+
+        def dirty(x):
+            jax.debug.print("x={x}", x=x)
+            return jax.device_put(x) + 1.0
+
+        found = transfer_ops(dirty, jnp.ones(4))
+        assert "debug_callback" in found and "device_put" in found
+
+        def clean(x):
+            return x * 2.0
+
+        assert transfer_ops(clean, jnp.ones(4)) == []
+
+    def test_transfer_walk_marks_while_bodies(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tdc_tpu.verify.ir import transfer_ops
+
+        def loopy(x):
+            def body(c):
+                jax.debug.print("c={c}", c=c)
+                return c - 1.0
+
+            return jax.lax.while_loop(lambda c: c.sum() > 0, body, x)
+
+        assert transfer_ops(loopy, jnp.ones(4)) == ["debug_callback(while)"]
+
+    def test_donation_report_counts_aliases(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tdc_tpu.verify.ir import donation_report
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def good(acc, x):
+            return acc + x
+
+        rep = donation_report(good, jnp.zeros((4, 4)), jnp.ones((4, 4)),
+                              declared=1)
+        assert rep.ok and rep.aliased == 1
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def defeated(acc, x):
+            # dtype mismatch: no output can alias the f32 donated input.
+            return (acc + x).astype(jnp.bfloat16)
+
+        rep = donation_report(defeated, jnp.zeros((4, 4)), jnp.ones((4, 4)),
+                              declared=1)
+        assert not rep.ok and rep.aliased == 0
+        assert rep.dropped  # the lowering named the unusable buffer
+
+    def test_recompile_report_catches_static_drift(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tdc_tpu.verify.ir import recompile_report
+
+        @jax.jit
+        def stable(x):
+            return x * 2.0
+
+        rep = recompile_report(stable, (jnp.ones(4),), (jnp.ones(4) + 1,))
+        assert rep.ok
+
+        @partial(jax.jit, static_argnums=(1,))
+        def hazard(x, tag):
+            return x + len(tag)
+
+        rep = recompile_report(
+            hazard, (jnp.ones(4), "cfg-1"), (jnp.ones(4), "cfg-2"))
+        assert not rep.ok and rep.new_entries_second == 1
+
+    def test_collective_op_json_roundtrip(self):
+        from tdc_tpu.verify.ir import CollectiveOp
+
+        op = CollectiveOp(prim="psum", axes="axes=('data',)",
+                          operands=(((8, 4), "float32"),), in_while=True)
+        assert CollectiveOp.from_json(op.to_json()) == op
+        assert op.legacy() == "while:psum[axes=('data',)]"
+
+    def test_jaxpr_check_shim_reexports(self):
+        # Backward compat: lint/jaxpr_check grew into verify/ir but the
+        # old import path keeps working (LINTING.md references it).
+        from tdc_tpu.lint import jaxpr_check
+        from tdc_tpu.verify import ir
+
+        assert jaxpr_check.assert_uniform_collectives \
+            is ir.assert_uniform_collectives
+        assert jaxpr_check.collective_trace is ir.collective_trace
+
+
+# ---------------------------------------------------------------------------
+# Registry hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_ids_unique_and_cross_refs_resolve(self):
+        from tdc_tpu.verify.entries import entries
+
+        ents = entries()
+        ids = [e.id for e in ents]
+        assert len(ids) == len(set(ids))
+        for e in ents:
+            if e.same_schedule_as is not None:
+                assert e.same_schedule_as in ids, e.id
+            assert e.donated_leaves >= 0
+
+    def test_goldens_cover_registry_exactly(self):
+        from tdc_tpu.verify.entries import entries
+
+        data = json.load(open(GOLDEN))
+        assert data["version"] == 1
+        assert set(data["entries"]) == {e.id for e in entries()}
+
+    def test_matrix_covers_documented_configs(self):
+        """The ISSUE's config matrix: 1-D + K-sharded × kmeans/fuzzy/GMM
+        × per_batch/per_pass[:int8] × exact/coarse × stream/hbm all have
+        at least one entry."""
+        from tdc_tpu.verify.entries import entries
+
+        ids = " ".join(e.id for e in entries())
+        for token in ("kmeans_1d", "fuzzy_1d", "gmm_1d", "sharded_k.kmeans",
+                      "sharded_k.fuzzy", "sharded_k.gmm", "per_batch",
+                      "per_pass", "int8", "coarse", "hbm", "hier"):
+            assert token in ids, token
+
+
+# ---------------------------------------------------------------------------
+# Golden round-trip + schedule compare
+# ---------------------------------------------------------------------------
+
+
+class TestGoldens:
+    @pytest.mark.slow
+    def test_regen_on_clean_tree_is_byte_identical(self, tmp_path):
+        out = tmp_path / "schedules.json"
+        r = _cli("--write-goldens", f"--golden={out}")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert out.read_bytes() == open(GOLDEN, "rb").read()
+
+    def test_compare_reports_drift_missing_and_stale(self):
+        from tdc_tpu.verify.ir import CollectiveOp
+        from tdc_tpu.verify.schedule import compare
+
+        op = CollectiveOp(prim="psum", axes="axes=('data',)",
+                          operands=(((4,), "float32"),))
+        gold = {"entries": {
+            "a": {"collectives": [op.to_json()]},
+            "gone": {"collectives": []},
+        }}
+        live = {"a": [], "b": [op]}
+        diffs = compare(live, gold, known_ids={"a", "b"})
+        by_entry = {d.entry: d.message for d in diffs}
+        assert "drifted" in by_entry["a"]
+        assert "no committed golden" in by_entry["b"]
+        assert "no registry entry point" in by_entry["gone"]
+        # known-but-untraced ids (a trace failure upstream) are NOT stale
+        diffs2 = compare({}, gold, known_ids={"a", "gone"})
+        assert all("no registry entry point" not in d.message
+                   or d.entry not in ("a", "gone") for d in diffs2)
+
+    def test_golden_sequence_reads_committed_file(self):
+        from tdc_tpu.verify.schedule import golden_sequence
+
+        seq = golden_sequence("sharded_k.kmeans.per_batch.exact")
+        assert seq == ["all_gather[axes=('model',)]"] * 2 + \
+            ["psum[axes=('data',)]"] * 3
+
+
+# ---------------------------------------------------------------------------
+# Mutation proofs: each seeded defect fails the gating stage
+# ---------------------------------------------------------------------------
+
+
+class TestMutations:
+    def test_divergent_collective_fails_stage(self):
+        r = _cli("--mutate", os.path.join(FIXDIR, "mut_divergent.py"),
+                 "--entries", "kmeans_1d.per_pass.reduce",
+                 "--audits", "schedule")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "different collective sequences" in r.stdout
+
+    def test_dropped_donation_fails_stage(self):
+        r = _cli("--mutate", os.path.join(FIXDIR, "mut_dropped_donation.py"),
+                 "--entries", "kmeans_1d.per_pass.acc_add",
+                 "--audits", "donation")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "declared 3 donated leaves" in r.stdout
+        assert "aliases 0" in r.stdout
+
+    def test_recompile_hazard_fails_stage(self):
+        r = _cli("--mutate", os.path.join(FIXDIR, "mut_recompile.py"),
+                 "--entries", "mut.recompile_hazard",
+                 "--audits", "recompile")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "grew the jit cache" in r.stdout
+
+    @pytest.mark.slow
+    def test_unfiltered_stage_trips_on_mutation(self):
+        # The full gating invocation (no --entries/--audits narrowing),
+        # exactly as ci_tier1.sh runs it, must also exit non-zero.
+        r = _cli("--mutate", os.path.join(FIXDIR, "mut_divergent.py"))
+        assert r.returncode == 1, r.stdout + r.stderr
+
+    def test_write_goldens_guard_rails(self, tmp_path, monkeypatch, capsys):
+        # Usage-error refusals: entry subsets (partial ledger), audit
+        # subsets (an --audits without 'schedule' would rewrite the
+        # ledger EMPTY — reviewed finding), and test-only mutations.
+        for extra in (("--entries", "kmeans_1d"),
+                      ("--audits", "donation"),
+                      ("--mutate",
+                       os.path.join(FIXDIR, "mut_divergent.py"))):
+            r = _cli("--write-goldens", *extra)
+            assert r.returncode == 2, extra
+        # Findings refusal (defense in depth): a registry whose audits
+        # fail must not regenerate, even via the plain invocation.
+        import importlib.util
+
+        import tdc_tpu.verify.entries as entries_mod
+        from tdc_tpu.verify.cli import main as verify_main
+
+        spec = importlib.util.spec_from_file_location(
+            "_mut_div", os.path.join(FIXDIR, "mut_divergent.py"))
+        mut = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mut)
+        monkeypatch.setattr(entries_mod, "entries", mut.entries)
+        out = tmp_path / "g.json"
+        rc = verify_main(["--write-goldens", f"--golden={out}"])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "refusing --write-goldens" in err
+        assert not out.exists()
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_clean_tree_passes_quick_audits(self):
+        # schedule+transfer+donation on the real registry (~2 s); the
+        # full run incl. recompile is the ci_tier1.sh stage itself.
+        r = _cli("--audits", "schedule,transfer,donation")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_json_format_schema(self):
+        r = _cli("--audits", "schedule", "--entries",
+                 "sharded_k.kmeans.per_batch.exact", "--format", "json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        payload = json.loads(
+            "\n".join(l for l in r.stdout.splitlines()
+                      if not l.startswith("{\"ts\"")))
+        assert payload["version"] == 1
+        assert payload["audits"] == ["schedule"]
+        assert payload["findings"] == []
+
+    def test_unknown_audit_is_usage_error(self):
+        r = _cli("--audits", "nonsense")
+        assert r.returncode == 2
+
+    def test_list_entries(self):
+        r = _cli("--list-entries")
+        assert r.returncode == 0
+        assert "sharded_k.kmeans.per_batch.exact" in r.stdout
+        assert "donate=3" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# docs/VERIFICATION.md drift
+# ---------------------------------------------------------------------------
+
+
+class TestVerificationDocDrift:
+    def _doc(self):
+        return open(os.path.join(REPO, "docs", "VERIFICATION.md")).read()
+
+    def test_audit_list_matches_cli_registry(self):
+        from tdc_tpu.verify.cli import AUDITS
+
+        m = re.search(r"^## Audits\n(.*?)(?=^## |\Z)", self._doc(),
+                      re.S | re.M)
+        assert m, "docs/VERIFICATION.md section missing: Audits"
+        doc = set(re.findall(r"^### `([a-z]+)`", m.group(1), re.M))
+        assert doc == set(AUDITS), (
+            f"doc-only: {sorted(doc - set(AUDITS))}; undocumented: "
+            f"{sorted(set(AUDITS) - doc)}"
+        )
+
+    def test_entry_families_documented(self):
+        from tdc_tpu.verify.entries import entries
+
+        doc = self._doc()
+        families = sorted({e.id.split(".")[0] for e in entries()})
+        for fam in families:
+            assert f"`{fam}" in doc, (
+                f"entry family {fam!r} missing from docs/VERIFICATION.md"
+            )

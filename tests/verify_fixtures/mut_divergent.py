@@ -1,0 +1,61 @@
+"""Seeded mutation: a process-branched psum.
+
+Overrides the 1-D per-pass reduce with a tower that only issues the
+cross-device psum on data-shard 0 — the exact gang-deadlock class TDC001
+catches lexically, here reproduced where the lexical rule can't see it
+(the branch is a traced lax.cond on axis_index, not a Python `if`). The
+schedule audit's branch-uniformity walk must fail the stage.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from tdc_tpu.verify.entries import Built, VerifyEntry
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tdc_tpu.parallel.compat import shard_map
+    from tdc_tpu.verify.entries import _mesh1  # the registry's 1-D mesh
+
+    mesh = _mesh1()
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+             check_vma=False)
+    def bad_reduce(acc):
+        local = acc[0]
+
+        def on_shard_zero(t):
+            return jax.lax.psum(t, "data")
+
+        def elsewhere(t):
+            return t * 8.0
+
+        return jax.lax.cond(
+            jax.lax.axis_index("data") == 0, on_shard_zero, elsewhere,
+            local,
+        )
+
+    fn = jax.jit(bad_reduce)
+
+    def fresh(i):
+        from jax.sharding import NamedSharding
+
+        acc = jnp.zeros((8, 8, 4), jnp.float32,
+                        device=NamedSharding(mesh, P("data"))) + i
+        return (acc,)
+
+    return Built(bad_reduce, fn, fresh)
+
+
+def entries() -> list[VerifyEntry]:
+    return [VerifyEntry(
+        id="kmeans_1d.per_pass.reduce",
+        build=_build,
+        recompile=False,
+        notes="mutation: psum only on shard 0",
+    )]

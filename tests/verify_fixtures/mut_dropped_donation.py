@@ -1,0 +1,35 @@
+"""Seeded mutation: a dropped donation.
+
+Overrides the 1-D per-pass acc_add with a rewrap that silently loses the
+`donate_argnums=(0,)` contract while keeping the math (and therefore the
+collective schedule) identical — the failure mode where a refactor
+re-jits a step and the n_dev×-larger deferred accumulator quietly starts
+being copied every batch. The donation audit must count 0 aliased inputs
+against the 3 declared leaves; every other audit stays green.
+"""
+
+from __future__ import annotations
+
+from tdc_tpu.verify.entries import Built, VerifyEntry
+
+
+def _build():
+    import jax
+
+    from tdc_tpu.verify.entries import _build_acc_add
+
+    real = _build_acc_add("kmeans")()
+
+    # Same computation, donation dropped: a fresh jit wrapper with no
+    # donate_argnums on top of the real step.
+    fn = jax.jit(lambda acc, x, c: real.fn(acc, x, c))
+    return Built(fn, fn, real.fresh)
+
+
+def entries() -> list[VerifyEntry]:
+    return [VerifyEntry(
+        id="kmeans_1d.per_pass.acc_add",
+        build=_build,
+        donated_leaves=3,
+        notes="mutation: donate_argnums lost in a rewrap",
+    )]

@@ -1,0 +1,41 @@
+"""Seeded mutation: an f-string static argument.
+
+Adds an entry whose jitted step takes a per-call f-string in a declared
+static position (TDC003's recompile hazard, reproduced semantically): a
+second call that only changed *values* carries a fresh static string and
+silently recompiles. The recompile audit must see the jit cache grow on
+the second static-compatible call.
+
+Run with --audits=recompile: the f-string static also defeats abstract
+tracing, so the schedule/transfer walks report a trace failure rather
+than this entry's specific hazard.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from tdc_tpu.verify.entries import Built, VerifyEntry
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnums=(1,))
+    def step(x, tag):
+        return x * 2.0 + len(tag)
+
+    def fresh(i):
+        # The hazard: the "config tag" interpolates a per-call value.
+        return (jnp.arange(8.0) + i, f"cfg-{i}")
+
+    return Built(step, step, fresh)
+
+
+def entries() -> list[VerifyEntry]:
+    return [VerifyEntry(
+        id="mut.recompile_hazard.fstring_static",
+        build=_build,
+        notes="mutation: per-call f-string in a static jit position",
+    )]
