@@ -82,6 +82,73 @@ def bench_cell(app, model_id, method, d, *, clients, requests_per_client,
     }
 
 
+def bench_coarse(k=16384, d=64, rows=64, repeats=60) -> int:
+    """ROADMAP 3b acceptance: serve-time coarse predict vs the exact all-K
+    route at emulated huge K. Direct engine.run latency (no batcher — the
+    route under test is the compiled assignment, not coalescing):
+
+    - p50 of the coarse route must beat the exact route >= 2x,
+    - a probe="all" model must bit-match the exact route's labels
+      (resolve_assign routes it to the exact path by construction).
+
+    The codebook is hierarchical (the trained-codebook shape; a
+    structureless codebook is the documented coarse worst case —
+    docs/ARCHITECTURE.md "Sub-linear assignment")."""
+    import tempfile as _tmp
+
+    from tdc_tpu.models.persist import save_fitted
+    from tdc_tpu.serve.engine import PredictEngine
+    from tdc_tpu.serve.registry import ModelRegistry
+
+    rng = np.random.default_rng(0)
+    n_super = k // 64
+    supers = rng.uniform(-10, 10, size=(n_super, d)).astype(np.float32)
+    cents = (np.repeat(supers, 64, axis=0)
+             + rng.normal(0, 1.0, size=(k, d))).astype(np.float32)
+    x = (cents[rng.integers(0, k, rows)]
+         + rng.normal(0, 0.05, size=(rows, d))).astype(np.float32)
+
+    root = _tmp.mkdtemp(prefix="tdc_serve_coarse_")
+    for mid, params in (("exact", {}),
+                        ("coarse", {"assign": "coarse", "probe": 8}),
+                        ("all", {"assign": "coarse", "probe": "all"})):
+        save_fitted(os.path.join(root, mid), model="kmeans",
+                    arrays={"centroids": cents}, params=params)
+    reg = ModelRegistry()
+    eng = PredictEngine()
+    entries = {mid: reg.add(mid, os.path.join(root, mid))
+               for mid in ("exact", "coarse", "all")}
+
+    def p50(mid):
+        eng.run(entries[mid], "predict", x)  # warm the compile
+        samples = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            eng.run(entries[mid], "predict", x)
+            samples.append((time.perf_counter() - t0) * 1e3)
+        return float(np.percentile(samples, 50))
+
+    p_exact = p50("exact")
+    p_coarse = p50("coarse")
+    out_e, _ = eng.run(entries["exact"], "predict", x)
+    out_a, meta_a = eng.run(entries["all"], "predict", x)
+    out_c, meta_c = eng.run(entries["coarse"], "predict", x)
+    bitexact = bool(np.array_equal(out_a, out_e))
+    agree = float(np.mean(out_c == out_e))
+    speedup = p_exact / max(p_coarse, 1e-9)
+    ok = speedup >= 2.0 and bitexact and meta_c["kernel"] == "coarse" \
+        and meta_a["kernel"] != "coarse"
+    print(
+        "SERVE-COARSE "
+        + ("PASS" if ok else "FAIL")
+        + f": K={k} d={d} rows={rows}: exact p50={p_exact:.2f} ms, "
+        f"coarse p50={p_coarse:.2f} ms, speedup={speedup:.1f}x (floor "
+        f"2x), probe_all_bitexact={bitexact}, champion_agreement="
+        f"{agree:.4f}"
+    )
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--out", default=None, help="markdown output path")
@@ -91,7 +158,14 @@ def main(argv=None) -> int:
     p.add_argument("--k", type=int, default=256)
     p.add_argument("--d", type=int, default=64)
     p.add_argument("--max_wait_ms", type=float, default=2.0)
+    p.add_argument("--coarse", action="store_true",
+                   help="run the sub-linear coarse-predict acceptance "
+                        "cell (emulated K=16,384; >= 2x p50 + probe=all "
+                        "bit-exactness) instead of the closed-loop sweep")
     args = p.parse_args(argv)
+
+    if args.coarse:
+        return bench_coarse(k=args.k if args.k > 256 else 16384, d=args.d)
 
     import jax
 

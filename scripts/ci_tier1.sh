@@ -1,18 +1,19 @@
 #!/usr/bin/env bash
 # Tier-1 verify, one command (ROADMAP.md "Tier-1 verify"): the CPU-mesh
 # test suite (8 virtual devices via tests/conftest.py) minus slow-marked
-# tests, the comms + resident + spill + subk + obs + chaos smokes, the
-# tdcverify IR-audit stage, and the tdclint static-analysis gate. The
-# suite-green invariant every PR must hold.
+# tests, the comms + resident + spill + subk + bounds + obs + chaos
+# smokes, the tdcverify IR-audit stage, and the tdclint static-analysis
+# gate. The suite-green invariant every PR must hold.
 #
 #   scripts/ci_tier1.sh            # tests + smokes + verify + lint
 #   SKIP_LINT=1 scripts/ci_tier1.sh
 #
 # Exit code: the FIRST failing stage's code (pytest, then comms smoke,
-# then resident smoke, then spill smoke, then subk smoke, then obs
-# smoke, then verify, then chaos smoke, then lint), with every failed
-# stage named on stderr — a run where pytest passes but both smokes
-# fail must say so, not silently collapse into one opaque code.
+# then resident smoke, then spill smoke, then subk smoke, then bounds
+# smoke, then obs smoke, then verify, then chaos smoke, then lint), with
+# every failed stage named on stderr — a run where pytest passes but
+# both smokes fail must say so, not silently collapse into one opaque
+# code.
 set -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -80,6 +81,19 @@ if [ -z "$SKIP_SUBK_SMOKE" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu \
         python benchmarks/bench_subk.py --smoke \
         | tail -n 1 || subk_rc=$?
+fi
+
+# Bounded-assignment smoke (benchmarks/bench_bounds.py): proves the
+# zero-loss Elkan/Hamerly bounds skip >=60% of distance evaluations by
+# iteration 5 on the blobs config at K=1024 (exact device-side
+# accounting off the donated resident carry) AND that the bounded fit's
+# centroids/SSE are bit-exact vs assign="exact". ~2 min (two 5-iteration
+# K=1024 resident fits).
+bounds_rc=0
+if [ -z "$SKIP_BOUNDS_SMOKE" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python benchmarks/bench_bounds.py --smoke \
+        | tail -n 1 || bounds_rc=$?
 fi
 
 # Observability smoke (scripts/obs_smoke.py): a tiny traced 2-process
@@ -158,7 +172,8 @@ fi
 overall=0
 for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
              "resident-smoke:$resident_rc" "spill-smoke:$spill_rc" \
-             "subk-smoke:$subk_rc" "obs-smoke:$obs_rc" \
+             "subk-smoke:$subk_rc" "bounds-smoke:$bounds_rc" \
+             "obs-smoke:$obs_rc" \
              "verify:$verify_rc" "chaos-smoke:$chaos_rc" \
              "tdclint:$lint_rc" "ruff:$ruff_rc"; do
     name=${stage%%:*}
@@ -169,6 +184,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, bounds-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
 fi
 exit "$overall"

@@ -122,14 +122,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "iters-to-converge comparisons; kmeans only)")
     p.add_argument("--class_sep", type=float, default=1.5)
     p.add_argument("--assign", type=str, default=None,
-                   choices=("exact", "auto", "coarse"),
+                   choices=("exact", "auto", "coarse", "bounded"),
                    help="assignment strategy for streamed/K-sharded "
                         "kmeans: 'exact' (default, all-K), 'coarse' "
                         "(sub-linear coarse->refine tile-pruned "
                         "assignment, ops/subk.py — bounded-loss; see "
-                        "benchmarks/bench_subk.py), or 'auto' (coarse at "
-                        "large K, exact below; logged as "
+                        "benchmarks/bench_subk.py), 'bounded' (ZERO-LOSS "
+                        "sub-linear Elkan/Hamerly bounds, ops/bounds.py "
+                        "— needs --residency hbm/auto; falls back to "
+                        "exact loudly otherwise), or 'auto' (bounded "
+                        "with --residency hbm at large K, else coarse "
+                        "at large K, exact below; logged as "
                         "assign_selected)")
+    p.add_argument("--bounds", type=str, default=None,
+                   choices=("hamerly", "elkan"),
+                   help="bound kind for --assign bounded (1-D streamed "
+                        "driver): 'hamerly' (default, one scalar lower "
+                        "bound per point) or 'elkan' (additional "
+                        "per-tile lower bounds — bounds prune points, "
+                        "tiles prune centroids; O(n*sqrt(K)) extra HBM)")
     p.add_argument("--probe", type=str, default=None,
                    help="coarse tiles scanned per point block for "
                         "--assign coarse/auto: an integer or 'all' "
@@ -321,10 +332,23 @@ def validate_args(parser, args):
                 parser.error("--shard_k gaussianMixture seeds from a host "
                              "subsample; --init=kmeans (a full K-Means "
                              "pre-fit) is the unsharded mode")
-    if args.probe is not None and args.assign is None:
+    if args.probe is not None and args.assign not in ("coarse", "auto"):
         parser.error("--probe needs --assign coarse|auto")
     if args.probe is not None and args.probe != "all":
         _valid_int(parser, "--probe", args.probe, 1)
+    if args.bounds is not None and args.assign != "bounded":
+        parser.error("--bounds needs --assign bounded")
+    if args.assign == "bounded":
+        if args.spherical:
+            parser.error("--assign bounded does not support --spherical")
+        if args.residency not in ("hbm", "auto"):
+            parser.error("--assign bounded needs --residency hbm|auto "
+                         "(per-point bounds live in the HBM-resident "
+                         "cache; without it the fit would silently run "
+                         "exact — ask for what you mean)")
+        if args.bounds == "elkan" and args.shard_k > 1:
+            parser.error("--bounds elkan is 1-D only (the K-sharded "
+                         "bounded tower runs per-shard hamerly bounds)")
     if args.assign is not None:
         # Sub-linear assignment rides the streamed / K-sharded kmeans
         # drivers (models/streaming.py, parallel/sharded_k.py).
@@ -949,15 +973,21 @@ def run_experiment(args) -> dict:
                 ckpt_dir=args.ckpt_dir,
                 kernel=args.kernel or "xla",
             )
-        # --assign/--probe pass-through for the streamed kmeans drivers
-        # (validate_args already restricted the combinations).
+        # --assign/--probe/--bounds pass-through for the streamed kmeans
+        # drivers (validate_args already restricted the combinations).
+        # `bounds` is 1-D only — the K-sharded bounded tower is per-shard
+        # hamerly by construction and takes no bound-kind knob.
         assign_kw = {}
+        assign_kw_1d = {}
         if args.assign is not None:
             assign_kw = {
                 "assign": args.assign,
                 "probe": (args.probe if args.probe in (None, "all")
                           else int(args.probe)),
             }
+            assign_kw_1d = dict(assign_kw)
+            if args.bounds is not None:
+                assign_kw_1d["bounds"] = args.bounds
 
         def shard_block(rows_per_pass: int) -> int:
             """N-block for the K-sharded towers: --block_rows, or the
@@ -1174,7 +1204,7 @@ def run_experiment(args) -> dict:
                 reduce=args.reduce,
                 residency=args.residency,
                 ingest=ingest_policy,
-                **assign_kw,
+                **assign_kw_1d,
             )
         return kmeans_fit(
             xx, args.K, init=args.init, key=key, max_iters=args.n_max_iters,

@@ -288,6 +288,12 @@ def streamed_bisecting_kmeans_fit(
     # loop, never a per-batch host sync (the PR-4 mean_combine_fit rule).
     sums = jnp.zeros((d,), jnp.float32)
     mass = jnp.zeros((), jnp.float32)
+    # Weight-validity evidence rides device-resident trackers too (the
+    # finite/nonnegative screens): ONE fetch after the loop instead of
+    # two per-batch host syncs; the host copies of the weight chunks
+    # (the split machinery's masks need them) convert after the loop.
+    bad_finite = jnp.zeros((), jnp.bool_)
+    bad_neg = jnp.zeros((), jnp.bool_)
     rows = []
     w_chunks = [] if weighted else None
     for item in _prefetched(stream(), prefetch):
@@ -297,24 +303,29 @@ def streamed_bisecting_kmeans_fit(
             xb, wb = item, None
         xb = jnp.asarray(xb, jnp.float32)
         rows.append(int(xb.shape[0]))
-        if wb is not None:
-            wb = np.asarray(wb, np.float32)
-            if wb.shape != (xb.shape[0],):
-                raise ValueError(
-                    f"weight batch shape {wb.shape} != ({xb.shape[0]},)"
-                )
-            if not np.isfinite(wb).all():
-                raise ValueError("sample_weight entries must be finite")
-            if (wb < 0).any():
-                raise ValueError("sample weights must be nonnegative")
-            w_chunks.append(wb)
         if wb is None:
             sums = sums + jnp.sum(xb, axis=0)
             mass = mass + xb.shape[0]
         else:
-            wbj = jnp.asarray(wb)
+            wbj = jnp.asarray(wb, jnp.float32)
+            if wbj.shape != (xb.shape[0],):
+                raise ValueError(
+                    f"weight batch shape {wbj.shape} != ({xb.shape[0]},)"
+                )
+            # Snapshot (np.array copies): a stream may reuse its weight
+            # buffer between yields.
+            w_chunks.append(np.array(wb, np.float32))  # tdclint: disable=TDC002 — deliberate host snapshot: a stream may reuse its weight buffer between yields; the device sync (if wb is a device array) is the price of the retained host copy the split masks need
+            bad_finite = jnp.logical_or(
+                bad_finite, jnp.logical_not(jnp.all(jnp.isfinite(wbj)))
+            )
+            bad_neg = jnp.logical_or(bad_neg, jnp.any(wbj < 0))
             sums = sums + jnp.sum(xb * wbj[:, None], axis=0)
             mass = mass + jnp.sum(wbj)
+    if weighted:
+        if bool(bad_finite):
+            raise ValueError("sample_weight entries must be finite")
+        if bool(bad_neg):
+            raise ValueError("sample weights must be nonnegative")
     n = sum(rows)
     if n < k:
         raise ValueError(f"n_obs={n} < K={k}")
@@ -395,22 +406,27 @@ def streamed_bisecting_kmeans_fit(
             # of them. Plain batches() here, not _prefetched: this scan
             # stops early, and breaking out of the prefetch generator would
             # strand its producer thread on the bounded queue forever.
-            seed_rows, seed_w = [], []
+            seed_chunks = []
             got = 0
             for i, item in enumerate(batches()):
                 m = labels_chunks[i] == target
                 if weighted:
                     m = m & (w_chunks[i] > 0)
                 if m.any():
-                    rows_i = np.asarray(item, np.float32)[m]
-                    seed_rows.append(rows_i)
-                    seed_w.append(
-                        w_chunks[i][m] if weighted
-                        else np.ones(len(rows_i), np.float32)
-                    )
-                    got += len(rows_i)
+                    # Stash a SNAPSHOT of the member rows (np.array
+                    # copies; a stream may reuse its batch buffer, so
+                    # holding raw references across iterations would
+                    # alias every stash to the last read).
+                    seed_chunks.append((i, np.array(item, np.float32)[m], m))  # tdclint: disable=TDC002 — deliberate host snapshot of the masked member rows (streams may reuse batch buffers); bounded by the _SEED_CAP break
+                    got += m.sum()  # m is a host-side numpy label mask
                     if got >= _SEED_CAP:
                         break
+            seed_rows = [rows_i for _, rows_i, _ in seed_chunks]
+            seed_w = [
+                (w_chunks[i][m] if weighted
+                 else np.ones(int(m.sum()), np.float32))
+                for i, _, m in seed_chunks
+            ]
             seed_x = jnp.asarray(np.concatenate(seed_rows)[:_SEED_CAP])
             seed_wj = jnp.asarray(np.concatenate(seed_w)[:_SEED_CAP])
             # n_init restarts mirror kmeans_fit's — lowest weighted SSE
@@ -438,7 +454,10 @@ def streamed_bisecting_kmeans_fit(
                     jnp.asarray(item, jnp.float32), res.centroids
                 )
                 mask = labels_chunks[i] == target
-                sides.append((mask, np.asarray(side_dev)))
+                # Device-resident: the (n,) label vectors stay on device
+                # until the single post-loop fetch below — the per-batch
+                # D2H pull blocked on each dispatch.
+                sides.append((mask, side_dev))
                 # Positive-weight members only (the in-memory fit's rule):
                 # a zero-weight row alone on one side must not validate
                 # the split.
@@ -457,6 +476,7 @@ def streamed_bisecting_kmeans_fit(
                 continue
             break
         for i, (mask, side) in enumerate(sides):
+            side = np.asarray(side)  # post-split fetch, outside the hot loop
             labels_chunks[i][mask & (side == 1)] = next_label
         new_centers = np.asarray(res.centroids, np.float32)
         centers[target] = new_centers[0]

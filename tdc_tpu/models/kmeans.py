@@ -53,6 +53,11 @@ class KMeansResult(NamedTuple):
     # probed vs total, pruned fraction), filled when the fit ran
     # assign='coarse' (None on the exact path).
     assign: object = None
+    # ops/bounds.BoundsReport — zero-loss bounded-assignment accounting
+    # (distance evaluations performed vs what the exact all-K path would
+    # do, skipped fraction), filled when the fit ran assign='bounded'
+    # over the HBM-resident cache (None otherwise).
+    bounds: object = None
     # obs/trace per-fit timeline: per-pass rows (batches, read_s/stage_s/
     # compute_s/reduce_s/ckpt_s, shift) assembled from the trace spans;
     # filled by the streamed drivers when tracing ($TDC_TRACE / --trace)

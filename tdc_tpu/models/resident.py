@@ -136,8 +136,17 @@ def run_resident_loop(
     counter=None,
     comms_per_iter=(0, 0),
     passes=None,
+    assign_counter=None,
+    assign_per_pass=(0, 0),
 ):
     """Drive `chunk` from iteration `n_iter`+1 to convergence/max_iters.
+
+    assign_counter/assign_per_pass: coarse-assignment tile accounting.
+    The per-pass (tiles probed, tiles total) cost is geometry-only —
+    computed exactly from the cache's batch shapes by the caller — and
+    `did` (the chunk's n_done, a value carried IN the compiled while
+    loop) is the exact pass count of each dispatch, so the tallies
+    booked here are exact, not the PR-11 per-pass extrapolation.
 
     One host sync per chunk boundary (the `int(n_done)` fetch); everything
     the streamed per-iteration loop did between iterations — heartbeat,
@@ -182,6 +191,9 @@ def run_resident_loop(
         trace.timeline_chunk(n_iter, did, chunk_span.seconds, shift)
         if counter is not None and did:
             counter.add(comms_per_iter[0] * did, comms_per_iter[1] * did)
+        if assign_counter is not None and did:
+            assign_counter.add(assign_per_pass[0] * did,
+                               assign_per_pass[1] * did)
         if passes is not None:
             passes[0] += did
         maybe_beat(progress=f"resident iter={n_iter}")
@@ -211,7 +223,8 @@ def run_resident_loop(
 
 
 def final_pass(pass_only, c, aux, cache, *, counter=None,
-               comms_per_iter=(0, 0), passes=None):
+               comms_per_iter=(0, 0), passes=None, assign_counter=None,
+               assign_per_pass=(0, 0)):
     """The end-of-fit reporting pass over the cache (SSE/objective at the
     RETURNED centroids) — same zero-transfer contract as the chunk."""
     with trace.span("final_pass"):
@@ -222,6 +235,8 @@ def final_pass(pass_only, c, aux, cache, *, counter=None,
         trace.sync(acc)
     if counter is not None:
         counter.add(*comms_per_iter)
+    if assign_counter is not None:
+        assign_counter.add(*assign_per_pass)
     if passes is not None:
         passes[0] += 1
     return acc, aux

@@ -39,6 +39,7 @@ from tdc_tpu.ops.assign import (
 from tdc_tpu.models.kmeans import KMeansResult, resolve_init, _normalize
 from tdc_tpu.models.fuzzy import FuzzyCMeansResult
 from tdc_tpu.obs import trace
+from tdc_tpu.ops import bounds as bounds_lib
 from tdc_tpu.ops import subk as subk_lib
 from tdc_tpu.parallel import mesh as mesh_lib
 from tdc_tpu.parallel import reduce as reduce_lib
@@ -874,7 +875,7 @@ def _plan_1d_residency(residency, batches, k, d, spec: MeshSpec, *,
 @lru_cache(maxsize=32)
 def _resident_lloyd_fns(mesh, k, d, spherical, kernel, quantize, weighted,
                         deferred, tol, chunk_iters,
-                        aspec=subk_lib.EXACT):
+                        aspec=subk_lib.EXACT, bspec=None):
     """(chunk, pass_only) for streamed_kmeans_fit's resident mode — the
     compiled R-iteration loop over the DeviceCache plus the final
     reporting pass. Cached per configuration (the _lloyd_fit_fns
@@ -884,7 +885,41 @@ def _resident_lloyd_fns(mesh, k, d, spherical, kernel, quantize, weighted,
     in stream order. `aspec` (ops/subk.CoarseSpec) swaps the per-batch
     stats for the coarse→refine path — the plan is rebuilt from the
     carried centroids inside the compiled pass, so residency composes
-    with sub-linear assignment with zero extra host boundaries."""
+    with sub-linear assignment with zero extra host boundaries.
+
+    `bspec` (ops/bounds.BoundsSpec) swaps the per-batch stats for the
+    ZERO-LOSS bounded path instead: the chunk's aux carry IS the
+    per-point Elkan/Hamerly bounds state (ops/bounds.BoundsState,
+    donated alongside the centroids), drifted/tightened/re-scanned
+    entirely in-trace. The final reporting pass stays the EXACT per-batch
+    pass (bounds must not drift during reporting, and the returned SSE is
+    then bit-identical to the exact fit's)."""
+    if bspec is not None:
+        def bounded_pass(c, aux, cache):
+            return bounds_lib.bounded_cache_pass(c, aux, cache, bspec, k)
+
+        def exact_pass(c, aux, cache):
+            acc = SufficientStats(
+                sums=jnp.zeros((k, d), jnp.float32),
+                counts=jnp.zeros((k,), jnp.float32),
+                sse=jnp.zeros((), jnp.float32),
+            )
+
+            def one(a, xb, wb, nv):
+                return _accumulate(a, xb, c, nv, spherical, kernel, mesh)
+
+            return (
+                device_cache_lib.scan_cache(acc, cache, one, False), aux
+            )
+
+        def update_fn(acc, c):
+            new_c = apply_centroid_update(acc, c)
+            shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
+            return new_c, shift, acc.sse
+
+        chunk = resident_lib.make_resident_chunk(bounded_pass, update_fn,
+                                                 tol, chunk_iters)
+        return chunk, jax.jit(exact_pass)
     if deferred:
         _, d_add, d_reduce = _deferred_lloyd_fns(
             mesh, k, d, spherical, kernel, quantize, weighted
@@ -1213,6 +1248,7 @@ def streamed_kmeans_fit(
     ingest=None,
     assign: str = "exact",
     probe=None,
+    bounds: str = "hamerly",
 ) -> KMeansResult:
     """Exact Lloyd over a re-iterable stream of (B, d) batches.
 
@@ -1320,18 +1356,74 @@ def streamed_kmeans_fit(
         sample weights, kernel='pallas', and multi-device per_pass
         reduce loudly (those compositions ride the K-sharded driver).
         The result's `assign` field carries the AssignReport (tiles
-        probed vs total, pruned fraction).
+        probed vs total, pruned fraction). assign="bounded" is the
+        ZERO-LOSS sub-linear mode (ops/bounds.py): per-point
+        Elkan/Hamerly triangle-inequality bounds live in the PR-5 HBM
+        cache as a donated per-point carry, so iterations 2..N skip the
+        all-K scan for every point whose assignment provably did not
+        change — centroids and assignments are IDENTICAL to
+        assign="exact" every iteration. Requires the fit to go resident
+        (residency="hbm"/"auto" reaching hbm; single-device, unweighted,
+        non-spherical) — bounds die with the batch otherwise, so
+        streamed/spill fits fall back to exact LOUDLY (structlog
+        `bounds_fallback`). `bounds=` picks "hamerly" (1 scalar lower
+        bound/point, the default) or "elkan" (additional per-TILE lower
+        bounds over the PR-11 tile structure: bounds prune points, tiles
+        prune centroids inside re-scans; O(n·√K) extra HBM).
+        assign="auto" with residency="hbm" prefers bounded at
+        K >= subk.AUTO_MIN_K (zero-loss beats the lossy coarse path when
+        the resident state is available). The result's `bounds` field
+        carries the BoundsReport (distance evals done vs exact,
+        skipped fraction).
     """
     weighted = sample_weight_batches is not None
-    # Assign resolves FIRST: a coarse verdict makes the Pallas kernels
-    # inapplicable, which kernel='auto' must treat as an ineligibility
-    # reason, not a user error (the explicit-pallas guard below is for
-    # users who NAMED the kernel).
-    aspec = subk_lib.resolve_assign(assign, k, probe=probe,
-                                    label="streamed_kmeans_fit")
+    # Assign resolves FIRST: a coarse/bounded verdict makes the Pallas
+    # kernels inapplicable, which kernel='auto' must treat as an
+    # ineligibility reason, not a user error (the explicit-pallas guard
+    # below is for users who NAMED the kernel).
+    if assign == "bounded" and probe is not None:
+        raise ValueError(
+            "probe= only applies to assign='coarse'/'auto' (bounded "
+            "assignment is exact — it probes everything it cannot prove "
+            "unchanged)"
+        )
+    bounded = assign == "bounded" or (
+        assign == "auto" and residency == "hbm" and k >= subk_lib.AUTO_MIN_K
+        and not weighted and not spherical and mesh is None
+    )
+    if bounded:
+        if weighted:
+            raise ValueError(
+                "assign='bounded' does not support sample_weight_batches "
+                "(the bounded stats have no weighted fold); use "
+                "assign='exact'"
+            )
+        if spherical:
+            raise ValueError(
+                "assign='bounded' does not support spherical=True (the "
+                "per-iteration renormalization breaks the center-drift "
+                "bound update); use assign='exact'"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "assign='bounded' on the 1-D driver is single-device "
+                "(per-point bounds are not mesh-sharded here); use "
+                "streamed_kmeans_fit_sharded for multi-device bounded "
+                "assignment"
+            )
+        bspec = bounds_lib.resolve_bounds(bounds, k,
+                                          label="streamed_kmeans_fit")
+        aspec = subk_lib.EXACT  # streamed passes (incl. the fill) run exact
+    else:
+        bspec = None
+        aspec = subk_lib.resolve_assign(assign, k, probe=probe,
+                                        label="streamed_kmeans_fit")
     from tdc_tpu.ops.pallas_kernels import resolve_kernel
 
-    if aspec.coarse:
+    if bounded:
+        ineligible = ("bounded assignment runs its own masked-recompute "
+                      "stats path")
+    elif aspec.coarse:
         ineligible = "coarse assignment runs its own tile-pruned stats path"
     elif weighted and mesh is not None:
         ineligible = "sample weights with a mesh have no weighted Pallas tower"
@@ -1366,6 +1458,12 @@ def streamed_kmeans_fit(
                 "cannot combine with kernel='pallas'; drop the explicit "
                 "kernel (or use assign='exact')"
             )
+    if bounded and kernel == "pallas":
+        raise ValueError(
+            "assign='bounded' is its own masked-recompute stats path and "
+            "cannot combine with kernel='pallas'; drop the explicit "
+            "kernel (or use assign='exact')"
+        )
     stream = _weighted_stream(batches, sample_weight_batches)
     guard = ingest_lib.guard_stream(stream, ingest, d=d, weighted=weighted,
                                     label="streamed_kmeans_fit")
@@ -1437,9 +1535,26 @@ def streamed_kmeans_fit(
         cursor=state.cursor, label="streamed_kmeans_fit",
         mid_pass_ckpt=ckpt_every_batches is not None,
     )
+    if bounded and (r_plan is None or not r_plan.resident):
+        # Bounds are multi-iteration device state living in the HBM
+        # cache; a fit that streams (or spills) re-uploads every batch
+        # and the bounds die with it. Loud, zero-loss fallback: exact.
+        from tdc_tpu.utils.structlog import emit
+
+        emit("bounds_fallback", label="streamed_kmeans_fit",
+             requested=assign, residency=residency,
+             reason="stream" if r_plan is None else r_plan.reason,
+             detail="bounded assignment needs the HBM-resident cache "
+                    "(per-point bounds are multi-iteration device "
+                    "state); running exact assignment instead")
+        bounded, bspec = False, None
     assign_counter = (
         subk_lib.AssignCounter(_mirror=subk_lib.GLOBAL_ASSIGN)
         if aspec.coarse else None
+    )
+    bounds_counter = (
+        bounds_lib.BoundsCounter(_mirror=bounds_lib.GLOBAL_BOUNDS)
+        if bounded else None
     )
 
     _stage = _make_stage(mesh, weighted)
@@ -1588,25 +1703,45 @@ def streamed_kmeans_fit(
             break
         if cache is not None:
             break  # iterations 2..N run on-device over the cache
-    if cache is not None and assign_counter is not None:
-        # Resident passes run inside the compiled chunk loop — book their
-        # tile accounting by extrapolating the (deterministic, geometry-
-        # only) per-pass totals the streamed fill pass already tallied.
-        _snap1 = assign_counter.snapshot()
-        _passes_before_resident = passes[0]
+    if bounded and cache is None:
+        # The plan said resident but the fill abandoned (geometry lie /
+        # HBM OOM) or never ran: the fit streamed exact — still
+        # zero-loss, but say so.
+        from tdc_tpu.utils.structlog import emit
+
+        emit("bounds_fallback", label="streamed_kmeans_fit",
+             requested=assign, residency=residency,
+             reason="cache_unfilled",
+             detail="the HBM cache fill did not complete; the fit ran "
+                    "exact streamed assignment")
+        bounded, bspec, bounds_counter = False, None, None
     if cache is not None:
         chunk, pass_only = _resident_lloyd_fns(
             mesh, k, d, bool(spherical), kernel, strategy.quantize,
-            weighted, deferred, float(tol), chunk_iters, aspec,
+            weighted, deferred, float(tol), chunk_iters, aspec, bspec,
         )
-        aux = (err_state[0]
-               if deferred and strategy.quantize is not None else ())
+        if bspec is not None:
+            # The per-point bounds carry: ±inf bounds make the first
+            # resident pass the full re-scan that initializes them (one
+            # exact iteration); placed BEFORE the transfer guard.
+            with trace.span("bounds_init", kind=bspec.kind):
+                fault_point("assign.bounds_recompute")
+                aux = bounds_lib.init_state(cache, c, bspec)
+        else:
+            aux = (err_state[0]
+                   if deferred and strategy.quantize is not None else ())
         if deferred:
             cost_ri = reduce_lib.tree_reduce_cost(example, axes,
                                                   strategy.quantize)
         else:
             cost_ri = (cost_pb[0] * cache.n_batches,
                        cost_pb[1] * cache.n_batches)
+        # Exact per-pass tile cost from the cache's batch geometry (the
+        # cached batches ARE the streamed batches, shape for shape) —
+        # booked per chunk against the while-loop's carried pass count,
+        # replacing the PR-11 "by extrapolation" accounting.
+        cost_ai = (cache_assign_cost(cache, aspec)
+                   if assign_counter is not None else (0, 0))
         if n_iter < max_iters and not (tol >= 0 and float(shift) <= tol):
             shift = float(shift)
             c, aux, n_iter, shift, _, history = (
@@ -1617,6 +1752,7 @@ def streamed_kmeans_fit(
                     gang=ckpt.gang, ckpt=ckpt, ckpt_dir=ckpt_dir,
                     ckpt_every=ckpt_every, counter=counter,
                     comms_per_iter=cost_ri, passes=passes,
+                    assign_counter=assign_counter, assign_per_pass=cost_ai,
                 )
             )
     shift = float(shift)  # one deferred fetch on the async path
@@ -1626,14 +1762,15 @@ def streamed_kmeans_fit(
         facc, aux = resident_lib.final_pass(
             pass_only, c, aux, cache, counter=counter,
             comms_per_iter=cost_ri, passes=passes,
+            assign_counter=assign_counter, assign_per_pass=cost_ai,
         )
         if deferred and strategy.quantize is not None:
             err_state[0] = aux
         sse = facc.sse
-        if assign_counter is not None:
-            extra = passes[0] - _passes_before_resident
-            assign_counter.add(_snap1["tiles_probed"] * extra,
-                               _snap1["tiles_total"] * extra)
+        if bounds_counter is not None:
+            # One fetch of the donated carry's running totals (outside
+            # the transfer guard): exact distance-eval accounting.
+            bounds_counter.add(float(aux.evals), float(aux.evals_exact))
     else:
         sse = full_pass(c).sse
     return KMeansResult(
@@ -1652,8 +1789,23 @@ def streamed_kmeans_fit(
         ingest=guard.report(),
         assign=(None if assign_counter is None
                 else subk_lib.report(aspec, assign_counter)),
+        bounds=(None if bounds_counter is None
+                else bounds_lib.report(bspec, bounds_counter)),
         timeline=trace.end_fit(tl),
     )
+
+
+def cache_assign_cost(cache, aspec) -> tuple[int, int]:
+    """EXACT per-pass (tiles probed, tiles total) of a coarse-assignment
+    pass over a DeviceCache: the cached batches replay the streamed
+    batches shape for shape, and subk.assign_cost is geometry-only."""
+    probed = total = 0
+    if cache.stacked is not None:
+        p, t = subk_lib.assign_cost(cache.stacked.shape[1], aspec)
+        probed += p * cache.stacked.shape[0]
+        total += t * cache.stacked.shape[0]
+    p, t = subk_lib.assign_cost(cache.tail.shape[0], aspec)
+    return probed + p, total + t
 
 
 def mean_combine_fit(

@@ -121,6 +121,27 @@ CATALOG = {
     "tdc_assign_pruned_fraction": (
         "gauge", "Fraction of centroid tiles pruned by coarse assignment "
                  "(1 - probed/total; 0 when no coarse fit ran)."),
+    # serve-time coarse predict (serve/engine.py coarse route)
+    "tdc_predict_tiles_probed_total": (
+        "counter", "Centroid tiles scanned by the compiled coarse-predict "
+                   "route (serve/engine.py)."),
+    "tdc_predict_tiles_total": (
+        "counter", "Centroid tiles an exact all-K predict would have "
+                   "touched across the same requests."),
+    "tdc_predict_pruned_fraction": (
+        "gauge", "Fraction of centroid tiles serve-time coarse predict "
+                 "pruned (1 - probed/total; 0 when no coarse predict "
+                 "ran)."),
+    # zero-loss bounded assignment (ops/bounds.py)
+    "tdc_bounds_dist_evals_total": (
+        "counter", "Point-centroid distance evaluations performed by "
+                   "bounded (Elkan/Hamerly) assignment (ops/bounds.py)."),
+    "tdc_bounds_dist_evals_exact_total": (
+        "counter", "Distance evaluations the exact all-K path would have "
+                   "performed across the same bounded passes."),
+    "tdc_bounds_pruned_fraction": (
+        "gauge", "Fraction of exact-path distance evaluations the bounds "
+                 "skipped (1 - done/exact; 0 when no bounded fit ran)."),
     # per-model registry state (serve/registry.py)
     "tdc_model_generation": (
         "gauge", "Monotonic reload generation per model."),

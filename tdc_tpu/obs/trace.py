@@ -54,6 +54,7 @@ KNOWN_SPANS = frozenset({
     "checkpoint",     # one checkpoint save
     "resident_chunk",  # one compiled R-iteration resident dispatch
     "final_pass",     # the end-of-fit reporting pass
+    "bounds_init",    # building/placing a bounded fit's per-point carry
     "produce",        # spill-ring producer: read+stage+H2D for one batch
     "ingest_retry",   # instant: one retried read (data/ingest.py)
     "pass_boundary",  # instant: gang alignment anchor, args {"pass": n}

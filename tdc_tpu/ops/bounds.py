@@ -1,0 +1,641 @@
+"""Exact sub-linear Lloyd via device-resident Elkan/Hamerly bounds.
+
+PR 11's coarse→refine path (ops/subk.py) closed the LOSSY half of
+ROADMAP item 2: tiles prune centroids, bounded-loss. This module closes
+the EXACT half — triangle-inequality bounds as per-point device state:
+
+  Hamerly (default, ``bounds="hamerly"``): per point keep the assigned
+  label, an upper bound ``u`` on the distance to the assigned centroid
+  and one lower bound ``l`` on the distance to every OTHER centroid.
+  After a centroid update where centroid j moved by δ_j,
+
+      u' = u + δ_label        l' = l − max_j δ_j
+
+  are still valid bounds, and a point with (tightened) u' < l' provably
+  keeps its assignment — no (K, d) distance scan needed. Points that
+  fail the test are re-scanned exactly, so assignments (and therefore
+  centroids) are IDENTICAL to exact Lloyd every iteration — zero-loss,
+  unlike the coarse path.
+
+  Elkan (``bounds="elkan"``): additionally keep per-TILE lower bounds
+  over PR 11's tile structure (the centroids packed once into T ≈ √K
+  fixed tiles): ``tl[i, t]`` lower-bounds the distance from point i to
+  every centroid in tile t and drifts by that tile's max δ. Bounds prune
+  POINTS (the Hamerly test above); tiles prune CENTROIDS — a re-scanned
+  block only computes distances against tiles some row's ``tl`` failed
+  to exclude. O(n·T) extra state; the composition the ROADMAP names.
+
+SPMD discipline (arXiv 1811.02084, machine-enforced by the PR-13
+collective-schedule goldens): bounds prune FLOPs INSIDE the compiled
+step, never collectives. The skip is real work-skipping — rows are
+packed by a stable sort on the need-rescan flag so whole MXU-shaped
+blocks take the cheap branch of a `lax.cond` (sequential under
+`lax.map`, so the skipped branch genuinely does not execute) — while
+every collective the exact path issues is issued identically.
+
+Residency contract: bounds are MULTI-ITERATION state. They live in the
+PR-5 HBM cache as a donated per-point carry next to the dataset
+(models/resident.py aux), are initialized in-trace on the first resident
+pass (±inf bounds force one full re-scan that doubles as the exact
+initialization), and die with the cache — streamed/spill fits fall back
+loudly (`bounds_fallback` structlog event) to exact assignment.
+
+Float caveat (the assign_refined docstring's regime): bound maintenance
+and the skip test run in f32 on matmul-form distances, so a champion
+whose margin over the runner-up is below f32 cancellation noise
+(~‖x‖²·eps) can in principle resolve differently than the exact path's
+argmin. The skip test is strict (`u < l`; ties re-scan), every re-scan
+uses the SAME `pairwise_sq_dist` + smallest-index tie-break as the
+exact kernels, and the bit-exactness gate (benchmarks/bench_bounds.py,
+tests/test_bounds.py) pins equality on every measured config.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tdc_tpu.ops.assign import SufficientStats, cluster_stats
+from tdc_tpu.ops.distance import pairwise_sq_dist
+from tdc_tpu.ops.pallas_kernels import champion_tile
+from tdc_tpu.ops.subk import ARG_SENTINEL, default_tiles
+
+BOUND_KINDS = ("hamerly", "elkan")
+
+# Rows per packed recompute block: small enough that one straggler row
+# re-scans at most this many rows' worth of extra (K, d) work, large
+# enough to keep the matmul MXU-shaped.
+DEFAULT_BLOCK_ROWS = 512
+
+
+class BoundsSpec(NamedTuple):
+    """Resolved, fully-static bounds config (hashable — it rides
+    lru_cache keys and jit static closures, like subk.CoarseSpec)."""
+
+    kind: str  # "hamerly" | "elkan"
+    n_tiles: int = 0  # elkan: fixed tile count (0 for hamerly)
+    tile_size: int = 0
+    block_rows: int = DEFAULT_BLOCK_ROWS
+
+    @property
+    def elkan(self) -> bool:
+        return self.kind == "elkan"
+
+
+def resolve_bounds(
+    bounds: str,
+    k: int,
+    *,
+    n_tiles: int | None = None,
+    block_rows: int | None = None,
+    label: str = "",
+) -> BoundsSpec:
+    """Resolve the ``bounds=`` knob into a BoundsSpec, loudly (one
+    structlog `assign_selected` event — bounded assignment is a mode of
+    the `assign=` knob, so it reports through the same event)."""
+    from tdc_tpu.utils.structlog import emit
+
+    if bounds not in BOUND_KINDS:
+        raise ValueError(f"bounds={bounds!r}: use one of {BOUND_KINDS}")
+    br = DEFAULT_BLOCK_ROWS if block_rows is None else int(block_rows)
+    if br < 1:
+        raise ValueError(f"block_rows={br} must be >= 1")
+    if bounds == "elkan":
+        t = int(n_tiles) if n_tiles else default_tiles(k)
+        if t < 1 or t > k:
+            raise ValueError(f"n_tiles={t} must be in [1, K={k}]")
+        s = -(-k // t)
+        spec = BoundsSpec(kind="elkan", n_tiles=t, tile_size=s,
+                          block_rows=br)
+        emit("assign_selected", assign="bounded", bounds="elkan", k=int(k),
+             n_tiles=t, tile_size=s, label=label,
+             reason="per-point Hamerly bounds prune points; per-tile "
+                    "Elkan bounds prune centroid tiles inside re-scans "
+                    "(zero-loss by the triangle inequality)")
+        return spec
+    spec = BoundsSpec(kind="hamerly", block_rows=br)
+    emit("assign_selected", assign="bounded", bounds="hamerly", k=int(k),
+         label=label,
+         reason="per-point upper/lower bounds skip the all-K scan for "
+                "points whose assignment provably did not change "
+                "(zero-loss by the triangle inequality)")
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# Accounting (the AssignCounter pattern): distance evaluations actually
+# performed vs what the exact all-K path would have performed. Totals are
+# read off the device carry once per fit (f32 — telemetry precision).
+# ---------------------------------------------------------------------------
+
+
+class BoundsCounter:
+    """Host-side tally of (distance evals done, exact-path evals) across
+    bounded fits. Thread-safe (fits and the serve /metrics scrape run on
+    different threads)."""
+
+    def __init__(self, _mirror=None):
+        self._lock = threading.Lock()
+        self._mirror = _mirror
+        self.dist_evals = 0
+        self.dist_evals_exact = 0
+
+    def add(self, evals: float, exact: float) -> None:
+        with self._lock:
+            self.dist_evals += int(evals)
+            self.dist_evals_exact += int(exact)
+        if self._mirror is not None:
+            self._mirror.add(evals, exact)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dist_evals": self.dist_evals,
+                "dist_evals_exact": self.dist_evals_exact,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.dist_evals = 0
+            self.dist_evals_exact = 0
+
+
+# Process-wide counter; surfaced on /metrics as tdc_bounds_*.
+GLOBAL_BOUNDS = BoundsCounter()
+
+
+class BoundsReport(NamedTuple):
+    """Per-fit bounded-assignment summary (`result.bounds`)."""
+
+    kind: str  # "hamerly" | "elkan"
+    n_tiles: int  # elkan tile count (0 for hamerly)
+    dist_evals: int  # point-centroid distance evaluations performed
+    dist_evals_exact: int  # evaluations the exact all-K path would do
+
+    @property
+    def skipped_fraction(self) -> float:
+        """Fraction of exact-path distance evaluations the bounds
+        skipped."""
+        if self.dist_evals_exact <= 0:
+            return 0.0
+        return max(0.0, 1.0 - self.dist_evals / self.dist_evals_exact)
+
+
+def report(spec: BoundsSpec, counter: BoundsCounter | None) -> BoundsReport:
+    snap = counter.snapshot() if counter is not None else {
+        "dist_evals": 0, "dist_evals_exact": 0,
+    }
+    return BoundsReport(
+        kind=spec.kind, n_tiles=spec.n_tiles,
+        dist_evals=snap["dist_evals"],
+        dist_evals_exact=snap["dist_evals_exact"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Per-point state — a pytree threaded through the resident chunk loop's
+# donated aux carry. Leaves mirror the DeviceCache geometry (stacked full
+# batches + a separately-shaped tail).
+# ---------------------------------------------------------------------------
+
+
+class BoundsState(NamedTuple):
+    """Device-resident per-point bounds carry (the `aux` of a bounded
+    resident fit). `prev_c` is the centroid matrix the bounds were last
+    valid against — the pass computes per-centroid drift from it, which
+    is what lets the whole update live inside the compiled chunk with no
+    host boundary. −inf initial lower bounds make the first pass a full
+    re-scan: initialization IS one exact iteration.
+
+    No upper-bound leaf: the pass always TIGHTENS (one gathered exact
+    distance per point — it doubles as the skipped point's exact SSE
+    contribution), so a carried drifted upper bound would never be read;
+    only the label and the lower bound survive between iterations."""
+
+    prev_c: jax.Array  # (K, d) f32
+    lab_s: jax.Array | None  # (n_full, B) int32 (None: single-batch cache)
+    lb_s: jax.Array | None  # (n_full, B) f32 — lower bound on 2nd-nearest
+    tlb_s: jax.Array | None  # (n_full, B, T) f32 — elkan per-tile bounds
+    lab_t: jax.Array  # tail variants
+    lb_t: jax.Array
+    tlb_t: jax.Array | None
+    ids: jax.Array | None  # (T, S) int32 fixed tile packing (elkan; -1 pad)
+    evals: jax.Array  # () f32 — distance evals performed (running)
+    evals_exact: jax.Array  # () f32 — exact-path evals (running)
+
+
+def _pack_tiles(c: jax.Array, spec: BoundsSpec) -> jax.Array:
+    """(T, S) int32 FIXED tile packing of the centroid indices (-1 pads):
+    cluster-the-centroids like subk.build_plan, but the membership is
+    frozen at init — per-point tile bounds are meaningless under a
+    repacking, so the tiling goes stale gracefully (pruning degrades,
+    correctness never depends on tile quality)."""
+    from tdc_tpu.ops.assign import apply_centroid_update, lloyd_stats
+
+    k = c.shape[0]
+    t, s = spec.n_tiles, spec.tile_size
+    cf = c.astype(jnp.float32)
+    reps = cf[:: max(1, k // t)][:t]
+    for _ in range(3):
+        reps = apply_centroid_update(lloyd_stats(cf, reps), reps)
+    lab = jnp.argmin(pairwise_sq_dist(cf, reps), axis=-1)
+    order = jnp.argsort(lab).astype(jnp.int32)
+    return jnp.concatenate(
+        [order, jnp.full((t * s - k,), -1, jnp.int32)]
+    ).reshape(t, s)
+
+
+def init_state(cache, c: jax.Array, spec: BoundsSpec) -> BoundsState:
+    """Build the ±inf bounds carry for a filled DeviceCache. Host-side
+    (runs BEFORE the transfer guard — all leaves are committed device
+    arrays by construction of jnp.*). prev_c is an explicit COPY of the
+    centroids: the chunk donates both its centroid argument and this
+    carry, and an aliased buffer would be donated twice."""
+    cf = jnp.array(c, jnp.float32, copy=True)
+    k = cf.shape[0]
+    t = spec.n_tiles
+
+    def zeros_like_rows(shape):
+        return (
+            jnp.zeros(shape, jnp.int32),
+            jnp.full(shape, -jnp.inf, jnp.float32),
+            (jnp.full(shape + (t,), -jnp.inf, jnp.float32)
+             if spec.elkan else None),
+        )
+
+    if cache.stacked is not None:
+        lab_s, lb_s, tlb_s = zeros_like_rows(tuple(cache.stacked.shape[:2]))
+    else:
+        lab_s = lb_s = tlb_s = None
+    lab_t, lb_t, tlb_t = zeros_like_rows((cache.tail.shape[0],))
+    return BoundsState(
+        prev_c=cf,
+        lab_s=lab_s, lb_s=lb_s, tlb_s=tlb_s,
+        lab_t=lab_t, lb_t=lb_t, tlb_t=tlb_t,
+        ids=_pack_tiles(cf, spec) if spec.elkan else None,
+        evals=jnp.zeros((), jnp.float32),
+        evals_exact=jnp.zeros((), jnp.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The bounded batch step — pure jnp, traced inside the resident chunk.
+# ---------------------------------------------------------------------------
+
+
+def _row_d2(xf, x2, c, lab):
+    """Exact matmul-form squared distance of each row to its assigned
+    centroid (the tighten step): same ‖x‖² + ‖c‖² − 2x·c expansion and
+    0-clamp as pairwise_sq_dist, restricted to one gathered centroid per
+    row — O(n·d), the cost a skipped point pays instead of O(K·d)."""
+    ca = c[lab]  # (n, d) gather
+    c2a = jnp.sum(ca * ca, axis=1)
+    cross = jnp.sum(xf * ca, axis=1)
+    return jnp.maximum(x2 + c2a - 2.0 * cross, 0.0)
+
+
+def _second_min(d2, champ_col):
+    """Second-smallest distance per row: min with exactly ONE instance of
+    the minimum masked out (`champ_col`, a (rows, 1) column index). Under
+    ties the other tie columns survive the mask, so the result is the tie
+    value — the correct second-nearest counting multiplicity. A masked
+    min, not lax.top_k: top-2 over (block, K) measured ~3× the whole
+    rescan's matmul on CPU."""
+    cols = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+    return jnp.min(jnp.where(cols == champ_col, jnp.inf, d2), axis=1)
+
+
+def _rescan_block_hamerly(x_blk, c):
+    """One packed block's full exact re-scan: the same pairwise form,
+    champion fold, and smallest-index tie-break as ops.assign.lloyd_stats
+    (champion_tile IS the shared epilogue)."""
+    d2 = pairwise_sq_dist(x_blk, c)  # (B, K), clamped, HIGHEST
+    tmin, targ = champion_tile(d2)
+    lab = targ[:, 0]
+    d1 = tmin[:, 0]
+    return lab, d1, _second_min(d2, targ)
+
+
+def _rescan_block_elkan(x_blk, x2_blk, c, tiles_now, tids, tlb_blk, ub_blk):
+    """Tile-pruned exact re-scan of one packed block: scan only tiles
+    some row's per-tile lower bound failed to exclude (`tl <= u` for any
+    row — a row's OWN tile always passes, since its tile bound is at
+    most the assigned-centroid distance). A sequential fori over tiles
+    with a `lax.cond` per tile skips the pruned tiles' (B, S) matmuls
+    for real; the champion fold keeps the exact smallest-id tie-break
+    via champion_tile's id row.
+
+    Returns (labels, champion d², second-min distance bound, new per-tile
+    bounds, tiles scanned)."""
+    t_count, s = tids.shape
+    b = x_blk.shape[0]
+    need_t = jnp.any(tlb_blk <= ub_blk[:, None], axis=0)  # (T,)
+    xf = x_blk.astype(jnp.float32)
+
+    def scan_tile(t, carry):
+        best, bid, second, tlb = carry
+        cand = tiles_now[t]  # (S, d) — padding slots are _FAR rows
+        idrow = jnp.where(tids[t] >= 0, tids[t], ARG_SENTINEL)[None, :]
+        c2 = jnp.sum(cand * cand, axis=1)
+        cross = jax.lax.dot_general(
+            xf, cand, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        d2t = jnp.maximum(x2_blk[:, None] + c2[None, :] - 2.0 * cross, 0.0)
+        tmin, tid = champion_tile(d2t, idrow)
+        # Column of ONE min instance (iota fold) for the second-min mask;
+        # the reported champion id keeps the smallest-GLOBAL-id tie rule.
+        _, tcol = champion_tile(d2t)
+        v1, tid = tmin[:, 0], tid[:, 0]
+        v2 = _second_min(d2t, tcol)
+        # Merge (best, second) with (v1, v2): two smallest of the union,
+        # champion id resolving ties to the smallest id (exact argmin
+        # semantics).
+        lo = jnp.minimum(best, v1)
+        hi = jnp.maximum(best, v1)
+        second = jnp.minimum(second, jnp.minimum(v2, hi))
+        bid = jnp.where(
+            v1 < best, tid,
+            jnp.where(v1 == best, jnp.minimum(bid, tid), bid),
+        )
+        tlb = tlb.at[:, t].set(jnp.sqrt(v1))
+        return lo, bid, second, tlb
+
+    def body(t, carry):
+        return jax.lax.cond(
+            need_t[t], lambda cr: scan_tile(t, cr), lambda cr: cr, carry
+        )
+
+    best0 = jnp.full((b,), jnp.inf, jnp.float32)
+    bid0 = jnp.full((b,), ARG_SENTINEL, jnp.int32)
+    best, bid, second, tlb = jax.lax.fori_loop(
+        0, t_count, body, (best0, bid0, best0, tlb_blk)
+    )
+    # The true second-nearest may live in a PRUNED tile whose bound
+    # undercuts the scanned second: the lower bound folds both in.
+    unscanned = jnp.min(
+        jnp.where(need_t[None, :], jnp.inf, tlb_blk), axis=1
+    )
+    lb2 = jnp.minimum(jnp.sqrt(jnp.maximum(second, 0.0)), unscanned)
+    scanned = jnp.sum(need_t.astype(jnp.float32))
+    return bid, best, lb2, tlb, scanned
+
+
+def bounded_batch_step(
+    xb: jax.Array,
+    c: jax.Array,
+    dmax: jax.Array,
+    lab: jax.Array,
+    lb: jax.Array,
+    spec: BoundsSpec,
+    tlb: jax.Array | None = None,
+    ids: jax.Array | None = None,
+    tiles_now: jax.Array | None = None,
+    dtile: jax.Array | None = None,
+):
+    """One batch's bounded assignment: drift the lower bound, tighten
+    (one gathered exact distance per point — it IS the skipped point's
+    upper bound AND its exact SSE contribution, which is why no upper
+    bound is carried), pack rows needing a re-scan into leading blocks
+    (stable sort on the need flag), re-scan only those blocks, and
+    return exact labels + champion d² + refreshed bounds.
+
+    Zero-padding rows are ORDINARY points here (x = 0 rows track the
+    argmin-‖c‖² centroid exactly like the exact kernels score them), so
+    callers apply the very same padding_correction as the exact path.
+
+    Returns (labels, champ_d2, lb', tlb', evals) — evals counts the
+    point·centroid distance evaluations this batch performed (the
+    tighten pass plus re-scanned blocks' full or tile-pruned scans).
+    """
+    n, d = xb.shape
+    k = c.shape[0]
+    xf = xb.astype(jnp.float32)
+    x2 = jnp.sum(xf * xf, axis=1)
+    cf = c.astype(jnp.float32)
+    # Drift the lower bound to the CURRENT centroids (triangle
+    # inequality); the upper bound is re-established exactly below.
+    lb = lb - dmax
+    if spec.elkan:
+        tlb = tlb - dtile[None, :]
+    # Tighten: one exact distance per point to its assigned centroid.
+    d2a = _row_d2(xf, x2, cf, lab)
+    ta = jnp.sqrt(d2a)
+    # Strict test — ties re-scan, so index-order tie-breaks can never
+    # silently diverge from the exact argmin.
+    need = jnp.logical_not(ta < lb)
+
+    block = min(spec.block_rows, max(n, 1))
+    # Pack: rows needing a re-scan first (stable), pad to a block
+    # multiple with benign skip rows, unsort through a sacrificial slot.
+    order = jnp.argsort(
+        jnp.logical_not(need).astype(jnp.int32)
+    ).astype(jnp.int32)
+    pad = (-n) % block
+    if pad:
+        order = jnp.concatenate([order, jnp.zeros((pad,), jnp.int32)])
+    npad = n + pad
+    real = jnp.arange(npad) < n
+    xs = xf[order]
+    x2s = x2[order]
+    labs = lab[order]
+    tas = ta[order]
+    d2as = d2a[order]
+    lbs = lb[order]
+    needs = jnp.where(real, need[order], False)
+    nb = npad // block
+
+    if spec.elkan:
+        tlbs = tlb[order]
+
+        def one_block(args):
+            xs_b, x2_b, lab_b, ta_b, d2a_b, lb_b, need_b, tlb_b = args
+
+            def rescan(_):
+                bid, best, lb2, tlb2, scanned = _rescan_block_elkan(
+                    xs_b, x2_b, cf, tiles_now, ids, tlb_b, ta_b
+                )
+                return (bid, best, lb2, tlb2,
+                        scanned * spec.tile_size * block)
+
+            def skip(_):
+                return (lab_b, d2a_b, lb_b, tlb_b,
+                        jnp.zeros((), jnp.float32))
+
+            return jax.lax.cond(jnp.any(need_b), rescan, skip, None)
+
+        outs = jax.lax.map(
+            one_block,
+            (xs.reshape(nb, block, d), x2s.reshape(nb, block),
+             labs.reshape(nb, block), tas.reshape(nb, block),
+             d2as.reshape(nb, block), lbs.reshape(nb, block),
+             needs.reshape(nb, block),
+             tlbs.reshape(nb, block, spec.n_tiles)),
+        )
+        lab2, champ, lb2, tlb2, ev_b = outs
+        tlb2 = tlb2.reshape(npad, spec.n_tiles)
+    else:
+
+        def one_block(args):
+            xs_b, lab_b, d2a_b, lb_b, need_b = args
+
+            def rescan(_):
+                lab_n, d1, second = _rescan_block_hamerly(xs_b, cf)
+                return (lab_n, d1,
+                        jnp.sqrt(jnp.maximum(second, 0.0)),
+                        jnp.full((), float(block * k), jnp.float32))
+
+            def skip(_):
+                return (lab_b, d2a_b, lb_b,
+                        jnp.zeros((), jnp.float32))
+
+            return jax.lax.cond(jnp.any(need_b), rescan, skip, None)
+
+        outs = jax.lax.map(
+            one_block,
+            (xs.reshape(nb, block, d), labs.reshape(nb, block),
+             d2as.reshape(nb, block), lbs.reshape(nb, block),
+             needs.reshape(nb, block)),
+        )
+        lab2, champ, lb2, ev_b = outs
+        tlb2 = None
+
+    evals = jnp.sum(ev_b) + float(n)  # + the tighten pass (1 eval/row)
+
+    def unsort(v, fill):
+        dest = jnp.where(real, order, n)
+        out = jnp.full((n + 1,), fill, v.dtype)
+        return out.at[dest].set(v.reshape(-1))[:n]
+
+    labels = unsort(lab2, 0)
+    champ_d2 = unsort(champ, 0.0)
+    lb_new = unsort(lb2, 0.0)
+    tlb_new = None
+    if spec.elkan:
+        dest = jnp.where(real, order, n)
+        out = jnp.zeros((n + 1, spec.n_tiles), jnp.float32)
+        tlb_new = out.at[dest].set(tlb2)[:n]
+    return labels, champ_d2, lb_new, tlb_new, evals
+
+
+def _tiles_from_ids(c: jax.Array, ids: jax.Array):
+    """(T, S, d) current centroid rows per fixed tile (padding slots
+    filled with far-away rows so they never win a champion — the
+    subk._FAR rule)."""
+    rows = c.astype(jnp.float32)[jnp.where(ids >= 0, ids, 0)]
+    return jnp.where((ids >= 0)[..., None], rows, 1e15)
+
+
+def bounded_cache_pass(
+    c: jax.Array,
+    state: BoundsState,
+    cache,
+    spec: BoundsSpec,
+    k: int,
+):
+    """One full bounded accumulation pass over a DeviceCache — the
+    bounded counterpart of the exact per-batch resident pass: per-batch
+    stats in stream order, each batch folded exactly like
+    models/streaming._accumulate (same cluster_stats one-hot matmul on
+    identical labels → bitwise-identical sums/counts; same
+    padding_correction against the argmin-‖c‖² centroid).
+
+    Returns (SufficientStats, new BoundsState). Everything (drift
+    computation included) is in-trace: the resident chunk re-derives the
+    per-centroid drift from the carried prev_c, so bounds stay valid
+    across on-device centroid updates with zero host round trips."""
+    from tdc_tpu.parallel.sharded_k import padding_correction
+
+    cf = c.astype(jnp.float32)
+    delta = jnp.linalg.norm(cf - state.prev_c, axis=1)
+    dmax = jnp.max(delta)
+    ids = state.ids
+    if spec.elkan:
+        tiles_now = _tiles_from_ids(cf, ids)
+        valid_slots = ids >= 0
+        dtile = jnp.max(
+            jnp.where(valid_slots, delta[jnp.where(valid_slots, ids, 0)],
+                      0.0),
+            axis=1,
+        )
+    else:
+        tiles_now = dtile = None
+
+    def one(acc_ev, xb, nv, lab, lb, tlb):
+        acc, ev = acc_ev
+        labels, champ_d2, lb2, tlb2, evals = bounded_batch_step(
+            xb, c, dmax, lab, lb, spec,
+            tlb=tlb, ids=ids, tiles_now=tiles_now, dtile=dtile,
+        )
+        sums, counts = cluster_stats(xb, labels, k)
+        sse = jnp.sum(champ_d2)
+        n_pad = jnp.asarray(xb.shape[0], jnp.float32) - nv.astype(
+            jnp.float32
+        )
+        counts, sse = padding_correction(counts, sse, c, n_pad)
+        acc = SufficientStats(
+            sums=acc.sums + sums, counts=acc.counts + counts,
+            sse=acc.sse + sse,
+        )
+        return (acc, ev + evals), (labels, lb2, tlb2)
+
+    zero = SufficientStats(
+        sums=jnp.zeros((k, c.shape[1]), jnp.float32),
+        counts=jnp.zeros((k,), jnp.float32),
+        sse=jnp.zeros((), jnp.float32),
+    )
+    carry = (zero, jnp.zeros((), jnp.float32))
+    lab_s = lb_s = tlb_s = None
+    rows_total = 0.0
+    if cache.stacked is not None:
+        def body(cr, xs):
+            xb, lab, lb = xs[:3]
+            tlb = xs[3] if spec.elkan else None
+            cr, (labels, lb2, tlb2) = one(
+                cr, xb, cache.nv_full, lab, lb, tlb
+            )
+            ys = (labels, lb2) + ((tlb2,) if spec.elkan else ())
+            return cr, ys
+
+        xs = (cache.stacked, state.lab_s, state.lb_s)
+        if spec.elkan:
+            xs = xs + (state.tlb_s,)
+        carry, ys = jax.lax.scan(body, carry, xs)
+        lab_s, lb_s = ys[0], ys[1]
+        if spec.elkan:
+            tlb_s = ys[2]
+        rows_total += cache.stacked.shape[0] * cache.stacked.shape[1]
+    carry, (lab_t, lb_t, tlb_t) = one(
+        carry, cache.tail, cache.nv_tail, state.lab_t, state.lb_t,
+        state.tlb_t,
+    )
+    rows_total += cache.tail.shape[0]
+    acc, evals = carry
+    new_state = BoundsState(
+        prev_c=cf,
+        lab_s=lab_s, lb_s=lb_s, tlb_s=tlb_s,
+        lab_t=lab_t, lb_t=lb_t, tlb_t=tlb_t,
+        ids=ids,
+        evals=state.evals + evals,
+        evals_exact=state.evals_exact + rows_total * float(k),
+    )
+    return acc, new_state
+
+
+__all__ = [
+    "BOUND_KINDS",
+    "BoundsCounter",
+    "BoundsReport",
+    "BoundsSpec",
+    "BoundsState",
+    "GLOBAL_BOUNDS",
+    "bounded_batch_step",
+    "bounded_cache_pass",
+    "init_state",
+    "report",
+    "resolve_bounds",
+]

@@ -207,6 +207,12 @@ class AssignCounter:
 # Process-wide counter; surfaced on /metrics as tdc_assign_*.
 GLOBAL_ASSIGN = AssignCounter()
 
+# Serve-time counterpart: tiles probed by the compiled coarse-PREDICT
+# route (serve/engine.py) — a separate ledger from the fit-time counter
+# so `tdc_predict_*` answers "how much serve traffic is pruned" without
+# fit traffic polluting it.
+GLOBAL_PREDICT = AssignCounter()
+
 
 class AssignReport(NamedTuple):
     """Per-fit assignment summary attached to fit results (`result.assign`)."""
@@ -235,10 +241,17 @@ def effective_block(n_rows: int, spec: CoarseSpec) -> int:
     cells and silently starved the probe budget (measured: 178× inertia
     blow-up on 2048-row batches that assign perfectly at full-batch
     granularity). Per-point FLOPs are block-size-independent, so shrinking
-    the block trades only per-block overhead for coverage."""
+    the block trades only per-block overhead for coverage.
+
+    The 128-row floor is the fit-time MXU-tiling default; an EXPLICIT
+    spec.block_rows below it wins — the serve-time coarse-predict route
+    (serve/engine.py) runs tiny request batches where a 128-row block
+    spans more cells than any probe budget covers, and per-block
+    overhead is noise next to the pruned all-K scan it replaces."""
     per_cell = -(-n_rows // max(spec.n_tiles, 1))
     share = -(-per_cell // 128) * 128
-    return max(128, min(spec.block_rows, share))
+    floor = min(128, spec.block_rows)
+    return max(floor, min(spec.block_rows, share))
 
 
 def assign_cost(n_rows: int, spec: CoarseSpec) -> tuple[int, int]:

@@ -289,6 +289,213 @@ def make_sharded_stats(
     return stats
 
 
+class ShardedBoundsState(NamedTuple):
+    """Per-shard Hamerly bounds for the K-sharded towers: each (data,
+    model) shard pair keeps, for every local point row, the champion
+    index WITHIN ITS OWN K/Pm centroid slice plus a lower bound on the
+    local runner-up distance (no upper-bound leaf — the tower always
+    tightens, see ops/bounds.BoundsState). Everything — the per-centroid
+    drift, the bound update, the skip test, the packed re-scan — is
+    shard-local, so bounded assignment adds ZERO collectives: each shard
+    reports its (possibly bound-certified) local champion into the very
+    same two all_gathers the exact tower issues.
+
+    lab/lb are (rows, Pm) sharded P(data, model) — one column per model
+    shard; ev is the (n_data·n_model,) per-shard distance-eval tally
+    (P((data, model)) — stacked locals, no reduce)."""
+
+    prev_c: jax.Array  # (K, d) f32, model-sharded
+    lab: jax.Array  # (rows, Pm) int32
+    lb: jax.Array  # (rows, Pm) f32 — lower bound on local runner-up
+    ev: jax.Array  # (n_data*n_model,) f32 — evals performed per shard
+
+
+def init_sharded_bounds(mesh: Mesh, rows: int, c) -> ShardedBoundsState:
+    """−inf bounds (first pass = full local re-scan on every shard, i.e.
+    one exact iteration that doubles as initialization). prev_c is an
+    explicit copy — the resident chunk donates the carry alongside the
+    centroids, and an aliased buffer would be donated twice."""
+    import numpy as _np
+
+    n_data = int(mesh.devices.shape[0])
+    n_model = int(mesh.devices.shape[1])
+    put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
+    return ShardedBoundsState(
+        prev_c=put(_np.asarray(c, _np.float32), P(MODEL_AXIS, None)),
+        lab=put(_np.zeros((rows, n_model), _np.int32),
+                P(DATA_AXIS, MODEL_AXIS)),
+        lb=put(_np.full((rows, n_model), -_np.inf, _np.float32),
+               P(DATA_AXIS, MODEL_AXIS)),
+        ev=put(_np.zeros((n_data * n_model,), _np.float32),
+               P((DATA_AXIS, MODEL_AXIS))),
+    )
+
+
+class ShardedResidentBounds(NamedTuple):
+    """The K-sharded resident chunk's bounds aux carry: per-batch
+    ShardedBoundsState slices aligned with the DeviceCache geometry
+    (stacked full batches + tail), donated alongside the centroids."""
+
+    prev_c: jax.Array  # (K, d) f32, model-sharded
+    lab_s: jax.Array | None  # (n_full, B, Pm) int32
+    lb_s: jax.Array | None
+    lab_t: jax.Array  # (B_tail, Pm)
+    lb_t: jax.Array
+    ev: jax.Array  # (n_data*n_model,) f32
+
+
+def init_resident_sharded_bounds(mesh: Mesh, cache, c) -> ShardedResidentBounds:
+    """±inf per-batch bounds for a filled DeviceCache (the sharded analog
+    of ops/bounds.init_state; prev_c copied for the donation contract)."""
+    import numpy as _np
+
+    n_data = int(mesh.devices.shape[0])
+    n_model = int(mesh.devices.shape[1])
+    put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
+
+    def duo(shape):
+        return (
+            put(_np.zeros(shape + (n_model,), _np.int32),
+                P(*((None,) * (len(shape) - 1)), DATA_AXIS, MODEL_AXIS)),
+            put(_np.full(shape + (n_model,), -_np.inf, _np.float32),
+                P(*((None,) * (len(shape) - 1)), DATA_AXIS, MODEL_AXIS)),
+        )
+
+    if cache.stacked is not None:
+        lab_s, lb_s = duo(tuple(cache.stacked.shape[:2]))
+    else:
+        lab_s = lb_s = None
+    lab_t, lb_t = duo((cache.tail.shape[0],))
+    return ShardedResidentBounds(
+        prev_c=put(_np.asarray(c, _np.float32), P(MODEL_AXIS, None)),
+        lab_s=lab_s, lb_s=lb_s,
+        lab_t=lab_t, lb_t=lb_t,
+        ev=put(_np.zeros((n_data * n_model,), _np.float32),
+               P((DATA_AXIS, MODEL_AXIS))),
+    )
+
+
+def make_sharded_bounded_stats(mesh: Mesh, block_rows_pack: int = 512):
+    """The bounded (zero-loss) counterpart of make_sharded_stats: jit-able
+    fn(x, c, prev_c, lab, lb) → (sums, counts, sse, lab', lb', evals)
+    with the EXACT tower's collective schedule — the per-shard bound
+    maintenance prunes only local FLOPs (rows whose local champion is
+    bound-certified skip the (rows, K/Pm) scan via the packed-block
+    `lax.cond`), and the champion all_gathers + data-axis stat psums run
+    identically (the PR-13 `same_schedule_as` invariant pins this).
+
+    Zero-padding rows are ordinary zero points (the exact tower's rule);
+    callers apply the same padding_correction. SSE is the full (clamped,
+    ‖x‖²-included) form — bounded fits don't use the x2sum shift."""
+    from tdc_tpu.ops.bounds import _second_min
+    from tdc_tpu.ops.pallas_kernels import champion_tile
+    from tdc_tpu.ops.sorted_stats import sorted_cluster_stats
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None),
+                  P(MODEL_AXIS, None), P(DATA_AXIS, MODEL_AXIS),
+                  P(DATA_AXIS, MODEL_AXIS)),
+        out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P(),
+                   P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS, MODEL_AXIS),
+                   P((DATA_AXIS, MODEL_AXIS))),
+        check_vma=False,
+    )
+    def stats(x_loc, c_loc, prev_loc, lab, lb):
+        n_loc, d = x_loc.shape
+        k_per = c_loc.shape[0]
+        m_idx = jax.lax.axis_index(MODEL_AXIS)
+        lab, lb = lab[:, 0], lb[:, 0]
+        cf = c_loc.astype(jnp.float32)
+        # Shard-LOCAL drift: this shard's centroids moved by delta; the
+        # local bounds only ever referenced local centroids, so no
+        # cross-shard drift exchange is needed (the collective-free
+        # property the schedule golden pins). The tighten below
+        # re-establishes the upper bound exactly, so only the lower
+        # bound drifts (ops/bounds.BoundsState's no-upper-leaf rule).
+        delta = jnp.linalg.norm(cf - prev_loc.astype(jnp.float32), axis=1)
+        dmax = jnp.max(delta)
+        xf = x_loc.astype(jnp.float32)
+        x2 = jnp.sum(xf * xf, axis=1)
+        lb = lb - dmax
+        ca = cf[lab]
+        d2a = jnp.maximum(
+            x2 + jnp.sum(ca * ca, axis=1) - 2.0 * jnp.sum(xf * ca, axis=1),
+            0.0,
+        )
+        ta = jnp.sqrt(d2a)
+        need = jnp.logical_not(ta < lb)
+        block = min(block_rows_pack, max(n_loc, 1))
+        order = jnp.argsort(
+            jnp.logical_not(need).astype(jnp.int32)
+        ).astype(jnp.int32)
+        pad = (-n_loc) % block
+        if pad:
+            order = jnp.concatenate([order, jnp.zeros((pad,), jnp.int32)])
+        npad = n_loc + pad
+        real = jnp.arange(npad) < n_loc
+        needs = jnp.where(real, need[order], False)
+        nb = npad // block
+
+        def one_block(args):
+            xs_b, lab_b, d2a_b, lb_b, need_b = args
+
+            def rescan(_):
+                d2 = pairwise_sq_dist(xs_b, cf)
+                tmin, targ = champion_tile(d2)
+                d1 = tmin[:, 0]
+                return (targ[:, 0], d1,
+                        jnp.sqrt(jnp.maximum(_second_min(d2, targ), 0.0)),
+                        jnp.full((), float(block * k_per), jnp.float32))
+
+            def skip(_):
+                return (lab_b, d2a_b, lb_b,
+                        jnp.zeros((), jnp.float32))
+
+            return jax.lax.cond(jnp.any(need_b), rescan, skip, None)
+
+        lab2, champ, lb2, ev_b = jax.lax.map(
+            one_block,
+            (xf[order].reshape(nb, block, d),
+             lab[order].reshape(nb, block),
+             d2a[order].reshape(nb, block),
+             lb[order].reshape(nb, block),
+             needs.reshape(nb, block)),
+        )
+
+        def unsort(v, fill):
+            dest = jnp.where(real, order, n_loc)
+            out = jnp.full((n_loc + 1,), fill, v.dtype)
+            return out.at[dest].set(v.reshape(-1))[:n_loc]
+
+        lab_n = unsort(lab2, 0)
+        lmin = unsort(champ, 0.0)
+        lb_n = unsort(lb2, 0.0)
+        evals = jnp.sum(ev_b) + float(n_loc)  # + the tighten pass
+        # From here the EXACT tower, op for op: global champion fold over
+        # the model axis, shard-local stats, data-axis psums.
+        larg = lab_n + m_idx * k_per
+        mins = jax.lax.all_gather(lmin, MODEL_AXIS)  # (Pm, n_loc)
+        args = jax.lax.all_gather(larg, MODEL_AXIS)
+        gmin = jnp.min(mins, axis=0)
+        garg = jnp.min(jnp.where(mins == gmin[None, :], args, 2**30),
+                       axis=0)
+        rel = garg - m_idx * k_per
+        sums, counts = sorted_cluster_stats(x_loc, rel, k_per)
+        sse = jnp.sum(gmin)
+        return (
+            jax.lax.psum(sums, DATA_AXIS),
+            jax.lax.psum(counts, DATA_AXIS),
+            jax.lax.psum(sse, DATA_AXIS),
+            lab_n[:, None],
+            lb_n[:, None],
+            (evals)[None],
+        )
+
+    return stats
+
+
 def make_sharded_deferred_reduce(mesh: Mesh):
     """The per-pass counterpart of make_sharded_stats(reduce_data=False):
     ONE data-axis psum of the deferred (n_data-leading) accumulator —
@@ -548,6 +755,15 @@ def kmeans_fit_sharded(
     contract — bounded-loss, probe='all' routes to the exact path;
     kernel='auto' resolves via ops/pallas_kernels.resolve_kernel).
 
+    assign="bounded": ZERO-LOSS sub-linear assignment — per-shard Hamerly
+    triangle-inequality bounds (make_sharded_bounded_stats) ride the
+    compiled fit loop's carry, each model shard bound-certifying or
+    re-scanning its own K/Pm slice locally, so centroids/assignments are
+    IDENTICAL to assign="exact" while pruned shards skip their local
+    distance scans. Adds no collectives (the PR-13 schedule golden pins
+    bounded ≡ exact); refuses spherical/kernel='pallas'/block_rows
+    combos loudly. The result's `bounds` field carries the BoundsReport.
+
     Multi-process meshes (SURVEY §7 step 7: sharded centroid tiles at pod
     scale) are supported by passing `x` as the full NUMPY array, identical on
     every process: numpy stays host-side until the global device_put, which
@@ -576,14 +792,72 @@ def kmeans_fit_sharded(
     # Whole fit loop device-side (round-4 VERDICT weak #2: the Python
     # iterate-and-float() loop here cost one device round trip per
     # iteration). Host syncs per fit: the loop-result fetch + the final SSE.
+    from tdc_tpu.ops import bounds as bounds_lib
     from tdc_tpu.ops import subk as subk_lib
     from tdc_tpu.ops.pallas_kernels import resolve_kernel
 
-    kernel = resolve_kernel(kernel, k=k // n_model, d=x.shape[1],
-                            model="kmeans_sharded",
-                            label="kmeans_fit_sharded")
-    aspec = subk_lib.resolve_assign(assign, k // n_model, probe=probe,
-                                    label="kmeans_fit_sharded")
+    bounded = assign == "bounded"
+    if bounded:
+        if probe is not None:
+            raise ValueError(
+                "probe= only applies to assign='coarse'/'auto' (bounded "
+                "assignment is exact)"
+            )
+        if spherical:
+            raise ValueError(
+                "assign='bounded' does not support spherical=True; use "
+                "assign='exact'"
+            )
+        if kernel == "pallas":
+            raise ValueError(
+                "assign='bounded' runs its own masked-recompute tower and "
+                "cannot combine with kernel='pallas'"
+            )
+        if MeshSpec.of(mesh).n_processes > 1:
+            raise ValueError(
+                "assign='bounded' on the K-sharded drivers is single-"
+                "process only (the bounds init and eval-tally fetches "
+                "read sharded state host-side); use assign='exact'"
+            )
+        aspec = subk_lib.EXACT
+        bounds_lib.resolve_bounds("hamerly", k, label="kmeans_fit_sharded")
+    else:
+        kernel = resolve_kernel(kernel, k=k // n_model, d=x.shape[1],
+                                model="kmeans_sharded",
+                                label="kmeans_fit_sharded")
+        aspec = subk_lib.resolve_assign(assign, k // n_model, probe=probe,
+                                        label="kmeans_fit_sharded")
+    bounds_report = None
+    if bounded:
+        brun, _ = _lloyd_fit_fns_bounded(mesh, spherical, int(max_iters),
+                                         float(tol))
+        # The final-report step stays the EXACT tower: identical reported
+        # SSE, and the bounds carry must not drift during reporting.
+        _, step = _lloyd_fit_fns(mesh, "xla", block_rows, spherical,
+                                 int(max_iters), float(tol), subk_lib.EXACT)
+        state0 = init_sharded_bounds(mesh, x.shape[0], c)
+        c, shift_dev, i_dev, hist, bstate = brun(x, c, state0)
+        n_iter = int(i_dev)
+        shift = float(shift_dev)
+        converged = tol >= 0 and shift <= tol
+        _, _, sse = step(x, c, x.shape[0], sum_sq(x))
+        counter = bounds_lib.BoundsCounter(_mirror=bounds_lib.GLOBAL_BOUNDS)
+        # ev sums actual per-shard evals; the K/Pm shards partition K, so
+        # the exact-path total is rows × K per iteration.
+        counter.add(float(np.asarray(bstate.ev).sum()),
+                    float(x.shape[0]) * float(k) * n_iter)
+        bounds_report = bounds_lib.report(
+            bounds_lib.BoundsSpec(kind="hamerly"), counter
+        )
+        return KMeansResult(
+            centroids=c,
+            n_iter=jnp.asarray(n_iter, jnp.int32),
+            sse=jnp.asarray(float(sse), jnp.float32),
+            shift=jnp.asarray(shift, jnp.float32),
+            converged=jnp.asarray(converged),
+            history=np.asarray(hist)[:n_iter],
+            bounds=bounds_report,
+        )
     run, step = _lloyd_fit_fns(mesh, kernel, block_rows, spherical,
                                int(max_iters), float(tol), aspec)
     x2sum = sum_sq(x)  # once per fit; the step then skips the ‖x‖² re-read
@@ -617,6 +891,60 @@ def kmeans_fit_sharded(
         history=np.asarray(hist)[:n_iter],
         assign=assign_report,
     )
+
+
+@lru_cache(maxsize=32)
+def _lloyd_fit_fns_bounded(mesh, spherical, max_iters, tol):
+    """kmeans_fit_sharded's bounded-assignment (loop, step) pair: the
+    per-shard Hamerly bounds ride the compiled while_loop's carry, so
+    the whole zero-loss pruned fit is still ONE dispatch. Returns
+    (run(x, c0, state0) -> (c, shift, n_iter, hist, state), step)."""
+    bstats = make_sharded_bounded_stats(mesh)
+
+    @jax.jit
+    def step(x, c, state: ShardedBoundsState):
+        sums, counts, sse, lab, lb, ev = bstats(
+            x, c, state.prev_c, state.lab, state.lb
+        )
+        cf = c.astype(jnp.float32)
+        new_c = jnp.where(
+            counts[:, None] > 0,
+            sums / jnp.maximum(counts[:, None], 1.0),
+            cf,
+        )
+        if spherical:
+            new_c = _normalize(new_c)
+        shift = jnp.max(jnp.linalg.norm(new_c - cf, axis=-1))
+        new_state = ShardedBoundsState(
+            prev_c=cf, lab=lab, lb=lb, ev=state.ev + ev
+        )
+        return new_c, shift, sse, new_state
+
+    @jax.jit
+    def run(x, c0, state0):
+        def cond(carry):
+            _, shift, i, _, _ = carry
+            live = i < max_iters
+            if tol >= 0:
+                live = jnp.logical_and(live, shift > tol)
+            return live
+
+        def body(carry):
+            c, _, i, hist, st = carry
+            new_c, shift, cost, st = step(x, c, st)
+            hist = hist.at[i].set(jnp.stack([cost, shift]))
+            return new_c, shift, i + 1, hist, st
+
+        carry0 = (
+            c0,
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.asarray(0, jnp.int32),
+            jnp.zeros((max_iters, 2), jnp.float32),
+            state0,
+        )
+        return jax.lax.while_loop(cond, body, carry0)
+
+    return run, step
 
 
 @lru_cache(maxsize=64)
@@ -1324,6 +1652,9 @@ def _sharded_stream_loop(
     mesh=None,
     gang: bool = False,
     counter=None,
+    make_aux=None,
+    assign_counter=None,
+    assign_pass_cost=None,
 ):
     """The deferred-sync iteration driver shared by the streamed K-sharded
     fits (Lloyd and fuzzy differ only in their accumulator algebra): resume
@@ -1349,13 +1680,17 @@ def _sharded_stream_loop(
     at chunk boundaries. resident_cost(cache) -> the per-resident-iteration
     comms (reduces, bytes) the counter should book.
 
+    make_aux(cache) builds the resident chunk's aux carry (the bounded
+    fits' per-shard bounds state; () when absent). assign_counter /
+    assign_pass_cost(cache) -> (probed, total): EXACT per-pass coarse
+    tile accounting booked per chunk against the while-loop's carried
+    pass count (replacing the PR-11 extrapolation).
+
     Returns (c, n_iter, start_iter, shift, converged, history, final_acc,
-    resident_passes) where final_acc is one extra pass at the RETURNED
-    centroids (its cost is the fit's reported SSE/objective — parity with
-    streamed_kmeans_fit) and resident_passes counts the passes that ran
-    inside the compiled resident chunk loop (the drivers extrapolate
-    per-pass host-side accounting — e.g. assign tile tallies — across
-    them).
+    resident_passes, aux) where final_acc is one extra pass at the
+    RETURNED centroids (its cost is the fit's reported SSE/objective —
+    parity with streamed_kmeans_fit) and aux is the resident carry after
+    the final pass (the bounded fits read their eval tallies off it).
     """
     from tdc_tpu.models import resident as resident_lib
     from tdc_tpu.models.streaming import _run_pass
@@ -1424,28 +1759,36 @@ def _sharded_stream_loop(
             break  # iterations 2..N run on-device over the cache
     chunk_fns = None
     resident_passes = 0
+    aux = ()
     if cache is not None and make_resident is not None:
         chunk_fns = make_resident(cache)
         cost_ri = resident_cost(cache)
+        cost_ai = (assign_pass_cost(cache)
+                   if assign_counter is not None and assign_pass_cost
+                   else (0, 0))
+        if make_aux is not None:
+            aux = make_aux(cache)
         if n_iter < max_iters and not (tol >= 0 and float(shift) <= tol):
             shift = float(shift)
             iter_before_resident = n_iter
-            c, _, n_iter, shift, converged, history = (
+            c, aux, n_iter, shift, converged, history = (
                 resident_lib.run_resident_loop(
-                    chunk=chunk_fns[0], cache=cache, c=c, aux=(),
+                    chunk=chunk_fns[0], cache=cache, c=c, aux=aux,
                     n_iter=n_iter, max_iters=max_iters, tol=tol,
                     shift=shift, history=history, chunk_iters=chunk_iters,
                     mesh=mesh, gang=gang, ckpt=ckpt, ckpt_dir=ckpt_dir,
                     ckpt_every=ckpt_every, counter=counter,
                     comms_per_iter=cost_ri,
+                    assign_counter=assign_counter, assign_per_pass=cost_ai,
                 )
             )
             resident_passes += n_iter - iter_before_resident
     shift = float(shift)  # one deferred fetch on the async path
     if chunk_fns is not None:
-        final_acc, _ = resident_lib.final_pass(
-            chunk_fns[1], c, (), cache, counter=counter,
+        final_acc, aux = resident_lib.final_pass(
+            chunk_fns[1], c, aux, cache, counter=counter,
             comms_per_iter=cost_ri,
+            assign_counter=assign_counter, assign_per_pass=cost_ai,
         )
         resident_passes += 1
     else:
@@ -1455,7 +1798,7 @@ def _sharded_stream_loop(
                 final_acc = finalize(final_acc, c)
                 trace.sync(final_acc)
     return (c, n_iter, start_iter, shift, converged, history, final_acc,
-            resident_passes)
+            resident_passes, aux)
 
 
 def streamed_kmeans_fit_sharded(
@@ -1523,6 +1866,17 @@ def streamed_kmeans_fit_sharded(
     bounded-loss accounting on the result's `ingest` field with the
     strict max_bad_fraction=0.0 default.
 
+    assign="bounded": the ZERO-LOSS sub-linear mode — per-shard Hamerly
+    bounds live NEXT TO the HBM cache as the resident chunk's donated
+    aux carry (ShardedResidentBounds), so it requires residency
+    "hbm"/"auto" reaching hbm; streamed/spill fits fall back to exact
+    LOUDLY (`bounds_fallback`). Streamed passes (incl. the cache fill)
+    run exact; resident iterations 2..N run the bounded tower
+    (make_sharded_bounded_stats) with the exact tower's collective
+    schedule byte for byte. Refuses spherical / kernel='pallas' /
+    reduce='per_pass'. The result's `bounds` field carries the
+    BoundsReport.
+
     ckpt_dir enables checkpoint/resume with the models/streaming contract
     (per-iteration saves every `ckpt_every` iterations; mid-pass accumulator
     + batch-cursor saves every `ckpt_every_batches` batches; resume is
@@ -1555,16 +1909,53 @@ def streamed_kmeans_fit_sharded(
     from tdc_tpu.ops.pallas_kernels import resolve_kernel
     from tdc_tpu.testing.faults import fault_point
 
-    kernel = resolve_kernel(
-        kernel, k=k // n_model, d=d,
-        itemsize=_stream_kernel_itemsize(batches, dtype),
-        model="kmeans_sharded",
-        label="streamed_kmeans_fit_sharded")
-    # Tiles are per model shard: the coarse plan (and the auto threshold)
-    # see K/Pm local centroids, mirroring where the pruning runs.
-    aspec = subk_lib.resolve_assign(assign, k // n_model, probe=probe,
-                                    label="streamed_kmeans_fit_sharded")
+    bounded = assign == "bounded"
+    if bounded:
+        from tdc_tpu.ops import bounds as bounds_lib
+
+        if probe is not None:
+            raise ValueError(
+                "probe= only applies to assign='coarse'/'auto' (bounded "
+                "assignment is exact)"
+            )
+        if spherical:
+            raise ValueError(
+                "assign='bounded' does not support spherical=True; use "
+                "assign='exact'"
+            )
+        if kernel == "pallas":
+            raise ValueError(
+                "assign='bounded' runs its own masked-recompute tower and "
+                "cannot combine with kernel='pallas'"
+            )
+        if spec.n_processes > 1:
+            raise ValueError(
+                "assign='bounded' on the K-sharded drivers is single-"
+                "process only (the bounds init and eval-tally fetches "
+                "read sharded state host-side); use assign='exact'"
+            )
+        kernel = "xla"
+        aspec = subk_lib.EXACT  # streamed passes (incl. the fill) run exact
+        bounds_lib.resolve_bounds("hamerly", k,
+                                  label="streamed_kmeans_fit_sharded")
+    else:
+        kernel = resolve_kernel(
+            kernel, k=k // n_model, d=d,
+            itemsize=_stream_kernel_itemsize(batches, dtype),
+            model="kmeans_sharded",
+            label="streamed_kmeans_fit_sharded")
+        # Tiles are per model shard: the coarse plan (and the auto
+        # threshold) see K/Pm local centroids, mirroring where the
+        # pruning runs.
+        aspec = subk_lib.resolve_assign(assign, k // n_model, probe=probe,
+                                        label="streamed_kmeans_fit_sharded")
     strategy = reduce_lib.resolve_reduce(reduce)
+    if bounded and strategy.deferred:
+        raise ValueError(
+            "assign='bounded' is wired for reduce='per_batch' (the bounded "
+            "tower reduces its stats per batch like the exact one); drop "
+            "reduce='per_pass' or use assign='exact'"
+        )
     deferred, _ = _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
                                allow_quantize=False)
     gang = spec.gang
@@ -1664,6 +2055,19 @@ def streamed_kmeans_fit_sharded(
         cursor=state.cursor, label="streamed_kmeans_fit_sharded",
         mid_pass_ckpt=ckpt_every_batches is not None,
     )
+    if bounded and (r_plan is None or not r_plan.resident):
+        # Per-shard bounds are multi-iteration device state living next
+        # to the HBM cache; streamed/spill fits re-upload every batch and
+        # the bounds die with it. Loud, zero-loss fallback: exact.
+        from tdc_tpu.utils.structlog import emit
+
+        emit("bounds_fallback", label="streamed_kmeans_fit_sharded",
+             requested=assign, residency=residency,
+             reason="stream" if r_plan is None else r_plan.reason,
+             detail="bounded assignment needs the HBM-resident cache "
+                    "(per-shard bounds are multi-iteration device "
+                    "state); running exact assignment instead")
+        bounded = False
     chunk_iters = _chunk_iters_for(ckpt_dir, ckpt_every)
     counter = reduce_lib.CommsCounter(_mirror=reduce_lib.GLOBAL_COMMS)
     assign_counter = (
@@ -1802,9 +2206,101 @@ def streamed_kmeans_fit_sharded(
         """(chunk, pass_only) over the HBM cache — the pass body mirrors
         the streamed accumulate/finalize ops EXACTLY (same per-batch stats
         in stream order, same one-per-pass deferred reduce and padding
-        correction), which keeps resident results bit-exact."""
+        correction), which keeps resident results bit-exact.
+
+        Bounded fits swap the per-batch stats for the zero-loss
+        make_sharded_bounded_stats tower, threading the per-shard bounds
+        carry (ShardedResidentBounds, the chunk's donated aux) batch for
+        batch; the final reporting pass stays the EXACT tower (bounds
+        must not drift during reporting)."""
         from tdc_tpu.data import device_cache as dc
         from tdc_tpu.models import resident as resident_lib
+
+        if bounded:
+            bstats = make_sharded_bounded_stats(mesh)
+
+            def bounded_pass(c, aux, cache_):
+                acc0 = _ShardedAcc(
+                    sums=jax.lax.with_sharding_constraint(
+                        jnp.zeros((k, d), jnp.float32),
+                        NamedSharding(mesh, P(MODEL_AXIS, None)),
+                    ),
+                    counts=jax.lax.with_sharding_constraint(
+                        jnp.zeros((k,), jnp.float32),
+                        NamedSharding(mesh, P(MODEL_AXIS)),
+                    ),
+                    sse=jnp.zeros((), jnp.float32),
+                )
+
+                def one(a, ev, xb, nv, lab, lb):
+                    sums, counts, sse, lab2, lb2, evb = bstats(
+                        xb, c, aux.prev_c, lab, lb
+                    )
+                    counts, sse = padding_correction(
+                        counts, sse, c, xb.shape[0] - nv
+                    )
+                    a = _ShardedAcc(
+                        a.sums + sums, a.counts + counts, a.sse + sse
+                    )
+                    return a, ev + evb, (lab2, lb2)
+
+                acc, ev = acc0, aux.ev
+                lab_s = lb_s = None
+                if cache_.stacked is not None:
+                    def body(carry, xs):
+                        a, ev = carry
+                        xb, lab, lb = xs
+                        a, ev, ys = one(a, ev, xb, cache_.nv_full,
+                                        lab, lb)
+                        return (a, ev), ys
+
+                    (acc, ev), (lab_s, lb_s) = jax.lax.scan(
+                        body, (acc, ev),
+                        (cache_.stacked, aux.lab_s, aux.lb_s),
+                    )
+                acc, ev, (lab_t, lb_t) = one(
+                    acc, ev, cache_.tail, cache_.nv_tail,
+                    aux.lab_t, aux.lb_t,
+                )
+                new_aux = ShardedResidentBounds(
+                    prev_c=c.astype(jnp.float32),
+                    lab_s=lab_s, lb_s=lb_s,
+                    lab_t=lab_t, lb_t=lb_t, ev=ev,
+                )
+                return acc, new_aux
+
+            def exact_pass(c, aux, cache_):
+                acc = _ShardedAcc(
+                    sums=jax.lax.with_sharding_constraint(
+                        jnp.zeros((k, d), jnp.float32),
+                        NamedSharding(mesh, P(MODEL_AXIS, None)),
+                    ),
+                    counts=jax.lax.with_sharding_constraint(
+                        jnp.zeros((k,), jnp.float32),
+                        NamedSharding(mesh, P(MODEL_AXIS)),
+                    ),
+                    sse=jnp.zeros((), jnp.float32),
+                )
+
+                def one(a, xb, wb, nv):
+                    sums, counts, sse = stats_fn(xb, c)
+                    counts, sse = padding_correction(
+                        counts, sse, c, xb.shape[0] - nv
+                    )
+                    return _ShardedAcc(
+                        a.sums + sums, a.counts + counts, a.sse + sse
+                    )
+
+                return dc.scan_cache(acc, cache_, one, False), aux
+
+            def update_fn(acc, c):
+                new_c, shift = update(acc, c)
+                return new_c, shift, acc.sse
+
+            chunk = resident_lib.make_resident_chunk(
+                bounded_pass, update_fn, float(tol), chunk_iters
+            )
+            return chunk, jax.jit(exact_pass)
 
         def pass_fn(c, aux, cache_):
             if deferred:
@@ -1891,12 +2387,38 @@ def streamed_kmeans_fit_sharded(
         xb, n_valid = put_batch(batch)
         return spill_lib.StagedBatch(xb, n_valid, n_valid)
 
+    def _assign_pass_cost(cache):
+        # EXACT per-pass tile tallies from the cache's batch geometry
+        # (the cached batches replay the streamed batches shape for
+        # shape; subk.assign_cost is geometry-only) — every (data,
+        # model) shard pair refines its own blocks against its own
+        # tiles, so the logical tally scales by both axes.
+        probed = total = 0
+        shapes = ([cache.stacked.shape[1]] * cache.stacked.shape[0]
+                  if cache.stacked is not None else [])
+        shapes.append(cache.tail.shape[0])
+        for rows in shapes:
+            p, t = subk_lib.assign_cost(rows // n_data, aspec)
+            probed += p * n_data * n_model
+            total += t * n_data * n_model
+        return probed, total
+
+    make_aux = None
+    if bounded:
+        from tdc_tpu.testing.faults import fault_point as _fp
+
+        def make_aux(cache):
+            with trace.span("bounds_init", kind="hamerly"):
+                _fp("assign.bounds_recompute")
+                return init_resident_sharded_bounds(mesh, cache, c)
+
     loop_batches, h2d = spill_lib.wrap_stream(r_plan, guard, _stage)
     loop_prefetch = prefetch if h2d is None else 0
     # Per-fit timeline (obs/trace): None unless tracing is enabled.
     tl = trace.begin_fit("streamed_kmeans_fit_sharded", k=k, d=d)
 
-    c, n_iter, start_iter, shift, converged, history, final_acc, res_p = (
+    (c, n_iter, start_iter, shift, converged, history, final_acc, res_p,
+     res_aux) = (
         _sharded_stream_loop(
             batches=loop_batches, prefetch=loop_prefetch, ckpt=ckpt,
             ckpt_dir=ckpt_dir,
@@ -1907,17 +2429,39 @@ def streamed_kmeans_fit_sharded(
             fill=r_builder, make_resident=make_resident,
             resident_cost=resident_cost, chunk_iters=chunk_iters,
             mesh=mesh, gang=gang, counter=counter,
+            make_aux=make_aux, assign_counter=assign_counter,
+            assign_pass_cost=_assign_pass_cost,
         )
     )
-    if assign_counter is not None and res_p:
-        # Resident passes ran inside the compiled chunk loop; every pass
-        # books identical (geometry-only) tile tallies, so extrapolate
-        # from the streamed passes' average (approximate only under a
-        # mid-pass resume, where the first streamed pass was partial).
-        streamed_p = max((n_iter - start_iter) + 1 - res_p, 1)
-        snap = assign_counter.snapshot()
-        assign_counter.add(snap["tiles_probed"] // streamed_p * res_p,
-                           snap["tiles_total"] // streamed_p * res_p)
+    bounds_report = None
+    if bounded:
+        from tdc_tpu.ops import bounds as bounds_lib
+
+        if isinstance(res_aux, ShardedResidentBounds):
+            bcounter = bounds_lib.BoundsCounter(
+                _mirror=bounds_lib.GLOBAL_BOUNDS
+            )
+            rows = ((res_aux.lab_s.shape[0] * res_aux.lab_s.shape[1]
+                     if res_aux.lab_s is not None else 0)
+                    + res_aux.lab_t.shape[0])
+            # res_p counts the final reporting pass, which runs the
+            # EXACT tower — only res_p - 1 passes went through bounds.
+            bcounter.add(float(np.asarray(res_aux.ev).sum()),
+                         float(rows) * float(k) * max(res_p - 1, 0))
+            bounds_report = bounds_lib.report(
+                bounds_lib.BoundsSpec(kind="hamerly"), bcounter
+            )
+        else:
+            # The plan said resident but the fill never completed: the
+            # fit streamed exact — still zero-loss, but say so (the 1-D
+            # driver's cache_unfilled rule).
+            from tdc_tpu.utils.structlog import emit
+
+            emit("bounds_fallback", label="streamed_kmeans_fit_sharded",
+                 requested=assign, residency=residency,
+                 reason="cache_unfilled",
+                 detail="the HBM cache fill did not complete; the fit "
+                        "ran exact streamed assignment")
     sse = float(final_acc.sse)
     return KMeansResult(
         centroids=c,
@@ -1936,6 +2480,7 @@ def streamed_kmeans_fit_sharded(
         ingest=guard.report(),
         assign=(None if assign_counter is None
                 else subk_lib.report(aspec, assign_counter)),
+        bounds=bounds_report,
         timeline=trace.end_fit(tl),
     )
 
@@ -2284,7 +2829,7 @@ def streamed_fuzzy_fit_sharded(
     # Per-fit timeline (obs/trace): None unless tracing is enabled.
     tl = trace.begin_fit("streamed_fuzzy_fit_sharded", k=k, d=d)
 
-    c, n_iter, start_iter, shift, converged, history, final_acc, _ = (
+    c, n_iter, start_iter, shift, converged, history, final_acc, _, _ = (
         _sharded_stream_loop(
             batches=loop_batches, prefetch=loop_prefetch, ckpt=ckpt,
             ckpt_dir=ckpt_dir,
@@ -2439,9 +2984,11 @@ def streamed_gmm_fit_sharded(
         # divergent EM).
         chunks, got = [], 0
         for b in batches():
-            b = np.asarray(b)
-            chunks.append(b)
-            got += b.shape[0]
+            # Snapshot stash (np.array copies): a stream may reuse its
+            # batch buffer between yields, so raw references held across
+            # iterations would alias to the last read.
+            chunks.append(np.array(b, np.float32))  # tdclint: disable=TDC002 — deliberate host snapshot (streams may reuse batch buffers); the seeding scan breaks at 65536 rows
+            got += int(getattr(b, "shape", (len(b),))[0])
             if got >= 65536:
                 break
         first = np.concatenate(chunks)[:65536]

@@ -23,10 +23,27 @@ Large-K models route hard assignment through
 centroid placement is cached on the registry entry (Mesh-TensorFlow's
 keep-the-layout-live-across-requests argument), so per-request work is
 one data-sharded device_put + the assign tower.
+
+Sub-linear predict (ROADMAP 3b): a kmeans/fuzzy model whose manifest
+params carry `assign: "coarse"|"auto"` (+ optional `probe`/`n_tiles`)
+routes hard assignment through the PR-11 coarse→refine tile-pruned path
+(ops/subk.py) — the served codebook workload is exactly where K is huge
+and the all-K scan made predict O(K). The coarse PLAN (cluster the
+codebook into √K tiles) is built ONCE per (model, generation) from the
+entry's device-resident centroids and cached in an LRU dict budgeted by
+`plan_budget`; a hot reload/atomic swap bumps the generation, so
+`_evict_stale` drops the stale plan with the rest of that generation's
+compiled state. `probe="all"` resolves to the exact route
+(ops/subk.resolve_assign) and is therefore bit-exact by construction;
+`predict_proba`/`transform` need every K distance by definition and
+stay exact. Pruned-tile accounting lands on ops/subk.GLOBAL_PREDICT
+(`tdc_predict_*` on /metrics).
 """
 
 from __future__ import annotations
 
+import collections
+import threading
 import time
 from typing import Callable
 
@@ -45,6 +62,28 @@ _METHODS = {
 
 def _next_pow2(n: int) -> int:
     return 1 << max(int(n - 1).bit_length(), 0)
+
+
+# Per-CoarseSpec jitted coarse-predict callables (labels only): module-
+# level so every engine (and jit_cache_size) sees one executable per
+# (spec, bucket) instead of per-entry re-traces. n_valid = all rows —
+# bucket-padding zero rows are ordinary points whose labels the caller
+# slices off.
+_COARSE_PREDICT_FNS: dict = {}
+
+
+def _coarse_predict_fn(spec):
+    fn = _COARSE_PREDICT_FNS.get(spec)
+    if fn is None:
+        from tdc_tpu.ops import subk
+
+        @jax.jit
+        def fn(x, plan):
+            labels, _ = subk.coarse_champions(x, plan, x.shape[0], spec)
+            return labels
+
+        _COARSE_PREDICT_FNS[spec] = fn
+    return fn
 
 
 @jax.jit
@@ -79,12 +118,22 @@ class PredictEngine:
         shard_k_threshold: int = 8192,
         min_bucket: int = 8,
         max_bucket: int = 1 << 15,
+        plan_budget: int = 8,
         log=None,
     ):
         self.mesh = mesh
         self.shard_k_threshold = int(shard_k_threshold)
         self.min_bucket = int(min_bucket)
         self.max_bucket = int(max_bucket)
+        # LRU budget for cached coarse-predict plans — each is O(K·d)
+        # device memory (the packed tile copy of the codebook), so
+        # hundreds of registered models must not pin hundreds of copies.
+        self.plan_budget = int(plan_budget)
+        if self.plan_budget < 1:
+            raise ValueError("plan_budget must be >= 1")
+        # (model_id, generation) -> (CoarseSpec, CoarsePlan), LRU order.
+        self._plans: collections.OrderedDict = collections.OrderedDict()
+        self._plan_lock = threading.Lock()
         self.log = log
         self._fns: dict[tuple, Callable] = {}
         self.compiled_keys: set[tuple] = set()  # (id, gen, method, bucket, kernel)
@@ -137,8 +186,84 @@ class PredictEngine:
             and entry.fitted.k >= self.shard_k_threshold
         ):
             return "sharded"
+        if (
+            method == "predict"
+            and entry.fitted.model in ("kmeans", "fuzzy")
+            and self._coarse_spec(entry) is not None
+        ):
+            return "coarse"
         k = entry.fitted.kernel
         return "xla" if k in ("auto", "") else k
+
+    def _coarse_spec(self, entry: ModelEntry):
+        """The per-model CoarseSpec from the manifest's `assign`/`probe`/
+        `n_tiles` params, or None for the exact route. `probe="all"` (and
+        `assign="auto"` below subk.AUTO_MIN_K) resolve to exact — the
+        bit-exact-by-construction safety valve — and spherical models
+        stay exact (the coarse path scores unnormalized rows)."""
+        from tdc_tpu.ops import subk
+
+        cached = entry.placements.get("coarse_spec", "unset")
+        if cached != "unset":
+            return cached
+        params = entry.fitted.params
+        assign = params.get("assign", "exact")
+        spec = None
+        if assign in ("coarse", "auto") and not bool(
+            params.get("spherical", False)
+        ):
+            # Serve batches are small and their rows arbitrary, so each
+            # sorted refine block must not span more coarse cells than
+            # the probe budget covers: default the block to the probe
+            # (one probed tile per distinct cell in the worst case; see
+            # subk.effective_block — per-point FLOPs are block-size-
+            # independent, only per-block overhead grows).
+            probe = params.get("probe")
+            block_default = (max(2, probe // 2)
+                             if isinstance(probe, int) and probe >= 1
+                             else 8)
+            resolved = subk.resolve_assign(
+                assign, entry.fitted.k,
+                probe=probe,
+                n_tiles=params.get("n_tiles"),
+                block_rows=int(params.get("block_rows", block_default)),
+                label=f"serve:{entry.model_id}",
+            )
+            if resolved.coarse:
+                spec = resolved
+        # Cached on the entry (one resolve + one structlog event per
+        # generation, not per request); a swap builds a fresh entry.
+        entry.placements["coarse_spec"] = spec
+        return spec
+
+    def _coarse_plan(self, entry: ModelEntry, spec):
+        """The cached (LRU-budgeted) coarse plan for this entry's
+        generation. Built once from the device-resident codebook; a hot
+        reload/atomic swap bumps the generation so the stale plan is
+        unreachable (and `_evict_stale` frees it)."""
+        from tdc_tpu.ops import subk
+
+        key = (entry.model_id, entry.generation)
+        with self._plan_lock:
+            hit = self._plans.get(key)
+            if hit is not None:
+                self._plans.move_to_end(key)
+                return hit[1]
+        plan = subk.plan_for(entry.device["centroids"], spec)
+        with self._plan_lock:
+            self._plans[key] = (spec, plan)
+            self._plans.move_to_end(key)
+            while len(self._plans) > self.plan_budget:
+                old_key, _ = self._plans.popitem(last=False)
+                if self.log is not None:
+                    self.log.event("predict_plan_evicted",
+                                   model=old_key[0],
+                                   generation=old_key[1])
+        if self.log is not None:
+            self.log.event("predict_plan_built", model=entry.model_id,
+                           generation=entry.generation,
+                           n_tiles=spec.n_tiles, probe=spec.probe)
+        return plan
 
     def _evict_stale(self, entry: ModelEntry) -> None:
         """Drop compiled state for generations OLDER than this entry's.
@@ -157,6 +282,13 @@ class PredictEngine:
             self.compiled_keys = {
                 k for k in self.compiled_keys if not stale(k)
             }
+        with self._plan_lock:
+            stale_plans = [
+                pk for pk in self._plans
+                if pk[0] == entry.model_id and pk[1] < entry.generation
+            ]
+            for pk in stale_plans:
+                del self._plans[pk]
 
     def _build_fn(self, entry: ModelEntry, method: str, kernel: str):
         """One closure over the entry's device-resident parameters. The
@@ -174,6 +306,20 @@ class PredictEngine:
 
         if kernel == "sharded":
             return self._build_sharded_predict(entry, spherical)
+
+        if kernel == "coarse":
+            spec = self._coarse_spec(entry)
+            impl = _coarse_predict_fn(spec)
+
+            def run_coarse(x, _e=entry, _s=spec, _impl=impl):
+                # Resolve the plan PER CALL (not captured): every request
+                # touches the LRU, and an evicted plan's device arrays
+                # are genuinely freed (rebuilt on next use) instead of
+                # staying pinned by the closure.
+                plan = self._coarse_plan(_e, _s)
+                return _impl(jnp.asarray(x, jnp.float32), plan)
+
+            return run_coarse
 
         if model == "gmm":
             from tdc_tpu.models.gmm import (
@@ -283,6 +429,12 @@ class PredictEngine:
         if not warm:
             self.compiled_keys.add(ckey)
             self.stats["compiles"] += 1
+        if kernel == "coarse":
+            from tdc_tpu.ops import subk
+
+            subk.GLOBAL_PREDICT.add(*subk.assign_cost(
+                bucket, self._coarse_spec(entry)
+            ))
         self.stats["batches"] += 1
         self.stats["rows"] += n
         self.stats["padded_rows"] += bucket - n
@@ -333,6 +485,7 @@ class PredictEngine:
             getattr(fuzzy_mod, "_memberships_jit", None),
         ]
         fns += [f for k, f in self._fns.items() if k[0] == "__sharded__"]
+        fns += list(_COARSE_PREDICT_FNS.values())
         total = 0
         for f in fns:
             size = getattr(f, "_cache_size", None)

@@ -495,6 +495,26 @@ class ServeApp:
             return (round(1.0 - asn["tiles_probed"] / asn["tiles_total"], 6)
                     if asn["tiles_total"] else 0.0)
 
+        def _pasn():
+            from tdc_tpu.ops.subk import GLOBAL_PREDICT
+
+            return GLOBAL_PREDICT.snapshot()
+
+        def _ppruned():
+            asn = _pasn()
+            return (round(1.0 - asn["tiles_probed"] / asn["tiles_total"], 6)
+                    if asn["tiles_total"] else 0.0)
+
+        def _bnd():
+            from tdc_tpu.ops.bounds import GLOBAL_BOUNDS
+
+            return GLOBAL_BOUNDS.snapshot()
+
+        def _bpruned():
+            b = _bnd()
+            return (round(1.0 - b["dist_evals"] / b["dist_evals_exact"], 6)
+                    if b["dist_evals_exact"] else 0.0)
+
         scalars += [
             ("tdc_comms_stats_reduces_total",
              lambda: _comms()["reduces"]),
@@ -518,6 +538,15 @@ class ServeApp:
              lambda: _asn()["tiles_probed"]),
             ("tdc_assign_tiles_total", lambda: _asn()["tiles_total"]),
             ("tdc_assign_pruned_fraction", _pruned),
+            ("tdc_predict_tiles_probed_total",
+             lambda: _pasn()["tiles_probed"]),
+            ("tdc_predict_tiles_total", lambda: _pasn()["tiles_total"]),
+            ("tdc_predict_pruned_fraction", _ppruned),
+            ("tdc_bounds_dist_evals_total",
+             lambda: _bnd()["dist_evals"]),
+            ("tdc_bounds_dist_evals_exact_total",
+             lambda: _bnd()["dist_evals_exact"]),
+            ("tdc_bounds_pruned_fraction", _bpruned),
         ]
         for name, fn in scalars:
             reg.callback(name, fn)

@@ -55,6 +55,10 @@ Instrumented points (grep fault_point for the live list):
     reshard.redistribute    restoring state saved under a different layout
     assign.refine           each coarse-assignment tile-pruned refine step
                             (ops/subk.py via the streamed kmeans drivers)
+    assign.bounds_recompute before a bounded fit hands its per-point
+                            Elkan/Hamerly bounds carry to the compiled
+                            resident loop (ops/bounds.py init; the
+                            masked recompute itself runs in-trace)
     online.fold             before folding a window of sampled traffic
     online.validate         before shadow-validating a fold candidate
     online.swap             between staged arrays and the manifest swap
@@ -93,6 +97,7 @@ KNOWN_POINTS = frozenset({
     "resident.chunk",
     "reshard.redistribute",
     "assign.refine",
+    "assign.bounds_recompute",
     "online.fold",
     "online.validate",
     "online.swap",

@@ -274,6 +274,82 @@ def _resident_cache():
     return _cache["rcache"]
 
 
+def _resident_cache_nomesh():
+    """Mesh-free 3-batch cache for the single-device bounded chunk (the
+    1-D bounded driver is mesh-free by contract)."""
+    if "rcache0" not in _cache:
+        from tdc_tpu.data.device_cache import DeviceCacheBuilder
+        from tdc_tpu.models.streaming import _prepare_batch
+
+        b = DeviceCacheBuilder(3)
+        for j in range(3):
+            xb, nv, _ = _prepare_batch(_rows(0, _ROWS, _D1) + j, None)
+            b.add(xb, nv)
+        _cache["rcache0"] = b.finish()
+    return _cache["rcache0"]
+
+
+def _build_bounded_chunk(kind: str):
+    """The 1-D bounded resident chunk: per-point bounds carry donated
+    alongside the centroids; single-device, so the pinned property is an
+    EMPTY explicit collective schedule — bounds prune FLOPs, never
+    collectives."""
+
+    def build():
+        import jax.numpy as jnp
+
+        from tdc_tpu.models import resident as resident_lib
+        from tdc_tpu.models.streaming import _resident_lloyd_fns
+        from tdc_tpu.ops import bounds as bounds_lib
+        from tdc_tpu.ops import subk as subk_lib
+
+        bspec = bounds_lib.BoundsSpec(kind=kind, **(
+            {"n_tiles": 2, "tile_size": _K1 // 2} if kind == "elkan" else {}
+        ))
+        (chunk, _), cache = (
+            _resident_lloyd_fns(None, _K1, _D1, False, "xla", None, False,
+                                False, 1e-6, 4, subk_lib.EXACT, bspec),
+            _resident_cache_nomesh(),
+        )
+
+        def fresh(i):
+            c = jnp.asarray(_centroids(i, _K1, _D1))
+            aux = bounds_lib.init_state(cache, c, bspec)
+            cap = resident_lib.place_scalar(4, None)
+            return (c, aux, cap, cache)
+
+        return Built(chunk, chunk, fresh)
+
+    return build
+
+
+def _build_sharded_bounded_stats():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tdc_tpu.parallel import sharded_k as sk
+
+        mesh = _mesh2d()
+        fn = sk.make_sharded_bounded_stats(mesh)
+        jit_fn = jax.jit(fn)
+
+        def fresh(i):
+            x = jnp.asarray(_rows(i, _ROWS, _D2))
+            c = jax.device_put(
+                jnp.asarray(_centroids(i, _K2, _D2)),
+                NamedSharding(mesh, P(sk.MODEL_AXIS, None)),
+            )
+            st = sk.init_sharded_bounds(mesh, _ROWS, _centroids(i, _K2,
+                                                                _D2))
+            return (x, c, st.prev_c, st.lab, st.lb)
+
+        return Built(fn, jit_fn, fresh)
+
+    return build
+
+
 def _resident_fns(model: str, deferred: bool, quantize, coarse: bool = False):
     mesh = _mesh1()
     if model == "fuzzy":
@@ -665,6 +741,22 @@ def entries() -> list[VerifyEntry]:
             build=_build_resident("fuzzy", True, None),
             donated_leaves=1,
         ),
+        VerifyEntry(
+            id="kmeans_1d.hbm.bounded.chunk",
+            build=_build_bounded_chunk("hamerly"),
+            donated_leaves=8,
+            notes="centroids + the 7-leaf Hamerly bounds carry donated "
+                  "(no upper-bound leaf: the pass always tightens); "
+                  "single-device — empty explicit schedule is the pinned "
+                  "property (bounds prune FLOPs, never collectives)",
+        ),
+        VerifyEntry(
+            id="kmeans_1d.hbm.bounded_elkan.chunk",
+            build=_build_bounded_chunk("elkan"),
+            donated_leaves=11,
+            same_schedule_as="kmeans_1d.hbm.bounded.chunk",
+            notes="adds the per-tile bounds + fixed tile ids to the carry",
+        ),
         # ---- K-sharded towers ----------------------------------------
         VerifyEntry(
             id="sharded_k.kmeans.per_batch.exact",
@@ -676,6 +768,13 @@ def entries() -> list[VerifyEntry]:
             build=_build_sharded_stats(coarse=True, reduce_data=True),
             same_schedule_as="sharded_k.kmeans.per_batch.exact",
             notes="assignment-mode independence: byte-identical schedule",
+        ),
+        VerifyEntry(
+            id="sharded_k.kmeans.per_batch.bounded",
+            build=_build_sharded_bounded_stats(),
+            same_schedule_as="sharded_k.kmeans.per_batch.exact",
+            notes="zero-loss bounded tower: per-shard bound maintenance "
+                  "adds NO collectives — byte-identical schedule to exact",
         ),
         VerifyEntry(
             id="sharded_k.kmeans.per_pass.acc",

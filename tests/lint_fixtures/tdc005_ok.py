@@ -18,6 +18,7 @@ KNOWN_POINTS = frozenset({
     "data.read.permanent",
     "data.corrupt",
     "assign.refine",
+    "assign.bounds_recompute",
 })
 
 
@@ -50,6 +51,10 @@ def guarded_read():
 
 def pruned_refine_step():
     fault_point("assign.refine")
+
+
+def bounded_handoff():
+    fault_point("assign.bounds_recompute")
 
 
 def integrity_screen():

@@ -67,6 +67,37 @@ def test_cli_end_to_end_fuzzy(tmp_path):
     assert row["status"] == "ok"
 
 
+def test_cli_bounded_assign_end_to_end(tmp_path):
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=4096 --n_dim=8 --K=32 --n_max_iters=4 --seed=1 "
+        f"--streamed --num_batches=4 --assign=bounded --residency=hbm "
+        f"--log_file={log} --n_GPUs=1".split()
+    )
+    assert rc == 0
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["status"] == "ok"
+
+
+@pytest.mark.parametrize("argstr,msg", [
+    ("--n_obs=100 --n_dim=4 --K=8 --streamed --assign=bounded",
+     "--residency"),
+    ("--n_obs=100 --n_dim=4 --K=8 --streamed --assign=bounded "
+     "--residency=hbm --spherical", "--spherical"),
+    ("--n_obs=100 --n_dim=4 --K=8 --streamed --assign=bounded "
+     "--residency=hbm --probe=4", "--assign coarse|auto"),
+    ("--n_obs=100 --n_dim=4 --K=8 --streamed --bounds=elkan", "--assign"),
+    ("--n_obs=100 --n_dim=4 --K=8 --streamed --assign=bounded "
+     "--residency=hbm --bounds=elkan --shard_k=2", "1-D only"),
+])
+def test_cli_bounded_knob_validation(argstr, msg, capsys):
+    p = build_parser()
+    args = p.parse_args(argstr.split())
+    with pytest.raises(SystemExit):
+        validate_args(p, args)
+    assert msg in capsys.readouterr().err
+
+
 def test_cli_coarse_assign_end_to_end(tmp_path):
     log = str(tmp_path / "log.csv")
     rc = cli_main(

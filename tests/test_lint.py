@@ -506,6 +506,8 @@ def test_fault_points_match_registry():
         "reshard.redistribute",
         # PR-11 sub-linear assignment (ops/subk.py refine steps)
         "assign.refine",
+        # PR-14 bounded assignment (ops/bounds.py carry handoff)
+        "assign.bounds_recompute",
         # PR-7 online-update pipeline (serve/online.py)
         "online.fold", "online.validate", "online.swap", "online.rollback",
         # PR-10 hardened ingest (data/ingest.py)

@@ -690,3 +690,128 @@ class TestDrainAndReadiness:
         # liveness stays 200 through the drain
         assert urllib.request.urlopen(url + "/healthz").status == 200
         app.stop()
+
+
+class TestCoarsePredictPlanLifecycle:
+    """ISSUE-14: the compiled coarse-predict route (serve/engine.py) —
+    plan built once per (model, generation) from the served codebook,
+    invalidated by the hot-reload atomic swap, evicted under the LRU
+    budget, probe='all' bit-exact with the exact route."""
+
+    def _codebook(self, k=512, d=16, seed=0):
+        rng = np.random.default_rng(seed)
+        n_super = max(1, k // 64)
+        supers = rng.uniform(-10, 10, size=(n_super, d)).astype(np.float32)
+        cents = (np.repeat(supers, k // n_super, axis=0)
+                 + rng.normal(0, 1.0, size=(k, d))).astype(np.float32)
+        x = (cents[rng.integers(0, k, 200)]
+             + rng.normal(0, 0.05, size=(200, d))).astype(np.float32)
+        return cents, x
+
+    def _save(self, path, cents, **params):
+        save_fitted(str(path), model="kmeans",
+                    arrays={"centroids": cents}, params=params)
+
+    def test_route_and_probe_all_bitexact(self, tmp_path):
+        cents, x = self._codebook()
+        self._save(tmp_path / "c", cents, assign="coarse", probe=4)
+        self._save(tmp_path / "a", cents, assign="coarse", probe="all")
+        self._save(tmp_path / "e", cents)
+        reg = ModelRegistry()
+        eng = PredictEngine()
+        ec = reg.add("c", str(tmp_path / "c"))
+        ea = reg.add("a", str(tmp_path / "a"))
+        ee = reg.add("e", str(tmp_path / "e"))
+        out_c, meta_c = eng.run(ec, "predict", x)
+        out_a, meta_a = eng.run(ea, "predict", x)
+        out_e, meta_e = eng.run(ee, "predict", x)
+        assert meta_c["kernel"] == "coarse"
+        # probe="all" resolves to the exact route — bit-exact by
+        # construction, and no plan is ever built for it.
+        assert meta_a["kernel"] != "coarse"
+        np.testing.assert_array_equal(out_a, out_e)
+        assert ("c", ec.generation) in eng._plans
+        assert ("a", ea.generation) not in eng._plans
+        # The coarse labels are high-quality on the clustered codebook.
+        assert float(np.mean(out_c == out_e)) > 0.95
+        # transform/predict_proba stay exact (all-K by definition).
+        _, meta_t = eng.run(ec, "transform", x)
+        assert meta_t["kernel"] != "coarse"
+
+    def test_predict_counter_books_tiles(self, tmp_path):
+        from tdc_tpu.ops.subk import GLOBAL_PREDICT
+
+        cents, x = self._codebook(seed=1)
+        self._save(tmp_path / "m", cents, assign="coarse", probe=4)
+        reg = ModelRegistry()
+        eng = PredictEngine()
+        before = GLOBAL_PREDICT.snapshot()
+        eng.run(reg.add("m", str(tmp_path / "m")), "predict", x)
+        after = GLOBAL_PREDICT.snapshot()
+        assert after["tiles_total"] > before["tiles_total"]
+        assert after["tiles_probed"] > before["tiles_probed"]
+        assert (after["tiles_probed"] - before["tiles_probed"]
+                < after["tiles_total"] - before["tiles_total"])
+
+    def test_plan_built_once_then_cached(self, tmp_path):
+        cents, x = self._codebook(seed=2)
+        self._save(tmp_path / "m", cents, assign="coarse", probe=4)
+        reg = ModelRegistry()
+        eng = PredictEngine()
+        entry = reg.add("m", str(tmp_path / "m"))
+        eng.run(entry, "predict", x)
+        plan1 = eng._plans[("m", entry.generation)][1]
+        eng.run(entry, "predict", x)
+        assert eng._plans[("m", entry.generation)][1] is plan1
+
+    def test_hot_swap_invalidates_plan(self, tmp_path):
+        cents, x = self._codebook(seed=3)
+        self._save(tmp_path / "m", cents, assign="coarse", probe=4)
+        reg = ModelRegistry()
+        eng = PredictEngine()
+        e1 = reg.add("m", str(tmp_path / "m"))
+        eng.run(e1, "predict", x)
+        assert ("m", e1.generation) in eng._plans
+        # Atomic republish (new arrays -> new generation on poll).
+        self._save(tmp_path / "m", cents + 0.25, assign="coarse", probe=4)
+        assert reg.poll_once() == ["m"]
+        e2 = reg.get("m")
+        assert e2.generation == e1.generation + 1
+        eng.run(e2, "predict", x)
+        assert ("m", e1.generation) not in eng._plans
+        assert ("m", e2.generation) in eng._plans
+
+    def test_lru_budget_evicts_oldest_used(self, tmp_path):
+        cents, x = self._codebook(seed=4)
+        reg = ModelRegistry()
+        eng = PredictEngine(plan_budget=2)
+        entries = {}
+        for mid in ("m1", "m2", "m3"):
+            self._save(tmp_path / mid, cents, assign="coarse", probe=4)
+            entries[mid] = reg.add(mid, str(tmp_path / mid))
+        eng.run(entries["m1"], "predict", x)
+        eng.run(entries["m2"], "predict", x)
+        eng.run(entries["m1"], "predict", x)  # refresh m1's recency
+        eng.run(entries["m3"], "predict", x)  # evicts m2 (LRU), not m1
+        keys = {k[0] for k in eng._plans}
+        assert keys == {"m1", "m3"}
+        assert len(eng._plans) == 2
+
+    def test_plan_budget_validated(self):
+        with pytest.raises(ValueError, match="plan_budget"):
+            PredictEngine(plan_budget=0)
+
+    def test_predict_metrics_on_scrape(self, tmp_path):
+        cents, x = self._codebook(seed=5)
+        self._save(tmp_path / "m", cents, assign="coarse", probe=4)
+        app = ServeApp(poll_interval=0)
+        app.registry.add("m", str(tmp_path / "m"))
+        app.engine.run(app.registry.get("m"), "predict", x)
+        text = app.metrics_text()
+        for fam in ("tdc_predict_tiles_probed_total",
+                    "tdc_predict_tiles_total",
+                    "tdc_predict_pruned_fraction",
+                    "tdc_bounds_dist_evals_total",
+                    "tdc_bounds_dist_evals_exact_total",
+                    "tdc_bounds_pruned_fraction"):
+            assert f"# TYPE {fam} " in text
