@@ -1,9 +1,17 @@
 """Serving-stack latency/throughput benchmark (tdc_tpu.serve).
 
-Closed-loop concurrent clients drive the in-process micro-batching stack
-(registry -> batcher -> engine); per-request e2e latency and the
-coalescing achieved are reported per (model, concurrency) cell, plus a
-single-request no-batching baseline.
+Closed-loop concurrent clients drive the in-process serving path
+(ServeApp.request -> batcher -> engine); coalescing and throughput are
+reported per (model, concurrency) cell.
+
+Percentiles are SCRAPE-DERIVED (PR 15): each cell scrapes /metrics
+before and after, and p50/p90/p99 come from the cell's
+`tdc_serve_latency_ms{endpoint,model}` bucket delta through
+`obs.metrics.quantile_from_buckets` — the same path `bench_load.py`
+and any Prometheus stack use, so the two harnesses cannot report from
+different definitions of latency. The client-side stopwatch window is
+kept only as the `client p50/p99` cross-check column (it must bracket
+the scrape numbers; a disagreement means the scrape is lying).
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python benchmarks/serve_latency.py --out benchmarks/serve_latency.md
@@ -15,32 +23,44 @@ of the serving acceptance shape; re-run on TPU for production numbers.
 from __future__ import annotations
 
 import argparse
-import asyncio
 import os
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tdc_tpu.obs.metrics import scrape_quantile  # noqa: E402
 
-def _percentiles(ms: list[float]) -> dict:
+
+def _client_window(ms: list[float]) -> dict:
+    """Client-side stopwatch percentiles — the CROSS-CHECK column only;
+    the reported p50/p90/p99 come from the /metrics scrape."""
+    if not ms:  # every request rejected: nothing to cross-check
+        return {"client_p50": float("nan"), "client_p99": float("nan")}
     arr = np.asarray(ms)
     return {
-        "p50": float(np.percentile(arr, 50)),
-        "p90": float(np.percentile(arr, 90)),
-        "p99": float(np.percentile(arr, 99)),
-        "mean": float(arr.mean()),
+        "client_p50": float(np.percentile(arr, 50)),
+        "client_p99": float(np.percentile(arr, 99)),
     }
 
 
-async def _client(app, model_id, method, queries, latencies):
+def _client(app, model_id, method, queries, latencies, failures):
     for q in queries:
         t0 = time.perf_counter()
-        await app.batcher.submit(model_id, method, q)
-        latencies.append((time.perf_counter() - t0) * 1e3)
+        status, _ = app.request(
+            method, {"model": model_id, "points": q.tolist()}
+        )
+        if status == 200:
+            # Only 200s: the scrape's latency histogram observes only
+            # successes, and a fast 503 round-trip in the window would
+            # falsely drag the cross-check below the scrape numbers.
+            latencies.append((time.perf_counter() - t0) * 1e3)
+        else:
+            failures.append(status)
 
 
 def bench_cell(app, model_id, method, d, *, clients, requests_per_client,
@@ -49,22 +69,28 @@ def bench_cell(app, model_id, method, d, *, clients, requests_per_client,
     e0 = dict(app.engine.stats)
     b0 = dict(app.batcher.stats)
     latencies: list[float] = []
+    failures: list[int] = []
+    before = app.metrics_text()
 
-    async def run():
-        tasks = []
-        for _ in range(clients):
-            queries = [
-                rng.normal(size=(int(rng.choice(sizes)), d)).astype(
-                    np.float32
-                )
-                for _ in range(requests_per_client)
-            ]
-            tasks.append(_client(app, model_id, method, queries, latencies))
-        t0 = time.perf_counter()
-        await asyncio.gather(*tasks)
-        return time.perf_counter() - t0
+    threads = []
+    for _ in range(clients):
+        queries = [
+            rng.normal(size=(int(rng.choice(sizes)), d)).astype(np.float32)
+            for _ in range(requests_per_client)
+        ]
+        threads.append(threading.Thread(
+            target=_client,
+            args=(app, model_id, method, queries, latencies, failures),
+        ))
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    after = app.metrics_text()
 
-    wall = asyncio.run_coroutine_threadsafe(run(), app._loop).result()
+    match = {"endpoint": method, "model": model_id}
     n_req = clients * requests_per_client
     rows = app.engine.stats["rows"] - e0["rows"]
     batches = app.batcher.stats["batches"] - b0["batches"]
@@ -73,12 +99,19 @@ def bench_cell(app, model_id, method, d, *, clients, requests_per_client,
         "method": method,
         "clients": clients,
         "requests": n_req,
+        "failures": len(failures),
         "batches": batches,
         "coalesce": n_req / max(batches, 1),
         "rows_per_s": rows / wall,
         "req_per_s": n_req / wall,
         "compiles": app.engine.stats["compiles"] - e0["compiles"],
-        **_percentiles(latencies),
+        "p50": scrape_quantile(after, "tdc_serve_latency_ms", 0.50,
+                               match, baseline=before),
+        "p90": scrape_quantile(after, "tdc_serve_latency_ms", 0.90,
+                               match, baseline=before),
+        "p99": scrape_quantile(after, "tdc_serve_latency_ms", 0.99,
+                               match, baseline=before),
+        **_client_window(latencies),
     }
 
 
@@ -226,24 +259,32 @@ def main(argv=None) -> int:
         f"K-Means K={args.k} d={args.d}, GMM K={min(args.k, 32)} diag; "
         f"micro-batch max_wait={args.max_wait_ms} ms, closed-loop "
         f"clients x {args.requests_per_client} requests each, odd request "
-        "sizes 1-27 rows.",
+        "sizes 1-27 rows. p50/p90/p99 are scrape-derived "
+        "(`tdc_serve_latency_ms` bucket deltas via "
+        "`quantile_from_buckets`); `client p50/p99` is the client-side "
+        "stopwatch cross-check.",
         "",
-        "| model | method | clients | p50 ms | p90 ms | p99 ms | req/s |"
-        " rows/s | coalesce | recompiles |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "| model | method | clients | p50 ms | p90 ms | p99 ms | client "
+        "p50/p99 | req/s | rows/s | coalesce | recompiles | non-200 |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for c in cells:
         lines.append(
             f"| {c['model']} | {c['method']} | {c['clients']} "
             f"| {c['p50']:.2f} | {c['p90']:.2f} | {c['p99']:.2f} "
+            f"| {c['client_p50']:.2f}/{c['client_p99']:.2f} "
             f"| {c['req_per_s']:.0f} | {c['rows_per_s']:.0f} "
-            f"| {c['coalesce']:.1f}x | {c['compiles']} |"
+            f"| {c['coalesce']:.1f}x | {c['compiles']} "
+            f"| {c['failures']} |"
         )
     lines += [
         "",
         "`coalesce` = requests per device batch; `recompiles` counts new "
         "engine cache keys during the cell (0 after bucket warmup = the "
-        "bucketed-padding invariant held).",
+        "bucketed-padding invariant held). Scrape-derived percentiles "
+        "are bucket-interpolated, so they can sit slightly above the "
+        "exact client stopwatch — the cross-check is that the client "
+        "window lands inside the same bucket, not equality.",
         "",
     ]
     text = "\n".join(lines)

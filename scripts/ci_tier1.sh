@@ -1,16 +1,17 @@
 #!/usr/bin/env bash
 # Tier-1 verify, one command (ROADMAP.md "Tier-1 verify"): the CPU-mesh
 # test suite (8 virtual devices via tests/conftest.py) minus slow-marked
-# tests, the comms + resident + spill + subk + bounds + obs + chaos
-# smokes, the tdcverify IR-audit stage, and the tdclint static-analysis
-# gate. The suite-green invariant every PR must hold.
+# tests, the comms + resident + spill + subk + bounds + load + obs +
+# chaos smokes, the tdcverify IR-audit stage, and the tdclint
+# static-analysis gate. The suite-green invariant every PR must hold.
 #
 #   scripts/ci_tier1.sh            # tests + smokes + verify + lint
 #   SKIP_LINT=1 scripts/ci_tier1.sh
 #
 # Exit code: the FIRST failing stage's code (pytest, then comms smoke,
 # then resident smoke, then spill smoke, then subk smoke, then bounds
-# smoke, then obs smoke, then verify, then chaos smoke, then lint), with
+# smoke, then load smoke, then obs smoke, then verify, then chaos
+# smoke, then lint), with
 # every failed stage named on stderr — a run where pytest passes but
 # both smokes fail must say so, not silently collapse into one opaque
 # code.
@@ -96,6 +97,23 @@ if [ -z "$SKIP_BOUNDS_SMOKE" ]; then
         | tail -n 1 || bounds_rc=$?
 fi
 
+# Load smoke (benchmarks/bench_load.py --smoke): the overload contract,
+# measured. Calibrates saturation with the open-loop generator, spikes
+# offered load to 2x that measurement, and asserts: accepted-request
+# p999 (scrape-derived) stays under the stated 2000 ms bound, the
+# admission governor sheds (nonzero tdc_serve_shed_total, scrape count
+# == client-counted shed 503s), sheds stay fair to the background
+# tenant, zero requests hang, and after the spike the governor exits
+# shedding with a clean post-window. Measured ~27 s on the CI box
+# (calibration ramp + 9 s spike cell + post cell); 300 is ~11x headroom
+# for a loaded box without masking a hang.
+load_rc=0
+if [ -z "$SKIP_LOAD_SMOKE" ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python benchmarks/bench_load.py --smoke \
+        | tail -n 1 || load_rc=$?
+fi
+
 # Observability smoke (scripts/obs_smoke.py): a tiny traced 2-process
 # gloo-gang streamed fit must export valid Chrome-trace JSON per process
 # (spans nested, per-pass read/stage/compute/reduce phases present) and
@@ -173,7 +191,7 @@ overall=0
 for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
              "resident-smoke:$resident_rc" "spill-smoke:$spill_rc" \
              "subk-smoke:$subk_rc" "bounds-smoke:$bounds_rc" \
-             "obs-smoke:$obs_rc" \
+             "load-smoke:$load_rc" "obs-smoke:$obs_rc" \
              "verify:$verify_rc" "chaos-smoke:$chaos_rc" \
              "tdclint:$lint_rc" "ruff:$ruff_rc"; do
     name=${stage%%:*}
@@ -184,6 +202,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, bounds-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, bounds-smoke, load-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
 fi
 exit "$overall"

@@ -53,6 +53,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--poll_interval", type=float, default=2.0,
                    help="hot-reload manifest poll period in seconds "
                         "(0 disables)")
+    # Admission governor (serve/governor.py): readiness-based shedding
+    # from measured signals, BEFORE work is queued. docs/OPERATIONS.md
+    # "Overload triage" is the tuning runbook.
+    p.add_argument("--shed", type=str, default="on",
+                   choices=("on", "off"),
+                   help="admission governor: 'off' = admit everything "
+                        "and let queue backpressure be the only limit "
+                        "(A/B the ungoverned overload behavior)")
+    p.add_argument("--shed_queue_high", type=float, default=0.75,
+                   help="queued-rows fraction of --max_queue_rows that "
+                        "ENTERS shedding")
+    p.add_argument("--shed_queue_low", type=float, default=0.35,
+                   help="queued-rows fraction that (with the other "
+                        "signals) EXITS shedding — hysteresis")
+    p.add_argument("--shed_p99_wait_ms", type=float, default=500.0,
+                   help="recent p99 queue wait (scrape-derived, off the "
+                        "tdc_serve_queue_wait_ms buckets) that enters "
+                        "shedding; 0 disables the signal")
+    p.add_argument("--shed_inflight_high", type=int, default=0,
+                   help="in-flight request count that enters shedding; "
+                        "0 disables the signal")
+    p.add_argument("--shed_min_hold_s", type=float, default=1.0,
+                   help="minimum shed duration before recovery is "
+                        "considered (flap damping)")
+    p.add_argument("--shed_retry_after_s", type=float, default=1.0,
+                   help="Retry-After advertised on shed 503s")
+    p.add_argument("--shed_fair_frac", type=float, default=0.5,
+                   help="per-model fair share of --max_queue_rows "
+                        "(x 1/models) still admitted mid-shed, so one "
+                        "flooded tenant cannot starve the rest")
     p.add_argument("--warmup_buckets", type=str, default="8,64,512",
                    help="comma-separated row buckets to pre-compile per "
                         "model ('' skips warmup)")
@@ -123,7 +153,12 @@ def make_app(args):
 
         enable_compile_cache(args.compile_cache_dir)
 
-    from tdc_tpu.serve import ModelRegistry, PredictEngine, ServeApp
+    from tdc_tpu.serve import (
+        GovernorConfig,
+        ModelRegistry,
+        PredictEngine,
+        ServeApp,
+    )
     from tdc_tpu.utils.structlog import RunLog
 
     log = RunLog(args.log_file)
@@ -152,6 +187,16 @@ def make_app(args):
         poll_interval=args.poll_interval,
         feed_dir=getattr(args, "feed_dir", None),
         feed_sample=getattr(args, "feed_sample", 1),
+        governor_config=GovernorConfig(
+            enabled=args.shed != "off",
+            queue_high_frac=args.shed_queue_high,
+            queue_low_frac=args.shed_queue_low,
+            p99_wait_high_ms=args.shed_p99_wait_ms,
+            inflight_high=args.shed_inflight_high,
+            min_shed_s=args.shed_min_hold_s,
+            retry_after_s=args.shed_retry_after_s,
+            fair_frac=args.shed_fair_frac,
+        ),
     )
     return app, log
 

@@ -1,7 +1,9 @@
 """Unified telemetry layer (PR 12): span tracing with gang-merged
-timelines (`obs/trace.py`, `python -m tdc_tpu.obs.merge_trace`) and the
+timelines (`obs/trace.py`, `python -m tdc_tpu.obs.merge_trace`), the
 central metrics registry every `tdc_*` Prometheus series renders through
-(`obs/metrics.py`).
+(`obs/metrics.py`, incl. the scrape-derived quantile helpers), and the
+open-loop load generator that drives the serving tier to measured
+saturation (`obs/loadgen.py`, PR 15).
 
 Everything here is stdlib-only at import time (jax is imported lazily,
 only when a hard sync is actually requested), so the hot-path guards —
@@ -11,7 +13,7 @@ rendered — cost a flag check, not an import.
 
 from __future__ import annotations
 
-_LAZY = ("metrics", "trace")
+_LAZY = ("loadgen", "metrics", "trace")
 
 
 def __getattr__(name):

@@ -1,0 +1,331 @@
+"""OPEN-loop load generation for the serving tier (the SLO observatory's
+traffic half; `benchmarks/bench_load.py` is the harness around it).
+
+`benchmarks/serve_latency.py`'s closed-loop clients wait for each
+response before sending the next request — under overload they slow down
+WITH the server, so offered load self-throttles to capacity and the tail
+the SLO cares about is never generated (coordinated omission). This
+module is the other discipline: requests fire on a PRECOMPUTED Poisson
+arrival schedule regardless of completion, so offered load is an input,
+not an emergent property, and driving the schedule past capacity is how
+the overload path gets measured instead of assumed.
+
+Three pieces:
+
+- **Shape programs** (`make_shape`): offered-RPS-over-time profiles —
+  `constant`, `step` (capacity-planning ramp), `spike` (the 2x-overload
+  contract cell + recovery), `diurnal` (the traffic claim's daily
+  curve). The schedule is drawn once up front (`poisson_schedule`) with
+  a seeded RNG: deterministic, and provably independent of how the
+  target responds.
+- **Targets**: `InprocTarget` drives a `ServeApp.request()` directly
+  (the CPU-CI path — same admission/batching/engine code as HTTP,
+  minus the socket); `HttpTarget` drives a live endpoint. Both expose
+  `scrape()` because the report's percentiles come from `/metrics`
+  bucket deltas (`obs.metrics.scrape_quantile`), NOT from the client's
+  own stopwatch — the harness proves the scrape is sufficient for SLO
+  monitoring. The client-side window is kept only as a cross-check.
+- **`run_open_loop`**: fires the schedule from a thread pool, classifies
+  every outcome (ok / shed / backpressure / drain / error), counts late
+  fires (scheduler fell behind — the open-loop guarantee degrading,
+  reported rather than hidden) and HUNG requests (fired but unresolved
+  past the deadline — the zero-hang contract's denominator).
+
+Stdlib-only, like the rest of `obs/`: points are plain nested lists, so
+no numpy/jax import is needed to generate traffic.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import math
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+
+# ---------------------------------------------------------------------------
+# Shape programs
+# ---------------------------------------------------------------------------
+
+
+def make_shape(kind: str, *, base_rps: float, peak_rps: float | None = None,
+               duration_s: float, at_s: float | None = None,
+               len_s: float | None = None, period_s: float | None = None):
+    """rps(t) callable over [0, duration_s).
+
+    - constant: base_rps throughout
+    - step:     base_rps until `at_s` (default duration/3), then peak_rps
+    - spike:    base_rps except [at_s, at_s + len_s) at peak_rps
+                (defaults: middle third) — the recovery window after the
+                spike is part of the program, not a separate run
+    - diurnal:  sinusoid from base_rps up to peak_rps and back, period
+                `period_s` (default = duration_s: one "day" per run)
+    """
+    if kind not in ("constant", "step", "spike", "diurnal"):
+        raise ValueError(f"unknown shape {kind!r}; "
+                         "have constant|step|spike|diurnal")
+    if base_rps <= 0:
+        raise ValueError(f"base_rps={base_rps} must be > 0")
+    if kind == "constant":
+        return lambda t: base_rps
+    if peak_rps is None:
+        raise ValueError(f"shape {kind!r} needs peak_rps")
+    if kind == "step":
+        t_step = duration_s / 3.0 if at_s is None else at_s
+        return lambda t: base_rps if t < t_step else peak_rps
+    if kind == "spike":
+        t0 = duration_s / 3.0 if at_s is None else at_s
+        t1 = t0 + (duration_s / 3.0 if len_s is None else len_s)
+        return lambda t: peak_rps if t0 <= t < t1 else base_rps
+    period = duration_s if period_s is None else period_s
+    amp = (peak_rps - base_rps) / 2.0
+    mid = base_rps + amp
+    return lambda t: mid - amp * math.cos(2.0 * math.pi * t / period)
+
+
+def poisson_schedule(rps_fn, duration_s: float, *, seed: int = 0,
+                     max_arrivals: int = 1_000_000) -> list[float]:
+    """Arrival times in [0, duration_s) from a piecewise-evaluated Poisson
+    process with instantaneous rate rps_fn(t). Drawn entirely up front
+    from a seeded RNG: the schedule cannot react to the target (the
+    open-loop property, by construction)."""
+    rng = random.Random(seed)
+    out: list[float] = []
+    t = 0.0
+    while t < duration_s and len(out) < max_arrivals:
+        rate = max(float(rps_fn(t)), 1e-9)
+        t += rng.expovariate(rate)
+        if t < duration_s:
+            out.append(t)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Targets
+# ---------------------------------------------------------------------------
+
+
+class InprocTarget:
+    """Drive a started ServeApp in-process: same admission governor,
+    batcher, and engine as the HTTP path, minus the socket."""
+
+    def __init__(self, app, endpoint: str = "predict"):
+        self.app = app
+        self.endpoint = endpoint
+
+    def __call__(self, model_id: str, points) -> tuple[int, str]:
+        status, body = self.app.request(
+            self.endpoint, {"model": model_id, "points": points}
+        )
+        return status, _classify(status, body)
+
+    def scrape(self) -> str:
+        return self.app.metrics_text()
+
+
+class HttpTarget:
+    """Drive a live serve endpoint over HTTP (base_url has no trailing
+    path; scrape() reads GET /metrics)."""
+
+    def __init__(self, base_url: str, endpoint: str = "predict",
+                 timeout: float = 35.0):
+        self.base_url = base_url.rstrip("/")
+        self.endpoint = endpoint
+        self.timeout = timeout
+
+    def __call__(self, model_id: str, points) -> tuple[int, str]:
+        req = urllib.request.Request(
+            f"{self.base_url}/{self.endpoint}",
+            data=json.dumps({"model": model_id, "points": points}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, "ok"
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read())
+            except ValueError:
+                body = {}
+            if not isinstance(body, dict):  # proxy/string error bodies
+                body = {}
+            return e.code, _classify(e.code, body)
+        except OSError:
+            return 599, "error"
+
+    def scrape(self) -> str:
+        with urllib.request.urlopen(
+            f"{self.base_url}/metrics", timeout=self.timeout
+        ) as r:
+            return r.read().decode()
+
+
+def _classify(status: int, body: dict) -> str:
+    """Outcome class off the response's explicit `reason` field (PR 15
+    disambiguated the 503s; "overloaded"/"draining" errors without a
+    reason are pre-PR-15 payload shapes)."""
+    if status == 200:
+        return "ok"
+    reason = body.get("reason")
+    if reason in ("shed", "backpressure", "drain"):
+        return reason
+    if body.get("error") == "draining":
+        return "drain"
+    if body.get("error") == "overloaded":
+        return "backpressure"
+    return "error"
+
+
+# ---------------------------------------------------------------------------
+# The open-loop driver
+# ---------------------------------------------------------------------------
+
+_OUTCOME_KEYS = ("ok", "shed", "backpressure", "drain", "error")
+
+
+@dataclass
+class LoadReport:
+    """One open-loop run's accounting. `offered` counts the schedule,
+    `fired` what was actually launched (== offered unless the run was
+    cancelled), `hung` requests that never resolved within the deadline
+    — the zero-hang contract counts them directly. `client_ms` is the
+    client-side latency window for CROSS-CHECKING the scrape-derived
+    percentiles, never for reporting them."""
+
+    offered: int = 0
+    fired: int = 0
+    completed: int = 0
+    hung: int = 0
+    late_fires: int = 0
+    duration_s: float = 0.0
+    counts: dict = field(default_factory=lambda: dict.fromkeys(
+        _OUTCOME_KEYS, 0))
+    by_model: dict = field(default_factory=dict)  # model -> outcome counts
+    client_ms: list = field(default_factory=list)  # ok requests only
+
+    @property
+    def offered_rps(self) -> float:
+        return self.offered / self.duration_s if self.duration_s else 0.0
+
+    @property
+    def goodput_rps(self) -> float:
+        return (self.counts["ok"] / self.duration_s
+                if self.duration_s else 0.0)
+
+    def client_percentile(self, q: float) -> float:
+        """Cross-check percentile from the client-side window (nearest-
+        rank). NaN when no request succeeded."""
+        if not self.client_ms:
+            return float("nan")
+        xs = sorted(self.client_ms)
+        i = min(len(xs) - 1, max(0, math.ceil(q * len(xs)) - 1))
+        return xs[i]
+
+
+def gauss_points(rng: random.Random, rows: int, d: int) -> list[list[float]]:
+    """Plain-list standard-normal request payload (no numpy in obs/)."""
+    return [[rng.gauss(0.0, 1.0) for _ in range(d)] for _ in range(rows)]
+
+
+def run_open_loop(target, shape_fn, duration_s: float, *, d: int,
+                  model_mix: dict[str, float], seed: int = 0,
+                  rows_choices=(2, 4, 8, 16), max_workers: int = 256,
+                  late_slack_s: float = 0.05,
+                  hang_timeout_s: float = 60.0) -> LoadReport:
+    """Fire one open-loop schedule at `target` and account for every
+    request. `model_mix` maps model id -> weight (each arrival draws a
+    model independently — the multi-tenant mix is part of the schedule,
+    so a flooded tenant's arrivals never depend on a light tenant's
+    completions)."""
+    if not model_mix:
+        raise ValueError("model_mix must name at least one model")
+    arrivals = poisson_schedule(shape_fn, duration_s, seed=seed)
+    rng = random.Random(seed + 1)
+    models = list(model_mix)
+    weights = [float(model_mix[m]) for m in models]
+    plan = [
+        (t, rng.choices(models, weights)[0], rng.choice(list(rows_choices)))
+        for t in arrivals
+    ]
+
+    rep = LoadReport(offered=len(plan), duration_s=duration_s)
+    lock = threading.Lock()
+
+    # Payload RNGs are per-thread: random.Random is lock-protected but
+    # contended; thread-local instances keep the generator off the
+    # critical path without sacrificing determinism of the SCHEDULE
+    # (already drawn above).
+    tls = threading.local()
+
+    def rng_local() -> random.Random:
+        r = getattr(tls, "rng", None)
+        if r is None:
+            r = tls.rng = random.Random(
+                seed + 2 + threading.get_ident() % 9973
+            )
+        return r
+
+    closed = False  # set once the report is returned: late completions
+    # of requests already counted as HUNG are discarded, so the caller
+    # never sees the report mutate under it (or a request double-counted
+    # as both hung and ok).
+
+    def one(model_id: str, rows: int):
+        t0 = time.perf_counter()
+        try:
+            status, outcome = target(
+                model_id, gauss_points(rng_local(), rows, d))
+        except Exception:
+            # Account-for-every-request contract: a target that RAISES
+            # (transport bug, malformed response) is an "error" outcome,
+            # never a silently dropped future.
+            status, outcome = 599, "error"
+        ms = (time.perf_counter() - t0) * 1e3
+        with lock:
+            if closed:
+                return status
+            rep.completed += 1
+            rep.counts[outcome] = rep.counts.get(outcome, 0) + 1
+            per = rep.by_model.setdefault(
+                model_id, dict.fromkeys(_OUTCOME_KEYS, 0))
+            per[outcome] = per.get(outcome, 0) + 1
+            if outcome == "ok":
+                rep.client_ms.append(ms)
+        return status
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_workers)
+    futures = []
+    t_start = time.perf_counter()
+    try:
+        for t_due, model_id, rows in plan:
+            lag = (time.perf_counter() - t_start) - t_due
+            if lag < 0:
+                time.sleep(-lag)
+            elif lag > late_slack_s:
+                rep.late_fires += 1  # fired anyway: open loop never skips
+            futures.append(pool.submit(one, model_id, rows))
+            rep.fired += 1
+        done, not_done = concurrent.futures.wait(
+            futures, timeout=hang_timeout_s
+        )
+        with lock:
+            closed = True  # freeze the report before handing it back
+            rep.hung = len(not_done)
+    finally:
+        pool.shutdown(wait=False)
+    return rep
+
+
+__all__ = [
+    "HttpTarget",
+    "InprocTarget",
+    "LoadReport",
+    "gauss_points",
+    "make_shape",
+    "poisson_schedule",
+    "run_open_loop",
+]

@@ -67,6 +67,18 @@ CATALOG = {
     "tdc_serve_draining": (
         "gauge", "1 while the server is draining (rejecting new work, "
                  "flushing in-flight batches)."),
+    # admission governor / load shedding (serve/governor.py, PR 15)
+    "tdc_serve_shed_total": (
+        "counter", "Requests shed by the admission governor before any "
+                   "work was queued, by model and trigger reason."),
+    "tdc_serve_inflight": (
+        "gauge", "Predict-family requests currently in flight (admitted "
+                 "and not yet answered)."),
+    "tdc_serve_admission_state": (
+        "gauge", "Admission state: 0 admitting, 1 shedding, 2 draining."),
+    "tdc_serve_offered_rps": (
+        "gauge", "Offered request rate (admitted + shed) over the "
+                 "governor's last evaluation window."),
     # serve latency histograms (PR 12: real fixed-bucket histograms
     # replacing the recent-window quantile summary)
     "tdc_serve_latency_ms": (
@@ -378,6 +390,24 @@ class Histogram(_Metric):
     def observe(self, v):
         self._default().observe(v)
 
+    def aggregate(self) -> tuple[tuple[float, ...], list[int]]:
+        """(finite upper bounds, cumulative counts incl. +Inf) summed over
+        every labeled child — the same numbers a scrape of this family
+        would yield, for in-process consumers (the serve governor's
+        recent-p99 signal) that must see what the scrape sees."""
+        with self._lock:
+            children = list(self._children.values())
+        per_bucket = [0] * (len(self.buckets) + 1)
+        for child in children:
+            with child._lock:
+                for i, n in enumerate(child.counts):
+                    per_bucket[i] += n
+        cum, out = 0, []
+        for n in per_bucket:
+            cum += n
+            out.append(cum)
+        return self.buckets, out
+
 
 class _Callback:
     """Render-time value source: fn() -> scalar, or -> iterable of
@@ -506,6 +536,173 @@ class Registry:
         return "\n".join(lines) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# Scrape-derived quantiles. The load harness (obs/loadgen.py), the serving
+# benchmarks, and the admission governor all report percentiles through
+# quantile_from_buckets over histogram bucket counts — the SAME numbers a
+# Prometheus stack derives from the scrape — so the committed latency
+# curves prove the scrape is sufficient for SLO monitoring instead of
+# reporting from a private client-side window that production would not
+# have.
+# ---------------------------------------------------------------------------
+
+
+def quantile_from_buckets(q, uppers, cum_counts) -> float:
+    """The q-quantile (0 <= q <= 1) of a fixed-bucket histogram, from its
+    finite upper bounds and CUMULATIVE counts (last entry = the +Inf
+    bucket, i.e. the total count) — `histogram_quantile` semantics:
+    monotone linear interpolation within the bucket the rank lands in,
+    a rank landing in the +Inf bucket reports the highest finite bound
+    (the scrape cannot resolve beyond it), and an empty histogram is NaN.
+
+    Raises ValueError on malformed input (shape mismatch, decreasing
+    cumulative counts, q outside [0, 1]) rather than interpolating
+    garbage — a scrape delta that went backwards means a counter reset
+    mid-window and the window must be re-anchored, not averaged over.
+    """
+    uppers = [float(u) for u in uppers]
+    cum = [float(c) for c in cum_counts]
+    if not 0.0 <= float(q) <= 1.0:
+        raise ValueError(f"quantile q={q} outside [0, 1]")
+    if len(cum) != len(uppers) + 1:
+        raise ValueError(
+            f"{len(uppers)} finite buckets need {len(uppers) + 1} "
+            f"cumulative counts (incl. +Inf), got {len(cum)}"
+        )
+    if any(b < a for a, b in zip(cum, cum[1:])):
+        raise ValueError(f"cumulative counts not monotone: {cum}")
+    if any(c < 0 for c in cum):
+        raise ValueError(f"negative cumulative count: {cum}")
+    total = cum[-1]
+    if total == 0:
+        return float("nan")
+    rank = float(q) * total
+    i = 0
+    while cum[i] < rank:
+        i += 1
+    if i == len(uppers):  # the +Inf bucket
+        return uppers[-1] if uppers else float("nan")
+    lower = uppers[i - 1] if i > 0 else 0.0
+    prev = cum[i - 1] if i > 0 else 0.0
+    in_bucket = cum[i] - prev
+    if in_bucket <= 0:
+        return lower
+    return lower + (uppers[i] - lower) * (rank - prev) / in_bucket
+
+
+_SCRAPE_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(.*)\})?"
+    r" (-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|\+?Inf|NaN))$"
+)
+_SCRAPE_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label_value(v: str) -> str:
+    """Exact inverse of escape_label_value. A sequential scan, not
+    chained str.replace: replacing '\\n' before '\\\\' would corrupt a
+    literal backslash-then-n ('a\\nb' escapes to 'a\\\\nb', which must
+    unescape to backslash + 'n', not a newline)."""
+    out = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ('"', "\\"):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def parse_scrape(text):
+    """Prometheus text exposition -> list of (name, labels_dict, value)
+    sample rows — the inverse of Registry.render, so harnesses and tests
+    read percentiles/counters off the scrape exactly as a monitoring
+    stack would. Comment/HELP/TYPE lines are skipped; malformed sample
+    lines raise (a scrape this module rendered always parses)."""
+    out = []
+    for ln in text.splitlines():
+        if not ln.strip() or ln.startswith("#"):
+            continue
+        m = _SCRAPE_SAMPLE_RE.match(ln)
+        if m is None:
+            raise ValueError(f"unparseable scrape line: {ln!r}")
+        labels = {}
+        if m.group(2) is not None:
+            labels = {
+                k: _unescape_label_value(v)
+                for k, v in _SCRAPE_LABEL_RE.findall(m.group(2))
+            }
+        out.append((m.group(1), labels, float(m.group(3))))
+    return out
+
+
+def scrape_counter(text, family, match=None) -> float:
+    """Sum of a counter/gauge family's samples whose labels include every
+    (k, v) in `match` (None/{} = all series). 0.0 when nothing matches."""
+    match = match or {}
+    total = 0.0
+    for name, labels, value in parse_scrape(text):
+        if name != family:
+            continue
+        if all(labels.get(k) == str(v) for k, v in match.items()):
+            total += value
+    return total
+
+
+def scrape_histogram(text, family, match=None):
+    """Aggregate a histogram family off a scrape: returns (uppers,
+    cum_counts) summed across every `<family>_bucket` series whose labels
+    include `match` (cumulative counts sum to cumulative counts), or None
+    when no series matches. Feed straight into quantile_from_buckets —
+    or difference two scrapes' cum_counts for a windowed quantile."""
+    match = match or {}
+    by_le: dict[float, float] = {}
+    for name, labels, value in parse_scrape(text):
+        if name != f"{family}_bucket" or "le" not in labels:
+            continue
+        if not all(labels.get(k) == str(v) for k, v in match.items()):
+            continue
+        le = float(labels["le"])
+        by_le[le] = by_le.get(le, 0.0) + value
+    if not by_le:
+        return None
+    les = sorted(by_le)
+    if les[-1] != float("inf"):
+        raise ValueError(f"{family}: scrape has no +Inf bucket")
+    uppers = tuple(le for le in les if le != float("inf"))
+    cum = [int(by_le[le]) for le in les]
+    return uppers, cum
+
+
+def scrape_quantile(text, family, q, match=None, *, baseline=None) -> float:
+    """q-quantile of a histogram family read off a scrape; `baseline` (an
+    earlier scrape of the same endpoint) windows the quantile to the
+    observations between the two scrapes. NaN when the window is empty."""
+    got = scrape_histogram(text, family, match)
+    if got is None:
+        return float("nan")
+    uppers, cum = got
+    if baseline is not None:
+        base = scrape_histogram(baseline, family, match)
+        if base is not None:
+            b_uppers, b_cum = base
+            if b_uppers != uppers:
+                raise ValueError(
+                    f"{family}: bucket bounds changed between scrapes"
+                )
+            cum = [a - b for a, b in zip(cum, b_cum)]
+    return quantile_from_buckets(q, uppers, cum)
+
+
 __all__ = [
     "CATALOG",
     "Counter",
@@ -514,4 +711,9 @@ __all__ = [
     "LATENCY_MS_BUCKETS",
     "Registry",
     "escape_label_value",
+    "parse_scrape",
+    "quantile_from_buckets",
+    "scrape_counter",
+    "scrape_histogram",
+    "scrape_quantile",
 ]

@@ -5,19 +5,23 @@ models (models/persist.py manifests or raw checkpoint dirs) and keeps
 their parameters device-resident across requests, `engine` owns the
 compiled predict-function cache (bucketed padding, sharded_assign routing
 for large K), `batcher` coalesces concurrent requests into one device
-batch, `server` exposes the stdlib HTTP JSON API, and `online` closes
-the fit→serve loop: sampled traffic folds back into the model through a
-guarded (screen → shadow-validate → atomic swap → auto-rollback)
-pipeline.
+batch, `governor` sheds load from measured signals before work is queued
+(readiness-based admission control), `server` exposes the stdlib HTTP
+JSON API, and `online` closes the fit→serve loop: sampled traffic folds
+back into the model through a guarded (screen → shadow-validate →
+atomic swap → auto-rollback) pipeline.
 """
 
 from tdc_tpu.serve.batcher import MicroBatcher, Overloaded
 from tdc_tpu.serve.engine import PredictEngine
+from tdc_tpu.serve.governor import GovernorConfig, LoadGovernor
 from tdc_tpu.serve.online import OnlineConfig, OnlineUpdater
 from tdc_tpu.serve.registry import ModelEntry, ModelRegistry
 from tdc_tpu.serve.server import ServeApp
 
 __all__ = [
+    "GovernorConfig",
+    "LoadGovernor",
     "MicroBatcher",
     "ModelEntry",
     "ModelRegistry",

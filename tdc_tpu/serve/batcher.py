@@ -32,7 +32,18 @@ from tdc_tpu.testing.faults import fault_point
 
 class Overloaded(Exception):
     """The pending-request queue is full (or the server is draining);
-    retry later / elsewhere (HTTP 503)."""
+    retry later / elsewhere (HTTP 503).
+
+    `reason` disambiguates the two 503 sources that used to render
+    identically upstream: "backpressure" (queue full — the server is
+    healthy but saturated, retry HERE after backoff) vs "drain" (this
+    replica is going away — retry ELSEWHERE immediately). The admission
+    governor's pre-queue sheds are a third, separate path
+    (serve/governor.py) and never raise this exception."""
+
+    def __init__(self, message: str, reason: str = "backpressure"):
+        super().__init__(message)
+        self.reason = reason
 
 
 @dataclass
@@ -89,6 +100,10 @@ class MicroBatcher:
         self._pending: dict[tuple, collections.deque[_Request]] = {}
         self._arrival = asyncio.Event()
         self._queued_rows = 0
+        # Per-model queued rows: the governor's fair-share signal.
+        self._queued_rows_by_model: collections.Counter = (
+            collections.Counter()
+        )
         self._in_flight = 0  # batches currently on device (drain watches it)
         self.draining = False  # reject new work; let queued work finish
         self._dispatcher: asyncio.Task | None = None
@@ -100,7 +115,16 @@ class MicroBatcher:
         }
         # Optional obs/metrics.Histogram: per-request queue-wait samples
         # (ServeApp attaches it; None = standalone batcher, no histogram).
+        # A per-tenant histogram (labelnames=("model",)) gets the model
+        # label; a plain one is observed directly.
         self.queue_wait_hist = None
+
+    @property
+    def queued_rows(self) -> int:
+        return self._queued_rows
+
+    def queued_rows_for(self, model_id: str) -> int:
+        return self._queued_rows_by_model.get(model_id, 0)
 
     # ---------------- client side ----------------
 
@@ -117,7 +141,8 @@ class MicroBatcher:
         the caller should report alongside the result."""
         if self.draining:
             self.stats["rejected"] += 1
-            raise Overloaded("server draining; not accepting new work")
+            raise Overloaded("server draining; not accepting new work",
+                             reason="drain")
         x = np.asarray(x, np.float32)
         if x.ndim == 1:
             x = x[None, :]
@@ -148,6 +173,7 @@ class MicroBatcher:
         key = (model_id, method, entry.generation)
         self._pending.setdefault(key, collections.deque()).append(req)
         self._queued_rows += x.shape[0]
+        self._queued_rows_by_model[model_id] += x.shape[0]
         self.stats["requests"] += 1
         self._arrival.set()
         return await fut, entry
@@ -182,14 +208,17 @@ class MicroBatcher:
                 pass
             self._dispatcher = None
         # Shutdown must not strand submitters: fail whatever is queued.
+        # reason="drain": these 503s are the replica going away, never
+        # admission sheds.
         for dq in self._pending.values():
             for req in dq:
                 if not req.future.done():
                     req.future.set_exception(
-                        Overloaded("server shutting down")
+                        Overloaded("server shutting down", reason="drain")
                     )
         self._pending.clear()
         self._queued_rows = 0
+        self._queued_rows_by_model.clear()
 
     def _run_tap(self, model_id: str, method: str, x) -> None:
         try:
@@ -246,6 +275,10 @@ class MicroBatcher:
             rows = sum(r.x.shape[0] for r in batch)
             self._queued_rows -= rows
             head = batch[0]
+            # A batch is single-model by construction (per-key queues).
+            self._queued_rows_by_model[head.model_id] -= rows
+            if self._queued_rows_by_model[head.model_id] <= 0:
+                del self._queued_rows_by_model[head.model_id]
             self._in_flight += 1
             try:
                 fault_point("serve.dispatch")
@@ -272,11 +305,13 @@ class MicroBatcher:
                 # close() cancelled the dispatcher mid-dispatch (drain
                 # timed out): the popped batch is in neither _pending nor
                 # done — fail its futures explicitly or their HTTP threads
-                # block the full request_timeout.
+                # block the full request_timeout. reason="drain": this is
+                # the replica going away, not overload.
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(
-                            Overloaded("server shutting down")
+                            Overloaded("server shutting down",
+                                       reason="drain")
                         )
                 raise
             except Exception as e:
@@ -296,7 +331,10 @@ class MicroBatcher:
                 wait_ms = (now - r.enqueued_at) * 1e3
                 self.stats["queue_wait_ms_total"] += wait_ms
                 if self.queue_wait_hist is not None:
-                    self.queue_wait_hist.observe(wait_ms)
+                    h = self.queue_wait_hist
+                    if getattr(h, "labelnames", ()):
+                        h = h.labels(model=r.model_id)
+                    h.observe(wait_ms)
                 if self.log is not None:
                     self.log.event(
                         "request", model=r.model_id, method=r.method,

@@ -440,7 +440,10 @@ class PredictEngine:
         self.stats["padded_rows"] += bucket - n
         self.stats["device_ms_total"] += device_ms
         if self.device_ms_hist is not None:
-            self.device_ms_hist.observe(device_ms)
+            h = self.device_ms_hist
+            if getattr(h, "labelnames", ()):
+                h = h.labels(model=entry.model_id)
+            h.observe(device_ms)
         meta = {
             "bucket": bucket,
             "kernel": kernel,

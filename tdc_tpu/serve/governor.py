@@ -1,0 +1,270 @@
+"""Readiness-based admission control: shed load from MEASURED signals
+before work is queued, instead of discovering overload as collapsed tail
+latency.
+
+The PR-1 backpressure path (`MicroBatcher` raising `Overloaded` at the
+queued-rows bound) is a hard stop at the cliff edge: by the time it
+fires, every queued request has already bought the full queue wait, and
+the 503s it produces are indistinguishable from drain 503s. The governor
+sits in FRONT of the queue (ServeApp.request calls `admit()` before
+`submit_full` ever runs) and computes admission from three measured
+signals:
+
+- **queue depth** — queued rows as a fraction of `max_queue_rows`;
+- **recent p99 queue wait** — derived from the SAME fixed-bucket
+  `tdc_serve_queue_wait_ms` histogram the scrape exports, via
+  `obs.metrics.quantile_from_buckets` over the delta between evaluation
+  windows. The governor sees exactly what a Prometheus alert would see;
+  there is no private latency window to disagree with the dashboard;
+- **in-flight requests** — admitted-and-unanswered count (optional cap).
+
+Transitions carry hysteresis (enter above the high watermark, exit only
+below the low watermark AND after `min_shed_s`), so a rate hovering at
+the knee does not flap readiness. While shedding:
+
+- new requests are rejected 503 + `Retry-After` BEFORE any work is
+  queued (body `reason: "shed"`, never confusable with drain 503s);
+- `/readyz` reports 503 `shedding` so an LB that gates on readiness
+  stops routing here — readiness-based shedding at the fleet level;
+- admission stays FAIR per model: a model whose queued rows are under
+  its fair share (`fair_frac * max_queue_rows / registered models`)
+  is still admitted, so one flooded tenant cannot starve the rest
+  (ROADMAP 3a). The flooded model is what gets shed.
+
+Everything is observable: `tdc_serve_shed_total{model,reason}`,
+`tdc_serve_admission_state`, `tdc_serve_offered_rps`,
+`tdc_serve_inflight` on the scrape, `shed_enter`/`shed_exit` structlog
+events at transitions. `benchmarks/bench_load.py` drives the whole path
+to measured saturation; the `load-smoke` tier-1 stage gates the
+overload contract.
+
+Stdlib-only, lock-protected: `admit()` is called from every HTTP
+handler thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from tdc_tpu.obs.metrics import quantile_from_buckets
+
+
+@dataclass
+class GovernorConfig:
+    """Admission-governor knobs (cli/serve exposes them as --shed_*).
+
+    Fractions are of the batcher's max_queue_rows; `p99_wait_high_ms`
+    and `inflight_high` set to 0 disable that signal; `enabled=False`
+    turns the governor into a pass-through (admission always granted,
+    no state evaluation) for A/B-ing the ungoverned overload behavior.
+    """
+
+    enabled: bool = True
+    queue_high_frac: float = 0.75
+    queue_low_frac: float = 0.35
+    p99_wait_high_ms: float = 500.0
+    p99_wait_low_ms: float = 0.0  # 0 -> p99_wait_high_ms / 2
+    inflight_high: int = 0
+    fair_frac: float = 0.5
+    eval_interval_s: float = 0.25
+    min_shed_s: float = 1.0
+    retry_after_s: float = 1.0
+
+    def __post_init__(self):
+        if not 0.0 < self.queue_low_frac <= self.queue_high_frac:
+            raise ValueError(
+                f"need 0 < queue_low_frac <= queue_high_frac, got "
+                f"{self.queue_low_frac} / {self.queue_high_frac}"
+            )
+        if self.p99_wait_low_ms <= 0:
+            self.p99_wait_low_ms = self.p99_wait_high_ms / 2.0
+        if self.eval_interval_s <= 0:
+            raise ValueError("eval_interval_s must be > 0")
+        if not 0.0 < self.fair_frac <= 1.0:
+            raise ValueError(f"fair_frac={self.fair_frac} outside (0, 1]")
+
+
+class LoadGovernor:
+    """One per ServeApp; `admit(model_id, rows)` from any thread.
+
+    batcher/registry are read-only signal sources; `queue_wait_hist` is
+    the app's `tdc_serve_queue_wait_ms` Histogram (None disables the
+    p99 signal — a standalone batcher has no histogram); `inflight` is
+    a callable returning the app's in-flight count; `clock` is
+    injectable for deterministic tests.
+    """
+
+    def __init__(self, batcher, registry, config: GovernorConfig | None
+                 = None, *, queue_wait_hist=None, inflight=None, log=None,
+                 clock=time.monotonic):
+        self.batcher = batcher
+        self.registry = registry
+        self.config = config or GovernorConfig()
+        self.queue_wait_hist = queue_wait_hist
+        self._inflight = inflight or (lambda: 0)
+        self.log = log
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.shedding = False
+        self._trigger = "queue_depth"  # what entered the current shed
+        self._shed_since = 0.0
+        self._last_eval = float("-inf")
+        self._wait_cum_prev: list[int] | None = None
+        self._recent_p99_ms = 0.0
+        # Offered-rate window: arrivals (admitted + shed) since win_start.
+        self._arrivals = 0
+        self._win_start = clock()
+        self._offered_rps = 0.0
+        self.sheds = 0
+
+    # ---------------- signals ----------------
+
+    def _queue_frac(self) -> float:
+        mx = max(getattr(self.batcher, "max_queue_rows", 1), 1)
+        return self.batcher.queued_rows / mx
+
+    def _recent_queue_p99(self) -> float:
+        """p99 queue wait over the observations since the last evaluation,
+        off the same bucket counts the scrape exports. 0 when the window
+        saw no dispatches (an empty window is not evidence of overload)."""
+        if self.queue_wait_hist is None:
+            return 0.0
+        uppers, cum = self.queue_wait_hist.aggregate()
+        prev, self._wait_cum_prev = self._wait_cum_prev, cum
+        if prev is None or len(prev) != len(cum):
+            return 0.0
+        delta = [a - b for a, b in zip(cum, prev)]
+        if delta[-1] <= 0:
+            return 0.0
+        p99 = quantile_from_buckets(0.99, uppers, delta)
+        return 0.0 if p99 != p99 else p99  # NaN -> no signal
+
+    def signals(self) -> dict:
+        """Point-in-time signal snapshot (the shed_enter/exit event body
+        and the bench harness's per-cell context)."""
+        return {
+            "queue_frac": round(self._queue_frac(), 4),
+            "queue_rows": self.batcher.queued_rows,
+            "recent_p99_wait_ms": round(self._recent_p99_ms, 3),
+            "inflight": int(self._inflight()),
+            "offered_rps": round(self._offered_rps, 3),
+        }
+
+    def offered_rps(self) -> float:
+        return self._offered_rps
+
+    def maybe_evaluate(self) -> None:
+        """Traffic-independent re-evaluation (rate-limited to
+        eval_interval_s). /readyz and /metrics call this so a shed
+        entered under load EXITS once the queue drains even if no new
+        request ever arrives — recovery must be observable from the
+        probes alone, not gated on the next arrival."""
+        now = self._clock()
+        with self._lock:
+            if not self.config.enabled:
+                self._roll_window(now)
+                return
+            self._evaluate(now)
+
+    def state_code(self) -> int:
+        """0 admitting, 1 shedding (2 = draining, reported by the app —
+        drain outranks shed and is not the governor's state)."""
+        self.maybe_evaluate()
+        return 1 if self.shedding else 0
+
+    # ---------------- evaluation ----------------
+
+    def _roll_window(self, now: float) -> bool:
+        """Close the offered-rate window if eval_interval_s elapsed;
+        caller holds the lock. Measured even with the governor DISABLED:
+        tdc_serve_offered_rps is exactly the number the `--shed off` A/B
+        arm exists to compare."""
+        if now - self._last_eval < self.config.eval_interval_s:
+            return False
+        self._last_eval = now
+        window = now - self._win_start
+        if window > 0:
+            self._offered_rps = self._arrivals / window
+        self._arrivals = 0
+        self._win_start = now
+        return True
+
+    def _evaluate(self, now: float) -> None:
+        """Re-derive shed state from the measured signals; caller holds
+        the lock. Runs at most every eval_interval_s."""
+        if not self._roll_window(now):
+            return
+        cfg = self.config
+        self._recent_p99_ms = self._recent_queue_p99()
+        qfrac = self._queue_frac()
+        inflight = int(self._inflight())
+
+        high = []
+        if qfrac >= cfg.queue_high_frac:
+            high.append("queue_depth")
+        if cfg.p99_wait_high_ms > 0 and \
+                self._recent_p99_ms >= cfg.p99_wait_high_ms:
+            high.append("queue_wait_p99")
+        if cfg.inflight_high > 0 and inflight >= cfg.inflight_high:
+            high.append("inflight")
+
+        if not self.shedding:
+            if high:
+                self.shedding = True
+                self._trigger = high[0]
+                self._shed_since = now
+                if self.log is not None:
+                    self.log.event("shed_enter", trigger=self._trigger,
+                                   **self.signals())
+            return
+        # Hysteresis: exit only after min_shed_s AND every signal is
+        # below its LOW watermark (an empty-window p99 of 0 counts as
+        # recovered — nothing waited because nothing was queued).
+        if now - self._shed_since < cfg.min_shed_s:
+            return
+        below = (
+            qfrac <= cfg.queue_low_frac
+            and (cfg.p99_wait_high_ms <= 0
+                 or self._recent_p99_ms <= cfg.p99_wait_low_ms)
+            and (cfg.inflight_high <= 0 or inflight < cfg.inflight_high)
+        )
+        if below:
+            self.shedding = False
+            if self.log is not None:
+                self.log.event("shed_exit",
+                               shed_s=round(now - self._shed_since, 3),
+                               **self.signals())
+
+    # ---------------- admission ----------------
+
+    def admit(self, model_id: str, rows: int) -> tuple[bool, str | None]:
+        """Admission decision for one request of `rows` rows, BEFORE any
+        work is queued. Returns (True, None) or (False, trigger_reason).
+        Counts the arrival either way (offered load includes sheds, and
+        a DISABLED governor still measures tdc_serve_offered_rps — the
+        `--shed off` A/B arm needs the same offered-load number)."""
+        now = self._clock()
+        with self._lock:
+            self._arrivals += 1
+            if not self.config.enabled:
+                self._roll_window(now)
+                return True, None
+            self._evaluate(now)
+            if not self.shedding:
+                return True, None
+            # Fair share: a model under its slice of the queue is still
+            # admitted mid-shed — shedding targets the flooded tenant(s),
+            # not everyone (one flooded model must not starve the rest).
+            n_models = max(len(self.registry.ids()), 1)
+            share = (self.config.fair_frac
+                     * self.batcher.max_queue_rows / n_models)
+            queued = self.batcher.queued_rows_for(model_id)
+            if queued + rows <= share:
+                return True, None
+            self.sheds += 1
+            return False, self._trigger
+
+
+__all__ = ["GovernorConfig", "LoadGovernor"]

@@ -48,6 +48,7 @@ import numpy as np
 from tdc_tpu.obs import metrics as obs_metrics
 from tdc_tpu.serve.batcher import MicroBatcher, Overloaded
 from tdc_tpu.serve.engine import PredictEngine
+from tdc_tpu.serve.governor import GovernorConfig, LoadGovernor
 from tdc_tpu.serve.registry import ModelRegistry
 
 _PREDICT_ENDPOINTS = ("predict", "predict_proba", "transform")
@@ -79,6 +80,7 @@ class ServeApp:
         request_timeout: float = 30.0,
         feed_dir: str | None = None,
         feed_sample: int = 1,
+        governor_config: GovernorConfig | None = None,
     ):
         self.log = log
         self.registry = registry or ModelRegistry()
@@ -122,9 +124,20 @@ class ServeApp:
         # per-batch device-ms / queue-wait samples directly.
         self.metrics_registry = obs_metrics.Registry()
         self._online_snapshot: dict[str, dict[str, float]] = {}
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._register_metrics()
         self.engine.device_ms_hist = self._hist_device
         self.batcher.queue_wait_hist = self._hist_queue
+        # Admission governor (serve/governor.py): sheds from measured
+        # signals BEFORE work is queued, flips /readyz while shedding,
+        # fair per model. Reads the same queue-wait bucket counts the
+        # scrape exports.
+        self.governor = LoadGovernor(
+            self.batcher, self.registry, governor_config,
+            queue_wait_hist=self._hist_queue,
+            inflight=lambda: self._inflight, log=log,
+        )
 
     # ---------------- lifecycle ----------------
 
@@ -317,12 +330,20 @@ class ServeApp:
         ms = (time.perf_counter() - t0) * 1e3
         self._counters[(endpoint, status)] += 1
         if status == 200:
-            self._hist_latency.labels(endpoint=endpoint).observe(ms)
+            # Per-tenant labels: a 200's model id is registry-validated,
+            # so cardinality is bounded by the registered-model set.
+            self._hist_latency.labels(
+                endpoint=endpoint, model=body["model"]
+            ).observe(ms)
         return status, body
 
     def _request_inner(self, endpoint: str, payload: dict) -> tuple[int, dict]:
+        # The two 503 sources carry DISTINCT `reason`s: "drain" (replica
+        # going away — retry elsewhere now) vs "shed"/"backpressure"
+        # (overload — retry here after Retry-After). Conflating them made
+        # rolling restarts indistinguishable from overload on dashboards.
         if self._draining:
-            return 503, {"error": "draining", "detail":
+            return 503, {"error": "draining", "reason": "drain", "detail":
                          "server is shutting down; retry another replica"}
         if self._loop is None:
             return 503, {"error": "server not started"}
@@ -340,27 +361,66 @@ class ServeApp:
             x = x[None, :]
         if x.ndim != 2 or x.shape[0] == 0 or not np.isfinite(x).all():
             return 400, {"error": "points must be a non-empty finite 2-D array"}
+        # Validate the model BEFORE admission: a 404 is not offered load,
+        # and an unregistered id must not mint a shed-counter label
+        # (cardinality stays bounded by the registry).
+        try:
+            self.registry.get(model_id)
+        except KeyError as e:
+            return 404, {"error": str(e)}
+        admitted, trigger = self.governor.admit(model_id, x.shape[0])
+        if not admitted:
+            # Shed BEFORE the queue: no work was enqueued for this
+            # request. Retry-After goes out as a real HTTP header too
+            # (_make_httpd) so well-behaved clients back off.
+            self._shed_total.labels(model=model_id, reason=trigger).inc()
+            retry_s = self.governor.config.retry_after_s
+            return 503, {
+                "error": "overloaded", "reason": "shed",
+                "trigger": trigger, "retry_after_s": retry_s,
+                "detail": "admission governor is shedding load; "
+                          f"retry after {retry_s}s",
+            }
         fut = asyncio.run_coroutine_threadsafe(
             self.batcher.submit_full(model_id, endpoint, x), self._loop
         )
+        # In-flight = ADMITTED and not yet answered (the catalog's and
+        # the inflight_high signal's definition): rejected/invalid
+        # requests never count, so a shed flood cannot feed the very
+        # signal that is shedding it.
+        with self._inflight_lock:
+            self._inflight += 1
         try:
-            # The version in the response comes from the SAME entry the
-            # batcher resolved at submit time — a hot reload between two
-            # separate registry reads would otherwise pair one version's
-            # predictions with the other's hash.
-            out, entry = fut.result(timeout=self.request_timeout)
-        except Overloaded as e:
-            return 503, {"error": "overloaded", "detail": str(e)}
-        except KeyError as e:
-            return 404, {"error": str(e)}
-        except ValueError as e:
-            return 400, {"error": str(e)}
-        except concurrent.futures.TimeoutError:
-            # NOT builtin TimeoutError: on 3.10 futures.TimeoutError is a
-            # distinct class (they merge in 3.11), and the builtin name
-            # would let timeouts escape as 500s.
-            fut.cancel()
-            return 504, {"error": "request timed out"}
+            try:
+                # The version in the response comes from the SAME entry
+                # the batcher resolved at submit time — a hot reload
+                # between two separate registry reads would otherwise
+                # pair one version's predictions with the other's hash.
+                out, entry = fut.result(timeout=self.request_timeout)
+            except Overloaded as e:
+                reason = getattr(e, "reason", "backpressure")
+                if reason == "drain":
+                    # The batcher refused/stranded the request because
+                    # the server is draining — report it as a drain 503,
+                    # NOT an overload (the pre-PR-15 double-503
+                    # ambiguity).
+                    return 503, {"error": "draining", "reason": "drain",
+                                 "detail": str(e)}
+                return 503, {"error": "overloaded", "reason": reason,
+                             "detail": str(e)}
+            except KeyError as e:
+                return 404, {"error": str(e)}
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            except concurrent.futures.TimeoutError:
+                # NOT builtin TimeoutError: on 3.10 futures.TimeoutError
+                # is a distinct class (they merge in 3.11), and the
+                # builtin name would let timeouts escape as 500s.
+                fut.cancel()
+                return 504, {"error": "request timed out"}
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
         field = _RESULT_FIELD[endpoint]
         return 200, {
             "model": model_id,
@@ -392,12 +452,22 @@ class ServeApp:
         if path == "/readyz":
             # Readiness: only when a predict request would succeed.
             reason = None
+            # Probe-driven governor re-evaluation: recovery must be
+            # visible to an LB polling /readyz even if no request ever
+            # arrives again.
+            self.governor.maybe_evaluate()
             if self._draining:
                 reason = "draining"
             elif self._loop is None:
                 reason = "not started"
             elif not self.registry.ids():
                 reason = "no model loaded"
+            elif self.governor.shedding:
+                # Readiness-based shedding: an LB that gates on /readyz
+                # stops routing here while the governor sheds, so the
+                # overload drains at the fleet level instead of being
+                # 503'd request by request.
+                reason = "shedding"
             status = 200 if reason is None else 503
             self._counters[("readyz", status)] += 1
             body = {"ready": reason is None}
@@ -581,13 +651,35 @@ class ServeApp:
                 ])(name),
             )
         # Real fixed-bucket latency histograms (PR 12): p50/p99/p999 are
-        # derivable from the scrape by any Prometheus stack — the
-        # precondition for the ROADMAP item-3c closed-loop load harness.
+        # derivable from the scrape by any Prometheus stack. PR 15 adds
+        # the per-tenant `model` label (ROADMAP 3a) — cardinality is
+        # bounded because only registry-validated ids are observed — and
+        # the open-loop load harness (obs/loadgen.py) reports exclusively
+        # from these buckets.
         self._hist_latency = reg.histogram(
-            "tdc_serve_latency_ms", labelnames=("endpoint",)
+            "tdc_serve_latency_ms", labelnames=("endpoint", "model")
         )
-        self._hist_queue = reg.histogram("tdc_serve_queue_wait_ms")
-        self._hist_device = reg.histogram("tdc_serve_engine_batch_device_ms")
+        self._hist_queue = reg.histogram(
+            "tdc_serve_queue_wait_ms", labelnames=("model",)
+        )
+        self._hist_device = reg.histogram(
+            "tdc_serve_engine_batch_device_ms", labelnames=("model",)
+        )
+        # Admission governor observability (serve/governor.py): sheds by
+        # (model, trigger), the live in-flight count, the admission state
+        # (drain outranks shed), and the measured offered rate.
+        self._shed_total = reg.counter(
+            "tdc_serve_shed_total", labelnames=("model", "reason")
+        )
+        reg.callback("tdc_serve_inflight", lambda: self._inflight)
+        reg.callback(
+            "tdc_serve_admission_state",
+            lambda: 2 if self._draining else self.governor.state_code(),
+        )
+        reg.callback(
+            "tdc_serve_offered_rps",
+            lambda: round(self.governor.offered_rps(), 3),
+        )
         # Scrape-health idioms.
         from tdc_tpu import __version__
 
@@ -654,11 +746,14 @@ def _make_httpd(app: ServeApp, host: str, port: int) -> ThreadingHTTPServer:
             if app.log is not None:
                 app.log.event("http", line=fmt % args)
 
-        def _reply(self, status: int, content_type: str, body: str) -> None:
+        def _reply(self, status: int, content_type: str, body: str,
+                   headers=()) -> None:
             data = body.encode()
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
+            for name, value in headers:
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
 
@@ -683,6 +778,15 @@ def _make_httpd(app: ServeApp, host: str, port: int) -> ThreadingHTTPServer:
                 )
             else:
                 status, body = app.request(endpoint, payload)
-            self._reply(status, "application/json", json.dumps(body))
+            headers = []
+            if status == 503 and "retry_after_s" in body:
+                # Shed 503s carry a real Retry-After header so
+                # well-behaved clients back off instead of hammering.
+                headers.append(
+                    ("Retry-After",
+                     str(max(1, round(body["retry_after_s"]))))
+                )
+            self._reply(status, "application/json", json.dumps(body),
+                        headers)
 
     return ThreadingHTTPServer((host, port), Handler)

@@ -1,0 +1,493 @@
+"""SLO observatory unit layer: scrape-derived quantiles
+(obs/metrics.quantile_from_buckets + the scrape parsers), the open-loop
+load generator (obs/loadgen), and the admission governor's state machine
+(serve/governor) against fake signal sources with an injected clock.
+
+The serve-stack integration (sheds on a real ServeApp, drain-vs-shed
+disambiguation, per-tenant labels) lives in tests/test_serve.py; the
+measured overload contract is gated by the `load-smoke` tier-1 stage
+(benchmarks/bench_load.py --smoke).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tdc_tpu.obs import loadgen
+from tdc_tpu.obs import metrics as obs_metrics
+from tdc_tpu.obs.metrics import (
+    parse_scrape,
+    quantile_from_buckets,
+    scrape_counter,
+    scrape_histogram,
+    scrape_quantile,
+)
+from tdc_tpu.serve.governor import GovernorConfig, LoadGovernor
+
+# ---------------------------------------------------------------------------
+# quantile_from_buckets
+# ---------------------------------------------------------------------------
+
+
+class TestQuantileFromBuckets:
+    def test_interpolated_within_bucket(self):
+        # 10 observations uniformly credited to (1, 2]: the median
+        # interpolates to the bucket midpoint.
+        assert quantile_from_buckets(0.5, (1, 2, 4), [0, 10, 10, 10]) == 1.5
+
+    def test_exact_boundary(self):
+        # rank == the cumulative count at a bound -> exactly that bound.
+        assert quantile_from_buckets(0.5, (1, 2, 4), [5, 10, 10, 10]) == 1.0
+        assert quantile_from_buckets(1.0, (1, 2, 4), [0, 0, 8, 8]) == 4.0
+
+    def test_first_bucket_interpolates_from_zero(self):
+        assert quantile_from_buckets(0.5, (10.0,), [4, 4]) == 5.0
+
+    def test_inf_bucket_reports_highest_finite_bound(self):
+        # All mass beyond the last finite bound: the scrape cannot
+        # resolve further than the highest finite edge.
+        assert quantile_from_buckets(0.999, (1, 2, 4), [0, 0, 0, 7]) == 4.0
+
+    def test_empty_histogram_is_nan(self):
+        assert math.isnan(quantile_from_buckets(0.5, (1, 2), [0, 0, 0]))
+
+    def test_non_monotone_rejected(self):
+        with pytest.raises(ValueError, match="monotone"):
+            quantile_from_buckets(0.5, (1, 2, 4), [5, 3, 2, 1])
+
+    def test_shape_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="cumulative"):
+            quantile_from_buckets(0.5, (1, 2, 4), [1, 2, 3])
+
+    def test_bad_q_rejected(self):
+        with pytest.raises(ValueError, match="outside"):
+            quantile_from_buckets(1.5, (1, 2), [1, 1, 1])
+        with pytest.raises(ValueError, match="outside"):
+            quantile_from_buckets(-0.1, (1, 2), [1, 1, 1])
+
+    def test_negative_count_rejected(self):
+        # A scrape delta that went backwards (counter reset) must raise,
+        # not interpolate garbage.
+        with pytest.raises(ValueError):
+            quantile_from_buckets(0.5, (1, 2), [-1, 0, 3])
+
+    def test_property_vs_np_percentile(self):
+        """On synthetic samples binned into fine buckets, the scrape-
+        derived quantile lands within one bucket width of the exact
+        np.percentile answer, across distributions and quantiles."""
+        rng = np.random.default_rng(0)
+        uppers = tuple(float(u) for u in range(2, 102, 2))  # width 2
+        for dist in ("uniform", "exponential", "bimodal"):
+            if dist == "uniform":
+                xs = rng.uniform(0, 100, size=5000)
+            elif dist == "exponential":
+                xs = np.minimum(rng.exponential(15.0, size=5000), 99.9)
+            else:
+                xs = np.concatenate([
+                    rng.normal(20, 3, size=2500),
+                    rng.normal(70, 5, size=2500),
+                ]).clip(0.1, 99.9)
+            counts = [int((xs <= u).sum()) for u in uppers] + [len(xs)]
+            for q in (0.1, 0.5, 0.9, 0.99, 0.999):
+                got = quantile_from_buckets(q, uppers, counts)
+                # inverted-CDF percentile: the sample at the rank. The
+                # default linear method can land mid-gap in a bimodal
+                # density where histogram_quantile semantics pin the
+                # bucket edge — the bucket-width bound only holds vs
+                # the rank sample.
+                want = float(np.percentile(xs, q * 100, method="lower"))
+                assert abs(got - want) <= 2.0 + 1e-9, (dist, q, got, want)
+
+
+# ---------------------------------------------------------------------------
+# Scrape parsing
+# ---------------------------------------------------------------------------
+
+
+class TestScrapeParsing:
+    def _registry(self):
+        reg = obs_metrics.Registry()
+        h = reg.histogram("tdc_serve_latency_ms",
+                          labelnames=("endpoint", "model"))
+        c = reg.counter("tdc_serve_shed_total",
+                        labelnames=("model", "reason"))
+        return reg, h, c
+
+    def test_parse_roundtrip(self):
+        reg, h, c = self._registry()
+        h.labels(endpoint="predict", model="km").observe(3.0)
+        c.labels(model="km", reason="queue_depth").inc(4)
+        rows = parse_scrape(reg.render())
+        shed = [r for r in rows if r[0] == "tdc_serve_shed_total"]
+        assert shed == [("tdc_serve_shed_total",
+                         {"model": "km", "reason": "queue_depth"}, 4.0)]
+        infs = [r for r in rows
+                if r[0] == "tdc_serve_latency_ms_bucket"
+                and r[1].get("le") == "+Inf"]
+        assert len(infs) == 1 and infs[0][2] == 1.0
+
+    def test_scrape_counter_sums_and_filters(self):
+        reg, _, c = self._registry()
+        c.labels(model="km", reason="queue_depth").inc(2)
+        c.labels(model="gm", reason="queue_wait_p99").inc(3)
+        text = reg.render()
+        assert scrape_counter(text, "tdc_serve_shed_total") == 5.0
+        assert scrape_counter(text, "tdc_serve_shed_total",
+                              {"model": "gm"}) == 3.0
+        assert scrape_counter(text, "tdc_serve_shed_total",
+                              {"model": "absent"}) == 0.0
+
+    def test_scrape_histogram_aggregates_across_series(self):
+        reg, h, _ = self._registry()
+        h.labels(endpoint="predict", model="km").observe(3.0)
+        h.labels(endpoint="predict", model="gm").observe(700.0)
+        h.labels(endpoint="transform", model="km").observe(0.1)
+        text = reg.render()
+        uppers, cum = scrape_histogram(
+            text, "tdc_serve_latency_ms", {"endpoint": "predict"})
+        assert cum[-1] == 2  # transform series filtered out
+        assert uppers == tuple(obs_metrics.LATENCY_MS_BUCKETS)
+        assert scrape_histogram(text, "absent_family_ms") is None
+
+    def test_scrape_quantile_windows_on_baseline(self):
+        reg, h, _ = self._registry()
+        child = h.labels(endpoint="predict", model="km")
+        child.observe(3.0)
+        before = reg.render()
+        for _ in range(50):
+            child.observe(600.0)
+        after = reg.render()
+        # Unwindowed, the early 3ms sample dilutes; windowed on the
+        # baseline scrape the delta is pure 600ms observations.
+        q = scrape_quantile(after, "tdc_serve_latency_ms", 0.5,
+                            {"model": "km"}, baseline=before)
+        assert 500.0 <= q <= 1000.0
+        assert math.isnan(scrape_quantile(
+            after, "tdc_serve_latency_ms", 0.5, {"model": "absent"}))
+
+    def test_label_escaping_roundtrips(self):
+        """Render -> parse is the identity on hostile label values, incl.
+        the backslash-then-n case chained str.replace corrupts (review
+        regression)."""
+        hostile = ['a\\nb', 'a\nb', 'quote"back\\slash', 'plain']
+        reg = obs_metrics.Registry()
+        c = reg.counter("tdc_serve_shed_total",
+                        labelnames=("model", "reason"))
+        for i, v in enumerate(hostile):
+            c.labels(model=v, reason=f"r{i}").inc(i + 1)
+        rows = parse_scrape(reg.render())
+        got = {r[1]["reason"]: r[1]["model"] for r in rows
+               if r[0] == "tdc_serve_shed_total"}
+        assert got == {f"r{i}": v for i, v in enumerate(hostile)}
+
+    def test_histogram_aggregate_matches_scrape(self):
+        reg, h, _ = self._registry()
+        h.labels(endpoint="predict", model="km").observe(3.0)
+        h.labels(endpoint="predict", model="gm").observe(40.0)
+        uppers, cum = h.aggregate()
+        s_uppers, s_cum = scrape_histogram(reg.render(),
+                                           "tdc_serve_latency_ms")
+        assert uppers == s_uppers and cum == s_cum
+
+
+# ---------------------------------------------------------------------------
+# Shape programs + open-loop schedule
+# ---------------------------------------------------------------------------
+
+
+class TestShapes:
+    def test_constant(self):
+        f = loadgen.make_shape("constant", base_rps=10, duration_s=5)
+        assert f(0) == f(4.9) == 10
+
+    def test_step(self):
+        f = loadgen.make_shape("step", base_rps=10, peak_rps=40,
+                               duration_s=9, at_s=3)
+        assert f(2.9) == 10 and f(3.0) == 40 and f(8.9) == 40
+
+    def test_spike_returns_to_base(self):
+        f = loadgen.make_shape("spike", base_rps=10, peak_rps=40,
+                               duration_s=9)
+        assert f(0) == 10 and f(4) == 40 and f(8) == 10
+
+    def test_diurnal_bounds_and_period(self):
+        f = loadgen.make_shape("diurnal", base_rps=10, peak_rps=30,
+                               duration_s=10)
+        vals = [f(t / 10) for t in range(101)]
+        assert min(vals) >= 10 - 1e-9 and max(vals) <= 30 + 1e-9
+        assert abs(f(5.0) - 30) < 1e-9  # peak at mid-period
+        assert abs(f(0.0) - 10) < 1e-9
+
+    def test_unknown_shape_and_missing_peak(self):
+        with pytest.raises(ValueError, match="unknown shape"):
+            loadgen.make_shape("square", base_rps=1, duration_s=1)
+        with pytest.raises(ValueError, match="peak_rps"):
+            loadgen.make_shape("step", base_rps=1, duration_s=1)
+
+    def test_poisson_schedule_rate_and_determinism(self):
+        f = loadgen.make_shape("constant", base_rps=500, duration_s=2)
+        a = loadgen.poisson_schedule(f, 2.0, seed=7)
+        b = loadgen.poisson_schedule(f, 2.0, seed=7)
+        assert a == b  # seeded: the schedule is reproducible
+        # 1000 expected arrivals; 5 sigma ~ 158
+        assert 842 <= len(a) <= 1158
+        assert all(0 <= t < 2.0 for t in a)
+        assert a == sorted(a)
+
+
+class TestOpenLoop:
+    def test_fired_count_independent_of_target_speed(self):
+        """The open-loop property: a slow target receives the SAME
+        offered schedule — firing never waits for completions."""
+        def slow_target(model_id, points):
+            time.sleep(0.25)
+            return 200, "ok"
+
+        shape = loadgen.make_shape("constant", base_rps=40, duration_s=0.5)
+        rep = loadgen.run_open_loop(
+            slow_target, shape, 0.5, d=2, model_mix={"m": 1.0},
+            seed=3, max_workers=64, hang_timeout_s=5.0)
+        assert rep.fired == rep.offered > 5
+        assert rep.hung == 0
+        assert rep.counts["ok"] == rep.offered
+
+    def test_outcome_classification_and_mix(self):
+        calls = []
+
+        def target(model_id, points):
+            calls.append(model_id)
+            if model_id == "hot":
+                return 503, "shed"
+            return 200, "ok"
+
+        shape = loadgen.make_shape("constant", base_rps=300, duration_s=0.4)
+        rep = loadgen.run_open_loop(
+            target, shape, 0.4, d=2,
+            model_mix={"hot": 0.5, "bg": 0.5}, seed=1, max_workers=64)
+        assert rep.counts["shed"] == rep.by_model["hot"]["shed"] > 0
+        assert rep.counts["ok"] == rep.by_model["bg"]["ok"] > 0
+        assert rep.completed == rep.fired
+        assert set(calls) == {"hot", "bg"}
+
+    def test_hung_requests_are_counted_not_waited_forever(self):
+        release = threading.Event()
+
+        def stuck_target(model_id, points):
+            release.wait()
+            return 200, "ok"
+
+        shape = loadgen.make_shape("constant", base_rps=30, duration_s=0.3)
+        try:
+            rep = loadgen.run_open_loop(
+                stuck_target, shape, 0.3, d=2, model_mix={"m": 1.0},
+                seed=2, max_workers=32, hang_timeout_s=0.3)
+            assert rep.hung == rep.fired > 0
+            assert rep.counts["ok"] == 0
+        finally:
+            release.set()  # let the workers unwind
+
+    def test_raising_target_counted_as_error_not_dropped(self):
+        """Account-for-every-request: a target that RAISES is an 'error'
+        outcome — never a silently lost future (review regression)."""
+        def broken_target(model_id, points):
+            raise RuntimeError("transport exploded")
+
+        shape = loadgen.make_shape("constant", base_rps=60, duration_s=0.3)
+        rep = loadgen.run_open_loop(
+            broken_target, shape, 0.3, d=2, model_mix={"m": 1.0},
+            seed=4, max_workers=32, hang_timeout_s=2.0)
+        assert rep.fired > 0
+        assert rep.completed == rep.fired
+        assert rep.counts["error"] == rep.fired
+        assert rep.hung == 0
+
+    def test_client_percentile_nearest_rank(self):
+        rep = loadgen.LoadReport()
+        rep.client_ms = [float(i) for i in range(1, 101)]
+        assert rep.client_percentile(0.5) == 50.0
+        assert rep.client_percentile(0.99) == 99.0
+        assert math.isnan(loadgen.LoadReport().client_percentile(0.5))
+
+    def test_gauss_points_shape(self):
+        import random
+
+        pts = loadgen.gauss_points(random.Random(0), 3, 5)
+        assert len(pts) == 3 and all(len(p) == 5 for p in pts)
+
+    def test_empty_mix_rejected(self):
+        shape = loadgen.make_shape("constant", base_rps=1, duration_s=0.1)
+        with pytest.raises(ValueError, match="model_mix"):
+            loadgen.run_open_loop(lambda m, p: (200, "ok"), shape, 0.1,
+                                  d=2, model_mix={})
+
+
+# ---------------------------------------------------------------------------
+# Governor state machine (fake signals, injected clock)
+# ---------------------------------------------------------------------------
+
+
+class _FakeBatcher:
+    max_queue_rows = 100
+
+    def __init__(self):
+        self.by_model: dict[str, int] = {}
+
+    @property
+    def queued_rows(self) -> int:
+        return sum(self.by_model.values())
+
+    def queued_rows_for(self, model_id: str) -> int:
+        return self.by_model.get(model_id, 0)
+
+
+class _FakeRegistry:
+    def __init__(self, ids):
+        self._ids = list(ids)
+
+    def ids(self):
+        return self._ids
+
+
+class _FakeLog:
+    def __init__(self):
+        self.events = []
+
+    def event(self, name, **fields):
+        self.events.append((name, fields))
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _gov(models=("km",), hist=None, **cfg):
+    cfg.setdefault("eval_interval_s", 0.05)
+    cfg.setdefault("min_shed_s", 1.0)
+    cfg.setdefault("p99_wait_high_ms", 0.0)  # off unless a test feeds it
+    batcher = _FakeBatcher()
+    log = _FakeLog()
+    clock = _Clock()
+    gov = LoadGovernor(
+        batcher, _FakeRegistry(models), GovernorConfig(**cfg),
+        queue_wait_hist=hist, log=log, clock=clock,
+    )
+    return gov, batcher, log, clock
+
+
+class TestGovernorStateMachine:
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="queue_low_frac"):
+            GovernorConfig(queue_low_frac=0.9, queue_high_frac=0.5)
+        with pytest.raises(ValueError, match="fair_frac"):
+            GovernorConfig(fair_frac=0.0)
+        assert GovernorConfig(p99_wait_high_ms=400).p99_wait_low_ms == 200
+
+    def test_enter_on_queue_depth_and_shed_flooded_model(self):
+        gov, batcher, log, clock = _gov()
+        batcher.by_model["km"] = 80  # 0.8 >= high 0.75
+        admitted, reason = gov.admit("km", 4)
+        assert not admitted and reason == "queue_depth"
+        assert gov.shedding and gov.state_code() == 1
+        assert [e[0] for e in log.events] == ["shed_enter"]
+        assert log.events[0][1]["trigger"] == "queue_depth"
+        assert gov.sheds == 1
+
+    def test_fair_share_admits_light_tenant_mid_shed(self):
+        gov, batcher, _, clock = _gov(models=("km", "gm"))
+        batcher.by_model["km"] = 80
+        assert gov.admit("km", 4) == (False, "queue_depth")
+        # fair share = 0.5 * 100 / 2 models = 25 rows: gm is far under.
+        assert gov.admit("gm", 4) == (True, None)
+        # ... but gm flooding past its share is shed too.
+        batcher.by_model["gm"] = 30
+        assert gov.admit("gm", 4)[0] is False
+
+    def test_hysteresis_exit_needs_min_hold_and_low_watermark(self):
+        gov, batcher, log, clock = _gov()
+        batcher.by_model["km"] = 80
+        gov.admit("km", 4)
+        assert gov.shedding
+        # Queue fully drains, but min_shed_s has not elapsed: still shed.
+        batcher.by_model.clear()
+        clock.t += 0.5
+        gov.maybe_evaluate()
+        assert gov.shedding
+        # Past min_shed_s with the queue below the low watermark: exit.
+        clock.t += 1.0
+        gov.maybe_evaluate()
+        assert not gov.shedding
+        assert [e[0] for e in log.events] == ["shed_enter", "shed_exit"]
+
+    def test_exit_blocked_above_low_watermark(self):
+        gov, batcher, _, clock = _gov()
+        batcher.by_model["km"] = 80
+        gov.admit("km", 4)
+        batcher.by_model["km"] = 50  # 0.5: below high, above low (0.35)
+        clock.t += 5.0
+        gov.maybe_evaluate()
+        assert gov.shedding  # hysteresis holds between the watermarks
+
+    def test_p99_queue_wait_signal_from_histogram_window(self):
+        reg = obs_metrics.Registry()
+        hist = reg.histogram("tdc_serve_queue_wait_ms",
+                             labelnames=("model",))
+        gov, batcher, log, clock = _gov(hist=hist, p99_wait_high_ms=250.0)
+        assert gov.admit("km", 1) == (True, None)  # primes the window
+        for _ in range(40):
+            hist.labels(model="km").observe(600.0)
+        clock.t += 0.1
+        # Shed ENTERS on the windowed p99; with an empty queue every
+        # model is under its fair share, so this request is still
+        # admitted (readiness flips; the LB diverts) ...
+        admitted, _ = gov.admit("km", 1)
+        assert admitted and gov.shedding
+        assert log.events[0][0] == "shed_enter"
+        assert log.events[0][1]["trigger"] == "queue_wait_p99"
+        assert log.events[0][1]["recent_p99_wait_ms"] > 250.0
+        # ... and a model that IS over its share gets shed with the
+        # latency trigger as the recorded reason.
+        batcher.by_model["km"] = 60
+        assert gov.admit("km", 4) == (False, "queue_wait_p99")
+
+    def test_inflight_signal(self):
+        gov, batcher, _, clock = _gov(inflight_high=10)
+        gov._inflight = lambda: 50
+        admitted, _ = gov.admit("km", 1)
+        assert admitted and gov.shedding  # under fair share: admitted
+        batcher.by_model["km"] = 60  # over fair share: shed
+        assert gov.admit("km", 4) == (False, "inflight")
+
+    def test_offered_rps_measured_over_window(self):
+        gov, batcher, _, clock = _gov(eval_interval_s=1.0)
+        gov.admit("km", 1)  # evaluates at t, resets the window
+        for _ in range(50):
+            gov.admit("km", 1)
+        clock.t += 1.0
+        gov.admit("km", 1)  # closes the window: 51 arrivals / ~1 s
+        assert 40.0 <= gov.offered_rps() <= 60.0
+
+    def test_disabled_governor_admits_everything(self):
+        gov, batcher, log, _ = _gov(enabled=False)
+        batcher.by_model["km"] = 100
+        assert gov.admit("km", 50) == (True, None)
+        assert not gov.shedding and log.events == []
+
+    def test_disabled_governor_still_measures_offered_rps(self):
+        """`--shed off` is the A/B arm for comparing overload behavior:
+        tdc_serve_offered_rps must keep measuring (review regression)."""
+        gov, _, _, clock = _gov(enabled=False, eval_interval_s=1.0)
+        gov.admit("km", 1)  # rolls (and resets) the window
+        for _ in range(50):
+            gov.admit("km", 1)
+        clock.t += 1.0
+        gov.admit("km", 1)
+        assert 40.0 <= gov.offered_rps() <= 60.0

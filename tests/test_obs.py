@@ -238,10 +238,14 @@ class TestServeMetricsPayload:
         # Populate every sample source: request counters, latency/queue/
         # device histograms, batcher/engine stats.
         app.request("predict", {"model": "m", "points": [[1.0, 2.0]]})
-        app._hist_latency.labels(endpoint="predict").observe(3.25)
-        app._hist_latency.labels(endpoint="transform").observe(11000.0)
-        app._hist_queue.observe(0.3)
-        app._hist_device.observe(7.5)
+        # PR 15: the serve histograms carry the per-tenant model label
+        # (and queue wait / device ms are per-model).
+        app._hist_latency.labels(endpoint="predict", model="m").observe(3.25)
+        app._hist_latency.labels(
+            endpoint="transform", model="m").observe(11000.0)
+        app._hist_queue.labels(model="m").observe(0.3)
+        app._hist_device.labels(model="m").observe(7.5)
+        app._shed_total.labels(model="m", reason="queue_depth").inc()
         app.batcher.stats["batches"] += 2
         app.batcher.stats["queue_wait_ms_total"] += 0.6
         app.engine.stats["device_ms_total"] += 15.0
@@ -322,13 +326,16 @@ class TestServeMetricsPayload:
                 'status="503"} 1') in text
 
     def test_latency_is_a_real_histogram(self):
+        # Byte pins updated DELIBERATELY in PR 15: the per-tenant model
+        # label (ROADMAP 3a) joins endpoint on the latency family.
         app = _fresh_app()
-        app._hist_latency.labels(endpoint="predict").observe(2.0)
+        app._hist_latency.labels(endpoint="predict", model="m").observe(2.0)
         text = app.metrics_text()
         assert "# TYPE tdc_serve_latency_ms histogram" in text
         assert ('tdc_serve_latency_ms_bucket{endpoint="predict",'
-                'le="+Inf"} 1') in text
-        assert 'tdc_serve_latency_ms_count{endpoint="predict"} 1' in text
+                'model="m",le="+Inf"} 1') in text
+        assert ('tdc_serve_latency_ms_count{endpoint="predict",'
+                'model="m"} 1') in text
         assert 'quantile=' not in text  # the summary is gone
 
     def test_build_info_and_up(self):

@@ -692,6 +692,248 @@ class TestDrainAndReadiness:
         app.stop()
 
 
+class TestAdmissionGovernor:
+    """PR-15 readiness-based shedding on the REAL serve stack: shed 503s
+    before work is queued (with Retry-After), /readyz flips, per-model
+    fairness, the scrape accounts every shed, and the drain-vs-shed
+    double-503 disambiguation."""
+
+    def _gov_app(self, model_root, **kw):
+        from tdc_tpu.serve import GovernorConfig
+
+        kw.setdefault("max_queue_rows", 32)
+        kw.setdefault("max_wait_ms", 1000.0)  # filler stays queued
+        kw.setdefault("governor_config", GovernorConfig(
+            queue_high_frac=0.7, queue_low_frac=0.3,
+            p99_wait_high_ms=0.0,  # isolate the queue-depth signal
+            eval_interval_s=0.01, min_shed_s=0.05, retry_after_s=2.0,
+        ))
+        return _mk_app(model_root, **kw)
+
+    def _fill_queue(self, app, rows_each=8, n=3, model="km"):
+        """Stuff the batcher with queued-but-undispatched work (the long
+        coalesce window holds it) and return the submit futures."""
+        import time as _time
+
+        futs = [
+            asyncio.run_coroutine_threadsafe(
+                app.batcher.submit(
+                    model, "predict",
+                    np.zeros((rows_each, DIM), np.float32)),
+                app._loop,
+            )
+            for _ in range(n)
+        ]
+        deadline = _time.time() + 2.0
+        while app.batcher.queued_rows < rows_each * n:
+            assert _time.time() < deadline, "filler never enqueued"
+            _time.sleep(0.005)
+        return futs
+
+    def _await_ready(self, app, timeout=8.0):
+        import time as _time
+
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            if app.handle_get("/readyz")[0] == 200:
+                return True
+            _time.sleep(0.05)
+        return False
+
+    def test_shed_503_before_queueing_then_recovery(self, model_root):
+        from tdc_tpu.obs.metrics import scrape_counter
+
+        app = self._gov_app(model_root)
+        try:
+            futs = self._fill_queue(app)  # 24/32 rows >= 0.7 high
+            queued_before = app.batcher.stats["requests"]
+            st, body = app.request(
+                "predict",
+                {"model": "km", "points": np.zeros((2, DIM)).tolist()},
+            )
+            assert (st, body["error"], body["reason"]) == \
+                (503, "overloaded", "shed")
+            assert body["trigger"] == "queue_depth"
+            assert body["retry_after_s"] == 2.0
+            # Shed BEFORE the queue: the batcher never saw the request.
+            assert app.batcher.stats["requests"] == queued_before
+            # The scrape accounts it, labeled by model and reason.
+            text = app.metrics_text()
+            assert scrape_counter(
+                text, "tdc_serve_shed_total",
+                {"model": "km", "reason": "queue_depth"}) == 1
+            assert scrape_counter(text, "tdc_serve_admission_state") == 1
+            # Readiness-based: /readyz flips while shedding.
+            st, _, rbody = app.handle_get("/readyz")
+            assert st == 503 and json.loads(rbody)["reason"] == "shedding"
+            # Recovery: filler dispatches, hysteresis elapses, readiness
+            # returns, and traffic is admitted again.
+            for f in futs:
+                f.result(timeout=10)
+            assert self._await_ready(app), "governor never exited shed"
+            st, body = app.request(
+                "predict",
+                {"model": "km", "points": np.zeros((2, DIM)).tolist()},
+            )
+            assert st == 200
+            assert scrape_counter(
+                app.metrics_text(), "tdc_serve_admission_state") == 0
+        finally:
+            app.stop()
+
+    def test_fair_share_spares_light_tenant(self, model_root):
+        app = self._gov_app(model_root)
+        try:
+            futs = self._fill_queue(app, model="km")
+            # km flooded past its fair share (0.5 * 32 / 2 models = 8
+            # rows): shed. gm under its share: served mid-shed.
+            st, body = app.request(
+                "predict",
+                {"model": "km", "points": np.zeros((2, DIM)).tolist()},
+            )
+            assert (st, body["reason"]) == (503, "shed")
+            st, body = app.request(
+                "predict",
+                {"model": "gm", "points": np.zeros((2, DIM)).tolist()},
+            )
+            assert st == 200, body
+            for f in futs:
+                f.result(timeout=10)
+        finally:
+            app.stop()
+
+    def test_retry_after_http_header(self, model_root):
+        import urllib.error
+
+        app = self._gov_app(model_root)
+        port = app.start_http(port=0)
+        try:
+            futs = self._fill_queue(app)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=json.dumps({
+                    "model": "km",
+                    "points": np.zeros((2, DIM)).tolist(),
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") == "2"
+            assert json.loads(ei.value.read())["reason"] == "shed"
+            for f in futs:
+                f.result(timeout=10)
+        finally:
+            app.stop()
+
+    def test_shed_events_logged(self, model_root, tmp_path):
+        from tdc_tpu.utils.structlog import RunLog
+
+        log_path = str(tmp_path / "gov.jsonl")
+        app = self._gov_app(model_root, log=RunLog(log_path))
+        try:
+            futs = self._fill_queue(app)
+            st, _ = app.request(
+                "predict",
+                {"model": "km", "points": np.zeros((2, DIM)).tolist()},
+            )
+            assert st == 503
+            for f in futs:
+                f.result(timeout=10)
+            assert self._await_ready(app)
+        finally:
+            app.stop()
+        names = [json.loads(line)["event"] for line in open(log_path)]
+        assert "shed_enter" in names and "shed_exit" in names
+        enter = next(json.loads(line) for line in open(log_path)
+                     if json.loads(line)["event"] == "shed_enter")
+        assert enter["trigger"] == "queue_depth"
+        assert "queue_frac" in enter and "offered_rps" in enter
+
+    def test_per_tenant_labels_on_scrape(self, fitted, model_root):
+        x, _, _ = fitted
+        app = _mk_app(model_root)
+        try:
+            st, _ = app.request(
+                "predict", {"model": "km", "points": x[:5].tolist()})
+            assert st == 200
+            text = app.metrics_text()
+            # ROADMAP 3a: request families are per-tenant now.
+            assert ('tdc_serve_latency_ms_bucket{endpoint="predict",'
+                    'model="km",') in text
+            assert 'tdc_serve_queue_wait_ms_bucket{model="km",' in text
+            assert ('tdc_serve_engine_batch_device_ms_bucket'
+                    '{model="km",') in text
+        finally:
+            app.stop()
+
+
+class TestDrainShedDisambiguation:
+    """Regression for the latent double-503 ambiguity: a draining server
+    must answer with reason 'drain' and must NEVER count its 503s as
+    admission sheds."""
+
+    def test_draining_server_503_is_drain_not_shed(self, fitted, model_root):
+        from tdc_tpu.obs.metrics import scrape_counter
+
+        x, _, _ = fitted
+        app = _mk_app(model_root)
+        app.stop()
+        st, body = app.request(
+            "predict", {"model": "km", "points": x[:3].tolist()})
+        assert (st, body["error"], body["reason"]) == \
+            (503, "draining", "drain")
+        text = app.metrics_text()
+        assert scrape_counter(text, "tdc_serve_shed_total") == 0
+        # Drain outranks shed on the admission-state gauge.
+        assert scrape_counter(text, "tdc_serve_admission_state") == 2
+
+    def test_batcher_drain_overloaded_maps_to_drain(
+        self, fitted, model_root
+    ):
+        """The sneaky half of the ambiguity: the BATCHER rejecting during
+        drain used to surface as a generic 'overloaded' 503."""
+        from tdc_tpu.obs.metrics import scrape_counter
+
+        x, _, _ = fitted
+        app = _mk_app(model_root)
+        try:
+            app.batcher.draining = True  # drain raced in below the app
+            st, body = app.request(
+                "predict", {"model": "km", "points": x[:3].tolist()})
+            assert (st, body["error"], body["reason"]) == \
+                (503, "draining", "drain")
+            assert scrape_counter(
+                app.metrics_text(), "tdc_serve_shed_total") == 0
+        finally:
+            app.batcher.draining = False
+            app.stop()
+
+    def test_backpressure_503_carries_reason(self, model_root):
+        app = _mk_app(model_root, max_queue_rows=4)
+        try:
+            async def fill():
+                return asyncio.ensure_future(
+                    app.batcher.submit(
+                        "km", "predict", np.zeros((4, DIM), np.float32)
+                    )
+                )
+
+            _run_async(app, fill())
+            # Disable the governor's queue signal so the request reaches
+            # the batcher's hard bound: the 503 must say "backpressure".
+            app.governor.config.enabled = False
+            st, body = app.request(
+                "predict",
+                {"model": "km", "points": np.zeros((3, DIM)).tolist()},
+            )
+            assert (st, body["error"], body["reason"]) == \
+                (503, "overloaded", "backpressure")
+        finally:
+            app.stop()
+
+
 class TestCoarsePredictPlanLifecycle:
     """ISSUE-14: the compiled coarse-predict route (serve/engine.py) —
     plan built once per (model, generation) from the served codebook,
