@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
 # Tier-1 verify, one command (ROADMAP.md "Tier-1 verify"): the CPU-mesh
 # test suite (8 virtual devices via tests/conftest.py) minus slow-marked
-# tests, the comms + resident + spill + subk + bounds + load + obs +
-# chaos smokes, the tdcverify IR-audit stage, and the tdclint
+# tests, the comms + resident + spill + subk + bounds + load + fleet +
+# obs + chaos smokes, the tdcverify IR-audit stage, and the tdclint
 # static-analysis gate. The suite-green invariant every PR must hold.
 #
 #   scripts/ci_tier1.sh            # tests + smokes + verify + lint
@@ -10,8 +10,8 @@
 #
 # Exit code: the FIRST failing stage's code (pytest, then comms smoke,
 # then resident smoke, then spill smoke, then subk smoke, then bounds
-# smoke, then load smoke, then obs smoke, then verify, then chaos
-# smoke, then lint), with
+# smoke, then load smoke, then fleet smoke, then obs smoke, then
+# verify, then chaos smoke, then lint), with
 # every failed stage named on stderr — a run where pytest passes but
 # both smokes fail must say so, not silently collapse into one opaque
 # code.
@@ -114,6 +114,26 @@ if [ -z "$SKIP_LOAD_SMOKE" ]; then
         | tail -n 1 || load_rc=$?
 fi
 
+# Fleet smoke (benchmarks/bench_fleet.py --smoke): the elasticity loop,
+# measured against a REAL 1->3 subprocess fleet behind the readiness-
+# routing proxy with the autoscaler on. Calibrates single-replica
+# saturation, spikes offered load to 2.5x it, and asserts from scrape
+# deltas: the lone replica sheds, the autoscaler scales OUT
+# (tdc_fleet_scale_events_total{direction="up"}), the grown fleet then
+# holds an offered load still above one replica's capacity with ZERO
+# sheds, dropping the load scales back IN through the SIGTERM->drain->
+# exit-75 contract, the draining replica takes zero routed requests
+# while live traffic continues, and no request hangs or sees a
+# transport error in any phase. Measured ~90 s on the CI box
+# (calibration ramp + replica startups + 14 s spike + scale-in wait);
+# 600 covers a loaded box importing jax in 3 replica subprocesses.
+fleet_rc=0
+if [ -z "$SKIP_FLEET_SMOKE" ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        python benchmarks/bench_fleet.py --smoke \
+        | tail -n 1 || fleet_rc=$?
+fi
+
 # Observability smoke (scripts/obs_smoke.py): a tiny traced 2-process
 # gloo-gang streamed fit must export valid Chrome-trace JSON per process
 # (spans nested, per-pass read/stage/compute/reduce phases present) and
@@ -154,7 +174,11 @@ fi
 # one validation window), and the PR-10 flaky-store ingest case (~30%
 # injected transient read failures + one globally-poisoned batch on the
 # 2-process gang: one launch, no collective deadlock, retries > 0,
-# quarantined_batches == 1, within 1e-4 of fault-free). slow-marked so
+# quarantined_batches == 1, within 1e-4 of fault-free), and the PR-16
+# fleet kill -9 case (2 subprocess serve replicas behind the router
+# under live load: kill -9 one, every client request still completes,
+# the autoscaler replaces the casualty outside its cooldown, and fleet
+# teardown drains the survivors to exit 75). slow-marked so
 # the main sweep above keeps its time budget; run here timeout-wrapped
 # (re-measured with the ingest case: ~60 s clean on the CI box — the new
 # soak adds ~5 s, one gang launch with no relaunches; 600 unchanged,
@@ -191,7 +215,8 @@ overall=0
 for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
              "resident-smoke:$resident_rc" "spill-smoke:$spill_rc" \
              "subk-smoke:$subk_rc" "bounds-smoke:$bounds_rc" \
-             "load-smoke:$load_rc" "obs-smoke:$obs_rc" \
+             "load-smoke:$load_rc" "fleet-smoke:$fleet_rc" \
+             "obs-smoke:$obs_rc" \
              "verify:$verify_rc" "chaos-smoke:$chaos_rc" \
              "tdclint:$lint_rc" "ruff:$ruff_rc"; do
     name=${stage%%:*}
@@ -202,6 +227,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, bounds-smoke, load-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, bounds-smoke, load-smoke, fleet-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
 fi
 exit "$overall"

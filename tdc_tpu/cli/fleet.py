@@ -1,0 +1,226 @@
+"""Fleet CLI: N serve replicas behind the readiness-routing proxy.
+
+    python -m tdc_tpu.cli.fleet \
+        --model_root /ckpts/models --replicas 2 --port 8200 \
+        --min_replicas 1 --max_replicas 4
+
+Replicas are `python -m tdc_tpu.cli.serve` children sharing the SAME
+--model/--model_root arguments (one manifest dir is the whole control
+plane: publish a new generation there and every replica hot-reloads
+it). The router answers on --host:--port; each replica gets its own
+fresh localhost port. With --autoscale on (default) the governor-driven
+autoscaler grows the fleet when replicas shed and drains one replica at
+a time when the fleet is calm — scale-in rides the SIGTERM→drain→
+exit-75 contract, so in-flight work always completes.
+
+docs/OPERATIONS.md "Running a fleet" is the runbook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tdc_tpu.fleet",
+        description="Replicated serving behind a readiness-routing proxy",
+    )
+    p.add_argument("--model", action="append", default=[],
+                   metavar="ID=PATH",
+                   help="model spec forwarded to every replica "
+                        "(repeatable)")
+    p.add_argument("--model_root", type=str, default=None,
+                   help="model dir forwarded to every replica — the "
+                        "fleet's shared control plane")
+    p.add_argument("--host", type=str, default="127.0.0.1",
+                   help="router bind host")
+    p.add_argument("--port", type=int, default=8200,
+                   help="router bind port")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="initial replica count")
+    p.add_argument("--min_replicas", type=int, default=1)
+    p.add_argument("--max_replicas", type=int, default=4)
+    p.add_argument("--autoscale", type=str, default="on",
+                   choices=("on", "off"),
+                   help="'off' = fixed fleet (dead replicas are still "
+                        "replaced by the controller poll via the "
+                        "autoscaler's repair path only when 'on')")
+    p.add_argument("--scale_eval_s", type=float, default=0.5,
+                   help="autoscaler evaluation period")
+    p.add_argument("--scale_up_shed_frac", type=float, default=0.5,
+                   help="fraction of live replicas shedding that "
+                        "triggers scale-out")
+    p.add_argument("--scale_up_hold_s", type=float, default=0.5,
+                   help="how long the shed signal must hold before "
+                        "scale-out")
+    p.add_argument("--scale_down_hold_s", type=float, default=3.0,
+                   help="how long the fleet must be calm before "
+                        "scale-in")
+    p.add_argument("--scale_cooldown_s", type=float, default=3.0,
+                   help="minimum spacing between scale decisions")
+    p.add_argument("--scale_p99_wait_ms", type=float, default=0.0,
+                   help="windowed p99 queue wait that also triggers "
+                        "scale-out (0 disables)")
+    p.add_argument("--scale_down_rps", type=float, default=0.0,
+                   help="offered rps per replica below which scale-in "
+                        "is allowed (0 = only the all-admitting gate)")
+    p.add_argument("--poll_interval", type=float, default=2.0,
+                   help="replica hot-reload poll period (forwarded)")
+    p.add_argument("--fleet_poll_s", type=float, default=0.25,
+                   help="router readiness-probe period per replica")
+    p.add_argument("--drain_linger", type=float, default=5.0,
+                   help="replica drain linger (forwarded)")
+    p.add_argument("--warmup_buckets", type=str, default="8,64,512",
+                   help="replica warmup buckets (forwarded)")
+    p.add_argument("--engine_budget", type=int, default=256,
+                   help="replica compiled-engine LRU budget (forwarded)")
+    p.add_argument("--service_ms", type=float, default=0.0,
+                   help="replica synthetic per-batch service time "
+                        "(forwarded; capacity testing)")
+    p.add_argument("--backend", type=str, default=None,
+                   help="replica jax platform override (forwarded)")
+    p.add_argument("--replica_arg", action="append", default=[],
+                   metavar="'--flag value'",
+                   help="extra argument string passed verbatim to every "
+                        "replica (repeatable, shell-split)")
+    p.add_argument("--log_file", type=str, default=None,
+                   help="fleet-level JSONL event log")
+    return p
+
+
+def replica_args_from(args) -> list[str]:
+    """The argv tail every replica is spawned with."""
+    out: list[str] = []
+    for spec in args.model:
+        out += ["--model", spec]
+    if args.model_root:
+        out += ["--model_root", args.model_root]
+    if args.backend:
+        out += ["--backend", args.backend]
+    out += ["--poll_interval", str(args.poll_interval)]
+    out += ["--drain_linger", str(args.drain_linger)]
+    out += ["--warmup_buckets", args.warmup_buckets]
+    out += ["--engine_budget", str(args.engine_budget)]
+    if args.service_ms > 0:
+        out += ["--service_ms", str(args.service_ms)]
+    for extra in args.replica_arg:
+        out += shlex.split(extra)
+    return out
+
+
+def make_fleet(args):
+    """Build (fleet, router, autoscaler, log) from parsed args — the
+    testable seam; nothing is started."""
+    from tdc_tpu.fleet import (
+        Autoscaler,
+        AutoscalerConfig,
+        FleetRouter,
+        ServeFleet,
+        subprocess_spawner,
+    )
+    from tdc_tpu.utils.structlog import RunLog
+
+    log = RunLog(args.log_file)
+    fleet = ServeFleet(
+        subprocess_spawner(replica_args_from(args)),
+        log=log,
+        poll_interval=args.fleet_poll_s,
+        drain_grace_s=max(30.0, args.drain_linger + 25.0),
+    )
+    router = FleetRouter(fleet, log=log)
+    autoscaler = Autoscaler(
+        fleet,
+        AutoscalerConfig(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            eval_interval_s=args.scale_eval_s,
+            up_hold_s=args.scale_up_hold_s,
+            down_hold_s=args.scale_down_hold_s,
+            cooldown_s=args.scale_cooldown_s,
+            shed_frac_high=args.scale_up_shed_frac,
+            p99_wait_high_ms=args.scale_p99_wait_ms,
+            rps_per_replica_low=args.scale_down_rps,
+            enabled=args.autoscale != "off",
+        ),
+        registry=router.registry,
+        log=log,
+    )
+    return fleet, router, autoscaler, log
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not args.model and not args.model_root:
+        parser.error("no models: pass --model ID=PATH or --model_root DIR")
+    fleet, router, autoscaler, log = make_fleet(args)
+
+    # SIGTERM: same discipline as the replica CLI (one raw fd-2 write —
+    # the TDC004 signal-safety rule), then unwind serve_forever so the
+    # drain runs outside the handler. stop_http() blocks until the serve
+    # loop acknowledges the shutdown, and this handler runs ON the serve
+    # loop's thread — hand it to a helper so the handler returns and the
+    # loop can actually unwind (calling it inline self-deadlocks).
+    # Installed BEFORE fleet.start: a SIGTERM landing in the startup
+    # window must still drain the replicas already spawned instead of
+    # killing the front door and orphaning them — `stopping` skips the
+    # serve loop so the finally-drain runs straight away.
+    import signal
+    import threading
+    import time as _time
+
+    stopping = threading.Event()
+
+    def _stop_router():
+        # serve_http may be mid-bind when the signal lands: retry until
+        # there is an httpd to stop (or the main thread saw `stopping`
+        # and never started one — the deadline bounds that case).
+        deadline = _time.monotonic() + 10.0
+        while not router.stop_http() and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+
+    def _term(signum, frame):
+        try:
+            os.write(2, b'{"event": "fleet_drain_begin"}\n')
+        except OSError:
+            pass
+        stopping.set()
+        threading.Thread(
+            target=_stop_router, name="tdc-fleet-term", daemon=True,
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:  # non-main thread (embedded); no signal path
+        pass
+
+    fleet.start(args.replicas)
+    try:
+        if not fleet.wait_ready(1, timeout=120.0) and not stopping.is_set():
+            print("fleet: no replica became ready within 120s", flush=True)
+            fleet.stop(drain=False)
+            return 1
+        if args.autoscale != "off" and not stopping.is_set():
+            autoscaler.start()
+
+        counts = fleet.counts()
+        print(f"fleet router on http://{args.host}:{args.port} "
+              f"(replicas: {counts['ready']} ready / "
+              f"{sum(counts.values())} total)", flush=True)
+        if not stopping.is_set():
+            router.serve_http(args.host, args.port)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        autoscaler.stop()
+        fleet.stop(drain=True)
+        log.event("fleet_stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

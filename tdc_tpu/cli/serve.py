@@ -83,6 +83,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-model fair share of --max_queue_rows "
                         "(x 1/models) still admitted mid-shed, so one "
                         "flooded tenant cannot starve the rest")
+    p.add_argument("--engine_budget", type=int, default=256,
+                   help="compiled-engine LRU budget: how many (model, "
+                        "generation) predict engines stay resident; an "
+                        "evicted model re-admits on its next request "
+                        "with no jit re-trace")
+    p.add_argument("--service_ms", type=float, default=0.0,
+                   help="add this many ms of synthetic per-batch service "
+                        "time after each engine run — a capacity-testing "
+                        "knob (fleet smoke/bench) that makes saturation "
+                        "cheap to reach; 0 (default) = off")
     p.add_argument("--warmup_buckets", type=str, default="8,64,512",
                    help="comma-separated row buckets to pre-compile per "
                         "model ('' skips warmup)")
@@ -175,8 +185,27 @@ def make_app(args):
         mesh = make_mesh_2d(n // args.shard_model, args.shard_model)
     registry = ModelRegistry()
     engine = PredictEngine(
-        mesh, shard_k_threshold=args.shard_k_threshold, log=log
+        mesh,
+        shard_k_threshold=args.shard_k_threshold,
+        engine_budget=getattr(args, "engine_budget", 256),
+        log=log,
     )
+    service_ms = float(getattr(args, "service_ms", 0.0) or 0.0)
+    if service_ms > 0:
+        # Capacity-testing knob: stretch every device batch by a fixed
+        # synthetic service time so fleet smokes/benches reach saturation
+        # at CI-friendly request rates. Instance-attribute wrap — the
+        # engine class (and its jit caches) are untouched.
+        import time as _time
+
+        inner = engine.run
+
+        def _slow_run(entry, method, x, _inner=inner, _ms=service_ms):
+            out = _inner(entry, method, x)
+            _time.sleep(_ms / 1e3)
+            return out
+
+        engine.run = _slow_run
     app = ServeApp(
         registry,
         engine,
@@ -262,6 +291,8 @@ def main(argv=None) -> int:
     # linger expires; app.stop() then flushes in-flight batches and closes.
     import signal
 
+    drained = []  # non-empty once the SIGTERM drain path ran
+
     def _drain(signum, frame):
         # Async-signal context: print/emit into a buffered stderr the
         # signal may have interrupted raises RuntimeError('reentrant
@@ -273,6 +304,7 @@ def main(argv=None) -> int:
                         b'"linger_s": %d}\n' % int(args.drain_linger))
         except OSError:
             pass
+        drained.append(True)
         app.begin_drain(linger=args.drain_linger)
 
     try:
@@ -288,6 +320,13 @@ def main(argv=None) -> int:
         pass
     finally:
         app.stop()
+    if drained:
+        # The supervisor/fleet preemption contract (utils/preempt): a
+        # SIGTERM'd replica that drained cleanly exits 75, so the party
+        # that sent the signal can tell "drained as asked" from "died".
+        from tdc_tpu.utils.preempt import PREEMPTED_EXIT_CODE
+
+        return PREEMPTED_EXIT_CODE
     return 0
 
 

@@ -1,0 +1,52 @@
+"""Serve fleet: replicated ServeApp processes behind a readiness-routing
+proxy, with a governor-driven autoscaler (PR 16).
+
+The scale-by-replication philosophy the reference repo applied inside a
+program (towers across GPUs) applied at the process level: N identical
+servers, one thin router, coordination only through state that already
+exists — the shared manifest dir (model distribution via hot-reload
+polling) and the /readyz + /metrics surfaces.
+
+    fleet/replica.py     one replica: state machine + probe/drain edges
+    fleet/controller.py  ServeFleet: spawn, poll loop, drain/reap
+    fleet/router.py      FleetRouter: readiness-routed reverse proxy +
+                         fleet-level /metrics
+    fleet/autoscaler.py  Autoscaler: hysteresis + cooldown over the
+                         replicas' own scrape signals
+    cli/fleet.py         the `python -m tdc_tpu.cli.fleet` entry point
+"""
+
+from tdc_tpu.fleet.autoscaler import Autoscaler, AutoscalerConfig
+from tdc_tpu.fleet.controller import (
+    ServeFleet,
+    free_port,
+    subprocess_spawner,
+)
+from tdc_tpu.fleet.replica import (
+    CLEAN_EXIT_CODES,
+    DEAD,
+    DRAINING,
+    NOT_READY,
+    READY,
+    STARTING,
+    STATES,
+    Replica,
+)
+from tdc_tpu.fleet.router import FleetRouter
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "CLEAN_EXIT_CODES",
+    "DEAD",
+    "DRAINING",
+    "FleetRouter",
+    "NOT_READY",
+    "READY",
+    "Replica",
+    "STARTING",
+    "STATES",
+    "ServeFleet",
+    "free_port",
+    "subprocess_spawner",
+]

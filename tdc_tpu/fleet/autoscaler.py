@@ -1,0 +1,212 @@
+"""Governor-driven autoscaler: grow/shrink the replica set from the
+signals the replicas already export.
+
+The control loop reads each live replica's /metrics scrape — the SAME
+families a monitoring stack reads, no private RPC: the admission state
+(`tdc_serve_admission_state`, the PR-15 governor's shed/admit bit), the
+measured offered rate (`tdc_serve_offered_rps`), and the scrape-derived
+windowed p99 queue wait (`tdc_serve_queue_wait_ms` bucket deltas
+between consecutive evaluations). Decisions use the governor's own
+discipline one level up: hysteresis (separate up/down signals, each
+sustained for a hold period) plus a cooldown after every action, so a
+noisy boundary cannot flap the fleet.
+
+Scale-out spawns replicas through the controller (they share the
+manifest dir, so they come up serving the same models); scale-in drains
+the victim through the supervisor's SIGTERM→drain→exit-75 contract —
+in-flight work completes inside the replica's linger window, the
+router's readiness poll stops routing to it immediately, and the
+controller reaps it on exit. Replicas that die WITHOUT being asked
+(crash, kill -9) are replaced outside the cooldown: availability
+repair must not wait out a scale-decision damper.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass
+
+from tdc_tpu.fleet.replica import NOT_READY, READY, STARTING
+from tdc_tpu.obs import metrics as obs_metrics
+from tdc_tpu.testing.faults import fault_point
+
+
+@dataclass
+class AutoscalerConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    eval_interval_s: float = 0.5
+    # Hysteresis: the up signal must hold this long before scale-out...
+    up_hold_s: float = 0.5
+    # ...and the calm signal this long before scale-in (asymmetric on
+    # purpose: adding capacity late sheds users, removing it late only
+    # costs a replica-interval of compute).
+    down_hold_s: float = 3.0
+    # Flap damper: no scale decision within this long of the last one.
+    cooldown_s: float = 3.0
+    # Scale-out when at least this fraction of live replicas is shedding
+    # (admission state 1)...
+    shed_frac_high: float = 0.5
+    # ...or when any replica's windowed p99 queue wait exceeds this
+    # (0 disables the latency signal).
+    p99_wait_high_ms: float = 0.0
+    # Scale-in additionally requires offered load per replica below this
+    # (0 disables the rate gate; all-replicas-admitting still required).
+    rps_per_replica_low: float = 0.0
+    up_step: int = 1
+    enabled: bool = True
+
+
+class Autoscaler:
+    """Hysteresis + cooldown control loop over a ServeFleet."""
+
+    def __init__(self, fleet, config: AutoscalerConfig | None = None, *,
+                 registry=None, log=None):
+        self.fleet = fleet
+        self.config = config or AutoscalerConfig()
+        self.log = log
+        reg = registry or obs_metrics.Registry()
+        self._scale_events = reg.counter(
+            "tdc_fleet_scale_events_total", labelnames=("direction",)
+        )
+        self._up_since: float | None = None
+        self._down_since: float | None = None
+        self._last_scale = -math.inf
+        self._prev_scrapes: dict[str, str] = {}
+        self._thread: threading.Thread | None = None
+        self._stop_evt = threading.Event()
+
+    # ---------------- signals ----------------
+
+    def signals(self) -> dict:
+        """One fleet-wide reading off the live replicas' scrapes."""
+        live = [r for r in self.fleet.snapshot()
+                if r.state in (READY, NOT_READY)]
+        shedding = 0
+        offered = 0.0
+        p99 = float("nan")
+        scraped = 0
+        fresh: dict[str, str] = {}
+        for r in live:
+            text = r.scrape()
+            if text is None:
+                continue
+            scraped += 1
+            fresh[r.name] = text
+            state = obs_metrics.scrape_counter(
+                text, "tdc_serve_admission_state"
+            )
+            if state == 1:
+                shedding += 1
+            offered += obs_metrics.scrape_counter(
+                text, "tdc_serve_offered_rps"
+            )
+            prev = self._prev_scrapes.get(r.name)
+            if prev is not None:
+                q = obs_metrics.scrape_quantile(
+                    text, "tdc_serve_queue_wait_ms", 0.99, baseline=prev
+                )
+                if not math.isnan(q) and not (p99 >= q):
+                    p99 = q
+        self._prev_scrapes = fresh
+        return {
+            "n_live": scraped,
+            "shedding": shedding,
+            "shed_frac": (shedding / scraped) if scraped else 0.0,
+            "offered_rps": offered,
+            "p99_wait_ms": p99,
+        }
+
+    # ---------------- decisions ----------------
+
+    def _population(self) -> int:
+        """Replicas counted against min/max: everything alive or coming
+        up (draining/dead ones are already on the way out)."""
+        return sum(1 for r in self.fleet.snapshot()
+                   if r.state in (STARTING, READY, NOT_READY))
+
+    def _record(self, direction: str, **fields) -> None:
+        self._scale_events.labels(direction=direction).inc()
+        if self.log is not None:
+            self.log.event("fleet_scale", direction=direction, **fields)
+
+    def evaluate_once(self) -> dict:
+        """One control step: replace the dead, then apply the
+        hysteresis'd scale decision. Returns the signals it acted on."""
+        cfg = self.config
+        now = time.monotonic()
+        for r in self.fleet.dead_replicas():
+            fault_point("fleet.scale")
+            self.fleet.remove(r)
+            self._prev_scrapes.pop(r.name, None)
+            self.fleet.add_replica()
+            self._record("replace", replica=r.name,
+                         exit_code=r.exit_code)
+        sig = self.signals()
+        if not cfg.enabled:
+            return sig
+        n = self._population()
+        want_up = (
+            sig["n_live"] > 0
+            and (sig["shed_frac"] >= cfg.shed_frac_high
+                 or (cfg.p99_wait_high_ms > 0
+                     and sig["p99_wait_ms"] >= cfg.p99_wait_high_ms))
+        )
+        want_down = (
+            sig["n_live"] > 0
+            and sig["shedding"] == 0
+            and (cfg.rps_per_replica_low <= 0
+                 or sig["offered_rps"] / max(n, 1)
+                 < cfg.rps_per_replica_low)
+        )
+        self._up_since = (self._up_since or now) if want_up else None
+        self._down_since = (self._down_since or now) if want_down else None
+        cooled = now - self._last_scale >= cfg.cooldown_s
+        if (self._up_since is not None and cooled and n < cfg.max_replicas
+                and now - self._up_since >= cfg.up_hold_s):
+            fault_point("fleet.scale")
+            added = 0
+            for _ in range(min(cfg.up_step, cfg.max_replicas - n)):
+                self.fleet.add_replica()
+                added += 1
+            self._last_scale = now
+            self._up_since = None
+            self._record("up", added=added, **sig)
+        elif (self._down_since is not None and cooled
+                and n > cfg.min_replicas
+                and now - self._down_since >= cfg.down_hold_s):
+            fault_point("fleet.scale")
+            victim = self.fleet.drain_replica()
+            if victim is not None:
+                self._last_scale = now
+                self._down_since = None
+                self._prev_scrapes.pop(victim.name, None)
+                self._record("down", replica=victim.name, **sig)
+        return sig
+
+    # ---------------- loop ----------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="tdc-fleet-autoscale", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop_evt.wait(self.config.eval_interval_s):
+            try:
+                self.evaluate_once()
+            except Exception as e:  # keep the loop alive; log and retry
+                if self.log is not None:
+                    self.log.event("fleet_scale_error",
+                                   error=f"{type(e).__name__}: {e}")
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,204 @@
+"""Fleet controller: owns the replica set and its lifecycle.
+
+The control plane is deliberately thin: replicas share ONE manifest dir
+(`--model_root`), so model distribution rides the registry's existing
+hot-reload polling — publishing a new generation into the dir reaches
+every replica within a poll interval, with no new consensus machinery.
+The controller only has to (1) spawn replicas, (2) probe their
+/readyz-derived state on a poll loop, (3) drain them on scale-in with
+the supervisor's SIGTERM→drain→exit-75 contract, and (4) surface the
+state counts the router and autoscaler act on.
+
+Spawning is pluggable: production passes `subprocess_spawner` (a
+`python -m tdc_tpu.cli.serve` child per replica on a controller-assigned
+port); tests pass a factory that wraps in-process
+`ServeApp.start_http()` apps. Both go through the
+`fleet.replica_spawn` fault point.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from tdc_tpu.fleet.replica import (
+    DEAD,
+    DRAINING,
+    READY,
+    STATES,
+    Replica,
+)
+from tdc_tpu.testing.faults import fault_point
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """A currently-free TCP port (bind-then-release; the tiny race is
+    acceptable for controller-assigned replica ports)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def subprocess_spawner(replica_args, *, host: str = "127.0.0.1",
+                       python=None, env=None):
+    """Factory for the production spawn path: each replica is a
+    `python -m tdc_tpu.cli.serve <replica_args> --host H --port P` child
+    on a fresh controller-assigned port. Returns `spawn(name) ->
+    Replica` for ServeFleet."""
+    python = python or sys.executable
+
+    def spawn(name: str) -> Replica:
+        port = free_port(host)
+        cmd = [python, "-m", "tdc_tpu.cli.serve", *replica_args,
+               "--host", host, "--port", str(port)]
+        proc = subprocess.Popen(
+            cmd,
+            env=env if env is not None else os.environ.copy(),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return Replica(name, f"http://{host}:{port}", proc=proc)
+
+    return spawn
+
+
+class ServeFleet:
+    """Replica set + poll loop + drain machinery."""
+
+    def __init__(self, spawn, *, log=None, poll_interval: float = 0.25,
+                 probe_timeout: float = 1.0, drain_grace_s: float = 30.0):
+        self._spawn = spawn
+        self.log = log
+        self.poll_interval = float(poll_interval)
+        self.probe_timeout = float(probe_timeout)
+        self.drain_grace_s = float(drain_grace_s)
+        self.replicas: list[Replica] = []
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._poller: threading.Thread | None = None
+        self._stop_evt = threading.Event()
+
+    # ---------------- replica set ----------------
+
+    def add_replica(self) -> Replica:
+        """Spawn one replica and add it to the set (state: starting)."""
+        with self._lock:
+            name = f"r{self._seq}"
+            self._seq += 1
+        fault_point("fleet.replica_spawn")
+        replica = self._spawn(name)
+        with self._lock:
+            self.replicas.append(replica)
+        if self.log is not None:
+            self.log.event("fleet_replica_spawned", replica=replica.name,
+                           url=replica.base_url)
+        return replica
+
+    def drain_replica(self, replica: Replica | None = None) -> Replica | None:
+        """Begin draining one replica (default: the last ready one). The
+        replica keeps answering in-flight work through its linger window
+        and is reaped from the set once it exits."""
+        with self._lock:
+            if replica is None:
+                ready = [r for r in self.replicas if r.state == READY]
+                replica = ready[-1] if ready else None
+            if replica is None:
+                return None
+        replica.begin_drain()
+        if self.log is not None:
+            self.log.event("fleet_replica_draining", replica=replica.name)
+        return replica
+
+    def snapshot(self) -> list[Replica]:
+        with self._lock:
+            return list(self.replicas)
+
+    def ready_replicas(self) -> list[Replica]:
+        with self._lock:
+            return [r for r in self.replicas if r.state == READY]
+
+    def counts(self) -> dict[str, int]:
+        """state -> replica count, zero-filled over every state so the
+        router's `tdc_fleet_replicas` gauge keeps stable series."""
+        out = {s: 0 for s in STATES}
+        for r in self.snapshot():
+            out[r.state] += 1
+        return out
+
+    def dead_replicas(self) -> list[Replica]:
+        """Replicas that died WITHOUT being asked to drain — the
+        autoscaler's replace signal. (Drained replicas are reaped by
+        poll_once and never appear here.)"""
+        with self._lock:
+            return [r for r in self.replicas if r.state == DEAD]
+
+    def remove(self, replica: Replica) -> None:
+        with self._lock:
+            if replica in self.replicas:
+                self.replicas.remove(replica)
+
+    # ---------------- poll loop ----------------
+
+    def poll_once(self) -> None:
+        """Probe every replica; reap the ones whose drain completed."""
+        for r in self.snapshot():
+            draining = r.state == DRAINING
+            state = r.probe(timeout=self.probe_timeout)
+            if state == DEAD and draining:
+                self.remove(r)
+                if self.log is not None:
+                    self.log.event("fleet_replica_drained",
+                                   replica=r.name, exit_code=r.exit_code,
+                                   clean=r.drained_clean())
+
+    def start(self, n: int = 0) -> None:
+        """Spawn `n` initial replicas and start the poll loop."""
+        for _ in range(int(n)):
+            self.add_replica()
+        if self._poller is None:
+            self._stop_evt.clear()
+            self._poller = threading.Thread(
+                target=self._poll_loop, name="tdc-fleet-poll", daemon=True
+            )
+            self._poller.start()
+
+    def _poll_loop(self) -> None:
+        while not self._stop_evt.wait(self.poll_interval):
+            self.poll_once()
+
+    def wait_ready(self, n: int = 1, timeout: float = 60.0) -> bool:
+        """Block until >= n replicas are ready (True) or timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.poll_once()
+            if len(self.ready_replicas()) >= n:
+                return True
+            time.sleep(min(self.poll_interval, 0.1))
+        return False
+
+    def stop(self, drain: bool = True) -> None:
+        """Drain (or kill) every replica and stop the poll loop."""
+        self._stop_evt.set()
+        if self._poller is not None:
+            self._poller.join(timeout=5.0)
+            self._poller = None
+        for r in self.snapshot():
+            if drain:
+                r.begin_drain()
+        deadline = time.monotonic() + (self.drain_grace_s if drain else 0.0)
+        for r in self.snapshot():
+            if r.proc is None:
+                continue
+            while r.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if r.proc.poll() is None:
+                r.kill()
+                r.proc.wait(timeout=10.0)
+            r.exit_code = r.proc.returncode
+            r.state = DEAD
+        with self._lock:
+            self.replicas.clear()

@@ -1,0 +1,130 @@
+"""One serve replica as the fleet sees it: an HTTP base URL, an optional
+OS process, and a readiness-derived lifecycle state.
+
+States::
+
+    starting --(readyz 200)--> ready <--> not_ready
+        ready/not_ready --begin_drain()--> draining --(exit)--> dead
+        any --(process exit without drain)--> dead
+
+The state machine is driven by `probe()` (the controller's poll loop)
+plus two event edges: `begin_drain()` (SIGTERM for subprocess replicas —
+the serve CLI's drain contract: /readyz flips 503 immediately, the
+listener lingers, the process exits PREEMPTED_EXIT_CODE) and
+`mark_not_ready()` (router feedback: a shed 503 or connect error means
+this replica must stop receiving traffic NOW, one poll interval earlier
+than the next probe would notice).
+
+A replica needs no subprocess: tests wrap an in-process
+`ServeApp.start_http()` port with a `stop` callable, and the whole
+router/autoscaler stack runs against it unchanged.
+"""
+
+from __future__ import annotations
+
+import signal
+import urllib.error
+import urllib.request
+
+from tdc_tpu.utils.preempt import PREEMPTED_EXIT_CODE
+
+STARTING = "starting"
+READY = "ready"
+NOT_READY = "not_ready"
+DRAINING = "draining"
+DEAD = "dead"
+
+STATES = (STARTING, READY, NOT_READY, DRAINING, DEAD)
+
+# Exit codes that mean "drained as asked" on scale-in: 0 (clean unwind)
+# and the utils/preempt SIGTERM contract.
+CLEAN_EXIT_CODES = (0, PREEMPTED_EXIT_CODE)
+
+
+class Replica:
+    """Fleet-side handle for one serve process (or in-process app)."""
+
+    def __init__(self, name: str, base_url: str, *, proc=None, stop=None):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.proc = proc  # subprocess.Popen | None
+        self._stop = stop  # in-process drain callable | None
+        self.state = STARTING
+        self.exit_code: int | None = None
+
+    # ---------------- probing ----------------
+
+    def probe(self, timeout: float = 1.0) -> str:
+        """Refresh `state` from the process table and /readyz."""
+        if self.proc is not None:
+            rc = self.proc.poll()
+            if rc is not None:
+                self.exit_code = rc
+                self.state = DEAD
+                return self.state
+        try:
+            with urllib.request.urlopen(
+                self.base_url + "/readyz", timeout=timeout
+            ):
+                status = 200
+        except urllib.error.HTTPError as e:
+            status = e.code
+        except OSError:
+            # Not answering at all: still booting (jax import) or gone.
+            if self.state not in (STARTING, DRAINING):
+                self.state = NOT_READY
+            return self.state
+        if self.state == DRAINING:
+            # Drain is sticky: the lingering listener answers 503 until
+            # exit; never re-admit a draining replica to the ready set.
+            return self.state
+        self.state = READY if status == 200 else NOT_READY
+        return self.state
+
+    def scrape(self, timeout: float = 2.0) -> str | None:
+        """This replica's /metrics text, or None if unreachable."""
+        try:
+            with urllib.request.urlopen(
+                self.base_url + "/metrics", timeout=timeout
+            ) as resp:
+                return resp.read().decode()
+        except OSError:
+            return None
+
+    # ---------------- event edges ----------------
+
+    def mark_not_ready(self) -> None:
+        """Router feedback: this replica shed or refused a forwarded
+        request — pull it from the ready set ahead of the next probe."""
+        if self.state == READY:
+            self.state = NOT_READY
+
+    def begin_drain(self) -> None:
+        """Start the drain: SIGTERM for subprocess replicas (the serve
+        CLI flips /readyz and lingers), the `stop` callable otherwise."""
+        if self.state in (DRAINING, DEAD):
+            return
+        self.state = DRAINING
+        if self.proc is not None:
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                pass
+        elif self._stop is not None:
+            self._stop()
+
+    def kill(self) -> None:
+        """Hard-stop a replica that refused to drain (escalation only)."""
+        if self.proc is not None:
+            try:
+                self.proc.kill()
+            except (ProcessLookupError, OSError):
+                pass
+
+    def drained_clean(self) -> bool:
+        """True if the replica exited with a clean-drain code."""
+        return self.exit_code in CLEAN_EXIT_CODES
+
+    def __repr__(self) -> str:  # debugging/logs only
+        return (f"Replica({self.name!r}, {self.base_url!r}, "
+                f"state={self.state!r})")

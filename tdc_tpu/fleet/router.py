@@ -1,0 +1,256 @@
+"""Readiness-routing reverse proxy over a replica fleet.
+
+One stdlib HTTP front door for N ServeApp replicas. Routing is
+READINESS-DRIVEN, not response-driven: the controller's poll loop keeps
+each replica's /readyz-derived state fresh, so a shedding or draining
+replica leaves the routable set BEFORE it would answer 503 — the router
+consults state it already has instead of discovering overload one
+failed request at a time. Two event edges tighten the window the poll
+interval leaves open: a forwarded request that comes back shed/drain
+(or fails to connect) marks its replica not_ready on the spot and fails
+over ONCE to a different ready replica; only when no replica is ready
+does the fleet itself answer 503 with a Retry-After.
+
+The router is also the fleet's scrape endpoint: its /metrics renders
+the fleet-level families (`tdc_fleet_replicas` by state,
+`tdc_fleet_routed_total` by replica and outcome, failover/unrouted
+counters, and the autoscaler's `tdc_fleet_scale_events_total` when one
+is attached) through the same obs/metrics Registry/CATALOG path the
+replicas use — `obs.loadgen.HttpTarget` pointed at the router works
+unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tdc_tpu.obs import metrics as obs_metrics
+from tdc_tpu.testing.faults import fault_point
+
+# Replica 503 `reason` values the router recognizes; shed and drain
+# trigger failover (the replica is overloaded/leaving and a peer may be
+# fine), backpressure passes through (the bounded queue spoke — a peer
+# may still help, but the client was promised explicit backpressure).
+_FAILOVER_REASONS = ("shed", "drain")
+
+
+class FleetRouter:
+    """Reverse proxy + fleet scrape surface over a ServeFleet."""
+
+    def __init__(self, fleet, *, registry=None, log=None,
+                 retry_after_s: float = 1.0,
+                 forward_timeout_s: float = 35.0):
+        self.fleet = fleet
+        self.log = log
+        self.retry_after_s = float(retry_after_s)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.registry = registry or obs_metrics.Registry()
+        self._rr = itertools.count()
+        self._httpd: ThreadingHTTPServer | None = None
+        reg = self.registry
+        reg.callback(
+            "tdc_fleet_replicas",
+            lambda: [({"state": s}, n)
+                     for s, n in sorted(self.fleet.counts().items())],
+        )
+        self._routed = reg.counter(
+            "tdc_fleet_routed_total", labelnames=("replica", "outcome")
+        )
+        self._unrouted = reg.counter("tdc_fleet_unrouted_total")
+        self._failovers = reg.counter("tdc_fleet_failovers_total")
+        reg.callback("tdc_up", lambda: 1)
+
+    # ---------------- routing ----------------
+
+    def _pick(self, exclude):
+        ready = [r for r in self.fleet.ready_replicas()
+                 if r not in exclude]
+        if not ready:
+            return None
+        return ready[next(self._rr) % len(ready)]
+
+    def _forward(self, replica, method: str, path: str, body):
+        """One proxied request. Returns (status, ctype, data,
+        retry_after); raises OSError on connect/transport failure."""
+        req = urllib.request.Request(
+            replica.base_url + path, data=body, method=method
+        )
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.forward_timeout_s
+            ) as resp:
+                return (resp.status,
+                        resp.headers.get("Content-Type",
+                                         "application/json"),
+                        resp.read(),
+                        resp.headers.get("Retry-After"))
+        except urllib.error.HTTPError as e:
+            return (e.code,
+                    e.headers.get("Content-Type", "application/json"),
+                    e.read(),
+                    e.headers.get("Retry-After"))
+
+    @staticmethod
+    def _outcome(status: int, data: bytes) -> str:
+        if status != 503:
+            return "ok"
+        try:
+            reason = json.loads(data or b"{}").get("reason", "")
+        except (ValueError, TypeError):
+            reason = ""
+        return reason if reason in ("shed", "backpressure", "drain") \
+            else "error"
+
+    def route(self, method: str, path: str, body):
+        """Forward one request: readiness-picked replica, single-retry
+        failover on shed/drain/connect-error, fleet 503 when nothing is
+        ready. Returns (status, ctype, data_bytes, retry_after|None)."""
+        tried: list = []
+        last = None
+        for attempt in (0, 1):
+            replica = self._pick(tried)
+            if replica is None:
+                break
+            if attempt == 1:
+                self._failovers.inc()
+                if self.log is not None:
+                    self.log.event("fleet_failover", path=path,
+                                   replica=replica.name)
+            fault_point("fleet.route")
+            try:
+                status, ctype, data, retry_after = self._forward(
+                    replica, method, path, body
+                )
+            except OSError:
+                self._routed.labels(
+                    replica=replica.name, outcome="error"
+                ).inc()
+                replica.mark_not_ready()
+                tried.append(replica)
+                continue
+            outcome = self._outcome(status, data)
+            self._routed.labels(
+                replica=replica.name, outcome=outcome
+            ).inc()
+            if outcome in _FAILOVER_REASONS and attempt == 0:
+                replica.mark_not_ready()
+                tried.append(replica)
+                last = (status, ctype, data, retry_after)
+                continue
+            return status, ctype, data, retry_after
+        if last is not None:
+            # Failover had nowhere to go: relay the replica's 503 (it
+            # carries the honest reason + Retry-After) rather than
+            # masking it with a fleet-level one.
+            return last
+        self._unrouted.inc()
+        if self.log is not None:
+            self.log.event("fleet_unrouted", path=path)
+        payload = {
+            "error": "overloaded",
+            "reason": "shed",
+            "trigger": "no_ready_replica",
+            "retry_after_s": self.retry_after_s,
+        }
+        return (503, "application/json", json.dumps(payload).encode(),
+                str(max(1, round(self.retry_after_s))))
+
+    # ---------------- local (non-proxied) GETs ----------------
+
+    def handle_get(self, path: str):
+        """Router-local GET endpoints; returns (status, ctype, text) or
+        None when the path should be proxied to a replica."""
+        if path == "/metrics":
+            return 200, "text/plain; version=0.0.4", self.registry.render()
+        counts = self.fleet.counts()
+        if path == "/healthz":
+            return 200, "application/json", json.dumps(
+                {"status": "ok", "replicas": counts}
+            )
+        if path == "/readyz":
+            if counts["ready"] > 0:
+                return 200, "application/json", json.dumps(
+                    {"status": "ok", "ready_replicas": counts["ready"]}
+                )
+            return 503, "application/json", json.dumps(
+                {"status": "unready", "replicas": counts}
+            )
+        return None
+
+    # ---------------- HTTP transport ----------------
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 8200):
+        """Blocking router serve loop (the CLI path)."""
+        self._httpd = _make_router_httpd(self, host, port)
+        try:
+            self._httpd.serve_forever()
+        finally:
+            httpd, self._httpd = self._httpd, None
+            if httpd is not None:
+                httpd.server_close()
+
+    def start_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Non-blocking router serving on a daemon thread; returns the
+        bound port (port=0 picks a free one — the test path)."""
+        self._httpd = _make_router_httpd(self, host, port)
+        bound = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, name="tdc-fleet-http",
+            daemon=True,
+        ).start()
+        return bound
+
+    def stop_http(self) -> bool:
+        """Stop the HTTP serve loop; returns False when none was running.
+
+        Blocks until serve_forever acknowledges — never call from the
+        serving thread itself (the CLI's SIGTERM handler hands this to a
+        helper thread for exactly that reason)."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return False
+        httpd.shutdown()
+        httpd.server_close()
+        return True
+
+
+def _make_router_httpd(router: FleetRouter, host: str,
+                       port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # structlog, not stderr noise
+            if router.log is not None:
+                router.log.event("http", line=fmt % args)
+
+        def _reply(self, status, ctype, data: bytes,
+                   retry_after=None) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            if retry_after is not None:
+                self.send_header("Retry-After", retry_after)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            local = router.handle_get(self.path)
+            if local is not None:
+                status, ctype, text = local
+                self._reply(status, ctype, text.encode())
+                return
+            self._reply(*router.route("GET", self.path, None))
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length) if length else b"{}"
+            self._reply(*router.route("POST", self.path, body))
+
+    return ThreadingHTTPServer((host, port), Handler)

@@ -60,6 +60,13 @@ CATALOG = {
         "counter", "jit traces paid (bucket warmup)."),
     "tdc_serve_engine_device_ms_total": (
         "counter", "Device compute milliseconds."),
+    # whole-engine LRU (serve/engine.py, PR 16)
+    "tdc_serve_engine_evictions_total": (
+        "counter", "Compiled engines evicted by the engine LRU under "
+                   "budget pressure (serve/engine.py)."),
+    "tdc_serve_engine_cached": (
+        "gauge", "Compiled (model, generation) engines resident in the "
+                 "engine LRU."),
     "tdc_serve_queue_wait_ms_total": (
         "counter", "Milliseconds requests spent queued before dispatch."),
     "tdc_serve_models": (
@@ -186,6 +193,22 @@ CATALOG = {
         "gauge", "serve/online updater metric."),
     "tdc_online_assignment_churn": (
         "gauge", "serve/online updater metric."),
+    # serve fleet: readiness-routing proxy + autoscaler (tdc_tpu/fleet/,
+    # PR 16). Exported by the ROUTER's registry, not the replicas'.
+    "tdc_fleet_replicas": (
+        "gauge", "Fleet replicas by lifecycle state (starting, ready, "
+                 "not_ready, draining, dead)."),
+    "tdc_fleet_routed_total": (
+        "counter", "Requests the router forwarded, by replica and outcome "
+                   "(ok, shed, backpressure, drain, error)."),
+    "tdc_fleet_unrouted_total": (
+        "counter", "Requests answered 503 at the fleet level because no "
+                   "replica was ready."),
+    "tdc_fleet_failovers_total": (
+        "counter", "Routed requests retried on a second replica after a "
+                   "shed or connect error."),
+    "tdc_fleet_scale_events_total": (
+        "counter", "Autoscaler actions by direction (up, down, replace)."),
 }
 
 # Fixed buckets for the serve latency/queue-wait/device-ms histograms, in

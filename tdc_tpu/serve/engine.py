@@ -38,6 +38,21 @@ compiled state. `probe="all"` resolves to the exact route
 `predict_proba`/`transform` need every K distance by definition and
 stay exact. Pruned-tile accounting lands on ops/subk.GLOBAL_PREDICT
 (`tdc_predict_*` on /metrics).
+
+Whole-engine LRU (fleet tentpole): the same budget discipline the plan
+cache applies to coarse plans is applied to a model's ENTIRE compiled
+predict state — closures in `_fns`, warm `compiled_keys`, the coarse
+plan, and the engine-owned device placements cached on the registry
+entry (`sharded_centroids`, `coarse_spec`). `engine_budget` bounds how
+many (model, generation) engines stay resident, so hundreds of
+registered models fit one replica. Eviction is memory-only, never
+correctness: an evicted model re-admits on its next request by
+re-filling the key cache (`stats["compiles"]` counts the fill), but the
+underlying jitted callables are SHARED module-level objects keyed by
+shape — `jit_cache_size()` is unchanged across an evict/re-admit cycle,
+so re-admission costs zero re-traces and responses stay bit-exact. A
+hot reload bumps the generation and `_evict_stale` retires the old
+engine exactly as before; the LRU only adds the capacity axis.
 """
 
 from __future__ import annotations
@@ -70,6 +85,22 @@ def _next_pow2(n: int) -> int:
 # bucket-padding zero rows are ordinary points whose labels the caller
 # slices off.
 _COARSE_PREDICT_FNS: dict = {}
+
+# Per-mesh jitted sharded-assign callables: module-level so rebuilding a
+# model's sharded predict closure (hot reload, engine-LRU re-admission)
+# reuses the SAME executable instead of re-tracing — the fn closes over
+# nothing model-specific, only the mesh.
+_SHARDED_ASSIGN_FNS: dict = {}
+
+
+def _sharded_assign_fn(mesh):
+    fn = _SHARDED_ASSIGN_FNS.get(mesh)
+    if fn is None:
+        from tdc_tpu.parallel.sharded_k import sharded_assign
+
+        fn = jax.jit(sharded_assign(mesh))
+        _SHARDED_ASSIGN_FNS[mesh] = fn
+    return fn
 
 
 def _coarse_predict_fn(spec):
@@ -119,6 +150,7 @@ class PredictEngine:
         min_bucket: int = 8,
         max_bucket: int = 1 << 15,
         plan_budget: int = 8,
+        engine_budget: int = 256,
         log=None,
     ):
         self.mesh = mesh
@@ -134,6 +166,17 @@ class PredictEngine:
         # (model_id, generation) -> (CoarseSpec, CoarsePlan), LRU order.
         self._plans: collections.OrderedDict = collections.OrderedDict()
         self._plan_lock = threading.Lock()
+        # Whole-engine LRU: how many (model, generation) compiled engines
+        # stay resident. Each holds closures + warm keys + plan + the
+        # engine-owned device placements on the entry; the value is the
+        # entry's placements dict so eviction can free those placements
+        # even after the registry swapped the entry out.
+        self.engine_budget = int(engine_budget)
+        if self.engine_budget < 1:
+            raise ValueError("engine_budget must be >= 1")
+        # (model_id, generation) -> entry.placements, LRU order.
+        self._engines: collections.OrderedDict = collections.OrderedDict()
+        self._engine_lock = threading.Lock()
         self.log = log
         self._fns: dict[tuple, Callable] = {}
         self.compiled_keys: set[tuple] = set()  # (id, gen, method, bucket, kernel)
@@ -142,6 +185,7 @@ class PredictEngine:
             "rows": 0,
             "padded_rows": 0,
             "compiles": 0,
+            "engine_evictions": 0,
             "device_ms_total": 0.0,
         }
         # Optional obs/metrics.Histogram: per-batch device-ms samples
@@ -289,6 +333,58 @@ class PredictEngine:
             ]
             for pk in stale_plans:
                 del self._plans[pk]
+        with self._engine_lock:
+            for ek in [
+                ek for ek in self._engines
+                if ek[0] == entry.model_id and ek[1] < entry.generation
+            ]:
+                del self._engines[ek]
+
+    # ---------------- whole-engine LRU ----------------
+
+    def _touch_engine(self, entry: ModelEntry) -> None:
+        """Mark this (model, generation) engine most-recently-used; evict
+        the oldest-used engines past `engine_budget`. The just-touched
+        engine is inserted before the overflow check, so it can never be
+        the one evicted."""
+        key = (entry.model_id, entry.generation)
+        evicted = []
+        with self._engine_lock:
+            if key in self._engines:
+                self._engines.move_to_end(key)
+                return
+            self._engines[key] = entry.placements
+            while len(self._engines) > self.engine_budget:
+                evicted.append(self._engines.popitem(last=False))
+        for (mid, gen), placements in evicted:
+            self._evict_engine(mid, gen, placements)
+
+    def _evict_engine(self, mid: str, gen: int, placements: dict) -> None:
+        """Free every piece of compiled state for one (model, generation):
+        closures, warm keys, coarse plan, and the engine-owned device
+        placements on the entry. Memory-only — the shared module-level
+        jitted callables stay warm, so re-admission re-fills the key
+        cache without a single re-trace."""
+        def ours(key) -> bool:
+            if key[0] == "__sharded__":
+                return key[1] == mid and key[2] == gen
+            return key[0] == mid and key[1] == gen
+
+        for k in [k for k in self._fns if ours(k)]:
+            del self._fns[k]
+        self.compiled_keys = {k for k in self.compiled_keys if not ours(k)}
+        with self._plan_lock:
+            self._plans.pop((mid, gen), None)
+        placements.pop("sharded_centroids", None)
+        placements.pop("coarse_spec", None)
+        self.stats["engine_evictions"] += 1
+        if self.log is not None:
+            self.log.event("engine_evicted", model=mid, generation=gen)
+
+    def engines_cached(self) -> int:
+        """Resident (model, generation) engines in the LRU."""
+        with self._engine_lock:
+            return len(self._engines)
 
     def _build_fn(self, entry: ModelEntry, method: str, kernel: str):
         """One closure over the entry's device-resident parameters. The
@@ -362,11 +458,7 @@ class PredictEngine:
     def _build_sharded_predict(self, entry: ModelEntry, spherical: bool):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from tdc_tpu.parallel.sharded_k import (
-            DATA_AXIS,
-            MODEL_AXIS,
-            sharded_assign,
-        )
+        from tdc_tpu.parallel.sharded_k import DATA_AXIS, MODEL_AXIS
 
         key = "sharded_centroids"
         if key not in entry.placements:
@@ -381,7 +473,7 @@ class PredictEngine:
                 NamedSharding(self.mesh, P(MODEL_AXIS, None)),
             )
         c_sharded = entry.placements[key]
-        assign = jax.jit(sharded_assign(self.mesh))
+        assign = _sharded_assign_fn(self.mesh)
         data_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
         self._fns[("__sharded__", entry.model_id, entry.generation)] = assign
 
@@ -413,6 +505,7 @@ class PredictEngine:
         bucket = self.bucket(n)
         kernel = self._resolve_kernel(entry, method)
         self._evict_stale(entry)
+        self._touch_engine(entry)
         fkey = (entry.model_id, entry.generation, method, kernel)
         fn = self._fns.get(fkey)
         if fn is None:
@@ -488,9 +581,14 @@ class PredictEngine:
             getattr(fuzzy_mod, "_memberships_jit", None),
         ]
         fns += [f for k, f in self._fns.items() if k[0] == "__sharded__"]
+        fns += list(_SHARDED_ASSIGN_FNS.values())
         fns += list(_COARSE_PREDICT_FNS.values())
         total = 0
+        seen: set[int] = set()
         for f in fns:
+            if id(f) in seen:  # _fns sharded entries alias the mesh cache
+                continue
+            seen.add(id(f))
             size = getattr(f, "_cache_size", None)
             if callable(size):
                 total += int(size())

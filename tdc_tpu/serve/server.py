@@ -532,6 +532,11 @@ class ServeApp:
             ("tdc_serve_engine_padded_rows_total",
              lambda: e["padded_rows"]),
             ("tdc_serve_engine_compiles_total", lambda: e["compiles"]),
+            ("tdc_serve_engine_evictions_total",
+             lambda: e.get("engine_evictions", 0)),
+            ("tdc_serve_engine_cached",
+             lambda: (self.engine.engines_cached()
+                      if hasattr(self.engine, "engines_cached") else 0)),
             ("tdc_serve_engine_device_ms_total",
              lambda: round(e["device_ms_total"], 3)),
             ("tdc_serve_queue_wait_ms_total",

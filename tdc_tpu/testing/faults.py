@@ -63,6 +63,11 @@ Instrumented points (grep fault_point for the live list):
     online.validate         before shadow-validating a fold candidate
     online.swap             between staged arrays and the manifest swap
     online.rollback         before republishing the last-good generation
+    fleet.route             before the fleet router forwards a request to
+                            the replica it picked (tdc_tpu/fleet/router.py)
+    fleet.scale             before the autoscaler applies a scale decision
+    fleet.replica_spawn     before the fleet controller spawns a replica
+                            process
 """
 
 from __future__ import annotations
@@ -102,6 +107,9 @@ KNOWN_POINTS = frozenset({
     "online.validate",
     "online.swap",
     "online.rollback",
+    "fleet.route",
+    "fleet.scale",
+    "fleet.replica_spawn",
 })
 
 # Exit code used by the 'crash' action: 128+9, what a shell reports for a

@@ -19,6 +19,9 @@ KNOWN_POINTS = frozenset({
     "data.corrupt",
     "assign.refine",
     "assign.bounds_recompute",
+    "fleet.route",
+    "fleet.scale",
+    "fleet.replica_spawn",
 })
 
 
@@ -62,3 +65,9 @@ def integrity_screen():
         fault_point("data.corrupt")
     except Exception:
         return "injected"
+
+
+def fleet_paths():
+    fault_point("fleet.route")
+    fault_point("fleet.scale")
+    fault_point("fleet.replica_spawn")

@@ -910,3 +910,200 @@ def test_chaos_online_poisoned_fold_and_crash_mid_swap(tmp_path):
         assert 'tdc_model_generation_age_seconds{model="km"}' in m
     finally:
         app.stop()
+
+
+# ---------------------------------------------------------------------------
+# Serve fleet (PR 16): kill -9 a replica under load — router failover,
+# autoscaler replacement, zero client hangs, clean SIGTERM drain (exit 75)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.multiproc
+def test_chaos_fleet_kill9_failover_replace_and_drain(tmp_path):
+    """Two subprocess serve replicas behind the fleet router, light open
+    client load, then kill -9 one replica mid-stream. Required story:
+    every in-flight and subsequent request completes (failover, no
+    hangs), the autoscaler replaces the casualty (direction=replace on
+    the router scrape), and fleet teardown drains the survivors through
+    the SIGTERM contract — every drained replica exits 75."""
+    import json
+    import threading
+    import urllib.request
+
+    from tdc_tpu.fleet import (
+        Autoscaler,
+        AutoscalerConfig,
+        FleetRouter,
+        ServeFleet,
+        subprocess_spawner,
+    )
+    from tdc_tpu.models.kmeans import kmeans_fit
+    from tdc_tpu.models.persist import save_fitted
+    from tdc_tpu.obs.metrics import scrape_counter
+
+    x = _blobs()
+    km = kmeans_fit(x, 3, key=None, max_iters=4, init=x[:3])
+    models = tmp_path / "models"
+    save_fitted(str(models / "km"), km)
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "TDC_FAULTS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    replica_args = [
+        "--model_root", str(models), "--poll_interval", "0",
+        "--warmup_buckets", "8", "--drain_linger", "0.5",
+        "--backend", "cpu",
+    ]
+    fleet = ServeFleet(subprocess_spawner(replica_args, env=env),
+                       poll_interval=0.1, drain_grace_s=60.0)
+    router = FleetRouter(fleet, forward_timeout_s=20.0)
+    # Replace-only autoscaler: scale-out/in disabled via impossible
+    # thresholds so the only allowed action is availability repair.
+    scaler = Autoscaler(fleet, AutoscalerConfig(
+        min_replicas=2, max_replicas=2, eval_interval_s=0.2,
+        shed_frac_high=2.0, down_hold_s=3600.0,
+    ), registry=router.registry)
+
+    fleet.start(2)
+    assert fleet.wait_ready(2, timeout=180.0), fleet.counts()
+    scaler.start()
+    port = router.start_http("127.0.0.1", 0)
+
+    body = json.dumps(
+        {"model": "km", "points": x[:4].tolist()}
+    ).encode()
+    results = {"ok": 0, "other": 0, "hung": 0}
+    stop_load = threading.Event()
+
+    def load_loop():
+        while not stop_load.is_set():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    results["ok" if resp.status == 200 else "other"] += 1
+            except urllib.error.HTTPError:
+                results["other"] += 1
+            except OSError:  # timeout = a hung client, the forbidden case
+                results["hung"] += 1
+            time.sleep(0.02)
+
+    loader = threading.Thread(target=load_loop, daemon=True)
+    loader.start()
+    try:
+        time.sleep(1.0)  # load flowing against both replicas
+        casualty = fleet.ready_replicas()[0]
+        casualty.proc.kill()  # the real kill -9
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            names = [r.name for r in fleet.snapshot()]
+            if (casualty.name not in names
+                    and len(fleet.ready_replicas()) == 2):
+                break
+            time.sleep(0.2)
+        time.sleep(1.0)  # more load against the repaired fleet
+    finally:
+        stop_load.set()
+        loader.join(timeout=60.0)
+        scaler.stop()
+        router.stop_http()
+
+    scrape = router.registry.render()
+    survivors = fleet.snapshot()
+    fleet.stop(drain=True)
+
+    assert results["hung"] == 0, results
+    assert results["other"] == 0, results  # failover hid the crash
+    assert results["ok"] > 20, results
+    assert scrape_counter(
+        scrape, "tdc_fleet_scale_events_total", {"direction": "replace"}
+    ) == 1, scrape
+    assert casualty.exit_code == -signal.SIGKILL
+    names = [r.name for r in survivors]
+    assert casualty.name not in names and len(names) == 2
+    # Teardown drained the survivors via SIGTERM: the exit-75 contract.
+    for r in survivors:
+        assert r.exit_code == PREEMPTED_EXIT_CODE, (r.name, r.exit_code)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.multiproc
+def test_chaos_fleet_cli_sigterm_drains_and_exits_zero(tmp_path):
+    """SIGTERM the `cli.fleet` front door itself (the blocking serve_http
+    path, where the signal handler runs ON the serve loop's thread).
+    Regression: stop_http() called inline from the handler self-deadlocks
+    — shutdown() waits for serve_forever to acknowledge, and the handler
+    is pinned on serve_forever's own thread — leaving the router hung and
+    the replica undrained. Required story: the front door serves, takes
+    SIGTERM, drains its replica, and exits 0 within the grace window."""
+    import json
+    import subprocess
+    import urllib.request
+
+    from tdc_tpu.models.kmeans import kmeans_fit
+    from tdc_tpu.models.persist import save_fitted
+
+    x = _blobs()
+    km = kmeans_fit(x, 3, key=None, max_iters=4, init=x[:3])
+    models = tmp_path / "models"
+    save_fitted(str(models / "km"), km)
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "TDC_FAULTS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tdc_tpu.cli.fleet",
+         "--model_root", str(models), "--port", str(port),
+         "--replicas", "1", "--min_replicas", "1", "--max_replicas", "1",
+         "--backend", "cpu", "--poll_interval", "0",
+         "--drain_linger", "0.5", "--warmup_buckets", "8"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + 180.0
+        up = False
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(base + "/readyz", timeout=2):
+                    up = True
+                break
+            except urllib.error.HTTPError:
+                pass  # router answering but replica not ready yet
+            except OSError:
+                pass
+            time.sleep(0.5)
+        assert up, "fleet front door never became ready"
+
+        body = json.dumps({"model": "km", "points": x[:4].tolist()}).encode()
+        req = urllib.request.Request(
+            base + "/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert len(out["labels"]) == 4, out
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=90.0)
+        assert rc == 0, rc
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30.0)

@@ -1,0 +1,532 @@
+"""Serve fleet (tdc_tpu.fleet): replica state machine, readiness-routed
+proxy, and the governor-driven autoscaler.
+
+Fast tests run the REAL router/controller against in-process ServeApp
+replicas (`start_http` on port 0) — no subprocesses, no jax re-import —
+and against canned-scrape fake replicas for the autoscaler's decision
+logic. The subprocess flavor (spawn, SIGTERM→drain→exit-75, kill -9
+failover + replace) lives in tests/test_chaos.py under the chaos
+markers, and the scrape-verified elasticity loop in
+benchmarks/bench_fleet.py --smoke.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from tdc_tpu.fleet import (
+    CLEAN_EXIT_CODES,
+    DEAD,
+    DRAINING,
+    NOT_READY,
+    READY,
+    STARTING,
+    Autoscaler,
+    AutoscalerConfig,
+    FleetRouter,
+    Replica,
+    ServeFleet,
+)
+from tdc_tpu.models.kmeans import kmeans_fit, kmeans_predict
+from tdc_tpu.models.persist import save_fitted
+from tdc_tpu.obs import metrics as obs_metrics
+from tdc_tpu.serve import ServeApp
+
+DIM = 4
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(400, DIM)).astype(np.float32)
+    x[:200] += 5.0
+    km = kmeans_fit(x, 3, key=jax.random.PRNGKey(0), max_iters=6)
+    root = tmp_path_factory.mktemp("fleet_models")
+    save_fitted(str(root / "km"), km)
+    return root
+
+
+def _inproc_spawner(model_dir, apps):
+    """ServeFleet spawn factory over in-process ServeApps; appends each
+    created app to `apps` so the test can stop them."""
+
+    def spawn(name):
+        app = ServeApp(poll_interval=0, max_wait_ms=2.0)
+        app.registry.add("km", str(model_dir / "km"))
+        app.start()
+        port = app.start_http("127.0.0.1", 0)
+        apps.append(app)
+        return Replica(
+            name, f"http://127.0.0.1:{port}",
+            stop=lambda: app.begin_drain(linger=0.2),
+        )
+
+    return spawn
+
+
+@pytest.fixture()
+def fleet2(model_dir):
+    """A polled 2-replica in-process fleet + its router."""
+    apps = []
+    fleet = ServeFleet(_inproc_spawner(model_dir, apps),
+                       poll_interval=0.05, probe_timeout=2.0)
+    fleet.start(2)
+    assert fleet.wait_ready(2, timeout=30.0)
+    router = FleetRouter(fleet, retry_after_s=2.0, forward_timeout_s=10.0)
+    yield fleet, router, apps
+    fleet.stop(drain=False)
+    for app in apps:
+        app.stop()
+
+
+def _predict_body(rows=4):
+    rng = np.random.default_rng(0)
+    return json.dumps({
+        "model": "km", "points": rng.normal(size=(rows, DIM)).tolist(),
+    }).encode()
+
+
+class TestReplicaStateMachine:
+    def test_probe_lifecycle(self, model_dir):
+        apps = []
+        r = _inproc_spawner(model_dir, apps)("r0")
+        try:
+            assert r.state == STARTING
+            assert r.probe() == READY
+            # Router feedback pulls it from the ready set immediately.
+            r.mark_not_ready()
+            assert r.state == NOT_READY
+            assert r.probe() == READY  # next probe re-admits
+            # App-level drain (e.g. governor/operator) -> readyz 503.
+            apps[0].begin_drain(linger=0.5)
+            assert r.probe() == NOT_READY
+        finally:
+            apps[0].stop()
+
+    def test_drain_is_sticky(self, model_dir):
+        apps = []
+        r = _inproc_spawner(model_dir, apps)("r0")
+        try:
+            assert r.probe() == READY
+            r.begin_drain()
+            assert r.state == DRAINING
+            # Even while the lingering listener still answers, a probe
+            # must never re-admit a draining replica.
+            assert r.probe() == DRAINING
+        finally:
+            apps[0].stop()
+
+    def test_clean_exit_codes(self):
+        r = Replica("r0", "http://127.0.0.1:1")
+        for code in CLEAN_EXIT_CODES:
+            r.exit_code = code
+            assert r.drained_clean()
+        r.exit_code = 137
+        assert not r.drained_clean()
+        assert set(CLEAN_EXIT_CODES) == {0, 75}
+
+
+class TestFleetController:
+    def test_counts_zero_filled(self, model_dir):
+        fleet = ServeFleet(lambda name: Replica(name, "http://x:1"))
+        counts = fleet.counts()
+        assert counts == {STARTING: 0, READY: 0, NOT_READY: 0,
+                          DRAINING: 0, DEAD: 0}
+
+    def test_drain_replica_picks_ready(self, fleet2):
+        fleet, _, _ = fleet2
+        victim = fleet.drain_replica()
+        assert victim is not None and victim.state == DRAINING
+        assert len(fleet.ready_replicas()) == 1
+
+    def test_dead_replicas_excludes_draining(self):
+        fleet = ServeFleet(lambda name: Replica(name, "http://x:1"))
+        r = Replica("r0", "http://x:1")
+        r.state = DEAD
+        fleet.replicas.append(r)
+        assert fleet.dead_replicas() == [r]
+
+
+class TestFleetRouter:
+    def test_routes_and_spreads_over_ready(self, fleet2):
+        fleet, router, _ = fleet2
+        for _ in range(6):
+            status, _, data, _ = router.route(
+                "POST", "/predict", _predict_body()
+            )
+            assert status == 200, data
+            assert len(json.loads(data)["labels"]) == 4
+        scrape = router.registry.render()
+        by_replica = [
+            obs_metrics.scrape_counter(
+                scrape, "tdc_fleet_routed_total",
+                {"replica": r.name, "outcome": "ok"},
+            )
+            for r in fleet.snapshot()
+        ]
+        assert sum(by_replica) == 6
+        assert all(n > 0 for n in by_replica), by_replica
+
+    def test_not_ready_replica_gets_zero_traffic(self, fleet2):
+        """The acceptance wording: no requests routed to a not-ready
+        replica, asserted from the router's own scrape deltas."""
+        fleet, router, _ = fleet2
+        shunned = fleet.ready_replicas()[0]
+        shunned.begin_drain()
+        before = router.registry.render()
+        for _ in range(8):
+            status, _, data, _ = router.route(
+                "POST", "/predict", _predict_body()
+            )
+            assert status == 200, data
+        after = router.registry.render()
+
+        def routed_to(scrape, name):
+            return obs_metrics.scrape_counter(
+                scrape, "tdc_fleet_routed_total", {"replica": name}
+            )
+
+        assert (routed_to(after, shunned.name)
+                == routed_to(before, shunned.name))
+        total = sum(routed_to(after, r.name) - routed_to(before, r.name)
+                    for r in fleet.snapshot())
+        assert total == 8
+
+    def test_failover_on_connect_error(self, fleet2):
+        fleet, router, _ = fleet2
+        # A replica whose port answers nothing, forced into the ready
+        # set: the router must fail over and demote it.
+        from tdc_tpu.fleet import free_port
+
+        ghost = Replica("ghost", f"http://127.0.0.1:{free_port()}")
+        fleet.replicas.append(ghost)
+        try:
+            ok = 0
+            for _ in range(8):
+                # Re-force past the poll loop so routes do see a "ready"
+                # ghost; the router must still answer 200 every time.
+                ghost.state = READY
+                status, _, data, _ = router.route(
+                    "POST", "/predict", _predict_body()
+                )
+                assert status == 200, data
+                ok += 1
+            assert ok == 8
+            scrape = router.registry.render()
+            assert obs_metrics.scrape_counter(
+                scrape, "tdc_fleet_routed_total",
+                {"replica": "ghost", "outcome": "error"},
+            ) >= 1
+            assert obs_metrics.scrape_counter(
+                scrape, "tdc_fleet_failovers_total"
+            ) >= 1
+            # Demoted by router feedback (or the poll loop's probe —
+            # the last loop iteration may not have dispatched to it).
+            deadline = time.monotonic() + 5.0
+            while ghost.state == READY and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert ghost.state == NOT_READY
+        finally:
+            fleet.remove(ghost)
+
+    def test_fleet_503_when_none_ready(self, model_dir):
+        fleet = ServeFleet(lambda name: Replica(name, "http://x:1"))
+        router = FleetRouter(fleet, retry_after_s=3.0)
+        status, _, data, retry_after = router.route(
+            "POST", "/predict", _predict_body()
+        )
+        assert status == 503
+        body = json.loads(data)
+        assert body["reason"] == "shed"
+        assert body["trigger"] == "no_ready_replica"
+        assert retry_after == "3"
+        assert obs_metrics.scrape_counter(
+            router.registry.render(), "tdc_fleet_unrouted_total"
+        ) == 1
+
+    def test_http_front_door(self, fleet2):
+        fleet, router, _ = fleet2
+        port = router.start_http("127.0.0.1", 0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            req = urllib.request.Request(
+                base + "/predict", data=_predict_body(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert len(json.loads(resp.read())["labels"]) == 4
+            with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+                assert json.loads(r.read())["ready_replicas"] == 2
+            with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+                assert json.loads(r.read())["replicas"][READY] == 2
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+                text = r.read().decode()
+            assert "tdc_fleet_replicas" in text
+            assert obs_metrics.scrape_counter(
+                text, "tdc_fleet_replicas", {"state": READY}
+            ) == 2
+            # Proxied GET: /models comes from a replica.
+            with urllib.request.urlopen(base + "/models", timeout=10) as r:
+                assert json.loads(r.read())["models"][0]["id"] == "km"
+        finally:
+            router.stop_http()
+
+    def test_http_503_carries_retry_after(self, model_dir):
+        fleet = ServeFleet(lambda name: Replica(name, "http://x:1"))
+        router = FleetRouter(fleet, retry_after_s=2.0)
+        port = router.start_http("127.0.0.1", 0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=_predict_body(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 503
+            assert ei.value.headers["Retry-After"] == "2"
+        finally:
+            router.stop_http()
+
+
+class _FakeReplica(Replica):
+    """Canned-scrape replica for autoscaler decision tests."""
+
+    def __init__(self, name):
+        super().__init__(name, "http://127.0.0.1:1")
+        self.state = READY
+        self.admission = 0
+        self.offered = 0.0
+
+    def scrape(self, timeout=2.0):
+        return (f"tdc_serve_admission_state {self.admission}\n"
+                f"tdc_serve_offered_rps {self.offered}\n")
+
+    def begin_drain(self):
+        self.state = DRAINING
+
+
+def _fake_fleet(n):
+    fleet = ServeFleet(_FakeReplica, poll_interval=9999)
+    for _ in range(n):
+        fleet.add_replica()
+    for r in fleet.snapshot():
+        r.state = READY
+    return fleet
+
+
+def _events(registry, direction):
+    return obs_metrics.scrape_counter(
+        registry.render(), "tdc_fleet_scale_events_total",
+        {"direction": direction},
+    )
+
+
+class TestAutoscaler:
+    def test_scales_up_on_shed_and_down_when_calm(self):
+        fleet = _fake_fleet(1)
+        reg = obs_metrics.Registry()
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            min_replicas=1, max_replicas=3, up_hold_s=0.0,
+            down_hold_s=0.0, cooldown_s=0.0, shed_frac_high=0.5,
+        ), registry=reg)
+        fleet.snapshot()[0].admission = 1  # shedding
+        scaler.evaluate_once()
+        assert len(fleet.snapshot()) == 2
+        assert _events(reg, "up") == 1
+        # New replica comes up ready & admitting; original calms down.
+        for r in fleet.snapshot():
+            r.state = READY
+            r.admission = 0
+        scaler.evaluate_once()  # first calm reading arms down_since
+        scaler.evaluate_once()
+        assert _events(reg, "down") == 1
+        assert sum(1 for r in fleet.snapshot()
+                   if r.state == DRAINING) == 1
+
+    def test_hold_and_cooldown_damp_flapping(self):
+        fleet = _fake_fleet(1)
+        reg = obs_metrics.Registry()
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            min_replicas=1, max_replicas=4, up_hold_s=0.0,
+            down_hold_s=0.0, cooldown_s=60.0, shed_frac_high=0.5,
+        ), registry=reg)
+        fleet.snapshot()[0].admission = 1
+        scaler.evaluate_once()
+        for r in fleet.snapshot():
+            r.state = READY
+            r.admission = 1
+        scaler.evaluate_once()  # inside cooldown: no second scale-out
+        assert len(fleet.snapshot()) == 2
+        assert _events(reg, "up") == 1
+
+    def test_up_hold_requires_sustained_signal(self):
+        fleet = _fake_fleet(1)
+        reg = obs_metrics.Registry()
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            up_hold_s=30.0, cooldown_s=0.0, shed_frac_high=0.5,
+        ), registry=reg)
+        fleet.snapshot()[0].admission = 1
+        scaler.evaluate_once()
+        assert len(fleet.snapshot()) == 1  # armed, not yet acted
+        fleet.snapshot()[0].admission = 0
+        scaler.evaluate_once()  # signal dropped: hold timer resets
+        assert scaler._up_since is None
+        assert _events(reg, "up") == 0
+
+    def test_respects_max_and_min(self):
+        fleet = _fake_fleet(2)
+        reg = obs_metrics.Registry()
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            min_replicas=2, max_replicas=2, up_hold_s=0.0,
+            down_hold_s=0.0, cooldown_s=0.0,
+        ), registry=reg)
+        for r in fleet.snapshot():
+            r.admission = 1
+        scaler.evaluate_once()
+        assert len(fleet.snapshot()) == 2  # capped at max
+        for r in fleet.snapshot():
+            r.admission = 0
+        scaler.evaluate_once()
+        scaler.evaluate_once()
+        assert all(r.state == READY for r in fleet.snapshot())  # floor
+        assert _events(reg, "up") + _events(reg, "down") == 0
+
+    def test_replaces_dead_replica_outside_cooldown(self):
+        fleet = _fake_fleet(2)
+        reg = obs_metrics.Registry()
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            cooldown_s=3600.0, up_hold_s=3600.0,
+        ), registry=reg)
+        scaler._last_scale = time.monotonic()  # cooldown in force
+        casualty = fleet.snapshot()[0]
+        casualty.state = DEAD
+        casualty.exit_code = 137
+        scaler.evaluate_once()
+        names = [r.name for r in fleet.snapshot()]
+        assert casualty.name not in names
+        assert len(names) == 2
+        assert _events(reg, "replace") == 1
+
+    def test_rps_gate_blocks_scale_in(self):
+        fleet = _fake_fleet(2)
+        reg = obs_metrics.Registry()
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            min_replicas=1, down_hold_s=0.0, cooldown_s=0.0,
+            rps_per_replica_low=5.0,
+        ), registry=reg)
+        for r in fleet.snapshot():
+            r.offered = 50.0  # busy: 50 rps/replica >> 5
+        scaler.evaluate_once()
+        scaler.evaluate_once()
+        assert _events(reg, "down") == 0
+        for r in fleet.snapshot():
+            r.offered = 1.0
+        scaler.evaluate_once()
+        scaler.evaluate_once()
+        assert _events(reg, "down") == 1
+
+    def test_disabled_governor_never_scales(self):
+        fleet = _fake_fleet(1)
+        reg = obs_metrics.Registry()
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            enabled=False, up_hold_s=0.0, cooldown_s=0.0,
+        ), registry=reg)
+        fleet.snapshot()[0].admission = 1
+        scaler.evaluate_once()
+        assert len(fleet.snapshot()) == 1
+
+
+class TestFleetCLI:
+    def test_parser_and_replica_args(self):
+        from tdc_tpu.cli.fleet import build_parser, replica_args_from
+
+        args = build_parser().parse_args([
+            "--model_root", "/m", "--replicas", "2",
+            "--service_ms", "5", "--engine_budget", "32",
+            "--replica_arg", "--shed off",
+        ])
+        tail = replica_args_from(args)
+        assert tail[:2] == ["--model_root", "/m"]
+        assert ["--engine_budget", "32"] == \
+            tail[tail.index("--engine_budget"):][:2]
+        assert ["--service_ms", "5.0"] == \
+            tail[tail.index("--service_ms"):][:2]
+        assert tail[-2:] == ["--shed", "off"]
+
+    def test_make_fleet_seam(self, model_dir):
+        from tdc_tpu.cli.fleet import build_parser, make_fleet
+
+        args = build_parser().parse_args([
+            "--model_root", str(model_dir), "--max_replicas", "3",
+            "--autoscale", "off",
+        ])
+        fleet, router, autoscaler, log = make_fleet(args)
+        assert autoscaler.config.max_replicas == 3
+        assert autoscaler.config.enabled is False
+        assert router.fleet is fleet
+        # The autoscaler's scale counter lives on the router registry,
+        # so one /metrics scrape carries the whole fleet story.
+        assert "tdc_fleet_scale_events_total" in router.registry.render()
+
+
+class TestFleetFaultPoints:
+    """The three PR-16 fault points fire through their REAL call sites
+    under the deterministic harness (TDC_FAULTS) — the same spec syntax
+    the chaos suite and TDC005 lint pin."""
+
+    @pytest.fixture()
+    def inject(self, monkeypatch):
+        from tdc_tpu.testing import faults
+
+        def _arm(point):
+            monkeypatch.setenv(
+                faults.ENV_VAR, f"{point}=raise:RuntimeError"
+            )
+            faults.reset()
+
+        yield _arm
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        faults.reset()
+
+    def test_replica_spawn_point(self, inject):
+        inject("fleet.replica_spawn")
+        fleet = ServeFleet(lambda name: Replica(name, "http://x:1"))
+        with pytest.raises(RuntimeError, match="fleet.replica_spawn"):
+            fleet.add_replica()
+        assert fleet.snapshot() == []  # fault fired before the spawn
+
+    def test_route_point(self, inject):
+        fleet = ServeFleet(lambda name: Replica(name, "http://x:1"))
+        ghost = Replica("r0", "http://127.0.0.1:1")
+        ghost.state = READY
+        fleet.replicas.append(ghost)
+        router = FleetRouter(fleet)
+        inject("fleet.route")
+        with pytest.raises(RuntimeError, match="fleet.route"):
+            router.route("POST", "/predict", _predict_body())
+
+    def test_scale_point_on_replace_path(self, inject):
+        fleet = _fake_fleet(2)
+        fleet.snapshot()[0].state = DEAD
+        scaler = Autoscaler(fleet)
+        inject("fleet.scale")
+        with pytest.raises(RuntimeError, match="fleet.scale"):
+            scaler.evaluate_once()
+
+    def test_scale_point_on_scale_out_path(self, inject):
+        fleet = _fake_fleet(1)
+        fleet.snapshot()[0].admission = 1
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            up_hold_s=0.0, cooldown_s=0.0, shed_frac_high=0.5,
+        ))
+        inject("fleet.scale")
+        with pytest.raises(RuntimeError, match="fleet.scale"):
+            scaler.evaluate_once()
+        assert len(fleet.snapshot()) == 1  # fault fired before the add

@@ -512,6 +512,8 @@ def test_fault_points_match_registry():
         "online.fold", "online.validate", "online.swap", "online.rollback",
         # PR-10 hardened ingest (data/ingest.py)
         "data.read.transient", "data.read.permanent", "data.corrupt",
+        # PR-16 serve fleet (tdc_tpu/fleet/)
+        "fleet.route", "fleet.scale", "fleet.replica_spawn",
     }
 
 

@@ -1057,3 +1057,146 @@ class TestCoarsePredictPlanLifecycle:
                     "tdc_bounds_dist_evals_exact_total",
                     "tdc_bounds_pruned_fraction"):
             assert f"# TYPE {fam} " in text
+
+
+class TestEngineLRU:
+    """ISSUE-16 tentpole (b): the plan cache's budget discipline applied
+    to WHOLE compiled engines — closures, warm keys, plan, and the
+    engine-owned placements — so hundreds of registered models fit one
+    replica. Eviction is memory-only: re-admission re-fills the key
+    cache (stats['compiles']) but re-traces NOTHING (`jit_cache_size`,
+    the PR-13 `_cache_size` recompile proof), and responses stay
+    bit-exact across evict/re-admit cycles."""
+
+    def _save_km(self, path, cents):
+        save_fitted(str(path), model="kmeans",
+                    arrays={"centroids": cents.astype(np.float32)})
+
+    def _mk(self, tmp_path, n_models, d=3, k=2):
+        rng = np.random.default_rng(11)
+        reg = ModelRegistry()
+        entries = []
+        for i in range(n_models):
+            cents = rng.normal(size=(k, d)).astype(np.float32)
+            self._save_km(tmp_path / f"m{i}", cents)
+            entries.append(reg.add(f"m{i}", str(tmp_path / f"m{i}")))
+        return reg, entries
+
+    def test_engine_budget_validated(self):
+        with pytest.raises(ValueError, match="engine_budget"):
+            PredictEngine(engine_budget=0)
+
+    def test_eviction_under_pressure_evicts_oldest_used(self, tmp_path):
+        reg, (e1, e2, e3) = self._mk(tmp_path, 3)
+        eng = PredictEngine(engine_budget=2)
+        x = np.zeros((4, 3), np.float32)
+        eng.run(e1, "predict", x)
+        eng.run(e2, "predict", x)
+        eng.run(e1, "predict", x)  # refresh m0's recency
+        eng.run(e3, "predict", x)  # evicts m1 (oldest-used), not m0
+        assert {k[0] for k in eng._engines} == {"m0", "m2"}
+        assert eng.engines_cached() == 2
+        assert eng.stats["engine_evictions"] == 1
+        # The evicted engine's compiled state is genuinely gone.
+        assert not any(k[0] == "m1" for k in eng.compiled_keys)
+        assert not any(k[0] == "m1" for k in eng._fns)
+
+    def test_readmit_refills_key_cache_without_retrace(self, tmp_path):
+        """The recompile proof: an evicted model re-admits with exactly
+        one key-cache fill and ZERO new jit traces — the underlying
+        jitted callables are shared module-level objects."""
+        reg, entries = self._mk(tmp_path, 3)
+        eng = PredictEngine(engine_budget=2)
+        x = np.arange(12, dtype=np.float32).reshape(4, 3) / 7.0
+        first, _ = eng.run(entries[0], "predict", x)
+        for e in entries[1:]:
+            eng.run(e, "predict", x)  # pushes m0 out of the budget
+        assert not any(k[0] == "m0" for k in eng.compiled_keys)
+        compiles = eng.stats["compiles"]
+        jit_cache = eng.jit_cache_size()
+        again, meta = eng.run(entries[0], "predict", x)
+        assert eng.stats["compiles"] == compiles + 1  # one key refill
+        assert eng.jit_cache_size() == jit_cache  # zero re-traces
+        assert meta["warm"] is False
+        np.testing.assert_array_equal(again, first)  # bit-exact
+
+    def test_generation_bump_never_serves_stale_engine(self, tmp_path):
+        reg, (e1,) = self._mk(tmp_path, 1)
+        eng = PredictEngine(engine_budget=2)
+        x = np.zeros((4, 3), np.float32)
+        eng.run(e1, "predict", x)
+        assert ("m0", e1.generation) in eng._engines
+        # Hot republish with shifted centroids -> new generation.
+        cents2 = np.asarray(e1.device["centroids"]) + 3.0
+        self._save_km(tmp_path / "m0", cents2)
+        assert reg.poll_once() == ["m0"]
+        e2 = reg.get("m0")
+        out, _ = eng.run(e2, "predict", x)
+        # The stale generation's engine is gone from the LRU and the
+        # response reflects the NEW parameters.
+        assert ("m0", e1.generation) not in eng._engines
+        assert ("m0", e2.generation) in eng._engines
+        expected = np.asarray(kmeans_predict(x, cents2))
+        np.testing.assert_array_equal(out, expected)
+
+    def test_eviction_frees_engine_owned_placements(self, tmp_path):
+        rng = np.random.default_rng(12)
+        cents = rng.normal(size=(8, 3)).astype(np.float32)
+        save_fitted(str(tmp_path / "c"), model="kmeans",
+                    arrays={"centroids": cents},
+                    params={"assign": "coarse", "probe": 2, "n_tiles": 4})
+        reg = ModelRegistry()
+        eng = PredictEngine(engine_budget=1)
+        entry = reg.add("c", str(tmp_path / "c"))
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        eng.run(entry, "predict", x)
+        assert "coarse_spec" in entry.placements
+        self._save_km(tmp_path / "d", cents)
+        other = reg.add("d", str(tmp_path / "d"))
+        eng.run(other, "predict", x)  # budget 1: evicts the coarse model
+        assert "coarse_spec" not in entry.placements
+        assert ("c", entry.generation) not in eng._plans
+
+    def test_holds_100_models_within_budget_bit_exact(self, tmp_path):
+        """Acceptance: >= 100 registered models on one engine within a
+        small configured budget, responses bit-exact through constant
+        evict/re-admit churn, and a full second pass re-traces nothing."""
+        n_models, budget = 100, 8
+        reg, entries = self._mk(tmp_path, n_models)
+        eng = PredictEngine(engine_budget=budget)
+        rng = np.random.default_rng(13)
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        expected = [
+            np.asarray(kmeans_predict(x, np.asarray(e.device["centroids"])))
+            for e in entries
+        ]
+        for e, want in zip(entries, expected):
+            out, _ = eng.run(e, "predict", x)
+            np.testing.assert_array_equal(out, want)
+        assert len(reg.ids()) == n_models
+        assert eng.engines_cached() <= budget
+        assert eng.stats["engine_evictions"] >= n_models - budget
+        jit_cache = eng.jit_cache_size()
+        # Second full pass: every re-admission is a key refill, never a
+        # re-trace, and every response is still bit-exact.
+        for e, want in zip(entries, expected):
+            out, _ = eng.run(e, "predict", x)
+            np.testing.assert_array_equal(out, want)
+        assert eng.jit_cache_size() == jit_cache
+        assert eng.engines_cached() <= budget
+
+    def test_engine_lru_metrics_on_scrape(self, model_root):
+        app = _mk_app(model_root, engine=PredictEngine(engine_budget=1))
+        try:
+            x = np.zeros((4, DIM), np.float32)
+            app.engine.run(app.registry.get("km"), "predict", x)
+            app.engine.run(app.registry.get("gm"), "predict", x)
+            text = app.metrics_text()
+            from tdc_tpu.obs.metrics import scrape_counter
+
+            assert scrape_counter(text, "tdc_serve_engine_cached") == 1
+            assert scrape_counter(
+                text, "tdc_serve_engine_evictions_total"
+            ) >= 1
+        finally:
+            app.stop()
