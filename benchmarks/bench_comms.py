@@ -23,7 +23,24 @@ What this measures, per (mesh, strategy, K):
 
 Mesh column: `flat8` = 1-D 8-device data mesh; `hier2x4` = hierarchical
 (dcn=2, ici=4) mesh (mesh.make_hierarchical_mesh) — two staged reduces
-whose DCN stage moves the payload once per host group.
+whose DCN stage moves the payload once per host group; `mesh2x4` = the
+2-D (data=2, model=4) K-sharded mesh for the gather= sweep.
+
+PR 17 adds the MODEL axis to the accounting (`CommsReport.data_bytes /
+model_bytes / gathers`): the K-sharded champion all_gathers and the
+centroid-finalize exchange, priced per `gather=` compression mode
+(fp32 | fp32_sharded | bf16 | int8, parallel/gather.py). The gather
+sweep's model-axis columns are the acceptance quantity: at K>=4096 the
+int8 finalize moves >=3.5x fewer bytes per centroid update than the
+fp32_sharded full-precision wire baseline (3.88x measured; fp32 proper
+books ZERO finalize bytes — its finalize is replicated compute, so
+fp32_sharded is the apples-to-apples baseline; the whole-axis per-pass
+ratio is lower because the champion argmin column is int32 and the
+report pass runs fp32 champions). `hier2x4-staged` rows price the
+staged (dcn=2, ici=4) finalize gather from the same cost function the
+drivers book (gather.finalize_gather_cost) — the ICI stage stays fp32,
+only the DCN hop is compressed, so the byte ratio there is the
+DCN-link ratio.
 
 Run:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -64,10 +81,13 @@ from tdc_tpu.parallel.mesh import (  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(__file__), "comms_8dev_cpu.csv")
 STRATEGIES = ("per_batch", "per_pass", "per_pass:bf16", "per_pass:int8")
+GATHER_MODES = ("fp32", "fp32_sharded", "bf16", "int8")
 FIELDS = [
-    "mesh", "strategy", "K", "d", "n", "batch_rows", "n_batches", "iters",
-    "passes", "reduces_per_pass", "bytes_per_pass", "total_reduces",
-    "total_bytes", "max_centroid_delta", "rel_inertia_delta", "wall_s",
+    "mesh", "strategy", "gather", "K", "d", "n", "batch_rows", "n_batches",
+    "iters", "passes", "reduces_per_pass", "bytes_per_pass",
+    "data_bytes_per_pass", "model_bytes_per_pass", "gathers_per_pass",
+    "total_reduces", "total_bytes", "max_centroid_delta",
+    "rel_inertia_delta", "wall_s",
 ]
 
 
@@ -96,18 +116,102 @@ def run_one(mesh_name, mesh, strategy, k, d, n, batch_rows, iters):
     )
     jax.block_until_ready(res.centroids)
     wall = time.perf_counter() - t0
-    c = res.comms
-    row = {
-        "mesh": mesh_name, "strategy": strategy, "K": k, "d": d,
-        "n": len(x), "batch_rows": batch_rows,
-        "n_batches": -(-len(x) // batch_rows), "iters": iters,
+    row = _row(mesh_name, strategy, "", k, d, len(x), batch_rows, iters,
+               res.comms, wall)
+    return row, res
+
+
+def _row(mesh_name, strategy, gather, k, d, n, batch_rows, iters, c, wall):
+    return {
+        "mesh": mesh_name, "strategy": strategy, "gather": gather,
+        "K": k, "d": d, "n": n, "batch_rows": batch_rows,
+        "n_batches": -(-n // batch_rows), "iters": iters,
         "passes": c.passes,
         "reduces_per_pass": round(c.reduces / c.passes, 3),
         "bytes_per_pass": c.logical_bytes // c.passes,
+        "data_bytes_per_pass": c.data_bytes // c.passes,
+        "model_bytes_per_pass": c.model_bytes // c.passes,
+        "gathers_per_pass": round(c.gathers / c.passes, 3),
         "total_reduces": c.reduces, "total_bytes": c.logical_bytes,
         "wall_s": round(wall, 3),
     }
+
+
+def run_gather_one(mesh2d, gather, k, d, n, batch_rows, iters):
+    """One K-sharded streamed fit on the (data=2, model=4) mesh with the
+    given gather= compression mode; the CommsReport's model-axis columns
+    are the result."""
+    from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
+
+    x, centers = _data(n, d, k)
+    batches = lambda: (
+        x[i: i + batch_rows] for i in range(0, len(x), batch_rows)
+    )
+    t0 = time.perf_counter()
+    res = streamed_kmeans_fit_sharded(
+        batches, k, d, mesh2d, init=centers, max_iters=iters, tol=-1.0,
+        gather=gather,
+    )
+    jax.block_until_ready(res.centroids)
+    wall = time.perf_counter() - t0
+    row = _row("mesh2x4", "per_batch", gather, k, d, len(x), batch_rows,
+               iters, res.comms, wall)
     return row, res
+
+
+def sweep_gather(ks, d, n, batch_rows, iters, mesh2d):
+    """gather= mode x K on the 2-D mesh; numerics columns are vs the
+    gather='fp32' (fully replicated finalize, pre-PR schedule) baseline."""
+    rows = []
+    for k in ks:
+        baseline = None
+        for gather in GATHER_MODES:
+            row, res = run_gather_one(mesh2d, gather, k, d, n, batch_rows,
+                                      iters)
+            if baseline is None:  # fp32 runs first
+                baseline = res
+            bc = np.asarray(baseline.centroids)
+            row["max_centroid_delta"] = float(
+                np.max(np.abs(np.asarray(res.centroids) - bc))
+            )
+            row["rel_inertia_delta"] = float(
+                abs(float(res.sse) - float(baseline.sse))
+                / max(float(baseline.sse), 1e-12)
+            )
+            rows.append(row)
+            print(json.dumps(row))
+    return rows
+
+
+def hier_staged_rows(ks, d, groups=(2, 4)):
+    """Cost-model rows for the staged hierarchical finalize gather: each
+    device's (K, d)/8 centroid slice gathered ICI-first at fp32, with
+    only the DCN stage compressed — priced by the SAME
+    gather.finalize_gather_cost the drivers book, so these rows are the
+    schedule's bytes, not a fit's. wall_s is blank on purpose (nothing
+    ran)."""
+    from tdc_tpu.parallel import gather as gather_lib
+
+    rows = []
+    for k in ks:
+        for mode in GATHER_MODES:
+            if mode == "fp32_sharded":
+                continue  # staging is about compression; fp32 is the ref
+            gathers, nbytes = gather_lib.finalize_gather_cost(
+                k, d, groups, mode
+            )
+            rows.append({
+                "mesh": "hier2x4-staged", "strategy": "finalize",
+                "gather": mode, "K": k, "d": d, "n": "", "batch_rows": "",
+                "n_batches": "", "iters": "", "passes": 1,
+                "reduces_per_pass": 0, "bytes_per_pass": nbytes,
+                "data_bytes_per_pass": 0, "model_bytes_per_pass": nbytes,
+                "gathers_per_pass": gathers, "total_reduces": 0,
+                "total_bytes": nbytes, "max_centroid_delta": "",
+                "rel_inertia_delta": "", "wall_s": "",
+            })
+            print(json.dumps(rows[-1]))
+    return rows
 
 
 def sweep(ks, d, n, batch_rows, iters, meshes):
@@ -148,6 +252,12 @@ def main(argv):
              ("per_batch", "per_pass"))
         )
 
+    mesh2d = None
+    if n_dev >= 8:
+        from tdc_tpu.parallel.sharded_k import make_mesh_2d
+
+        mesh2d = make_mesh_2d(2, 4)
+
     if smoke:
         rows = sweep([16], d=16, n=2048, batch_rows=256, iters=2,
                      meshes=meshes[:1])
@@ -158,6 +268,25 @@ def main(argv):
             == by["per_batch"]["n_batches"]
             and all(r["rel_inertia_delta"] < 1e-3 for r in rows)
         )
+        # Quantized-gather config (PR 17): the K-sharded tower with the
+        # bf16 compressed gather — sharded finalize must stay bit-exact
+        # at fp32 wire precision, bf16 must cut model-axis bytes below
+        # the full-precision sharded baseline while staying within the
+        # quantized inertia envelope.
+        gok = True
+        if mesh2d is not None:
+            grows = sweep_gather([64], d=16, n=2048, batch_rows=256,
+                                 iters=2, mesh2d=mesh2d)
+            gby = {r["gather"]: r for r in grows}
+            gok = (
+                gby["fp32_sharded"]["max_centroid_delta"] == 0.0
+                and gby["bf16"]["rel_inertia_delta"] < 1e-2
+                and gby["bf16"]["model_bytes_per_pass"]
+                < gby["fp32_sharded"]["model_bytes_per_pass"]
+                and gby["int8"]["model_bytes_per_pass"]
+                < gby["bf16"]["model_bytes_per_pass"]
+            )
+        ok = ok and gok
         print(
             "COMMS-SMOKE "
             + ("PASS" if ok else "FAIL")
@@ -165,12 +294,20 @@ def main(argv):
             f"per_batch={by['per_batch']['reduces_per_pass']}/pass "
             f"(n_batches={by['per_batch']['n_batches']}), "
             f"worst rel_inertia_delta="
-            f"{max(r['rel_inertia_delta'] for r in rows):.2e}"
+            f"{max(r['rel_inertia_delta'] for r in rows):.2e}, "
+            f"gather={'ok' if gok else 'FAIL'}"
         )
         return 0 if ok else 1
 
     rows = sweep([16, 256, 1024], d=64, n=8192, batch_rows=1024, iters=5,
                  meshes=meshes)
+    if mesh2d is not None:
+        # n >= K so every blob gets rows (_data repeats n//k per center).
+        rows += sweep_gather([1024], d=128, n=8192, batch_rows=1024,
+                             iters=3, mesh2d=mesh2d)
+        rows += sweep_gather([4096], d=128, n=8192, batch_rows=2048,
+                             iters=3, mesh2d=mesh2d)
+    rows += hier_staged_rows([1024, 4096], d=128)
     with open(OUT, "w", newline="") as f:
         w = csv.DictWriter(f, fieldnames=FIELDS)
         w.writeheader()

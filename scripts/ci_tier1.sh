@@ -38,9 +38,12 @@ timeout -k 10 1800 env JAX_PLATFORMS=cpu \
 pytest_rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$log" | tr -cd . | wc -c)"
 
-# Comms-strategy smoke (parallel/reduce): proves per-pass reduction issues
-# exactly 1 cross-device reduce per iteration on the 8-device mesh and the
-# strategies stay within numeric tolerance. ~20 s; prints one PASS/FAIL line.
+# Comms-strategy smoke (parallel/reduce + parallel/gather): proves per-pass
+# reduction issues exactly 1 cross-device reduce per iteration on the
+# 8-device mesh, the strategies stay within numeric tolerance, and the
+# gather= block on the 2-D mesh holds (fp32_sharded bit-exact, quantized
+# model-axis bytes strictly shrinking, bf16 inertia in band). ~30 s;
+# prints one PASS/FAIL line.
 comms_rc=0
 if [ -z "$SKIP_COMMS_SMOKE" ]; then
     timeout -k 10 300 env JAX_PLATFORMS=cpu \

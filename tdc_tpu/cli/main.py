@@ -47,6 +47,35 @@ def _sharded_reduce(args) -> str:
     return args.reduce
 
 
+def _sharded_gather(args) -> str:
+    """--gather for the K-sharded kmeans/fuzzy drivers: surface the
+    plan_gather guard rails in the CLI's vocabulary (loud SystemExit, the
+    --reduce convention) instead of a deep driver ValueError."""
+    if args.gather == "fp32":
+        return args.gather
+    if args.gather in ("bf16", "int8"):
+        if args.ckpt_dir or args.ckpt_every_batches:
+            raise SystemExit(
+                f"--gather={args.gather} does not support checkpointing "
+                "(--ckpt_dir/--ckpt_every_batches): a resume would restart "
+                "the finalize error-feedback residual, breaking the "
+                "bit-identical-resume contract"
+            )
+        if args.residency not in (None, "stream"):
+            raise SystemExit(
+                f"--gather={args.gather} requires --residency stream: the "
+                "compiled resident chunk traces the centroid update once "
+                "and cannot carry the gather error-feedback state"
+            )
+        if args.assign == "bounded":
+            raise SystemExit(
+                f"--gather={args.gather} cannot combine with --assign "
+                "bounded (quantized champion mins would invalidate the "
+                "triangle-inequality certificates); use --gather fp32"
+            )
+    return args.gather
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tdc_tpu",
@@ -148,11 +177,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "and is bit-exact by construction); default "
                         "~sqrt(n_tiles)")
     p.add_argument("--kernel", type=str, default=None,
-                   choices=("xla", "pallas", "refined", "auto"),
+                   choices=("xla", "pallas", "pallas_bf16", "refined",
+                            "auto"),
                    help="sufficient-stats kernel for K-Means: 'pallas' = "
                         "fused single-pass VMEM kernel (single-device and "
                         "mesh; with --shard_k, the blockwise online-argmin "
-                        "kernel runs inside each shard); 'refined' = exact-"
+                        "kernel runs inside each shard); 'pallas_bf16' = "
+                        "the fused kernel with its bf16-MXU/f32-accumulate "
+                        "distance epilogue (assignment at bf16 MXU "
+                        "precision, statistics exact f32; in-memory "
+                        "kmeans, single-device); 'refined' = exact-"
                         "distance champion refinement (in-memory kmeans "
                         "only — the iters-to-converge parity path: matmul-"
                         "form cancellation can flip assignments near "
@@ -183,6 +217,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "parity); ':bf16'/':int8' additionally quantize the "
                         "(K, d) sums on the wire with error feedback "
                         "(1-D meshes only)")
+    p.add_argument("--gather", type=str, default="fp32",
+                   choices=("fp32", "fp32_sharded", "bf16", "int8"),
+                   help="model-axis collective strategy for the K-sharded "
+                        "drivers (parallel/gather.py): 'fp32_sharded' "
+                        "computes the centroid finalize on each device's "
+                        "1/n_data K-slice and all-gathers the slices "
+                        "(bit-exact, 1/n_data the replicated FLOPs); "
+                        "'bf16'/'int8' additionally compress the champion "
+                        "and finalize all_gathers with per-128-block "
+                        "shared scales + persistent error feedback on the "
+                        "finalize slices (tolerance-level parity; refuses "
+                        "checkpointing, hbm/auto residency, and --assign "
+                        "bounded — the EF residual must persist across "
+                        "passes)")
     p.add_argument("--residency", type=str, default="stream",
                    choices=("stream", "auto", "hbm", "spill"),
                    help="streamed kmeans/fuzzy dataset residency "
@@ -332,6 +380,14 @@ def validate_args(parser, args):
                 parser.error("--shard_k gaussianMixture seeds from a host "
                              "subsample; --init=kmeans (a full K-Means "
                              "pre-fit) is the unsharded mode")
+    if args.gather != "fp32":
+        if args.shard_k <= 1:
+            parser.error("--gather applies to the K-sharded drivers "
+                         "(model-axis collectives only exist there); add "
+                         "--shard_k")
+        if args.method_name == "gaussianMixture":
+            parser.error("--gather is kmeans/fuzzy only (the GMM shard "
+                         "tower keeps the replicated M-step)")
     if args.probe is not None and args.assign not in ("coarse", "auto"):
         parser.error("--probe needs --assign coarse|auto")
     if args.probe is not None and args.probe != "all":
@@ -363,7 +419,7 @@ def validate_args(parser, args):
         if args.weight_file:
             parser.error("--assign coarse has no weighted fold; drop "
                          "--weight_file or --assign")
-        if args.kernel in ("pallas", "refined"):
+        if args.kernel in ("pallas", "pallas_bf16", "refined"):
             parser.error("--assign coarse is its own tile-pruned stats "
                          "path; --kernel pallas/refined cannot combine "
                          "with it")
@@ -474,6 +530,29 @@ def validate_args(parser, args):
         if args.num_batches > 1 or args.shard_k > 1:
             parser.error("--kernel=refined is in-memory single-shard "
                          "(use it for iters-to-converge parity runs)")
+    if args.kernel == "pallas_bf16":
+        # bf16-MXU / f32-accumulate distance epilogue: in-memory kmeans,
+        # single-device (models/kmeans rejects mesh/weights at fit time;
+        # catch the CLI-visible combinations at parse time, per the
+        # standing explicit-kernel fail-fast rule).
+        if args.method_name != "distributedKMeans":
+            parser.error("--kernel=pallas_bf16 is distributedKMeans only "
+                         "(the bf16-MXU epilogue exists for the Lloyd "
+                         "stats kernel)")
+        for flag in ("minibatch", "streamed", "mean_combine"):
+            if getattr(args, flag):
+                parser.error(f"--kernel=pallas_bf16 is the in-memory fused "
+                             f"kernel; --{flag} is not supported")
+        if args.num_batches > 1 or args.shard_k > 1:
+            parser.error("--kernel=pallas_bf16 is in-memory single-shard")
+        if args.n_devices and args.n_devices > 1:
+            parser.error("--kernel=pallas_bf16 is single-device (no "
+                         "shard_map tower; cast inputs to bf16 with "
+                         "--kernel=pallas for the same MXU precision)")
+        if args.weight_file:
+            parser.error("--kernel=pallas_bf16 does not support "
+                         "--weight_file (the weighted epilogue keeps full "
+                         "precision)")
     if args.metrics_sample < 0:
         parser.error("--metrics_sample must be >= 0")
     if args.weight_file:
@@ -1029,6 +1108,7 @@ def run_experiment(args) -> dict:
                     reduce=_sharded_reduce(args),
                     residency=args.residency,
                     ingest=ingest_policy,
+                    gather=_sharded_gather(args),
                 )
             from tdc_tpu.parallel.sharded_k import fuzzy_fit_sharded
 
@@ -1091,6 +1171,7 @@ def run_experiment(args) -> dict:
                 reduce=_sharded_reduce(args),
                 residency=args.residency,
                 ingest=ingest_policy,
+                gather=_sharded_gather(args),
                 **assign_kw,
             )
         if args.method_name == "gaussianMixture":

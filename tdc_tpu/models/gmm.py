@@ -1184,6 +1184,8 @@ def streamed_gmm_fit(
         comms=reduce_lib.CommsReport(
             strategy=strategy.label(), reduces=counter.reduces,
             logical_bytes=counter.logical_bytes, passes=passes[0],
+            data_bytes=counter.data_bytes, model_bytes=counter.model_bytes,
+            gathers=counter.gathers,
         ),
     )
 

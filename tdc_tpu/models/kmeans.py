@@ -110,7 +110,18 @@ def _stats_fn(kernel: str, block_rows: int, mesh=None):
         from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto
 
         return lloyd_stats_auto
-    raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
+    if kernel == "pallas_bf16":
+        # bf16-MXU / f32-accumulate distance epilogue: assignment at bf16
+        # MXU precision, statistics exact f32 (ops/pallas_kernels
+        # _LLOYD_BF16_EPILOGUE). Single-device — the sharded towers keep
+        # kernel='pallas' (cast the INPUT to bf16 there instead; same MXU
+        # precision, exact bf16 stats).
+        from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto
+
+        return lambda x, c: lloyd_stats_auto(x, c, mxu_dtype="bfloat16")
+    raise ValueError(
+        f"unknown kernel {kernel!r} (use 'xla', 'pallas' or 'pallas_bf16')"
+    )
 
 
 def auto_block_rows(n: int, k: int, *, budget_bytes: int | None = None) -> int:
@@ -462,6 +473,18 @@ def kmeans_fit(
             "kernel='pallas' with sample_weight is single-device (the "
             "weighted kernels have no shard_map tower); drop mesh or the "
             "explicit kernel"
+        )
+    if kernel == "pallas_bf16" and mesh is not None:
+        raise ValueError(
+            "kernel='pallas_bf16' is single-device (the bf16-MXU epilogue "
+            "has no shard_map tower; cast the input to bf16 with "
+            "kernel='pallas' for the same MXU precision on a mesh)"
+        )
+    if kernel == "pallas_bf16" and sample_weight is not None:
+        raise ValueError(
+            "kernel='pallas_bf16' does not support sample_weight (the "
+            "weighted epilogue keeps full precision); drop the explicit "
+            "kernel"
         )
     block_rows = 0
     if mesh is None and (kernel in ("xla", "refined")

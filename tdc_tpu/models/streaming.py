@@ -1784,6 +1784,8 @@ def streamed_kmeans_fit(
         comms=reduce_lib.CommsReport(
             strategy=strategy.label(), reduces=counter.reduces,
             logical_bytes=counter.logical_bytes, passes=passes[0],
+            data_bytes=counter.data_bytes, model_bytes=counter.model_bytes,
+            gathers=counter.gathers,
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
         ingest=guard.report(),
@@ -2241,6 +2243,8 @@ def streamed_fuzzy_fit(
         comms=reduce_lib.CommsReport(
             strategy=strategy.label(), reduces=counter.reduces,
             logical_bytes=counter.logical_bytes, passes=passes[0],
+            data_bytes=counter.data_bytes, model_bytes=counter.model_bytes,
+            gathers=counter.gathers,
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
         ingest=guard.report(),

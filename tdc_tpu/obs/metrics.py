@@ -103,7 +103,15 @@ CATALOG = {
     "tdc_comms_stats_reduces_total": (
         "counter", "Cross-device stats reduces issued (parallel/reduce)."),
     "tdc_comms_stats_logical_bytes_total": (
-        "counter", "Logical payload bytes moved by stats reduces."),
+        "counter", "Logical payload bytes moved by stats reduces and "
+                   "model-axis gathers (cross-axis total)."),
+    "tdc_comms_stats_gathers_total": (
+        "counter", "Cross-device all_gathers issued (champion + sharded "
+                   "finalize; parallel/gather)."),
+    "tdc_comms_stats_axis_bytes_total": (
+        "counter", "Logical payload bytes per mesh axis "
+                   "(axis=\"data\"|\"model\"; data-axis stats reduces vs "
+                   "model-axis champion/finalize gathers)."),
     # spill-tier H2D prefetch ring (data/spill.py)
     "tdc_h2d_bytes_total": (
         "counter", "Logical host->device bytes staged by the spill "

@@ -380,7 +380,37 @@ _LLOYD_EPILOGUE = KernelEpilogue(
 )
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "halves", "interpret"))
+def _cross_mxu_bf16(subs, resident):
+    """bf16-MXU / f32-accumulate variant of _cross_mxu: both cross-product
+    operands are rounded to bf16 at the MXU port, the accumulator stays
+    f32 (preferred_element_type) — the half-width-throughput mode of the
+    distance matmul. The assignment decision and the SSE term see bf16
+    rounding (SSE error ~2^-9·‖x‖² per point — the matmul-form
+    cancellation, amplified; kernel='refined' is the f32 antidote); the
+    fold is the unchanged _lloyd_fold, whose stats contraction
+    (one-hot · x) runs at the INPUT dtype, so f32 inputs keep exact f32
+    sums/counts — the same assignment-approximate/statistics-exact split
+    as the PR-2 quantized reduce. For bf16 inputs both casts are no-ops
+    and this epilogue is bit-identical to _cross_mxu."""
+    return (
+        jax.lax.dot_general(
+            subs[0].astype(jnp.bfloat16),
+            resident[0].astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ),  # (BN/halves, K)
+    )
+
+
+_LLOYD_BF16_EPILOGUE = KernelEpilogue(
+    name="lloyd_mxu_bf16", n_row=1, n_acc=3, mxu=_cross_mxu_bf16,
+    fold=_lloyd_fold,
+)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_n", "halves", "interpret", "mxu_dtype")
+)
 def lloyd_stats_fused(
     x: jax.Array,
     centroids: jax.Array,
@@ -388,6 +418,7 @@ def lloyd_stats_fused(
     block_n: int | None = None,
     halves: int | None = None,
     interpret: bool | None = None,
+    mxu_dtype: str | None = None,
 ):
     """Fully-fused Lloyd sufficient stats: one kernel, one pass over x, no
     (N, K) intermediate anywhere (HBM or otherwise). Requires the (K, d)
@@ -402,11 +433,26 @@ def lloyd_stats_fused(
     benchmarks/kernel_tuning.py sweep); any other block keeps the strictly
     sequential kernel. The math is identical either way.
 
+    mxu_dtype='bfloat16' selects the bf16-MXU / f32-accumulate epilogue
+    (_LLOYD_BF16_EPILOGUE): the distance cross product runs at bf16 MXU
+    precision (2× matmul throughput on f32 inputs) while the one-hot stats
+    contraction keeps the input dtype — assignment approximate, statistics
+    exact, the kernel-side analogue of the PR-2 quantized reduce. No-op
+    (bit-identical) for bf16 inputs. kernel='pallas_bf16' in the fit APIs
+    reaches this knob.
+
     Returns ops.assign.SufficientStats (sums (K,d) f32, counts (K,) f32,
     sse () f32 — true Σ min‖x−c‖², clamped at 0).
     """
     from tdc_tpu.ops.assign import SufficientStats
 
+    if mxu_dtype not in (None, "bfloat16"):
+        raise ValueError(
+            f"lloyd_stats_fused: mxu_dtype={mxu_dtype!r} (only 'bfloat16' "
+            "— the MXU's native half-precision — or None for full input "
+            "precision)"
+        )
+    epilogue = _LLOYD_BF16_EPILOGUE if mxu_dtype else _LLOYD_EPILOGUE
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     n, d = x.shape
@@ -436,7 +482,7 @@ def lloyd_stats_fused(
     n_blocks = n_pad // block_n
 
     sums, counts, sse = pl.pallas_call(
-        functools.partial(_fused_epilogue_kernel, epilogue=_LLOYD_EPILOGUE,
+        functools.partial(_fused_epilogue_kernel, epilogue=epilogue,
                           halves=halves),
         grid=(n_blocks,),
         in_specs=[
@@ -779,8 +825,13 @@ def resolve_kernel(
     (fused_block_n / twopass_blocks / gmm_block_n), and falls back to 'xla'
     LOUDLY otherwise — one structlog `kernel_selected` event names the
     choice and the reason every time auto decides. Explicitly named
-    kernels ('xla', 'pallas', ...) pass through untouched, so existing
-    behavior is bit-identical when the knob is spelled out.
+    kernels ('xla', 'pallas', 'pallas_bf16', ...) pass through untouched,
+    so existing behavior is bit-identical when the knob is spelled out.
+    auto itself never resolves to 'pallas_bf16': the bf16-MXU epilogue
+    rounds f32 assignment distances, and an auto policy must be
+    numerics-preserving — opting into the half-precision MXU is always
+    explicit (for bf16 INPUTS the plain fused kernel already runs the MXU
+    at bf16, so auto loses nothing).
 
     `k` is the per-device centroid count (callers on the K-sharded towers
     pass K / n_model — VMEM feasibility is a per-shard question).
@@ -845,11 +896,26 @@ def lloyd_stats_auto(x: jax.Array, centroids: jax.Array, **kw):
     including the K=4096·d=256 and K=16,384·d=768 regimes where the fused
     kernel cannot compile. Beyond the fused regime the dense one-hot stats
     contraction costs a full second distance pass; the sorted path replaces
-    it with 2·B·d FLOPs/point (benchmarks/ROOFLINE_SHARDED.md)."""
+    it with 2·B·d FLOPs/point (benchmarks/ROOFLINE_SHARDED.md).
+
+    mxu_dtype (kernel='pallas_bf16') is a FUSED-kernel knob: beyond the
+    fused VMEM regime it is dropped LOUDLY (one `kernel_selected` event)
+    and the sorted path runs at full input precision — precision silently
+    degrading is a bug, precision silently improving on the fallback is
+    just the conservative default."""
     from tdc_tpu.ops.sorted_stats import lloyd_stats_sorted
 
     if fused_block_n(centroids.shape[0], x.shape[1], x.dtype.itemsize) > 0:
         return lloyd_stats_fused(x, centroids, **kw)
+    if kw.pop("mxu_dtype", None) is not None:
+        from tdc_tpu.utils.structlog import emit
+
+        emit("kernel_selected", kernel="sorted", model="kmeans",
+             k=int(centroids.shape[0]), d=int(x.shape[1]),
+             reason="bf16-MXU epilogue is fused-only; (K, d) exceeds the "
+                    "fused-kernel VMEM model — sorted path runs at full "
+                    "input precision",
+             label="lloyd_stats_auto")
     return lloyd_stats_sorted(x, centroids, **kw)
 
 

@@ -1,0 +1,272 @@
+"""Compressed model/data-axis all_gather — the gather-side twin of
+parallel/reduce (ROADMAP item 3, EQuARX-style block-wise quantization,
+arXiv 2506.17615).
+
+PR 2 compressed the data-axis stats psum; the model-axis traffic of the
+K-sharded towers — the per-batch champion (min, argmin) all_gathers and
+the centroid slices the data-sharded finalize exchanges — stayed full
+fp32. This module provides the quantized gather primitives both ride:
+
+- int8 with per-BLOCK (128-element) shared scales. Unlike the psum,
+  gather payloads are never summed across devices, so the scales are
+  LOCAL per source shard — no pmax agreement round is needed, and the
+  codes + bitcast-to-int8 scales travel as ONE packed int8 buffer in a
+  single all_gather. The collective count/order is therefore identical
+  to the fp32 schedule (only operand dtypes/shapes change — the
+  property tdcverify pins via same_schedule_as).
+- bf16: cast → all_gather → upcast, same one-collective shape.
+- Error feedback for pass-persistent leaves (the finalize's centroid
+  slices): residual = y − decode(encode(y)) is returned to the caller,
+  held in one persistent slot per gathered leaf, and re-injected into
+  the next pass's encode — the EXACT algebra of reduce._q_psum_leaf.
+  The finalize feeds the codec centroid DELTAS (new − current, with the
+  replicated current added back after the gather), so the shared scales
+  track the per-pass shift magnitude rather than the centroid
+  magnitude — decode error shrinks with the update as the fit
+  converges, instead of staying proportional to the data scale.
+  Per-batch leaves (champion mins) are NOT error-fed: their payloads are
+  new data every batch, there is no "next pass" for the residual of a
+  batch that never recurs.
+- Hierarchical staging (staged_all_gather): innermost-first over
+  (dcn, ici)-style axis tuples with only the LAST (outermost = DCN)
+  stage compressed — the expensive hop is the one quantized, mirroring
+  reduce.tree_psum's last-stage-only policy.
+
+Exactness invariant the coarse assignment path relies on: 0.0 encodes
+to code 0 under any positive scale and decodes to exactly 0.0, so
+zero-padding rows report min 0.0 on every shard after the quantized
+gather, same as fp32.
+
+Byte accounting (leaf_gather_cost / staged_gather_cost /
+champion_gather_cost) mirrors reduce.tree_reduce_cost: logical bytes of
+the gathered buffer per stage, not wire bytes. CommsCounter books these
+under axis="model" (see parallel/reduce.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+GATHER_MODES = ("fp32", "fp32_sharded", "bf16", "int8")
+
+# Shared-scale block width (EQuARX-style). 128 matches the TPU lane
+# width; payloads are zero-padded up to a multiple internally.
+BLOCK = 128
+
+_EPS = 1e-30  # all-zero blocks keep a positive scale (0 -> code 0 -> 0.0)
+
+
+@dataclass(frozen=True)
+class GatherStrategy:
+    """Validated `gather=` knob for the K-sharded drivers (the gather
+    twin of reduce.ReduceStrategy).
+
+    mode:
+      'fp32'         — the pre-PR schedules, byte-identical: fp32
+                       champion gathers, fully replicated finalize.
+      'fp32_sharded' — full-precision wire, but the centroid finalize is
+                       computed on each device's 1/n_data K-slice and
+                       all-gathered (the FLOP-reduction ablation mode;
+                       bit-exact vs the replicated finalize).
+      'bf16' / 'int8' — fp32_sharded's structure with the champion and
+                       finalize gathers compressed; the finalize gather
+                       carries a persistent error-feedback residual.
+    """
+
+    mode: str = "fp32"
+
+    def __post_init__(self):
+        if self.mode not in GATHER_MODES:
+            raise ValueError(
+                f"gather mode {self.mode!r} not in {GATHER_MODES}"
+            )
+
+    @property
+    def quantized(self) -> bool:
+        return self.mode in ("bf16", "int8")
+
+    @property
+    def sharded_finalize(self) -> bool:
+        return self.mode != "fp32"
+
+    def label(self) -> str:
+        return self.mode
+
+
+def resolve_gather(gather) -> GatherStrategy:
+    """'fp32' | 'fp32_sharded' | 'bf16' | 'int8' | GatherStrategy →
+    GatherStrategy (same shorthand contract as reduce.resolve_reduce)."""
+    if isinstance(gather, GatherStrategy):
+        return gather
+    return GatherStrategy(mode=str(gather))
+
+
+# ---------------------------------------------------------------------------
+# int8 block codec: (B, BLOCK) rows -> int8 codes + one f32 scale per row.
+# ---------------------------------------------------------------------------
+
+
+def _encode_int8(blocks):
+    """(B, L) f32 → (codes (B, L) int8, scales (B,) f32), symmetric
+    per-row scale = max|y|/127 (the reduce._q_psum_leaf quantizer with
+    local instead of pmax-agreed scales)."""
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = jnp.maximum(amax, _EPS) / 127.0
+    codes = jnp.clip(
+        jnp.round(blocks / scales[:, None]), -127, 127
+    ).astype(jnp.int8)
+    return codes, scales
+
+
+def _decode_int8(codes, scales):
+    return codes.astype(jnp.float32) * scales[..., None]
+
+
+def _pad_to_block(flat):
+    n = flat.shape[0]
+    n_pad = -(-n // BLOCK) * BLOCK
+    if n_pad != n:
+        flat = jnp.pad(flat, (0, n_pad - n))
+    return flat, n_pad
+
+
+def _pack(codes_flat, scales):
+    """codes (int8) ++ scales bitcast to int8 bytes: ONE flat payload so
+    the compressed gather stays ONE collective."""
+    sbytes = jax.lax.bitcast_convert_type(scales, jnp.int8).reshape(-1)
+    return jnp.concatenate([codes_flat, sbytes])
+
+
+def _unpack(gathered, n_codes, n_scales):
+    """(G, payload) → (codes (G, n_codes) int8, scales (G, n_scales) f32)."""
+    codes = gathered[:, :n_codes]
+    sbytes = gathered[:, n_codes:].reshape(gathered.shape[0], n_scales, 4)
+    return codes, jax.lax.bitcast_convert_type(sbytes, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# The compressed all_gather primitive.
+# ---------------------------------------------------------------------------
+
+
+def compressed_all_gather(y, axis_name, mode: str, *, err=None):
+    """all_gather an f32 leaf across ONE mesh axis under `mode`.
+
+    Returns (gathered (G,) + y.shape f32, new_err). `err` (same shape as
+    y, or None) is the persistent error-feedback residual: injected into
+    the encode, and the returned new_err holds this round's quantization
+    error for the NEXT call — reduce.py's EF algebra applied to a gather
+    leaf. err=None skips EF entirely (per-batch leaves); fp32 modes pass
+    err through untouched (the residual stays identically zero).
+
+    Must be called inside shard_map (axis_name must be bound).
+    """
+    if mode in ("fp32", "fp32_sharded"):
+        return jax.lax.all_gather(y, axis_name), err
+    if mode == "bf16":
+        src = y if err is None else y + err
+        enc = src.astype(jnp.bfloat16)
+        new_err = None if err is None else src - enc.astype(jnp.float32)
+        g = jax.lax.all_gather(enc, axis_name).astype(jnp.float32)
+        return g, new_err
+    if mode != "int8":
+        raise ValueError(f"gather mode {mode!r} not in {GATHER_MODES}")
+    src = y if err is None else y + err
+    flat = src.reshape(-1)
+    n = flat.shape[0]
+    flat_p, n_pad = _pad_to_block(flat)
+    codes, scales = _encode_int8(flat_p.reshape(-1, BLOCK))
+    if err is None:
+        new_err = None
+    else:
+        dec_local = _decode_int8(codes, scales).reshape(-1)[:n]
+        new_err = (flat - dec_local).reshape(y.shape)
+    gathered = jax.lax.all_gather(_pack(codes.reshape(-1), scales), axis_name)
+    cg, sg = _unpack(gathered, n_pad, n_pad // BLOCK)
+    dec = _decode_int8(cg.reshape(gathered.shape[0], -1, BLOCK), sg)
+    dec = dec.reshape(gathered.shape[0], -1)[:, :n]
+    return dec.reshape((gathered.shape[0],) + y.shape), new_err
+
+
+def staged_all_gather(y, axes, mode: str, *, err=None):
+    """all_gather across one or more mesh axes, innermost-first, with
+    only the LAST (outermost — the DCN hop on hierarchical meshes) stage
+    compressed — the staging policy of reduce.tree_psum applied to
+    gathers: ICI stages stay fp32, the expensive hop is the one
+    quantized.
+
+    Returns (gathered (prod(G),) + y.shape f32, new_err). For EF, `err`
+    matches the LAST stage's input shape: (inner groups…,) + y.shape —
+    for single-axis calls that is just y.shape.
+    """
+    axes = tuple(axes)
+    if not axes:
+        raise ValueError("staged_all_gather needs at least one axis")
+    leaf = y
+    for ax in axes[:0:-1]:  # inner stages, innermost first, full precision
+        leaf = jax.lax.all_gather(leaf, ax)
+    g, new_err = compressed_all_gather(leaf, axes[0], mode, err=err)
+    return g.reshape((-1,) + y.shape), new_err
+
+
+# ---------------------------------------------------------------------------
+# Byte accounting (the gather twin of reduce.tree_reduce_cost): logical
+# bytes of the gathered buffer per stage. Booked under axis="model".
+# ---------------------------------------------------------------------------
+
+
+def _payload_bytes(n_elems: int, mode: str) -> int:
+    if mode in ("fp32", "fp32_sharded"):
+        return n_elems * 4
+    if mode == "bf16":
+        return n_elems * 2
+    n_pad = -(-n_elems // BLOCK) * BLOCK
+    return n_pad + 4 * (n_pad // BLOCK)  # int8 codes + f32 block scales
+
+
+def leaf_gather_cost(n_elems: int, group: int, mode: str) -> int:
+    """Logical bytes one all_gather stage materializes: group × the
+    per-source payload (codes + scales when quantized)."""
+    return group * _payload_bytes(n_elems, mode)
+
+
+def staged_gather_cost(n_elems: int, groups, mode: str):
+    """Per-stage logical bytes for staged_all_gather, innermost-first
+    (the order the stages execute). groups is (outer, …, inner) matching
+    the axes tuple; inner stages are fp32, the last is `mode`."""
+    groups = tuple(groups)
+    stages = []
+    cur = n_elems
+    for g in groups[:0:-1]:
+        stages.append(leaf_gather_cost(cur, g, "fp32"))
+        cur *= g
+    stages.append(leaf_gather_cost(cur, groups[0], mode))
+    return stages
+
+
+def champion_gather_cost(n_rows: int, n_model: int, mode: str):
+    """(gathers, logical bytes) for ONE batch's champion (min, argmin)
+    all_gather pair over the model axis. The int32 argmin column is
+    never quantized (champion ids must survive exactly)."""
+    mins = leaf_gather_cost(n_rows, n_model, mode)
+    args = n_model * n_rows * 4
+    return 2, mins + args
+
+
+def finalize_gather_cost(k: int, d: int, groups, mode: str):
+    """(collectives, logical bytes) for one sharded-finalize exchange:
+    the staged slice all_gather (each stage's gathered buffer) plus the
+    4-byte shift pmax. groups = data-axis stage sizes, outer-first;
+    k is the GLOBAL centroid count — each of prod(groups) slices carries
+    k·d / prod(groups) elements, so the bytes sum telescopes to the full
+    (K, d) buffer per model column at the final stage."""
+    groups = tuple(groups)
+    n_data = 1
+    for g in groups:
+        n_data *= g
+    slice_elems = (k * d) // n_data
+    stages = staged_gather_cost(slice_elems, groups, mode)
+    return len(stages) + 1, sum(stages) + 4

@@ -43,9 +43,12 @@ model, not a link-level measurement). Drivers attach a `CommsReport` to
 fit results and bump the process-wide `GLOBAL_COMMS`, which the serve
 `/metrics` endpoint exposes.
 
-The champion all_gather of the K-sharded towers (parallel/sharded_k) is a
-different category — N-proportional assignment traffic, not stats — and is
-deliberately not counted here.
+The model-axis traffic of the K-sharded towers — the champion all_gathers
+and the sharded-finalize centroid exchange (parallel/gather.py) — is
+booked by the K-sharded streamed drivers into the SAME counters under
+`axis="model"`, so `CommsReport.data_bytes`/`model_bytes` split the total
+by mesh axis and `bench_comms` can price the gather= compression
+independently of the reduce= compression.
 """
 
 from __future__ import annotations
@@ -140,26 +143,45 @@ class CommsCounter:
         self._lock = threading.Lock()
         self._mirror = _mirror
         self.reduces = 0
+        self.gathers = 0
         self.logical_bytes = 0
+        self.data_bytes = 0
+        self.model_bytes = 0
 
-    def add(self, reduces: int, nbytes: int) -> None:
+    def add(self, reduces: int, nbytes: int, *, axis: str = "data",
+            gathers: int = 0) -> None:
+        """axis="data" books a stats reduce (the historical meaning);
+        axis="model" books K-sharded gather traffic (champion all_gathers
+        + the sharded-finalize exchange). logical_bytes stays the total
+        across both axes."""
         with self._lock:
             self.reduces += int(reduces)
+            self.gathers += int(gathers)
             self.logical_bytes += int(nbytes)
+            if axis == "model":
+                self.model_bytes += int(nbytes)
+            else:
+                self.data_bytes += int(nbytes)
         if self._mirror is not None:
-            self._mirror.add(reduces, nbytes)
+            self._mirror.add(reduces, nbytes, axis=axis, gathers=gathers)
 
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "reduces": self.reduces,
+                "gathers": self.gathers,
                 "logical_bytes": self.logical_bytes,
+                "data_bytes": self.data_bytes,
+                "model_bytes": self.model_bytes,
             }
 
     def reset(self) -> None:
         with self._lock:
             self.reduces = 0
+            self.gathers = 0
             self.logical_bytes = 0
+            self.data_bytes = 0
+            self.model_bytes = 0
 
 
 # Process-wide counter (mirrored into by every per-fit counter); surfaced
@@ -168,12 +190,21 @@ GLOBAL_COMMS = CommsCounter()
 
 
 class CommsReport(NamedTuple):
-    """Per-fit communication summary attached to fit results."""
+    """Per-fit communication summary attached to fit results.
+
+    data_bytes/model_bytes split logical_bytes by mesh axis: data-axis
+    stats reduces vs model-axis gathers (K-sharded champion all_gathers
+    + the sharded-finalize centroid exchange; zero on 1-D fits). The
+    trailing fields default so pre-split call sites keep working.
+    """
 
     strategy: str  # ReduceStrategy.label()
     reduces: int  # cross-device stats reduces issued by this fit
-    logical_bytes: int  # total logical payload bytes across those reduces
+    logical_bytes: int  # total logical payload bytes (both axes)
     passes: int  # full passes over the stream (iterations + final scoring)
+    data_bytes: int = 0  # logical bytes of the data-axis stats reduces
+    model_bytes: int = 0  # logical bytes of the model-axis gathers
+    gathers: int = 0  # model-axis all_gathers issued by this fit
 
     @property
     def reduces_per_pass(self) -> float:

@@ -165,6 +165,41 @@ def redistribute_deferred(tree, n_slots: int, place=None):
     return host if place is None else place(host)
 
 
+def redistribute_gather_err(err, n_data: int, n_model: int, place=None):
+    """Re-lay a sharded-finalize gather residual (parallel/gather.py EF
+    state, `zero_finalize_err` layout: (slots, K, d) where slot i carries
+    the residual rows of data-slice i within each model column, zeros
+    elsewhere) onto a resized (n_data, n_model) mesh.
+
+    A plain `redistribute_deferred` fold-to-slot-0 would preserve
+    Σ_slots but ORPHAN re-injection: on the new mesh, device (i>0, j)
+    reads only its own slice band of slot i, which the fold left zero.
+    So: fold (Σ-preserving), then re-scatter the folded (K, d) residual
+    map into the NEW slice partition — row r lands in the slot that owns
+    r under the new (n_data, n_model) split, so every slice's next
+    encode re-injects exactly its own rows' residual.
+
+    Exact (no f32 re-association beyond the fold): slot bands are
+    disjoint, so the fold is a permutation-free sum of non-overlapping
+    rows."""
+    folded = redistribute_deferred(err, 1)  # (1, K, d): slot 0 = Σ_slots
+    full = folded[0]
+    k = full.shape[0]
+    if k % (n_model * n_data):
+        raise ValueError(
+            f"K={k} must divide over n_model={n_model} × n_data={n_data} "
+            "to re-partition the gather residual"
+        )
+    rows = k // (n_model * n_data)
+    out = np.zeros((n_data,) + full.shape, full.dtype)
+    for j in range(n_model):
+        base = j * (k // n_model)
+        for i in range(n_data):
+            lo = base + i * rows
+            out[i, lo:lo + rows] = full[lo:lo + rows]
+    return out if place is None else place(out)
+
+
 __all__ = [
     "LAYOUT_META_PREFIX",
     "LayoutManifest",
@@ -173,4 +208,5 @@ __all__ = [
     "manifest_of",
     "redistribute",
     "redistribute_deferred",
+    "redistribute_gather_err",
 ]

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tdc_tpu.data import ingest as ingest_lib
 from tdc_tpu.data import spill as spill_lib
 from tdc_tpu.obs import trace
+from tdc_tpu.parallel import gather as gather_lib
 from tdc_tpu.parallel.compat import shard_map
 from tdc_tpu.parallel.meshspec import MeshSpec
 from tdc_tpu.parallel import reshard as reshard_lib
@@ -57,12 +58,21 @@ def make_mesh_2d(n_data: int, n_model: int) -> Mesh:
     )
 
 
-def _block_champions(x_blk, c_loc, kernel: str, shifted: bool = False):
+def _block_champions(x_blk, c_loc, kernel: str, shifted: bool = False,
+                     gather: str = "fp32"):
     """Per-block global (min d², argmin) across all K shards.
 
     Each model shard scores the block against its local centroids, then the
     per-shard champions — two (Pm, block) arrays, not distances — cross ICI
     via all_gather for the global argmin.
+
+    gather='bf16'/'int8' compresses the min-distance column of that pair
+    (parallel/gather.py: packed codes + per-block scales, still ONE
+    all_gather); the int32 argmin column always travels exact. Champion
+    comparisons then happen on the decoded values — identical on every
+    shard, so sse stays replicated — and ties still resolve to the lowest
+    centroid index. No error feedback: mins are per-batch data with no
+    next-pass residual slot to fold into.
 
     shifted=True drops the row-constant ‖x‖² term from the reported min
     distances — every shard shifts a given point by the same amount, so
@@ -94,7 +104,9 @@ def _block_champions(x_blk, c_loc, kernel: str, shifted: bool = False):
         lmin = jnp.min(d2, axis=1)
         arg = jnp.argmin(d2, axis=1).astype(jnp.int32)
     larg = arg + m_idx * k_per
-    mins = jax.lax.all_gather(lmin, MODEL_AXIS)  # (Pm, block)
+    mins, _ = gather_lib.compressed_all_gather(
+        lmin, MODEL_AXIS, gather
+    )  # (Pm, block)
     args = jax.lax.all_gather(larg, MODEL_AXIS)  # (Pm, block)
     # Champion selection as pure reductions: per-column take_along_axis
     # gathers on (Pm, N) measured 3.75 ms each at N=524k (scalar-gather
@@ -105,7 +117,8 @@ def _block_champions(x_blk, c_loc, kernel: str, shifted: bool = False):
     return gmin, garg
 
 
-def _block_stats(x_blk, c_loc, kernel: str, shifted: bool = False):
+def _block_stats(x_blk, c_loc, kernel: str, shifted: bool = False,
+                 gather: str = "fp32"):
     """(sums (K/Pm, d), counts (K/Pm,), sse ()) for one N-block — local to
     this (data, model) shard pair; data-psum'd by the caller.
 
@@ -120,7 +133,7 @@ def _block_stats(x_blk, c_loc, kernel: str, shifted: bool = False):
 
     k_per = c_loc.shape[0]
     m_idx = jax.lax.axis_index(MODEL_AXIS)
-    gmin, garg = _block_champions(x_blk, c_loc, kernel, shifted)
+    gmin, garg = _block_champions(x_blk, c_loc, kernel, shifted, gather)
     rel = garg - m_idx * k_per
     # On the pallas route the windowed-accumulate runs as a Pallas kernel
     # too (accumulator tiles stay VMEM-resident instead of DUS round-trips
@@ -134,7 +147,7 @@ def _block_stats(x_blk, c_loc, kernel: str, shifted: bool = False):
 def make_sharded_stats(
     mesh: Mesh, kernel: str = "xla", block_rows: int = 0,
     shifted: bool = False, reduce_data: bool = True,
-    assign_spec=None,
+    assign_spec=None, gather: str = "fp32",
 ):
     """Returns a jit-able fn(x, c) → (sums, counts, sse): x sharded (data,),
     c sharded (model,); sums/counts stay K-sharded, sse replicated.
@@ -164,6 +177,13 @@ def make_sharded_stats(
     shard-locally and issue `make_sharded_deferred_reduce` once per pass.
     The champion all_gather over the model axis still runs per batch (it is
     N-proportional assignment traffic and cannot be deferred).
+
+    gather='bf16'/'int8' compresses the champion min column's model-axis
+    all_gather (parallel/gather.py); 'fp32'/'fp32_sharded' keep the exact
+    fp32 pair (the finalize-side difference between those two lives in
+    make_sharded_finalize, not here). The collective count/order is
+    mode-independent — only operand dtypes change (tdcverify pins this
+    via same_schedule_as).
     """
     out_specs = (
         (P(MODEL_AXIS, None), P(MODEL_AXIS), P()) if reduce_data
@@ -209,7 +229,13 @@ def make_sharded_stats(
             # them sentinel/zero (no padding correction anywhere).
             larg = jnp.where(labels < subk_lib.ARG_SENTINEL,
                              labels + m_idx * k_per, subk_lib.ARG_SENTINEL)
-            mins = jax.lax.all_gather(lmin, MODEL_AXIS)  # (Pm, n_loc)
+            # Pad rows report min 0.0 on every shard, and 0.0 survives the
+            # quantized gather exactly (code 0 decodes to 0.0 under any
+            # positive scale — parallel/gather.py), so the sentinel/zero
+            # masking below is gather-mode-independent.
+            mins, _ = gather_lib.compressed_all_gather(
+                lmin, MODEL_AXIS, gather
+            )  # (Pm, n_loc)
             args = jax.lax.all_gather(larg, MODEL_AXIS)
             gmin = jnp.min(mins, axis=0)
             garg = jnp.min(
@@ -262,7 +288,7 @@ def make_sharded_stats(
             xb = x_loc.reshape(n_loc // block_rows, block_rows, d)
 
             def body(acc, blk):
-                s, ct, e = _block_stats(blk, c_loc, kernel, shifted)
+                s, ct, e = _block_stats(blk, c_loc, kernel, shifted, gather)
                 return (acc[0] + s, acc[1] + ct, acc[2] + e), None
 
             zero = (
@@ -272,7 +298,8 @@ def make_sharded_stats(
             )
             (sums, counts, sse), _ = jax.lax.scan(body, zero, xb)
         else:
-            sums, counts, sse = _block_stats(x_loc, c_loc, kernel, shifted)
+            sums, counts, sse = _block_stats(x_loc, c_loc, kernel, shifted,
+                                             gather)
         if not reduce_data:
             # Deferred mode: keep the data-shard partials local (leading
             # device axis); the sse is identical on every model shard (the
@@ -568,12 +595,189 @@ def padding_correction(counts, sse, centroids, n_pad):
     return counts.at[j].add(-n_pad), sse - n_pad * c2[j]
 
 
+def zero_finalize_err(mesh: Mesh, k: int, d: int):
+    """Fresh error-feedback state for the sharded finalize's quantized
+    slice gather: ONE persistent residual slot per gathered leaf, in the
+    deferred (n_data, K, d) leading-slot layout — slot i carries the
+    residual of slice i's rows, zeros elsewhere, so Σ_slots is the full
+    (K, d) residual map and `reshard.redistribute_gather_err` can fold
+    it across a mesh resize (Σ-preserving, like the deferred stats
+    accumulators). Device-placed sharding-first, like zero_deferred."""
+    n_data = mesh.devices.shape[0]
+    sharding = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None))
+    return jax.jit(
+        lambda: jnp.zeros((n_data, k, d), jnp.float32),
+        out_shardings=sharding,
+    )()
+
+
+def make_sharded_finalize(
+    mesh: Mesh,
+    *,
+    spherical: bool = False,
+    mode: str = "fp32_sharded",
+    fuzzy: bool = False,
+):
+    """Data-axis-sharded centroid finalize (ROADMAP item 3: the
+    cross-replica weight-update sharding pattern of arXiv 2004.13336
+    applied to the Lloyd divide/renormalize).
+
+    The replicated finalize computes the full (K/Pm, d) divide on every
+    data replica — n_data× redundant FLOPs and, once compressed gathers
+    exist, the only place left where centroids cross the wire fp32. Here
+    each (data, model) shard divides only its 1/n_data slice of the local
+    K/Pm rows and the slices cross the data axis in one all_gather
+    (compressed under mode='bf16'/'int8', with a persistent per-leaf
+    error-feedback residual in the zero_finalize_err layout).
+
+    mode='fp32_sharded' is bit-exact vs the replicated finalize: the
+    slice rows run the identical elementwise ops, and the gather moves
+    exact f32. Signatures:
+
+      fp32_sharded:  fn(sums, counts, c)      -> (new_c, shift)
+      bf16 / int8:   fn(sums, counts, c, err) -> (new_c, shift, new_err)
+
+    fuzzy=True divides by max(weights, 1e-12) with no empty-cluster
+    fallback (the streamed fuzzy driver's update). Requires
+    (K/Pm) % n_data == 0 — validated by the drivers' gather plan.
+    """
+    n_data = mesh.devices.shape[0]
+    quantized = mode in ("bf16", "int8")
+    err_specs = (P(DATA_AXIS, MODEL_AXIS, None),) if quantized else ()
+    in_specs = (
+        P(MODEL_AXIS, None), P(MODEL_AXIS), P(MODEL_AXIS, None)
+    ) + err_specs
+    out_specs = (P(MODEL_AXIS, None), P()) + err_specs
+
+    @partial(shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+             check_vma=False)
+    def finalize(sums_loc, counts_loc, c_loc, *err_loc):
+        k_per, d = sums_loc.shape
+        if k_per % n_data:
+            raise ValueError(
+                f"sharded finalize needs K/Pm={k_per} divisible by the "
+                f"data axis ({n_data})"
+            )
+        rows = k_per // n_data
+        start = jax.lax.axis_index(DATA_AXIS) * rows
+        s = jax.lax.dynamic_slice_in_dim(sums_loc, start, rows)
+        w = jax.lax.dynamic_slice_in_dim(counts_loc, start, rows)
+        cf = jax.lax.dynamic_slice_in_dim(
+            c_loc, start, rows
+        ).astype(jnp.float32)
+        if fuzzy:
+            new_slice = s / jnp.maximum(w[:, None], 1e-12)
+        else:
+            new_slice = jnp.where(
+                w[:, None] > 0, s / jnp.maximum(w[:, None], 1.0), cf
+            )
+        if spherical:
+            new_slice = _normalize(new_slice)
+        # Max centroid shift: per-slice max, then one 4-byte pmax over
+        # both axes (the replicated finalize got the cross-model max for
+        # free from XLA's auto-sharded reduce).
+        shift = jax.lax.pmax(
+            jnp.max(jnp.linalg.norm(new_slice - cf, axis=-1)),
+            (DATA_AXIS, MODEL_AXIS),
+        )
+        if quantized:
+            e = jax.lax.dynamic_slice(
+                err_loc[0], (0, start, 0), (1, rows, d)
+            )[0]
+            # Delta coding: quantize the iteration's centroid SHIFT, not
+            # the centroid values. The reference c is replicated across
+            # the data axis, so every shard reconstructs identically with
+            # one local add — and the codec's scale tracks the shift
+            # magnitude (→ 0 near convergence) instead of the centroid
+            # magnitude, which keeps the decode error proportional to the
+            # update instead of the data scale. A zero shift (empty
+            # cluster keeping cf) encodes to code 0 and decodes to
+            # exactly cf.
+            g, new_e = gather_lib.compressed_all_gather(
+                new_slice - cf, DATA_AXIS, mode, err=e
+            )
+            new_err = jax.lax.dynamic_update_slice(
+                jnp.zeros_like(err_loc[0]), new_e[None], (0, start, 0)
+            )
+            new_c = g.reshape(k_per, d) + c_loc.astype(jnp.float32)
+            return new_c, shift, new_err
+        g, _ = gather_lib.compressed_all_gather(new_slice, DATA_AXIS, mode)
+        return g.reshape(k_per, d), shift
+
+    return finalize
+
+
+def plan_gather(gather, mesh: Mesh, k: int, *, assign: str = "exact",
+                ckpt_dir=None, ckpt_every_batches: int = 0,
+                residency: str = "stream"):
+    """Shared validation for the K-sharded drivers' `gather=` knob — the
+    gather twin of streaming._reduce_plan, and the ONE copy of the
+    guard-rail rules. Returns the resolved GatherStrategy.
+
+    Quantized gathers refuse loudly wherever the error-feedback state
+    cannot persist: checkpointed fits (a resume would restart the
+    residual — the same bit-identical-resume contract as the quantized
+    reduce, and mid-pass ckpt_every_batches saves have no residual slot
+    at all), hbm/auto residency (the compiled resident chunk traces the
+    centroid update once; the host-held residual cannot ride it), and
+    single-device meshes (the gathers are no-ops — there is nothing to
+    quantize). assign='bounded' is a bit-exact contract: quantized
+    champion mins would invalidate the triangle-inequality certificates.
+    """
+    strategy = gather_lib.resolve_gather(gather)
+    if strategy.mode == "fp32":
+        return strategy
+    n_data, n_model = mesh.devices.shape
+    if (k // n_model) % n_data:
+        raise ValueError(
+            f"gather={strategy.mode!r} shards the finalize over the data "
+            f"axis: K/Pm={k // n_model} must be divisible by "
+            f"n_data={n_data}"
+        )
+    if assign == "bounded":
+        raise ValueError(
+            "assign='bounded' runs its own tower with the replicated "
+            "finalize (a zero-loss contract quantized champion gathers "
+            "would invalidate); use gather='fp32'"
+        )
+    if not strategy.quantized:
+        return strategy
+    if n_data * n_model <= 1:
+        raise ValueError(
+            "quantized gather requires a multi-device mesh (on one "
+            "device the champion/finalize gathers are no-ops — there is "
+            "no cross-device gather to quantize)"
+        )
+    if ckpt_dir is not None:
+        raise ValueError(
+            "quantized gather does not support ckpt_dir: a resume would "
+            "restart the finalize error-feedback residual, breaking the "
+            "bit-identical-resume contract (and mid-pass "
+            "ckpt_every_batches saves carry no residual slot at all)"
+        )
+    if ckpt_every_batches:
+        raise ValueError(
+            "quantized gather does not support mid-pass checkpointing "
+            "(ckpt_every_batches): the finalize error-feedback residual "
+            "only exists at pass boundaries"
+        )
+    if residency not in (None, "stream"):
+        raise ValueError(
+            f"quantized gather requires residency='stream' (got "
+            f"{residency!r}): the compiled resident chunk traces the "
+            "centroid update once and cannot carry the gather "
+            "error-feedback state across chunk iterations"
+        )
+    return strategy
+
+
 def make_sharded_lloyd_step(
     mesh: Mesh,
     kernel: str = "xla",
     block_rows: int = 0,
     spherical: bool = False,
     assign_spec=None,
+    gather: str = "fp32",
 ):
     """Returns a jit'd step: (x (data,)-sharded, c (model,)-sharded, n_valid)
     → (new_c (model,)-sharded, shift, sse). Zero-padding rows beyond n_valid
@@ -592,15 +796,25 @@ def make_sharded_lloyd_step(
     against the unshifted per-point-clamped path — assignments and centroid
     updates are unaffected (champions are shift-invariant); only the scalar
     SSE report degrades. Pre-center such data, or call the step without
-    x2sum for an exact final report."""
+    x2sum for an exact final report.
+
+    gather != 'fp32' routes the centroid update through the data-axis-
+    sharded finalize (make_sharded_finalize); for the quantized modes the
+    step takes and returns the persistent gather residual:
+    step(x, c, n_valid, x2sum, gerr) -> (new_c, shift, sse, new_gerr)."""
     coarse = assign_spec is not None and assign_spec.coarse
     stats_fn = make_sharded_stats(mesh, kernel, block_rows,
-                                  assign_spec=assign_spec)
+                                  assign_spec=assign_spec, gather=gather)
     stats_shifted = make_sharded_stats(mesh, kernel, block_rows, shifted=True,
-                                       assign_spec=assign_spec)
+                                       assign_spec=assign_spec, gather=gather)
+    strategy = gather_lib.resolve_gather(gather)
+    finalize = (
+        make_sharded_finalize(mesh, spherical=spherical, mode=strategy.mode)
+        if strategy.sharded_finalize else None
+    )
 
     @jax.jit
-    def step(x, c, n_valid, x2sum=None):
+    def step(x, c, n_valid, x2sum=None, gerr=None):
         if coarse:
             # Coarse stats mask padding internally (sentinel champions,
             # zero sse contributions) — no correction term exists.
@@ -617,6 +831,12 @@ def make_sharded_lloyd_step(
         if not coarse:
             n_pad = x.shape[0] - n_valid
             counts, sse = padding_correction(counts, sse, c, n_pad)
+        if finalize is not None:
+            if strategy.quantized:
+                new_c, shift, new_gerr = finalize(sums, counts, c, gerr)
+                return new_c, shift, sse, new_gerr
+            new_c, shift = finalize(sums, counts, c)
+            return new_c, shift, sse
         cf = c.astype(jnp.float32)
         new_c = jnp.where(
             counts[:, None] > 0,
@@ -745,10 +965,17 @@ def kmeans_fit_sharded(
     block_rows: int = 0,
     assign: str = "exact",
     probe=None,
+    gather: str = "fp32",
 ) -> KMeansResult:
     """Lloyd K-Means with points sharded over 'data' and centroids over
     'model' (the K=16,384 regime). init may be a (K, d) array or an init name
     ('kmeans++'/'random'/'first_k'/'kmeans||'), resolved on a host subsample.
+
+    gather: 'fp32' (default — byte-identical to the pre-gather schedules) |
+    'fp32_sharded' (data-axis-sharded finalize, bit-exact, n_data× fewer
+    replicated finalize FLOPs) | 'bf16' | 'int8' (compressed champion +
+    finalize gathers with persistent error feedback riding the fit loop's
+    carry). See parallel/gather.py / make_sharded_finalize.
 
     assign="coarse"/"auto" + probe: sub-linear coarse→refine tile-pruned
     assignment per model shard (ops/subk.py; streamed_kmeans_fit_sharded's
@@ -778,6 +1005,7 @@ def kmeans_fit_sharded(
         raise ValueError(f"N={x.shape[0]} not divisible by data axis {n_data}")
     if k % n_model != 0:
         raise ValueError(f"K={k} not divisible by model axis {n_model}")
+    gstrategy = plan_gather(gather, mesh, k, assign=assign)
     if spherical:
         if isinstance(x, np.ndarray):
             norms = np.linalg.norm(x, axis=-1, keepdims=True)
@@ -859,9 +1087,20 @@ def kmeans_fit_sharded(
             bounds=bounds_report,
         )
     run, step = _lloyd_fit_fns(mesh, kernel, block_rows, spherical,
-                               int(max_iters), float(tol), aspec)
+                               int(max_iters), float(tol), aspec,
+                               gstrategy.mode)
     x2sum = sum_sq(x)  # once per fit; the step then skips the ‖x‖² re-read
-    c, shift_dev, i_dev, hist = run(x, c, x2sum)
+    if gstrategy.quantized:
+        gerr0 = zero_finalize_err(mesh, k, x.shape[1])
+        c, shift_dev, i_dev, hist, _ = run(x, c, x2sum, gerr0)
+        # The final-report step stays the EXACT (fp32-gather) tower, the
+        # bounded path's precedent: the reported SSE measures the returned
+        # centroids with exact champion mins, so rel-inertia comparisons
+        # against fp32 fits are apples-to-apples.
+        _, step = _lloyd_fit_fns(mesh, kernel, block_rows, spherical,
+                                 int(max_iters), float(tol), aspec)
+    else:
+        c, shift_dev, i_dev, hist = run(x, c, x2sum)
     n_iter = int(i_dev)
     shift = float(shift_dev)
     converged = tol >= 0 and shift <= tol
@@ -949,16 +1188,48 @@ def _lloyd_fit_fns_bounded(mesh, spherical, max_iters, tol):
 
 @lru_cache(maxsize=64)
 def _lloyd_fit_fns(mesh, kernel, block_rows, spherical, max_iters, tol,
-                   assign_spec=None):
+                   assign_spec=None, gather="fp32"):
     """Per-configuration jitted (loop, step) pair for kmeans_fit_sharded,
     cached module-wide: a fit call otherwise builds FRESH jit closures and
     re-traces + re-compiles the whole while_loop every invocation —
     measured ~6 s per fit through the remote-compile tunnel even with the
     persistent XLA cache warm (round-5; repeated fits are the sweep
     harness's bread and butter). Keyed by everything the trace closes over
-    (assign_spec is the hashable ops/subk.CoarseSpec)."""
+    (assign_spec is the hashable ops/subk.CoarseSpec).
+
+    Quantized gather modes return run(x, c0, x2sum, gerr0): the
+    finalize's error-feedback residual joins the while_loop carry (the
+    same move the bounded tower makes for its bounds state), so the
+    whole error-fed fit is still ONE dispatch."""
     step = make_sharded_lloyd_step(mesh, kernel, block_rows, spherical,
-                                   assign_spec)
+                                   assign_spec, gather)
+    if gather_lib.resolve_gather(gather).quantized:
+
+        @jax.jit
+        def run(x, c0, x2sum, gerr0):
+            def cond(carry):
+                _, shift, i, _, _ = carry
+                live = i < max_iters
+                if tol >= 0:
+                    live = jnp.logical_and(live, shift > tol)
+                return live
+
+            def body(carry):
+                c, _, i, hist, ge = carry
+                new_c, shift, cost, ge = step(x, c, x.shape[0], x2sum, ge)
+                hist = hist.at[i].set(jnp.stack([cost, shift]))
+                return new_c, shift, i + 1, hist, ge
+
+            carry0 = (
+                c0,
+                jnp.asarray(jnp.inf, jnp.float32),
+                jnp.asarray(0, jnp.int32),
+                jnp.zeros((max_iters, 2), jnp.float32),
+                gerr0,
+            )
+            return jax.lax.while_loop(cond, body, carry0)
+
+        return run, step
 
     @jax.jit
     def run(x, c0, x2sum):
@@ -1655,6 +1926,7 @@ def _sharded_stream_loop(
     make_aux=None,
     assign_counter=None,
     assign_pass_cost=None,
+    report_step=None,
 ):
     """The deferred-sync iteration driver shared by the streamed K-sharded
     fits (Lloyd and fuzzy differ only in their accumulator algebra): resume
@@ -1691,6 +1963,13 @@ def _sharded_stream_loop(
     RETURNED centroids (its cost is the fit's reported SSE/objective —
     parity with streamed_kmeans_fit) and aux is the resident carry after
     the final pass (the bounded fits read their eval tallies off it).
+
+    report_step, when given, replaces step_batch for that final reporting
+    pass only: the quantized-gather fits route it through full-precision
+    champion stats so the REPORTED SSE measures centroid quality, not
+    wire compression (the convention kmeans_fit_sharded's fp32 report
+    step established; per-iteration history rows keep the fit's own
+    quantized cost).
     """
     from tdc_tpu.models import resident as resident_lib
     from tdc_tpu.models.streaming import _run_pass
@@ -1701,12 +1980,15 @@ def _sharded_stream_loop(
     resume_cursor, resume_rows = state.cursor, state.rows_seen
     resume_acc = None if state.acc is None else put_acc(state.acc)
 
-    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0, pass_fill=None):
+    def full_pass(c, n_iter=0, skip=0, acc0=None, rows0=0, pass_fill=None,
+                  step=None):
+        fn = step_batch if step is None else step
+
         def pass_step(acc, batch):
             maybe_beat()  # supervised-gang liveness
             if pass_fill is None:
-                return step_batch(acc, batch, c)
-            return step_batch(acc, batch, c, pass_fill)
+                return fn(acc, batch, c)
+            return fn(acc, batch, c, pass_fill)
 
         return _run_pass(
             batches, prefetch, zero_acc, pass_step,
@@ -1792,7 +2074,7 @@ def _sharded_stream_loop(
         )
         resident_passes += 1
     else:
-        final_acc = full_pass(c)
+        final_acc = full_pass(c, step=report_step)
         if finalize is not None:
             with trace.span("reduce", n_iter=0):
                 final_acc = finalize(final_acc, c)
@@ -1824,6 +2106,7 @@ def streamed_kmeans_fit_sharded(
     ingest=None,
     assign: str = "exact",
     probe=None,
+    gather: str = "fp32",
 ) -> KMeansResult:
     """Exact out-of-core Lloyd under the 2-D (data × model) layout — the
     1B×768, K=16,384 configuration: batches stream host→device, each batch's
@@ -1836,8 +2119,22 @@ def streamed_kmeans_fit_sharded(
     whole pass and issues ONE data-axis reduce per Lloyd iteration — O(1)
     vs O(num_batches) collectives, at the cost of reordered f32 summation
     (tolerance-level, not bitwise, parity) and no mid-pass checkpointing.
-    The fit result's `comms` field reports reduces issued / logical bytes.
-    Quantized encodings are wired for the 1-D streamed fits only.
+    The fit result's `comms` field reports reduces issued / logical bytes,
+    split by mesh axis (data_bytes = stats reduces, model_bytes = champion
+    + finalize gathers).
+
+    gather: "fp32" (default — the pre-PR schedules, byte-identical),
+    "fp32_sharded" (full-precision wire, data-axis-sharded centroid
+    finalize: each device divides its 1/n_data K-slice and the slices
+    cross in one all_gather — bit-exact vs the replicated finalize),
+    "bf16" or "int8" (the sharded-finalize structure with the champion
+    mins and finalize slices compressed per parallel/gather.py; the
+    finalize gather carries a persistent error-feedback residual across
+    passes). Quantized modes refuse checkpointing, hbm/auto residency,
+    bounded assignment, and single-device meshes (plan_gather's loud
+    rules — the EF residual must persist host-side across passes).
+    Model-axis byte accounting covers the streamed path; resident
+    (hbm/auto) iterations book data-axis reduces only.
 
     residency: "stream" (default), "hbm", "spill", or "auto" — under
     "hbm"/"auto" iteration 1 streams AND fills a per-device HBM cache of
@@ -1950,6 +2247,10 @@ def streamed_kmeans_fit_sharded(
         aspec = subk_lib.resolve_assign(assign, k // n_model, probe=probe,
                                         label="streamed_kmeans_fit_sharded")
     strategy = reduce_lib.resolve_reduce(reduce)
+    gstrategy = plan_gather(gather, mesh, k, assign=assign,
+                            ckpt_dir=ckpt_dir,
+                            ckpt_every_batches=ckpt_every_batches or 0,
+                            residency=residency)
     if bounded and strategy.deferred:
         raise ValueError(
             "assign='bounded' is wired for reduce='per_batch' (the bounded "
@@ -2048,7 +2349,7 @@ def streamed_kmeans_fit_sharded(
 
     stats_fn = make_sharded_stats(mesh, kernel, block_rows,
                                   reduce_data=not deferred,
-                                  assign_spec=aspec)
+                                  assign_spec=aspec, gather=gstrategy.mode)
     r_plan, r_builder = _plan_sharded_residency(
         residency, batches, k, d, spec,
         pad_multiple=pad_multiple, kernel=kernel, dtype=dtype,
@@ -2086,18 +2387,59 @@ def streamed_kmeans_fit_sharded(
         if n_data > 1 else (0, 0)
     )
 
-    @jax.jit
-    def update(acc: _ShardedAcc, c):
-        cf = c.astype(jnp.float32)
-        new_c = jnp.where(
-            acc.counts[:, None] > 0,
-            acc.sums / jnp.maximum(acc.counts[:, None], 1.0),
-            cf,
+    def _book_champion(rows_padded: int, gmode: str) -> None:
+        # Model-axis accounting for the batch's champion (min, argmin)
+        # all_gather pair: every row's champion crosses the model axis
+        # once, so the logical bytes cover the full padded batch (data
+        # shards gather DISTINCT rows — unlike the replicated psum, the
+        # per-shard buffers don't collapse into one logical payload).
+        if n_model <= 1:
+            return
+        rows_loc = rows_padded // n_data
+        g, b = gather_lib.champion_gather_cost(rows_padded, n_model, gmode)
+        if block_rows and rows_loc > block_rows:
+            g *= rows_loc // block_rows  # one pair per scanned block
+        counter.add(0, b, axis="model", gathers=g)
+
+    if gstrategy.sharded_finalize:
+        _fin = jax.jit(make_sharded_finalize(mesh, spherical=spherical,
+                                             mode=gstrategy.mode))
+        cost_fin = (
+            gather_lib.finalize_gather_cost(k, d, (n_data,), gstrategy.mode)
+            if n_data > 1 else (0, 0)
         )
-        if spherical:
-            new_c = _normalize(new_c)
-        shift = jnp.max(jnp.linalg.norm(new_c - cf, axis=-1))
-        return new_c, shift
+        if gstrategy.quantized:
+            # ONE persistent error-feedback residual slot per gathered
+            # leaf: update() runs host-side once per pass, so a host cell
+            # carries the residual across passes (the streamed twin of
+            # the while_loop carry in kmeans_fit_sharded).
+            gerr_cell = [zero_finalize_err(mesh, k, d)]
+
+            def update(acc: _ShardedAcc, c):
+                counter.add(0, cost_fin[1], axis="model",
+                            gathers=cost_fin[0])
+                new_c, shift, gerr_cell[0] = _fin(
+                    acc.sums, acc.counts, c, gerr_cell[0]
+                )
+                return new_c, shift
+        else:
+            def update(acc: _ShardedAcc, c):
+                counter.add(0, cost_fin[1], axis="model",
+                            gathers=cost_fin[0])
+                return _fin(acc.sums, acc.counts, c)
+    else:
+        @jax.jit
+        def update(acc: _ShardedAcc, c):
+            cf = c.astype(jnp.float32)
+            new_c = jnp.where(
+                acc.counts[:, None] > 0,
+                acc.sums / jnp.maximum(acc.counts[:, None], 1.0),
+                cf,
+            )
+            if spherical:
+                new_c = _normalize(new_c)
+            shift = jnp.max(jnp.linalg.norm(new_c - cf, axis=-1))
+            return new_c, shift
 
     put_batch = _make_put_batch(mesh, pad_multiple, dtype, spherical)
 
@@ -2124,22 +2466,42 @@ def streamed_kmeans_fit_sharded(
             counter.add(*cost_reduce)
             return _finalize_jit(acc, c, jnp.asarray(n_pad, jnp.float32))
 
-        def step_batch(acc, batch, c, fill=None):
-            # _stage (below) handles raw AND Quarantined batches; rows for
-            # resume accounting come from n_local (stream geometry), which
-            # a quarantine verdict never changes.
-            sb = (batch if isinstance(batch, spill_lib.StagedBatch)
-                  else _stage(batch))
-            xb, n_valid = sb.xb, sb.n_valid
-            if fill is not None:
-                fill.add(xb, n_valid)
-            if aspec.coarse:
-                fault_point("assign.refine")
-                _book_assign(xb.shape[0])
-                return (accumulate(acc, xb, c, jnp.asarray(n_valid)),
-                        sb.n_local)
-            pad_cell[0] += xb.shape[0] - n_valid
-            return accumulate(acc, xb, c), sb.n_local
+        def _make_step(accum, gmode):
+            def step_batch(acc, batch, c, fill=None):
+                # _stage (below) handles raw AND Quarantined batches; rows
+                # for resume accounting come from n_local (stream
+                # geometry), which a quarantine verdict never changes.
+                sb = (batch if isinstance(batch, spill_lib.StagedBatch)
+                      else _stage(batch))
+                xb, n_valid = sb.xb, sb.n_valid
+                if fill is not None:
+                    fill.add(xb, n_valid)
+                _book_champion(xb.shape[0], gmode)
+                if aspec.coarse:
+                    fault_point("assign.refine")
+                    _book_assign(xb.shape[0])
+                    return (accum(acc, xb, c, jnp.asarray(n_valid)),
+                            sb.n_local)
+                pad_cell[0] += xb.shape[0] - n_valid
+                return accum(acc, xb, c), sb.n_local
+            return step_batch
+
+        step_batch = _make_step(accumulate, gstrategy.mode)
+        report_step = None
+        if gstrategy.quantized:
+            # Full-precision champion stats for the final reporting pass:
+            # the reported SSE measures the centroids the quantized fit
+            # produced, not the quantization noise of one more gather
+            # (kmeans_fit_sharded's fp32 report-step convention).
+            report_step = _make_step(
+                make_sharded_deferred_accumulate(
+                    make_sharded_stats(mesh, kernel, block_rows,
+                                       reduce_data=False, assign_spec=aspec,
+                                       gather="fp32"),
+                    _ShardedAcc, coarse=aspec.coarse,
+                ),
+                "fp32",
+            )
 
         def zero_acc() -> _ShardedAcc:
             # Sharding-first zeros: this runs once per pass and the
@@ -2164,30 +2526,52 @@ def streamed_kmeans_fit_sharded(
     else:
         finalize = None
 
-        @jax.jit
-        def accumulate(acc: _ShardedAcc, x, c, n_valid) -> _ShardedAcc:
-            if aspec.coarse:
-                # Padding masked inside the coarse stats — no correction.
-                sums, counts, sse = stats_fn(x, c, n_valid)
-            else:
-                sums, counts, sse = stats_fn(x, c)
-                n_pad = x.shape[0] - n_valid
-                counts, sse = padding_correction(counts, sse, c, n_pad)
-            return _ShardedAcc(
-                acc.sums + sums, acc.counts + counts, acc.sse + sse
-            )
+        def _make_accumulate(sfn):
+            @jax.jit
+            def accumulate(acc: _ShardedAcc, x, c, n_valid) -> _ShardedAcc:
+                if aspec.coarse:
+                    # Padding masked inside the coarse stats — no
+                    # correction.
+                    sums, counts, sse = sfn(x, c, n_valid)
+                else:
+                    sums, counts, sse = sfn(x, c)
+                    n_pad = x.shape[0] - n_valid
+                    counts, sse = padding_correction(counts, sse, c, n_pad)
+                return _ShardedAcc(
+                    acc.sums + sums, acc.counts + counts, acc.sse + sse
+                )
+            return accumulate
 
-        def step_batch(acc, batch, c, fill=None):
-            sb = (batch if isinstance(batch, spill_lib.StagedBatch)
-                  else _stage(batch))
-            xb, n_valid = sb.xb, sb.n_valid
-            if fill is not None:
-                fill.add(xb, n_valid)
-            counter.add(*cost_reduce)
-            if aspec.coarse:
-                fault_point("assign.refine")
-                _book_assign(xb.shape[0])
-            return accumulate(acc, xb, c, n_valid), sb.n_local
+        accumulate = _make_accumulate(stats_fn)
+
+        def _make_step(accum, gmode):
+            def step_batch(acc, batch, c, fill=None):
+                sb = (batch if isinstance(batch, spill_lib.StagedBatch)
+                      else _stage(batch))
+                xb, n_valid = sb.xb, sb.n_valid
+                if fill is not None:
+                    fill.add(xb, n_valid)
+                counter.add(*cost_reduce)
+                _book_champion(xb.shape[0], gmode)
+                if aspec.coarse:
+                    fault_point("assign.refine")
+                    _book_assign(xb.shape[0])
+                return accum(acc, xb, c, n_valid), sb.n_local
+            return step_batch
+
+        step_batch = _make_step(accumulate, gstrategy.mode)
+        report_step = None
+        if gstrategy.quantized:
+            # See the deferred branch: fp32 champion stats for the final
+            # reporting pass only.
+            report_step = _make_step(
+                _make_accumulate(
+                    make_sharded_stats(mesh, kernel, block_rows,
+                                       reduce_data=True, assign_spec=aspec,
+                                       gather="fp32")
+                ),
+                "fp32",
+            )
 
         def zero_acc() -> _ShardedAcc:
             return _ShardedAcc(
@@ -2430,7 +2814,7 @@ def streamed_kmeans_fit_sharded(
             resident_cost=resident_cost, chunk_iters=chunk_iters,
             mesh=mesh, gang=gang, counter=counter,
             make_aux=make_aux, assign_counter=assign_counter,
-            assign_pass_cost=_assign_pass_cost,
+            assign_pass_cost=_assign_pass_cost, report_step=report_step,
         )
     )
     bounds_report = None
@@ -2475,6 +2859,8 @@ def streamed_kmeans_fit_sharded(
             strategy=strategy.label(), reduces=counter.reduces,
             logical_bytes=counter.logical_bytes,
             passes=(n_iter - start_iter) + 1,
+            data_bytes=counter.data_bytes, model_bytes=counter.model_bytes,
+            gathers=counter.gathers,
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
         ingest=guard.report(),
@@ -2512,6 +2898,7 @@ def streamed_fuzzy_fit_sharded(
     reduce="per_batch",
     residency: str = "stream",
     ingest=None,
+    gather: str = "fp32",
 ):
     """Exact out-of-core Fuzzy C-Means under the 2-D (data × model) layout —
     the large-K regime of the reference's fastest algorithm, streamed: each
@@ -2535,6 +2922,11 @@ def streamed_fuzzy_fit_sharded(
     loop (streamed_kmeans_fit_sharded's contract). ingest= is the
     hardened-ingest policy (retry + zero-mass quarantine + bounded-loss
     accounting; streamed_kmeans_fit_sharded's contract).
+    gather="fp32_sharded"/"bf16"/"int8" routes the centroid update
+    through the data-axis-sharded finalize (streamed_kmeans_fit_sharded's
+    contract; fuzzy has no champion gathers — its memberships reduce via
+    the per-point normalizer psum — so only the finalize exchange rides
+    the gather= wire).
     """
     from tdc_tpu.models.fuzzy import FuzzyCMeansResult
     from tdc_tpu.models.streaming import (
@@ -2560,6 +2952,10 @@ def streamed_fuzzy_fit_sharded(
                             model="fuzzy_sharded",
                             label="streamed_fuzzy_fit_sharded")
     strategy = reduce_lib.resolve_reduce(reduce)
+    gstrategy = plan_gather(gather, mesh, k,
+                            ckpt_dir=ckpt_dir,
+                            ckpt_every_batches=ckpt_every_batches or 0,
+                            residency=residency)
     deferred, _ = _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
                                allow_quantize=False)
     gang = spec.gang
@@ -2635,11 +3031,36 @@ def streamed_fuzzy_fit_sharded(
         if n_data > 1 else (0, 0)
     )
 
-    @jax.jit
-    def update(acc: _ShardedFuzzyAcc, c):
-        new_c = acc.wsums / jnp.maximum(acc.weights[:, None], 1e-12)
-        shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
-        return new_c, shift
+    if gstrategy.sharded_finalize:
+        _fin = jax.jit(make_sharded_finalize(mesh, mode=gstrategy.mode,
+                                             fuzzy=True))
+        cost_fin = (
+            gather_lib.finalize_gather_cost(k, d, (n_data,), gstrategy.mode)
+            if n_data > 1 else (0, 0)
+        )
+        if gstrategy.quantized:
+            # Host-cell error-feedback residual, one slot per gathered
+            # leaf (see streamed_kmeans_fit_sharded).
+            gerr_cell = [zero_finalize_err(mesh, k, d)]
+
+            def update(acc: _ShardedFuzzyAcc, c):
+                counter.add(0, cost_fin[1], axis="model",
+                            gathers=cost_fin[0])
+                new_c, shift, gerr_cell[0] = _fin(
+                    acc.wsums, acc.weights, c, gerr_cell[0]
+                )
+                return new_c, shift
+        else:
+            def update(acc: _ShardedFuzzyAcc, c):
+                counter.add(0, cost_fin[1], axis="model",
+                            gathers=cost_fin[0])
+                return _fin(acc.wsums, acc.weights, c)
+    else:
+        @jax.jit
+        def update(acc: _ShardedFuzzyAcc, c):
+            new_c = acc.wsums / jnp.maximum(acc.weights[:, None], 1e-12)
+            shift = jnp.max(jnp.linalg.norm(new_c - c, axis=-1))
+            return new_c, shift
 
     put_batch = _make_put_batch(mesh, pad_multiple, dtype)
 
@@ -2856,6 +3277,8 @@ def streamed_fuzzy_fit_sharded(
             strategy=strategy.label(), reduces=counter.reduces,
             logical_bytes=counter.logical_bytes,
             passes=(n_iter - start_iter) + 1,
+            data_bytes=counter.data_bytes, model_bytes=counter.model_bytes,
+            gathers=counter.gathers,
         ),
         h2d=None if h2d is None else h2d.report(r_plan.spill_slots),
         ingest=guard.report(),

@@ -595,6 +595,8 @@ class ServeApp:
              lambda: _comms()["reduces"]),
             ("tdc_comms_stats_logical_bytes_total",
              lambda: _comms()["logical_bytes"]),
+            ("tdc_comms_stats_gathers_total",
+             lambda: _comms()["gathers"]),
             ("tdc_h2d_bytes_total", lambda: _h2d()["h2d_bytes"]),
             ("tdc_h2d_batches_total", lambda: _h2d()["batches"]),
             ("tdc_h2d_copy_stall_seconds_total",
@@ -626,6 +628,15 @@ class ServeApp:
         for name, fn in scalars:
             reg.callback(name, fn)
 
+        # Per-axis byte split of the comms counters (PR 17):
+        # logical_bytes stays the cross-axis total (the pre-PR series is
+        # unbroken); the axis label separates data-axis stats reduces
+        # from model-axis champion/finalize gathers.
+        reg.callback(
+            "tdc_comms_stats_axis_bytes_total",
+            lambda: [({"axis": "data"}, _comms()["data_bytes"]),
+                     ({"axis": "model"}, _comms()["model_bytes"])],
+        )
         # Per-model generation/staleness: generation is the registry's
         # monotonic reload counter (bumps on every swap, incl. online
         # publishes and rollbacks); age is seconds since that generation

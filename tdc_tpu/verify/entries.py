@@ -454,6 +454,68 @@ def _build_sharded_stats(coarse: bool, reduce_data: bool):
     return build
 
 
+def _build_sharded_gather_stats(mode: str, coarse: bool = False):
+    """The compressed-gather stats towers (parallel/gather.py): the
+    champion (min, argmin) all_gather pair with the mins leg encoded
+    bf16 / packed-int8 — the packed payload keeps the collective count
+    and order IDENTICAL to fp32 (the property same_schedule_as pins)."""
+    def build():
+        import jax
+
+        from tdc_tpu.parallel.sharded_k import make_sharded_stats
+        from tdc_tpu.ops import subk as subk_lib
+
+        aspec = (subk_lib.resolve_assign("coarse", _K2 // 4, probe=1,
+                                         label="tdcverify")
+                 if coarse else None)
+        fn = make_sharded_stats(_mesh2d(), assign_spec=aspec, gather=mode)
+        jit_fn = jax.jit(fn)
+
+        def fresh(i):
+            return _sharded_args(i, with_nv=coarse)
+
+        return Built(fn, jit_fn, fresh)
+
+    return build
+
+
+def _build_sharded_finalize(mode: str):
+    """The data-axis-sharded centroid finalize: one slice all_gather
+    (data) + one 4-byte shift pmax (data, model); the quantized modes
+    add the error-feedback residual operand without changing the
+    collective count/order."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tdc_tpu.parallel import sharded_k as sk
+
+        mesh = _mesh2d()
+        fn = sk.make_sharded_finalize(mesh, mode=mode)
+        jit_fn = jax.jit(fn)
+        quantized = mode in ("bf16", "int8")
+
+        def fresh(i):
+            sums = jnp.asarray(_centroids(i, _K2, _D2))
+            sums = jax.device_put(
+                sums, NamedSharding(mesh, P(sk.MODEL_AXIS, None)))
+            counts = jax.device_put(
+                jnp.ones((_K2,), jnp.float32) * (i + 1),
+                NamedSharding(mesh, P(sk.MODEL_AXIS)))
+            c = jax.device_put(
+                jnp.asarray(_centroids(i + 7, _K2, _D2)),
+                NamedSharding(mesh, P(sk.MODEL_AXIS, None)))
+            if quantized:
+                return (sums, counts, c,
+                        sk.zero_finalize_err(mesh, _K2, _D2))
+            return (sums, counts, c)
+
+        return Built(fn, jit_fn, fresh)
+
+    return build
+
+
 def _build_sharded_deferred_reduce():
     def build():
         import jax
@@ -775,6 +837,40 @@ def entries() -> list[VerifyEntry]:
             same_schedule_as="sharded_k.kmeans.per_batch.exact",
             notes="zero-loss bounded tower: per-shard bound maintenance "
                   "adds NO collectives — byte-identical schedule to exact",
+        ),
+        VerifyEntry(
+            id="sharded_k.kmeans.gather_bf16.exact",
+            build=_build_sharded_gather_stats("bf16"),
+            same_schedule_as="sharded_k.kmeans.per_batch.exact",
+            notes="bf16 champion-mins gather: dtype narrows, collective "
+                  "count/order byte-identical to fp32",
+        ),
+        VerifyEntry(
+            id="sharded_k.kmeans.gather_int8.exact",
+            build=_build_sharded_gather_stats("int8"),
+            same_schedule_as="sharded_k.kmeans.per_batch.exact",
+            notes="packed int8 codes + bitcast block scales travel as ONE "
+                  "all_gather — schedule identical to fp32",
+        ),
+        VerifyEntry(
+            id="sharded_k.kmeans.gather_int8.coarse",
+            build=_build_sharded_gather_stats("int8", coarse=True),
+            same_schedule_as="sharded_k.kmeans.gather_int8.exact",
+            notes="assignment-mode independence holds under quantized "
+                  "gathers too (pad rows decode to exactly 0.0)",
+        ),
+        VerifyEntry(
+            id="sharded_k.finalize.fp32",
+            build=_build_sharded_finalize("fp32_sharded"),
+            notes="data-axis-sharded centroid finalize: 1 slice "
+                  "all_gather (data) + 1 shift pmax (data, model)",
+        ),
+        VerifyEntry(
+            id="sharded_k.finalize.int8",
+            build=_build_sharded_finalize("int8"),
+            same_schedule_as="sharded_k.finalize.fp32",
+            notes="quantized finalize adds the EF residual operand, not "
+                  "collectives — schedule identical to fp32_sharded",
         ),
         VerifyEntry(
             id="sharded_k.kmeans.per_pass.acc",

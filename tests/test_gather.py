@@ -1,0 +1,401 @@
+"""Compressed model/data-axis gather tests (PR 17): the parallel/gather
+codec + error-feedback algebra, the data-axis-sharded finalize, the
+K-sharded drivers' gather= wiring, per-axis comms accounting, the
+plan_gather/CLI guard rails, and the resize fold of the finalize
+residual."""
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+from tdc_tpu.parallel.compat import shard_map
+
+from tdc_tpu.parallel import gather as gather_lib
+from tdc_tpu.parallel import reduce as reduce_lib
+from tdc_tpu.parallel.mesh import DATA_AXIS, make_hierarchical_mesh
+from tdc_tpu.parallel.sharded_k import (
+    kmeans_fit_sharded,
+    make_mesh_2d,
+    make_sharded_finalize,
+    plan_gather,
+    streamed_kmeans_fit_sharded,
+    zero_finalize_err,
+)
+
+BLOCK = gather_lib.BLOCK
+
+
+# ---------------------------------------------------------------------------
+# Codec unit tests (no mesh).
+# ---------------------------------------------------------------------------
+
+
+def test_int8_codec_roundtrip_error_bound():
+    """decode(encode(y)) is within half a quantization step of y, with the
+    symmetric per-row scale max|y|/127 the module documents."""
+    rng = np.random.default_rng(5)
+    y = jnp.asarray(rng.normal(0, 7.0, size=(6, BLOCK)).astype(np.float32))
+    codes, scales = gather_lib._encode_int8(y)
+    dec = gather_lib._decode_int8(codes, scales)
+    np.testing.assert_allclose(
+        np.asarray(scales), np.max(np.abs(np.asarray(y)), axis=1) / 127.0,
+        rtol=1e-6,
+    )
+    err = np.abs(np.asarray(dec) - np.asarray(y))
+    assert (err <= np.asarray(scales)[:, None] * 0.5 + 1e-7).all()
+
+
+def test_int8_codec_zero_rows_decode_exact():
+    """0.0 → code 0 → exactly 0.0 (the padding/coarse-assignment exactness
+    invariant, and — via delta coding — the empty-cluster invariant)."""
+    y = jnp.zeros((3, BLOCK), jnp.float32)
+    codes, scales = gather_lib._encode_int8(y)
+    assert (np.asarray(codes) == 0).all()
+    assert (np.asarray(scales) > 0).all()  # positive even on zero blocks
+    np.testing.assert_array_equal(
+        np.asarray(gather_lib._decode_int8(codes, scales)), np.asarray(y)
+    )
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(6)
+    y = jnp.asarray(rng.normal(0, 3.0, size=(4, BLOCK)).astype(np.float32))
+    codes, scales = gather_lib._encode_int8(y)
+    packed = gather_lib._pack(codes.reshape(-1), scales)
+    assert packed.dtype == jnp.int8
+    c2, s2 = gather_lib._unpack(packed[None], 4 * BLOCK, 4)
+    np.testing.assert_array_equal(np.asarray(c2[0]),
+                                  np.asarray(codes.reshape(-1)))
+    np.testing.assert_array_equal(np.asarray(s2[0]), np.asarray(scales))
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_compressed_gather_ef_identity(mode):
+    """Error-feedback algebra on a 2-shard gather: every shard receives
+    decode(encode(y_i + err_i)), and dec_i + new_err_i == y_i + err_i —
+    the residual carries exactly what the wire dropped."""
+    from tdc_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2)
+    rng = np.random.default_rng(7)
+    n = BLOCK + 17  # exercise the zero-pad tail
+    y = rng.normal(0, 4.0, size=(2, n)).astype(np.float32)
+    err = rng.normal(0, 0.05, size=(2, n)).astype(np.float32)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+             out_specs=(P(None, None), P(DATA_AXIS, None)),
+             check_vma=False)
+    def run(y_loc, e_loc):
+        g, ne = gather_lib.compressed_all_gather(
+            y_loc[0], DATA_AXIS, mode, err=e_loc[0]
+        )
+        return g, ne[None]
+
+    g, new_err = jax.jit(run)(jnp.asarray(y), jnp.asarray(err))
+    g, new_err = np.asarray(g), np.asarray(new_err)
+    src = y + err
+    np.testing.assert_allclose(g + new_err, src, rtol=0, atol=1e-5)
+    # Decode error bounded by the codec's step at the source's scale.
+    step = np.abs(src).max() / (127.0 if mode == "int8" else 256.0)
+    assert np.abs(g - src).max() <= step
+    # err=None (per-batch leaves) still gathers, returns no residual.
+    @partial(shard_map, mesh=mesh, in_specs=(P(DATA_AXIS, None),),
+             out_specs=P(None, None), check_vma=False)
+    def run_no_ef(y_loc):
+        g2, ne2 = gather_lib.compressed_all_gather(y_loc[0], DATA_AXIS, mode)
+        assert ne2 is None
+        return g2
+
+    g2 = np.asarray(jax.jit(run_no_ef)(jnp.asarray(y)))
+    step2 = np.abs(y).max() / (127.0 if mode == "int8" else 256.0)
+    assert np.abs(g2 - y).max() <= step2
+
+
+def test_staged_gather_ordering_and_fp32_exactness():
+    """staged_all_gather over the hierarchical (dcn, ici) axes: ICI stage
+    first, DCN stage last (the compressed one), result in dcn-major
+    order. fp32 is exact; int8 decodes within one codec step."""
+    mesh = make_hierarchical_mesh(n_hosts=2, n_devices=8)
+    rng = np.random.default_rng(8)
+    y = rng.normal(0, 2.0, size=(8, 5)).astype(np.float32)
+
+    def run(mode):
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(("dcn", "ici"), None),),
+                 out_specs=P(None, None), check_vma=False)
+        def f(y_loc):
+            g, _ = gather_lib.staged_all_gather(
+                y_loc[0], ("dcn", "ici"), mode
+            )
+            return g
+        return np.asarray(jax.jit(f)(jnp.asarray(y)))
+
+    np.testing.assert_array_equal(run("fp32"), y)
+    assert np.abs(run("int8") - y).max() <= np.abs(y).max() / 127.0
+
+
+# ---------------------------------------------------------------------------
+# Cost functions.
+# ---------------------------------------------------------------------------
+
+
+def test_gather_cost_functions():
+    n = 1000
+    pad = -(-n // BLOCK) * BLOCK
+    assert gather_lib.leaf_gather_cost(n, 4, "fp32") == 4 * 4 * n
+    assert gather_lib.leaf_gather_cost(n, 4, "fp32_sharded") == 4 * 4 * n
+    assert gather_lib.leaf_gather_cost(n, 4, "bf16") == 4 * 2 * n
+    assert gather_lib.leaf_gather_cost(n, 4, "int8") == 4 * (
+        pad + 4 * (pad // BLOCK)
+    )
+    # Staged: per-stage list, inner stages fp32, only the last compressed.
+    stages = gather_lib.staged_gather_cost(n, (2, 4), "int8")
+    assert stages == [
+        gather_lib.leaf_gather_cost(n, 4, "fp32"),
+        gather_lib.leaf_gather_cost(4 * n, 2, "int8"),
+    ]
+    # Champion: always 2 collectives (mins + args); args never compress.
+    g_f, b_f = gather_lib.champion_gather_cost(n, 4, "fp32")
+    g_q, b_q = gather_lib.champion_gather_cost(n, 4, "int8")
+    assert g_f == g_q == 2
+    args_bytes = gather_lib.leaf_gather_cost(n, 4, "fp32")
+    assert b_f == 2 * args_bytes
+    assert b_q == gather_lib.leaf_gather_cost(n, 4, "int8") + args_bytes
+    # Finalize: slice gather stages + one 4-byte shift pmax.
+    k, d = 256, 16
+    c, b = gather_lib.finalize_gather_cost(k, d, (2,), "fp32_sharded")
+    assert (c, b) == (2, gather_lib.leaf_gather_cost(k * d // 2, 2,
+                                                     "fp32") + 4)
+    assert (gather_lib.finalize_gather_cost(k, d, (2,), "int8")[1]
+            < gather_lib.finalize_gather_cost(k, d, (2,), "bf16")[1]
+            < b)
+
+
+def test_gather_strategy_validation():
+    with pytest.raises(ValueError, match="not in"):
+        gather_lib.GatherStrategy(mode="fp16")
+    s = gather_lib.resolve_gather("int8")
+    assert s.quantized and s.sharded_finalize and s.label() == "int8"
+    f = gather_lib.resolve_gather("fp32")
+    assert not f.quantized and not f.sharded_finalize
+    fs = gather_lib.resolve_gather("fp32_sharded")
+    assert not fs.quantized and fs.sharded_finalize
+    assert gather_lib.resolve_gather(s) is s
+
+
+# ---------------------------------------------------------------------------
+# Sharded finalize.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def blob_data():
+    rng = np.random.default_rng(17)
+    k, d, n = 32, 12, 4096
+    centers = rng.normal(0, 10.0, size=(k, d)).astype(np.float32)
+    x = np.repeat(centers, n // k, axis=0) + rng.normal(
+        0, 0.5, size=(n, d)
+    ).astype(np.float32)
+    rng.shuffle(x)
+    return x, centers
+
+
+def test_fp32_sharded_finalize_bitexact_vs_replicated(blob_data):
+    """gather='fp32_sharded' moves exact f32 slices: identical centroids
+    and SSE to the fully replicated finalize (the FLOP ablation is
+    numerically free)."""
+    x, centers = blob_data
+    mesh = make_mesh_2d(2, 4)
+    base = kmeans_fit_sharded(x, 32, mesh, init=centers, max_iters=5,
+                              tol=-1.0)
+    shd = kmeans_fit_sharded(x, 32, mesh, init=centers, max_iters=5,
+                             tol=-1.0, gather="fp32_sharded")
+    np.testing.assert_array_equal(np.asarray(shd.centroids),
+                                  np.asarray(base.centroids))
+    assert float(shd.sse) == float(base.sse)
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_quantized_gather_in_memory_close(blob_data, mode):
+    x, centers = blob_data
+    mesh = make_mesh_2d(2, 4)
+    base = kmeans_fit_sharded(x, 32, mesh, init=centers, max_iters=5,
+                              tol=-1.0)
+    q = kmeans_fit_sharded(x, 32, mesh, init=centers, max_iters=5,
+                           tol=-1.0, gather=mode)
+    rel = abs(float(q.sse) - float(base.sse)) / float(base.sse)
+    assert rel <= 1e-2  # delta-coded EF: observed ~1e-6
+
+
+def test_quantized_finalize_empty_clusters_exact():
+    """Delta coding: a cluster with zero mass keeps its centroid BITWISE
+    (shift 0 encodes to code 0, decodes to exactly 0), and the residual
+    stays zero — the quantized finalize cannot drift parked centroids."""
+    mesh = make_mesh_2d(2, 4)
+    k, d = 16, 8
+    rng = np.random.default_rng(9)
+    c = jnp.asarray(rng.normal(0, 10.0, size=(k, d)).astype(np.float32))
+    fin = jax.jit(make_sharded_finalize(mesh, mode="int8"))
+    err0 = zero_finalize_err(mesh, k, d)
+    new_c, shift, new_err = fin(
+        jnp.zeros((k, d), jnp.float32), jnp.zeros((k,), jnp.float32), c,
+        err0,
+    )
+    np.testing.assert_array_equal(np.asarray(new_c), np.asarray(c))
+    assert float(shift) == 0.0
+    np.testing.assert_array_equal(np.asarray(new_err),
+                                  np.zeros((2, k, d), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Streamed driver: modes, per-axis accounting, fp32 report pass.
+# ---------------------------------------------------------------------------
+
+
+def _stream_fit(x, k, gather, **kw):
+    mesh = make_mesh_2d(2, 4)
+    batches = lambda: (x[i:i + 512] for i in range(0, len(x), 512))
+    return streamed_kmeans_fit_sharded(
+        batches, k=k, d=x.shape[1], mesh=mesh, init=kw.pop("init"),
+        max_iters=3, tol=-1.0, gather=gather, **kw,
+    )
+
+
+def test_streamed_gather_modes_and_comms_split(blob_data):
+    x, centers = blob_data
+    runs = {}
+    for mode in gather_lib.GATHER_MODES:
+        reduce_lib.GLOBAL_COMMS.reset()
+        r = _stream_fit(x, 32, mode, init=centers)
+        runs[mode] = (r, reduce_lib.GLOBAL_COMMS.snapshot())
+    base, bsnap = runs["fp32"]
+    # fp32_sharded is bit-exact; quantized modes within the PR-2 band.
+    assert float(runs["fp32_sharded"][0].sse) == float(base.sse)
+    for mode in ("bf16", "int8"):
+        rel = abs(float(runs[mode][0].sse) - float(base.sse)) / float(base.sse)
+        assert rel <= 1e-2, mode
+    # Per-axis split: data-axis traffic is gather-mode-independent; the
+    # model axis is where compression bites, monotonically.
+    mb = {m: s["model_bytes"] for m, (_, s) in runs.items()}
+    assert all(s["data_bytes"] == bsnap["data_bytes"]
+               for _, s in runs.values())
+    assert mb["int8"] < mb["bf16"] < mb["fp32_sharded"]
+    assert mb["fp32"] < mb["fp32_sharded"]  # fp32 books no finalize gather
+    # logical_bytes stays the cross-axis total; gathers are booked.
+    for _, s in runs.values():
+        assert s["logical_bytes"] == s["data_bytes"] + s["model_bytes"]
+        assert s["gathers"] > 0
+
+
+def test_streamed_quantized_reports_fp32_sse(blob_data):
+    """The reported SSE of a quantized-gather fit measures the returned
+    centroids at full precision (the report_step pass), not the
+    quantization noise of one more champion gather."""
+    x, centers = blob_data
+    r = _stream_fit(x, 32, "int8", init=centers)
+    c = np.asarray(r.centroids)
+    d2 = ((x[:, None, :] - c[None]) ** 2).sum(-1).min(1)
+    np.testing.assert_allclose(float(r.sse), d2.sum(), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Guard rails.
+# ---------------------------------------------------------------------------
+
+
+def test_plan_gather_guard_rails(tmp_path):
+    mesh = make_mesh_2d(2, 4)
+    ok = plan_gather("int8", mesh, 32)
+    assert ok.mode == "int8"
+    with pytest.raises(ValueError, match="divisible"):
+        plan_gather("fp32_sharded", mesh, 28)  # K/Pm=7 not % n_data=2
+    with pytest.raises(ValueError, match="bounded"):
+        plan_gather("fp32_sharded", mesh, 32, assign="bounded")
+    with pytest.raises(ValueError, match="ckpt_dir"):
+        plan_gather("int8", mesh, 32, ckpt_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="mid-pass"):
+        plan_gather("bf16", mesh, 32, ckpt_every_batches=2)
+    with pytest.raises(ValueError, match="residency"):
+        plan_gather("int8", mesh, 32, residency="hbm")
+    with pytest.raises(ValueError, match="multi-device"):
+        plan_gather("int8", make_mesh_2d(1, 1), 32)
+    # Non-quantized sharded finalize has none of the EF restrictions.
+    s = plan_gather("fp32_sharded", mesh, 32, residency="hbm")
+    assert s.sharded_finalize and not s.quantized
+
+
+def test_cli_gather_guards():
+    from tdc_tpu.cli.main import main as cli_main
+
+    base = "--n_obs=256 --n_dim=4 --K=8 --n_GPUs=8"
+    with pytest.raises(SystemExit):  # gather needs the K-sharded tower
+        cli_main(f"{base} --gather=int8".split())
+    with pytest.raises(SystemExit):  # GMM keeps the replicated M-step
+        cli_main(
+            f"{base} --shard_k=4 --gather=fp32_sharded "
+            "--method_name=gaussianMixture".split()
+        )
+    with pytest.raises(SystemExit):  # EF cannot ride checkpoints
+        cli_main(
+            f"{base} --shard_k=4 --gather=int8 --streamed "
+            "--num_batches=2 --ckpt_dir=/tmp/nope".split()
+        )
+    with pytest.raises(SystemExit):  # bounded assignment is bit-exact
+        cli_main(
+            f"{base} --shard_k=4 --gather=bf16 --streamed "
+            "--num_batches=2 --assign=bounded --bounds=elkan "
+            "--residency=hbm".split()
+        )
+
+
+def test_cli_gather_end_to_end(tmp_path):
+    import csv
+
+    from tdc_tpu.cli.main import main as cli_main
+
+    log = str(tmp_path / "log.csv")
+    rc = cli_main(
+        f"--n_obs=2048 --n_dim=8 --K=16 --n_max_iters=3 --seed=3 "
+        f"--streamed --num_batches=4 --shard_k=4 --gather=int8 "
+        f"--log_file={log} --n_GPUs=8".split()
+    )
+    assert rc == 0
+    row = list(csv.DictReader(open(log)))[0]
+    assert row["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Resize: the finalize residual folds across mesh shape changes.
+# ---------------------------------------------------------------------------
+
+
+def test_redistribute_gather_err_fold():
+    """(2, K, d) residual slots → (4, K, d): Σ_slots preserved exactly and
+    every new slot holds exactly its own slice band under the new
+    (n_data, n_model) split — re-injection stays row-aligned."""
+    from tdc_tpu.parallel.reshard import redistribute_gather_err
+
+    rng = np.random.default_rng(11)
+    k, d = 16, 3
+    # Old mesh (2 data x 2 model): slot i carries rows [i*4, i*4+4) of
+    # each model column (k//n_model = 8 rows per column, 4 per slice).
+    err = np.zeros((2, k, d), np.float32)
+    for j in range(2):  # model column
+        for i in range(2):  # data slot
+            lo = j * 8 + i * 4
+            err[i, lo:lo + 4] = rng.normal(size=(4, d))
+    total = err.sum(axis=0)
+    out = redistribute_gather_err(err, n_data=4, n_model=1)
+    assert out.shape == (4, k, d)
+    np.testing.assert_allclose(out.sum(axis=0), total, rtol=0, atol=0)
+    rows = k // 4
+    for i in range(4):
+        band = np.zeros_like(total)
+        band[i * rows:(i + 1) * rows] = total[i * rows:(i + 1) * rows]
+        np.testing.assert_array_equal(out[i], band)
+    with pytest.raises(ValueError, match="divide"):
+        redistribute_gather_err(err, n_data=3, n_model=2)

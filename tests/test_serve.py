@@ -471,6 +471,11 @@ class TestHTTP:
             # the same scrape.
             assert "tdc_comms_stats_reduces_total" in metrics
             assert "tdc_comms_stats_logical_bytes_total" in metrics
+            # PR 17: per-axis byte split + gather count ride the same
+            # scrape (axis="data"|"model" labels).
+            assert "tdc_comms_stats_gathers_total" in metrics
+            assert 'tdc_comms_stats_axis_bytes_total{axis="data"}' in metrics
+            assert 'tdc_comms_stats_axis_bytes_total{axis="model"}' in metrics
         finally:
             app.stop()
 
