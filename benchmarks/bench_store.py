@@ -1,0 +1,234 @@
+"""Object-store data-plane benchmark: manifest streams (data/store.py)
+vs the in-memory streamed baseline, over file:// and live flaky HTTP.
+
+What this measures, per config (bench_spill.py's iteration-differencing
+methodology — `(wall(I2) - wall(I1)) / (I2 - I1)` with tol=-1 pinning
+iteration counts, so compile/init/reporting cancel):
+
+- **mem_iter_s / file_iter_s / http_iter_s** — the marginal cost of one
+  more Lloyd iteration when every batch is (a) an in-memory slice, (b) a
+  pread-ranged read through `FileStore` + CRC32 verify, (c) a real
+  HTTP Range request against a localhost server (stdlib http.client,
+  keep-alive, one socket per producer thread). The deltas are the data
+  plane's whole toll: syscall/socket + copy + CRC per batch.
+- **http_flaky_iter_s / flaky_retries** — the same HTTP fit through a
+  deterministic ~33% 5xx storm (`testing/flaky_http.py`, Retry-After
+  honored): what a production-grade bad day costs, and proof the retry
+  ladder absorbed it (`retries > 0`, result still bit-exact).
+- **reads_per_pass / mb_per_pass** — `StoreCounter` truth (the
+  `tdc_store_*` `/metrics` families): one ranged read per batch, bytes
+  = the batch slice, no amplification.
+- **spill_cross_pass** — the pass-persistent spill ring over the
+  manifest stream: batches staged ACROSS iteration boundaries (> 0 is
+  the PR-18 acceptance evidence) while staying bit-exact.
+- **bitexact_*** — every store path vs the in-memory baseline via
+  `np.array_equal`: the data plane changes WHERE bytes come from, never
+  what the accumulate ops see.
+
+The smoke gates correctness and robustness, not speed — on a loaded
+1-core CI box wall-clock ratios are noise, but bit-exactness, absorbed
+retries, zero quarantines, and cross-pass staging are invariant:
+
+  STORE-SMOKE PASS requires file://, HTTP, and flaky-HTTP fits bit-exact
+  with the in-memory baseline; flaky retries > 0 with 0 quarantined;
+  spill-over-manifest bit-exact with cross_pass > 0.
+
+Run:
+  JAX_PLATFORMS=cpu python benchmarks/bench_store.py        # sweep -> CSV
+  python benchmarks/bench_store.py --smoke                  # CI gate
+
+Writes benchmarks/store_cpu.csv; one JSON line per config on stdout.
+"""
+
+import csv
+import json
+import os
+import sys
+import tempfile
+import time
+
+# Runnable as a plain script from any cwd (the serve_latency.py pattern).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tdc_tpu.data.device_cache import SizedBatches  # noqa: E402
+from tdc_tpu.data.ingest import IngestPolicy  # noqa: E402
+from tdc_tpu.data.manifest import build_manifest  # noqa: E402
+from tdc_tpu.data.store import StoreCounter, open_manifest_stream  # noqa: E402
+from tdc_tpu.models.streaming import streamed_kmeans_fit  # noqa: E402
+from tdc_tpu.testing.flaky_http import FlakyHTTPServer  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "store_cpu.csv")
+FIELDS = [
+    "config", "K", "d", "n", "batch_rows", "n_shards", "i1", "i2",
+    "mem_iter_s", "file_iter_s", "http_iter_s", "http_flaky_iter_s",
+    "file_overhead", "http_overhead", "flaky_retries", "flaky_quarantined",
+    "reads_per_pass", "mb_per_pass", "spill_cross_pass",
+    "bitexact_file", "bitexact_http", "bitexact_flaky", "bitexact_spill",
+]
+
+
+def _blobs(n, d, k, seed=20250418):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-8.0, 8.0, size=(k, d)).astype(np.float32)
+    x = np.repeat(centers, n // k, axis=0) + rng.normal(
+        0, 0.4, size=(n // k * k, d)
+    ).astype(np.float32)
+    rng.shuffle(x)
+    return x, centers
+
+
+def _mem_stream(x, batch_rows):
+    def read(i):
+        return x[i * batch_rows: (i + 1) * batch_rows]
+
+    def gen():
+        for i in range(-(-len(x) // batch_rows)):
+            yield read(i)
+
+    return SizedBatches(gen, len(x), batch_rows, itemsize=4,
+                        read_batch=read)
+
+
+def _fit(make_stream, k, d, init, iters, residency="stream", ingest=None):
+    batches = make_stream()
+    t0 = time.perf_counter()
+    res = streamed_kmeans_fit(
+        batches, k, d, init=init, max_iters=iters, tol=-1.0,
+        residency=residency,
+        **({} if ingest is None else {"ingest": ingest}),
+    )
+    jax.block_until_ready(res.centroids)
+    return time.perf_counter() - t0, res
+
+
+def _marginal(make_stream, k, d, init, i1, i2, repeats, **kw):
+    samples, r2 = [], None
+    for _ in range(repeats):
+        w1, _ = _fit(make_stream, k, d, init, i1, **kw)
+        w2, r2 = _fit(make_stream, k, d, init, i2, **kw)
+        samples.append((w2 - w1) / (i2 - i1))
+    return max(float(np.median(samples)), 1e-6), r2
+
+
+def run_one(config, k, d, n, batch_rows, n_shards, i1, i2, repeats=3,
+            fail_every=3):
+    x, centers = _blobs(n, d, k)
+    init = centers
+    tmp = tempfile.mkdtemp(prefix="tdc_bench_store_")
+    manifest_path = build_manifest(x, batch_rows, tmp, n_shards=n_shards)
+
+    def mem():
+        return _mem_stream(x, batch_rows)
+
+    counter = StoreCounter()
+
+    def file_stream():
+        return open_manifest_stream(manifest_path, counter=counter)
+
+    # Warm the compile caches once (identical geometry on every path).
+    _fit(mem, k, d, init, i1)
+    _fit(file_stream, k, d, init, i1)
+
+    mem_iter, rm = _marginal(mem, k, d, init, i1, i2, repeats)
+    file_iter, rf = _marginal(file_stream, k, d, init, i1, i2, repeats)
+
+    with FlakyHTTPServer(tmp) as url:
+        def http_stream():
+            return open_manifest_stream(f"{url}/manifest.json", timeout=10.0)
+
+        http_iter, rh = _marginal(http_stream, k, d, init, i1, i2, repeats)
+
+    storm = FlakyHTTPServer(tmp, fail_every=fail_every, fail_status=503,
+                            retry_after=0.001)
+    with storm as url:
+        def flaky_stream():
+            return open_manifest_stream(f"{url}/manifest.json", timeout=10.0)
+
+        flaky_iter, rfl = _marginal(
+            flaky_stream, k, d, init, i1, i2, 1,
+            ingest=IngestPolicy(io_retries=6, io_backoff=0.001),
+        )
+
+    _, rsp = _fit(file_stream, k, d, init, i2, residency="spill")
+
+    n_batches = -(-n // batch_rows)
+    c0 = np.asarray(rm.centroids)
+    row = {
+        "config": config, "K": k, "d": d, "n": n,
+        "batch_rows": batch_rows, "n_shards": n_shards, "i1": i1, "i2": i2,
+        "mem_iter_s": round(mem_iter, 6),
+        "file_iter_s": round(file_iter, 6),
+        "http_iter_s": round(http_iter, 6),
+        "http_flaky_iter_s": round(flaky_iter, 6),
+        "file_overhead": round(file_iter / mem_iter, 3),
+        "http_overhead": round(http_iter / mem_iter, 3),
+        "flaky_retries": rfl.ingest.retries if rfl.ingest else 0,
+        "flaky_quarantined": (rfl.ingest.quarantined_batches
+                              if rfl.ingest else 0),
+        "reads_per_pass": n_batches,
+        "mb_per_pass": round(x.nbytes / 2**20, 2),
+        "spill_cross_pass": rsp.h2d.cross_pass if rsp.h2d else 0,
+        "bitexact_file": bool(np.array_equal(c0, np.asarray(rf.centroids))),
+        "bitexact_http": bool(np.array_equal(c0, np.asarray(rh.centroids))),
+        "bitexact_flaky": bool(np.array_equal(c0, np.asarray(rfl.centroids))),
+        "bitexact_spill": bool(np.array_equal(c0, np.asarray(rsp.centroids))),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main(argv):
+    smoke = "--smoke" in argv
+
+    if smoke:
+        # One config, correctness-gated (module docstring): 8 batches x
+        # 2 shards covers multi-shard locate arithmetic, the storm fires
+        # on every 3rd request, the spill fit must stage across a pass
+        # boundary. ~30 s on the CI box.
+        row = run_one("smoke", k=16, d=32, n=1 << 16, batch_rows=1 << 13,
+                      n_shards=2, i1=2, i2=4, repeats=1)
+        ok = (
+            row["bitexact_file"] and row["bitexact_http"]
+            and row["bitexact_flaky"] and row["bitexact_spill"]
+            and row["flaky_retries"] > 0
+            and row["flaky_quarantined"] == 0
+            and row["spill_cross_pass"] > 0
+        )
+        print(
+            "STORE-SMOKE "
+            + ("PASS" if ok else "FAIL")
+            + f": mem={row['mem_iter_s'] * 1e3:.1f} file="
+            f"{row['file_iter_s'] * 1e3:.1f} http="
+            f"{row['http_iter_s'] * 1e3:.1f} flaky="
+            f"{row['http_flaky_iter_s'] * 1e3:.1f} ms/iter, "
+            f"retries={row['flaky_retries']} (floor >0), "
+            f"quarantined={row['flaky_quarantined']} (==0), "
+            f"cross_pass={row['spill_cross_pass']} (floor >0), "
+            f"bitexact={row['bitexact_file'] and row['bitexact_http'] and row['bitexact_flaky'] and row['bitexact_spill']}",
+            flush=True,
+        )
+        return 0 if ok else 1
+
+    rows = [
+        run_one("small_8x2", k=16, d=32, n=1 << 16, batch_rows=1 << 13,
+                n_shards=2, i1=2, i2=5),
+        run_one("wide_d128", k=32, d=128, n=1 << 16, batch_rows=1 << 13,
+                n_shards=4, i1=2, i2=5),
+        run_one("many_batches", k=16, d=32, n=1 << 17, batch_rows=1 << 12,
+                n_shards=4, i1=2, i2=5),
+    ]
+    with open(OUT, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=FIELDS)
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {OUT}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

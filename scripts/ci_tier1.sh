@@ -1,17 +1,17 @@
 #!/usr/bin/env bash
 # Tier-1 verify, one command (ROADMAP.md "Tier-1 verify"): the CPU-mesh
 # test suite (8 virtual devices via tests/conftest.py) minus slow-marked
-# tests, the comms + resident + spill + subk + bounds + load + fleet +
-# obs + chaos smokes, the tdcverify IR-audit stage, and the tdclint
+# tests, the comms + resident + spill + store + subk + bounds + load +
+# fleet + obs + chaos smokes, the tdcverify IR-audit stage, and the tdclint
 # static-analysis gate. The suite-green invariant every PR must hold.
 #
 #   scripts/ci_tier1.sh            # tests + smokes + verify + lint
 #   SKIP_LINT=1 scripts/ci_tier1.sh
 #
 # Exit code: the FIRST failing stage's code (pytest, then comms smoke,
-# then resident smoke, then spill smoke, then subk smoke, then bounds
-# smoke, then load smoke, then fleet smoke, then obs smoke, then
-# verify, then chaos smoke, then lint), with
+# then resident smoke, then spill smoke, then store smoke, then subk
+# smoke, then bounds smoke, then load smoke, then fleet smoke, then obs
+# smoke, then verify, then chaos smoke, then lint), with
 # every failed stage named on stderr — a run where pytest passes but
 # both smokes fail must say so, not silently collapse into one opaque
 # code.
@@ -71,6 +71,22 @@ if [ -z "$SKIP_SPILL_SMOKE" ]; then
     timeout -k 10 420 env JAX_PLATFORMS=cpu \
         python benchmarks/bench_spill.py --smoke \
         | tail -n 1 || spill_rc=$?
+fi
+
+# Store smoke (benchmarks/bench_store.py): the object-store data plane,
+# correctness-gated — file://, live-HTTP, and flaky-HTTP (deterministic
+# ~33% 503 storm, Retry-After honored) manifest-stream fits must all be
+# bit-exact with the in-memory streamed baseline, the storm must be
+# absorbed by retries (> 0) with ZERO quarantines, and the
+# pass-persistent spill ring over the manifest must stage batches
+# across iteration boundaries (cross_pass > 0) while staying bit-exact.
+# Speed is reported, not gated (wall ratios are noise on a loaded box).
+# Measured ~10 s clean on the CI box; 300 is ample headroom.
+store_rc=0
+if [ -z "$SKIP_STORE_SMOKE" ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python benchmarks/bench_store.py --smoke \
+        | tail -n 1 || store_rc=$?
 fi
 
 # Sub-linear-assignment smoke (benchmarks/bench_subk.py): proves the
@@ -177,14 +193,19 @@ fi
 # one validation window), and the PR-10 flaky-store ingest case (~30%
 # injected transient read failures + one globally-poisoned batch on the
 # 2-process gang: one launch, no collective deadlock, retries > 0,
-# quarantined_batches == 1, within 1e-4 of fault-free), and the PR-16
+# quarantined_batches == 1, within 1e-4 of fault-free), the PR-16
 # fleet kill -9 case (2 subprocess serve replicas behind the router
 # under live load: kill -9 one, every client request still completes,
 # the autoscaler replaces the casualty outside its cooldown, and fleet
-# teardown drains the survivors to exit 75). slow-marked so
+# teardown drains the survivors to exit 75), and the PR-18 flaky-HTTP
+# object-store case (2-process gang on disjoint manifest shards against
+# a live fault-injecting HTTP server — ~30% 503s + one stalled read +
+# one truncated body + one CRC-corrupt blob: one launch, retries > 0,
+# exactly the corrupt batch quarantined, gang-bitwise-identical
+# centroids matching the file:// oracle). slow-marked so
 # the main sweep above keeps its time budget; run here timeout-wrapped
-# (re-measured with the ingest case: ~60 s clean on the CI box — the new
-# soak adds ~5 s, one gang launch with no relaunches; 600 unchanged,
+# (re-measured with the store case: ~70 s clean on the CI box — the new
+# soak adds ~8 s, one gang launch with no relaunches; 600 unchanged,
 # still covering a loaded box re-importing jax across the soaks'
 # subprocess relaunches).
 chaos_rc=0
@@ -217,6 +238,7 @@ fi
 overall=0
 for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
              "resident-smoke:$resident_rc" "spill-smoke:$spill_rc" \
+             "store-smoke:$store_rc" \
              "subk-smoke:$subk_rc" "bounds-smoke:$bounds_rc" \
              "load-smoke:$load_rc" "fleet-smoke:$fleet_rc" \
              "obs-smoke:$obs_rc" \
@@ -230,6 +252,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, subk-smoke, bounds-smoke, load-smoke, fleet-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, store-smoke, subk-smoke, bounds-smoke, load-smoke, fleet-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
 fi
 exit "$overall"

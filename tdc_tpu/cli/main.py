@@ -248,6 +248,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--native_loader", action="store_true",
                    help="stream batches through the C++ prefetch loader "
                         "(requires --data_file pointing at an .npy)")
+    p.add_argument("--data_manifest", type=str, default=None,
+                   help="stream batches from a sharded object-store "
+                        "manifest (data/store.py): http(s):// URL, "
+                        "file:// URL, or local path of a manifest.json "
+                        "(or its directory). Geometry/dtype/batching come "
+                        "from the manifest — batch size is its batch_rows "
+                        "(--num_batches cannot override it) — ranged blob "
+                        "reads are CRC-checked and routed through the "
+                        "ingest guard's retry/quarantine ladder, and a "
+                        "multi-process gang opens disjoint shard sets "
+                        "with zero coordination. Streamed kmeans/fuzzy "
+                        "only (--streamed, optionally --shard_k)")
+    p.add_argument("--store_timeout", type=float, default=None,
+                   help="with --data_manifest: socket deadline in seconds "
+                        "per ranged read on the HTTP backend (default 10; "
+                        "a stalled read surfaces as a transient timeout "
+                        "the --io_retries ladder owns)")
+    p.add_argument("--store_base", type=str, default=None,
+                   help="base URL/directory a relative --data_manifest "
+                        "resolves against (one configured bucket, many "
+                        "datasets)")
     p.add_argument("--trace", type=str, default=None, metavar="DIR",
                    help="enable obs/trace span tracing: export Chrome-trace"
                         " JSON per process into DIR (also $TDC_TRACE) and "
@@ -344,10 +365,51 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def validate_args(parser, args):
-    if args.data_file is None and (args.n_obs is None or args.n_dim is None):
-        parser.error("either --data_file or both --n_obs and --n_dim required")
+    if (args.data_file is None and args.data_manifest is None
+            and (args.n_obs is None or args.n_dim is None)):
+        parser.error("either --data_file, --data_manifest, or both "
+                     "--n_obs and --n_dim required")
     if args.data_file is not None and not os.path.exists(args.data_file):
         parser.error(f"data file does not exist: {args.data_file}")
+    if args.store_timeout is not None and args.store_timeout <= 0:
+        parser.error("--store_timeout must be > 0 seconds")
+    if ((args.store_timeout is not None or args.store_base)
+            and not args.data_manifest):
+        parser.error("--store_timeout/--store_base require --data_manifest")
+    if args.data_manifest:
+        # The manifest stream feeds the guarded streamed kmeans/fuzzy
+        # drivers (1-D and K-sharded) only; reject every other route
+        # rather than silently ignore, per the CLI's standing rule.
+        if args.data_file or args.native_loader:
+            parser.error("--data_manifest replaces --data_file/"
+                         "--native_loader (the manifest names its own "
+                         "blobs)")
+        if args.method_name not in ("distributedKMeans",
+                                    "distributedFuzzyCMeans"):
+            parser.error("--data_manifest feeds the guarded streamed "
+                         "kmeans/fuzzy drivers only")
+        if not args.streamed:
+            parser.error("--data_manifest requires --streamed (the "
+                         "object-store tier is a streaming data plane; "
+                         "batch size comes from the manifest)")
+        if args.num_batches > 1:
+            parser.error("--data_manifest takes its batching from the "
+                         "manifest's batch_rows (the per-slice CRCs are "
+                         "computed at that granularity); --num_batches "
+                         "cannot override it")
+        if args.minibatch or args.mean_combine:
+            parser.error("--data_manifest supports the exact streamed "
+                         "drivers only (not --minibatch/--mean_combine)")
+        if args.layout == "features":
+            parser.error("--data_manifest streams sample-major batches; "
+                         "--layout=features is an in-memory device layout")
+        if args.weight_file:
+            parser.error("--data_manifest has no weight stream aligned "
+                         "to manifest batches; drop --weight_file")
+        if args.metrics:
+            parser.error("--metrics scores in-memory points; "
+                         "--data_manifest keeps the dataset in the "
+                         "object store")
     for name in ("K", "n_max_iters"):
         if getattr(args, name) < 1:
             parser.error(f"--{name} must be >= 1")
@@ -719,6 +781,23 @@ def run_experiment(args) -> dict:
             else:
                 x, _ = load_points(args.data_file)
                 n_obs, n_dim = x.shape
+        manifest = None
+        manifest_url = None
+        if args.data_manifest:
+            # Object-store tier: the dataset never lands in host memory.
+            # Geometry, dtype, and batching all come from the manifest
+            # document; x stays None (validate_args pinned this to the
+            # streamed kmeans/fuzzy drivers, which only touch the stream).
+            from tdc_tpu.data.store import fetch_manifest, resolve_url
+
+            manifest_url = resolve_url(args.data_manifest, args.store_base)
+            manifest = fetch_manifest(
+                manifest_url,
+                **({} if args.store_timeout is None
+                   else {"timeout": args.store_timeout}),
+            )
+            x = None
+            n_obs, n_dim = manifest.n_rows, manifest.d
         if (args.method_name == "gaussianMixture" and args.kernel == "pallas"
                 and n_devices > 1):
             # The parse-time copy of this rule can only see an explicit
@@ -729,7 +808,7 @@ def run_experiment(args) -> dict:
                 "--kernel=pallas gaussianMixture is single-device "
                 f"(resolved n_devices={n_devices}); pass --n_GPUs=1"
             )
-        if not args.data_file:
+        if not args.data_file and not args.data_manifest:
             n_obs, n_dim = args.n_obs, args.n_dim
             # Fully in-memory single-device fits keep the generated points on
             # device: a host round trip of the whole dataset through a
@@ -1023,8 +1102,23 @@ def run_experiment(args) -> dict:
             else x
         )
         def make_stream(rows):
-            """Batch stream honoring --native_loader (C++ prefetch off an
-            .npy) for both the 1-D streamed and the K-sharded paths."""
+            """Batch stream honoring --data_manifest (object-store ranged
+            reads) and --native_loader (C++ prefetch off an .npy) for
+            both the 1-D streamed and the K-sharded paths."""
+            if args.data_manifest:
+                # `rows` is ignored: the manifest fixes batch_rows (the
+                # per-slice CRC granularity). Gang placement rides the
+                # MeshSpec — disjoint shard sets for a 1-D gang, every
+                # batch for K-sharded/single-process fits.
+                from tdc_tpu.data.store import open_manifest_stream
+
+                m = mesh2d if mesh2d is not None else mesh
+                return open_manifest_stream(
+                    manifest_url,
+                    spec=MeshSpec.of(m) if m is not None else None,
+                    **({} if args.store_timeout is None
+                       else {"timeout": args.store_timeout}),
+                )
             if args.native_loader:
                 if not (args.data_file and args.data_file.endswith(".npy")):
                     raise ValueError("--native_loader requires an .npy --data_file")
@@ -1032,6 +1126,14 @@ def run_experiment(args) -> dict:
 
                 return NativePrefetchStream(args.data_file, rows)
             return NpzStream(host_points(), rows)
+
+        # Streamed batches keep their source dtype: the manifest declares
+        # it outright (no x in host memory); otherwise the loaded or
+        # generated array's dtype drives the residency cap sizing.
+        def stream_itemsize() -> int:
+            if args.data_manifest:
+                return np.dtype(manifest.dtype).itemsize
+            return np.dtype(x.dtype).itemsize
 
         if args.minibatch:
             from tdc_tpu.data.batching import auto_batch_size
@@ -1090,10 +1192,15 @@ def run_experiment(args) -> dict:
                     streamed_fuzzy_fit_sharded,
                 )
 
-                rows = residency_rows(
-                    -(-n_obs // num_batches),
-                    itemsize=2 if args.dtype == "bfloat16" else 4,
-                    n_cache_devices=MeshSpec.of(mesh2d).n_data,
+                rows = (
+                    # The manifest fixes batch_rows (the CRC slice size);
+                    # the residency planner sees the stream's own geometry.
+                    manifest.batch_rows if args.data_manifest
+                    else residency_rows(
+                        -(-n_obs // num_batches),
+                        itemsize=2 if args.dtype == "bfloat16" else 4,
+                        n_cache_devices=MeshSpec.of(mesh2d).n_data,
+                    )
                 )
                 return streamed_fuzzy_fit_sharded(
                     make_stream(rows), args.K, n_dim, mesh2d,
@@ -1149,13 +1256,17 @@ def run_experiment(args) -> dict:
             # the in-memory case (one batch) and pads ragged batches exactly.
             from tdc_tpu.parallel.sharded_k import streamed_kmeans_fit_sharded
 
-            rows = residency_rows(
-                -(-n_obs // num_batches),
-                itemsize=2 if args.dtype == "bfloat16" else 4,
-                # The K-sharded cache divides over the DATA axis only
-                # (replicated across model shards) — the MeshSpec is the
-                # one source of that geometry (parallel/meshspec.py).
-                n_cache_devices=MeshSpec.of(mesh2d).n_data,
+            rows = (
+                # The manifest fixes batch_rows (the CRC slice size).
+                manifest.batch_rows if args.data_manifest
+                else residency_rows(
+                    -(-n_obs // num_batches),
+                    itemsize=2 if args.dtype == "bfloat16" else 4,
+                    # The K-sharded cache divides over the DATA axis only
+                    # (replicated across model shards) — the MeshSpec is
+                    # the one source of that geometry (parallel/meshspec).
+                    n_cache_devices=MeshSpec.of(mesh2d).n_data,
+                )
             )
             block = shard_block(rows)
             return streamed_kmeans_fit_sharded(
@@ -1223,16 +1334,21 @@ def run_experiment(args) -> dict:
             )
         if args.method_name == "distributedFuzzyCMeans":
             if streamed:
-                rows = residency_rows(
-                    -(-n_obs // num_batches),
-                    # The 1-D streamed drivers never cast: the cache holds
-                    # the stream's own dtype (bf16 only when generation or
-                    # the data file made it so), unlike the shard_k sites
-                    # where --dtype drives a host-side cast.
-                    itemsize=np.dtype(x.dtype).itemsize,
+                rows = (
+                    manifest.batch_rows if args.data_manifest
+                    else residency_rows(
+                        -(-n_obs // num_batches),
+                        # The 1-D streamed drivers never cast: the cache
+                        # holds the stream's own dtype (bf16 only when
+                        # generation or the data file made it so), unlike
+                        # the shard_k sites where --dtype drives a
+                        # host-side cast.
+                        itemsize=stream_itemsize(),
+                    )
                 )
                 return streamed_fuzzy_fit(
-                    NpzStream(host_points(), rows), args.K, n_dim,
+                    make_stream(rows) if args.data_manifest
+                    else NpzStream(host_points(), rows), args.K, n_dim,
                     m=args.fuzzifier, init=args.init, key=key,
                     max_iters=args.n_max_iters, tol=args.tol, mesh=mesh,
                     ckpt_dir=args.ckpt_dir,
@@ -1256,9 +1372,12 @@ def run_experiment(args) -> dict:
                 history=args.history_file is not None,
             )
         if streamed:
-            rows = residency_rows(
-                -(-n_obs // num_batches),
-                itemsize=np.dtype(x.dtype).itemsize,
+            rows = (
+                manifest.batch_rows if args.data_manifest
+                else residency_rows(
+                    -(-n_obs // num_batches),
+                    itemsize=stream_itemsize(),
+                )
             )
             if args.mean_combine:
                 from tdc_tpu.models import mean_combine_fit

@@ -12,10 +12,16 @@ from tdc_tpu.data.loader import (
 )
 from tdc_tpu.data.batching import auto_batch_size, oom_adaptive
 from tdc_tpu.data.ingest import IngestPolicy, IngestReport
+from tdc_tpu.data.manifest import Manifest, build_manifest
+from tdc_tpu.data.store import ManifestStream, open_manifest_stream
 
 __all__ = [
     "IngestPolicy",
     "IngestReport",
+    "Manifest",
+    "ManifestStream",
+    "build_manifest",
+    "open_manifest_stream",
     "crc_sidecar_path",
     "write_crc_sidecar",
     "make_blobs",

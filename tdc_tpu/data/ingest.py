@@ -74,6 +74,7 @@ through 30% transient read failures plus one poisoned batch).
 
 from __future__ import annotations
 
+import http.client
 import math
 import threading
 import time
@@ -183,9 +184,27 @@ _TRANSIENT = (ConnectionError, TimeoutError, InterruptedError,
 
 
 def classify_error(e: BaseException) -> str:
-    """'transient' | 'permanent' | 'corrupt' for one read failure."""
+    """'transient' | 'permanent' | 'corrupt' for one read failure.
+
+    HTTP semantics (the object-store backend, data/store.py): an error
+    carrying an int `.status` is classified by status class — 408/429
+    (server asked us to slow down and retry) and 5xx (server-side
+    breakage) are transient, every other 4xx is the CLIENT's contract
+    error (missing blob, bad auth, malformed range) and permanent.
+    http.client's IncompleteRead / generic HTTPException are transient:
+    a body truncated by a dropped connection or a torn response means
+    the TRANSFER died, not the object — re-reading is exactly right.
+    (A blob verifiably shorter than the manifest claims is NOT here:
+    store.StoreShortBlob becomes CorruptBatch before classification.)
+    """
     if isinstance(e, CorruptBatch):
         return "corrupt"
+    status = getattr(e, "status", None)
+    if isinstance(status, int):
+        if status in (408, 429) or 500 <= status <= 599:
+            return "transient"
+        if 400 <= status <= 499:
+            return "permanent"
     if isinstance(e, _PERMANENT_OS):
         return "permanent"
     if isinstance(e, _TRANSIENT):
@@ -193,6 +212,8 @@ def classify_error(e: BaseException) -> str:
     if isinstance(e, OSError):
         # Residual OSErrors (EIO, ESTALE, network-filesystem hiccups) are
         # the cold-store faults the retry tier exists for.
+        return "transient"
+    if isinstance(e, http.client.HTTPException):
         return "transient"
     return "permanent"
 
@@ -420,6 +441,21 @@ class GuardedStream:
                     raise
                 attempt += 1
                 delay = backoff_delay(p.io_backoff, attempt, self.label, i)
+                # An HTTP 429/503 Retry-After is the server TELLING us
+                # the earliest useful retry: floor the backoff at it
+                # (capped — a hostile/buggy header must not park a
+                # producer thread past the heartbeat window). The floored
+                # delay still counts against the io_deadline below, so a
+                # Retry-After that cannot fit the budget fails fast
+                # instead of sleeping past it.
+                ra = getattr(e, "retry_after", None)
+                if ra is not None:
+                    try:
+                        # Retry-After header text off the HTTP error —
+                        # host-only, never a traced value.
+                        delay = max(delay, min(float(ra), 30.0))  # tdclint: disable=TDC002
+                    except (TypeError, ValueError):
+                        pass
                 elapsed = time.monotonic() - t0
                 retryable = (
                     kind == "transient"

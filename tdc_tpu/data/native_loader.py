@@ -97,6 +97,14 @@ class NativePrefetchStream:
     Same callable protocol as data.loader.NpzStream: each call returns a fresh
     iterator over (rows_per_batch, d) float batches; one pass per Lloyd
     iteration. The C++ reader stays `depth` batches ahead of the consumer.
+
+    Also speaks the spill ring's RANGED protocol (`read_batch(i)` +
+    `num_batches`): positional `os.pread` against the same fd geometry the
+    C++ reader uses, thread-safe by construction (pread carries its own
+    offset — no shared cursor with the C++ thread or between ring
+    producers), so raw .npy rides the CONCURRENT spill path instead of
+    falling back to the serial ring. The sequential `__call__` pass stays
+    on the C++ prefetcher; ranged reads only run when the ring asks.
     """
 
     def __init__(self, npy_path: str, rows_per_batch: int, *, depth: int = 4):
@@ -107,6 +115,7 @@ class NativePrefetchStream:
         self.shape = shape
         self.rows_per_batch = int(rows_per_batch)
         self._row_bytes = int(dtype.itemsize * shape[1])
+        self._offset = int(offset)
         lib = _load_lib()
         self._id = lib.ldr_open(
             npy_path.encode(), offset, self._row_bytes, shape[0],
@@ -115,6 +124,8 @@ class NativePrefetchStream:
         if self._id < 0:
             raise OSError(f"ldr_open failed (errno {lib.ldr_last_error()})")
         self._lib = lib
+        self._fd = os.open(npy_path, os.O_RDONLY)
+        self.path = npy_path  # store identity for ingest events
 
     @property
     def num_batches(self) -> int:
@@ -136,10 +147,40 @@ class NativePrefetchStream:
             # Copy out: the ring slot is recycled as soon as we return.
             yield buf[:rows].copy()
 
+    def read_batch(self, i: int) -> np.ndarray:
+        """Random-access batch read (the spill ring's RANGED protocol):
+        batch `i` of the `__call__` order, via positional pread — batch
+        boundaries and the ragged tail match the C++ reader exactly."""
+        nb = self.num_batches
+        if not (0 <= i < nb):
+            raise IndexError(f"batch {i} out of range [0, {nb})")
+        row0 = i * self.rows_per_batch
+        rows = min(self.rows_per_batch, self.shape[0] - row0)
+        want = rows * self._row_bytes
+        off = self._offset + row0 * self._row_bytes
+        chunks = []
+        got = 0
+        while got < want:
+            b = os.pread(self._fd, want - got, off + got)
+            if not b:
+                raise OSError(
+                    f"{self.path}: EOF at byte {off + got} reading batch "
+                    f"{i} ({want} bytes expected) — truncated .npy"
+                )
+            chunks.append(b)
+            got += len(b)
+        return (np.frombuffer(b"".join(chunks), dtype=self.dtype)
+                .reshape(rows, self.shape[1]))
+
     def close(self):
         if getattr(self, "_id", -1) >= 0:
             self._lib.ldr_close(self._id)
             self._id = -1
+        if getattr(self, "_fd", -1) >= 0:
+            try:
+                os.close(self._fd)
+            finally:
+                self._fd = -1
 
     def __del__(self):
         try:

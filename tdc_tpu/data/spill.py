@@ -39,14 +39,28 @@ wraps a driver's batch stream with a staging `prepare` on that thread plus
 the H2D accounting (`H2DCounter`) the fit result and `/metrics` surface.
 
 Streams that additionally expose the RANGED protocol — a thread-safe
-`read_batch(i)` next to `num_batches` (NpzStream does natively) — get
-CONCURRENT staging: up to `slots` reads+copies in flight on a small pool,
-delivered strictly in order. Sequential-iterator streams keep the serial
-producer (staging still leaves the dispatch thread); the ranged path is
-what hides per-read LATENCY (cold memmap page faults, NFS/object-store
-GETs) rather than just moving CPU work aside — overlapping reads with each
-other is the same discipline tf.data's parallel interleave applies, and
-the reason the over-budget billion-row pass can approach compute-bound.
+`read_batch(i)` next to `num_batches` (NpzStream, NativePrefetchStream,
+and the object-store ManifestStream all do natively) — get CONCURRENT
+staging: up to `slots` reads+copies in flight on a small pool, delivered
+strictly in order. Sequential-iterator streams keep the serial producer
+(staging still leaves the dispatch thread); the ranged path is what hides
+per-read LATENCY (cold memmap page faults, NFS/object-store GETs) rather
+than just moving CPU work aside — overlapping reads with each other is
+the same discipline tf.data's parallel interleave applies, and the reason
+the over-budget billion-row pass can approach compute-bound.
+
+The ranged ring is additionally PASS-PERSISTENT (`SpillRing`): staging is
+centroid-INdependent (pad + device_put never reads the model), so when a
+pass exhausts normally the ring immediately submits the NEXT pass's first
+`slots` batches into its still-live pool and hands the futures across the
+iteration boundary — the cold-store first-batch latency of pass k+1 is
+paid WHILE pass k's shift check and centroid update drain, not after.
+Every handoff is loud (`spill_cross_pass` structlog event + trace
+instant) and counted (`H2DCounter.cross_pass`, `SpillReport.cross_pass`),
+and speculation is bounded by the same `slots` budget the ring already
+holds. The drivers release the ring (`release`) after the final pass so
+a converged fit's speculative futures are cancelled promptly; early
+close (consumer exception) tears the pool down exactly as before.
 """
 
 from __future__ import annotations
@@ -56,6 +70,7 @@ import time
 from typing import NamedTuple
 
 from tdc_tpu.obs import trace
+from tdc_tpu.utils.structlog import emit
 
 # In-flight device batch slots the ring targets ahead of the consumer.
 # 2 = classic double buffering: one slot computing, one filling.
@@ -91,6 +106,7 @@ class H2DCounter:
         self.copy_s = 0.0
         self.stall_s = 0.0
         self.depth_max = 0
+        self.cross_pass = 0
 
     def add_copy(self, nbytes: int, seconds: float) -> None:
         with self._lock:
@@ -113,6 +129,12 @@ class H2DCounter:
         if self._mirror is not None:
             self._mirror.sample_depth(depth)
 
+    def add_cross_pass(self, batches: int) -> None:
+        with self._lock:
+            self.cross_pass += int(batches)
+        if self._mirror is not None:
+            self._mirror.add_cross_pass(batches)
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -121,6 +143,7 @@ class H2DCounter:
                 "copy_s": self.copy_s,
                 "stall_s": self.stall_s,
                 "depth_max": self.depth_max,
+                "cross_pass": self.cross_pass,
             }
 
     def report(self, slots: int) -> "SpillReport":
@@ -132,6 +155,7 @@ class H2DCounter:
             copy_s=s["copy_s"],
             stall_s=s["stall_s"],
             depth_max=s["depth_max"],
+            cross_pass=s["cross_pass"],
         )
 
 
@@ -159,6 +183,7 @@ class SpillReport(NamedTuple):
     copy_s: float  # producer seconds: read/decode + pad + put + completion
     stall_s: float  # consumer seconds stalled waiting on the ring
     depth_max: int  # deepest ring fill observed
+    cross_pass: int = 0  # batches staged across iteration boundaries
 
     @property
     def overlap_lower_bound(self) -> float:
@@ -288,7 +313,8 @@ def ranged_reader(batches):
     random-access batch read, 0 <= i < num_batches, batch i identical to
     the i-th item of `batches()`) next to `num_batches`. Returns
     (read_batch, n_batches) or None when the stream only iterates
-    sequentially (bare generators, the C++ NativePrefetchStream)."""
+    sequentially (bare generators; the C++ NativePrefetchStream grew a
+    pread-based read_batch in PR 18 and now rides the concurrent ring)."""
     rb = getattr(batches, "read_batch", None)
     nb = getattr(batches, "num_batches", None)
     if rb is None or nb is None:
@@ -300,58 +326,135 @@ def ranged_reader(batches):
     return (rb, nb) if nb >= 1 else None
 
 
-def _concurrent_staged(read_batch, n_batches: int, prepare, slots: int,
-                       counter: H2DCounter | None):
-    """One staged pass with up to `slots` read+stage pipelines in flight,
-    delivered strictly in stream order (bit-exactness: order is the
-    consumer's, concurrency only changes WHEN slots fill). In-flight
+class SpillRing:
+    """The spill tier's pass-persistent staged stream: a zero-arg
+    re-iterable callable (the drivers' stream protocol) whose ranged path
+    keeps ONE worker pool alive across passes and hands `slots` staged
+    next-pass batches across every normal iteration boundary (module
+    doc). Within a pass, delivery is strictly in stream order with up to
+    `slots` read+stage pipelines in flight — bit-exactness: order is the
+    consumer's, concurrency only changes WHEN slots fill — and in-flight
     device memory is bounded by the `slots` outstanding futures plus the
-    batch being consumed — the same (slots + 1) bound the serial ring and
-    `plan_residency` use. Early close cancels undispatched reads and joins
-    the pool; a read/staging exception re-raises at the consumer in order,
-    promptly."""
-    from collections import deque
-    from concurrent.futures import ThreadPoolExecutor
+    batch being consumed, the same (slots + 1) bound `plan_residency`
+    budgets (cross-pass futures REUSE that budget: they exist only while
+    the consumer holds no in-pass futures). Early close (consumer
+    exception / generator close mid-pass) cancels undispatched reads and
+    joins the pool exactly like the pre-persistent ring; `release()` —
+    called by the drivers after the final pass, or by `release(stream)`
+    — cancels any speculative handoff and joins the pool. Sequential
+    (non-ranged) streams fall back to the single-producer bounded ring,
+    fresh threads per pass, no persistence."""
 
-    import jax
+    def __init__(self, batches, prepare, *,
+                 slots: int = DEFAULT_SPILL_SLOTS,
+                 counter: H2DCounter | None = None,
+                 cross_pass: bool = True):
+        self.batches = batches
+        self.prepare = prepare
+        self.slots = max(int(slots), 2)
+        self.counter = counter
+        self._ranged = ranged_reader(batches)
+        self._cross_pass = bool(cross_pass) and self._ranged is not None
+        self._ex = None  # lazily-built ThreadPoolExecutor, pass-persistent
+        self._pending = None  # deque of next-pass futures handed across
 
-    def stage(i):
+    def _stage(self, i: int):
+        import jax
+
         with trace.span("produce", batch=i):
             t0 = time.perf_counter()
-            staged = prepare(read_batch(i))
-            leaves = ([staged.xb] if staged.wb is None
-                      else [staged.xb, staged.wb])
+            staged = self.prepare(self._ranged[0](i))
+            # Account the device-array leaves; host scalars (n_valid /
+            # n_local) ride along untouched. Works for any staged pytree
+            # (a StagedBatch from the drivers, a bare array in tests).
+            if isinstance(staged, StagedBatch):
+                leaves = ([staged.xb] if staged.wb is None
+                          else [staged.xb, staged.wb])
+            else:
+                leaves = [leaf
+                          for leaf in jax.tree_util.tree_leaves(staged)
+                          if hasattr(leaf, "nbytes")]
             jax.block_until_ready(leaves)
-            if counter is not None:
-                counter.add_copy(
+            if self.counter is not None:
+                self.counter.add_copy(
                     sum(int(leaf.nbytes) for leaf in leaves),
                     time.perf_counter() - t0,
                 )
             return staged
 
-    ex = ThreadPoolExecutor(max_workers=max(slots, 1),
-                            thread_name_prefix="tdc-spill")
-    try:
-        futs = deque(ex.submit(stage, i)
-                     for i in range(min(slots, n_batches)))
-        nxt = len(futs)
-        while futs:
-            t0 = time.perf_counter()
-            staged = futs.popleft().result()
-            if counter is not None:
-                counter.add_stall(time.perf_counter() - t0)
-                counter.sample_depth(sum(f.done() for f in futs))
-            if nxt < n_batches:
-                futs.append(ex.submit(stage, nxt))
-                nxt += 1
-            yield staged
-    finally:
-        # Generator close / consumer exception: drop queued reads, join
-        # the workers (bounded: at most `slots` stages finish and are
-        # dropped with their references).
-        for f in futs:
+    def _teardown(self) -> None:
+        """Drop queued reads, join the workers (bounded: at most `slots`
+        stages finish and are dropped with their references)."""
+        ex, self._ex = self._ex, None
+        futs, self._pending = self._pending, None
+        for f in futs or ():
             f.cancel()
-        ex.shutdown(wait=True)
+        if ex is not None:
+            ex.shutdown(wait=True)
+
+    def _ranged_pass(self):
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        n_batches = self._ranged[1]
+        if self._ex is None:
+            self._ex = ThreadPoolExecutor(max_workers=self.slots,
+                                          thread_name_prefix="tdc-spill")
+        ex = self._ex
+        if self._pending is not None:
+            # Adopt the previous pass's speculative handoff: these
+            # batches were staging while the shift check drained.
+            futs, self._pending = self._pending, None
+        else:
+            futs = deque(ex.submit(self._stage, i)
+                         for i in range(min(self.slots, n_batches)))
+        nxt = len(futs)
+        completed = False
+        try:
+            while futs:
+                t0 = time.perf_counter()
+                staged = futs.popleft().result()
+                if self.counter is not None:
+                    self.counter.add_stall(time.perf_counter() - t0)
+                    self.counter.sample_depth(sum(f.done() for f in futs))
+                if nxt < n_batches:
+                    futs.append(ex.submit(self._stage, nxt))
+                    nxt += 1
+                yield staged
+            completed = True
+            if self._cross_pass:
+                # Normal exhaustion: the NEXT pass's first batches start
+                # staging NOW, overlapping the consumer's between-pass
+                # work (shift check, centroid update, checkpoint). Pure
+                # speculation bounded by the ring's own slot budget —
+                # staging never reads the centroids, so the bytes are
+                # identical whether or not another pass happens.
+                k = min(self.slots, n_batches)
+                self._pending = deque(ex.submit(self._stage, i)
+                                      for i in range(k))
+                if self.counter is not None:
+                    self.counter.add_cross_pass(k)
+                emit("spill_cross_pass", batches=k, slots=self.slots)
+                trace.instant("spill_cross_pass", batches=k)
+        finally:
+            if not completed:
+                # Early close / consumer exception mid-pass: same prompt
+                # teardown as the pre-persistent ring.
+                for f in futs:
+                    f.cancel()
+                self._teardown()
+
+    def __call__(self):
+        if self._ranged is not None:
+            return self._ranged_pass()
+        return prefetch_map(
+            _staged_iter(self.batches, self.prepare, self.counter),
+            self.slots - 1, counter=self.counter)
+
+    def release(self) -> None:
+        """Cancel any cross-pass speculation and join the pool. Idempotent;
+        the ring is reusable afterwards (a new pass rebuilds the pool)."""
+        self._teardown()
 
 
 def spill_stream(batches, prepare, *, slots: int = DEFAULT_SPILL_SLOTS,
@@ -362,21 +465,24 @@ def spill_stream(batches, prepare, *, slots: int = DEFAULT_SPILL_SLOTS,
     unchanged — the consumer's step recognizes StagedBatch and skips
     staging, so the op sequence (and therefore the fp32 result) is
     identical to plain streaming. Ranged streams (`ranged_reader`) get
-    `slots` CONCURRENT read+stage pipelines with in-order delivery;
-    sequential streams get the single-producer bounded ring. Returns a
-    zero-arg callable with the same re-iterable protocol (fresh
-    threads per pass)."""
-    slots = max(int(slots), 2)
-    ranged = ranged_reader(batches)
+    `slots` CONCURRENT read+stage pipelines with in-order delivery and
+    pass-persistent cross-boundary prefetch; sequential streams get the
+    single-producer bounded ring. Returns a `SpillRing` (a zero-arg
+    callable with the same re-iterable protocol)."""
+    return SpillRing(batches, prepare, slots=slots, counter=counter)
 
-    def stream():
-        if ranged is not None:
-            return _concurrent_staged(ranged[0], ranged[1], prepare, slots,
-                                      counter)
-        return prefetch_map(_staged_iter(batches, prepare, counter),
-                            slots - 1, counter=counter)
 
-    return stream
+def release(stream) -> None:
+    """Release a stream IF it is a SpillRing (cancel cross-pass
+    speculation, join the pool); anything else — the raw stream when the
+    spill tier was not selected, a GuardedStream, a user-owned loader —
+    is left untouched. The drivers call this once after the final
+    reporting pass; closing user-owned streams is NOT this function's
+    job (a GuardedStream delegates attribute access to the raw stream,
+    so a duck-typed close() here would reach through and close a stream
+    the caller may reuse)."""
+    if isinstance(stream, SpillRing):
+        stream.release()
 
 
 def wrap_stream(plan, batches, prepare):
@@ -386,7 +492,10 @@ def wrap_stream(plan, batches, prepare):
     otherwise (batches, None) and the caller keeps its inline staging and
     prefetch knob. A spill-wrapped stream supersedes `_prefetched` — pass
     prefetch 0 when the counter is non-None. Shared so the four drivers'
-    staging-to-ring bridges cannot drift (the _make_put_batch lesson)."""
+    staging-to-ring bridges cannot drift (the _make_put_batch lesson).
+    Callers pair this with `release(stream)` after their final pass so
+    the pass-persistent ring's speculative futures do not outlive the
+    fit."""
     if plan is None or not plan.spill:
         return batches, None
     counter = H2DCounter(_mirror=GLOBAL_H2D)
@@ -402,9 +511,11 @@ __all__ = [
     "GLOBAL_H2D",
     "H2DCounter",
     "SpillReport",
+    "SpillRing",
     "StagedBatch",
     "prefetch_map",
     "ranged_reader",
+    "release",
     "spill_stream",
     "wrap_stream",
 ]

@@ -92,6 +92,11 @@ def _accumulate(
         s = lloyd_stats(batch, centroids)
     from tdc_tpu.parallel.sharded_k import padding_correction
 
+    if n_valid.ndim:
+        # Multi-process: a sharded per-host valid-count vector (see
+        # _valid_arg) — the device sum is the global valid count, agreed
+        # through the collective instead of a replicated scalar.
+        n_valid = jnp.sum(n_valid)
     n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(jnp.float32)
     # The correction's argmin must mirror where the kernel actually PUT the
     # zero pad rows: the pallas kernels score them against centroids cast to
@@ -405,6 +410,52 @@ def _prepare_batch(batch, mesh):
     n_dev = int(np.prod(mesh.devices.shape))
     padded, _ = mesh_lib.pad_to_multiple(batch, n_dev, fill_value=0.0)
     return mesh_lib.shard_points(padded, mesh), n_local, n_local
+
+
+def _valid_arg(mesh, n_valid: int):
+    """`n_valid` as the per-batch SPMD argument to the padding correction.
+
+    Single-process fits pass the plain scalar. Multi-process fits pass a
+    (n_devices, 1) sharded vector whose per-host slice holds THIS HOST'S
+    valid-row count (in its leading slot) — `_accumulate` sums it on
+    device, so the global valid count is agreed THROUGH the collective.
+    A replicated scalar cannot carry it: quarantine verdicts on
+    disjoint-shard streams (object-store manifests) are host-local, and
+    a host correcting with its own divergent pad count would fork the
+    replicated centroid state (one cluster's mass off by the quarantined
+    rows' zero-point contribution, silently)."""
+    if mesh is None:
+        return jnp.asarray(n_valid)
+    nproc, local_dev = _mesh_layout(mesh)
+    if nproc <= 1:
+        return jnp.asarray(n_valid)
+    local = np.zeros((max(local_dev, 1), 1), np.float32)
+    local[0, 0] = n_valid // nproc  # _prepare_batch staged local x nproc
+    return jax.make_array_from_process_local_data(
+        mesh_lib.data_sharding(mesh), local, (local.shape[0] * nproc, 1)
+    )
+
+
+def _agreed_pad(mesh, pad_rows: int) -> int:
+    """The deferred (per-pass) path's whole-pass pad total, agreed across
+    hosts. Each host tallies (global_rows - its own n_valid view) per
+    batch, so a disjoint-shard quarantine — a host-LOCAL verdict — skews
+    the tally by nproc x the quarantined rows on the owning host only.
+    Summing the host tallies counts every global pad row exactly nproc
+    times, so the mean is the true global total: one tiny allgather per
+    pass buys the same verdict agreement _valid_arg gives the per-batch
+    path. Symmetric tallies (geometry padding only) are unchanged."""
+    if mesh is None:
+        return pad_rows
+    nproc, _ = _mesh_layout(mesh)
+    if nproc <= 1:
+        return pad_rows
+    from jax.experimental import multihost_utils
+
+    total = int(np.asarray(
+        multihost_utils.process_allgather(np.int64(pad_rows))
+    ).sum())
+    return total // nproc
 
 
 def _crosscheck_pass_rows(mesh, rows: int, quarantined: int = 0) -> None:
@@ -1617,8 +1668,8 @@ def streamed_kmeans_fit(
                 return d_add(acc, xb, c), n_local
             counter.add(*cost_pb)
             return (
-                _accumulate(acc, xb, c, jnp.asarray(n_valid), spherical,
-                            kernel, mesh),
+                _accumulate(acc, xb, c, _valid_arg(mesh, n_valid),
+                            spherical, kernel, mesh),
                 n_local,
             )
 
@@ -1627,7 +1678,15 @@ def streamed_kmeans_fit(
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
-            crosscheck_quarantine=guard.quarantined_rows_seen,
+            # Disjoint-shard manifests (object-store ManifestStream in a
+            # gang) legitimately quarantine per-host — each host reads
+            # DIFFERENT bytes, so the symmetric-verdict contract does not
+            # apply and the quarantine-total crosscheck must stand down
+            # (row totals still check: gang manifests refuse ragged
+            # layouts at assignment time).
+            crosscheck_quarantine=(
+                None if getattr(guard, "disjoint_shards", False)
+                else guard.quarantined_rows_seen),
             preempt_batch=not ckpt.gang,
             preempt_can_save=bool(ckpt_every_batches) and not deferred,
         )
@@ -1645,7 +1704,9 @@ def streamed_kmeans_fit(
             *reduce_lib.tree_reduce_cost(example, axes, strategy.quantize)
         )
         return _lloyd_pass_correction(
-            acc, c, jnp.asarray(0.0 if weighted else pad[0], jnp.float32),
+            acc, c,
+            jnp.asarray(0.0 if weighted else _agreed_pad(mesh, pad[0]),
+                        jnp.float32),
             cast=bdt[0] if kernel == "pallas" else None,
         )
 
@@ -1773,6 +1834,9 @@ def streamed_kmeans_fit(
             bounds_counter.add(float(aux.evals), float(aux.evals_exact))
     else:
         sse = full_pass(c).sse
+    # The fit is done: cancel the pass-persistent ring's speculative
+    # next-pass staging and join its pool (no-op off the spill tier).
+    spill_lib.release(run_stream)
     return KMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),
@@ -1937,6 +2001,9 @@ def _accumulate_fuzzy(
         s = distributed_fuzzy_stats(batch, centroids, mesh, m=m, kernel="xla")
     else:
         s = fuzzy_stats(batch, centroids, m=m)
+    if n_valid.ndim:
+        # Multi-process sharded per-host valid counts (see _valid_arg).
+        n_valid = jnp.sum(n_valid)
     n_pad = jnp.asarray(batch.shape[0], jnp.float32) - n_valid.astype(jnp.float32)
     zero_row = jnp.zeros((1, batch.shape[1]), batch.dtype)
     zs = fuzzy_stats(zero_row, centroids, m=m)
@@ -2120,8 +2187,8 @@ def streamed_fuzzy_fit(
                 return d_add(acc, xb, c), n_local
             counter.add(*cost_pb)
             return (
-                _accumulate_fuzzy(acc, xb, c, jnp.asarray(n_valid), m,
-                                  kernel, mesh),
+                _accumulate_fuzzy(acc, xb, c, _valid_arg(mesh, n_valid),
+                                  m, kernel, mesh),
                 n_local,
             )
 
@@ -2130,7 +2197,15 @@ def streamed_fuzzy_fit(
             ckpt=ckpt, ckpt_every_batches=ckpt_every_batches, n_iter=n_iter,
             skip=skip, acc0=acc0, rows0=rows0, save_args=(c, shift, history),
             crosscheck_mesh=mesh if n_iter == start_iter + 1 else None,
-            crosscheck_quarantine=guard.quarantined_rows_seen,
+            # Disjoint-shard manifests (object-store ManifestStream in a
+            # gang) legitimately quarantine per-host — each host reads
+            # DIFFERENT bytes, so the symmetric-verdict contract does not
+            # apply and the quarantine-total crosscheck must stand down
+            # (row totals still check: gang manifests refuse ragged
+            # layouts at assignment time).
+            crosscheck_quarantine=(
+                None if getattr(guard, "disjoint_shards", False)
+                else guard.quarantined_rows_seen),
             preempt_batch=not ckpt.gang,
             preempt_can_save=bool(ckpt_every_batches) and not deferred,
         )
@@ -2146,7 +2221,9 @@ def streamed_fuzzy_fit(
             *reduce_lib.tree_reduce_cost(example, axes, strategy.quantize)
         )
         return _fuzzy_pass_correction(
-            acc, c, jnp.asarray(0.0 if weighted else pad[0], jnp.float32),
+            acc, c,
+            jnp.asarray(0.0 if weighted else _agreed_pad(mesh, pad[0]),
+                        jnp.float32),
             m=float(m), cast=bdt[0] if kernel == "pallas" else None,
         )
 
@@ -2232,6 +2309,9 @@ def streamed_fuzzy_fit(
         objective = facc.objective
     else:
         objective = full_pass(c).objective
+    # The fit is done: cancel the pass-persistent ring's speculative
+    # next-pass staging and join its pool (no-op off the spill tier).
+    spill_lib.release(run_stream)
     return FuzzyCMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),

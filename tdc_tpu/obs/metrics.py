@@ -123,6 +123,22 @@ CATALOG = {
                    "staging (copy time the overlap failed to hide)."),
     "tdc_h2d_prefetch_depth": (
         "gauge", "Deepest spill prefetch-ring fill observed."),
+    "tdc_h2d_cross_pass_batches_total": (
+        "counter", "Batches the pass-persistent spill ring staged across "
+                   "iteration boundaries (next-pass prefetch while the "
+                   "shift check drains)."),
+    # object-store data plane (data/store.py)
+    "tdc_store_reads_total": (
+        "counter", "Successful ranged blob reads against object-store "
+                   "backends (data/store.py)."),
+    "tdc_store_retries_total": (
+        "counter", "Failed store read attempts (each becomes an ingest "
+                   "retry or an abandoned read)."),
+    "tdc_store_bytes_total": (
+        "counter", "Blob bytes fetched from object-store backends."),
+    "tdc_store_stall_seconds_total": (
+        "counter", "Wall-clock seconds burned inside failed store read "
+                   "attempts (timeouts, 5xx round trips, resets)."),
     # hardened ingest (data/ingest.py)
     "tdc_ingest_retries_total": (
         "counter", "Stream read attempts retried after transient failures "

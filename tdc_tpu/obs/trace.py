@@ -58,6 +58,8 @@ KNOWN_SPANS = frozenset({
     "produce",        # spill-ring producer: read+stage+H2D for one batch
     "ingest_retry",   # instant: one retried read (data/ingest.py)
     "pass_boundary",  # instant: gang alignment anchor, args {"pass": n}
+    "spill_cross_pass",  # instant: next-pass batches staged across the
+                         # iteration boundary (data/spill.SpillRing)
 })
 
 # Span name -> per-fit timeline column. shift_check books into reduce_s:

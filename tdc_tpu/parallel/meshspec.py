@@ -112,6 +112,22 @@ class MeshSpec:
             return ()
         return mesh_lib.data_axes(self.mesh)
 
+    def manifest_batches(self, n_batches: int) -> range:
+        """This process's batch range of an `n_batches`-batch dataset
+        manifest (data/manifest.py): disjoint contiguous per-process
+        ranges exactly when the drivers stream PER-HOST slices
+        (`process_scale > 1` — the 1-D gang contract), the full range
+        when batches are already global (single process, or the
+        K-sharded identical-global-batch contract). The same
+        process_scale rule the staging geometry uses, so manifest
+        assignment can never disagree with how the batch is staged."""
+        from tdc_tpu.data.manifest import assign_batches
+
+        if self.process_scale <= 1:
+            return range(int(n_batches))
+        return assign_batches(n_batches, self.n_processes,
+                              jax.process_index())
+
     # -- placement --------------------------------------------------------
 
     def named(self, spec: P) -> NamedSharding:

@@ -2295,6 +2295,15 @@ def streamed_kmeans_fit_sharded(
         ckpt = _GatheringCheckpointer(ckpt)
     guard = ingest_lib.guard_stream(batches, ingest, d=d,
                                     label="streamed_kmeans_fit_sharded")
+    if gang and getattr(guard, "disjoint_shards", False):
+        raise ValueError(
+            "streamed_kmeans_fit_sharded: disjoint-shard manifest streams "
+            "in a multi-process gang are not supported on the K-sharded "
+            "driver — its padding correction folds n_valid as a replicated "
+            "scalar, and disjoint shards quarantine per HOST, which would "
+            "fork the replicated state; use the 1-D streamed driver "
+            "(streamed_kmeans_fit) for gang object-store ingestion"
+        )
     # Restore FIRST (models/streaming convention): a resume must not re-pay
     # init resolution, and must report the checkpointed state faithfully.
     state = ckpt.restore(_ShardedAcc, None)
@@ -2847,6 +2856,9 @@ def streamed_kmeans_fit_sharded(
                  detail="the HBM cache fill did not complete; the fit "
                         "ran exact streamed assignment")
     sse = float(final_acc.sse)
+    # The fit is done: cancel the pass-persistent ring's speculative
+    # next-pass staging and join its pool (no-op off the spill tier).
+    spill_lib.release(loop_batches)
     return KMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),
@@ -2981,6 +2993,15 @@ def streamed_fuzzy_fit_sharded(
     )
     guard = ingest_lib.guard_stream(batches, ingest, d=d,
                                     label="streamed_fuzzy_fit_sharded")
+    if gang and getattr(guard, "disjoint_shards", False):
+        raise ValueError(
+            "streamed_fuzzy_fit_sharded: disjoint-shard manifest streams "
+            "in a multi-process gang are not supported on the K-sharded "
+            "driver — its padding correction folds n_valid as a replicated "
+            "scalar, and disjoint shards quarantine per HOST, which would "
+            "fork the replicated state; use the 1-D streamed driver "
+            "(streamed_fuzzy_fit) for gang object-store ingestion"
+        )
     state = ckpt.restore(_ShardedFuzzyAcc, None)
     if state.cursor:
         _reduce_plan(strategy, mesh, ckpt_dir, ckpt_every_batches,
@@ -3265,6 +3286,9 @@ def streamed_fuzzy_fit_sharded(
     )
     # The final pass's objective is measured at the RETURNED centroids.
     obj = float(final_acc.obj)
+    # Cancel the pass-persistent ring's speculation and join its pool
+    # (no-op off the spill tier).
+    spill_lib.release(loop_batches)
     return FuzzyCMeansResult(
         centroids=c,
         n_iter=jnp.asarray(n_iter, jnp.int32),

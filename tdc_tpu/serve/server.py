@@ -560,6 +560,11 @@ class ServeApp:
 
             return GLOBAL_INGEST.snapshot()
 
+        def _sto():
+            from tdc_tpu.data.store import GLOBAL_STORE
+
+            return GLOBAL_STORE.snapshot()
+
         def _asn():
             from tdc_tpu.ops.subk import GLOBAL_ASSIGN
 
@@ -602,6 +607,13 @@ class ServeApp:
             ("tdc_h2d_copy_stall_seconds_total",
              lambda: round(_h2d()["stall_s"], 3)),
             ("tdc_h2d_prefetch_depth", lambda: _h2d()["depth_max"]),
+            ("tdc_h2d_cross_pass_batches_total",
+             lambda: _h2d()["cross_pass"]),
+            ("tdc_store_reads_total", lambda: _sto()["reads"]),
+            ("tdc_store_retries_total", lambda: _sto()["failed"]),
+            ("tdc_store_bytes_total", lambda: _sto()["bytes"]),
+            ("tdc_store_stall_seconds_total",
+             lambda: round(_sto()["stall_s"], 3)),
             ("tdc_ingest_retries_total", lambda: _ing()["retries"]),
             ("tdc_ingest_read_failures_total",
              lambda: _ing()["read_failures"]),

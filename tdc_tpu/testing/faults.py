@@ -68,6 +68,13 @@ Instrumented points (grep fault_point for the live list):
     fleet.scale             before the autoscaler applies a scale decision
     fleet.replica_spawn     before the fleet controller spawns a replica
                             process
+    store.read.transient    every object-store ranged blob read, before
+                            the backend I/O (data/store.py — the
+                            retryable storm injection point)
+    store.read.permanent    every object-store ranged blob read (the
+                            non-retryable injection point)
+    store.list              before an object-store manifest document load
+                            (data/store.py read_doc)
 """
 
 from __future__ import annotations
@@ -110,6 +117,9 @@ KNOWN_POINTS = frozenset({
     "fleet.route",
     "fleet.scale",
     "fleet.replica_spawn",
+    "store.read.transient",
+    "store.read.permanent",
+    "store.list",
 })
 
 # Exit code used by the 'crash' action: 128+9, what a shell reports for a

@@ -22,6 +22,9 @@ KNOWN_POINTS = frozenset({
     "fleet.route",
     "fleet.scale",
     "fleet.replica_spawn",
+    "store.read.transient",
+    "store.read.permanent",
+    "store.list",
 })
 
 
@@ -71,3 +74,14 @@ def fleet_paths():
     fault_point("fleet.route")
     fault_point("fleet.scale")
     fault_point("fleet.replica_spawn")
+
+
+def store_paths():
+    while True:
+        fault_point("store.read.transient")
+        fault_point("store.read.permanent")
+        return
+
+
+def store_listing():
+    fault_point("store.list")

@@ -749,6 +749,177 @@ def test_chaos_flaky_store_and_poisoned_batch_gang(tmp_path):
                                rtol=1e-4, atol=1e-4)
 
 
+_STORE_CHAOS_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from tdc_tpu.data.ingest import IngestPolicy
+    from tdc_tpu.data.store import open_manifest_stream
+    from tdc_tpu.parallel.multihost import (
+        barrier, global_mesh, initialize_from_env,
+    )
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    outdir, manifest_path, http_url = sys.argv[1], sys.argv[2], sys.argv[3]
+    pid, nproc = initialize_from_env()
+
+    init = np.load(os.path.join(outdir, "init.npy"))
+    mesh = global_mesh()
+    policy = IngestPolicy(io_retries=6, io_backoff=0.01,
+                          max_bad_fraction=0.5)
+
+    def fit(url, timeout=None):
+        stream = open_manifest_stream(
+            url, process_index=pid, num_processes=nproc,
+            **({} if timeout is None else {"timeout": timeout}),
+        )
+        return streamed_kmeans_fit(
+            stream, 5, 4, init=init, max_iters=3, tol=-1.0,
+            mesh=mesh, ingest=policy,
+        )
+
+    # Fit A rides the storm; fit B is the local-file oracle over the
+    # SAME blob directory (same on-disk corruption, same disjoint
+    # assignment) — A must match B bitwise: transient HTTP faults are
+    # invisible, permanent corruption quarantines identically.
+    res_a = fit(http_url, timeout=0.5)
+    res_b = fit(manifest_path)
+    np.save(os.path.join(outdir, f"centroids_http_{pid}.npy"),
+            np.asarray(res_a.centroids))
+    np.save(os.path.join(outdir, f"centroids_file_{pid}.npy"),
+            np.asarray(res_b.centroids))
+    with open(os.path.join(outdir, f"store_{pid}.json"), "w") as f:
+        json.dump({"http_retries": res_a.ingest.retries,
+                   "http_quarantined": res_a.ingest.quarantined_batches,
+                   "http_quarantined_rows": res_a.ingest.quarantined_rows,
+                   "file_retries": res_b.ingest.retries,
+                   "file_quarantined": res_b.ingest.quarantined_batches}, f)
+    print("CHAOS_OK", pid, flush=True)
+    barrier()
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.multiproc
+def test_chaos_flaky_http_store_gang(tmp_path):
+    """The object-store data-plane soak (PR-18 ISSUE acceptance): a
+    2-process gloo gang streams DISJOINT shard sets of one blob manifest
+    from an in-process HTTP server injecting ~30% 5xx (Retry-After set),
+    one stalled read (longer than the client's socket deadline) and one
+    truncated body — all TRANSIENT, retried transparently on the store's
+    real sockets — while one batch is bit-flipped ON DISK, so its CRC32
+    verdict is permanent: exactly one quarantined batch, on the one host
+    whose shard set owns it (disjoint shards stand the symmetric-verdict
+    crosscheck down; row totals still crosscheck). The gang completes in
+    ONE launch with retries > 0, bitwise-identical replicated centroids,
+    bitwise equality with the local-file oracle over the same corrupted
+    blobs, and matches the fault-free oracle with that batch's rows
+    absent within the documented streamed tolerance."""
+    from tdc_tpu.data.manifest import build_manifest
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(960, 4)).astype(np.float32)
+    x[:240] += 4.0
+    x[240:480] -= 4.0
+    mdir = tmp_path / "blobs"
+    mdir.mkdir()
+    manifest_path = build_manifest(x, 120, str(mdir), n_shards=2)
+
+    # Bit-flip one byte inside GLOBAL batch 5 (rows 600..719) on disk:
+    # shard part-00001.bin starts at row 480, so the batch lives at
+    # local byte offset (600-480)*16. Batches 4..7 belong to process 1
+    # under the disjoint assignment — the quarantine is asymmetric by
+    # construction.
+    blob = mdir / "part-00001.bin"
+    raw = bytearray(blob.read_bytes())
+    raw[(600 - 480) * 16 + 7] ^= 0x40
+    blob.write_bytes(bytes(raw))
+
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    np.save(outdir / "init.npy", x[:5])
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_STORE_CHAOS_WORKER)
+
+    from tdc_tpu.testing.flaky_http import FlakyHTTPServer
+
+    # 4 passes (3 Lloyd + final stats) x 4 local batches x 2 workers =
+    # 32 base blob reads on the HTTP fit; every 3rd counted request
+    # 503s (~30%, each failure's retry is itself counted and may fail
+    # again — io_retries=6 rides it out), request 4 stalls past the
+    # 0.5 s client deadline, request 9 truncates its body mid-transfer.
+    server = FlakyHTTPServer(
+        str(mdir), fail_every=3, fail_status=503, retry_after=0.01,
+        stall_requests={4}, stall_s=1.5, truncate_requests={9},
+    )
+    echoes = []
+    with server as base_url:
+        res = run_gang(
+            [sys.executable, str(worker), str(outdir), manifest_path,
+             f"{base_url}/manifest.json"], 2,
+            max_restarts=0, log_dir=str(tmp_path / "logs"),
+            heartbeat_timeout=180.0, env=env, echo=echoes.append,
+            backoff_base=0.05,
+        )
+    # One launch, no restart, no collective deadlock.
+    assert res.attempts == 1 and res.returncodes == [0, 0], (res, echoes)
+    assert server.fault_count > 0
+
+    import json
+
+    reps = [json.load(open(outdir / f"store_{pid}.json"))
+            for pid in range(2)]
+    # The storm hit the gang and every retry was absorbed in-launch.
+    assert reps[0]["http_retries"] + reps[1]["http_retries"] > 0, reps
+    # Exactly ONE quarantined batch gang-wide, owned by process 1
+    # (global batch 5 lives in its shard set), on BOTH the HTTP fit and
+    # the file:// oracle — CRC verdicts are transport-independent.
+    for kind in ("http_quarantined", "file_quarantined"):
+        assert reps[0][kind] == 0 and reps[1][kind] == 1, (kind, reps)
+    assert reps[1]["http_quarantined_rows"] == 120, reps
+    # The file oracle saw no transient faults at all.
+    assert reps[0]["file_retries"] == 0 and reps[1]["file_retries"] == 0
+
+    c_http = [np.load(outdir / f"centroids_http_{pid}.npy")
+              for pid in range(2)]
+    c_file = [np.load(outdir / f"centroids_file_{pid}.npy")
+              for pid in range(2)]
+    # Replicated state agrees bitwise across the gang; the stormy HTTP
+    # fit is bitwise-identical to the local-file oracle on each host.
+    np.testing.assert_array_equal(c_http[0], c_http[1])
+    for pid in range(2):
+        np.testing.assert_array_equal(c_http[pid], c_file[pid])
+
+    log1 = (tmp_path / "logs" / "worker_a0_p1.log").read_text()
+    assert "ingest_quarantine" in log1
+    logs = log1 + (tmp_path / "logs" / "worker_a0_p0.log").read_text()
+    assert "ingest_retry" in logs and "manifest_open" in logs
+
+    # Fault-free oracle: single process, ORIGINAL bytes, the quarantined
+    # batch's rows absent — the zero-mass quarantine identity end to end
+    # (gang fold order differs, hence the documented streamed tolerance).
+    from tdc_tpu.models.streaming import streamed_kmeans_fit
+
+    def batches():
+        for b in (0, 1, 2, 3, 4, 6, 7):
+            yield x[b * 120:(b + 1) * 120]
+
+    want = streamed_kmeans_fit(batches, 5, 4, init=x[:5], max_iters=3,
+                               tol=-1.0)
+    np.testing.assert_allclose(c_http[0], np.asarray(want.centroids),
+                               rtol=1e-4, atol=1e-4)
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_chaos_online_poisoned_fold_and_crash_mid_swap(tmp_path):

@@ -150,6 +150,84 @@ class TestClassification:
             ingest_lib.resolve_policy(3)
 
 
+class TestHTTPClassification:
+    """Object-store (data/store.py) failure modes through classify_error:
+    every class the HTTP-range backend can produce routes to the verdict
+    the retry/quarantine ladder expects."""
+
+    def test_http_status_semantics(self):
+        from tdc_tpu.data.store import StoreHTTPError
+
+        # 408/429 + 5xx: the server asked for a retry / broke — transient.
+        for status in (500, 502, 503, 504, 599, 408, 429):
+            e = StoreHTTPError(f"HTTP {status}", status=status)
+            assert classify_error(e) == "transient", status
+        # Every other 4xx is the CLIENT's contract error — permanent.
+        for status in (400, 401, 403, 404, 410):
+            e = StoreHTTPError(f"HTTP {status}", status=status)
+            assert classify_error(e) == "permanent", status
+
+    def test_status_is_duck_typed_but_only_for_ints(self):
+        e = RuntimeError("boom")
+        e.status = 503
+        assert classify_error(e) == "transient"
+        e2 = RuntimeError("boom")
+        e2.status = "503"  # non-int status never triggers HTTP semantics
+        assert classify_error(e2) == "permanent"
+
+    def test_transfer_deaths_are_transient(self):
+        import http.client
+
+        from tdc_tpu.data.store import StoreShortBlob
+
+        # A body truncated by a dropped connection / torn status line /
+        # remote hangup means the TRANSFER died, not the object.
+        assert classify_error(
+            http.client.IncompleteRead(b"xx")) == "transient"
+        assert classify_error(http.client.BadStatusLine("")) == "transient"
+        assert classify_error(
+            http.client.RemoteDisconnected("gone")) == "transient"
+        # Raw StoreShortBlob (a store user outside ManifestStream): an
+        # OSError, retried like any cold-store hiccup. Inside
+        # ManifestStream a verifiably-short blob becomes CorruptBatch
+        # (quarantine) before classification — covered in test_store.py.
+        assert classify_error(StoreShortBlob("short")) == "transient"
+
+    def test_retry_after_floors_the_backoff(self, runlog):
+        """A 429's Retry-After is the server naming the earliest useful
+        retry: the ladder must sleep at least that long (not its own
+        millisecond backoff) and stay transparent."""
+        from tdc_tpu.data.store import StoreHTTPError
+
+        x = _data(400, 4, seed=9)
+        tripped = []
+
+        def gen():
+            for i in range(0, 400, 100):
+                yield x[i:i + 100]
+
+        def read(i):
+            if i == 1 and not tripped:
+                tripped.append(i)
+                raise StoreHTTPError("HTTP 429", status=429,
+                                     retry_after=0.2)
+            return x[i * 100:(i + 1) * 100]
+
+        base = streamed_kmeans_fit(NpzStream(x, 100), 4, 4, init=x[:4],
+                                   max_iters=2, tol=-1.0)
+        res = streamed_kmeans_fit(
+            SizedBatches(gen, 400, 100, read_batch=read), 4, 4,
+            init=x[:4], max_iters=2, tol=-1.0,
+            ingest=IngestPolicy(io_retries=2, io_backoff=1e-3),
+        )
+        assert res.ingest.retries == 1
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids)
+        )
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_retry"]
+        assert ev and ev[0]["delay_s"] >= 0.2
+
+
 # ---------------------------------------------------------------------------
 # Retry / failure routing (incl. the spill producer-thread bugfix)
 # ---------------------------------------------------------------------------

@@ -514,6 +514,8 @@ def test_fault_points_match_registry():
         "data.read.transient", "data.read.permanent", "data.corrupt",
         # PR-16 serve fleet (tdc_tpu/fleet/)
         "fleet.route", "fleet.scale", "fleet.replica_spawn",
+        # PR-18 object-store data plane (data/store.py, data/manifest.py)
+        "store.read.transient", "store.read.permanent", "store.list",
     }
 
 

@@ -58,3 +58,62 @@ def test_streamed_fit_over_native_loader(npy_file):
 def test_open_missing_file_raises():
     with pytest.raises((OSError, FileNotFoundError)):
         NativePrefetchStream("/nonexistent/file.npy", 128)
+
+
+def test_ranged_read_batch_parity_and_ragged_tail(npy_file):
+    # pread-based random access alongside the sequential C++ reader:
+    # same bytes, any order, usable from the spill ring's producers.
+    path, x = npy_file
+    s = NativePrefetchStream(path, rows_per_batch=128)
+    assert s.read_batch(0).shape == (128, 6)
+    np.testing.assert_array_equal(s.read_batch(7), x[896:])  # 107 rows
+    got = np.concatenate([s.read_batch(i) for i in reversed(range(8))])
+    want = np.concatenate([x[i * 128:(i + 1) * 128]
+                           for i in reversed(range(8))])
+    np.testing.assert_array_equal(got, want)
+    with pytest.raises(IndexError):
+        s.read_batch(8)
+    with pytest.raises(IndexError):
+        s.read_batch(-1)
+    s.close()
+
+
+def test_ranged_reads_concurrent_with_sequential_pass(npy_file):
+    # The fd-level pread path shares no cursor with the sequential
+    # reader: interleaving them must not corrupt either.
+    path, x = npy_file
+    s = NativePrefetchStream(path, rows_per_batch=128)
+    it = s()
+    first = next(it)
+    np.testing.assert_array_equal(s.read_batch(3), x[384:512])
+    np.testing.assert_array_equal(first, x[:128])
+    rest = np.concatenate([first] + list(it))
+    np.testing.assert_array_equal(rest, x)
+    s.close()
+
+
+def test_ranged_reads_from_threads(npy_file):
+    from concurrent.futures import ThreadPoolExecutor
+
+    path, x = npy_file
+    s = NativePrefetchStream(path, rows_per_batch=128)
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        got = list(ex.map(s.read_batch, range(8)))
+    np.testing.assert_array_equal(np.concatenate(got), x)
+    s.close()
+
+
+def test_spill_fit_over_native_loader_bit_exact(npy_file):
+    # RANGED protocol end-to-end: the pass-persistent spill ring stages
+    # off the pread path and stays bit-exact with plain streaming.
+    path, x = npy_file
+    base = streamed_kmeans_fit(NativePrefetchStream(path, 200), 4, 6,
+                               init=x[:4], max_iters=3, tol=-1.0)
+    s = NativePrefetchStream(path, 200)
+    res = streamed_kmeans_fit(s, 4, 6, init=x[:4], max_iters=3, tol=-1.0,
+                              residency="spill")
+    np.testing.assert_array_equal(
+        np.asarray(base.centroids), np.asarray(res.centroids)
+    )
+    assert res.h2d is not None and res.h2d.cross_pass > 0
+    s.close()

@@ -1,0 +1,478 @@
+"""Object-store data plane (data/manifest.py + data/store.py).
+
+The contract under test:
+- a manifest whose totals, geometry, or CRC counts lie is refused at
+  LOAD, loudly — never discovered as a hung collective mid-pass;
+- `assign_batches` hands N gang processes disjoint, covering, contiguous
+  batch ranges with zero coordination, and refuses the layouts that
+  would desynchronize the per-batch collectives (NB % P != 0, ragged
+  tails in gang mode);
+- ManifestStream speaks the full streamed-driver protocol (sequential
+  `__call__`, ranged `read_batch`, sizing hints) over both backends, and
+  the file:// and HTTP-range paths produce bit-identical fits;
+- a CRC bit-flip or a verifiably short blob becomes CorruptBatch →
+  zero-mass quarantine (bit-exact with dropping the batch), while the
+  transfer-level faults the flaky HTTP server injects (5xx, Retry-After
+  429s, stalled sockets, truncated bodies) ride the transparent
+  retry ladder;
+- mid-pass checkpoint resume through a ManifestStream is bit-identical
+  to the uninterrupted run.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from tdc_tpu.data import store as store_lib
+from tdc_tpu.data.ingest import CorruptBatch, IngestPolicy
+from tdc_tpu.data.loader import NpzStream
+from tdc_tpu.data.manifest import (
+    MANIFEST_NAME,
+    Manifest,
+    ShardSpec,
+    assign_batches,
+    build_manifest,
+    parse_manifest,
+)
+from tdc_tpu.data.store import (
+    FileStore,
+    HTTPRangeStore,
+    ManifestStream,
+    StoreCounter,
+    StoreHTTPError,
+    StoreShortBlob,
+    fetch_manifest,
+    open_manifest_stream,
+    resolve_url,
+)
+from tdc_tpu.models.streaming import streamed_kmeans_fit
+from tdc_tpu.testing.flaky_http import FlakyHTTPServer
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _data(n=960, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=8, size=(6, d)).astype(np.float32)
+    x = centers[rng.integers(0, 6, n)] + rng.normal(size=(n, d)).astype(
+        np.float32
+    )
+    return x.astype(np.float32)
+
+
+def _events(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture
+def runlog(tmp_path, monkeypatch):
+    path = tmp_path / "runlog.jsonl"
+    monkeypatch.setenv("TDC_RUNLOG", str(path))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Manifest integrity: refused at load, loudly
+# ---------------------------------------------------------------------------
+
+
+class TestManifestIntegrity:
+    def _doc(self, **over):
+        x = _data(480, 4, seed=1)
+        doc = {
+            "version": 1, "dtype": "float32", "d": 4, "n_rows": 480,
+            "batch_rows": 120,
+            "shards": [
+                {"blob": "a.bin", "rows": 240, "offset": 0,
+                 "crcs": [1, 2]},
+                {"blob": "b.bin", "rows": 240, "offset": 0,
+                 "crcs": [3, 4]},
+            ],
+        }
+        doc.update(over)
+        return doc
+
+    def test_roundtrip(self, tmp_path):
+        x = _data(500, 4, seed=2)
+        path = build_manifest(x, 120, str(tmp_path), n_shards=2)
+        with open(path) as f:
+            m = parse_manifest(json.load(f))
+        assert m.n_rows == 500 and m.d == 4 and m.batch_rows == 120
+        assert m.num_batches == 5  # ragged 20-row tail batch
+        assert sum(s.rows for s in m.shards) == 500
+
+    def test_clean_doc_parses(self):
+        m = parse_manifest(self._doc())
+        assert m.num_batches == 4 and len(m.shards) == 2
+
+    def test_version_mismatch_refused(self):
+        with pytest.raises(ValueError, match="version"):
+            parse_manifest(self._doc(version=2))
+
+    def test_totals_lie_refused(self):
+        with pytest.raises(ValueError, match="totals lie"):
+            parse_manifest(self._doc(n_rows=481))
+
+    def test_crc_count_mismatch_refused(self):
+        doc = self._doc()
+        doc["shards"][0]["crcs"] = [1]  # 240 rows / 120 needs 2
+        with pytest.raises(ValueError, match="CRC"):
+            parse_manifest(doc)
+
+    def test_batch_straddling_shard_refused(self):
+        # A non-final shard not a whole number of batches would make one
+        # read_batch span two blobs.
+        doc = self._doc()
+        doc["shards"][0].update(rows=200, crcs=[1, 2])
+        doc["shards"][1].update(rows=280, crcs=[3, 4, 5])
+        with pytest.raises(ValueError, match="straddle"):
+            parse_manifest(doc)
+
+    def test_malformed_document_refused(self):
+        with pytest.raises(ValueError, match="malformed manifest"):
+            parse_manifest(self._doc(shards=[{"blob": "a.bin"}]))
+
+    def test_non_json_manifest_refused(self, tmp_path):
+        p = tmp_path / MANIFEST_NAME
+        p.write_text("not json {")
+        with pytest.raises(ValueError, match="not JSON"):
+            open_manifest_stream(str(tmp_path))
+
+    def test_locate_spans_shards_with_offsets(self):
+        m = Manifest(
+            dtype=np.dtype(np.float32), d=4, n_rows=480, batch_rows=120,
+            shards=(
+                ShardSpec("a.bin", 240, 64, (7, 8)),
+                ShardSpec("b.bin", 240, 0, (9, 10)),
+            ),
+        ).validate()
+        s, off, rows, crc = m.locate(0)
+        assert s.blob == "a.bin" and off == 64 and rows == 120 and crc == 7
+        s, off, rows, crc = m.locate(1)
+        assert s.blob == "a.bin" and off == 64 + 120 * 16 and crc == 8
+        s, off, rows, crc = m.locate(3)
+        assert s.blob == "b.bin" and off == 120 * 16 and crc == 10
+        with pytest.raises(IndexError):
+            m.locate(4)
+
+
+# ---------------------------------------------------------------------------
+# Zero-coordination gang assignment
+# ---------------------------------------------------------------------------
+
+
+class TestAssignment:
+    @pytest.mark.parametrize("procs", [1, 2, 4])
+    @pytest.mark.parametrize("n_batches", [4, 8, 12])
+    def test_disjoint_and_covering(self, procs, n_batches):
+        ranges = [assign_batches(n_batches, procs, p)
+                  for p in range(procs)]
+        seen = [g for r in ranges for g in r]
+        assert sorted(seen) == list(range(n_batches))  # disjoint + cover
+        assert len({len(r) for r in ranges}) == 1  # equal local counts
+
+    def test_indivisible_refused_with_deadlock_reason(self):
+        with pytest.raises(ValueError, match="deadlock"):
+            assign_batches(10, 4, 0)
+
+    def test_bad_process_index_refused(self):
+        with pytest.raises(ValueError, match="out of range"):
+            assign_batches(8, 2, 2)
+
+    def test_gang_stream_assignment_uneven_shards(self, tmp_path):
+        # Shard boundaries are irrelevant to assignment: 3 uneven shards,
+        # 8 batches, 2 procs — each proc still gets a contiguous half.
+        x = _data(960, 6, seed=3)
+        build_manifest(x, 120, str(tmp_path), shard_rows=[480, 240, 240])
+        local = []
+        for p in range(2):
+            s = open_manifest_stream(str(tmp_path), process_index=p,
+                                     num_processes=2)
+            assert s.disjoint_shards and s.num_batches == 4
+            assert s.n_rows == 480
+            got = np.concatenate([s.read_batch(i) for i in range(4)])
+            local.append(got)
+            s.close()
+        np.testing.assert_array_equal(np.concatenate(local), x)
+
+    def test_gang_refuses_ragged_tail(self, tmp_path):
+        # 430 rows / 120 = 4 batches (divisible by 2 procs) with a
+        # 70-row tail — equal batch COUNTS, unequal local rows per batch.
+        x = _data(430, 4, seed=4)
+        build_manifest(x, 120, str(tmp_path))
+        with pytest.raises(ValueError, match="ragged tail"):
+            open_manifest_stream(str(tmp_path), process_index=0,
+                                 num_processes=2)
+        # single-process mode streams the ragged tail fine
+        s = open_manifest_stream(str(tmp_path))
+        assert s.num_batches == 4 and s.n_rows == 430
+        s.close()
+
+    def test_spec_driven_placement_single_process(self, tmp_path):
+        # process_scale == 1 (single process / K-sharded layouts): every
+        # batch, no disjoint splitting.
+        from tdc_tpu.parallel.mesh import make_mesh
+        from tdc_tpu.parallel.meshspec import MeshSpec
+
+        x = _data(480, 4, seed=5)
+        build_manifest(x, 120, str(tmp_path))
+        mesh = make_mesh(1)
+        s = open_manifest_stream(str(tmp_path), spec=MeshSpec.of(mesh))
+        assert not s.disjoint_shards and s.num_batches == 4
+        s.close()
+        with pytest.raises(ValueError, match="not both"):
+            open_manifest_stream(str(tmp_path), spec=MeshSpec.of(mesh),
+                                 process_index=0)
+
+
+# ---------------------------------------------------------------------------
+# Stream protocol + backends
+# ---------------------------------------------------------------------------
+
+
+class TestManifestStream:
+    def test_sequential_and_ranged_parity(self, tmp_path, runlog):
+        x = _data(600, 6, seed=6)
+        build_manifest(x, 150, str(tmp_path), n_shards=2)
+        s = open_manifest_stream(str(tmp_path))
+        np.testing.assert_array_equal(np.concatenate(list(s())), x)
+        np.testing.assert_array_equal(
+            np.concatenate([s.read_batch(i) for i in range(4)]), x)
+        # sizing protocol for the residency planner
+        assert s.n_rows == 600 and s.batch_rows == 150
+        assert s.itemsize == 4 and s.dtype == np.float32
+        ev = [e for e in _events(runlog) if e["event"] == "manifest_open"]
+        assert ev and ev[0]["num_batches"] == 4 and ev[0]["shards"] == 2
+        s.close()
+
+    def test_fetch_manifest_geometry_probe(self, tmp_path):
+        x = _data(480, 4, seed=7)
+        build_manifest(x, 120, str(tmp_path))
+        m = fetch_manifest(str(tmp_path))
+        assert (m.n_rows, m.d, m.batch_rows) == (480, 4, 120)
+
+    def test_resolve_url(self):
+        assert resolve_url("m.json", "http://h:1/b") == "http://h:1/b/m.json"
+        assert resolve_url("m.json", "/data/") == "/data/m.json"
+        assert resolve_url("http://x/m.json", "/d") == "http://x/m.json"
+        assert resolve_url("/abs/m.json", "/d") == "/abs/m.json"
+        assert resolve_url("m.json", None) == "m.json"
+
+    def test_unknown_scheme_refused(self):
+        with pytest.raises(ValueError, match="scheme"):
+            open_manifest_stream("s3://bucket/manifest.json")
+
+    def test_http_bit_identical_to_file(self, tmp_path):
+        x = _data(600, 6, seed=8)
+        build_manifest(x, 150, str(tmp_path), n_shards=3)
+        via_file = np.concatenate(
+            list(open_manifest_stream(str(tmp_path))()))
+        with FlakyHTTPServer(str(tmp_path)) as url:
+            s = open_manifest_stream(url + "/" + MANIFEST_NAME)
+            via_http = np.concatenate(list(s()))
+            s.close()
+        np.testing.assert_array_equal(via_file, via_http)
+
+    def test_store_counter_books_reads_and_bytes(self, tmp_path):
+        x = _data(480, 4, seed=9)
+        build_manifest(x, 120, str(tmp_path))
+        c = StoreCounter()
+        s = open_manifest_stream(str(tmp_path), counter=c)
+        list(s())
+        s.close()
+        snap = c.snapshot()
+        assert snap["reads"] == 4 and snap["bytes"] == x.nbytes
+        assert snap["failed"] == 0
+
+    def test_file_store_short_read_is_short_blob(self, tmp_path):
+        (tmp_path / "b.bin").write_bytes(b"\0" * 100)
+        st = FileStore(str(tmp_path))
+        with pytest.raises(StoreShortBlob):
+            st.read_range("b.bin", 0, 200)
+
+    def test_http_416_is_short_blob(self, tmp_path):
+        (tmp_path / "b.bin").write_bytes(b"\0" * 100)
+        with FlakyHTTPServer(str(tmp_path)) as url:
+            st = HTTPRangeStore(url)
+            with pytest.raises(StoreShortBlob):
+                st.read_range("b.bin", 200, 50)
+            st.close()
+
+    def test_http_5xx_carries_status_and_retry_after(self, tmp_path):
+        (tmp_path / "b.bin").write_bytes(b"\0" * 100)
+        with FlakyHTTPServer(str(tmp_path), fail_every=1,
+                             fail_status=503, retry_after=7) as url:
+            st = HTTPRangeStore(url)
+            with pytest.raises(StoreHTTPError) as ei:
+                st.read_range("b.bin", 0, 10)
+            st.close()
+        assert ei.value.status == 503 and ei.value.retry_after == 7.0
+
+
+# ---------------------------------------------------------------------------
+# Corruption → quarantine; transfer faults → transparent retry
+# ---------------------------------------------------------------------------
+
+
+class TestFaultRouting:
+    X = _data(960, 6, seed=10)
+
+    def _built(self, tmp_path, **kw):
+        d = str(tmp_path / "blobs")
+        build_manifest(self.X, 120, d, **kw)
+        return d
+
+    def _fit(self, stream, **kw):
+        kw.setdefault("max_iters", 4)
+        kw.setdefault("tol", -1.0)
+        return streamed_kmeans_fit(stream, 6, 6, init=self.X[:6], **kw)
+
+    def _flip_bit(self, mdir, blob="part-00000.bin", byte=3):
+        p = os.path.join(mdir, blob)
+        raw = bytearray(open(p, "rb").read())
+        raw[byte] ^= 0x10
+        open(p, "wb").write(bytes(raw))
+
+    def test_crc_bit_flip_raises_corrupt(self, tmp_path):
+        mdir = self._built(tmp_path)
+        self._flip_bit(mdir)
+        s = open_manifest_stream(mdir)
+        with pytest.raises(CorruptBatch, match="CRC32 mismatch"):
+            s.read_batch(0)
+        s.close()
+
+    def test_crc_bit_flip_quarantined_equals_removed(self, tmp_path,
+                                                     runlog):
+        mdir = self._built(tmp_path)
+        self._flip_bit(mdir, blob="part-00000.bin", byte=3)  # batch 0
+        res = self._fit(open_manifest_stream(mdir),
+                        ingest=IngestPolicy(max_bad_fraction=0.5))
+        assert res.ingest.quarantined_batches == 1
+        assert res.ingest.quarantined_rows == 120
+
+        def without_batch0():
+            for i in range(1, 8):
+                yield self.X[i * 120:(i + 1) * 120]
+
+        oracle = self._fit(lambda: without_batch0())
+        np.testing.assert_array_equal(
+            np.asarray(res.centroids), np.asarray(oracle.centroids))
+        assert float(res.sse) == float(oracle.sse)
+        ev = [e for e in _events(runlog)
+              if e["event"] == "ingest_quarantine"]
+        # the guard namespaces CorruptBatch verdicts under crc:
+        assert ev and ev[0]["reason"] == "crc:crc_mismatch"
+
+    def test_truncated_blob_on_disk_quarantined(self, tmp_path, runlog):
+        # A blob SHORTER than the manifest claims is corruption, not a
+        # transfer death: quarantine, never an infinite retry.
+        mdir = self._built(tmp_path, n_shards=4)
+        p = os.path.join(mdir, "part-00003.bin")
+        raw = open(p, "rb").read()
+        open(p, "wb").write(raw[:len(raw) // 2])
+        res = self._fit(open_manifest_stream(mdir),
+                        ingest=IngestPolicy(max_bad_fraction=0.5,
+                                            io_retries=2, io_backoff=1e-3))
+        assert res.ingest.quarantined_batches >= 1
+        assert res.ingest.retries == 0  # classified corrupt, not retried
+        ev = [e for e in _events(runlog)
+              if e["event"] == "ingest_quarantine"]
+        assert ev and ev[0]["reason"] == "crc:short_blob"
+
+    def test_strict_default_aborts_on_corruption(self, tmp_path):
+        from tdc_tpu.data.ingest import IngestAbort
+
+        mdir = self._built(tmp_path)
+        self._flip_bit(mdir)
+        with pytest.raises(IngestAbort):
+            self._fit(open_manifest_stream(mdir))
+
+    def test_http_storm_rides_the_retry_ladder(self, tmp_path, runlog):
+        """~1/3 of blob requests 503 (with Retry-After) + one truncated
+        body: the guarded fit is bit-exact with the clean file:// run and
+        every recovery is visible in the report."""
+        mdir = self._built(tmp_path, n_shards=2)
+        base = self._fit(open_manifest_stream(mdir))
+        with FlakyHTTPServer(mdir, fail_every=3, retry_after=0.01,
+                             truncate_requests={5}) as url:
+            res = self._fit(
+                open_manifest_stream(url + "/" + MANIFEST_NAME),
+                ingest=IngestPolicy(io_retries=4, io_backoff=1e-3))
+        assert res.ingest.retries > 0
+        assert res.ingest.quarantined_batches == 0
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids))
+        assert float(base.sse) == float(res.sse)
+
+    def test_stalled_socket_times_out_and_recovers(self, tmp_path):
+        mdir = self._built(tmp_path)
+        with FlakyHTTPServer(mdir, stall_requests={1},
+                             stall_s=1.5) as url:
+            res = self._fit(
+                open_manifest_stream(url + "/" + MANIFEST_NAME,
+                                     timeout=0.3),
+                ingest=IngestPolicy(io_retries=3, io_backoff=1e-3))
+        base = self._fit(open_manifest_stream(mdir))
+        assert res.ingest.retries >= 1
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids))
+
+    def test_persistent_404_fails_loudly_no_retry_storm(self, tmp_path,
+                                                        runlog):
+        mdir = self._built(tmp_path)
+        os.remove(os.path.join(mdir, "part-00000.bin"))
+        with FlakyHTTPServer(mdir) as url:
+            # permanent failures re-raise the ORIGINAL exception type
+            # (the guard's contract) after one loud ingest_failed event
+            with pytest.raises(StoreHTTPError, match="404"):
+                self._fit(
+                    open_manifest_stream(url + "/" + MANIFEST_NAME),
+                    ingest=IngestPolicy(io_retries=5, io_backoff=1e-3))
+        ev = [e for e in _events(runlog) if e["event"] == "ingest_failed"]
+        assert ev and ev[0]["attempts"] == 1  # 404 never retries
+        assert ev[0]["kind"] == "permanent"
+
+    def test_spill_ring_over_manifest_bit_exact(self, tmp_path):
+        # Ranged protocol + producer threads + cross-pass handoff over
+        # the store path, all at once.
+        mdir = self._built(tmp_path, n_shards=2)
+        base = self._fit(open_manifest_stream(mdir))
+        res = self._fit(open_manifest_stream(mdir), residency="spill")
+        np.testing.assert_array_equal(
+            np.asarray(base.centroids), np.asarray(res.centroids))
+        assert res.h2d is not None and res.h2d.cross_pass > 0
+
+    def test_midpass_ckpt_resume_bit_identical(self, tmp_path):
+        from tdc_tpu.utils import preempt
+        from tdc_tpu.utils.preempt import Preempted
+
+        mdir = self._built(tmp_path)
+        full = self._fit(open_manifest_stream(mdir))
+        trip = {"reads": 0}
+        s = open_manifest_stream(mdir)
+        raw_read = s.read_batch
+
+        def tripping_read(i):
+            trip["reads"] += 1
+            if trip["reads"] == 13:  # mid-pass, second iteration
+                preempt.request()
+            return raw_read(i)
+
+        s.read_batch = tripping_read
+        d = str(tmp_path / "ck")
+        preempt.reset()
+        with pytest.raises(Preempted):
+            self._fit(s, ckpt_dir=d, ckpt_every=100,
+                      ckpt_every_batches=3)
+        preempt.reset()
+        resumed = self._fit(open_manifest_stream(mdir), ckpt_dir=d,
+                            ckpt_every=100, ckpt_every_batches=3)
+        np.testing.assert_array_equal(
+            np.asarray(resumed.centroids), np.asarray(full.centroids))
