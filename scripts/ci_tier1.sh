@@ -8,10 +8,11 @@
 #   scripts/ci_tier1.sh            # tests + smokes + verify + lint
 #   SKIP_LINT=1 scripts/ci_tier1.sh
 #
-# Exit code: the FIRST failing stage's code (pytest, then comms smoke,
-# then resident smoke, then spill smoke, then store smoke, then subk
-# smoke, then bounds smoke, then load smoke, then fleet smoke, then obs
-# smoke, then verify, then chaos smoke, then lint), with
+# Exit code: the FIRST failing stage's code (timeout-sync, then pytest,
+# then comms smoke, then resident smoke, then spill smoke, then store
+# smoke, then subk smoke, then bounds smoke, then load smoke, then fleet
+# smoke, then obs smoke, then verify, then chaos smoke, then lint, then
+# the lint-dataflow TDC1xx gate with its seeded self-test), with
 # every failed stage named on stderr — a run where pytest passes but
 # both smokes fail must say so, not silently collapse into one opaque
 # code.
@@ -29,8 +30,18 @@ rm -f "$log"
 # with one concurrent build job (the gloo gang tests serialize badly
 # under load). 1800 = ~2.6x the clean run, so a loaded box flakes the
 # tests themselves before it flakes the timeout; ROADMAP.md's Tier-1
-# command uses the SAME number (reconciled in PR 6 — keep them aligned).
-timeout -k 10 1800 env JAX_PLATFORMS=cpu \
+# command uses the SAME number (reconciled in PR 6). The grep asserts
+# the alignment instead of trusting the comment: editing either side
+# without the other fails the timeout-sync stage below.
+PYTEST_TIMEOUT=1800
+sync_rc=0
+if ! grep -q "timeout -k 10 $PYTEST_TIMEOUT " ROADMAP.md; then
+    echo "ci_tier1: pytest-stage timeout ${PYTEST_TIMEOUT}s does not" \
+         "appear in ROADMAP.md's Tier-1 command — the two are one number" \
+         "by decree (ROADMAP 'Housekeeping'); re-align them" >&2
+    sync_rc=1
+fi
+timeout -k 10 "$PYTEST_TIMEOUT" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --strict-markers \
     --continue-on-collection-errors \
@@ -232,18 +243,69 @@ if [ -z "$SKIP_LINT" ]; then
     fi
 fi
 
+# Gang-divergence dataflow gate (TDC1xx, docs/LINTING.md): two parts.
+# (a) Self-test: seed the PR-18 bug shape (host-local quarantine count
+# into a psum operand, TDC101) and a derived-flag unbalanced branch
+# (TDC103 — the shape the lexical TDC001 rule cannot see) into a
+# scratch file and require the analyzer to flag BOTH with exit 1. A
+# divergence gate that cannot fire is indistinguishable from a clean
+# repo, and a regression in the taint tables would otherwise read as
+# green. (b) The gate itself: the TDC1xx family over tdc_tpu/ with NO
+# baseline — the family was burned to zero at introduction, so every
+# new finding fails immediately (waivers need a justified
+# `# tdclint: disable=` with the reason inline).
+dataflow_rc=0
+if [ -z "$SKIP_LINT" ]; then
+    seed_dir=$(mktemp -d)
+    cat > "$seed_dir/seeded.py" <<'EOF'
+import jax
+
+
+def seeded_tdc101(x, report):
+    pad = report.quarantined_rows
+    return jax.lax.psum(x + pad, "data")
+
+
+def seeded_tdc103(x):
+    is_coord = jax.process_index() == 0
+    if is_coord:
+        x = jax.lax.psum(x, "data")
+    return x
+EOF
+    seed_out=$(timeout -k 10 120 python -m tdc_tpu.lint \
+        --select=TDC101,TDC102,TDC103,TDC104 "$seed_dir" 2>&1)
+    seed_rc=$?
+    if [ "$seed_rc" -ne 1 ] \
+            || ! grep -q "TDC101" <<<"$seed_out" \
+            || ! grep -q "TDC103" <<<"$seed_out"; then
+        echo "ci_tier1: lint-dataflow SELF-TEST failed — seeded" \
+             "TDC101/TDC103 violations not both flagged" \
+             "(exit $seed_rc):" >&2
+        echo "$seed_out" >&2
+        dataflow_rc=1
+    fi
+    rm -rf "$seed_dir"
+    if [ "$dataflow_rc" -eq 0 ]; then
+        timeout -k 10 120 python -m tdc_tpu.lint \
+            --select=TDC101,TDC102,TDC103,TDC104 tdc_tpu/ \
+            || dataflow_rc=$?
+    fi
+fi
+
 # First-failure exit, every failure named: the old cascade exited with
 # whichever stage happened to be checked first and said nothing about
 # the rest — "exit 1" with pytest green left comms vs chaos ambiguous.
 overall=0
-for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
+for stage in "timeout-sync:$sync_rc" "pytest:$pytest_rc" \
+             "comms-smoke:$comms_rc" \
              "resident-smoke:$resident_rc" "spill-smoke:$spill_rc" \
              "store-smoke:$store_rc" \
              "subk-smoke:$subk_rc" "bounds-smoke:$bounds_rc" \
              "load-smoke:$load_rc" "fleet-smoke:$fleet_rc" \
              "obs-smoke:$obs_rc" \
              "verify:$verify_rc" "chaos-smoke:$chaos_rc" \
-             "tdclint:$lint_rc" "ruff:$ruff_rc"; do
+             "tdclint:$lint_rc" "lint-dataflow:$dataflow_rc" \
+             "ruff:$ruff_rc"; do
     name=${stage%%:*}
     rc=${stage##*:}
     if [ "$rc" -ne 0 ]; then
@@ -252,6 +314,6 @@ for stage in "pytest:$pytest_rc" "comms-smoke:$comms_rc" \
     fi
 done
 if [ "$overall" -eq 0 ]; then
-    echo "ci_tier1: all stages green (pytest, comms-smoke, resident-smoke, spill-smoke, store-smoke, subk-smoke, bounds-smoke, load-smoke, fleet-smoke, obs-smoke, verify, chaos-smoke, lint)" >&2
+    echo "ci_tier1: all stages green (timeout-sync, pytest, comms-smoke, resident-smoke, spill-smoke, store-smoke, subk-smoke, bounds-smoke, load-smoke, fleet-smoke, obs-smoke, verify, chaos-smoke, lint, lint-dataflow)" >&2
 fi
 exit "$overall"

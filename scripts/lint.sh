@@ -4,7 +4,10 @@
 #
 #   scripts/lint.sh                      # gate against the baseline
 #   scripts/lint.sh --format=github      # CI annotations
-#   scripts/lint.sh --write-baseline     # shrink the baseline after fixes
+#   scripts/lint.sh --write-baseline     # full baseline regeneration
+#   scripts/lint.sh --prune-baseline     # shrink-only: drop stale entries
+#                                        # after fixing findings (stale
+#                                        # entries FAIL the gated run)
 #   scripts/lint.sh path/to/file.py      # spot-check specific paths
 #   scripts/lint.sh --verify [args...]   # the tdcverify IR-audit stage
 #                                        # instead (python -m

@@ -14,8 +14,12 @@ Workflow (docs/LINTING.md):
     # fix findings, then shrink the baseline:
     python -m tdc_tpu.lint --baseline=... --write-baseline tdc_tpu/ tests/
 
-Stale entries (fingerprints no longer matching any finding) are reported
-as a non-gating notice so the file gets regenerated rather than rotting.
+Stale entries (fingerprints no longer matching any finding) FAIL the
+gated full run: a fixed finding whose baseline entry lingers is headroom
+a regression could silently spend — `--prune-baseline` rewrites the file
+down to the entries that still match, and CI stays red until someone
+does. Partial runs (path or rule subsets) never judge staleness: most
+entries trivially match nothing there.
 """
 
 from __future__ import annotations
@@ -23,7 +27,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from tdc_tpu.lint.engine import Finding
 
@@ -46,7 +50,10 @@ def fingerprint(f: Finding) -> str:
 class BaselineResult:
     new: list[Finding]  # findings NOT covered by the baseline — these gate
     grandfathered: int  # findings absorbed by the baseline
-    stale: list[str]  # baseline fingerprints with no matching finding
+    stale: list[str]  # fingerprints with unspent budget — gate on full runs
+    # findings the baseline absorbed — exactly what --prune-baseline
+    # rewrites the file from (multiplicity preserved by construction)
+    matched: list[Finding] = field(default_factory=list)
 
 
 def normalize_paths(paths: list[str]) -> list[str]:
@@ -83,16 +90,18 @@ def apply(findings: list[Finding], baseline: dict) -> BaselineResult:
     }
     used: dict[str, int] = {}
     new: list[Finding] = []
+    matched: list[Finding] = []
     grandfathered = 0
     for f in findings:
         fp = fingerprint(f)
         if used.get(fp, 0) < budget.get(fp, 0):
             used[fp] = used.get(fp, 0) + 1
             grandfathered += 1
+            matched.append(f)
         else:
             new.append(f)
     stale = sorted(fp for fp, n in budget.items() if used.get(fp, 0) < n)
-    return BaselineResult(new, grandfathered, stale)
+    return BaselineResult(new, grandfathered, stale, matched)
 
 
 def write(path: str, findings: list[Finding],

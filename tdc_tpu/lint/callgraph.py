@@ -1,0 +1,544 @@
+"""Whole-program half of the TDC1xx gang-divergence analyzer.
+
+`tdc_tpu.lint.dataflow` solves one function at a time; this module makes
+those solutions compose across the package:
+
+1. **Index**: every linted file is walked once — module-level functions,
+   class methods, nested defs, import aliases, `@jax.jit` /
+   `@partial(jax.jit, static_arg*)` decorations, and module-level
+   `g_jit = jax.jit(g, static_arg*)` wrapper assignments.
+2. **Summaries to fixpoint**: each function (and each module body, as a
+   pseudo-function providing its module's global environment) is
+   analyzed with the *current* summaries of its resolved callees; when a
+   summary changes, its callers re-queue. Counters are capped, joins are
+   unions — monotone, so the worklist terminates (recursion included).
+3. **Report**: one final pass re-runs transfers over the solved
+   environments with emission on (TDC101 sinks, TDC104 static-arg
+   forks), then walks loop and branch headers for the control-flow
+   sinks: TDC102 (tainted trip count / break guard of a
+   collective-bearing loop) and TDC103 (tainted branch whose arms issue
+   different collective multisets, callee-inclusive).
+
+Call resolution is deliberately conservative: lexical scope (nested
+defs), `self.`/`cls.` within the enclosing class, module-level names,
+and import aliases — never a global "same last segment" match. An
+unresolved call degrades to the pure-function assumption (result taint =
+union of input taints), which keeps the analysis sound for
+value-tracking without inventing edges.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter, deque
+from dataclasses import dataclass, field, replace
+
+from tdc_tpu.lint import dataflow as df
+from tdc_tpu.lint.engine import call_name, dotted_name, last_seg, str_const
+
+EMPTY = df.EMPTY
+
+_AMBIGUOUS = object()  # two indexed modules share a dotted suffix
+
+
+# --------------------------------------------------------------------------
+# Index records
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class FunctionInfo:
+    qual: str               # unique id: "<modname>:<Outer.inner>"
+    path: str
+    node: object            # FunctionDef/AsyncFunctionDef; None = module body
+    body: list
+    params: tuple
+    jitted: bool = False
+    static_params: frozenset = EMPTY
+    static_names: frozenset = EMPTY
+    parent: str | None = None   # enclosing function's qual (nested defs)
+    cls: str | None = None      # enclosing class name (methods + their nested)
+    nested: dict = field(default_factory=dict)  # name -> qual
+    local_names: frozenset = EMPTY
+    summary: df.Summary = field(default_factory=df.Summary)
+    analysis: object = None
+    is_module: bool = False
+
+
+@dataclass
+class ModuleInfo:
+    modname: str
+    path: str
+    tree: ast.AST
+    alias: dict = field(default_factory=dict)    # local name -> dotted target
+    top: dict = field(default_factory=dict)      # name -> qual
+    classes: dict = field(default_factory=dict)  # cls -> {method -> qual}
+    overlays: dict = field(default_factory=dict)
+    # name -> (target_qual, static_params, static_names): jit wrappers
+    env: dict = field(default_factory=dict)      # solved global taint env
+    body_qual: str = ""
+
+
+def _modname_for(path: str) -> list[str]:
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [x for x in p.split("/") if x not in ("", ".", "..")]
+    if parts and parts[-1] == "__init__":
+        parts.pop()
+    return parts
+
+
+def _static_kwargs(keywords) -> tuple[frozenset, frozenset]:
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.add(e.value)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                s = str_const(e)
+                if s:
+                    names.add(s)
+    return frozenset(nums), frozenset(names)
+
+
+def _jit_decoration(func) -> tuple[bool, frozenset, frozenset]:
+    """(jitted, static positions, static names) from the decorator list."""
+    jitted = False
+    nums: frozenset = frozenset()
+    names: frozenset = frozenset()
+    for dec in func.decorator_list:
+        if isinstance(dec, ast.Call):
+            seg = last_seg(call_name(dec))
+            if seg == "jit":
+                jitted = True
+                n2, s2 = _static_kwargs(dec.keywords)
+                nums, names = nums | n2, names | s2
+            elif seg == "partial" and dec.args and \
+                    last_seg(dotted_name(dec.args[0])) == "jit":
+                jitted = True
+                n2, s2 = _static_kwargs(dec.keywords)
+                nums, names = nums | n2, names | s2
+        elif last_seg(dotted_name(dec)) == "jit":
+            jitted = True
+    return jitted, nums, names
+
+
+# --------------------------------------------------------------------------
+# The program
+# --------------------------------------------------------------------------
+
+_MAX_PASS_FACTOR = 12  # fixpoint safety valve: N functions get 12N analyses
+
+
+class Program:
+    """Index + summary fixpoint + reporting over a set of parsed files."""
+
+    def __init__(self, files):
+        """files: iterable of (path, ast.Module[, uniform_lines]) —
+        uniform_lines are the justified-TDC10x-waiver lines where source
+        tags are cleared (see rules_taint.uniform_lines)."""
+        self.funcs: dict[str, FunctionInfo] = {}
+        self.modules: dict[str, ModuleInfo] = {}       # keyed by path
+        self.modules_by_name: dict[str, object] = {}   # dotted suffix -> mod
+        self.callers: dict[str, set] = {}
+        self.uniform: dict[str, frozenset] = {}
+        for entry in files:
+            path, tree = entry[0], entry[1]
+            self.uniform[path] = frozenset(
+                entry[2]) if len(entry) > 2 else frozenset()
+            self._index_module(path, tree)
+
+    # -- indexing ---------------------------------------------------------
+
+    def _index_module(self, path: str, tree: ast.AST) -> None:
+        parts = _modname_for(path)
+        modname = ".".join(parts)
+        mod = ModuleInfo(modname=modname, path=path, tree=tree,
+                         body_qual=f"{modname}:<module>")
+        self.modules[path] = mod
+        for i in range(len(parts)):
+            suffix = ".".join(parts[i:])
+            if suffix in self.modules_by_name and \
+                    self.modules_by_name[suffix] is not mod:
+                self.modules_by_name[suffix] = _AMBIGUOUS
+            else:
+                self.modules_by_name[suffix] = mod
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.alias[alias.asname] = alias.name
+                    else:
+                        first = alias.name.split(".")[0]
+                        mod.alias[first] = first
+            elif isinstance(node, ast.ImportFrom):
+                base = parts[:-node.level] if node.level else []
+                if node.module:
+                    base = base + node.module.split(".")
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    mod.alias[alias.asname or alias.name] = \
+                        ".".join(base + [alias.name])
+
+        # module body pseudo-function
+        self.funcs[mod.body_qual] = FunctionInfo(
+            qual=mod.body_qual, path=path, node=None, body=list(tree.body),
+            params=(), local_names=df.assigned_names(tree.body),
+            is_module=True)
+
+        def walk(body, prefix, parent_qual, cls_name, register):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{modname}:{prefix}{stmt.name}"
+                    jitted, nums, names = _jit_decoration(stmt)
+                    fi = FunctionInfo(
+                        qual=qual, path=path, node=stmt,
+                        body=list(stmt.body),
+                        params=df.param_names(stmt),
+                        jitted=jitted, static_params=nums,
+                        static_names=names, parent=parent_qual,
+                        cls=cls_name,
+                        local_names=df.assigned_names(stmt.body))
+                    self.funcs[qual] = fi
+                    register(stmt.name, qual)
+                    walk(stmt.body, prefix + stmt.name + ".", qual,
+                         cls_name, lambda n, q, fi=fi: fi.nested.update(
+                             {n: q}))
+                elif isinstance(stmt, ast.ClassDef) and parent_qual is None:
+                    methods = mod.classes.setdefault(stmt.name, {})
+                    walk(stmt.body, prefix + stmt.name + ".", None,
+                         stmt.name, lambda n, q, m=methods: m.update(
+                             {n: q}))
+
+        walk(tree.body, "", None, None,
+             lambda n, q: mod.top.update({n: q}))
+
+        # module-level jit wrapper assignments: g_jit = jax.jit(g, ...)
+        for stmt in tree.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)
+                    and last_seg(call_name(stmt.value)) == "jit"
+                    and stmt.value.args
+                    and isinstance(stmt.value.args[0], ast.Name)):
+                continue
+            target_qual = mod.top.get(stmt.value.args[0].id)
+            if target_qual is None:
+                continue
+            nums, names = _static_kwargs(stmt.value.keywords)
+            mod.overlays[stmt.targets[0].id] = (target_qual, nums, names)
+
+    # -- call resolution --------------------------------------------------
+
+    def _find_by_dotted(self, dotted: str):
+        """'pkg.mod.func' or 'pkg.mod.Cls.meth' -> qual, via the longest
+        indexed module prefix."""
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = self.modules_by_name.get(".".join(parts[:cut]))
+            if mod is None or mod is _AMBIGUOUS:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                if rest[0] in mod.overlays:
+                    return ("overlay", mod.overlays[rest[0]])
+                if rest[0] in mod.top:
+                    return ("fn", mod.top[rest[0]], 0)
+            if len(rest) == 2:
+                q = mod.classes.get(rest[0], {}).get(rest[1])
+                if q:
+                    return ("fn", q, 0)  # unbound Cls.meth(obj, ...): no shift
+            return None
+        return None
+
+    def _resolve(self, call: ast.Call, finfo: FunctionInfo):
+        """-> ('fn', qual, shift) | ('overlay', (qual, nums, names)) | None"""
+        name = call_name(call)
+        if name is None:
+            return None
+        parts = name.split(".")
+        mod = self.modules.get(finfo.path)
+        if mod is None:
+            return None
+
+        if parts[0] in ("self", "cls") and len(parts) == 2 and finfo.cls:
+            q = mod.classes.get(finfo.cls, {}).get(parts[1])
+            return ("fn", q, 1) if q else None
+
+        if len(parts) == 1:
+            n = parts[0]
+            f = finfo
+            while f is not None:  # lexical chain of nested defs
+                if n in f.nested:
+                    return ("fn", f.nested[n], 0)
+                f = self.funcs.get(f.parent) if f.parent else None
+            if n in mod.overlays:
+                return ("overlay", mod.overlays[n])
+            if n in mod.top:
+                return ("fn", mod.top[n], 0)
+            target = mod.alias.get(n)
+            if target:
+                return self._find_by_dotted(target)
+            return None
+
+        if parts[0] in mod.classes and len(parts) == 2:
+            q = mod.classes[parts[0]].get(parts[1])
+            return ("fn", q, 0) if q else None
+
+        target = mod.alias.get(parts[0])
+        if target:
+            return self._find_by_dotted(".".join([target] + parts[1:]))
+        return None
+
+    def _summary_for(self, resolved) -> tuple[df.Summary, int] | None:
+        if resolved is None:
+            return None
+        if resolved[0] == "overlay":
+            qual, nums, names = resolved[1]
+            base = self.funcs[qual].summary
+            return (replace(base, jitted=True,
+                            static_params=base.static_params | nums,
+                            static_names=base.static_names | names), 0)
+        _, qual, shift = resolved
+        return (self.funcs[qual].summary, shift)
+
+    # -- fixpoint ---------------------------------------------------------
+
+    def solve(self) -> None:
+        order = ([q for q, f in self.funcs.items() if f.is_module]
+                 + [q for q, f in self.funcs.items() if not f.is_module])
+        work = deque(order)
+        queued = set(order)
+        budget = _MAX_PASS_FACTOR * max(1, len(order))
+        while work and budget > 0:
+            budget -= 1
+            qual = work.popleft()
+            queued.discard(qual)
+            finfo = self.funcs[qual]
+            changed = self._analyze(finfo)
+            if changed:
+                for caller in sorted(self.callers.get(qual, ())):
+                    if caller not in queued:
+                        queued.add(caller)
+                        work.append(caller)
+
+    def _analyze(self, finfo: FunctionInfo) -> bool:
+        mod = self.modules[finfo.path]
+
+        def resolver(call):
+            resolved = self._resolve(call, finfo)
+            if resolved is None:
+                return None
+            qual = resolved[1][0] if resolved[0] == "overlay" \
+                else resolved[1]
+            self.callers.setdefault(qual, set()).add(finfo.qual)
+            return self._summary_for(resolved)
+
+        analysis = df.FunctionAnalysis(
+            finfo.body, params=finfo.params,
+            base_env={} if finfo.is_module else mod.env,
+            resolver=resolver, local_names=finfo.local_names,
+            uniform_lines=self.uniform.get(finfo.path, frozenset()))
+        analysis.run()
+        finfo.analysis = analysis
+
+        if finfo.is_module:
+            new_env = analysis.exit_env()
+            if new_env != mod.env:
+                mod.env = new_env
+                # every function in this module inherits the global env
+                for qual, f in self.funcs.items():
+                    if f.path == finfo.path and not f.is_module:
+                        self.callers.setdefault(
+                            finfo.qual, set()).add(qual)
+                return True
+            return False
+
+        new_summary = analysis.summary(
+            jitted=finfo.jitted, static_params=finfo.static_params,
+            static_names=finfo.static_names,
+            callee_collectives=analysis.callee_collective_sets)
+        if new_summary.key() != finfo.summary.key():
+            finfo.summary = new_summary
+            return True
+        finfo.summary = new_summary
+        return False
+
+    # -- reporting --------------------------------------------------------
+
+    def report(self) -> list:
+        """-> [(code, path, node, message)] after solve()."""
+        out: list = []
+        for finfo in self.funcs.values():
+            if finfo.analysis is None:
+                continue
+
+            def report_finding(code, node, message, _f=finfo):
+                out.append((code, _f.path, node, message))
+
+            finfo.analysis.report(report_finding)
+            self._control_sinks(finfo, out)
+        return out
+
+    # -- TDC102 / TDC103 --------------------------------------------------
+
+    def _stmts_collectives(self, finfo: FunctionInfo, stmts: list) -> tuple:
+        c: Counter = Counter()
+        sets: list = []
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue  # not executed here
+                if isinstance(child, ast.Call):
+                    seg = last_seg(call_name(child))
+                    if seg in df.ALL_COLLECTIVES:
+                        c[seg] = min(8, c[seg] + 1)
+                    else:
+                        r = self._summary_for(self._resolve(child, finfo))
+                        if r is not None and r[0].collectives:
+                            sets.append(r[0].collectives)
+                visit(child)
+
+        for stmt in stmts:
+            visit(stmt)
+        return df.merge_collectives(tuple(c.items()), *sets)
+
+    @staticmethod
+    def _has_break(stmts: list) -> bool:
+        def visit(node) -> bool:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.While, ast.For, ast.AsyncFor,
+                                      ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue  # break binds to the inner loop
+                if isinstance(child, ast.Break):
+                    return True
+                if visit(child):
+                    return True
+            return False
+        for stmt in stmts:
+            if isinstance(stmt, ast.Break):
+                return True
+            if not isinstance(stmt, (ast.While, ast.For, ast.AsyncFor,
+                                     ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)) and visit(stmt):
+                return True
+        return False
+
+    def _break_guards(self, loop) -> list:
+        """If-headers inside `loop` (not inside nested loops) whose
+        subtree contains a break of THIS loop."""
+        guards: list = []
+
+        def scan(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor,
+                                     ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, ast.If):
+                    if self._has_break(stmt.body) or \
+                            self._has_break(stmt.orelse):
+                        guards.append(stmt)
+                    scan(stmt.body)
+                    scan(stmt.orelse)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    scan(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    scan(stmt.body)
+                    for h in stmt.handlers:
+                        scan(h.body)
+                    scan(stmt.orelse)
+                    scan(stmt.finalbody)
+
+        scan(loop.body)
+        return guards
+
+    def _control_sinks(self, finfo: FunctionInfo, out: list) -> None:
+        analysis = finfo.analysis
+        idx = {id(n): i for i, n in enumerate(analysis.cfg.nodes)}
+
+        def taint_of(expr, anchor) -> frozenset:
+            nid = idx.get(id(anchor))
+            env = analysis._env_in[nid] if nid is not None else {}
+            return df.real_tags(analysis.eval(expr, dict(env)))
+
+        for node in analysis.cfg.nodes:
+            if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                coll = self._stmts_collectives(finfo, node.body)
+                if not coll:
+                    continue
+                header = node.test if isinstance(node, ast.While) \
+                    else node.iter
+                tags = taint_of(header, node)
+                what = "trip count" if not isinstance(node, ast.While) \
+                    else "loop condition"
+                if tags:
+                    out.append((
+                        "TDC102", finfo.path, node,
+                        f"host-local state ({df.describe_tags(tags)}) "
+                        f"controls the {what} of a loop that issues "
+                        f"collectives ({df.format_collectives(coll)}) — "
+                        "processes disagree on the iteration count and "
+                        "the gang deadlocks mid-collective; agree the "
+                        "count first (process_allgather/psum the "
+                        "driver value)"))
+                    continue
+                for guard in self._break_guards(node):
+                    gtags = taint_of(guard.test, guard)
+                    if gtags:
+                        out.append((
+                            "TDC102", finfo.path, guard,
+                            "host-local state "
+                            f"({df.describe_tags(gtags)}) controls a "
+                            "break out of a loop that issues collectives "
+                            f"({df.format_collectives(coll)}) — one "
+                            "process exits while the rest wait in the "
+                            "collective (gang deadlock); make the exit "
+                            "decision collectively (psum/process_"
+                            "allgather the stop flag, as the drivers' "
+                            "shift-convergence loops do)"))
+            elif isinstance(node, ast.If):
+                tags = taint_of(node.test, node)
+                if not tags:
+                    continue
+                body_c = self._stmts_collectives(finfo, node.body)
+                else_c = self._stmts_collectives(finfo, node.orelse)
+                if body_c != else_c:
+                    out.append((
+                        "TDC103", finfo.path, node,
+                        f"branch condition is host-local "
+                        f"({df.describe_tags(tags)}) and the arms issue "
+                        f"different collectives (then: "
+                        f"{df.format_collectives(body_c)}; else: "
+                        f"{df.format_collectives(else_c)}) — processes "
+                        "take different paths and the collective "
+                        "schedules diverge (the invariant tdcverify "
+                        "proves per golden at the IR level); hoist the "
+                        "collectives out of the branch or agree the "
+                        "condition first"))
+
+
+# --------------------------------------------------------------------------
+# Entry point for the rules
+# --------------------------------------------------------------------------
+
+
+def analyze_program(files) -> list:
+    """files: [(path, tree)] -> [(code, path, node, message)]."""
+    prog = Program(files)
+    prog.solve()
+    return prog.report()

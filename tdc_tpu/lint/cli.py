@@ -1,9 +1,10 @@
 """`python -m tdc_tpu.lint` — the CLI over engine + baseline.
 
-Exit codes: 0 clean (or fully grandfathered/suppressed), 1 findings,
-2 usage error. `--format=github` emits workflow-command annotations;
-`--format=json` is the machine interface (schema tested in
-tests/test_lint.py::test_json_schema).
+Exit codes: 0 clean (or fully grandfathered/suppressed), 1 findings —
+or stale baseline entries on a gated full run (fix them with
+--prune-baseline), 2 usage error. `--format=github` emits
+workflow-command annotations; `--format=json` is the machine interface
+(schema tested in tests/test_lint.py::test_json_schema).
 """
 
 from __future__ import annotations
@@ -78,6 +79,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="rewrite --baseline from the current findings "
                         "(the ratchet: regenerate after fixing, never to "
                         "admit new findings)")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="drop stale --baseline entries (fingerprints no "
+                        "longer matching any finding) and rewrite the "
+                        "file; never admits new findings")
     p.add_argument("--select", metavar="CODES",
                    help="comma-separated rule codes to run (default: all)")
     p.add_argument("--list-rules", action="store_true")
@@ -91,11 +96,18 @@ def main(argv: list[str] | None = None) -> int:
         p.error("no paths given (try: python -m tdc_tpu.lint tdc_tpu/ tests/)")
     if args.write_baseline and not args.baseline:
         p.error("--write-baseline requires --baseline=PATH")
-    if args.write_baseline and args.select:
+    if args.prune_baseline and not args.baseline:
+        p.error("--prune-baseline requires --baseline=PATH")
+    if args.prune_baseline and args.write_baseline:
+        p.error("--prune-baseline and --write-baseline are exclusive "
+                "(prune is the shrink-only subset of write)")
+    if (args.write_baseline or args.prune_baseline) and args.select:
         # A baseline written from a rule subset's findings drops every
         # other rule's grandfathered entries — the rule-selection twin of
         # the partial-path wipe refused below.
-        p.error("--write-baseline cannot be combined with --select "
+        flag = "--write-baseline" if args.write_baseline \
+            else "--prune-baseline"
+        p.error(f"{flag} cannot be combined with --select "
                 "(it would drop every unselected rule's baseline entries)")
 
     select = None
@@ -151,6 +163,10 @@ def main(argv: list[str] | None = None) -> int:
         try:
             base = baseline_mod.load(args.baseline)
         except FileNotFoundError:
+            if args.prune_baseline:
+                print(f"tdclint: --prune-baseline: {args.baseline} not "
+                      "found — nothing to prune", file=sys.stderr)
+                return 2
             print(
                 f"tdclint: baseline {args.baseline} not found — treating "
                 "every finding as new (generate it with --write-baseline)",
@@ -164,8 +180,32 @@ def main(argv: list[str] | None = None) -> int:
             if not full_run:
                 # Partial run (path subset OR rule subset): unmatched
                 # baseline entries are expected, not stale — reporting
-                # them (in any format) steers the user into a
-                # ratchet-wiping partial regeneration.
+                # them (in any format), or letting --prune-baseline act
+                # on them, steers the user into a ratchet-wiping partial
+                # shrink.
+                base_res.stale = []
+                if args.prune_baseline:
+                    print(
+                        f"tdclint: refusing --prune-baseline: "
+                        f"{args.baseline} was generated from paths "
+                        f"{base.get('paths')} but this run lints "
+                        f"{baseline_mod.normalize_paths(args.paths)} — "
+                        "on a partial run most entries trivially match "
+                        "nothing, and pruning them would wipe the "
+                        "ratchet. Re-run with the recorded paths.",
+                        file=sys.stderr,
+                    )
+                    return 2
+            elif args.prune_baseline:
+                removed = len(base_res.stale)
+                baseline_mod.write(args.baseline, base_res.matched,
+                                   args.paths)
+                print(
+                    f"tdclint: baseline {args.baseline} pruned — "
+                    f"{removed} stale fingerprint(s) dropped or shrunk, "
+                    f"{base_res.grandfathered} matched finding(s) kept",
+                    file=sys.stderr,
+                )
                 base_res.stale = []
 
     if args.format == "json":
@@ -187,12 +227,16 @@ def main(argv: list[str] | None = None) -> int:
         print(summary, file=sys.stderr)
         if stale:
             print(
-                "tdclint: stale baseline entries mean findings were fixed "
-                "— shrink the baseline with --write-baseline so the count "
-                "keeps ratcheting down",
+                "tdclint: FAIL — stale baseline entries mean findings "
+                "were fixed but their grandfathered budget lingers "
+                "(headroom a regression could silently spend); shrink "
+                "the file with --prune-baseline",
                 file=sys.stderr,
             )
-    return 1 if findings else 0
+    # Stale entries gate exactly like findings, but only on a full run —
+    # partial runs cleared base_res.stale above.
+    stale_gate = bool(base_res and base_res.stale)
+    return 1 if findings or stale_gate else 0
 
 
 if __name__ == "__main__":

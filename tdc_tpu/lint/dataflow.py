@@ -1,0 +1,839 @@
+"""Intraprocedural taint dataflow for the TDC1xx gang-divergence family.
+
+The lexical TDC001 rule sees a collective *under* a `process_index()`
+branch. The PR-18 padding-correction bug had no such shape: a host-local
+quarantine verdict flowed through plain assignments into a replicated
+scalar that fed the in-graph correction — the taint travelled through
+*dataflow*, and the gang forked silently. This module is the
+value-tracking half of the analyzer that catches that class: a
+per-function control-flow graph built from `ast`, and a worklist taint
+analysis over it. The whole-program half (call graph, per-function
+summaries, fixpoint, finding emission) lives in
+`tdc_tpu.lint.callgraph`; the rule surface is
+`tdc_tpu.lint.rules_taint` (TDC101-TDC104).
+
+Taint domain — a value's taint is a frozenset of *tokens*:
+
+- a `str` source tag, one of the `SOURCE_*` families below: the value
+  observably differs across gang processes (host identity, rank-like
+  env reads, clocks, randomness, quarantine verdicts and retry
+  counters, addressable-shard fetches);
+- a `("param", i)` token: the value derives from the enclosing
+  function's i-th parameter — the ingredient of the interprocedural
+  param→return / param→sink summaries;
+- a `("free", name)` token: the value derives from a free (closure)
+  variable — resolved against the enclosing function's environment at
+  the call site, so taint flows through closures.
+
+What deliberately does NOT taint (the TDC001/TDC002 allowances,
+preserved — pinned by tests/test_lint_dataflow.py):
+
+- `process_count()` / `device_count()` / `axis_size(...)`: gang-uniform
+  by definition;
+- `len(x)`, `.shape`/`.ndim`/`.dtype` metadata: host metadata the
+  drivers' equal-rows contract makes uniform;
+- results of collectives: a psum/all_gather/process_allgather output is
+  gang-AGREED — feeding a host-local value *into* a host-level
+  agreement collective is the PR-18 *fix*, so those calls sanitize;
+- `jax.make_array_from_process_local_data(...)`: the explicit
+  "per-host slices, divergence is the point" staging constructor —
+  the `_valid_arg` fix's shape;
+- batch *data* reads (`read_batch` & co.): the data plane is sharded by
+  design — every host's rows are supposed to differ, and they enter the
+  graph through the staging constructors above. Divergence taint tracks
+  *control* values derived from I/O (verdicts, counters, identity),
+  which is exactly the PR-18 class.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from dataclasses import dataclass, field
+
+from tdc_tpu.lint.engine import call_name, dotted_name, last_seg, str_const
+
+EMPTY: frozenset = frozenset()
+
+# --------------------------------------------------------------------------
+# Collectives (sinks for TDC101, content for TDC102/TDC103)
+# --------------------------------------------------------------------------
+
+# In-graph (traced) collectives: operands must be gang-uniform-or-sharded
+# device values. A *tainted* (host-divergent, replicated) operand is the
+# TDC101 sink: each process traces the same program over different
+# "replicated" bytes and the state forks.
+IN_GRAPH_COLLECTIVES = frozenset({
+    "psum", "psum_scatter", "pmax", "pmin", "pmean",
+    "all_gather", "allgather", "ppermute", "all_to_all", "pshuffle",
+    "tree_psum",
+})
+
+# Host-level agreement collectives: feeding a host-local value in is the
+# FIX (every process contributes, the collective agrees) — they sanitize
+# and are never TDC101 sinks. They still count as collectives for
+# TDC102/TDC103: reaching them divergently deadlocks the gang.
+HOST_COLLECTIVES = frozenset({
+    "process_allgather", "barrier", "sync_global_devices",
+    "broadcast_one_to_all",
+})
+
+ALL_COLLECTIVES = IN_GRAPH_COLLECTIVES | HOST_COLLECTIVES
+
+# --------------------------------------------------------------------------
+# Sources
+# --------------------------------------------------------------------------
+
+# call last-segment -> tag
+SOURCE_CALLS = {
+    "process_index": "process_identity",
+    "getpid": "process_identity",
+    "gethostname": "process_identity",
+    "getfqdn": "process_identity",
+    # host-sharded device state pulled back to THIS host (PR-18's
+    # "device_get of host-sharded data"): the addressable-shard
+    # accessors are per-host by construction. Plain device_get is
+    # pass-through — fetching a collective-agreed scalar is uniform.
+    "addressable_data": "host_shard",
+    # ingest / object-store *verdicts*: transient-failure
+    # classification and integrity screens run on host-local reads.
+    "screen_batch": "quarantine",
+    "classify_error": "quarantine",
+}
+
+# exact dotted names -> tag (time.monotonic, random.random, ...).
+# Matched EXACTLY, not by suffix: `jax.random.choice(key, ...)` is
+# explicit-key PRNG (gang-uniform when the key is) and `np.random.*`
+# generators are seeded — only the stdlib modules under their canonical
+# names are host-divergence sources.
+SOURCE_CALL_TAILS = {
+    "time.time": "clock", "time.time_ns": "clock",
+    "time.monotonic": "clock", "time.monotonic_ns": "clock",
+    "time.perf_counter": "clock", "time.perf_counter_ns": "clock",
+    "datetime.now": "clock", "datetime.utcnow": "clock",
+    "datetime.today": "clock",
+    "random.random": "random", "random.randint": "random",
+    "random.randrange": "random", "random.choice": "random",
+    "random.shuffle": "random", "random.getrandbits": "random",
+    "uuid.uuid1": "random", "uuid.uuid4": "random",
+    "os.urandom": "random",
+    "secrets.token_hex": "random", "secrets.token_urlsafe": "random",
+}
+
+# attribute read -> tag: quarantine verdicts and retry counters are the
+# host-local control outcomes PR-18's bug flowed into the graph.
+SOURCE_ATTRS = {
+    "quarantined": "quarantine",
+    "quarantined_rows": "quarantine",
+    "quarantined_batches": "quarantine",
+    "crc_failures": "quarantine",
+    "retries": "quarantine",
+    "addressable_shards": "host_shard",
+}
+
+# $RANK-style env reads (the TDC001 hint list)
+RANK_ENV_HINTS = ("PROCESS", "RANK", "HOST", "WORKER")
+
+TAG_HELP = {
+    "process_identity": "process_index()/host identity",
+    "clock": "wall-clock reads",
+    "random": "random/uuid",
+    "quarantine": "quarantine verdicts / retry counters",
+    "host_shard": "addressable-shard fetches",
+    "env_rank": "rank-like environment reads",
+}
+
+# --------------------------------------------------------------------------
+# Sanitizers
+# --------------------------------------------------------------------------
+
+SANITIZER_CALLS = frozenset({
+    # gang-uniform by definition (the TDC001 process_count allowance)
+    "process_count", "device_count", "local_device_count",
+    "axis_size", "axis_index_groups",
+    # host metadata (the TDC002 len/.shape allowance)
+    "len",
+    # the explicit per-host-sharded staging constructors: divergence is
+    # declared and the downstream collective agrees it (the _valid_arg
+    # fix)
+    "make_array_from_process_local_data",
+    "host_local_array_to_global_array",
+}) | ALL_COLLECTIVES
+
+METADATA_ATTRS = frozenset({
+    "shape", "ndim", "dtype", "size", "itemsize", "nbytes",
+})
+
+
+def real_tags(taint: frozenset) -> frozenset:
+    return frozenset(t for t in taint if isinstance(t, str))
+
+
+def param_ids(taint: frozenset) -> frozenset:
+    return frozenset(
+        t[1] for t in taint if isinstance(t, tuple) and t[0] == "param")
+
+
+def free_names(taint: frozenset) -> frozenset:
+    return frozenset(
+        t[1] for t in taint if isinstance(t, tuple) and t[0] == "free")
+
+
+def describe_tags(tags) -> str:
+    return ", ".join(sorted(TAG_HELP.get(t, t) for t in tags))
+
+
+# --------------------------------------------------------------------------
+# Function summaries (the interprocedural currency)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Summary:
+    """What a caller needs to know about a function, computed to fixpoint
+    by callgraph.TaintProgram."""
+
+    params: tuple = ()          # parameter names, index order
+    ret: frozenset = EMPTY      # tokens flowing to return/yield
+    sink_params: frozenset = EMPTY  # param indices reaching an in-graph
+    #                               collective operand (transitively)
+    sink_frees: frozenset = EMPTY   # free (closure) names reaching one —
+    #                               resolved in the CALLER's environment
+    collectives: tuple = ()     # sorted ((name, capped-count)) multiset,
+    #                           callee-inclusive
+    jitted: bool = False        # wrapped by jax.jit (decorator)
+    static_params: frozenset = EMPTY   # declared-static positions
+    static_names: frozenset = EMPTY    # declared-static kwarg names
+
+    def key(self):
+        return (self.ret, self.sink_params, self.sink_frees,
+                self.collectives)
+
+    def param_index(self, kw: str) -> int | None:
+        try:
+            return self.params.index(kw)
+        except ValueError:
+            return None
+
+    def has_collective(self) -> bool:
+        return bool(self.collectives)
+
+
+_COUNT_CAP = 8  # recursion-safe multiset cap; arm comparison only needs
+#                 "differs", not exact counts past this
+
+
+def merge_collectives(*multisets) -> tuple:
+    c: Counter = Counter()
+    for m in multisets:
+        for name, n in m:
+            c[name] = min(_COUNT_CAP, c[name] + n)
+    return tuple(sorted(c.items()))
+
+
+def format_collectives(multiset: tuple) -> str:
+    if not multiset:
+        return "none"
+    return ", ".join(f"{name} x{n}" if n > 1 else name
+                     for name, n in multiset)
+
+
+# --------------------------------------------------------------------------
+# Per-function CFG
+# --------------------------------------------------------------------------
+
+
+class CFG:
+    """Control-flow graph over one function body (or module body).
+
+    Nodes are SIMPLE statements plus compound-statement *headers* (the
+    ast.If/While/For node itself — its transfer evaluates the test/iter
+    expression). Edges follow if/else joins, loop back-edges,
+    break/continue, and return/raise exits; try-handlers are entered
+    from every statement of the protected body (coarse but sound for a
+    may-taint analysis)."""
+
+    def __init__(self, body: list):
+        self.nodes: list[ast.AST] = []
+        self.preds: list[set[int]] = []
+        self._loop_stack: list[tuple[list[int], list[int]]] = []
+        # (continue-targets' pred-sets get the ids, break collectors)
+        exits = self._seq(body, {-1})  # -1: virtual entry
+        self.exit_preds = exits
+
+    def _new(self, node: ast.AST, preds: set[int]) -> int:
+        nid = len(self.nodes)
+        self.nodes.append(node)
+        self.preds.append(set(preds))
+        return nid
+
+    def _seq(self, stmts: list, preds: set[int]) -> set[int]:
+        for stmt in stmts:
+            preds = self._stmt(stmt, preds)
+        return preds
+
+    def _stmt(self, stmt: ast.stmt, preds: set[int]) -> set[int]:
+        if isinstance(stmt, (ast.If,)):
+            nid = self._new(stmt, preds)
+            body_exits = self._seq(stmt.body, {nid})
+            else_exits = self._seq(stmt.orelse, {nid})
+            return body_exits | else_exits
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            nid = self._new(stmt, preds)
+            self._loop_stack.append(([nid], []))
+            body_exits = self._seq(stmt.body, {nid})
+            _, breaks = self._loop_stack.pop()
+            # back-edge: body exit (and continues) re-reach the header
+            for p in body_exits:
+                self.preds[nid].add(p)
+            else_exits = self._seq(stmt.orelse, {nid})
+            return {nid} | else_exits | set(breaks)
+        if isinstance(stmt, (ast.Try,)):
+            entry = set(preds)
+            body_start = len(self.nodes)
+            body_exits = self._seq(stmt.body, preds)
+            body_ids = set(range(body_start, len(self.nodes)))
+            handler_exits: set[int] = set()
+            for handler in stmt.handlers:
+                h_preds = entry | body_ids
+                if handler.name:
+                    nid = self._new(handler, h_preds)
+                    h_preds = {nid}
+                handler_exits |= self._seq(handler.body, h_preds)
+            else_exits = self._seq(stmt.orelse, body_exits)
+            out = (body_exits if not stmt.orelse else else_exits) \
+                | handler_exits
+            if stmt.finalbody:
+                out = self._seq(stmt.finalbody, out)
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            nid = self._new(stmt, preds)
+            return self._seq(stmt.body, {nid})
+        if isinstance(stmt, (ast.Break,)):
+            if self._loop_stack:
+                self._loop_stack[-1][1].extend(preds)
+            return set()
+        if isinstance(stmt, (ast.Continue,)):
+            if self._loop_stack:
+                header = self._loop_stack[-1][0][0]
+                self.preds[header] |= preds
+            return set()
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            nid = self._new(stmt, preds)
+            self.exit_like = getattr(self, "exit_like", set())
+            self.exit_like.add(nid)
+            return set()
+        # simple statement (Assign, Expr, FunctionDef, ...)
+        nid = self._new(stmt, preds)
+        return {nid}
+
+
+# --------------------------------------------------------------------------
+# The analysis
+# --------------------------------------------------------------------------
+
+
+class FunctionAnalysis:
+    """One function's (or module body's) taint dataflow.
+
+    `resolver(call) -> (Summary|None, info)` is provided by the call
+    graph; `report_finding(code, node, message)` is set only on the final
+    reporting pass — summary-fixpoint passes run with emission off.
+    """
+
+    def __init__(self, body: list, params: tuple = (),
+                 base_env: dict | None = None, resolver=None,
+                 local_names: frozenset = EMPTY,
+                 uniform_lines: frozenset = EMPTY):
+        self.body = body
+        self.params = params
+        self.base_env = dict(base_env or {})
+        self.resolver = resolver or (lambda call: None)
+        self.report_finding = None
+        # names assigned anywhere in this scope — reads of anything else
+        # are free/global (closure tokens)
+        self.local_names = local_names
+        # lines covered by a JUSTIFIED TDC10x waiver comment: the author
+        # declares values produced there host-uniform-by-construction,
+        # so source tags are cleared (an unjustified waiver clears
+        # nothing — TDC100 flags it instead)
+        self.uniform_lines = uniform_lines
+        self.ret: frozenset = EMPTY
+        self.sink_params: set = set()
+        self.sink_frees: set = set()
+        self.direct_collectives: Counter = Counter()
+        self.callee_collective_sets: list = []
+        self._env_in: list[dict] = []
+        self.cfg: CFG | None = None
+
+    # -- driving ----------------------------------------------------------
+
+    def run(self) -> None:
+        self.cfg = CFG(self.body)
+        n = len(self.cfg.nodes)
+        self._env_in = [dict() for _ in range(n)]
+        self._env_out: list[dict | None] = [None] * n
+        succs: list[list[int]] = [[] for _ in range(n)]
+        for nid in range(n):
+            for p in self.cfg.preds[nid]:
+                if p != -1:
+                    succs[p].append(nid)
+        entry_env = dict(self.base_env)
+        for i, name in enumerate(self.params):
+            entry_env[name] = entry_env.get(name, EMPTY) | {("param", i)}
+        work = list(range(n))
+        queued = set(work)
+        while work:
+            nid = work.pop(0)
+            queued.discard(nid)
+            env = dict(entry_env)
+            for p in self.cfg.preds[nid]:
+                if p == -1:
+                    continue
+                prev = self._env_out[p]
+                if prev:
+                    for k, v in prev.items():
+                        env[k] = env.get(k, EMPTY) | v
+            self._env_in[nid] = env
+            out = dict(env)
+            self._transfer(self.cfg.nodes[nid], out)
+            if self._env_out[nid] != out:
+                self._env_out[nid] = out
+                for succ in succs[nid]:
+                    if succ not in queued:
+                        queued.add(succ)
+                        work.append(succ)
+
+    def exit_env(self) -> dict:
+        """Union of OUT-envs over every node — for module bodies, the
+        global-name environment functions of that module inherit."""
+        env: dict = dict(self.base_env)
+        for out in self._env_out:
+            if out:
+                for k, v in out.items():
+                    env[k] = env.get(k, EMPTY) | v
+        return env
+
+    def report(self, report_finding) -> None:
+        """Re-run transfers over the solved envs with finding emission."""
+        self.report_finding = report_finding
+        try:
+            for nid, node in enumerate(self.cfg.nodes):
+                self._transfer(node, dict(self._env_in[nid]))
+        finally:
+            self.report_finding = None
+
+    def env_at(self, node: ast.AST) -> dict:
+        for nid, n in enumerate(self.cfg.nodes):
+            if n is node:
+                return self._env_in[nid]
+        return {}
+
+    # -- transfer ---------------------------------------------------------
+
+    def _transfer(self, node: ast.AST, env: dict) -> None:
+        if isinstance(node, (ast.Assign,)):
+            taint = self.eval(node.value, env)
+            for tgt in node.targets:
+                self._bind(tgt, taint, env, value=node.value)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._bind(node.target, self.eval(node.value, env), env,
+                           value=node.value)
+        elif isinstance(node, ast.AugAssign):
+            taint = self.eval(node.value, env)
+            if isinstance(node.target, ast.Name):
+                env[node.target.id] = (
+                    env.get(node.target.id, EMPTY)
+                    | self._read(node.target.id, env) | taint)
+            else:
+                self._bind(node.target, taint, env, augment=True)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            taint = self.eval(node.iter, env)
+            self._bind(node.target, taint, env)
+        elif isinstance(node, ast.While):
+            self.eval(node.test, env)
+        elif isinstance(node, ast.If):
+            self.eval(node.test, env)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                taint = self.eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, taint, env)
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                self.ret |= self.eval(node.value, env)
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                self.eval(node.exc, env)
+        elif isinstance(node, ast.ExceptHandler):
+            if node.name:
+                env[node.name] = EMPTY
+        elif isinstance(node, (ast.Expr,)):
+            self.eval(node.value, env)
+        elif isinstance(node, ast.Assert):
+            self.eval(node.test, env)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    env.pop(tgt.id, None)
+        # FunctionDef/ClassDef/Import/Global/Pass: no taint effect here
+        # (nested defs are summarized by the call graph).
+
+    def _bind(self, target: ast.AST, taint: frozenset, env: dict,
+              value: ast.AST | None = None, augment: bool = False) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = (env.get(target.id, EMPTY) | taint
+                              if augment else taint)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elems = None
+            if isinstance(value, (ast.Tuple, ast.List)) and \
+                    len(value.elts) == len(target.elts) and \
+                    not any(isinstance(e, ast.Starred) for e in target.elts):
+                elems = [self.eval(e, env) for e in value.elts]
+            for i, elt in enumerate(target.elts):
+                if isinstance(elt, ast.Starred):
+                    elt = elt.value
+                self._bind(elt, elems[i] if elems is not None else taint,
+                           env)
+        elif isinstance(target, (ast.Attribute, ast.Subscript)):
+            # obj.x = tainted / obj[k] = tainted: taint the whole object
+            # (coarse, monotone)
+            root = target
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Name):
+                env[root.id] = env.get(root.id, EMPTY) \
+                    | self._read(root.id, env) | taint
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, taint, env)
+
+    def _read(self, name: str, env: dict) -> frozenset:
+        if name in env:
+            return env[name]
+        if name in self.base_env:
+            return self.base_env[name]
+        if name not in self.local_names:
+            # free/global variable: a closure token the caller resolves
+            return frozenset({("free", name)})
+        return EMPTY
+
+    # -- expressions ------------------------------------------------------
+
+    def eval(self, node: ast.AST, env: dict) -> frozenset:
+        out = self._eval(node, env)
+        if out and self.uniform_lines and \
+                getattr(node, "lineno", None) in self.uniform_lines:
+            # declared host-uniform-by-construction: drop source tags,
+            # keep the symbolic param/free tokens (they only encode
+            # caller dependence, not divergence)
+            out = frozenset(t for t in out if not isinstance(t, str))
+        return out
+
+    def _eval(self, node: ast.AST, env: dict) -> frozenset:
+        if node is None:
+            return EMPTY
+        if isinstance(node, ast.Constant):
+            return EMPTY
+        if isinstance(node, ast.Name):
+            return self._read(node.id, env)
+        if isinstance(node, ast.NamedExpr):  # walrus
+            taint = self.eval(node.value, env)
+            self._bind(node.target, taint, env)
+            return taint
+        if isinstance(node, ast.Call):
+            return self._call(node, env)
+        if isinstance(node, ast.Attribute):
+            base = self.eval(node.value, env)
+            if node.attr in METADATA_ATTRS:
+                return EMPTY
+            if node.attr in SOURCE_ATTRS:
+                return base | {SOURCE_ATTRS[node.attr]}
+            return base
+        if isinstance(node, ast.Subscript):
+            out = self.eval(node.value, env) | self.eval(node.slice, env)
+            # os.environ["RANK"]-style reads are rank-hint sources too
+            base_name = dotted_name(node.value)
+            key = str_const(node.slice)
+            if base_name and base_name.endswith("environ") and key and \
+                    any(h in key.upper() for h in RANK_ENV_HINTS):
+                out |= {"env_rank"}
+            return out
+        if isinstance(node, ast.BinOp):
+            return self.eval(node.left, env) | self.eval(node.right, env)
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            out = EMPTY
+            for v in node.values:
+                out |= self.eval(v, env)
+            return out
+        if isinstance(node, ast.Compare):
+            out = self.eval(node.left, env)
+            for c in node.comparators:
+                out |= self.eval(c, env)
+            return out
+        if isinstance(node, ast.IfExp):
+            return (self.eval(node.test, env) | self.eval(node.body, env)
+                    | self.eval(node.orelse, env))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = EMPTY
+            for e in node.elts:
+                out |= self.eval(e, env)
+            return out
+        if isinstance(node, ast.Dict):
+            out = EMPTY
+            for k in node.keys:
+                out |= self.eval(k, env)
+            for v in node.values:
+                out |= self.eval(v, env)
+            return out
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            comp_env = dict(env)
+            for gen in node.generators:
+                src = self.eval(gen.iter, comp_env)
+                self._bind(gen.target, src, comp_env)
+                for cond in gen.ifs:
+                    self.eval(cond, comp_env)
+            if isinstance(node, ast.DictComp):
+                return (self.eval(node.key, comp_env)
+                        | self.eval(node.value, comp_env))
+            return self.eval(node.elt, comp_env)
+        if isinstance(node, ast.JoinedStr):
+            out = EMPTY
+            for v in node.values:
+                out |= self.eval(v, env)
+            return out
+        if isinstance(node, ast.FormattedValue):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Yield):
+            if node.value is not None:
+                self.ret |= self.eval(node.value, env)
+            return EMPTY
+        if isinstance(node, ast.Lambda):
+            return EMPTY
+        if isinstance(node, ast.Slice):
+            return (self.eval(node.lower, env) | self.eval(node.upper, env)
+                    | self.eval(node.step, env))
+        # anything else: union over children (sound default)
+        out = EMPTY
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                out |= self.eval(child, env)
+        return out
+
+    # -- calls (sources, sanitizers, sinks, summaries) --------------------
+
+    def _call(self, call: ast.Call, env: dict) -> frozenset:
+        name = call_name(call)
+        seg = last_seg(name)
+        arg_taints = [self.eval(a, env) for a in call.args]
+        kw_taints = {kw.arg: self.eval(kw.value, env)
+                     for kw in call.keywords}
+        all_args = EMPTY
+        for t in arg_taints:
+            all_args |= t
+        for t in kw_taints.values():
+            all_args |= t
+
+        # env reads with rank-like hints are sources
+        if (name or "").endswith("environ.get") or seg == "getenv":
+            key = str_const(call.args[0]) if call.args else None
+            if key and any(h in key.upper() for h in RANK_ENV_HINTS):
+                return all_args | {"env_rank"}
+
+        # intrinsic sources
+        if seg in SOURCE_CALLS:
+            return all_args | {SOURCE_CALLS[seg]}
+        if name is not None and name in SOURCE_CALL_TAILS:
+            return all_args | {SOURCE_CALL_TAILS[name]}
+
+        # in-graph collective: tainted operand is THE TDC101 sink
+        if seg in IN_GRAPH_COLLECTIVES:
+            self.direct_collectives[seg] = min(
+                _COUNT_CAP, self.direct_collectives[seg] + 1)
+            for t in arg_taints[:1] + list(kw_taints.values()):
+                # operand is arg 0 (axis names et al. carry no taint)
+                self._sink_collective_operand(call, seg, t)
+            return EMPTY  # the reduced/gathered result is gang-agreed
+        if seg in HOST_COLLECTIVES:
+            self.direct_collectives[seg] = min(
+                _COUNT_CAP, self.direct_collectives[seg] + 1)
+            return EMPTY  # host-level agreement: the fix, not the bug
+
+        # sanitizers
+        if seg in SANITIZER_CALLS:
+            return EMPTY
+
+        # resolved callee: apply its summary (shift=1 for bound-method
+        # calls, whose param 0 is `self`)
+        resolved = self.resolver(call)
+        if resolved is not None:
+            summary, shift = resolved
+            return self._apply_summary(call, summary, arg_taints,
+                                       kw_taints, env, shift)
+
+        # unknown call: pure-function assumption — taint of the result is
+        # the union of the inputs (and of the callee expression itself,
+        # which makes functools.partial/tainted-closures compose for
+        # free: `partial(f, tainted)` taints the partial object, calling
+        # it taints the result).
+        return all_args | self.eval(call.func, env)
+
+    def _apply_summary(self, call: ast.Call, summary: Summary,
+                       arg_taints: list, kw_taints: dict,
+                       env: dict, shift: int = 0) -> frozenset:
+        if summary.collectives:
+            self.callee_collective_sets.append(summary.collectives)
+        # TDC104: tainted value in a declared-static jit position
+        if summary.static_params or summary.static_names:
+            for i, t in enumerate(arg_taints):
+                if (i + shift) in summary.static_params and real_tags(t):
+                    self._emit_static(call, summary, t)
+            for kw, t in kw_taints.items():
+                if kw is None or not real_tags(t):
+                    continue
+                idx = summary.param_index(kw)
+                if kw in summary.static_names or \
+                        (idx is not None and idx in summary.static_params):
+                    self._emit_static(call, summary, t)
+
+        # param->sink: tainted value handed to a param that reaches an
+        # in-graph collective operand inside the callee (the PR-18 bug's
+        # interprocedural shape)
+        for i, t in enumerate(arg_taints):
+            if (i + shift) in summary.sink_params:
+                self._sink_collective_operand(
+                    call, f"(via parameter {i} of the callee)", t,
+                    via=summary)
+        for kw, t in kw_taints.items():
+            idx = summary.param_index(kw) if kw else None
+            if idx is not None and idx in summary.sink_params:
+                self._sink_collective_operand(
+                    call, f"(via parameter {kw!r} of the callee)", t,
+                    via=summary)
+        # closure->sink: a nested def's collective operand reads a free
+        # variable — the variable lives in THIS scope, so its taint is
+        # only knowable here
+        for free in summary.sink_frees:
+            self._sink_collective_operand(
+                call, f"(via closed-over {free!r} of the callee)",
+                self._read(free, env), via=summary)
+
+        # param->return + closure->return
+        out = frozenset(real_tags(summary.ret))
+        for i in param_ids(summary.ret):
+            if 0 <= i - shift < len(arg_taints):
+                out |= arg_taints[i - shift]
+        for kw, t in kw_taints.items():
+            idx = summary.param_index(kw) if kw else None
+            if idx is not None and idx in param_ids(summary.ret):
+                out |= t
+        for free in free_names(summary.ret):
+            out |= self._read(free, env)
+        return out
+
+    # -- sink plumbing ----------------------------------------------------
+
+    def _sink_collective_operand(self, call: ast.Call, what: str,
+                                 taint: frozenset, via=None) -> None:
+        tags = real_tags(taint)
+        self.sink_params |= param_ids(taint)
+        self.sink_frees |= free_names(taint)
+        if tags and self.report_finding is not None:
+            if via is None:
+                msg = (
+                    f"value derived from host-local state "
+                    f"({describe_tags(tags)}) is an operand of in-graph "
+                    f"collective '{what}' — each process contributes "
+                    "different bytes to a nominally replicated value and "
+                    "the gang state forks silently (the PR-18 "
+                    "padding-correction bug class); agree it through a "
+                    "host-level collective (process_allgather) or stage "
+                    "it explicitly sharded "
+                    "(make_array_from_process_local_data)"
+                )
+            else:
+                msg = (
+                    f"host-local value ({describe_tags(tags)}) flows "
+                    f"into '{call_name(call)}' {what}, which reaches an "
+                    "in-graph collective operand — a replicated scalar "
+                    "fed from per-host state forks the gang's centroid "
+                    "state (the PR-18 bug, interprocedurally); sum it "
+                    "through the device collective instead (see "
+                    "models/streaming._valid_arg)"
+                )
+            self.report_finding("TDC101", call, msg)
+
+    def _emit_static(self, call: ast.Call, summary: Summary,
+                     taint: frozenset) -> None:
+        if self.report_finding is not None:
+            self.report_finding(
+                "TDC104", call,
+                f"host-local value ({describe_tags(real_tags(taint))}) "
+                f"flows into a declared-static argument of jitted "
+                f"'{call_name(call)}' — each process specializes a "
+                "DIFFERENT compiled program (per-host recompile fork): "
+                "static args must be gang-uniform; derive them from "
+                "process_count()/geometry, or make the argument traced",
+            )
+
+    # -- summary export ---------------------------------------------------
+
+    def summary(self, jitted=False, static_params=EMPTY,
+                static_names=EMPTY, callee_collectives=()) -> Summary:
+        return Summary(
+            params=tuple(self.params),
+            ret=self.ret,
+            sink_params=frozenset(self.sink_params),
+            sink_frees=frozenset(self.sink_frees),
+            collectives=merge_collectives(
+                tuple(self.direct_collectives.items()),
+                *callee_collectives),
+            jitted=jitted,
+            static_params=static_params,
+            static_names=static_names,
+        )
+
+
+# --------------------------------------------------------------------------
+# Helpers shared with callgraph/rules
+# --------------------------------------------------------------------------
+
+
+def param_names(func) -> tuple:
+    """Positional(+kwonly) parameter names of a def, index order."""
+    a = func.args
+    names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    names += [p.arg for p in a.kwonlyargs]
+    return tuple(names)
+
+
+def assigned_names(body: list) -> frozenset:
+    """Every name bound anywhere in a scope (assignments, loop targets,
+    withitems, defs, imports, comprehension-free) — reads of anything
+    else are free variables."""
+    out: set[str] = set()
+
+    def visit(stmts):
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    out.add(node.name)
+                elif isinstance(node, ast.Name) and isinstance(
+                        node.ctx, (ast.Store, ast.Del)):
+                    out.add(node.id)
+                elif isinstance(node, ast.ExceptHandler) and node.name:
+                    out.add(node.name)
+                elif isinstance(node, ast.alias):
+                    out.add((node.asname or node.name).split(".")[0])
+                elif isinstance(node, ast.arg):
+                    out.add(node.arg)
+    visit(body)
+    return frozenset(out)

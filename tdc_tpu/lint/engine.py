@@ -253,6 +253,7 @@ def all_rules() -> list:
         FaultPointDrift, MetricNameDrift, NondeterministicCkptPath,
         SpanNameDrift, StructlogEventDrift,
     )
+    from tdc_tpu.lint.rules_taint import taint_rules
 
     return [
         CollectiveDivergence(),
@@ -265,6 +266,9 @@ def all_rules() -> list:
         AxisNameMismatch(),
         MetricNameDrift(),
         SpanNameDrift(),
+        # TDC1xx: the gang-divergence dataflow family — five rules
+        # sharing ONE whole-program taint analysis per run.
+        *taint_rules(),
     ]
 
 

@@ -1,0 +1,253 @@
+"""TDC1xx: the gang-divergence dataflow family.
+
+The TDC00x rules are lexical — TDC001 sees a collective *under* a
+`process_index()` branch. PR 18's bug had no such shape: host-local
+quarantine verdicts flowed through ordinary assignments into a
+replicated scalar feeding the in-graph padding correction, and the
+centroid state forked silently across workers. These rules track the
+*value*: `tdc_tpu.lint.dataflow` solves per-function taint over a CFG,
+`tdc_tpu.lint.callgraph` composes the solutions package-wide, and the
+four sink rules report where host-divergent values meet the gang:
+
+- **TDC101** tainted operand of an in-graph collective (or a parameter
+  that transitively reaches one) — the PR-18 bug, verbatim;
+- **TDC102** tainted trip count / break guard of a collective-bearing
+  loop — gang deadlock;
+- **TDC103** tainted branch whose arms issue different collective
+  multisets — schedule divergence, the static shadow of `tdcverify`'s
+  IR-level schedule goldens;
+- **TDC104** tainted value in a declared-static jit argument — per-host
+  recompile fork.
+
+**TDC100** guards the waiver budget: every `# tdclint: disable=TDC1xx`
+must carry a trailing prose justification — a gang-uniformity invariant
+is waived with a reason or not at all.
+
+All five share ONE whole-program analysis per run: each rule's check()
+registers the file; the first finalize() solves the program once and the
+rules split the findings by code (so `--select=TDC101` still sees the
+whole program — interprocedural findings need every file indexed).
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+
+from tdc_tpu.lint.engine import Finding, _SUPPRESS_RE
+
+_JUSTIFIED_RE = re.compile(r"[A-Za-z]{3,}")
+
+_FAMILY = frozenset({"TDC101", "TDC102", "TDC103", "TDC104"})
+
+
+def uniform_lines(source: str) -> set:
+    """Lines covered by a JUSTIFIED `# tdclint: disable=TDC10x` comment.
+
+    The dataflow layer treats values produced on these lines as
+    host-uniform-by-construction (source tags cleared): a justified
+    waiver placed where a value is *born* declares the whole value
+    clean, instead of needing one suppression at every downstream sink.
+    Unjustified waivers clear nothing — TDC100 flags them, and their
+    findings still fire. Mirrors engine.Suppressions' logical-statement
+    coverage so a trailing comment on a wrapped statement covers every
+    physical line the statement's AST nodes anchor to.
+    """
+    out: set = set()
+    try:
+        stmt_start = None
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.NEWLINE:
+                stmt_start = None
+                continue
+            if tok.type != tokenize.COMMENT:
+                if stmt_start is None and tok.type not in (
+                        tokenize.NL, tokenize.INDENT, tokenize.DEDENT,
+                        tokenize.ENCODING, tokenize.ENDMARKER):
+                    stmt_start = tok.start[0]
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            codes = {c.strip().upper() for c in m.group(2).split(",")}
+            if not (codes & _FAMILY):
+                continue
+            if not _JUSTIFIED_RE.search(tok.string[m.end():]):
+                continue  # bare waiver: no effect (and a TDC100 finding)
+            kind = m.group(1).lower()
+            if kind == "disable-file":
+                out.update(range(1, source.count("\n") + 2))
+            elif kind == "disable-next-line":
+                out.add(tok.start[0] + 1)
+            else:
+                out.update(range(stmt_start or tok.start[0],
+                                 tok.start[0] + 1))
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+class TaintProgram:
+    """Shared per-run state: files registered by check(), solved once."""
+
+    def __init__(self):
+        self.ctxs: dict = {}
+        self._findings: list | None = None
+
+    def add(self, ctx) -> None:
+        self.ctxs[ctx.path] = ctx
+
+    def findings(self) -> list:
+        if self._findings is None:
+            from tdc_tpu.lint.callgraph import analyze_program
+            files = [(path, ctx.tree, uniform_lines(ctx.source))
+                     for path, ctx in sorted(self.ctxs.items())]
+            self._findings = analyze_program(files)
+        return self._findings
+
+
+class _TaintRule:
+    """One code of the shared-program family."""
+
+    def __init__(self, program: TaintProgram):
+        self.program = program
+
+    def check(self, ctx):
+        self.program.add(ctx)
+        return ()
+
+    def finalize(self):
+        for code, path, node, message in self.program.findings():
+            if code != self.code:
+                continue
+            ctx = self.program.ctxs.get(path)
+            if ctx is None:
+                continue
+            yield ctx.finding(self, node, message)
+
+
+class TaintedCollectiveOperand(_TaintRule):
+    code = "TDC101"
+    name = "tainted-collective-operand"
+    description = (
+        "A value derived from host-local state (process_index, rank-like "
+        "env reads, clocks, random/uuid, quarantine verdicts, retry "
+        "counters, addressable-shard fetches) becomes an operand of an "
+        "in-graph collective, directly or through a callee parameter "
+        "that reaches one. Each process contributes different bytes to a "
+        "nominally replicated value and the gang's state forks silently "
+        "— the PR-18 padding-correction bug. Fix: agree the value first "
+        "(process_allgather / psum) or stage it explicitly sharded "
+        "(make_array_from_process_local_data), as "
+        "models/streaming._valid_arg and _agreed_pad do."
+    )
+
+
+class TaintedCollectiveLoop(_TaintRule):
+    code = "TDC102"
+    name = "tainted-collective-loop"
+    description = (
+        "Host-local state controls the trip count or a break guard of a "
+        "loop that issues collectives. Processes disagree on how many "
+        "iterations run, so one side enters a collective the other never "
+        "reaches: the gang deadlocks (or worse, mismatched collectives "
+        "pair up). Fix: make the loop-exit decision collectively — psum "
+        "or process_allgather the driver value/stop flag, as the "
+        "drivers' shift-convergence loops do."
+    )
+
+
+class UnbalancedCollectivePaths(_TaintRule):
+    code = "TDC103"
+    name = "unbalanced-collective-paths"
+    description = (
+        "A branch on host-local state has arms that issue different "
+        "collective multisets — processes take different paths and the "
+        "collective schedules diverge (the invariant tdcverify proves "
+        "per golden entry at the compiled-IR level; this is its static, "
+        "whole-codebase shadow). Branches on gang-uniform values "
+        "(process_count(), config) are fine. Fix: hoist the collectives "
+        "out of the branch, or agree the condition first."
+    )
+
+
+class TaintedStaticJitArg(_TaintRule):
+    code = "TDC104"
+    name = "tainted-static-jit-arg"
+    description = (
+        "Host-local state flows into a declared-static argument "
+        "(static_argnums/static_argnames) of a jitted function. Statics "
+        "are compile-time constants: each process specializes a "
+        "DIFFERENT compiled program, forking compilation caches and — "
+        "if the static steers collective layout — the gang schedule. "
+        "Fix: derive statics from gang-uniform geometry "
+        "(process_count(), mesh shape) or make the argument traced."
+    )
+
+
+class UnjustifiedGangWaiver:
+    """TDC100: a TDC1xx suppression without a trailing prose reason.
+
+    The engine's `_SUPPRESS_RE` anchors the codes group to CODE-shaped
+    tokens precisely so trailing prose reads as justification — this
+    rule makes that prose mandatory for the gang-uniformity family:
+    waiving a divergence finding is a reviewed decision, and the reason
+    belongs next to the waiver, not in a PR description that history
+    forgets.
+    """
+
+    code = "TDC100"
+    name = "unjustified-gang-waiver"
+    description = (
+        "A `# tdclint: disable=TDC1xx` suppression with no trailing "
+        "justification. Gang-uniformity waivers assert a value is "
+        "host-uniform for a reason the analyzer cannot prove — write "
+        "the reason after the code list (e.g. `# tdclint: "
+        "disable=TDC101 mesh geometry, identical on every host`)."
+    )
+
+    def check(self, ctx):
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(ctx.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m is None:
+                    continue
+                codes = {c.strip().upper() for c in m.group(2).split(",")}
+                if not any(c.startswith("TDC1") for c in codes):
+                    continue
+                rest = tok.string[m.end():]
+                if _JUSTIFIED_RE.search(rest):
+                    continue
+                gang = sorted(c for c in codes if c.startswith("TDC1"))
+                yield Finding(
+                    self.code, self.name, ctx.path, tok.start[0],
+                    tok.start[1] + 1,
+                    f"suppression of {', '.join(gang)} carries no "
+                    "justification — a gang-uniformity waiver asserts "
+                    "host-uniformity the analyzer cannot prove; state "
+                    "the reason after the code list "
+                    "(`# tdclint: disable=TDC101 <why this value is "
+                    "identical on every host>`)",
+                    ctx.snippet(tok.start[0]))
+        except (tokenize.TokenError, IndentationError):
+            return
+
+    def finalize(self):
+        return ()
+
+
+def taint_rules() -> list:
+    """The TDC1xx family, sharing one whole-program analysis per run."""
+    program = TaintProgram()
+    return [
+        UnjustifiedGangWaiver(),
+        TaintedCollectiveOperand(program),
+        TaintedCollectiveLoop(program),
+        UnbalancedCollectivePaths(program),
+        TaintedStaticJitArg(program),
+    ]

@@ -145,7 +145,7 @@ class MeshSpec:
 
 
 def _local_count(mesh: Mesh) -> int:
-    pidx = jax.process_index()
+    pidx = jax.process_index()  # tdclint: disable=TDC101 membership count only: every host of a JAX mesh holds the same number of its own devices, so n_local is gang-uniform even though pidx is not
     return sum(d.process_index == pidx for d in mesh.devices.ravel())
 
 

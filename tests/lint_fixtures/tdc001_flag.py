@@ -1,13 +1,21 @@
 """MUST-FLAG TDC001: collectives under host-local branches (each shape
-mirrors a way the PR-3 gang deadlock could re-enter the codebase)."""
+mirrors a way the PR-3 gang deadlock could re-enter the codebase).
+
+The arms carry BALANCED collective multisets on purpose: TDC001 is the
+lexical cop — ANY collective under a host-local guard is flagged, even
+when the counts happen to line up — while the dataflow rule TDC103 only
+fires on *unbalanced* arms (it has its own fixture). Keeping the arms
+balanced here keeps this corpus single-rule."""
 import jax
 
 
 def coordinator_only_reduce(stats):
-    # The canonical deadlock: only process 0 enters the psum; every other
-    # process waits forever at its next collective.
+    # The canonical deadlock shape: the psum a process runs depends on
+    # its identity. (Balanced counts, so only the lexical rule fires.)
     if jax.process_index() == 0:
         stats = jax.lax.psum(stats, "data")
+    else:
+        stats = jax.lax.psum(stats * 0, "data")
     return stats
 
 
@@ -21,7 +29,7 @@ def barrier_in_else(step):
     from tdc_tpu.parallel.multihost import barrier
 
     if jax.process_index() != 0:
-        pass
+        barrier(f"follower_{step}")
     else:
         barrier(f"ckpt_{step}")
 
@@ -31,4 +39,6 @@ def env_targeted(x):
 
     if os.environ.get("TDC_PROCESS_ID") == "0":
         x = jax.lax.pmax(x, "data")
+    else:
+        x = jax.lax.pmax(x * 0, "data")
     return x

@@ -1,0 +1,18 @@
+"""MUST-FLAG TDC100: gang-uniformity waivers with no trailing prose.
+A bare TDC1xx suppression silences a divergence finding without
+recording WHY the value is host-uniform — the family requires the
+reason next to the waiver. (These lines have nothing to suppress; the
+rule polices the waiver itself.)"""
+import jax
+
+TILE = 128  # tdclint: disable=TDC101
+
+
+def warm(x):
+    # tdclint: disable-next-line=TDC102
+    for _ in range(4):
+        x = x + 1.0
+    return jax.numpy.sum(x)
+
+
+# tdclint: disable-file=TDC103,TDC104

@@ -1,0 +1,22 @@
+"""MUST-NOT-FLAG TDC100: justified gang-uniformity waivers (prose after
+the code list) and non-family suppressions, which TDC100 does not
+police."""
+import jax
+
+N_LOCAL = 8  # tdclint: disable=TDC101 devices per host is mesh geometry, identical on every host
+
+
+def windowed(x):
+    # tdclint: disable-next-line=TDC102 trip count is config, not host state
+    for _ in range(4):
+        x = x + 1.0
+    return jax.numpy.sum(x)
+
+
+def shard_bounds(global_rows):
+    n_local = global_rows // jax.process_count()
+    lo = jax.process_index() * n_local  # tdclint: disable=TDC101 offset is used to slice this host's shard only, never fed to a replicated operand
+    return lo, lo + n_local
+
+
+REGISTRY = []  # tdclint: disable=TDC003

@@ -1,0 +1,43 @@
+"""MUST-FLAG TDC101: host-local values reaching in-graph collective
+operands. The first two shapes re-create the PR-18 padding-correction
+bug (host-local quarantine verdicts -> replicated correction scalar)
+that the lexical rules were structurally blind to; the TDC001 fixture
+keeps its collectives under literal process_index() branches, so this
+corpus stays single-rule by never branching on host identity."""
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def stream_pad(stream):
+    # PR-18, direct form: each host counts ITS quarantine verdicts, then
+    # feeds the count to a psum as if it were replicated.
+    pad = 0
+    for batch in stream:
+        pad += batch.quarantined_rows
+    correction = jnp.asarray(pad, jnp.float32)
+    return jax.lax.psum(correction, "data")
+
+
+def _correction(acc, pad_count):
+    frac = pad_count / 128.0
+    return acc - jax.lax.psum(frac, "data")
+
+
+def fit_step(acc, report):
+    # PR-18, interprocedural form: the tainted count crosses a call
+    # boundary before touching the collective — the parameter summary
+    # (pad_count -> psum operand) carries the sink back to this line.
+    dropped = report.quarantined
+    return _correction(acc, dropped)
+
+
+def salted_mean(x):
+    salt = jax.process_index() * 1e-6
+    return jax.lax.pmean(x + salt, "data")
+
+
+def env_weighted(x):
+    w = int(os.environ.get("TDC_WORKER_ID", "0"))
+    return jax.lax.pmax(x * w, "model")

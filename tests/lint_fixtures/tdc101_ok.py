@@ -1,0 +1,42 @@
+"""MUST-NOT-FLAG TDC101: the PR-18 fix idioms and the gang-uniform
+negatives the taint tables must keep clean (process_count, len, shape
+metadata, explicit agreement, explicit sharded staging)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+
+def agreed_pad(stream):
+    # The PR-18 fix: agree the host-local count across the gang BEFORE
+    # it feeds anything replicated. process_allgather sanitizes.
+    pad = 0
+    for batch in stream:
+        pad += batch.quarantined_rows
+    agreed = multihost_utils.process_allgather(np.int64(pad)).sum()
+    return jnp.full((), agreed / 128.0)
+
+
+def staged_shard(mesh, spec):
+    # The other fix: keep the value host-local but STAGE it as an
+    # explicitly sharded global array — the staging call declares the
+    # per-host difference instead of smuggling it.
+    local = jax.process_index() * np.ones((8,), np.float32)
+    arr = multihost_utils.host_local_array_to_global_array(
+        local, mesh, spec)
+    return jax.lax.psum(arr, "data")
+
+
+def geometry_scaled(x):
+    n = jax.process_count()
+    return jax.lax.psum(x / n, "data")
+
+
+def metadata_only(batch, x):
+    rows = batch.shape[0]
+    return jax.lax.pmean(x * rows, "data")
+
+
+def length_scaled(chunks, x):
+    return jax.lax.pmax(x * len(chunks), "model")

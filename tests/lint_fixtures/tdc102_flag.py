@@ -1,0 +1,34 @@
+"""MUST-FLAG TDC102: host-local state deciding how many times a
+collective-bearing loop runs. Each shape is a deadlock: processes
+disagree on the trip count, so one side issues a collective the other
+never reaches."""
+import time
+
+import jax
+
+
+def deadline_refine(x, budget_s):
+    # Wall-clock loop guard: hosts cross the deadline at different
+    # moments, so they run different numbers of psums.
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < budget_s:
+        x = jax.lax.psum(x, "data") / jax.process_count()
+    return x
+
+
+def verdict_polish(x, report):
+    # Trip count from a quarantine counter — each host screened its OWN
+    # batches, so `retries` differs per host.
+    for _ in range(report.retries):
+        x = jax.lax.pmean(x, "data")
+    return x
+
+
+def drain_until_quiet(stream, x):
+    # Tainted BREAK guard inside a collective-bearing loop: the break
+    # fires on host-local CRC verdicts, exiting some hosts early.
+    for batch in stream:
+        x = jax.lax.psum(x + batch.total, "data")
+        if batch.crc_failures:
+            break
+    return x

@@ -1,0 +1,30 @@
+"""MUST-NOT-FLAG TDC102: gang-uniform trip counts — config-driven,
+geometry-driven, and the drivers' fix idiom of agreeing the count
+collectively before looping."""
+import numpy as np
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def fixed_refine(x, n_steps):
+    for _ in range(n_steps):
+        x = jax.lax.pmean(x, "data")
+    return x
+
+
+def gang_sized(x):
+    # process_count() is identical on every host — looping on it is the
+    # canonical gang-uniform schedule.
+    for _ in range(jax.process_count()):
+        x = jax.lax.psum(x, "data")
+    return x
+
+
+def agreed_trip(pad_rows, x):
+    # The fix idiom: hosts disagree on pad_rows, so AGREE on the worst
+    # case first — after process_allgather the trip count is uniform.
+    worst = int(multihost_utils.process_allgather(np.int64(pad_rows)).max())
+    for _ in range(worst):
+        x = jax.lax.psum(x, "data")
+    return x

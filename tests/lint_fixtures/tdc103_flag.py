@@ -1,0 +1,39 @@
+"""MUST-FLAG TDC103: branches on host-local state whose arms issue
+DIFFERENT collective multisets. Every condition here is a plain name
+holding a tainted value — the lexical TDC001 rule (which matches
+process_index() calls and rank-ish names in the test itself) cannot see
+any of these, which is exactly the gap the dataflow rule closes."""
+import os
+import time
+
+import jax
+
+
+def coordinator_probe(x):
+    pid = jax.process_index()
+    is_coord = pid == 0
+    if is_coord:
+        x = jax.lax.psum(x, "data")
+    return x
+
+
+def _refresh(stats):
+    return jax.lax.all_gather(stats, "model")
+
+
+def budget_refresh(stats, t0):
+    # The extra collective hides in a callee: arm multisets are compared
+    # callee-inclusively, so {all_gather} vs {} still diverges.
+    stale = time.monotonic() - t0 > 60.0
+    if stale:
+        stats = _refresh(stats)
+    else:
+        stats = stats * 1.0
+    return stats
+
+
+def slot_probe(x):
+    slot = os.getenv("TDC_HOST_SLOT", "0")
+    if slot == "0":
+        x = jax.lax.pmin(x, "data")
+    return x

@@ -1,0 +1,31 @@
+"""MUST-NOT-FLAG TDC103: balanced arms under a tainted condition (every
+host runs the same collective schedule whichever arm it takes), and
+unbalanced arms under gang-uniform conditions (every host takes the
+SAME arm)."""
+import jax
+
+
+def balanced_fallback(x):
+    # Tainted condition, but BOTH arms run exactly one psum on "data" —
+    # the schedules agree, so processes can diverge safely.
+    pid = jax.process_index()
+    noisy = pid > 0
+    if noisy:
+        x = jax.lax.psum(x, "data")
+    else:
+        x = jax.lax.psum(x * 0.0, "data")
+    return x
+
+
+def config_branch(x, cfg):
+    if cfg.use_model_axis:
+        x = jax.lax.pmax(x, "model")
+    return x
+
+
+def count_gated(x):
+    # process_count() is gang-uniform: every host evaluates the same
+    # condition to the same value and takes the same arm.
+    if jax.process_count() > 1:
+        x = jax.lax.psum(x, "data")
+    return x

@@ -1,0 +1,42 @@
+"""MUST-FLAG TDC104: host-local values flowing into declared-static jit
+arguments — each host specializes a different compiled program. Statics
+are kept away from collectives and branches so this corpus trips only
+the static-arg rule."""
+import os
+import time
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def compiled_probe(x, chunk):
+    return x.reshape((chunk, -1)).sum()
+
+
+def env_sized(x):
+    chunk = int(os.environ.get("TDC_WORKER_SLOT", "1"))
+    return compiled_probe(x, chunk=chunk)
+
+
+def _window(x, width):
+    return x[:width].sum()
+
+
+probe = jax.jit(_window, static_argnums=(1,))
+
+
+def clock_windowed(x):
+    # The jit overlay form: `probe` was declared with static_argnums at
+    # module level; a clock-derived width forks the compile cache.
+    width = int(time.monotonic()) % 128
+    return probe(x, width)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def padded(x, pad):
+    return x + pad
+
+
+def identity_padded(x):
+    return padded(x, jax.process_index())

@@ -1,0 +1,27 @@
+"""MUST-NOT-FLAG TDC104: statics derived from gang-uniform geometry and
+shape metadata — every host specializes the SAME compiled program."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("banks",))
+def bucketed(x, banks):
+    return x.reshape((banks, -1)).sum()
+
+
+def geometry_banks(x):
+    return bucketed(x, banks=jax.process_count())
+
+
+def shape_banks(x):
+    return bucketed(x, banks=x.shape[0])
+
+
+@partial(jax.jit, static_argnums=(1,))
+def tiled(x, tile):
+    return x + tile
+
+
+def config_tiled(x, cfg):
+    return tiled(x, cfg.tile)

@@ -51,6 +51,11 @@ RULES = [
     #                 computed catalog key, bad-charset catalog key
     ("TDC010", 5),  # typo'd span, typo'd timed_iter name, unregistered
     #                 instant, f-string name, bad-charset registry entry
+    ("TDC100", 3),  # bare inline waiver, bare next-line, bare disable-file
+    ("TDC101", 4),  # PR-18 direct, PR-18 via-callee, process_index, env rank
+    ("TDC102", 3),  # clock while-guard, quarantine trip count, break guard
+    ("TDC103", 3),  # derived coord flag, via-callee arm, env slot flag
+    ("TDC104", 3),  # env static_argnames, clock via jit overlay, identity
 ]
 
 
@@ -71,6 +76,26 @@ def test_must_not_flag(code, _):
     path = os.path.join(FIXDIR, f"{code.lower()}_ok.py")
     found = codes_in(path)
     assert found == [], f"{path}: expected clean, got {found}"
+
+
+def test_pr18_regression_shapes_pinned():
+    """The PR-18 padding-correction bug, pinned by line: the host-local
+    quarantine count reaching psum directly, and the interprocedural
+    variant where it crosses a call boundary first (the shape every
+    lexical rule missed — there is no branch to see)."""
+    path = os.path.join(FIXDIR, "tdc101_flag.py")
+    found = run_paths([path]).findings
+    by_line = {f.line: f for f in found if f.rule == "TDC101"}
+    # stream_pad: `return jax.lax.psum(correction, "data")`
+    direct = next(f for f in by_line.values()
+                  if "quarantine" in f.message and "psum" in f.message
+                  and "parameter" not in f.message)
+    assert "jax.lax.psum(correction" in direct.snippet
+    # fit_step: `return _correction(acc, dropped)` — flagged at the CALL,
+    # because the sink lives in the callee's parameter summary.
+    via = next(f for f in by_line.values() if "parameter" in f.message)
+    assert "_correction(acc, dropped)" in via.snippet
+    assert "_correction" in via.message
 
 
 # ---------------------------------------------------------------------------
@@ -184,11 +209,19 @@ def test_baseline_roundtrip(tmp_path, capsys):
             return w
     """))
     assert lint_main([f"--baseline={base}", str(f)]) == 1
-    # fixing EVERYTHING leaves stale entries — still exit 0, but noted
+    # fixing EVERYTHING leaves stale entries — the gated full run FAILS
+    # (lingering budget is headroom a regression could silently spend)
     f.write_text("x = 1\n")
     capsys.readouterr()
-    assert lint_main([f"--baseline={base}", str(f)]) == 0
+    assert lint_main([f"--baseline={base}", str(f)]) == 1
     assert "STALE" in capsys.readouterr().err
+    # --prune-baseline shrinks the file; the rerun is clean again
+    assert lint_main([f"--baseline={base}", "--prune-baseline",
+                      str(f)]) == 0
+    assert "pruned" in capsys.readouterr().err
+    assert json.load(open(base))["fingerprints"] == {}
+    assert lint_main([f"--baseline={base}", str(f)]) == 0
+    assert "STALE" not in capsys.readouterr().err
 
 
 def test_baseline_multiplicity_ratchets_down(tmp_path):
@@ -248,9 +281,13 @@ def test_partial_run_reports_no_stale_entries(tmp_path, capsys):
     capsys.readouterr()
     assert lint_main([f"--baseline={base}", str(d / "b.py")]) == 0
     assert "STALE" not in capsys.readouterr().err
-    # ...while the full run still reports staleness once a.py is fixed
+    # ...and spot-check pruning is refused (it would wipe the ratchet)
+    assert lint_main([f"--baseline={base}", "--prune-baseline",
+                      str(d / "b.py")]) == 2
+    assert "refusing" in capsys.readouterr().err
+    # ...while the full run GATES on staleness once a.py is fixed
     (d / "a.py").write_text("x = 2\n")
-    assert lint_main([f"--baseline={base}", str(d)]) == 0
+    assert lint_main([f"--baseline={base}", str(d)]) == 1
     assert "STALE" in capsys.readouterr().err
 
 

@@ -1,0 +1,247 @@
+"""Edge-case pins for the TDC1xx gang-divergence dataflow analyzer.
+
+The fixture corpus (tests/lint_fixtures/tdc10*_{flag,ok}.py) pins the
+headline shapes; this module pins the *propagation machinery* — the
+Python constructs taint must survive (tuple unpacking, walrus, closures,
+functools.partial chains, decorated callees, comprehensions, cross-module
+calls) and the gang-uniform negatives it must NOT smear over
+(process_count, len, shape metadata, explicit-key jax.random). Every
+test here is a regression tripwire for a specific transfer-function or
+resolution rule in tdc_tpu.lint.{dataflow,callgraph}.
+"""
+from __future__ import annotations
+
+import ast
+import textwrap
+
+import pytest
+
+from tdc_tpu.lint.callgraph import analyze_program
+from tdc_tpu.lint.rules_taint import uniform_lines
+
+pytestmark = pytest.mark.lint
+
+
+def findings_in(*sources: str, paths: list[str] | None = None):
+    """Analyze inline module sources as one program; returns the raw
+    (code, path, node, message) tuples."""
+    files = []
+    for i, src in enumerate(sources):
+        src = textwrap.dedent(src)
+        path = paths[i] if paths else f"mod{i}.py"
+        files.append((path, ast.parse(src), uniform_lines(src)))
+    return analyze_program(files)
+
+
+def codes_in(*sources: str, paths: list[str] | None = None) -> list[str]:
+    return sorted(c for c, _, _, _ in findings_in(*sources, paths=paths))
+
+
+# ---------------------------------------------------------------------------
+# Propagation constructs: taint must survive these
+# ---------------------------------------------------------------------------
+
+def test_tuple_unpacking_is_elementwise():
+    src = """
+    import jax
+
+    def fit(x):
+        pid, scale = jax.process_index(), 2.0
+        tainted = jax.lax.psum(x + pid, "data")
+        clean = jax.lax.psum(x * scale, "data")
+        return tainted + clean
+    """
+    found = findings_in(src)
+    assert [c for c, *_ in found] == ["TDC101"]
+    # ...and the finding anchors on the pid psum, not the scale one.
+    assert found[0][2].lineno == 6
+
+
+def test_walrus_propagates():
+    src = """
+    import time
+    import jax
+
+    def fit(x):
+        y = (t := time.monotonic()) * 0.0
+        return jax.lax.psum(x + y, "data")
+    """
+    assert codes_in(src) == ["TDC101"]
+
+
+def test_closure_carries_taint_into_nested_def():
+    src = """
+    import jax
+
+    def fit(x):
+        salt = jax.process_index()
+
+        def inner(v):
+            return jax.lax.psum(v + salt, "data")
+
+        return inner(x)
+    """
+    assert "TDC101" in codes_in(src)
+
+
+def test_partial_chain_propagates_taint():
+    src = """
+    import functools
+    import jax
+
+    def fit(x, report):
+        mk = functools.partial(max, report.quarantined)
+        corr = mk(0)
+        return jax.lax.psum(x + corr, "data")
+    """
+    assert codes_in(src) == ["TDC101"]
+
+
+def test_decorated_callee_still_resolves():
+    src = """
+    import jax
+
+    def traced(fn):
+        return fn
+
+    @traced
+    def reduce_corr(x, corr):
+        return jax.lax.psum(x + corr, "data")
+
+    def fit(x, report):
+        return reduce_corr(x, report.quarantined)
+    """
+    found = findings_in(src)
+    assert [c for c, *_ in found] == ["TDC101"]
+    assert "reduce_corr" in found[0][3]  # flagged at the tainted call
+
+
+def test_comprehension_accumulates_taint():
+    src = """
+    import jax
+
+    def fit(x, reports):
+        pads = [r.quarantined_rows for r in reports]
+        return jax.lax.psum(x + sum(pads), "data")
+    """
+    assert codes_in(src) == ["TDC101"]
+
+
+def test_cross_module_parameter_sink():
+    helper = """
+    import jax
+
+    def reduce_corr(x, corr):
+        return jax.lax.psum(x + corr, "data")
+    """
+    driver = """
+    import jax
+    from pkg.helper import reduce_corr
+
+    def fit(x, report):
+        return reduce_corr(x, report.quarantined)
+    """
+    found = findings_in(helper, driver,
+                        paths=["pkg/helper.py", "pkg/driver.py"])
+    assert [c for c, *_ in found] == ["TDC101"]
+    assert found[0][1] == "pkg/driver.py"  # sink reported at the call site
+
+
+# ---------------------------------------------------------------------------
+# Gang-uniform negatives: these must never taint
+# ---------------------------------------------------------------------------
+
+def test_geometry_and_metadata_stay_clean():
+    src = """
+    import jax
+
+    def fit(x, chunks, batch):
+        n = jax.process_count() * jax.local_device_count()
+        m = len(chunks) + batch.shape[0] + batch.ndim
+        return jax.lax.psum(x * n * m, "data")
+    """
+    assert codes_in(src) == []
+
+
+def test_explicit_key_prng_stays_clean():
+    # jax.random is keyed: same key -> same stream on every host. Only
+    # the stdlib clock/uuid/random sources are host-divergence sources.
+    src = """
+    import jax
+
+    def fit(x, key):
+        noise = jax.random.normal(key, (8,))
+        return jax.lax.psum(x + noise, "data")
+    """
+    assert codes_in(src) == []
+
+
+def test_collective_result_is_agreed():
+    # A collective's RESULT is gang-uniform by construction — feeding it
+    # onward must not re-flag (only the first, genuinely tainted operand
+    # does).
+    src = """
+    import jax
+    from jax.experimental import multihost_utils
+
+    def fit(x):
+        pid = jax.process_index()
+        agreed = multihost_utils.process_allgather(pid).sum()
+        return jax.lax.psum(x + agreed, "data")
+    """
+    assert codes_in(src) == []
+
+
+# ---------------------------------------------------------------------------
+# The uniformity-declaration idiom (justified waivers clear source tags)
+# ---------------------------------------------------------------------------
+
+_WAIVED = """
+import jax
+
+def fit(x):
+    pid = jax.process_index()  {comment}
+    return jax.lax.psum(x + pid, "data")
+"""
+
+
+def test_justified_waiver_declares_uniform():
+    src = _WAIVED.format(
+        comment="# tdclint: disable=TDC101 uniform under the test harness")
+    assert codes_in(src) == []
+
+
+def test_bare_waiver_clears_nothing():
+    # An unjustified waiver must NOT launder taint: the TDC101 finding
+    # still exists at the dataflow level (the engine layer separately
+    # reports TDC100 for the bare comment).
+    src = _WAIVED.format(comment="# tdclint: disable=TDC101")
+    assert codes_in(src) == ["TDC101"]
+
+
+def test_short_token_is_not_justification():
+    # "ok" is not a reason — the justification needs a real word.
+    src = _WAIVED.format(comment="# tdclint: disable=TDC101 ok")
+    assert codes_in(src) == ["TDC101"]
+
+
+def test_uniform_lines_coverage_kinds():
+    src = textwrap.dedent("""
+    a = 1  # tdclint: disable=TDC101 mesh geometry, every host identical
+    # tdclint: disable-next-line=TDC102 config trip count, not host state
+    b = 2
+    c = 3  # tdclint: disable=TDC101
+    d = 4  # tdclint: disable=TDC002 non-family waivers never clear tags
+    """)
+    lines = uniform_lines(src)
+    assert 2 in lines      # inline justified
+    assert 4 in lines      # next-line justified
+    assert 5 not in lines  # bare: clears nothing
+    assert 6 not in lines  # non-family code: not this family's business
+
+
+def test_uniform_lines_disable_file_covers_all():
+    src = ("# tdclint: disable-file=TDC103 single-host tool, no gang\n"
+           "x = 1\ny = 2\n")
+    lines = uniform_lines(src)
+    assert {1, 2, 3} <= lines
