@@ -41,10 +41,12 @@ from __future__ import annotations
 
 import argparse
 import csv
+import json
 import math
 import os
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -97,17 +99,20 @@ def _replica_env() -> dict:
     return env
 
 
-def _replica_args(model_root: str, args) -> list[str]:
+def _replica_args(model_root: str, args, *, service_ms=None,
+                  max_wait_ms="4.0") -> list[str]:
     """cli.serve argv tail tuned so one replica saturates in seconds at
     CI scale: small batches + queue, fast governor, short linger."""
+    if service_ms is None:
+        service_ms = args.service_ms
     return [
         "--model_root", model_root,
         "--poll_interval", "0",
         "--max_batch_rows", str(args.max_batch_rows),
         "--max_queue_rows", str(args.max_queue_rows),
-        "--max_wait_ms", "4.0",
+        "--max_wait_ms", max_wait_ms,
         "--warmup_buckets", "2,4,8,16,32",
-        "--service_ms", str(args.service_ms),
+        "--service_ms", str(service_ms),
         "--shed_p99_wait_ms", "250",
         "--shed_min_hold_s", "0.5",
         "--shed_retry_after_s", "0.5",
@@ -117,16 +122,39 @@ def _replica_args(model_root: str, args) -> list[str]:
 
 
 class FleetHarness:
-    """One fleet + router + (optional, caller-started) autoscaler."""
+    """One fleet + router + (optional, caller-started) autoscaler.
 
-    def __init__(self, model_root, args, *, max_replicas: int):
+    `slow_names`/`slow_service_ms` make the named replicas emulate a
+    longer per-batch service time — the skewed-load scenario's one slow
+    replica (a noisy neighbor / thermally-throttled host stand-in)."""
+
+    def __init__(self, model_root, args, *, max_replicas: int,
+                 balance: str = "p2c", pool_max_idle: int = 8,
+                 replica_max_wait_ms: str = "4.0",
+                 slow_names=(), slow_service_ms=None):
+        fast = subprocess_spawner(
+            _replica_args(model_root, args,
+                          max_wait_ms=replica_max_wait_ms),
+            env=_replica_env())
+        if slow_names:
+            slow = subprocess_spawner(
+                _replica_args(model_root, args, service_ms=slow_service_ms,
+                              max_wait_ms=replica_max_wait_ms),
+                env=_replica_env())
+            slow_set = frozenset(slow_names)
+
+            def spawn(name):
+                return (slow if name in slow_set else fast)(name)
+        else:
+            spawn = fast
         self.fleet = ServeFleet(
-            subprocess_spawner(_replica_args(model_root, args),
-                               env=_replica_env()),
+            spawn,
             poll_interval=0.1,
             drain_grace_s=60.0,
         )
-        self.router = FleetRouter(self.fleet, forward_timeout_s=30.0)
+        self.router = FleetRouter(self.fleet, forward_timeout_s=30.0,
+                                  balance=balance,
+                                  pool_max_idle=pool_max_idle)
         self.scaler = Autoscaler(self.fleet, AutoscalerConfig(
             min_replicas=1,
             max_replicas=max_replicas,
@@ -206,8 +234,11 @@ def run_cell(harness, target, *, rps: float, duration_s: float,
         "drain": rep.counts["drain"],
         "error": rep.counts["error"],
         "hung": rep.hung,
+        # None (blank CSV cell, em-dash in the table) when every
+        # replica's bucket delta came back empty — a scrape gap must
+        # read as "absent", never as a literal nan committed as data.
         "p99_worst_replica_ms":
-            round(worst_p99, 2) if worst_p99 == worst_p99 else float("nan"),
+            round(worst_p99, 2) if worst_p99 == worst_p99 else None,
         "client_p50_ms": round(rep.client_percentile(0.50), 2),
         "client_p99_ms": round(rep.client_percentile(0.99), 2),
         "shed_scrape": int(sheds),
@@ -237,13 +268,223 @@ def measure_capacity(harness, target, *, start_rps: float, cell_s: float,
 
 
 # ---------------------------------------------------------------------------
+# Router overhead: direct vs through-router, pooled vs per-request dial
+# ---------------------------------------------------------------------------
+
+
+def _closed_loop_lat(port: int, body: bytes, n: int,
+                     warmup: int = 20) -> list[float]:
+    """Sequential closed-loop request latencies (ms, sorted) over ONE
+    keep-alive client connection — the client hop is identical for the
+    direct and through-router cells, so their difference isolates the
+    router's own data-plane cost."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    lat = []
+    try:
+        for i in range(n + warmup):
+            t0 = time.perf_counter()
+            conn.request("POST", "/predict", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"overhead cell got {resp.status}")
+            if i >= warmup:
+                lat.append((time.perf_counter() - t0) * 1000.0)
+    finally:
+        conn.close()
+    lat.sort()
+    return lat
+
+
+def _pct(sorted_ms: list[float], q: float) -> float:
+    i = min(len(sorted_ms) - 1, max(0, math.ceil(q * len(sorted_ms)) - 1))
+    return sorted_ms[i]
+
+
+def _legacy_proxy(upstream_base: str) -> tuple[object, int]:
+    """A faithful copy of the PR-16 router data plane, kept here as the
+    baseline half of the overhead A/B: one `urllib.request.urlopen` per
+    proxied request (fresh TCP dial), whole-body `resp.read()`, and the
+    `BaseHTTPRequestHandler` default UNBUFFERED response write (status
+    line, headers, and body leave as separate small TCP segments — the
+    Nagle/delayed-ACK stall this PR removed from the live handlers).
+    Returns (httpd, port); caller shuts it down."""
+    import http.server
+    import urllib.request
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else None
+            req = urllib.request.Request(
+                upstream_base + self.path, data=body, method="POST"
+            )
+            if body is not None:
+                req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                data = resp.read()
+                status = resp.status
+                ctype = resp.headers.get("Content-Type",
+                                         "application/json")
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]
+
+
+def run_overhead(model_root, args) -> dict:
+    """Per-request router overhead at service_ms=0: the same replica
+    measured direct, through the pooled router, through the router with
+    the pool disabled (isolates the dial cost alone), and through a
+    verbatim PR-16 legacy proxy (`_legacy_proxy`: per-request urllib
+    dial + whole-body buffering + unbuffered response writes) — the
+    committed-baseline half of the overhead_cut A/B."""
+    oargs = argparse.Namespace(**{**vars(args), "service_ms": 0.0})
+    harness = FleetHarness(model_root, oargs, max_replicas=1,
+                           replica_max_wait_ms="0.5")
+    legacy_httpd = None
+    try:
+        harness.start(1)
+        replica = harness.fleet.ready_replicas()[0]
+        rport = int(replica.base_url.rsplit(":", 1)[1])
+        rng = np.random.default_rng(5)
+        body = json.dumps({
+            "model": "km", "points": rng.normal(size=(4, D)).tolist(),
+        }).encode()
+        n = args.overhead_n
+        direct = _closed_loop_lat(rport, body, n)
+        pooled = _closed_loop_lat(harness.port, body, n)
+        harness.router.pool.flush_all(reason="bench_overhead")
+        harness.router.pool.max_idle_per_replica = 0
+        nopool = _closed_loop_lat(harness.port, body, n)
+        legacy_httpd, lport = _legacy_proxy(replica.base_url)
+        legacy = _closed_loop_lat(lport, body, n)
+    finally:
+        if legacy_httpd is not None:
+            legacy_httpd.shutdown()
+            legacy_httpd.server_close()
+        harness.stop()
+    row = {
+        "scenario": "overhead",
+        "replicas": 1,
+        "direct_p50_ms": round(_pct(direct, 0.5), 3),
+        "direct_p99_ms": round(_pct(direct, 0.99), 3),
+        "router_p50_ms": round(_pct(pooled, 0.5), 3),
+        "router_p99_ms": round(_pct(pooled, 0.99), 3),
+        "router_nopool_p50_ms": round(_pct(nopool, 0.5), 3),
+        "router_nopool_p99_ms": round(_pct(nopool, 0.99), 3),
+        "legacy_p50_ms": round(_pct(legacy, 0.5), 3),
+        "legacy_p99_ms": round(_pct(legacy, 0.99), 3),
+    }
+    over = row["router_p50_ms"] - row["direct_p50_ms"]
+    over_np = row["router_nopool_p50_ms"] - row["direct_p50_ms"]
+    over_legacy = row["legacy_p50_ms"] - row["direct_p50_ms"]
+    row["overhead_p50_ms"] = round(over, 3)
+    row["nopool_overhead_p50_ms"] = round(over_np, 3)
+    row["legacy_overhead_p50_ms"] = round(over_legacy, 3)
+    row["overhead_cut"] = round(over_legacy / over, 2) if over > 0 \
+        else float("inf")
+    return row
+
+
+# ---------------------------------------------------------------------------
+# Skewed load: one slow replica, round-robin vs queue-aware p2c
+# ---------------------------------------------------------------------------
+
+
+def run_skew_cells(model_root, args, *, rps: float,
+                   cell_s: float) -> tuple[dict, dict]:
+    """3 replicas with r2 at `--skew_slow_mult`x the service time, the
+    SAME offered load routed round-robin then p2c on the same fleet.
+    Each cell reports the slow replica's routed share (router scrape
+    deltas) alongside the client percentiles."""
+    import threading
+
+    slow_ms = args.skew_slow_mult * args.service_ms
+    harness = FleetHarness(model_root, args, max_replicas=3, balance="rr",
+                           slow_names=("r2",), slow_service_ms=slow_ms)
+    out = {}
+    # The autoscaler's scrape pass is what stamps replica.queue_p99_ms
+    # for the p2c score; run JUST that (signals(), never
+    # evaluate_once()) on its cadence so the balancer sees the same
+    # queue-wait signal a production fleet would — without any scale
+    # decisions mutating the fleet mid-measurement.
+    stop_stamp = threading.Event()
+
+    def stamp_loop():
+        while not stop_stamp.is_set():
+            try:
+                harness.scaler.signals()
+            except Exception:
+                pass
+            stop_stamp.wait(0.5)
+
+    stamper = threading.Thread(target=stamp_loop, daemon=True)
+    try:
+        target = harness.start(3)
+        stamper.start()
+        # Warm every replica's serving path before the measured cells.
+        run_cell(harness, target, rps=max(4.0, 0.2 * rps),
+                 duration_s=1.5, seed=41)
+        harness.settle()
+
+        def routed(name):
+            return scrape_counter(harness.router.registry.render(),
+                                  "tdc_fleet_routed_total",
+                                  {"replica": name})
+
+        names = [r.name for r in harness.fleet.snapshot()]
+        for strat, seed in (("rr", 51), ("p2c", 52)):
+            harness.router.balance = strat
+            base = {name: routed(name) for name in names}
+            cell = run_cell(harness, target, rps=rps, duration_s=cell_s,
+                            seed=seed, max_workers=args.max_workers)
+            deltas = {name: routed(name) - base[name] for name in names}
+            total = sum(deltas.values())
+            cell["scenario"] = f"skew_{strat}"
+            cell["replicas"] = 3
+            cell["balance"] = strat
+            cell["slow_share"] = (
+                round(deltas.get("r2", 0.0) / total, 3) if total else 0.0)
+            out[strat] = cell
+            print(f"  skew {strat}: offered={cell['offered_rps']} "
+                  f"client_p99={cell['client_p99_ms']}ms "
+                  f"slow_share={cell['slow_share']} "
+                  f"shed={cell['shed_scrape']}", flush=True)
+            harness.settle()
+    finally:
+        stop_stamp.set()
+        if stamper.is_alive():
+            stamper.join(timeout=5.0)
+        harness.stop()
+    return out["rr"], out["p2c"]
+
+
+# ---------------------------------------------------------------------------
 # The committed sweep (fleet_cpu.csv + FLEET.md)
 # ---------------------------------------------------------------------------
 
 CSV_COLUMNS = (
-    "replicas", "capacity_rps", "efficiency", "offered_rps", "goodput_rps",
-    "ok", "shed_scrape", "backpressure", "hung", "p99_worst_replica_ms",
-    "client_p50_ms", "client_p99_ms",
+    "scenario", "replicas", "capacity_rps", "efficiency", "offered_rps",
+    "goodput_rps", "ok", "shed_scrape", "backpressure", "hung",
+    "p99_worst_replica_ms", "client_p50_ms", "client_p99_ms", "balance",
+    "slow_share", "direct_p50_ms", "direct_p99_ms", "router_p50_ms",
+    "router_p99_ms", "router_nopool_p50_ms", "router_nopool_p99_ms",
+    "legacy_p50_ms", "legacy_p99_ms", "overhead_p50_ms",
+    "nopool_overhead_p50_ms", "legacy_overhead_p50_ms", "overhead_cut",
 )
 
 
@@ -266,6 +507,7 @@ def run_sweep(model_root, args) -> list[dict]:
             harness.stop()
         if cap1 is None:
             cap1 = cap
+        cell["scenario"] = f"capacity_n{n}"
         cell["replicas"] = n
         cell["capacity_rps"] = round(cap, 1)
         cell["efficiency"] = round(cap / (n * cap1), 2) if cap1 else 0.0
@@ -275,7 +517,13 @@ def run_sweep(model_root, args) -> list[dict]:
     return rows
 
 
-def render_md(rows: list[dict], args) -> str:
+def _fmt(v) -> str:
+    """Absent measurement (None / nan) renders as an em-dash."""
+    return "—" if v is None or v != v else str(v)
+
+
+def render_md(rows: list[dict], args, overhead: dict | None = None,
+              skew: tuple[dict, dict] | None = None) -> str:
     cap1 = rows[0]["capacity_rps"]
     lines = [
         "# Fleet capacity vs replica count (benchmarks/bench_fleet.py)",
@@ -300,7 +548,7 @@ def render_md(rows: list[dict], args) -> str:
             f"| {r['replicas']} | {r['capacity_rps']} | {r['efficiency']} "
             f"| {r['offered_rps']} | {r['goodput_rps']} "
             f"| {r['shed_scrape']} | {r['backpressure']} | {r['hung']} "
-            f"| {r['p99_worst_replica_ms']} "
+            f"| {_fmt(r['p99_worst_replica_ms'])} "
             f"| {r['client_p50_ms']}/{r['client_p99_ms']} |"
         )
     lines.append("")
@@ -318,14 +566,86 @@ def render_md(rows: list[dict], args) -> str:
         "when the router hop and thinner per-replica arrival dominate "
         "— read the trend, not the third digit."
     )
+    if overhead is not None:
+        o = overhead
+        lines += [
+            "",
+            "## Router data-plane overhead (per request, service_ms=0)",
+            "",
+            "Sequential closed loop over one keep-alive client "
+            "connection against the same replica, four ways: direct "
+            "(no router), through the router with the keep-alive pool "
+            "(`--pool_max_idle 8`, the default plane), through the "
+            "router with the pool disabled (`--pool_max_idle 0` — "
+            "isolates the per-request TCP dial), and through a verbatim "
+            "copy of the PR-16 data plane (per-request `urllib` dial, "
+            "whole-body buffering, UNBUFFERED response writes). "
+            "Overhead = through-proxy p50 minus direct p50.",
+            "",
+            "| plane | p50 ms | p99 ms | overhead p50 ms |",
+            "|---|---|---|---|",
+            f"| direct to replica | {o['direct_p50_ms']} "
+            f"| {o['direct_p99_ms']} | — |",
+            f"| router, pooled | {o['router_p50_ms']} "
+            f"| {o['router_p99_ms']} | {o['overhead_p50_ms']} |",
+            f"| router, per-request dial | {o['router_nopool_p50_ms']} "
+            f"| {o['router_nopool_p99_ms']} "
+            f"| {o['nopool_overhead_p50_ms']} |",
+            f"| PR-16 legacy plane | {o['legacy_p50_ms']} "
+            f"| {o['legacy_p99_ms']} "
+            f"| {o['legacy_overhead_p50_ms']} |",
+            "",
+            f"**The new data plane cuts the router's p50 hop cost "
+            f"{o['overhead_cut']}x vs the PR-16 baseline** (from "
+            f"{o['legacy_overhead_p50_ms']} ms to "
+            f"{o['overhead_p50_ms']} ms). Most of the legacy cost is "
+            "the unbuffered handler's Nagle/delayed-ACK stall — status "
+            "line, headers, and body left as separate small TCP "
+            "segments, costing a single-in-flight client ~40 ms per "
+            "response (fixed in BOTH the router and the replica server "
+            "by buffering each response into one segment); the "
+            "remainder is the per-request TCP dial the keep-alive pool "
+            "removes (the `per-request dial` row isolates it).",
+        ]
+    if skew is not None:
+        rr, p2c = skew
+        lines += [
+            "",
+            "## Skewed load: one slow replica "
+            f"({args.skew_slow_mult:.0f}x service time on r2)",
+            "",
+            "Same fleet, same offered load "
+            f"(~{rr['offered_rps']} rps), balanced round-robin then "
+            "power-of-two-choices. `slow share` is the fraction of "
+            "routed requests the router sent to the slow replica "
+            "(`tdc_fleet_routed_total` deltas). Round-robin keeps "
+            "feeding the slow replica its full share, so a third of "
+            "requests queue behind a replica that cannot keep up; p2c "
+            "reads the live in-flight count plus the scrape-derived "
+            "queue p99 (the autoscaler's scrape pass runs during the "
+            "cells, stamping it exactly as in production) and routes "
+            "around the hotspot.",
+            "",
+            "| balance | offered rps | goodput rps | shed | "
+            "client p50 ms | client p99 ms | slow share |",
+            "|---|---|---|---|---|---|---|",
+            f"| rr | {rr['offered_rps']} | {rr['goodput_rps']} "
+            f"| {rr['shed_scrape']} | {rr['client_p50_ms']} "
+            f"| {rr['client_p99_ms']} | {rr['slow_share']} |",
+            f"| p2c | {p2c['offered_rps']} | {p2c['goodput_rps']} "
+            f"| {p2c['shed_scrape']} | {p2c['client_p50_ms']} "
+            f"| {p2c['client_p99_ms']} | {p2c['slow_share']} |",
+        ]
     lines += [
         "",
         "The elasticity loop itself (shed onset → autoscale OUT → shed "
         "stops at unchanged offered load → scale back IN with zero "
         "requests routed to the draining replica) is gated by "
-        "`bench_fleet.py --smoke` — the `fleet-smoke` tier-1 stage. "
-        "CPU-CI numbers; re-run with `--service_ms 0` on real silicon "
-        "for production capacity.",
+        "`bench_fleet.py --smoke` — the `fleet-smoke` tier-1 stage, "
+        "which also replays the skewed-load scenario and asserts p2c "
+        "beats round-robin on client p99 while shifting routed share "
+        "off the slow replica. CPU-CI numbers; re-run with "
+        "`--service_ms 0` on real silicon for production capacity.",
         "",
     ]
     return "\n".join(lines)
@@ -399,7 +719,18 @@ def run_smoke(args) -> int:
         # offered load still ABOVE one replica's capacity: with the
         # capacity the autoscaler added, nothing sheds.
         harness.scaler.stop()
-        harness.settle()
+        # Let the grown fleet actually stabilize before sampling its
+        # size: a replica the autoscaler spawned near the spike's end
+        # may still be STARTING (jax import takes seconds on a loaded
+        # box), and the spiked replica can shed past a short settle
+        # while it burns down the backlog. Phase 2 measures the grown
+        # fleet at steady state, not the spike's tail.
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if (len(harness.fleet.ready_replicas()) >= grown
+                    and harness.settle(timeout_s=5.0)):
+                break
+            time.sleep(0.2)
         n_now = max(1, len(harness.fleet.ready_replicas()))
         held_rps = min(args.spike_frac, 0.6 * n_now) * cap1
         held = run_cell(harness, target, rps=held_rps,
@@ -455,6 +786,20 @@ def run_smoke(args) -> int:
     finally:
         harness.stop()
 
+    # Phase 4 — skewed load: a fresh 3-replica fleet with one slow
+    # replica, the SAME offered load round-robin then p2c. Queue-aware
+    # balancing must beat rr on client p99 AND visibly shift routed
+    # share off the slow replica.
+    skew_rps = args.skew_frac * cap1
+    rr, p2c = run_skew_cells(model_root, args, rps=skew_rps,
+                             cell_s=max(4.0, args.cell_s))
+    checks["skew_p2c_beats_rr_p99"] = (
+        p2c["client_p99_ms"] < rr["client_p99_ms"])
+    checks["skew_share_shifts_off_slow"] = (
+        p2c["slow_share"] < rr["slow_share"] - 0.05)
+    checks["skew_zero_hung"] = rr["hung"] == 0 and p2c["hung"] == 0
+    detail["skew"] = (rr, p2c)
+
     ok = all(checks.values())
     failed = [k for k, v in checks.items() if not v]
     spike, held = detail["spike"], detail["held"]
@@ -468,7 +813,9 @@ def run_smoke(args) -> int:
         f"{held['shed_scrape']}, scale-in victim={detail['victim']} "
         f"(down={_scale_events(harness.router, 'down'):.0f}) routed-"
         f"while-draining=0:{checks.get('drain_gets_zero_traffic')}, "
-        f"calm ok={detail['calm_ok']}"
+        f"calm ok={detail['calm_ok']}, skew p99 rr="
+        f"{rr['client_p99_ms']}ms p2c={p2c['client_p99_ms']}ms "
+        f"slow-share rr={rr['slow_share']} p2c={p2c['slow_share']}"
         + (f" FAILED={failed}" if failed else "")
     )
     return 0 if ok else 1
@@ -495,6 +842,13 @@ def main(argv=None) -> int:
     p.add_argument("--spike_frac", type=float, default=2.5,
                    help="spike offered load as a multiple of cap1")
     p.add_argument("--max_workers", type=int, default=256)
+    p.add_argument("--overhead_n", type=int, default=300,
+                   help="closed-loop samples per overhead cell")
+    p.add_argument("--skew_slow_mult", type=float, default=4.0,
+                   help="slow replica's service-time multiplier")
+    p.add_argument("--skew_frac", type=float, default=1.3,
+                   help="skew offered load as a multiple of cap1 "
+                        "(above one fast replica, below the fleet)")
     args = p.parse_args(argv)
 
     if args.smoke:
@@ -502,15 +856,26 @@ def main(argv=None) -> int:
 
     model_root = _models_dir()
     rows = run_sweep(model_root, args)
+    print("overhead: starting", flush=True)
+    overhead = run_overhead(model_root, args)
+    print(f"overhead: direct p50={overhead['direct_p50_ms']}ms, router "
+          f"pooled +{overhead['overhead_p50_ms']}ms, per-request dial "
+          f"+{overhead['nopool_overhead_p50_ms']}ms, PR-16 legacy "
+          f"+{overhead['legacy_overhead_p50_ms']}ms "
+          f"(cut {overhead['overhead_cut']}x vs legacy)", flush=True)
+    print("skew: starting", flush=True)
+    skew = run_skew_cells(model_root, args,
+                          rps=args.skew_frac * rows[0]["capacity_rps"],
+                          cell_s=2 * args.cell_s)
     if args.csv:
         with open(args.csv, "w", newline="") as f:
             w = csv.DictWriter(f, fieldnames=CSV_COLUMNS,
                                extrasaction="ignore")
             w.writeheader()
-            for r in rows:
+            for r in rows + [overhead, *skew]:
                 w.writerow(r)
         print(f"wrote {args.csv}")
-    text = render_md(rows, args)
+    text = render_md(rows, args, overhead=overhead, skew=skew)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text)

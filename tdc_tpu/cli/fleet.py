@@ -42,6 +42,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="router bind port")
     p.add_argument("--replicas", type=int, default=1,
                    help="initial replica count")
+    p.add_argument("--balance", type=str, default="p2c",
+                   choices=("p2c", "rr"),
+                   help="router balancing: power-of-two-choices over "
+                        "in-flight + recent queue p99 (default), or "
+                        "plain round-robin")
+    p.add_argument("--pool_max_idle", type=int, default=8,
+                   help="keep-alive sockets pooled per replica "
+                        "(0 = connection-per-request, the PR-16 "
+                        "behaviour)")
     p.add_argument("--min_replicas", type=int, default=1)
     p.add_argument("--max_replicas", type=int, default=4)
     p.add_argument("--autoscale", type=str, default="on",
@@ -68,6 +77,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scale_down_rps", type=float, default=0.0,
                    help="offered rps per replica below which scale-in "
                         "is allowed (0 = only the all-admitting gate)")
+    p.add_argument("--scale_error_frac", type=float, default=0.5,
+                   help="router-observed error fraction at which a "
+                        "replica is replaced even though its /readyz "
+                        "looks fine (0 disables)")
+    p.add_argument("--scale_failover_rate", type=float, default=0.0,
+                   help="router failovers/s that also triggers "
+                        "scale-out (0 disables)")
     p.add_argument("--poll_interval", type=float, default=2.0,
                    help="replica hot-reload poll period (forwarded)")
     p.add_argument("--fleet_poll_s", type=float, default=0.25,
@@ -131,7 +147,8 @@ def make_fleet(args):
         poll_interval=args.fleet_poll_s,
         drain_grace_s=max(30.0, args.drain_linger + 25.0),
     )
-    router = FleetRouter(fleet, log=log)
+    router = FleetRouter(fleet, log=log, balance=args.balance,
+                         pool_max_idle=args.pool_max_idle)
     autoscaler = Autoscaler(
         fleet,
         AutoscalerConfig(
@@ -144,10 +161,13 @@ def make_fleet(args):
             shed_frac_high=args.scale_up_shed_frac,
             p99_wait_high_ms=args.scale_p99_wait_ms,
             rps_per_replica_low=args.scale_down_rps,
+            error_frac_high=args.scale_error_frac,
+            failover_rate_high=args.scale_failover_rate,
             enabled=args.autoscale != "off",
         ),
         registry=router.registry,
         log=log,
+        router=router,
     )
     return fleet, router, autoscaler, log
 

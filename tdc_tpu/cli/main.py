@@ -178,7 +178,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "~sqrt(n_tiles)")
     p.add_argument("--kernel", type=str, default=None,
                    choices=("xla", "pallas", "pallas_bf16", "refined",
-                            "auto"),
+                            "auto", "auto:quantized"),
                    help="sufficient-stats kernel for K-Means: 'pallas' = "
                         "fused single-pass VMEM kernel (single-device and "
                         "mesh; with --shard_k, the blockwise online-argmin "
@@ -197,7 +197,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "fused Pallas path when the (K, d) block fits "
                         "VMEM on TPU and falls back to XLA loudly "
                         "(kernel_selected event; "
-                        "ops/pallas_kernels.resolve_kernel)")
+                        "ops/pallas_kernels.resolve_kernel). "
+                        "'auto:quantized' = auto, plus permission to pick "
+                        "the bf16-MXU epilogue where it applies (kmeans, "
+                        "f32 inputs, single-device, fused-feasible) — the "
+                        "caller accepts quantized-reduce tolerances")
     p.add_argument("--shard_k", type=int, default=1,
                    help="model-axis size: shard the K centroids/components "
                         "this many ways over a 2-D (data x model) mesh (the "
@@ -593,20 +597,24 @@ def validate_args(parser, args):
             parser.error("--kernel=refined is in-memory single-shard "
                          "(use it for iters-to-converge parity runs)")
     if args.kernel == "pallas_bf16":
-        # bf16-MXU / f32-accumulate distance epilogue: in-memory kmeans,
+        # bf16-MXU / f32-accumulate distance epilogue: kmeans only,
         # single-device (models/kmeans rejects mesh/weights at fit time;
         # catch the CLI-visible combinations at parse time, per the
-        # standing explicit-kernel fail-fast rule).
+        # standing explicit-kernel fail-fast rule). The streamed driver
+        # runs it per-batch (streamed_kmeans_fit's pallas_bf16 branch);
+        # minibatch/mean_combine have no epilogue plumbing.
         if args.method_name != "distributedKMeans":
             parser.error("--kernel=pallas_bf16 is distributedKMeans only "
                          "(the bf16-MXU epilogue exists for the Lloyd "
                          "stats kernel)")
-        for flag in ("minibatch", "streamed", "mean_combine"):
+        for flag in ("minibatch", "mean_combine"):
             if getattr(args, flag):
-                parser.error(f"--kernel=pallas_bf16 is the in-memory fused "
-                             f"kernel; --{flag} is not supported")
-        if args.num_batches > 1 or args.shard_k > 1:
-            parser.error("--kernel=pallas_bf16 is in-memory single-shard")
+                parser.error(f"--kernel=pallas_bf16 has no --{flag} "
+                             f"plumbing (the epilogue lives in the fused "
+                             f"Lloyd stats kernel)")
+        if (args.num_batches > 1 and not args.streamed) or args.shard_k > 1:
+            parser.error("--kernel=pallas_bf16 is single-shard (in-memory "
+                         "or --streamed)")
         if args.n_devices and args.n_devices > 1:
             parser.error("--kernel=pallas_bf16 is single-device (no "
                          "shard_map tower; cast inputs to bf16 with "

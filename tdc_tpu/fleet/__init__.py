@@ -8,11 +8,14 @@ exists — the shared manifest dir (model distribution via hot-reload
 polling) and the /readyz + /metrics surfaces.
 
     fleet/replica.py     one replica: state machine + probe/drain edges
-    fleet/controller.py  ServeFleet: spawn, poll loop, drain/reap
-    fleet/router.py      FleetRouter: readiness-routed reverse proxy +
-                         fleet-level /metrics
+    fleet/controller.py  ServeFleet: spawn, poll loop, drain/reap,
+                         state-change listeners
+    fleet/pool.py        ReplicaPool: per-replica keep-alive sockets,
+                         generation-keyed, flushed on state exit (PR 20)
+    fleet/router.py      FleetRouter: pooled, queue-aware (p2c)
+                         reverse proxy + fleet-level /metrics
     fleet/autoscaler.py  Autoscaler: hysteresis + cooldown over the
-                         replicas' own scrape signals
+                         replicas' scrape signals + the router's view
     cli/fleet.py         the `python -m tdc_tpu.cli.fleet` entry point
 """
 
@@ -32,6 +35,7 @@ from tdc_tpu.fleet.replica import (
     STATES,
     Replica,
 )
+from tdc_tpu.fleet.pool import ReplicaPool
 from tdc_tpu.fleet.router import FleetRouter
 
 __all__ = [
@@ -44,6 +48,7 @@ __all__ = [
     "NOT_READY",
     "READY",
     "Replica",
+    "ReplicaPool",
     "STARTING",
     "STATES",
     "ServeFleet",

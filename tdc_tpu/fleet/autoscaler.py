@@ -57,16 +57,34 @@ class AutoscalerConfig:
     rps_per_replica_low: float = 0.0
     up_step: int = 1
     enabled: bool = True
+    # Router-view signals (need a router wired in; each 0 disables):
+    # replace a replica whose router-observed error fraction over the
+    # view window reaches this — a readiness-lying or half-dead replica
+    # whose own /metrics look fine still fails the requests the router
+    # actually sends it...
+    error_frac_high: float = 0.5
+    # ...but only once the router has really exercised it (a 1-sample
+    # window must not condemn a replica).
+    error_min_samples: int = 4
+    # Scale-out when the router's failover rate (failovers/s over its
+    # view window) reaches this — failovers mean replicas are refusing
+    # work faster than the readiness poll can hide them.
+    failover_rate_high: float = 0.0
 
 
 class Autoscaler:
     """Hysteresis + cooldown control loop over a ServeFleet."""
 
     def __init__(self, fleet, config: AutoscalerConfig | None = None, *,
-                 registry=None, log=None):
+                 registry=None, log=None, router=None):
         self.fleet = fleet
         self.config = config or AutoscalerConfig()
         self.log = log
+        # Optional FleetRouter: its view() federates the router-side
+        # signals (routed rps, failover rate, per-replica error
+        # fraction) into signals()/evaluate_once — the repair path for
+        # replicas whose /readyz lies.
+        self.router = router
         reg = registry or obs_metrics.Registry()
         self._scale_events = reg.counter(
             "tdc_fleet_scale_events_total", labelnames=("direction",)
@@ -108,16 +126,28 @@ class Autoscaler:
                 q = obs_metrics.scrape_quantile(
                     text, "tdc_serve_queue_wait_ms", 0.99, baseline=prev
                 )
-                if not math.isnan(q) and not (p99 >= q):
-                    p99 = q
+                if not math.isnan(q):
+                    # Stamp the replica for the router's queue-aware
+                    # balancer (p2c reads it while fresh).
+                    r.queue_p99_ms = q
+                    r.queue_p99_at = time.monotonic()
+                    if not (p99 >= q):
+                        p99 = q
         self._prev_scrapes = fresh
-        return {
+        sig = {
             "n_live": scraped,
             "shedding": shedding,
             "shed_frac": (shedding / scraped) if scraped else 0.0,
             "offered_rps": offered,
             "p99_wait_ms": p99,
         }
+        if self.router is not None:
+            view = self.router.view()
+            sig["routed_rps"] = view["routed_rps"]
+            sig["failover_rate"] = view["failover_rate"]
+            sig["error_frac"] = view["error_frac"]
+            sig["error_samples"] = view["samples"]
+        return sig
 
     # ---------------- decisions ----------------
 
@@ -130,7 +160,9 @@ class Autoscaler:
     def _record(self, direction: str, **fields) -> None:
         self._scale_events.labels(direction=direction).inc()
         if self.log is not None:
-            self.log.event("fleet_scale", direction=direction, **fields)
+            flat = {k: v for k, v in fields.items()
+                    if not isinstance(v, dict)}  # per-replica maps: noise
+            self.log.event("fleet_scale", direction=direction, **flat)
 
     def evaluate_once(self) -> dict:
         """One control step: replace the dead, then apply the
@@ -147,12 +179,42 @@ class Autoscaler:
         sig = self.signals()
         if not cfg.enabled:
             return sig
+        # Router-view repair: a replica the router keeps failing on is
+        # replaced even though its own /readyz and /metrics look fine —
+        # the readiness-lying case the replica-side signals cannot see.
+        # Cooldown-gated (unlike dead-replace: a corpse is unambiguous,
+        # an error fraction is a judgement) and one repair per
+        # evaluation.
+        if (self.router is not None and cfg.error_frac_high > 0
+                and now - self._last_scale >= cfg.cooldown_s):
+            frac = sig.get("error_frac", {})
+            samples = sig.get("error_samples", {})
+            by_name = {r.name: r for r in self.fleet.snapshot()
+                       if r.state in (READY, NOT_READY)}
+            for name in sorted(frac):
+                replica = by_name.get(name)
+                if (replica is None
+                        or samples.get(name, 0) < cfg.error_min_samples
+                        or frac[name] < cfg.error_frac_high):
+                    continue
+                fault_point("fleet.scale")
+                self.fleet.drain_replica(replica)
+                self.fleet.add_replica()
+                self._prev_scrapes.pop(name, None)
+                self._last_scale = now
+                self._record("replace", replica=name,
+                             reason="error_frac",
+                             error_frac=round(frac[name], 3))
+                break
         n = self._population()
         want_up = (
             sig["n_live"] > 0
             and (sig["shed_frac"] >= cfg.shed_frac_high
                  or (cfg.p99_wait_high_ms > 0
-                     and sig["p99_wait_ms"] >= cfg.p99_wait_high_ms))
+                     and sig["p99_wait_ms"] >= cfg.p99_wait_high_ms)
+                 or (cfg.failover_rate_high > 0
+                     and sig.get("failover_rate", 0.0)
+                     >= cfg.failover_rate_high))
         )
         want_down = (
             sig["n_live"] > 0

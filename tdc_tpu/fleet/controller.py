@@ -81,6 +81,33 @@ class ServeFleet:
         self._seq = 0
         self._poller: threading.Thread | None = None
         self._stop_evt = threading.Event()
+        # State-change listeners (router pool flush rides these):
+        # fn(replica, old_state, new_state), fired from poll_once's
+        # probe transitions and the drain edge. _known is each
+        # replica's last NOTIFIED state, so an event edge that mutated
+        # replica.state between polls (begin_drain, mark_not_ready)
+        # still produces exactly one notification.
+        self._listeners: list = []
+        self._known: dict[Replica, str] = {}
+
+    # ---------------- state listeners ----------------
+
+    def add_listener(self, fn) -> None:
+        """Subscribe to replica state transitions: fn(replica, old,
+        new). Listener failures are logged, never propagated — the poll
+        loop must outlive a misbehaving subscriber."""
+        self._listeners.append(fn)
+
+    def _notify(self, replica: Replica, old: str, new: str) -> None:
+        self._known[replica] = new
+        for fn in self._listeners:
+            try:
+                fn(replica, old, new)
+            except Exception as e:
+                if self.log is not None:
+                    self.log.event("fleet_listener_error",
+                                   replica=replica.name,
+                                   error=f"{type(e).__name__}: {e}")
 
     # ---------------- replica set ----------------
 
@@ -93,6 +120,7 @@ class ServeFleet:
         replica = self._spawn(name)
         with self._lock:
             self.replicas.append(replica)
+            self._known[replica] = replica.state
         if self.log is not None:
             self.log.event("fleet_replica_spawned", replica=replica.name,
                            url=replica.base_url)
@@ -108,7 +136,10 @@ class ServeFleet:
                 replica = ready[-1] if ready else None
             if replica is None:
                 return None
+        prev = self._known.get(replica, replica.state)
         replica.begin_drain()
+        if replica.state != prev:
+            self._notify(replica, prev, replica.state)
         if self.log is not None:
             self.log.event("fleet_replica_draining", replica=replica.name)
         return replica
@@ -140,6 +171,7 @@ class ServeFleet:
         with self._lock:
             if replica in self.replicas:
                 self.replicas.remove(replica)
+            self._known.pop(replica, None)
 
     # ---------------- poll loop ----------------
 
@@ -147,7 +179,10 @@ class ServeFleet:
         """Probe every replica; reap the ones whose drain completed."""
         for r in self.snapshot():
             draining = r.state == DRAINING
+            prev = self._known.get(r, r.state)
             state = r.probe(timeout=self.probe_timeout)
+            if state != prev:
+                self._notify(r, prev, state)
             if state == DEAD and draining:
                 self.remove(r)
                 if self.log is not None:

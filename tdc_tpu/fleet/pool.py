@@ -1,0 +1,135 @@
+"""Per-replica keep-alive connection pool for the fleet router's data
+plane.
+
+The PR-16 router opened a fresh TCP connection per proxied request
+(urllib.request.urlopen) — connect/teardown on the hot path of every
+production request. This pool keeps a bounded stack of idle
+`http.client.HTTPConnection` sockets per replica and follows the
+HTTPRangeStore (data/store.py) socket discipline: a connection that saw
+ANY failure is in an unknown protocol state and is dropped, never
+returned to the pool; the next checkout redials.
+
+Lifecycle safety is generation-keyed: every checkout records the
+replica's readiness generation (bumped each time the replica
+transitions INTO the ready state, see Replica.probe), and a checkin
+whose generation is stale — the replica flapped, restarted, or was
+replaced while the request was in flight — closes the socket instead of
+pooling it. `flush()` empties a replica's idle stack the moment it
+leaves READY (router feedback edges and the controller's state
+listeners both call it), so a kill -9'd replica never leaves a hung
+pooled socket behind.
+
+`max_idle_per_replica` bounds the sockets RETAINED per replica;
+concurrent requests beyond it dial fresh connections that are simply
+closed on checkin (counted as discards). `max_idle_per_replica=0`
+disables keep-alive entirely — one connection per request, the PR-16
+data plane, kept as a kill-switch and as the benchmark baseline.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import urllib.parse
+
+from tdc_tpu.fleet.replica import READY
+from tdc_tpu.obs import metrics as obs_metrics
+
+
+class ReplicaPool:
+    """Bounded per-replica keep-alive `http.client` connection pool."""
+
+    def __init__(self, *, registry=None, log=None,
+                 max_idle_per_replica: int = 8, timeout_s: float = 35.0):
+        self.log = log
+        self.max_idle_per_replica = int(max_idle_per_replica)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        # name -> stack of (generation, HTTPConnection); LIFO so the
+        # warmest socket (fewest idle seconds, least likely to have been
+        # closed under us by the server) is reused first.
+        self._idle: dict[str, list] = {}
+        reg = registry or obs_metrics.Registry()
+        self._checkouts = reg.counter("tdc_fleet_pool_checkouts_total")
+        self._reuses = reg.counter("tdc_fleet_pool_reuses_total")
+        self._discards = reg.counter("tdc_fleet_pool_discards_total")
+
+    # ---------------- checkout / checkin ----------------
+
+    def checkout(self, replica):
+        """An open connection to `replica`: a pooled idle socket of the
+        replica's CURRENT generation when one exists, else a fresh dial
+        (connection established lazily on first request). Returns
+        (conn, generation) — hand both back to checkin/discard."""
+        gen = replica.generation
+        reused = None
+        stale = []
+        with self._lock:
+            idle = self._idle.get(replica.name)
+            while idle:
+                g, conn = idle.pop()
+                if g == gen:
+                    reused = conn
+                    break
+                stale.append(conn)
+        for conn in stale:
+            self._close(conn)
+        self._checkouts.inc()
+        if reused is not None:
+            self._reuses.inc()
+            return reused, gen
+        netloc = urllib.parse.urlsplit(replica.base_url).netloc
+        return http.client.HTTPConnection(netloc, timeout=self.timeout_s), gen
+
+    def checkin(self, replica, conn, generation: int) -> None:
+        """Return a connection that completed a request CLEANLY. Pooled
+        only if the replica is still ready in the same generation and
+        the idle stack has room; closed otherwise."""
+        if (replica.state == READY and replica.generation == generation
+                and self.max_idle_per_replica > 0):
+            with self._lock:
+                idle = self._idle.setdefault(replica.name, [])
+                if len(idle) < self.max_idle_per_replica:
+                    idle.append((generation, conn))
+                    return
+        self.discard(conn)
+
+    def discard(self, conn) -> None:
+        """Close a connection that failed (or overflowed the pool) —
+        never re-pool it: after any transport error the socket's
+        protocol state is unknown (the HTTPRangeStore rule)."""
+        self._close(conn)
+
+    def _close(self, conn) -> None:
+        self._discards.inc()
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    # ---------------- lifecycle ----------------
+
+    def flush(self, name: str, reason: str = "") -> int:
+        """Close every idle socket pooled for `name` (the replica left
+        READY, restarted, or died). Returns how many were closed."""
+        with self._lock:
+            idle = self._idle.pop(name, [])
+        for _, conn in idle:
+            self._close(conn)
+        if idle and self.log is not None:
+            self.log.event("fleet_pool_flush", replica=name,
+                           discarded=len(idle), reason=reason)
+        return len(idle)
+
+    def flush_all(self, reason: str = "") -> int:
+        with self._lock:
+            names = list(self._idle)
+        return sum(self.flush(n, reason) for n in names)
+
+    def idle_count(self, name: str | None = None) -> int:
+        """Idle sockets pooled for one replica (or all) — the
+        zero-hung-sockets assertion surface for the chaos tests."""
+        with self._lock:
+            if name is not None:
+                return len(self._idle.get(name, ()))
+            return sum(len(v) for v in self._idle.values())

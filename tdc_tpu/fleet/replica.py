@@ -51,6 +51,16 @@ class Replica:
         self._stop = stop  # in-process drain callable | None
         self.state = STARTING
         self.exit_code: int | None = None
+        # Readiness generation: bumped every time the replica enters
+        # READY from any other state. The router's connection pool keys
+        # pooled sockets on it — a socket checked out before a
+        # flap/restart is never re-pooled after one (fleet/pool.py).
+        self.generation = 0
+        # Scrape-derived recent p99 queue wait, stamped by whoever
+        # scrapes this replica (the autoscaler's signal loop); the
+        # router's queue-aware balancer reads it while fresh.
+        self.queue_p99_ms = 0.0
+        self.queue_p99_at = 0.0  # time.monotonic() of the stamp
 
     # ---------------- probing ----------------
 
@@ -78,7 +88,10 @@ class Replica:
             # Drain is sticky: the lingering listener answers 503 until
             # exit; never re-admit a draining replica to the ready set.
             return self.state
-        self.state = READY if status == 200 else NOT_READY
+        new = READY if status == 200 else NOT_READY
+        if new == READY and self.state != READY:
+            self.generation += 1
+        self.state = new
         return self.state
 
     def scrape(self, timeout: float = 2.0) -> str | None:

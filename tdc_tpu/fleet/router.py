@@ -11,24 +11,44 @@ interval leaves open: a forwarded request that comes back shed/drain
 over ONCE to a different ready replica; only when no replica is ready
 does the fleet itself answer 503 with a Retry-After.
 
+The data plane (PR 20) runs on pooled keep-alive connections
+(fleet/pool.py — per-replica bounded `http.client` sockets, dropped on
+any failure, flushed when a replica leaves READY or its generation
+restarts) instead of a fresh TCP dial per request, and picks replicas
+with power-of-two-choices over a per-replica load score (the router's
+own in-flight count plus the scrape-derived recent p99 queue wait the
+autoscaler stamps on each replica) — `balance="rr"` keeps blind
+round-robin as the fallback knob. Large response bodies stream to the
+client through a fixed buffer (Content-Length-bounded copy) instead of
+triple-buffering in the router; large request bodies likewise stream
+upstream, at the documented cost of no failover for them (the body is
+consumed).
+
 The router is also the fleet's scrape endpoint: its /metrics renders
 the fleet-level families (`tdc_fleet_replicas` by state,
 `tdc_fleet_routed_total` by replica and outcome, failover/unrouted
-counters, and the autoscaler's `tdc_fleet_scale_events_total` when one
-is attached) through the same obs/metrics Registry/CATALOG path the
-replicas use — `obs.loadgen.HttpTarget` pointed at the router works
-unchanged.
+counters, the pool and balance-decision counters, the recent-window
+`tdc_fleet_router_rps` gauge, and the autoscaler's
+`tdc_fleet_scale_events_total` when one is attached) through the same
+obs/metrics Registry/CATALOG path the replicas use —
+`obs.loadgen.HttpTarget` pointed at the router works unchanged. The
+same recent window backs `view()` — routed rps, failover rate, and
+per-replica error fractions — the autoscaler's router-side signals for
+catching readiness-lying replicas.
 """
 
 from __future__ import annotations
 
-import itertools
+import http.client
 import json
 import threading
-import urllib.error
-import urllib.request
+import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from random import Random
 
+from tdc_tpu.fleet.pool import ReplicaPool
+from tdc_tpu.fleet.replica import READY
 from tdc_tpu.obs import metrics as obs_metrics
 from tdc_tpu.testing.faults import fault_point
 
@@ -38,21 +58,84 @@ from tdc_tpu.testing.faults import fault_point
 # may still help, but the client was promised explicit backpressure).
 _FAILOVER_REASONS = ("shed", "drain")
 
+_BALANCE_STRATEGIES = ("p2c", "rr")
+
+# Fixed copy buffer for streamed bodies: large enough to amortize
+# syscalls, small enough that N concurrent streams stay cheap.
+_COPY_BUF = 64 * 1024
+
+# One in-flight request is "worth" this many ms of scraped p99 queue
+# wait in the p2c load score: in-flight is the live signal, the scraped
+# p99 a slower-moving tiebreak, so a replica whose queue wait is one
+# service-time-ish worse counts like one extra outstanding request.
+_P99_SCORE_MS = 50.0
+
+# Scraped p99 staleness bound: with the autoscaler (the stamper) off or
+# wedged, an old reading must not pin a replica as slow forever.
+_P99_FRESH_S = 10.0
+
+
+class _StreamAborted(RuntimeError):
+    """A streamed response failed AFTER the status line was committed to
+    the client — no failover is possible; the handler must abort the
+    client connection instead of sending a second response."""
+
+
+class _BoundedReader:
+    """Content-Length-bounded file-like over the client's rfile, so
+    http.client can stream a large request body upstream in fixed
+    blocks without the router ever holding the whole body."""
+
+    def __init__(self, raw, length: int):
+        self._raw = raw
+        self.remaining = int(length)
+
+    def read(self, n: int = -1) -> bytes:
+        if self.remaining <= 0:
+            return b""
+        if n is None or n < 0 or n > self.remaining:
+            n = min(self.remaining, _COPY_BUF)
+        chunk = self._raw.read(n)
+        self.remaining -= len(chunk)
+        return chunk
+
 
 class FleetRouter:
     """Reverse proxy + fleet scrape surface over a ServeFleet."""
 
     def __init__(self, fleet, *, registry=None, log=None,
                  retry_after_s: float = 1.0,
-                 forward_timeout_s: float = 35.0):
+                 forward_timeout_s: float = 35.0,
+                 balance: str = "p2c",
+                 pool_max_idle: int = 8,
+                 stream_threshold: int = 64 * 1024,
+                 view_window_s: float = 5.0):
+        if balance not in _BALANCE_STRATEGIES:
+            raise ValueError(
+                f"balance must be one of {_BALANCE_STRATEGIES}, "
+                f"got {balance!r}"
+            )
         self.fleet = fleet
         self.log = log
         self.retry_after_s = float(retry_after_s)
         self.forward_timeout_s = float(forward_timeout_s)
+        self.balance = balance
+        self.stream_threshold = int(stream_threshold)
+        self.view_window_s = float(view_window_s)
         self.registry = registry or obs_metrics.Registry()
-        self._rr = itertools.count()
+        self._rr = 0
+        self._rng = Random(0x7DC)
         self._httpd: ThreadingHTTPServer | None = None
+        self._lock = threading.Lock()  # rr cursor, inflight, view window
+        self._inflight: dict[str, int] = {}
+        self._win: deque = deque()  # (t_monotonic, replica, outcome)
+        self._failover_win: deque = deque()  # t_monotonic
+        self._fallback_active = False  # edge-trigger for the event
         reg = self.registry
+        self.pool = ReplicaPool(
+            registry=reg, log=log, max_idle_per_replica=pool_max_idle,
+            timeout_s=forward_timeout_s,
+        )
         reg.callback(
             "tdc_fleet_replicas",
             lambda: [({"state": s}, n)
@@ -63,39 +146,169 @@ class FleetRouter:
         )
         self._unrouted = reg.counter("tdc_fleet_unrouted_total")
         self._failovers = reg.counter("tdc_fleet_failovers_total")
+        self._decisions = reg.counter(
+            "tdc_fleet_balance_decisions_total", labelnames=("strategy",)
+        )
+        reg.callback("tdc_fleet_router_rps",
+                     lambda: round(self.view()["routed_rps"], 3))
         reg.callback("tdc_up", lambda: 1)
+        # Flush a replica's pooled sockets the moment the poll loop (or
+        # a drain edge) moves it out of READY; the router's own
+        # feedback paths flush synchronously without waiting for this.
+        if hasattr(fleet, "add_listener"):
+            fleet.add_listener(self._on_replica_state)
 
-    # ---------------- routing ----------------
+    # ---------------- lifecycle / view ----------------
+
+    def _on_replica_state(self, replica, old, new) -> None:
+        if new != READY:
+            self.pool.flush(replica.name, reason=new)
+
+    def _note(self, replica_name: str, outcome: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._win.append((now, replica_name, outcome))
+            self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.view_window_s
+        while self._win and self._win[0][0] < horizon:
+            self._win.popleft()
+        while self._failover_win and self._failover_win[0] < horizon:
+            self._failover_win.popleft()
+
+    def view(self) -> dict:
+        """The router's own recent-window reading — the autoscaler's
+        second signal source: routed rps, failover rate, and the
+        per-replica error fraction a readiness-lying replica cannot
+        hide (its /metrics look fine; its forwarded requests do not)."""
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            events = list(self._win)
+            failovers = len(self._failover_win)
+        totals: dict[str, int] = {}
+        errors: dict[str, int] = {}
+        for _, name, outcome in events:
+            totals[name] = totals.get(name, 0) + 1
+            if outcome == "error":
+                errors[name] = errors.get(name, 0) + 1
+        return {
+            "routed_rps": len(events) / self.view_window_s,
+            "failover_rate": failovers / self.view_window_s,
+            "samples": totals,
+            "error_frac": {
+                name: errors.get(name, 0) / n
+                for name, n in totals.items()
+            },
+        }
+
+    # ---------------- balancing ----------------
+
+    def _inflight_of(self, name: str) -> int:
+        with self._lock:
+            return self._inflight.get(name, 0)
+
+    def _score(self, replica) -> float:
+        """p2c load score: live in-flight count, plus the scraped p99
+        queue wait (when fresh) normalized to in-flight units."""
+        score = float(self._inflight_of(replica.name))
+        if (replica.queue_p99_ms > 0
+                and time.monotonic() - replica.queue_p99_at < _P99_FRESH_S):
+            score += replica.queue_p99_ms / _P99_SCORE_MS
+        return score
+
+    def _note_fallback(self, active: bool, n_ready: int) -> None:
+        if active and not self._fallback_active and self.log is not None:
+            self.log.event("fleet_balance_fallback", ready=n_ready)
+        self._fallback_active = active
 
     def _pick(self, exclude):
         ready = [r for r in self.fleet.ready_replicas()
                  if r not in exclude]
         if not ready:
             return None
-        return ready[next(self._rr) % len(ready)]
+        if self.balance == "p2c" and len(ready) >= 2:
+            self._note_fallback(False, len(ready))
+            a, b = self._rng.sample(ready, 2)
+            sa, sb = self._score(a), self._score(b)
+            if sa == sb:
+                # Tied (typically both idle): alternate on the rr
+                # cursor so an idle fleet still spreads instead of
+                # following the sample order's bias.
+                with self._lock:
+                    cursor = self._rr
+                    self._rr += 1
+                choice = (a, b)[cursor % 2]
+            else:
+                choice = a if sa < sb else b
+            self._decisions.labels(strategy="p2c").inc()
+            return choice
+        if self.balance == "p2c":
+            # One candidate: no choice to make — degrade to round-robin
+            # semantics, announced once per transition (not per
+            # request) so a long single-replica phase is one log line.
+            self._note_fallback(True, len(ready))
+        self._decisions.labels(strategy="rr").inc()
+        with self._lock:
+            cursor = self._rr
+            self._rr += 1
+        return ready[cursor % len(ready)]
 
-    def _forward(self, replica, method: str, path: str, body):
-        """One proxied request. Returns (status, ctype, data,
-        retry_after); raises OSError on connect/transport failure."""
-        req = urllib.request.Request(
-            replica.base_url + path, data=body, method=method
-        )
-        if body is not None:
-            req.add_header("Content-Type", "application/json")
+    # ---------------- forwarding ----------------
+
+    def _forward(self, replica, method: str, path: str, body, sink=None):
+        """One proxied request over a pooled keep-alive connection.
+        Returns (status, ctype, data, retry_after) for buffered
+        replies, or None after streaming a large OK body to `sink`.
+        Raises OSError/HTTPException on transport failure (the socket
+        is already discarded), _StreamAborted when the failure happened
+        after the response was committed to the client."""
+        conn, gen = self.pool.checkout(replica)
+        committed = False
         try:
-            with urllib.request.urlopen(
-                req, timeout=self.forward_timeout_s
-            ) as resp:
-                return (resp.status,
-                        resp.headers.get("Content-Type",
-                                         "application/json"),
-                        resp.read(),
-                        resp.headers.get("Retry-After"))
-        except urllib.error.HTTPError as e:
-            return (e.code,
-                    e.headers.get("Content-Type", "application/json"),
-                    e.read(),
-                    e.headers.get("Retry-After"))
+            headers = {}
+            send_body = body
+            if isinstance(body, _BoundedReader):
+                # Explicit Content-Length so http.client streams the
+                # reader in fixed blocks instead of chunking (the
+                # replica's stdlib server reads Content-Length only).
+                headers["Content-Length"] = str(body.remaining)
+                headers["Content-Type"] = "application/json"
+            elif body is not None:
+                headers["Content-Type"] = "application/json"
+            conn.request(method, path, body=send_body, headers=headers)
+            resp = conn.getresponse()
+            status = resp.status
+            ctype = resp.headers.get("Content-Type", "application/json")
+            retry_after = resp.headers.get("Retry-After")
+            length = resp.headers.get("Content-Length")
+            if (sink is not None and status == 200 and length is not None
+                    and int(length) > self.stream_threshold):
+                wfile = sink(status, ctype, int(length), retry_after)
+                committed = True
+                remaining = int(length)
+                while remaining > 0:
+                    chunk = resp.read(min(_COPY_BUF, remaining))
+                    if not chunk:
+                        raise http.client.IncompleteRead(b"", remaining)
+                    wfile.write(chunk)
+                    remaining -= len(chunk)
+                data = None
+            else:
+                data = resp.read()
+            if resp.will_close:
+                self.pool.discard(conn)
+            else:
+                self.pool.checkin(replica, conn, gen)
+            if committed:
+                return None
+            return status, ctype, data, retry_after
+        except Exception as e:
+            self.pool.discard(conn)
+            if committed:
+                raise _StreamAborted(str(e)) from e
+            raise
 
     @staticmethod
     def _outcome(status: int, data: bytes) -> str:
@@ -108,43 +321,70 @@ class FleetRouter:
         return reason if reason in ("shed", "backpressure", "drain") \
             else "error"
 
-    def route(self, method: str, path: str, body):
-        """Forward one request: readiness-picked replica, single-retry
-        failover on shed/drain/connect-error, fleet 503 when nothing is
-        ready. Returns (status, ctype, data_bytes, retry_after|None)."""
+    def route(self, method: str, path: str, body, sink=None):
+        """Forward one request: load-balanced over the ready replicas,
+        single-retry failover on shed/drain/connect-error, fleet 503
+        when nothing is ready. `body` is bytes/None (replayable —
+        failover applies) or a _BoundedReader for a large streamed
+        request body (consumed on send — no failover). Returns
+        (status, ctype, data_bytes, retry_after|None), or None when the
+        response streamed to `sink`."""
         tried: list = []
         last = None
+        replayable = body is None or isinstance(body, bytes)
         for attempt in (0, 1):
             replica = self._pick(tried)
             if replica is None:
                 break
             if attempt == 1:
                 self._failovers.inc()
+                with self._lock:
+                    self._failover_win.append(time.monotonic())
                 if self.log is not None:
                     self.log.event("fleet_failover", path=path,
                                    replica=replica.name)
             fault_point("fleet.route")
+            name = replica.name
+            with self._lock:
+                self._inflight[name] = self._inflight.get(name, 0) + 1
             try:
-                status, ctype, data, retry_after = self._forward(
-                    replica, method, path, body
-                )
-            except OSError:
-                self._routed.labels(
-                    replica=replica.name, outcome="error"
-                ).inc()
+                out = self._forward(replica, method, path, body, sink)
+            except _StreamAborted:
+                self._routed.labels(replica=name, outcome="error").inc()
+                self._note(name, "error")
+                raise
+            except (OSError, http.client.HTTPException):
+                self._routed.labels(replica=name, outcome="error").inc()
+                self._note(name, "error")
                 replica.mark_not_ready()
+                self.pool.flush(name, reason="transport_error")
                 tried.append(replica)
+                if not replayable:
+                    break  # body consumed: nothing left to fail over
                 continue
+            finally:
+                with self._lock:
+                    n = self._inflight.get(name, 1) - 1
+                    if n > 0:
+                        self._inflight[name] = n
+                    else:
+                        self._inflight.pop(name, None)
+            if out is None:  # streamed to the client, request complete
+                self._routed.labels(replica=name, outcome="ok").inc()
+                self._note(name, "ok")
+                return None
+            status, ctype, data, retry_after = out
             outcome = self._outcome(status, data)
-            self._routed.labels(
-                replica=replica.name, outcome=outcome
-            ).inc()
-            if outcome in _FAILOVER_REASONS and attempt == 0:
+            self._routed.labels(replica=name, outcome=outcome).inc()
+            self._note(name, outcome)
+            if (outcome in _FAILOVER_REASONS and attempt == 0
+                    and replayable):
                 replica.mark_not_ready()
+                self.pool.flush(name, reason=outcome)
                 tried.append(replica)
-                last = (status, ctype, data, retry_after)
+                last = out
                 continue
-            return status, ctype, data, retry_after
+            return out
         if last is not None:
             # Failover had nowhere to go: relay the replica's 503 (it
             # carries the honest reason + Retry-After) rather than
@@ -156,7 +396,8 @@ class FleetRouter:
         payload = {
             "error": "overloaded",
             "reason": "shed",
-            "trigger": "no_ready_replica",
+            "trigger": ("forward_failed" if tried
+                        else "no_ready_replica"),
             "retry_after_s": self.retry_after_s,
         }
         return (503, "application/json", json.dumps(payload).encode(),
@@ -195,6 +436,7 @@ class FleetRouter:
             httpd, self._httpd = self._httpd, None
             if httpd is not None:
                 httpd.server_close()
+            self.pool.flush_all(reason="router_stopped")
 
     def start_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Non-blocking router serving on a daemon thread; returns the
@@ -218,6 +460,7 @@ class FleetRouter:
             return False
         httpd.shutdown()
         httpd.server_close()
+        self.pool.flush_all(reason="router_stopped")
         return True
 
 
@@ -225,6 +468,12 @@ def _make_router_httpd(router: FleetRouter, host: str,
                        port: int) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # One TCP segment per buffered response (see serve/server.py:
+        # the unbuffered default costs a Nagle/delayed-ACK stall). The
+        # streamed-sink path writes through the same buffer; its large
+        # block copies pass straight through, and handle_one_request
+        # flushes at request end.
+        wbufsize = -1
 
         def log_message(self, fmt, *args):  # structlog, not stderr noise
             if router.log is not None:
@@ -237,8 +486,42 @@ def _make_router_httpd(router: FleetRouter, host: str,
             self.send_header("Content-Length", str(len(data)))
             if retry_after is not None:
                 self.send_header("Retry-After", retry_after)
+            if self.close_connection:
+                self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.write(data)
+
+        def _sink(self, status, ctype, length, retry_after=None):
+            """Commit status+headers for a streamed response; returns
+            the client socket's write file for the body copy."""
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(length))
+            if retry_after is not None:
+                self.send_header("Retry-After", retry_after)
+            self.end_headers()
+            return self.wfile
+
+        def _route(self, method, body) -> None:
+            try:
+                out = router.route(method, self.path, body, sink=self._sink)
+            except _StreamAborted:
+                # Mid-stream upstream failure after the status line was
+                # sent: the only honest move left is dropping the
+                # client connection (the truncated Content-Length makes
+                # the failure unambiguous client-side).
+                self.close_connection = True
+                return
+            if isinstance(body, _BoundedReader) and body.remaining > 0:
+                # The streamed request body was not fully consumed (the
+                # forward failed mid-send, or no replica was ready to
+                # receive it): the unread bytes are still in rfile, and
+                # a keep-alive peer's next request would be parsed out
+                # of them. Close the connection (advertised in _reply's
+                # Connection header) so the client redials clean.
+                self.close_connection = True
+            if out is not None:
+                self._reply(*out)
 
         def do_GET(self):
             local = router.handle_get(self.path)
@@ -246,11 +529,18 @@ def _make_router_httpd(router: FleetRouter, host: str,
                 status, ctype, text = local
                 self._reply(status, ctype, text.encode())
                 return
-            self._reply(*router.route("GET", self.path, None))
+            self._route("GET", None)
 
         def do_POST(self):
             length = int(self.headers.get("Content-Length", "0"))
+            if length > router.stream_threshold:
+                # Large body: hand the bounded reader through so the
+                # upstream send is a fixed-buffer copy, never a
+                # router-resident buffer (cost: no failover — see
+                # route()).
+                self._route("POST", _BoundedReader(self.rfile, length))
+                return
             body = self.rfile.read(length) if length else b"{}"
-            self._reply(*router.route("POST", self.path, body))
+            self._route("POST", body)
 
     return ThreadingHTTPServer((host, port), Handler)

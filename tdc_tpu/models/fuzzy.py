@@ -204,7 +204,7 @@ def fuzzy_cmeans_fit(
                 history=np.asarray(res.history)[: int(res.n_iter)]
             )
         return res
-    if kernel == "auto":
+    if kernel.startswith("auto"):
         from tdc_tpu.ops.pallas_kernels import resolve_kernel
 
         kernel = resolve_kernel(

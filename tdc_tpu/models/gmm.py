@@ -356,7 +356,7 @@ def gmm_fit(
     # work, and each solve's RHS is (d, N) with N data-sharded, which XLA
     # distributes column-wise like any batched op; the Σ r·xxᵀ contraction
     # reduces over the sharded N axis into a psum'd (K, d, d)).
-    if kernel == "auto":
+    if kernel.startswith("auto"):
         from tdc_tpu.ops.pallas_kernels import resolve_kernel
 
         kernel = resolve_kernel(
@@ -855,7 +855,7 @@ def streamed_gmm_fit(
         )
     # full covariance runs under the mesh too (see gmm_fit's note: the
     # solves' RHS shards over N; the round-4 gate was overcautious).
-    if kernel == "auto":
+    if kernel.startswith("auto"):
         from tdc_tpu.ops.pallas_kernels import resolve_kernel
 
         kernel = resolve_kernel(

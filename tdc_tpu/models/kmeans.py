@@ -448,7 +448,7 @@ def kmeans_fit(
             )
         return res
 
-    if kernel == "auto":
+    if kernel.startswith("auto"):
         from tdc_tpu.ops.pallas_kernels import resolve_kernel
 
         kernel = resolve_kernel(
@@ -459,6 +459,10 @@ def kmeans_fit(
             ineligible=(
                 "sample weights with a mesh have no weighted Pallas tower"
                 if sample_weight is not None and mesh is not None else None
+            ),
+            mxu_ineligible=(
+                "the bf16-MXU epilogue has no shard_map tower"
+                if mesh is not None else None
             ),
         )
     if sample_weight is not None and kernel == "refined":
@@ -536,7 +540,8 @@ def kmeans_predict(
     if spherical:
         x = _normalize(x.astype(jnp.float32))
     centroids = jnp.asarray(centroids)
-    if kernel == "auto":
+    if kernel.startswith("auto"):  # ':quantized' is a stats knob; predict
+        # is assignment-only, so it resolves like plain auto here.
         on_tpu = jax.devices()[0].platform == "tpu"
         big = 4 * x.shape[0] * centroids.shape[0] > (1 << 30)
         kernel = "pallas" if (on_tpu and big) else "xla"

@@ -362,11 +362,14 @@ def minibatch_kmeans_fit(
     from tdc_tpu.models.kmeans import KMeansResult
     from tdc_tpu.models.streaming import _prefetched
 
-    if kernel == "auto":
+    if kernel.startswith("auto"):
         from tdc_tpu.ops.pallas_kernels import resolve_kernel
 
-        kernel = resolve_kernel(kernel, k=k, d=d, model="kmeans",
-                                label="minibatch_kmeans_fit")
+        kernel = resolve_kernel(
+            kernel, k=k, d=d, model="kmeans",
+            label="minibatch_kmeans_fit",
+            mxu_ineligible="mini-batch updates have no bf16-MXU epilogue",
+        )
     mbk = MiniBatchKMeans(k, d, init=init, key=key, mesh=mesh,
                           reassignment_ratio=reassignment_ratio,
                           kernel=kernel)

@@ -81,6 +81,13 @@ def _accumulate(
             from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto
 
             s = lloyd_stats_auto(batch, centroids)
+    elif kernel == "pallas_bf16":
+        # Single-device only (resolve_kernel/"auto:quantized" and the
+        # explicit-kernel guards keep the mesh path off this branch):
+        # f32 cross terms on the bf16 MXU, f32 accumulate.
+        from tdc_tpu.ops.pallas_kernels import lloyd_stats_auto
+
+        s = lloyd_stats_auto(batch, centroids, mxu_dtype="bfloat16")
     elif mesh is not None and mesh_lib.is_hierarchical(mesh):
         # Hierarchical (dcn, ici) mesh: the explicit two-stage tower — an
         # intra-host ICI psum, then one inter-host psum of the combined
@@ -103,7 +110,11 @@ def _accumulate(
     # the batch dtype (bf16 norm ties can pick a different winner than f32),
     # the XLA path in f32. One shared correction (padding_correction) so the
     # per-batch and per-pass paths can never drift.
-    cd = centroids.astype(batch.dtype) if kernel == "pallas" else centroids
+    # (pallas_bf16 requires f32 inputs, so the cast is a no-op there; its
+    # zero pad rows have an exactly-zero cross term in any precision, so
+    # d² = ‖c‖² in f32 and the correction argmin matches the kernel's.)
+    cd = (centroids.astype(batch.dtype)
+          if kernel in ("pallas", "pallas_bf16") else centroids)
     counts, sse = padding_correction(s.counts, s.sse, cd, n_pad)
     return SufficientStats(
         sums=acc.sums + s.sums, counts=acc.counts + counts, sse=acc.sse + sse
@@ -1019,7 +1030,8 @@ def _resident_lloyd_fns(mesh, k, d, spherical, kernel, quantize, weighted,
                  else device_cache_lib.cache_pad_rows(cache))
         return _lloyd_pass_correction(
             acc, c, n_pad,
-            cast=str(cache.tail.dtype) if kernel == "pallas" else None,
+            cast=(str(cache.tail.dtype)
+                  if kernel in ("pallas", "pallas_bf16") else None),
         ), aux
 
     def update_fn(acc, c):
@@ -1486,15 +1498,34 @@ def streamed_kmeans_fit(
         model="kmeans_weighted" if weighted else "kmeans",
         label="streamed_kmeans_fit",
         ineligible=ineligible,
+        mxu_ineligible=(
+            "the bf16-MXU epilogue has no shard_map tower"
+            if mesh is not None else None
+        ),
     )
-    if kernel not in ("xla", "pallas"):
-        raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
+    if kernel not in ("xla", "pallas", "pallas_bf16"):
+        raise ValueError(
+            f"unknown kernel {kernel!r} (use 'xla', 'pallas', or "
+            "'pallas_bf16')"
+        )
     strategy = reduce_lib.resolve_reduce(reduce)
     if weighted and kernel == "pallas" and mesh is not None:
         raise ValueError(
             "kernel='pallas' with sample_weight_batches is single-device "
             "(the weighted kernels have no shard_map tower); drop mesh or "
             "the explicit kernel"
+        )
+    if kernel == "pallas_bf16" and mesh is not None:
+        raise ValueError(
+            "kernel='pallas_bf16' is single-device (the bf16-MXU epilogue "
+            "has no shard_map tower; stream bf16 batches with "
+            "kernel='pallas' for the same MXU precision on a mesh)"
+        )
+    if kernel == "pallas_bf16" and weighted:
+        raise ValueError(
+            "kernel='pallas_bf16' does not support sample_weight_batches "
+            "(the weighted epilogue keeps full precision); drop the "
+            "explicit kernel"
         )
     if aspec.coarse:
         if weighted:
@@ -1503,16 +1534,16 @@ def streamed_kmeans_fit(
                 "(the tile-pruned stats have no weighted fold); use "
                 "assign='exact'"
             )
-        if kernel == "pallas":
+        if kernel in ("pallas", "pallas_bf16"):
             raise ValueError(
                 "assign='coarse' is its own tile-pruned stats path and "
-                "cannot combine with kernel='pallas'; drop the explicit "
+                f"cannot combine with kernel={kernel!r}; drop the explicit "
                 "kernel (or use assign='exact')"
             )
-    if bounded and kernel == "pallas":
+    if bounded and kernel in ("pallas", "pallas_bf16"):
         raise ValueError(
             "assign='bounded' is its own masked-recompute stats path and "
-            "cannot combine with kernel='pallas'; drop the explicit "
+            f"cannot combine with kernel={kernel!r}; drop the explicit "
             "kernel (or use assign='exact')"
         )
     stream = _weighted_stream(batches, sample_weight_batches)

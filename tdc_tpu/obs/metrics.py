@@ -233,6 +233,23 @@ CATALOG = {
                    "shed or connect error."),
     "tdc_fleet_scale_events_total": (
         "counter", "Autoscaler actions by direction (up, down, replace)."),
+    # router data plane (fleet/pool.py + the pooled/balanced router,
+    # PR 20). Exported by the router's registry.
+    "tdc_fleet_pool_checkouts_total": (
+        "counter", "Connections checked out of the router's keep-alive "
+                   "pool (one per forwarded request attempt)."),
+    "tdc_fleet_pool_reuses_total": (
+        "counter", "Pool checkouts satisfied by an idle kept-alive "
+                   "socket instead of a fresh dial."),
+    "tdc_fleet_pool_discards_total": (
+        "counter", "Pooled sockets closed: transport failure, replica "
+                   "left READY / generation restart, or pool overflow."),
+    "tdc_fleet_balance_decisions_total": (
+        "counter", "Router replica picks by balancing strategy "
+                   "(p2c, rr)."),
+    "tdc_fleet_router_rps": (
+        "gauge", "Requests the router forwarded per second over its "
+                 "recent view window."),
 }
 
 # Fixed buckets for the serve latency/queue-wait/device-ms histograms, in

@@ -818,6 +818,7 @@ def resolve_kernel(
     platform: str | None = None,
     label: str = "",
     ineligible: str | None = None,
+    mxu_ineligible: str | None = None,
 ) -> str:
     """The default-kernel auto policy (ROADMAP item 1b): kernel='auto'
     resolves to 'pallas' when the fused (K, d) block fits VMEM on TPU via
@@ -827,11 +828,17 @@ def resolve_kernel(
     choice and the reason every time auto decides. Explicitly named
     kernels ('xla', 'pallas', 'pallas_bf16', ...) pass through untouched,
     so existing behavior is bit-identical when the knob is spelled out.
-    auto itself never resolves to 'pallas_bf16': the bf16-MXU epilogue
-    rounds f32 assignment distances, and an auto policy must be
-    numerics-preserving — opting into the half-precision MXU is always
-    explicit (for bf16 INPUTS the plain fused kernel already runs the MXU
-    at bf16, so auto loses nothing).
+
+    Plain 'auto' never resolves to 'pallas_bf16': the bf16-MXU epilogue
+    rounds f32 assignment distances, and the default policy must be
+    numerics-preserving. 'auto:quantized' is the opt-in spelling — the
+    caller accepts quantized-reduce tolerances (the PR-2 harness bounds:
+    the same ~1e-2 relative band the collective-compression path is
+    tested to), and auto may then pick 'pallas_bf16' where the epilogue
+    applies: TPU, model='kmeans', f32 inputs (itemsize 4 — bf16 inputs
+    already run the MXU at bf16 under plain 'pallas'), fused-feasible.
+    Anywhere the epilogue cannot apply, ':quantized' degrades to the
+    plain auto choice with the reason in the event — never an error.
 
     `k` is the per-device centroid count (callers on the K-sharded towers
     pass K / n_model — VMEM feasibility is a per-shard question).
@@ -843,9 +850,13 @@ def resolve_kernel(
     TPU branch from the CPU CI this way). `ineligible` names a caller-side
     reason the Pallas path cannot apply at all (e.g. weighted + mesh has
     no weighted shard_map tower) — auto then resolves to 'xla' with that
-    reason in the event instead of tripping the explicit-kernel guard."""
-    if kernel != "auto":
+    reason in the event instead of tripping the explicit-kernel guard.
+    `mxu_ineligible` names a caller-side reason only the bf16 epilogue
+    cannot apply (e.g. the mesh tower path has no mxu_dtype plumbing) —
+    ':quantized' then settles for the plain auto choice."""
+    if kernel not in ("auto", "auto:quantized"):
         return kernel
+    quantized = kernel == "auto:quantized"
     from tdc_tpu.utils.structlog import emit
 
     if platform is None:
@@ -882,6 +893,25 @@ def resolve_kernel(
             if feasible
             else f"(K={k}, d={d}) exceeds the fused-kernel VMEM model"
         )
+        if quantized and choice == "pallas":
+            if mxu_ineligible is not None:
+                reason += f"; bf16-MXU declined: {mxu_ineligible}"
+            elif model != "kmeans":
+                reason += (
+                    f"; bf16-MXU declined: the epilogue is kmeans-fused "
+                    f"only (model={model})"
+                )
+            elif itemsize != 4:
+                reason += (
+                    "; bf16-MXU declined: inputs are not f32 — the plain "
+                    "fused kernel already runs the MXU at input precision"
+                )
+            else:
+                choice = "pallas_bf16"
+                reason += (
+                    "; :quantized accepted — f32 cross terms on the "
+                    "bf16 MXU, f32 accumulate (PR-2 tolerance band)"
+                )
     emit("kernel_selected", kernel=choice, model=model, k=int(k), d=int(d),
          reason=reason, label=label)
     return choice

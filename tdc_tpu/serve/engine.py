@@ -237,7 +237,9 @@ class PredictEngine:
         ):
             return "coarse"
         k = entry.fitted.kernel
-        return "xla" if k in ("auto", "") else k
+        # ':quantized' is a training-stats knob; serving predict is
+        # assignment-only, so every auto spelling means xla here.
+        return "xla" if k.startswith("auto") or k == "" else k
 
     def _coarse_spec(self, entry: ModelEntry):
         """The per-model CoarseSpec from the manifest's `assign`/`probe`/

@@ -769,6 +769,12 @@ class ServeApp:
 def _make_httpd(app: ServeApp, host: str, port: int) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # Buffer the response so status line, headers, and body leave in
+        # ONE TCP segment (handle_one_request flushes per request). The
+        # stdlib default (wbufsize=0) writes them as separate small
+        # segments, and Nagle + the peer's delayed ACK turns that into a
+        # ~40 ms stall per response for a single-in-flight client.
+        wbufsize = -1
 
         def log_message(self, fmt, *args):  # structlog, not stderr noise
             if app.log is not None:

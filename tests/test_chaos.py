@@ -1188,6 +1188,12 @@ def test_chaos_fleet_kill9_failover_replace_and_drain(tmp_path):
 
     scrape = router.registry.render()
     survivors = fleet.snapshot()
+    # Pool hygiene after kill -9: the casualty's keep-alive sockets were
+    # flushed (state listener + transport-error discard), never re-pooled
+    # — a hung pooled socket would have shown up as results["hung"] > 0.
+    assert router.pool.idle_count(casualty.name) == 0
+    assert scrape_counter(scrape, "tdc_fleet_pool_discards_total") > 0, scrape
+    assert scrape_counter(scrape, "tdc_fleet_pool_reuses_total") > 0, scrape
     fleet.stop(drain=True)
 
     assert results["hung"] == 0, results

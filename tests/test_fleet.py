@@ -10,7 +10,10 @@ markers, and the scrape-verified elasticity loop in
 benchmarks/bench_fleet.py --smoke.
 """
 
+import http.client
 import json
+import socket
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -31,6 +34,7 @@ from tdc_tpu.fleet import (
     AutoscalerConfig,
     FleetRouter,
     Replica,
+    ReplicaPool,
     ServeFleet,
 )
 from tdc_tpu.models.kmeans import kmeans_fit, kmeans_predict
@@ -530,3 +534,529 @@ class TestFleetFaultPoints:
         with pytest.raises(RuntimeError, match="fleet.scale"):
             scaler.evaluate_once()
         assert len(fleet.snapshot()) == 1  # fault fired before the add
+
+
+# ---------------------------------------------------------------------------
+# pooled keep-alive data plane
+# ---------------------------------------------------------------------------
+
+
+def _counting_server():
+    """Keep-alive HTTP/1.1 server that counts TCP connections (one
+    handler instantiation per accepted connection) — the server-side
+    witness for whether the router's pool actually reuses sockets."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    state = {"connections": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def setup(self):
+            state["connections"] += 1
+            super().setup()
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _reply(self):
+            data = b'{"pong": true}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            self._reply()
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            if n:
+                self.rfile.read(n)
+            self._reply()
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, state
+
+
+class _RecorderLog:
+    """Minimal structured-log stand-in capturing event() calls."""
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, name, **fields):
+        self.events.append((name, fields))
+
+    def named(self, name):
+        return [f for n, f in self.events if n == name]
+
+
+class TestReplicaPool:
+    def _replica(self):
+        r = Replica("r0", "http://127.0.0.1:1")
+        r.state = READY
+        r.generation = 1
+        return r
+
+    def test_sequential_requests_reuse_one_socket(self):
+        httpd, state = _counting_server()
+        try:
+            port = httpd.server_address[1]
+            fleet = ServeFleet(
+                lambda name: Replica(name, f"http://127.0.0.1:{port}"))
+            r = fleet.add_replica()
+            r.state = READY
+            r.generation = 1
+            router = FleetRouter(fleet)
+            for _ in range(6):
+                status, _, _, _ = router.route("POST", "/predict", b"{}")
+                assert status == 200
+            assert state["connections"] == 1  # keep-alive held throughout
+            scrape = router.registry.render()
+            assert obs_metrics.scrape_counter(
+                scrape, "tdc_fleet_pool_checkouts_total") == 6
+            assert obs_metrics.scrape_counter(
+                scrape, "tdc_fleet_pool_reuses_total") == 5
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_pool_disabled_dials_per_request(self):
+        httpd, state = _counting_server()
+        try:
+            port = httpd.server_address[1]
+            fleet = ServeFleet(
+                lambda name: Replica(name, f"http://127.0.0.1:{port}"))
+            r = fleet.add_replica()
+            r.state = READY
+            router = FleetRouter(fleet, pool_max_idle=0)
+            for _ in range(4):
+                status, _, _, _ = router.route("POST", "/predict", b"{}")
+                assert status == 200
+            assert state["connections"] == 4  # the PR-16 data plane
+            assert router.pool.idle_count() == 0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_checkin_refuses_non_ready_replica(self):
+        pool = ReplicaPool(registry=obs_metrics.Registry())
+        r = self._replica()
+        conn, gen = pool.checkout(r)
+        r.state = DRAINING
+        pool.checkin(r, conn, gen)
+        assert pool.idle_count("r0") == 0
+
+    def test_checkin_refuses_stale_generation(self):
+        pool = ReplicaPool(registry=obs_metrics.Registry())
+        r = self._replica()
+        conn, gen = pool.checkout(r)
+        r.generation += 1  # replica flapped while the request was out
+        pool.checkin(r, conn, gen)
+        assert pool.idle_count("r0") == 0
+
+    def test_checkout_drops_stale_generation_idles(self):
+        reg = obs_metrics.Registry()
+        pool = ReplicaPool(registry=reg)
+        r = self._replica()
+        conn, gen = pool.checkout(r)
+        pool.checkin(r, conn, gen)
+        assert pool.idle_count("r0") == 1
+        r.generation += 1  # restart: the pooled socket points at a ghost
+        _, gen2 = pool.checkout(r)
+        assert gen2 == r.generation
+        assert pool.idle_count("r0") == 0
+        assert obs_metrics.scrape_counter(
+            reg.render(), "tdc_fleet_pool_reuses_total") == 0
+
+    def test_max_idle_bounds_retained_sockets(self):
+        pool = ReplicaPool(registry=obs_metrics.Registry(),
+                           max_idle_per_replica=1)
+        r = self._replica()
+        c1, g1 = pool.checkout(r)
+        c2, g2 = pool.checkout(r)
+        pool.checkin(r, c1, g1)
+        pool.checkin(r, c2, g2)  # overflow: closed, never pooled
+        assert pool.idle_count("r0") == 1
+
+    def test_state_listener_flushes_pool_on_drain(self):
+        fleet = _fake_fleet(2)
+        log = _RecorderLog()
+        router = FleetRouter(fleet, log=log)
+        r = fleet.snapshot()[0]
+        conn, gen = router.pool.checkout(r)
+        router.pool.checkin(r, conn, gen)
+        assert router.pool.idle_count(r.name) == 1
+        fleet.drain_replica(r)  # controller edge -> listener -> flush
+        assert router.pool.idle_count(r.name) == 0
+        flushes = log.named("fleet_pool_flush")
+        assert flushes and flushes[0]["replica"] == r.name
+        assert flushes[0]["reason"] == DRAINING
+
+    def test_probe_bumps_generation_on_ready_reentry(self, model_dir):
+        apps = []
+        r = _inproc_spawner(model_dir, apps)("r0")
+        try:
+            assert r.generation == 0
+            assert r.probe() == READY
+            assert r.generation == 1
+            assert r.probe() == READY
+            assert r.generation == 1  # steady READY: no churn
+            r.mark_not_ready()
+            assert r.probe() == READY
+            assert r.generation == 2  # re-entry invalidates pooled socks
+        finally:
+            apps[0].stop()
+
+
+# ---------------------------------------------------------------------------
+# queue-aware balancing + router view
+# ---------------------------------------------------------------------------
+
+
+class TestQueueAwareBalancing:
+    def test_p2c_prefers_fewer_inflight(self):
+        fleet = _fake_fleet(2)
+        router = FleetRouter(fleet)
+        a, b = fleet.snapshot()
+        with router._lock:
+            router._inflight[a.name] = 4
+        assert {router._pick([]).name for _ in range(10)} == {b.name}
+
+    def test_p2c_scores_fresh_queue_p99(self):
+        fleet = _fake_fleet(2)
+        router = FleetRouter(fleet)
+        a, b = fleet.snapshot()
+        a.queue_p99_ms = 500.0  # ten in-flight equivalents
+        a.queue_p99_at = time.monotonic()
+        assert {router._pick([]).name for _ in range(10)} == {b.name}
+
+    def test_p2c_ignores_stale_queue_p99(self):
+        fleet = _fake_fleet(2)
+        router = FleetRouter(fleet)
+        a, b = fleet.snapshot()
+        a.queue_p99_ms = 500.0
+        a.queue_p99_at = time.monotonic() - 60.0  # beyond _P99_FRESH_S
+        picks = {router._pick([]).name for _ in range(12)}
+        assert picks == {a.name, b.name}  # tie: alternation spreads
+
+    def test_rr_mode_alternates(self):
+        fleet = _fake_fleet(2)
+        router = FleetRouter(fleet, balance="rr")
+        names = [router._pick([]).name for _ in range(4)]
+        assert names[0] != names[1]
+        assert names[:2] == names[2:]
+
+    def test_invalid_balance_rejected(self):
+        with pytest.raises(ValueError, match="balance"):
+            FleetRouter(_fake_fleet(1), balance="fifo")
+
+    def test_single_ready_degrades_to_rr_with_one_event(self):
+        fleet = _fake_fleet(2)
+        log = _RecorderLog()
+        router = FleetRouter(fleet, log=log)
+        a, b = fleet.snapshot()
+        router._pick([])
+        router._pick([])
+        a.state = NOT_READY
+        for _ in range(3):
+            assert router._pick([]) is b
+        scrape = router.registry.render()
+        assert obs_metrics.scrape_counter(
+            scrape, "tdc_fleet_balance_decisions_total",
+            {"strategy": "p2c"}) == 2
+        assert obs_metrics.scrape_counter(
+            scrape, "tdc_fleet_balance_decisions_total",
+            {"strategy": "rr"}) == 3
+        # Edge-triggered: one event covers the whole degraded phase...
+        assert len(log.named("fleet_balance_fallback")) == 1
+        a.state = READY
+        router._pick([])  # pair restored: the edge re-arms
+        a.state = NOT_READY
+        router._pick([])
+        assert len(log.named("fleet_balance_fallback")) == 2
+
+
+class TestRouterView:
+    def test_view_aggregates_window(self):
+        fleet = _fake_fleet(2)
+        router = FleetRouter(fleet, view_window_s=60.0)
+        router._note("r0", "ok")
+        router._note("r0", "error")
+        router._note("r1", "ok")
+        with router._lock:
+            router._failover_win.append(time.monotonic())
+        v = router.view()
+        assert v["samples"] == {"r0": 2, "r1": 1}
+        assert v["error_frac"] == {"r0": 0.5, "r1": 0.0}
+        assert v["routed_rps"] == pytest.approx(3 / 60.0)
+        assert v["failover_rate"] == pytest.approx(1 / 60.0)
+
+    def test_view_window_expires(self):
+        router = FleetRouter(_fake_fleet(1), view_window_s=0.05)
+        router._note("r0", "ok")
+        time.sleep(0.1)
+        v = router.view()
+        assert v["samples"] == {}
+        assert v["routed_rps"] == 0.0
+
+    def test_router_rps_gauge_rendered(self):
+        router = FleetRouter(_fake_fleet(1), view_window_s=60.0)
+        for _ in range(6):
+            router._note("r0", "ok")
+        assert obs_metrics.scrape_counter(
+            router.registry.render(), "tdc_fleet_router_rps"
+        ) == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler x router view
+# ---------------------------------------------------------------------------
+
+
+class _StubRouterView:
+    """Canned router.view() source for autoscaler decision tests."""
+
+    def __init__(self, **view):
+        self._view = {"routed_rps": 0.0, "failover_rate": 0.0,
+                      "samples": {}, "error_frac": {}}
+        self._view.update(view)
+
+    def view(self):
+        return dict(self._view)
+
+
+class TestAutoscalerRouterView:
+    def test_signals_merge_router_view(self):
+        fleet = _fake_fleet(1)
+        stub = _StubRouterView(routed_rps=7.5, failover_rate=0.25,
+                               samples={"r0": 9}, error_frac={"r0": 0.1})
+        scaler = Autoscaler(fleet, registry=obs_metrics.Registry(),
+                            router=stub)
+        sig = scaler.signals()
+        assert sig["routed_rps"] == 7.5
+        assert sig["failover_rate"] == 0.25
+        assert sig["error_samples"] == {"r0": 9}
+        assert sig["error_frac"] == {"r0": 0.1}
+
+    def test_error_frac_replaces_readiness_liar(self):
+        fleet = _fake_fleet(2)
+        liar = fleet.snapshot()[0]
+        reg = obs_metrics.Registry()
+        stub = _StubRouterView(samples={liar.name: 8},
+                               error_frac={liar.name: 1.0})
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            min_replicas=2, max_replicas=2, cooldown_s=0.0,
+            up_hold_s=3600.0, down_hold_s=3600.0,
+        ), registry=reg, router=stub)
+        scaler.evaluate_once()
+        assert liar.state == DRAINING  # condemned despite a healthy readyz
+        assert _events(reg, "replace") == 1
+        live = [r for r in fleet.snapshot() if r.state == READY]
+        assert len(live) == 2  # replacement spawned alongside the survivor
+
+    def test_error_frac_needs_min_samples(self):
+        fleet = _fake_fleet(2)
+        liar = fleet.snapshot()[0]
+        reg = obs_metrics.Registry()
+        stub = _StubRouterView(samples={liar.name: 2},
+                               error_frac={liar.name: 1.0})
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            cooldown_s=0.0, up_hold_s=3600.0, down_hold_s=3600.0,
+            error_min_samples=4,
+        ), registry=reg, router=stub)
+        scaler.evaluate_once()
+        assert liar.state == READY  # a 2-sample window convicts nobody
+        assert _events(reg, "replace") == 0
+
+    def test_error_frac_below_threshold_is_tolerated(self):
+        fleet = _fake_fleet(2)
+        suspect = fleet.snapshot()[0]
+        reg = obs_metrics.Registry()
+        stub = _StubRouterView(samples={suspect.name: 20},
+                               error_frac={suspect.name: 0.3})
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            cooldown_s=0.0, up_hold_s=3600.0, down_hold_s=3600.0,
+            error_frac_high=0.5,
+        ), registry=reg, router=stub)
+        scaler.evaluate_once()
+        assert suspect.state == READY
+        assert _events(reg, "replace") == 0
+
+    def test_failover_rate_triggers_scale_out(self):
+        fleet = _fake_fleet(1)
+        reg = obs_metrics.Registry()
+        stub = _StubRouterView(failover_rate=2.0)
+        scaler = Autoscaler(fleet, AutoscalerConfig(
+            max_replicas=3, cooldown_s=0.0, up_hold_s=0.0,
+            down_hold_s=3600.0, failover_rate_high=1.0,
+        ), registry=reg, router=stub)
+        scaler.evaluate_once()
+        assert len(fleet.snapshot()) == 2
+        assert _events(reg, "up") == 1
+
+    def test_signals_stamp_queue_p99_on_replicas(self):
+        class _HistReplica(_FakeReplica):
+            def __init__(self, name):
+                super().__init__(name)
+                self.counts = (0, 0, 0)
+
+            def scrape(self, timeout=2.0):
+                lo, mid, inf = self.counts
+                return (
+                    super().scrape(timeout)
+                    + f'tdc_serve_queue_wait_ms_bucket{{le="5"}} {lo}\n'
+                    + f'tdc_serve_queue_wait_ms_bucket{{le="50"}} {mid}\n'
+                    + f'tdc_serve_queue_wait_ms_bucket{{le="+Inf"}} {inf}\n'
+                )
+
+        fleet = ServeFleet(_HistReplica, poll_interval=9999)
+        r = fleet.add_replica()
+        scaler = Autoscaler(fleet, registry=obs_metrics.Registry())
+        scaler.signals()  # baseline scrape
+        assert r.queue_p99_at == 0.0
+        r.counts = (0, 10, 10)  # 10 waits landed in (5, 50] ms
+        sig = scaler.signals()
+        assert 5.0 < r.queue_p99_ms <= 50.0
+        assert r.queue_p99_at > 0.0
+        assert sig["p99_wait_ms"] == r.queue_p99_ms
+
+
+# ---------------------------------------------------------------------------
+# streamed request/response forwarding
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestStreamedDataPlane:
+    def test_large_predict_streams_both_directions(self, fleet2):
+        fleet, router, _ = fleet2
+        router.stream_threshold = 256  # force both streaming paths
+        port = router.start_http("127.0.0.1", 0)
+        try:
+            body = _predict_body(rows=300)
+            assert len(body) > 256  # request streams via _BoundedReader
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+                out = json.loads(resp.read())
+            assert len(out["labels"]) == 300  # intact through both copies
+        finally:
+            router.stop_http()
+
+    def test_streamed_request_does_not_fail_over(self):
+        # Two READY ghosts: a replayable body would fail over (and
+        # count a failover); a streamed one is consumed on first send,
+        # so the router must give up honestly instead.
+        fleet = ServeFleet(lambda name: Replica(name, "http://x:1"))
+        for name in ("g0", "g1"):
+            ghost = Replica(name, f"http://127.0.0.1:{_free_port()}")
+            ghost.state = READY
+            fleet.replicas.append(ghost)
+        router = FleetRouter(fleet, stream_threshold=256)
+        port = router.start_http("127.0.0.1", 0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=_predict_body(rows=100),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["trigger"] == "forward_failed"
+            assert obs_metrics.scrape_counter(
+                router.registry.render(), "tdc_fleet_failovers_total") == 0
+        finally:
+            router.stop_http()
+
+    def test_keepalive_survives_forward_failed_streamed_503(self):
+        # A streamed request body the forward never (fully) consumed
+        # leaves its unread bytes in the client connection's rfile; the
+        # router must close that connection with the 503 (advertised
+        # via Connection: close) so a keep-alive client's NEXT request
+        # is parsed from a clean socket — not from the stale body
+        # bytes, which used to come back as a bogus 501.
+        fleet = ServeFleet(lambda name: Replica(name, "http://x:1"))
+        ghost = Replica("g0", f"http://127.0.0.1:{_free_port()}")
+        ghost.state = READY
+        fleet.replicas.append(ghost)
+        router = FleetRouter(fleet, stream_threshold=256)
+        port = router.start_http("127.0.0.1", 0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("POST", "/predict", body=_predict_body(rows=100),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 503
+            assert json.loads(resp.read())["trigger"] == "forward_failed"
+            assert resp.will_close  # router said Connection: close
+            # http.client redials transparently after a closed
+            # response; the follow-up must be a clean local 200.
+            conn.request("GET", "/healthz")
+            resp2 = conn.getresponse()
+            assert resp2.status == 200
+            assert json.loads(resp2.read())["status"] == "ok"
+            conn.close()
+        finally:
+            router.stop_http()
+
+    def test_midstream_upstream_death_drops_client_connection(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Truncating(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                if n:
+                    self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", "1048576")
+                self.end_headers()
+                self.wfile.write(b'{"labels": [')
+                self.wfile.flush()
+                self.connection.shutdown(socket.SHUT_WR)  # die mid-body
+                self.close_connection = True
+
+        upstream = ThreadingHTTPServer(("127.0.0.1", 0), Truncating)
+        threading.Thread(target=upstream.serve_forever, daemon=True).start()
+        fleet = ServeFleet(lambda name: Replica(
+            name, f"http://127.0.0.1:{upstream.server_address[1]}"))
+        r = fleet.add_replica()
+        r.state = READY
+        router = FleetRouter(fleet, stream_threshold=256)
+        port = router.start_http("127.0.0.1", 0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("POST", "/predict", body=b"{}",
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            # Headers were already committed when the upstream died; the
+            # router's only honest move is dropping the connection so
+            # the short body is unambiguous client-side.
+            assert resp.status == 200
+            with pytest.raises((http.client.HTTPException, OSError)):
+                data = resp.read()
+                if len(data) < 1048576:
+                    raise http.client.IncompleteRead(data)
+            conn.close()
+        finally:
+            router.stop_http()
+            upstream.shutdown()
+            upstream.server_close()

@@ -468,6 +468,81 @@ class TestKernelAuto:
         assert np.isfinite(float(r.sse))
 
 
+class TestKernelAutoQuantized:
+    """kernel='auto:quantized' — the opt-in spelling that lets auto pick
+    the PR-17 bf16-MXU epilogue where it applies (ROADMAP item 1: fold
+    the epilogue into the auto policy behind the PR-2 tolerance
+    contract). Everywhere the epilogue cannot apply it degrades to the
+    plain auto choice, never an error."""
+
+    def test_picks_bf16_epilogue_on_tpu_kmeans_f32(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto:quantized", k=1024, d=128,
+                              platform="tpu") == "pallas_bf16"
+
+    def test_on_cpu_is_xla(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto:quantized", k=64, d=8) == "xla"
+
+    def test_bf16_inputs_stay_plain_pallas(self):
+        # bf16 inputs already run the MXU at bf16 under plain pallas —
+        # the epilogue would change nothing, so auto does not name it.
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto:quantized", k=1024, d=128, itemsize=2,
+                              platform="tpu") == "pallas"
+
+    def test_non_kmeans_stays_plain_pallas(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto:quantized", k=256, d=32, model="fuzzy",
+                              platform="tpu") == "pallas"
+
+    def test_mxu_ineligible_stays_plain_pallas(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel(
+            "auto:quantized", k=1024, d=128, platform="tpu",
+            mxu_ineligible="the bf16-MXU epilogue has no shard_map tower",
+        ) == "pallas"
+
+    def test_over_vmem_is_xla(self):
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto:quantized", k=16384, d=768,
+                              platform="tpu") == "xla"
+
+    def test_plain_auto_never_picks_bf16(self):
+        # The numerics-preserving default: without the ':quantized'
+        # opt-in, auto must not round assignment distances.
+        from tdc_tpu.ops.pallas_kernels import resolve_kernel
+
+        assert resolve_kernel("auto", k=1024, d=128,
+                              platform="tpu") == "pallas"
+
+    def test_streamed_fit_accepts_auto_quantized(self, blobs256):
+        x, centers = blobs256
+        r_q = streamed_kmeans_fit(batches_of(x, 4096), 256, 16,
+                                  init=centers, max_iters=2, tol=-1.0,
+                                  kernel="auto:quantized")
+        r_xla = streamed_kmeans_fit(batches_of(x, 4096), 256, 16,
+                                    init=centers, max_iters=2, tol=-1.0,
+                                    kernel="xla")
+        # on the CPU CI the opt-in degrades to xla — bit-identical
+        np.testing.assert_array_equal(np.asarray(r_q.centroids),
+                                      np.asarray(r_xla.centroids))
+
+    def test_kmeans_fit_accepts_auto_quantized(self, blobs256):
+        from tdc_tpu.models.kmeans import kmeans_fit
+
+        x, centers = blobs256
+        r = kmeans_fit(x[:4096], 16, init="first_k", max_iters=3,
+                       kernel="auto:quantized")
+        assert np.isfinite(float(r.sse))
+
+
 # ---------------------------------------------------------------------------
 # metrics surface
 # ---------------------------------------------------------------------------
